@@ -1,0 +1,148 @@
+"""mpi_tpu — a TPU-native message-passing framework.
+
+Capability-parity rebuild of the reference MPI-in-Python library
+(mgawino/mpi; see SURVEY.md — the reference checkout itself was empty this
+session, so SURVEY.md §0's contract extraction from BASELINE.json is the
+blueprint).  Two backends behind one Communicator plugin boundary
+(BASELINE.json:5):
+
+* ``backend=socket`` — TCP/pickle CPU transport + mpirun-alike launcher; the
+  source-compatibility proof and CPU baseline (SURVEY.md §7 Milestone 0).
+* ``backend=tpu`` — MPI_COMM_WORLD bound to a ``jax.sharding.Mesh``; p2p
+  lowers to ``lax.ppermute``; collectives re-emit as ``lax.psum`` /
+  ``lax.all_gather`` / ``lax.all_to_all`` over ICI, with hand-scheduled
+  ring / recursive-halving / tree algorithm variants (Milestones 1-2).
+
+Also ``backend=local`` (threads, in-process) for fast tests and fault
+injection.
+
+Portable programs are written as ``def main(comm): ...`` and dispatched with
+:func:`run`; classic per-process MPI scripts use :data:`COMM_WORLD` or the
+flat ``MPI_*`` layer in :mod:`mpi_tpu.api`.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Any, Callable, Optional
+
+from .version import __version__
+from . import ops
+from .ops import SUM, PROD, MAX, MIN, LAND, LOR, LXOR, BAND, BOR, BXOR, ReduceOp
+from .communicator import Communicator, P2PCommunicator, Status
+from .transport.base import ANY_SOURCE, ANY_TAG
+from .transport.local import run_local
+from . import schedules, checker
+
+__all__ = [
+    "__version__", "ops", "ReduceOp",
+    "SUM", "PROD", "MAX", "MIN", "LAND", "LOR", "LXOR", "BAND", "BOR", "BXOR",
+    "Communicator", "P2PCommunicator", "Status", "ANY_SOURCE", "ANY_TAG",
+    "init", "finalize", "is_initialized", "run", "run_local",
+    "schedules", "checker", "COMM_WORLD",
+]
+
+_ENV_RANK = "MPI_TPU_RANK"
+_ENV_SIZE = "MPI_TPU_SIZE"
+_ENV_RDV = "MPI_TPU_RDV"
+_ENV_BACKEND = "MPI_TPU_BACKEND"
+
+_world: Optional[P2PCommunicator] = None
+_world_lock = threading.Lock()
+
+
+def is_initialized() -> bool:
+    return _world is not None
+
+
+def init(backend: Optional[str] = None) -> Communicator:
+    """Create (or return) the world communicator — MPI_Init + MPI_COMM_WORLD
+    (SURVEY.md §2 component #10).
+
+    Under the launcher (``python -m mpi_tpu.launcher -n N script.py``) this
+    builds the socket transport from the launcher-provided environment;
+    standalone it returns a single-rank world.
+    """
+    global _world
+    with _world_lock:
+        if _world is not None:
+            return _world
+        backend = backend or os.environ.get(_ENV_BACKEND) or (
+            "socket" if _ENV_RANK in os.environ else "self"
+        )
+        if backend == "socket":
+            rank = int(os.environ[_ENV_RANK])
+            size = int(os.environ[_ENV_SIZE])
+            rdv = os.environ[_ENV_RDV]
+            from .transport.socket import SocketTransport
+
+            t = SocketTransport(rank, size, rdv)
+            _world = P2PCommunicator(t, range(size))
+        elif backend in ("self", "local"):
+            from .transport.local import LocalTransport, LocalWorld
+
+            t = LocalTransport(LocalWorld(1), 0)
+            _world = P2PCommunicator(t, range(1))
+        else:
+            raise ValueError(
+                f"unknown backend {backend!r} for process-world init; "
+                "the TPU backend is entered via mpi_tpu.run(fn, backend='tpu') "
+                "or mpi_tpu.tpu.run_spmd (it is an SPMD program, not a process world)"
+            )
+        return _world
+
+
+def finalize() -> None:
+    """MPI_Finalize: synchronize, close the transport, and report unexpected
+    pending messages (the finalize-time sanitizer check, SURVEY.md §5)."""
+    global _world
+    with _world_lock:
+        if _world is None:
+            return
+        _world.barrier()
+        pending = _world.close_transport()
+        _world = None
+    if pending:
+        import warnings
+
+        warnings.warn(f"MPI_Finalize: {len(pending)} unreceived message(s): {pending[:8]}")
+
+
+def run(
+    fn: Callable,
+    *args: Any,
+    backend: Optional[str] = None,
+    nranks: Optional[int] = None,
+    **kwargs: Any,
+):
+    """Run a portable MPI program ``fn(comm, *args, **kwargs)``.
+
+    * ``backend='socket'`` (or under the launcher): calls ``fn`` with this
+      process's world communicator; returns its local result.
+    * ``backend='local'``: spawns ``nranks`` threads in-process; returns the
+      list of per-rank results.
+    * ``backend='tpu'``: traces ``fn`` once as an SPMD program over a device
+      mesh (shard_map) and executes it on all devices; returns the stacked
+      per-rank results (SURVEY.md §7 Milestone 1).
+    """
+    backend = backend or os.environ.get(_ENV_BACKEND) or (
+        "socket" if _ENV_RANK in os.environ else "local"
+    )
+    if backend in ("socket", "self"):
+        return fn(init(backend), *args, **kwargs)
+    if backend == "local":
+        if nranks is None:
+            nranks = int(os.environ.get(_ENV_SIZE, "1"))
+        return run_local(fn, nranks, args=args, kwargs=kwargs)
+    if backend == "tpu":
+        from .tpu import run_spmd
+
+        return run_spmd(fn, *args, nranks=nranks, **kwargs)
+    raise ValueError(f"unknown backend {backend!r}")
+
+
+def __getattr__(name: str):
+    if name == "COMM_WORLD":
+        return init()
+    raise AttributeError(f"module 'mpi_tpu' has no attribute {name!r}")

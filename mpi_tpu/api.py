@@ -1,0 +1,129 @@
+"""Flat MPI_* function layer (L4 of SURVEY.md §1; BASELINE.json:5 API surface).
+
+Thin wrappers over the world communicator so classic MPI-style programs read
+naturally::
+
+    from mpi_tpu.api import *
+    MPI_Init()
+    rank = MPI_Comm_rank()
+    if rank == 0:
+        MPI_Send(data, dest=1)
+    ...
+    MPI_Finalize()
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Sequence
+
+from . import ops
+from .communicator import Communicator, Status
+from .transport.base import ANY_SOURCE, ANY_TAG
+
+__all__ = [
+    "MPI_Init", "MPI_Finalize", "MPI_Initialized", "MPI_COMM_WORLD",
+    "MPI_Comm_rank", "MPI_Comm_size", "MPI_Send", "MPI_Recv", "MPI_Sendrecv",
+    "MPI_Bcast", "MPI_Reduce", "MPI_Allreduce", "MPI_Allgather", "MPI_Alltoall",
+    "MPI_Barrier", "MPI_Comm_split", "MPI_Comm_dup", "MPI_Scatter", "MPI_Gather",
+    "ANY_SOURCE", "ANY_TAG", "SUM", "PROD", "MAX", "MIN", "Status",
+]
+
+SUM, PROD, MAX, MIN = ops.SUM, ops.PROD, ops.MAX, ops.MIN
+
+
+def _world(comm: Optional[Communicator]) -> Communicator:
+    if comm is not None:
+        return comm
+    from . import init
+
+    return init()
+
+
+def MPI_Init(backend: Optional[str] = None) -> Communicator:
+    from . import init
+
+    return init(backend)
+
+
+def MPI_Initialized() -> bool:
+    from . import is_initialized
+
+    return is_initialized()
+
+
+def MPI_Finalize() -> None:
+    from . import finalize
+
+    finalize()
+
+
+def MPI_COMM_WORLD() -> Communicator:
+    return _world(None)
+
+
+def MPI_Comm_rank(comm: Optional[Communicator] = None) -> int:
+    return _world(comm).rank
+
+
+def MPI_Comm_size(comm: Optional[Communicator] = None) -> int:
+    return _world(comm).size
+
+
+def MPI_Send(obj: Any, dest: int, tag: int = 0, comm: Optional[Communicator] = None) -> None:
+    _world(comm).send(obj, dest, tag)
+
+
+def MPI_Recv(source: int = ANY_SOURCE, tag: int = ANY_TAG,
+             comm: Optional[Communicator] = None,
+             status: Optional[Status] = None) -> Any:
+    return _world(comm).recv(source, tag, status)
+
+
+def MPI_Sendrecv(sendobj: Any, dest: int, source: int = ANY_SOURCE,
+                 sendtag: int = 0, recvtag: int = ANY_TAG,
+                 comm: Optional[Communicator] = None) -> Any:
+    return _world(comm).sendrecv(sendobj, dest, source, sendtag, recvtag)
+
+
+def MPI_Bcast(obj: Any, root: int = 0, comm: Optional[Communicator] = None) -> Any:
+    return _world(comm).bcast(obj, root)
+
+
+def MPI_Reduce(obj: Any, op: ops.ReduceOp = ops.SUM, root: int = 0,
+               comm: Optional[Communicator] = None) -> Any:
+    return _world(comm).reduce(obj, op, root)
+
+
+def MPI_Allreduce(obj: Any, op: ops.ReduceOp = ops.SUM, algorithm: str = "auto",
+                  comm: Optional[Communicator] = None) -> Any:
+    return _world(comm).allreduce(obj, op, algorithm)
+
+
+def MPI_Allgather(obj: Any, comm: Optional[Communicator] = None) -> Any:
+    return _world(comm).allgather(obj)
+
+
+def MPI_Alltoall(objs: Sequence[Any], comm: Optional[Communicator] = None) -> Any:
+    return _world(comm).alltoall(objs)
+
+
+def MPI_Barrier(comm: Optional[Communicator] = None) -> None:
+    _world(comm).barrier()
+
+
+def MPI_Comm_split(color: Optional[int], key: int = 0,
+                   comm: Optional[Communicator] = None) -> Optional[Communicator]:
+    return _world(comm).split(color, key)
+
+
+def MPI_Comm_dup(comm: Optional[Communicator] = None) -> Communicator:
+    return _world(comm).dup()
+
+
+def MPI_Scatter(objs: Optional[Sequence[Any]], root: int = 0,
+                comm: Optional[Communicator] = None) -> Any:
+    return _world(comm).scatter(objs, root)
+
+
+def MPI_Gather(obj: Any, root: int = 0, comm: Optional[Communicator] = None) -> Any:
+    return _world(comm).gather(obj, root)

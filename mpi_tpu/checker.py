@@ -1,0 +1,103 @@
+"""Static schedule checking — the framework's race-detector analogue.
+
+SURVEY.md §5: the reference has no sanitizer; its race surface (tag matching
+across threads) disappears under SPMD, where the remaining failure mode is a
+malformed communication schedule.  This module validates schedules statically:
+every ppermute permutation must be a *partial permutation* (no rank sends
+twice, no rank receives twice in one round), and a whole schedule must deliver
+every payload exactly once.  The TPU backend runs these checks at trace time
+(they are pure-Python, zero cost on device); the CPU backends use them in
+tests; `verify_matching` cross-checks per-rank send/recv logs the way a
+message-race detector would (used with the recording communicator in
+mpi_tpu/trace.py).
+"""
+
+from __future__ import annotations
+
+from collections import Counter, deque
+from typing import Iterable, List, Sequence, Tuple
+
+Pair = Tuple[int, int]
+
+
+class ScheduleError(ValueError):
+    """A communication schedule is structurally invalid."""
+
+
+def validate_perm(pairs: Iterable[Pair], size: int) -> None:
+    """Check that ``pairs`` is a valid partial permutation over ``size`` ranks.
+
+    Raises ScheduleError if any rank appears twice as source or twice as
+    destination, or if any endpoint is out of range.  This is exactly the
+    precondition of ``lax.ppermute`` — violating it silently misdelivers on
+    some backends, which is the SPMD analogue of a data race.
+    """
+    pairs = list(pairs)
+    srcs = Counter(s for s, _ in pairs)
+    dsts = Counter(d for _, d in pairs)
+    for s, d in pairs:
+        if not (0 <= s < size and 0 <= d < size):
+            raise ScheduleError(f"pair ({s}, {d}) out of range for size {size}")
+    dup_s = [r for r, c in srcs.items() if c > 1]
+    dup_d = [r for r, c in dsts.items() if c > 1]
+    if dup_s or dup_d:
+        raise ScheduleError(
+            f"not a partial permutation: duplicate sources {dup_s}, "
+            f"duplicate destinations {dup_d}"
+        )
+
+
+def validate_rounds(rounds: Sequence[Sequence[Pair]], size: int) -> None:
+    for i, pairs in enumerate(rounds):
+        try:
+            validate_perm(pairs, size)
+        except ScheduleError as e:
+            raise ScheduleError(f"round {i}: {e}") from e
+
+
+def verify_matching(logs: Sequence[Sequence[tuple]]) -> List[str]:
+    """Cross-check per-rank communication logs for unmatched traffic.
+
+    ``logs[r]`` is rank r's ordered op log; entries are tuples
+    ``('send', dst, tag)`` or ``('recv', src, tag)`` (src/tag may be the
+    wildcard -1).  Returns a list of human-readable problems (empty = clean):
+    sends with no matching recv, recvs with no matching send.  Matching is
+    FIFO per (src, dst) channel, mirroring the transports' ordering guarantee
+    (SURVEY.md §2 component #2: FIFO per (source, tag) [S]).
+    """
+    problems: List[str] = []
+    size = len(logs)
+    # channel (src, dst) -> deque of send tags, in order
+    sends: dict = {}
+    for r, log in enumerate(logs):
+        for op in log:
+            if op[0] == "send":
+                _, dst, tag = op
+                sends.setdefault((r, dst), deque()).append(tag)
+    for r, log in enumerate(logs):
+        for op in log:
+            if op[0] != "recv":
+                continue
+            _, src, tag = op
+            candidates = (
+                [(s, r) for s in range(size)] if src == -1 else [(src, r)]
+            )
+            matched = False
+            for ch in candidates:
+                q = sends.get(ch)
+                if not q:
+                    continue
+                if tag == -1 or q[0] == tag or tag in q:
+                    # consume the first tag-compatible send on this channel
+                    if tag == -1 or q[0] == tag:
+                        q.popleft()
+                    else:
+                        q.remove(tag)
+                    matched = True
+                    break
+            if not matched:
+                problems.append(f"rank {r}: recv(src={src}, tag={tag}) has no matching send")
+    for (s, d), q in sends.items():
+        for tag in q:
+            problems.append(f"rank {s}: send(dst={d}, tag={tag}) was never received")
+    return problems

@@ -1,0 +1,445 @@
+"""The Communicator — rank/size bookkeeping, p2p, collectives, split.
+
+This is L2+L3+L4 of SURVEY.md §1: the abstract Communicator is the plugin
+boundary the whole framework hangs off (BASELINE.json:5 — "Communicator
+rank/size bookkeeping and comm.split() stay intact behind the existing
+Communicator plugin boundary").  Concrete subclasses:
+
+* :class:`P2PCommunicator` — any point-to-point Transport (socket, local
+  threads); collectives are *executed* from the shared schedule generators in
+  mpi_tpu/schedules.py (tree bcast/reduce, ring and recursive-halving
+  allreduce, ring/doubling allgather, pairwise alltoall — BASELINE.json:8,10).
+* mpi_tpu.tpu.TpuCommunicator — the headline backend: same API, re-emitted as
+  XLA collectives / ppermute schedules over a device mesh (SURVEY.md §7).
+
+API conventions (MPI-1.x semantics [S], pythonic spelling):
+* comm-rank space everywhere; world ranks are an internal detail.
+* user tags are ints >= 0; wildcards ANY_SOURCE / ANY_TAG = -1.  Internal
+  traffic (collectives, barrier, shift) uses negative tags that user
+  wildcards can never match (see transport/base.py).
+* reductions accept numpy-convertible payloads; bcast/p2p/allgather/alltoall
+  accept arbitrary picklable objects on CPU backends.
+"""
+
+from __future__ import annotations
+
+import threading
+from abc import ABC, abstractmethod
+from typing import Any, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from . import ops as _ops
+from . import schedules
+from .transport.base import ANY_SOURCE, ANY_TAG, Transport
+
+# Internal tags (never matched by user-level ANY_TAG — see Mailbox._matches).
+_TAG_COLL = -2
+_TAG_SHIFT = -3
+_TAG_BARRIER = -4
+_TAG_SPLIT = -5
+
+
+class Status:
+    """Result metadata for a receive (MPI_Status analogue)."""
+
+    __slots__ = ("source", "tag")
+
+    def __init__(self) -> None:
+        self.source = ANY_SOURCE
+        self.tag = ANY_TAG
+
+    def __repr__(self) -> str:  # pragma: no cover - cosmetic
+        return f"Status(source={self.source}, tag={self.tag})"
+
+
+def _check_user_tag(tag: int) -> None:
+    if tag != ANY_TAG and tag < 0:
+        raise ValueError(f"user tags must be >= 0 (got {tag}); negative tags are reserved")
+
+
+def _as_array(obj: Any) -> Tuple[np.ndarray, bool]:
+    """Coerce a reduction payload to an ndarray; remember scalar-ness."""
+    arr = np.asarray(obj)
+    return arr, arr.ndim == 0
+
+
+def _unwrap(arr: np.ndarray, was_scalar: bool) -> Any:
+    return arr[()] if was_scalar else arr
+
+
+class Communicator(ABC):
+    """Abstract communicator: the API user MPI programs are written against."""
+
+    # -- identity ----------------------------------------------------------
+
+    @property
+    @abstractmethod
+    def rank(self):
+        """This process's rank in this communicator (0..size-1)."""
+
+    @property
+    @abstractmethod
+    def size(self) -> int:
+        """Number of ranks in this communicator."""
+
+    # -- point-to-point ----------------------------------------------------
+
+    @abstractmethod
+    def send(self, obj: Any, dest: int, tag: int = 0) -> None:
+        """Blocking standard-mode send (buffered; completes locally)."""
+
+    @abstractmethod
+    def recv(self, source: int = ANY_SOURCE, tag: int = ANY_TAG,
+             status: Optional[Status] = None) -> Any:
+        """Blocking matched receive; returns the payload."""
+
+    @abstractmethod
+    def sendrecv(self, sendobj: Any, dest: int, source: int = ANY_SOURCE,
+                 sendtag: int = 0, recvtag: int = ANY_TAG,
+                 status: Optional[Status] = None) -> Any:
+        """Combined send+receive (deadlock-free halo-exchange primitive)."""
+
+    @abstractmethod
+    def shift(self, obj: Any, offset: int = 1, wrap: bool = True, fill: Any = None) -> Any:
+        """Portable neighbor exchange: every rank sends ``obj`` to
+        ``rank+offset`` and returns the payload from ``rank-offset``.
+
+        With ``wrap=False`` boundary ranks send/receive nothing and the
+        receiver-side hole is filled with ``fill``.  This is the portable
+        spelling of the Jacobi halo exchange (BASELINE.json:11): on CPU
+        backends it is a sendrecv pair, on TPU it is exactly one
+        ``lax.ppermute`` (SURVEY.md §3.2).
+        """
+
+    # -- collectives -------------------------------------------------------
+
+    @abstractmethod
+    def bcast(self, obj: Any, root: int = 0) -> Any: ...
+
+    @abstractmethod
+    def reduce(self, obj: Any, op: _ops.ReduceOp = _ops.SUM, root: int = 0) -> Any: ...
+
+    @abstractmethod
+    def allreduce(self, obj: Any, op: _ops.ReduceOp = _ops.SUM,
+                  algorithm: str = "auto") -> Any: ...
+
+    @abstractmethod
+    def allgather(self, obj: Any, algorithm: str = "auto") -> Any: ...
+
+    @abstractmethod
+    def alltoall(self, objs: Sequence[Any], algorithm: str = "auto") -> Any: ...
+
+    @abstractmethod
+    def barrier(self) -> None: ...
+
+    def scatter(self, objs: Optional[Sequence[Any]], root: int = 0) -> Any:
+        raise NotImplementedError(f"{type(self).__name__} does not implement scatter")
+
+    def gather(self, obj: Any, root: int = 0) -> Optional[List[Any]]:
+        raise NotImplementedError(f"{type(self).__name__} does not implement gather")
+
+    # -- communicator management ------------------------------------------
+
+    @abstractmethod
+    def split(self, color: Optional[int], key: int = 0) -> Optional["Communicator"]:
+        """MPI_Comm_split [S]: ranks sharing ``color`` form a new communicator
+        ordered by (key, old rank); ``color=None`` opts out (returns None)."""
+
+    @abstractmethod
+    def dup(self) -> "Communicator":
+        """New communicator over the same group with isolated message space."""
+
+    def free(self) -> None:
+        """Release resources (no-op for sub-communicators by default)."""
+
+
+class P2PCommunicator(Communicator):
+    """Communicator over any point-to-point Transport (socket / local threads).
+
+    Collectives execute the shared schedules from mpi_tpu/schedules.py with
+    real sends/receives — this is the reference's architecture (SURVEY.md §1:
+    L3 composes L2 primitives).
+    """
+
+    def __init__(self, transport: Transport, group: Sequence[int], context=0):
+        self._t = transport
+        self._group: Tuple[int, ...] = tuple(group)
+        if transport.world_rank not in self._group:
+            raise ValueError(
+                f"world rank {transport.world_rank} not in group {self._group}"
+            )
+        self._rank = self._group.index(transport.world_rank)
+        self._ctx = context
+        self._nchildren = 0
+        self._lock = threading.Lock()
+
+    # -- identity ----------------------------------------------------------
+
+    @property
+    def rank(self) -> int:
+        return self._rank
+
+    @property
+    def size(self) -> int:
+        return len(self._group)
+
+    @property
+    def context(self) -> int:
+        return self._ctx
+
+    def _world(self, comm_rank: int) -> int:
+        if not (0 <= comm_rank < self.size):
+            raise ValueError(f"rank {comm_rank} out of range for communicator of size {self.size}")
+        return self._group[comm_rank]
+
+    def _from_world(self, world_rank: int) -> int:
+        return self._group.index(world_rank)
+
+    # -- point-to-point ----------------------------------------------------
+
+    def send(self, obj: Any, dest: int, tag: int = 0) -> None:
+        _check_user_tag(tag)
+        self._send_internal(obj, dest, tag)
+
+    def _send_internal(self, obj: Any, dest: int, tag: int) -> None:
+        self._t.send(self._world(dest), self._ctx, tag, obj)
+
+    def recv(self, source: int = ANY_SOURCE, tag: int = ANY_TAG,
+             status: Optional[Status] = None) -> Any:
+        _check_user_tag(tag)
+        return self._recv_internal(source, tag, status)
+
+    def _recv_internal(self, source: int, tag: int,
+                       status: Optional[Status] = None) -> Any:
+        src_world = ANY_SOURCE if source == ANY_SOURCE else self._world(source)
+        obj, src, t = self._t.recv(src_world, self._ctx, tag)
+        if status is not None:
+            status.source = self._from_world(src)
+            status.tag = t
+        return obj
+
+    def sendrecv(self, sendobj: Any, dest: int, source: int = ANY_SOURCE,
+                 sendtag: int = 0, recvtag: int = ANY_TAG,
+                 status: Optional[Status] = None) -> Any:
+        # Deadlock-free because transports buffer sends and drain receives on
+        # dedicated threads (SURVEY.md §2 component #2 internals).
+        self.send(sendobj, dest, sendtag)
+        return self.recv(source, recvtag, status)
+
+    def _sendrecv_internal(self, sendobj: Any, dest: int, source: int, tag: int) -> Any:
+        self._send_internal(sendobj, dest, tag)
+        return self._recv_internal(source, tag)
+
+    def shift(self, obj: Any, offset: int = 1, wrap: bool = True, fill: Any = None) -> Any:
+        p, r = self.size, self._rank
+        d, s = r + offset, r - offset
+        if wrap:
+            return self._sendrecv_internal(obj, d % p, s % p, _TAG_SHIFT)
+        if 0 <= d < p:
+            self._send_internal(obj, d, _TAG_SHIFT)
+        if 0 <= s < p:
+            return self._recv_internal(s, _TAG_SHIFT)
+        return fill
+
+    # -- collectives -------------------------------------------------------
+
+    def bcast(self, obj: Any, root: int = 0) -> Any:
+        # Binomial tree, log2(P) rounds (BASELINE.json:8).
+        for pairs in schedules.binomial_bcast_rounds(self.size, root):
+            for s, d in pairs:
+                if self._rank == s:
+                    self._send_internal(obj, d, _TAG_COLL)
+                elif self._rank == d:
+                    obj = self._recv_internal(s, _TAG_COLL)
+        return obj
+
+    def reduce(self, obj: Any, op: _ops.ReduceOp = _ops.SUM, root: int = 0) -> Any:
+        arr, scalar = _as_array(obj)
+        acc = arr.copy()
+        for pairs in schedules.binomial_reduce_rounds(self.size, root):
+            for s, d in pairs:
+                if self._rank == s:
+                    self._send_internal(acc, d, _TAG_COLL)
+                elif self._rank == d:
+                    acc = op.combine(acc, self._recv_internal(s, _TAG_COLL))
+        return _unwrap(acc, scalar) if self._rank == root else None
+
+    def allreduce(self, obj: Any, op: _ops.ReduceOp = _ops.SUM,
+                  algorithm: str = "auto") -> Any:
+        arr, scalar = _as_array(obj)
+        if algorithm == "auto":
+            # Latency-optimal recursive halving for small payloads on
+            # power-of-two groups; bandwidth-optimal ring otherwise
+            # (the crossover the reference benchmarks head-to-head,
+            # BASELINE.json:10).
+            if schedules.is_pow2(self.size) and arr.nbytes < (64 << 10):
+                algorithm = "recursive_halving"
+            else:
+                algorithm = "ring"
+        if self.size == 1:
+            return _unwrap(arr.copy(), scalar)
+        if algorithm == "ring":
+            out = self._allreduce_ring(arr, op)
+        elif algorithm == "recursive_halving":
+            out = self._allreduce_halving(arr, op)
+        elif algorithm == "reduce_bcast":
+            out = self.bcast(self.reduce(arr, op, root=0), root=0)
+        else:
+            raise ValueError(f"unknown allreduce algorithm {algorithm!r}")
+        return _unwrap(np.asarray(out), scalar)
+
+    def _allreduce_ring(self, arr: np.ndarray, op: _ops.ReduceOp) -> np.ndarray:
+        # Reduce-scatter ring + allgather ring, 2(P-1) steps (SURVEY.md §3.3).
+        p, r = self.size, self._rank
+        shape, dtype = arr.shape, arr.dtype
+        chunks = np.array_split(arr.reshape(-1), p)
+        chunks = [c.copy() for c in chunks]
+        right, left = (r + 1) % p, (r - 1) % p
+        for step in range(p - 1):
+            si = schedules.ring_rs_send_chunk(r, step, p)
+            ri = schedules.ring_rs_recv_chunk(r, step, p)
+            recvd = self._sendrecv_internal(chunks[si], right, left, _TAG_COLL)
+            chunks[ri] = op.combine(chunks[ri], recvd)
+        for step in range(p - 1):
+            si = schedules.ring_ag_send_chunk(r, step, p)
+            ri = schedules.ring_ag_recv_chunk(r, step, p)
+            chunks[ri] = self._sendrecv_internal(chunks[si], right, left, _TAG_COLL)
+        return np.concatenate(chunks).reshape(shape).astype(dtype, copy=False)
+
+    def _allreduce_halving(self, arr: np.ndarray, op: _ops.ReduceOp) -> np.ndarray:
+        # Recursive-halving reduce-scatter + recursive-doubling allgather
+        # (power-of-two only; latency-optimal [S]; BASELINE.json:10).
+        p, r = self.size, self._rank
+        shape, dtype = arr.shape, arr.dtype
+        chunks = [c.copy() for c in np.array_split(arr.reshape(-1), p)]
+        masks = schedules.halving_masks(p)
+        lo, hi = 0, p
+        for mask in masks:
+            partner = r ^ mask
+            mid = (lo + hi) // 2
+            if r & mask:
+                mine, theirs = (mid, hi), (lo, mid)
+            else:
+                mine, theirs = (lo, mid), (mid, hi)
+            recvd = self._sendrecv_internal(chunks[theirs[0]:theirs[1]], partner,
+                                            partner, _TAG_COLL)
+            lo, hi = mine
+            for i, c in zip(range(lo, hi), recvd):
+                chunks[i] = op.combine(chunks[i], c)
+        # now [lo, hi) == [r, r+1): rank r holds reduced chunk r
+        for mask in reversed(masks):
+            partner = r ^ mask
+            recvd = self._sendrecv_internal(chunks[lo:hi], partner, partner, _TAG_COLL)
+            w = hi - lo
+            if r & mask:
+                chunks[lo - w:lo] = recvd
+                lo -= w
+            else:
+                chunks[hi:hi + w] = recvd
+                hi += w
+        return np.concatenate(chunks).reshape(shape).astype(dtype, copy=False)
+
+    def allgather(self, obj: Any, algorithm: str = "auto") -> List[Any]:
+        p, r = self.size, self._rank
+        if algorithm == "auto":
+            algorithm = "doubling" if schedules.is_pow2(p) else "ring"
+        items: List[Any] = [None] * p
+        items[r] = obj
+        if p == 1:
+            return items
+        if algorithm == "ring":
+            right, left = (r + 1) % p, (r - 1) % p
+            for step in range(p - 1):
+                si = schedules.ring_ag_send_chunk(r, step + 1, p)
+                ri = schedules.ring_ag_recv_chunk(r, step + 1, p)
+                items[ri] = self._sendrecv_internal(items[si], right, left, _TAG_COLL)
+        elif algorithm == "doubling":
+            owned = {r: obj}
+            for mask in schedules.doubling_masks(p):
+                partner = r ^ mask
+                recvd = self._sendrecv_internal(owned, partner, partner, _TAG_COLL)
+                owned.update(recvd)
+            for i, v in owned.items():
+                items[i] = v
+        else:
+            raise ValueError(f"unknown allgather algorithm {algorithm!r}")
+        return items
+
+    def alltoall(self, objs: Sequence[Any], algorithm: str = "auto") -> List[Any]:
+        p, r = self.size, self._rank
+        if len(objs) != p:
+            raise ValueError(f"alltoall needs one payload per rank ({p}), got {len(objs)}")
+        result: List[Any] = [None] * p
+        result[r] = objs[r]
+        # Pairwise exchange, P-1 rounds (BASELINE.json:9; SURVEY.md §2 #9).
+        for k in schedules.alltoall_rounds(p):
+            dst, src = (r + k) % p, (r - k) % p
+            result[src] = self._sendrecv_internal(objs[dst], dst, src, _TAG_COLL)
+        return result
+
+    def barrier(self) -> None:
+        # Dissemination barrier, ceil(log2 P) rounds [S].
+        p, r = self.size, self._rank
+        for off in schedules.dissemination_offsets(p):
+            self._send_internal(None, (r + off) % p, _TAG_BARRIER)
+            self._recv_internal((r - off) % p, _TAG_BARRIER)
+
+    def scatter(self, objs: Optional[Sequence[Any]], root: int = 0) -> Any:
+        if self._rank == root:
+            if objs is None or len(objs) != self.size:
+                raise ValueError(f"scatter root needs one payload per rank ({self.size})")
+            for d in range(self.size):
+                if d != root:
+                    self._send_internal(objs[d], d, _TAG_COLL)
+            return objs[root]
+        return self._recv_internal(root, _TAG_COLL)
+
+    def gather(self, obj: Any, root: int = 0) -> Optional[List[Any]]:
+        if self._rank == root:
+            items: List[Any] = [None] * self.size
+            items[root] = obj
+            for s in range(self.size):
+                if s != root:
+                    items[s] = self._recv_internal(s, _TAG_COLL)
+            return items
+        self._send_internal(obj, root, _TAG_COLL)
+        return None
+
+    # -- communicator management ------------------------------------------
+
+    def _alloc_context(self):
+        # Deterministic across ranks: split/dup are collective, so every rank
+        # performs the same sequence of allocations on this communicator.
+        # Tree-path tuples (parent_ctx, n) are collision-free across
+        # generations by construction (unlike any fixed-width arithmetic
+        # encoding) and transports treat contexts as opaque hashables.
+        with self._lock:
+            self._nchildren += 1
+            return (self._ctx, self._nchildren)
+
+    def split(self, color: Optional[int], key: int = 0) -> Optional["P2PCommunicator"]:
+        infos = self.allgather((color, key))
+        ctx = self._alloc_context()
+        if color is None:
+            return None
+        members = sorted(
+            (k, cr) for cr, (c, k) in enumerate(infos) if c == color
+        )
+        group = [self._group[cr] for _, cr in members]
+        return P2PCommunicator(self._t, group, ctx)
+
+    def dup(self) -> "P2PCommunicator":
+        self.barrier()  # collectiveness check + sync, like MPI_Comm_dup
+        ctx = self._alloc_context()
+        return P2PCommunicator(self._t, self._group, ctx)
+
+    def free(self) -> None:
+        pass
+
+    def close_transport(self) -> List[Tuple[int, int, int]]:
+        """Finalize-time shutdown: returns any unexpected pending messages
+        (the 'unreceived message' sanitizer check, SURVEY.md §5)."""
+        pending = self._t.mailbox.drain()
+        self._t.close()
+        return pending

@@ -1,0 +1,103 @@
+"""mpirun-alike launcher (SURVEY.md §2 component #1).
+
+Spawns N rank processes of a user script, assigns ranks 0..N-1 via
+environment, hands them a file-based rendezvous directory for port exchange
+(see transport/socket.py), propagates the first nonzero exit code, and
+kills the remaining ranks on failure — the L0 contract of SURVEY.md §1.
+
+Usage::
+
+    python -m mpi_tpu.launcher -n 4 examples/pi.py [script args...]
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import shutil
+import signal
+import subprocess
+import sys
+import tempfile
+import time
+from typing import List, Optional, Sequence
+
+ENV_RANK = "MPI_TPU_RANK"
+ENV_SIZE = "MPI_TPU_SIZE"
+ENV_RDV = "MPI_TPU_RDV"
+ENV_BACKEND = "MPI_TPU_BACKEND"
+
+
+def launch(
+    nranks: int,
+    argv: Sequence[str],
+    env_extra: Optional[dict] = None,
+    timeout: Optional[float] = None,
+) -> int:
+    """Run ``python argv...`` as ``nranks`` rank processes; return exit code."""
+    if nranks < 1:
+        raise ValueError(f"nranks must be >= 1, got {nranks}")
+    rdv = tempfile.mkdtemp(prefix="mpi_tpu_rdv_")
+    procs: List[subprocess.Popen] = []
+    try:
+        for r in range(nranks):
+            env = dict(os.environ)
+            env.update(
+                {
+                    ENV_RANK: str(r),
+                    ENV_SIZE: str(nranks),
+                    ENV_RDV: rdv,
+                    ENV_BACKEND: env.get(ENV_BACKEND, "socket"),
+                }
+            )
+            if env_extra:
+                env.update(env_extra)
+            procs.append(subprocess.Popen([sys.executable, *argv], env=env))
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while True:
+            codes = [p.poll() for p in procs]
+            bad = [c for c in codes if c not in (None, 0)]
+            if bad:
+                _kill_all(procs)
+                return bad[0]
+            if all(c == 0 for c in codes):
+                return 0
+            if deadline is not None and time.monotonic() > deadline:
+                _kill_all(procs)
+                raise TimeoutError(f"ranks still running after {timeout}s")
+            time.sleep(0.02)
+    finally:
+        _kill_all(procs)
+        shutil.rmtree(rdv, ignore_errors=True)
+
+
+def _kill_all(procs: List[subprocess.Popen]) -> None:
+    for p in procs:
+        if p.poll() is None:
+            p.terminate()
+    deadline = time.monotonic() + 5.0
+    for p in procs:
+        if p.poll() is None:
+            try:
+                p.wait(max(0.0, deadline - time.monotonic()))
+            except subprocess.TimeoutExpired:
+                p.send_signal(signal.SIGKILL)
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="mpi_tpu.launcher", description="mpirun-alike launcher for mpi_tpu"
+    )
+    parser.add_argument("-n", "--np", type=int, required=True, dest="nranks",
+                        help="number of rank processes")
+    parser.add_argument("--timeout", type=float, default=None,
+                        help="kill all ranks after this many seconds")
+    parser.add_argument("script", help="python script to run on every rank")
+    parser.add_argument("script_args", nargs=argparse.REMAINDER,
+                        help="arguments passed to the script")
+    args = parser.parse_args(argv)
+    return launch(args.nranks, [args.script, *args.script_args], timeout=args.timeout)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

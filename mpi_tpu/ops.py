@@ -1,0 +1,129 @@
+"""Reduction operators for mpi_tpu collectives.
+
+Capability contract: SURVEY.md §2 (components #6, #7) — the reference's
+collective layer reduces with SUM at minimum; MPI-1.x additionally defines
+MAX / MIN / PROD and the logical / bitwise ops [S].  (The reference checkout
+at /root/reference is empty this session — see SURVEY.md §0 — so the MPI
+standard is the behavioral contract.)
+
+Each op carries an elementwise ``combine`` (works on numpy arrays, python
+scalars, and jax tracers alike) plus a dtype-aware ``identity`` so tree /
+masked-ppermute schedules can pad with neutral elements
+(mpi_tpu/tpu/collectives.py).
+"""
+
+from __future__ import annotations
+
+import operator
+from dataclasses import dataclass
+from typing import Any, Callable
+
+import numpy as np
+
+
+def _is_jax(x: Any) -> bool:
+    mod = type(x).__module__
+    return mod.startswith("jax") or mod.startswith("jaxlib")
+
+
+def _maximum(a, b):
+    if _is_jax(a) or _is_jax(b):
+        import jax.numpy as jnp
+
+        return jnp.maximum(a, b)
+    return np.maximum(a, b)
+
+
+def _minimum(a, b):
+    if _is_jax(a) or _is_jax(b):
+        import jax.numpy as jnp
+
+        return jnp.minimum(a, b)
+    return np.minimum(a, b)
+
+
+@dataclass(frozen=True)
+class ReduceOp:
+    """An MPI reduction operator: elementwise combiner + dtype-aware identity."""
+
+    name: str
+    combine: Callable[[Any, Any], Any]
+    identity: Callable[[Any], Any]  # np.dtype -> neutral scalar
+    commutative: bool = True
+
+    def __repr__(self) -> str:  # pragma: no cover - cosmetic
+        return f"ReduceOp({self.name})"
+
+
+def _id_sum(dtype):
+    return np.zeros((), dtype=dtype)[()]
+
+
+def _id_prod(dtype):
+    return np.ones((), dtype=dtype)[()]
+
+
+def _id_max(dtype):
+    dtype = np.dtype(dtype)
+    if dtype.kind == "f":
+        return dtype.type(-np.inf)
+    if dtype.kind in "iu":
+        return dtype.type(np.iinfo(dtype).min)
+    if dtype.kind == "b":
+        return False
+    raise TypeError(f"MAX has no identity for dtype {dtype}")
+
+
+def _id_min(dtype):
+    dtype = np.dtype(dtype)
+    if dtype.kind == "f":
+        return dtype.type(np.inf)
+    if dtype.kind in "iu":
+        return dtype.type(np.iinfo(dtype).max)
+    if dtype.kind == "b":
+        return True
+    raise TypeError(f"MIN has no identity for dtype {dtype}")
+
+
+def _id_band(dtype):
+    dtype = np.dtype(dtype)
+    if dtype.kind == "b":
+        return True
+    if dtype.kind in "iu":
+        return dtype.type(-1) if dtype.kind == "i" else dtype.type(np.iinfo(dtype).max)
+    raise TypeError(f"BAND has no identity for dtype {dtype}")
+
+
+def _id_false(dtype):
+    dtype = np.dtype(dtype)
+    if dtype.kind == "b":
+        return False
+    if dtype.kind in "iu":
+        return dtype.type(0)
+    raise TypeError(f"bitwise/logical op has no identity for dtype {dtype}")
+
+
+def _id_true(dtype):
+    dtype = np.dtype(dtype)
+    if dtype.kind == "b":
+        return True
+    if dtype.kind in "iu":
+        return dtype.type(1)
+    raise TypeError(f"LAND has no identity for dtype {dtype}")
+
+
+SUM = ReduceOp("sum", operator.add, _id_sum)
+PROD = ReduceOp("prod", operator.mul, _id_prod)
+MAX = ReduceOp("max", _maximum, _id_max)
+MIN = ReduceOp("min", _minimum, _id_min)
+# Logical ops are defined on bool payloads (MPI's int-as-logical is not
+# replicated; pass bool arrays).  Bitwise ops are defined on bool/int payloads.
+LAND = ReduceOp("land", operator.and_, _id_true)
+LOR = ReduceOp("lor", operator.or_, _id_false)
+LXOR = ReduceOp("lxor", operator.xor, _id_false)
+BAND = ReduceOp("band", operator.and_, _id_band)
+BOR = ReduceOp("bor", operator.or_, _id_false)
+BXOR = ReduceOp("bxor", operator.xor, _id_false)
+
+ALL_OPS = (SUM, PROD, MAX, MIN, LAND, LOR, LXOR, BAND, BOR, BXOR)
+BY_NAME = {op.name: op for op in ALL_OPS}

@@ -1,0 +1,165 @@
+"""Pure collective-schedule generators — the shared algorithm layer (L3).
+
+These are pure functions from rank-geometry to message schedules, consumed by
+BOTH backends: the CPU transports execute them with real send/recv
+(mpi_tpu/communicator.py) and the TPU backend re-emits each round as a
+(masked) ``lax.ppermute`` step (mpi_tpu/tpu/collectives.py).  Sharing L3 is a
+deliberate structural decision: SURVEY.md §1 notes the reference's collective
+algorithms are written against the Communicator boundary, not the transport,
+and §7 Milestone 2 requires the same schedule generators to drive both
+backends so the algorithm-vs-algorithm benchmark dimension (BASELINE.json:10:
+ring-allreduce vs recursive-halving; BASELINE.json:8: tree bcast/reduce)
+exists everywhere.
+
+Conventions
+-----------
+* A *round* of pairwise traffic is a list of ``(src, dst)`` comm-rank pairs.
+  Within one round every rank appears at most once as src and at most once as
+  dst (a partial permutation) — validated by mpi_tpu.checker.validate_perm.
+* Chunk-index helpers are written so ``rank`` may be a Python int (CPU
+  backends) or a traced jax scalar (TPU backend): only ``+ - %`` on the rank.
+"""
+
+from __future__ import annotations
+
+from typing import List, Tuple
+
+Pair = Tuple[int, int]
+
+
+def is_pow2(n: int) -> bool:
+    return n >= 1 and (n & (n - 1)) == 0
+
+
+# ---------------------------------------------------------------------------
+# Binomial trees (MPI_Bcast / MPI_Reduce — BASELINE.json:8)
+# ---------------------------------------------------------------------------
+
+
+def binomial_bcast_rounds(size: int, root: int = 0) -> List[List[Pair]]:
+    """Binomial-tree broadcast: ceil(log2 P) rounds of (src, dst) pairs.
+
+    Round k doubles the set of ranks holding the value.  Works for any P.
+    Pairs are in comm-rank space; ``root`` is handled by virtual-rank rotation.
+    """
+    rounds: List[List[Pair]] = []
+    k = 1
+    while k < size:
+        pairs = []
+        for v in range(k):
+            peer = v + k
+            if peer < size:
+                pairs.append(((v + root) % size, (peer + root) % size))
+        rounds.append(pairs)
+        k *= 2
+    return rounds
+
+
+def binomial_reduce_rounds(size: int, root: int = 0) -> List[List[Pair]]:
+    """Binomial-tree reduction to ``root``: mirror of bcast, children → parents."""
+    return [
+        [(dst, src) for (src, dst) in pairs]
+        for pairs in reversed(binomial_bcast_rounds(size, root))
+    ]
+
+
+# ---------------------------------------------------------------------------
+# Ring schedules (ring-allreduce, ring-allgather — BASELINE.json:10)
+# ---------------------------------------------------------------------------
+
+
+def ring_perm(size: int, shift: int = 1, wrap: bool = True) -> List[Pair]:
+    """The ring permutation: every rank sends to ``rank + shift``."""
+    pairs = []
+    for r in range(size):
+        d = r + shift
+        if wrap:
+            pairs.append((r, d % size))
+        elif 0 <= d < size:
+            pairs.append((r, d))
+    return pairs
+
+
+# Ring-allreduce = reduce-scatter ring + allgather ring, 2(P-1) steps total
+# [S: classic bandwidth-optimal schedule; SURVEY.md §3.3].  At reduce-scatter
+# step s (0-based), rank r sends chunk (r - s) mod P to r+1 and receives chunk
+# (r - s - 1) mod P from r-1, accumulating.  After P-1 steps rank r holds the
+# fully reduced chunk (r + 1) mod P.  The allgather phase then rotates the
+# reduced chunks around the ring.
+
+
+def ring_rs_send_chunk(rank, step: int, size: int):
+    return (rank - step) % size
+
+
+def ring_rs_recv_chunk(rank, step: int, size: int):
+    return (rank - step - 1) % size
+
+
+def ring_ag_send_chunk(rank, step: int, size: int):
+    return (rank - step + 1) % size
+
+
+def ring_ag_recv_chunk(rank, step: int, size: int):
+    return (rank - step) % size
+
+
+# ---------------------------------------------------------------------------
+# Recursive halving / doubling (allreduce, allgather — BASELINE.json:10)
+# ---------------------------------------------------------------------------
+
+
+def halving_masks(size: int) -> List[int]:
+    """Partner masks for recursive-halving reduce-scatter, high bit first.
+
+    Power-of-two sizes only.  Round with mask m: partner = rank ^ m; each rank
+    keeps the half of its active chunk-range whose bit ``m`` equals its own
+    and sends the other half.  After all rounds rank r holds exactly chunk r.
+    """
+    if not is_pow2(size):
+        raise ValueError(f"recursive halving requires power-of-two size, got {size}")
+    masks = []
+    m = size >> 1
+    while m:
+        masks.append(m)
+        m >>= 1
+    return masks
+
+
+def doubling_masks(size: int) -> List[int]:
+    """Partner masks for recursive-doubling allgather, low bit first (the
+    exact reverse of :func:`halving_masks`)."""
+    return list(reversed(halving_masks(size)))
+
+
+def xor_perm(size: int, mask: int) -> List[Pair]:
+    """The pairwise-exchange permutation rank ↔ rank^mask."""
+    return [(r, r ^ mask) for r in range(size)]
+
+
+# ---------------------------------------------------------------------------
+# Pairwise all-to-all (BASELINE.json:9)
+# ---------------------------------------------------------------------------
+
+
+def alltoall_rounds(size: int) -> List[int]:
+    """Offsets for the pairwise-exchange alltoall: P-1 rounds; in round with
+    offset k, rank r sends block[(r+k)%P] to (r+k)%P and receives from
+    (r-k)%P into block slot (r-k)%P.  Works for any P [S]."""
+    return list(range(1, size))
+
+
+# ---------------------------------------------------------------------------
+# Dissemination barrier [S: Hensgen/Finkel/Manber]
+# ---------------------------------------------------------------------------
+
+
+def dissemination_offsets(size: int) -> List[int]:
+    """Offsets 1, 2, 4, ... < P; at each round rank r signals (r+off)%P and
+    waits on (r-off)%P; ceil(log2 P) rounds synchronize all ranks."""
+    offs = []
+    k = 1
+    while k < size:
+        offs.append(k)
+        k *= 2
+    return offs

@@ -1,0 +1,15 @@
+"""backend=tpu — the headline SPMD backend (SURVEY.md §7 Milestones 1-2).
+
+Under construction this round: run_spmd / TpuCommunicator land with
+Milestone 1.  This stub exists so ``mpi_tpu.run(fn, backend='tpu')`` fails
+with a clear message rather than an ImportError until then.
+"""
+
+from __future__ import annotations
+
+
+def run_spmd(*args, **kwargs):  # pragma: no cover - placeholder
+    raise NotImplementedError(
+        "the TPU backend is still being built this round; use backend='local' "
+        "or backend='socket' meanwhile"
+    )

@@ -1,0 +1,16 @@
+from .base import ANY_SOURCE, ANY_TAG, Mailbox, RecvTimeout, Transport, TransportError
+from .local import LocalTransport, LocalWorld, run_local
+from .socket import SocketTransport
+
+__all__ = [
+    "ANY_SOURCE",
+    "ANY_TAG",
+    "Mailbox",
+    "RecvTimeout",
+    "Transport",
+    "TransportError",
+    "LocalTransport",
+    "LocalWorld",
+    "run_local",
+    "SocketTransport",
+]

@@ -1,0 +1,129 @@
+"""Transport plugin boundary (L1) + the shared message-matching engine.
+
+SURVEY.md §1/§2: the load-bearing seam of the reference is the Communicator
+plugin boundary — collectives are written against Communicator, Communicators
+own a swappable Transport.  A Transport moves opaque payloads between world
+ranks and supports MPI-style matching by (source, context, tag) with FIFO
+ordering per (src, dst) channel [S].
+
+The matching engine (Mailbox) is shared by every CPU transport so matching
+semantics — including wildcard rules — are identical across them:
+* ANY_SOURCE matches any source rank.
+* ANY_TAG matches only *user* tags (>= 0); internal negative tags (used by
+  collectives/barrier, see mpi_tpu/communicator.py) must be matched exactly,
+  so user wildcard receives can never steal collective traffic.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from abc import ABC, abstractmethod
+from typing import Any, List, Optional, Tuple
+
+ANY_SOURCE = -1
+ANY_TAG = -1
+
+
+class TransportError(RuntimeError):
+    pass
+
+
+class RecvTimeout(TransportError):
+    pass
+
+
+class Mailbox:
+    """Thread-safe matching queue of (src, ctx, tag, payload) messages."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._cv = threading.Condition(self._lock)
+        self._items: List[Tuple[int, int, int, Any]] = []
+        self._closed = False
+
+    def deliver(self, src: int, ctx: int, tag: int, payload: Any) -> None:
+        with self._cv:
+            self._items.append((src, ctx, tag, payload))
+            self._cv.notify_all()
+
+    def close(self) -> None:
+        with self._cv:
+            self._closed = True
+            self._cv.notify_all()
+
+    @staticmethod
+    def _matches(item, source: int, ctx, tag: int) -> bool:
+        s, c, t, _ = item
+        if c != ctx:
+            return False
+        if source != ANY_SOURCE and s != source:
+            return False
+        if tag == ANY_TAG:
+            return t >= 0  # wildcards never match internal (negative) tags
+        return t == tag
+
+    def match(
+        self, source: int, ctx, tag: int, timeout: Optional[float] = None
+    ) -> Tuple[Any, int, int]:
+        """Block until the oldest message matching (source, ctx, tag) arrives;
+        return (payload, src, tag)."""
+        deadline = None if timeout is None else time.monotonic() + timeout
+        with self._cv:
+            while True:
+                for i, item in enumerate(self._items):
+                    if self._matches(item, source, ctx, tag):
+                        s, _, t, payload = self._items.pop(i)
+                        return payload, s, t
+                if self._closed:
+                    raise TransportError(
+                        f"transport closed while waiting for recv(source={source}, "
+                        f"ctx={ctx}, tag={tag})"
+                    )
+                if deadline is None:
+                    self._cv.wait()
+                else:
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        pending = [(s, c, t) for s, c, t, _ in self._items[:16]]
+                        raise RecvTimeout(
+                            f"recv(source={source}, ctx={ctx}, tag={tag}) timed "
+                            f"out after {timeout}s; pending={pending}"
+                        )
+                    self._cv.wait(remaining)
+
+    def pending_summary(self) -> List[Tuple[int, int, int]]:
+        with self._lock:
+            return [(s, c, t) for s, c, t, _ in self._items[:16]]
+
+    def drain(self) -> List[Tuple[int, int, int]]:
+        """Return and clear all pending (src, ctx, tag) — used by the finalize
+        'unexpected message' check (sanitizer analogue, SURVEY.md §5)."""
+        with self._lock:
+            items = [(s, c, t) for s, c, t, _ in self._items]
+            self._items.clear()
+            return items
+
+
+class Transport(ABC):
+    """Moves payloads between world ranks; owns a Mailbox for incoming traffic."""
+
+    def __init__(self, world_rank: int, world_size: int) -> None:
+        self.world_rank = world_rank
+        self.world_size = world_size
+        self.mailbox = Mailbox()
+
+    @abstractmethod
+    def send(self, dest: int, ctx, tag: int, payload: Any) -> None:
+        """Buffered (non-blocking w.r.t. the receiver) send to world rank
+        ``dest``.  FIFO order per (self, dest) channel is guaranteed.
+        ``ctx`` is any hashable communicator-context id (the tree-path tuples
+        allocated by Communicator.split/dup — collision-free by construction)."""
+
+    def recv(
+        self, source: int, ctx, tag: int, timeout: Optional[float] = None
+    ) -> Tuple[Any, int, int]:
+        return self.mailbox.match(source, ctx, tag, timeout=timeout)
+
+    def close(self) -> None:
+        self.mailbox.close()

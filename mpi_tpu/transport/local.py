@@ -1,0 +1,117 @@
+"""In-process transport: rank = thread, channel = shared mailbox.
+
+Not present in the reference (SURVEY.md §2 lists socket/pickle as its only
+transport [B]); added here because a thread transport makes every semantic
+test of the Communicator layer run in milliseconds on one host, and because
+it is the substrate for fault injection and the comm-op recorder (both enter
+via ``run_local``'s ``transport_wrapper`` hook).  Message semantics are kept honest:
+payloads are deep-copied by default so ranks cannot share mutable state
+through a 'message' the way threads otherwise could.
+"""
+
+from __future__ import annotations
+
+import copy
+import sys
+import threading
+import traceback
+from typing import Any, Callable, List, Optional, Sequence
+
+from .base import Mailbox, Transport
+
+
+class LocalWorld:
+    """Shared state for one in-process world of ``size`` ranks."""
+
+    def __init__(self, size: int, copy_payloads: bool = True) -> None:
+        self.size = size
+        self.copy_payloads = copy_payloads
+        self.mailboxes = [Mailbox() for _ in range(size)]
+
+
+class LocalTransport(Transport):
+    def __init__(self, world: LocalWorld, rank: int) -> None:
+        super().__init__(rank, world.size)
+        self._world = world
+        self.mailbox = world.mailboxes[rank]
+
+    def send(self, dest: int, ctx, tag: int, payload: Any) -> None:
+        if not (0 <= dest < self.world_size):
+            raise ValueError(f"dest {dest} out of range for world size {self.world_size}")
+        if self._world.copy_payloads:
+            payload = copy.deepcopy(payload)
+        self._world.mailboxes[dest].deliver(self.world_rank, ctx, tag, payload)
+
+    def close(self) -> None:
+        self.mailbox.close()
+
+
+def run_local(
+    fn: Callable,
+    nranks: int,
+    args: Sequence = (),
+    kwargs: Optional[dict] = None,
+    timeout: float = 120.0,
+    copy_payloads: bool = True,
+    transport_wrapper: Optional[Callable[[Transport], Transport]] = None,
+) -> List[Any]:
+    """Run ``fn(comm, *args, **kwargs)`` on ``nranks`` in-process ranks;
+    return the per-rank results as a list indexed by rank.
+
+    ``transport_wrapper`` lets tests interpose (fault injection, tracing) at
+    the plugin boundary without touching the Communicator.
+    """
+    from ..communicator import P2PCommunicator
+
+    kwargs = kwargs or {}
+    world = LocalWorld(nranks, copy_payloads=copy_payloads)
+    results: List[Any] = [None] * nranks
+    errors: List[tuple] = []
+    lock = threading.Lock()
+
+    def runner(r: int) -> None:
+        try:
+            t: Transport = LocalTransport(world, r)
+            if transport_wrapper is not None:
+                t = transport_wrapper(t)
+            comm = P2PCommunicator(t, range(nranks))
+            results[r] = fn(comm, *args, **kwargs)
+        except BaseException as e:  # noqa: BLE001 - propagated to caller below
+            with lock:
+                errors.append((r, e, traceback.format_exc()))
+            # unblock peers waiting on this rank
+            for mb in world.mailboxes:
+                mb.close()
+
+    threads = [
+        threading.Thread(target=runner, args=(r,), name=f"mpi-tpu-rank-{r}", daemon=True)
+        for r in range(nranks)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout)
+    stuck = [t for t in threads if t.is_alive()]
+    if stuck:
+        # snapshot where each stuck rank is blocked before unblocking them —
+        # this is the actionable part of a deadlock report
+        import traceback as _tb
+
+        frames = sys._current_frames()
+        where = []
+        for t in stuck:
+            frame = frames.get(t.ident)
+            if frame is not None:
+                loc = _tb.extract_stack(frame)[-1]
+                where.append(f"{t.name} at {loc.filename}:{loc.lineno} in {loc.name}")
+            else:
+                where.append(t.name)
+        for mb in world.mailboxes:
+            mb.close()
+        raise TimeoutError(
+            f"ranks did not finish within {timeout}s (likely deadlock): {where}"
+        )
+    if errors:
+        r, e, tb = errors[0]
+        raise RuntimeError(f"rank {r} failed:\n{tb}") from e
+    return results

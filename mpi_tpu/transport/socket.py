@@ -1,0 +1,214 @@
+"""TCP socket + pickle transport — the reference's L1, reimplemented.
+
+SURVEY.md §2 component #2 [B: "the existing socket/pickle path",
+BASELINE.json:5]: per-pair TCP connections, length-prefixed pickle frames,
+blocking matched receive.  This backend exists for two reasons (SURVEY.md §4
+item 4): it is the CPU fallback, and it is the source-compatibility proof —
+the same user program must run here and on backend=tpu.
+
+Wire format per message: a fixed header ``!QQ`` = (payload_len, seq) followed
+by ``payload_len`` bytes of pickle holding the envelope ``(ctx, tag, obj)`` —
+the context id is an arbitrary hashable (tree-path tuple), so it rides inside
+the pickle rather than a fixed-width header field.  The sender's world rank
+is established once per connection by a hello frame (``!i``), not repeated
+per message.  Rank discovery is file-based rendezvous: each rank binds an
+OS-assigned port and publishes it as ``<rdv>/port.<rank>``; peers poll.  The
+launcher (mpi_tpu/launcher.py) provides the rendezvous directory.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+import socket
+import struct
+import threading
+import time
+from typing import Any, Dict, Optional
+
+from .base import Transport, TransportError
+
+_HELLO = struct.Struct("!i")
+_HEADER = struct.Struct("!QQ")  # payload_len, seq
+_HOST = "127.0.0.1"
+
+
+def _recv_exact(sock: socket.socket, n: int) -> Optional[bytes]:
+    buf = bytearray()
+    while len(buf) < n:
+        try:
+            chunk = sock.recv(n - len(buf))
+        except OSError:
+            return None
+        if not chunk:
+            return None
+        buf += chunk
+    return bytes(buf)
+
+
+class SocketTransport(Transport):
+    def __init__(
+        self,
+        rank: int,
+        size: int,
+        rdv_dir: str,
+        connect_timeout: float = 60.0,
+    ) -> None:
+        super().__init__(rank, size)
+        self._rdv = rdv_dir
+        self._connect_timeout = connect_timeout
+        self._closing = False
+        self._send_locks: Dict[int, threading.Lock] = {}
+        self._conns: Dict[int, socket.socket] = {}
+        self._conn_lock = threading.Lock()
+        self._reader_threads = []
+        self._seq = 0
+
+        self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._listener.bind((_HOST, 0))
+        self._listener.listen(size + 4)
+        port = self._listener.getsockname()[1]
+        tmp = os.path.join(rdv_dir, f".port.{rank}.tmp")
+        with open(tmp, "w") as f:
+            f.write(str(port))
+        os.replace(tmp, os.path.join(rdv_dir, f"port.{rank}"))
+
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop, name=f"mpi-tpu-accept-{rank}", daemon=True
+        )
+        self._accept_thread.start()
+
+    # -- incoming ----------------------------------------------------------
+
+    def _accept_loop(self) -> None:
+        while not self._closing:
+            try:
+                conn, _ = self._listener.accept()
+            except OSError:
+                return
+            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            hello = _recv_exact(conn, _HELLO.size)
+            if hello is None:
+                conn.close()
+                continue
+            (src,) = _HELLO.unpack(hello)
+            t = threading.Thread(
+                target=self._reader_loop,
+                args=(conn, src),
+                name=f"mpi-tpu-reader-{self.world_rank}<-{src}",
+                daemon=True,
+            )
+            self._reader_threads.append(t)
+            t.start()
+
+    def _reader_loop(self, conn: socket.socket, src: int) -> None:
+        while True:
+            head = _recv_exact(conn, _HEADER.size)
+            if head is None:
+                conn.close()
+                return
+            plen, _seq = _HEADER.unpack(head)
+            payload = _recv_exact(conn, plen)
+            if payload is None:
+                conn.close()
+                return
+            ctx, tag, obj = pickle.loads(payload)
+            self.mailbox.deliver(src, ctx, tag, obj)
+
+    # -- outgoing ----------------------------------------------------------
+
+    def _peer_port(self, dest: int) -> int:
+        path = os.path.join(self._rdv, f"port.{dest}")
+        deadline = time.monotonic() + self._connect_timeout
+        while True:
+            try:
+                with open(path) as f:
+                    text = f.read().strip()
+                if text:
+                    return int(text)
+            except FileNotFoundError:
+                pass
+            if time.monotonic() > deadline:
+                raise TransportError(
+                    f"rank {self.world_rank}: peer {dest} did not publish a port "
+                    f"within {self._connect_timeout}s (rendezvous dir {self._rdv})"
+                )
+            time.sleep(0.005)
+
+    def _send_lock(self, dest: int) -> threading.Lock:
+        # _conn_lock guards only the dict lookups; the (possibly slow)
+        # rendezvous poll + connect happens under the per-dest lock so sends
+        # to other, already-connected peers are never stalled behind it.
+        with self._conn_lock:
+            lock = self._send_locks.get(dest)
+            if lock is None:
+                lock = self._send_locks[dest] = threading.Lock()
+            return lock
+
+    def _get_conn_locked(self, dest: int) -> socket.socket:
+        """Return the connection to ``dest``; caller holds the per-dest lock."""
+        with self._conn_lock:
+            conn = self._conns.get(dest)
+        if conn is not None:
+            return conn
+        port = self._peer_port(dest)
+        deadline = time.monotonic() + self._connect_timeout
+        while True:
+            try:
+                conn = socket.create_connection((_HOST, port), timeout=5.0)
+                break
+            except OSError:
+                if time.monotonic() > deadline:
+                    raise TransportError(
+                        f"rank {self.world_rank}: cannot connect to rank {dest} "
+                        f"on port {port}"
+                    )
+                time.sleep(0.01)
+        conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        conn.settimeout(None)
+        conn.sendall(_HELLO.pack(self.world_rank))
+        with self._conn_lock:
+            self._conns[dest] = conn
+        return conn
+
+    def send(self, dest: int, ctx, tag: int, payload: Any) -> None:
+        if not (0 <= dest < self.world_size):
+            raise ValueError(f"dest {dest} out of range for world size {self.world_size}")
+        if dest == self.world_rank:
+            # pickle round-trip preserves message (value) semantics
+            copy = pickle.loads(pickle.dumps(payload, protocol=pickle.HIGHEST_PROTOCOL))
+            self.mailbox.deliver(dest, ctx, tag, copy)
+            return
+        blob = pickle.dumps((ctx, tag, payload), protocol=pickle.HIGHEST_PROTOCOL)
+        with self._send_lock(dest):
+            conn = self._get_conn_locked(dest)
+            self._seq += 1
+            frame = _HEADER.pack(len(blob), self._seq) + blob
+            try:
+                conn.sendall(frame)
+            except OSError as e:
+                raise TransportError(
+                    f"rank {self.world_rank}: send to rank {dest} failed: {e}"
+                ) from e
+
+    # -- shutdown ----------------------------------------------------------
+
+    def close(self) -> None:
+        self._closing = True
+        try:
+            self._listener.close()
+        except OSError:
+            pass
+        with self._conn_lock:
+            for conn in self._conns.values():
+                try:
+                    conn.shutdown(socket.SHUT_RDWR)
+                except OSError:
+                    pass
+                try:
+                    conn.close()
+                except OSError:
+                    pass
+            self._conns.clear()
+        self.mailbox.close()

@@ -1,0 +1,11 @@
+"""Test fixture environment (SURVEY.md §4 item 2): force an 8-device virtual
+CPU platform BEFORE jax initializes, so every SPMD/mesh test runs multi-device
+on any machine.  CPU-backend tests don't touch jax and are unaffected."""
+
+import os
+
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ.setdefault("JAX_ENABLE_X64", "0")

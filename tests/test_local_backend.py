@@ -1,0 +1,315 @@
+"""Semantic tests of the Communicator layer over the in-process thread
+transport (SURVEY.md §4: collective results must match a single-process numpy
+oracle; split/dup isolation; MPI matching semantics)."""
+
+import numpy as np
+import pytest
+
+import mpi_tpu
+from mpi_tpu import ANY_SOURCE, ANY_TAG, Status, ops
+from mpi_tpu.transport.local import run_local
+
+NRANKS = [1, 2, 3, 4, 5, 8]
+POW2 = [1, 2, 4, 8]
+
+
+# -- point to point --------------------------------------------------------
+
+
+def test_send_recv_basic():
+    def prog(comm):
+        if comm.rank == 0:
+            comm.send({"hello": [1, 2, 3]}, dest=1, tag=7)
+            return None
+        st = Status()
+        obj = comm.recv(source=0, tag=7, status=st)
+        assert st.source == 0 and st.tag == 7
+        return obj
+
+    res = run_local(prog, 2)
+    assert res[1] == {"hello": [1, 2, 3]}
+
+
+def test_fifo_ordering_and_tag_matching():
+    def prog(comm):
+        if comm.rank == 0:
+            for i in range(5):
+                comm.send(("a", i), dest=1, tag=1)
+            comm.send("late-tag2", dest=1, tag=2)
+            return None
+        # out-of-order tag match first: tag=2 must skip queued tag=1 messages
+        assert comm.recv(source=0, tag=2) == "late-tag2"
+        got = [comm.recv(source=0, tag=1) for _ in range(5)]
+        assert got == [("a", i) for i in range(5)]
+
+    run_local(prog, 2)
+
+
+def test_any_source_any_tag():
+    def prog(comm):
+        if comm.rank == 3:
+            seen = set()
+            for _ in range(3):
+                st = Status()
+                obj = comm.recv(source=ANY_SOURCE, tag=ANY_TAG, status=st)
+                assert obj == ("from", st.source)
+                seen.add(st.source)
+            assert seen == {0, 1, 2}
+            return None
+        comm.send(("from", comm.rank), dest=3, tag=comm.rank + 10)
+
+    run_local(prog, 4)
+
+
+def test_sendrecv_ring_rotation():
+    def prog(comm):
+        right = (comm.rank + 1) % comm.size
+        left = (comm.rank - 1) % comm.size
+        return comm.sendrecv(comm.rank, dest=right, source=left)
+
+    for n in [2, 3, 5]:
+        res = run_local(prog, n)
+        assert res == [(r - 1) % n for r in range(n)]
+
+
+def test_shift_wrap_and_boundary():
+    def prog(comm):
+        wrapped = comm.shift(comm.rank, offset=1, wrap=True)
+        bounded = comm.shift(comm.rank, offset=1, wrap=False, fill=-99)
+        return wrapped, bounded
+
+    res = run_local(prog, 4)
+    assert [w for w, _ in res] == [3, 0, 1, 2]
+    assert [b for _, b in res] == [-99, 0, 1, 2]
+
+
+def test_negative_user_tag_rejected():
+    def prog(comm):
+        with pytest.raises(ValueError):
+            comm.send(1, dest=0, tag=-5)
+
+    run_local(prog, 1)
+
+
+# -- collectives vs numpy oracle ------------------------------------------
+
+
+@pytest.mark.parametrize("n", NRANKS)
+def test_bcast(n):
+    payload = {"w": np.arange(5), "k": "v"}
+
+    def prog(comm):
+        obj = payload if comm.rank == 2 % comm.size else None
+        return comm.bcast(obj, root=2 % comm.size)
+
+    for got in run_local(prog, n):
+        assert got["k"] == "v"
+        np.testing.assert_array_equal(got["w"], np.arange(5))
+
+
+@pytest.mark.parametrize("n", NRANKS)
+def test_reduce_sum(n):
+    rng = np.random.RandomState(0)
+    data = rng.randn(n, 7)
+
+    def prog(comm):
+        return comm.reduce(data[comm.rank], op=ops.SUM, root=0)
+
+    res = run_local(prog, n)
+    np.testing.assert_allclose(res[0], data.sum(axis=0), rtol=1e-12)
+    assert all(r is None for r in res[1:])
+
+
+@pytest.mark.parametrize("algo", ["ring", "recursive_halving", "reduce_bcast", "auto"])
+@pytest.mark.parametrize("n", POW2)
+def test_allreduce_algorithms(n, algo):
+    rng = np.random.RandomState(1)
+    data = rng.randn(n, 33)  # 33 not divisible by n: exercises uneven chunks
+
+    def prog(comm):
+        return comm.allreduce(data[comm.rank], op=ops.SUM, algorithm=algo)
+
+    for got in run_local(prog, n):
+        np.testing.assert_allclose(got, data.sum(axis=0), rtol=1e-10)
+
+
+@pytest.mark.parametrize("n", [3, 5, 6])
+def test_allreduce_ring_non_pow2(n):
+    rng = np.random.RandomState(2)
+    data = rng.randn(n, 17)
+
+    def prog(comm):
+        return comm.allreduce(data[comm.rank], op=ops.SUM, algorithm="ring")
+
+    for got in run_local(prog, n):
+        np.testing.assert_allclose(got, data.sum(axis=0), rtol=1e-10)
+
+
+@pytest.mark.parametrize(
+    "op,oracle",
+    [
+        (ops.SUM, lambda d: d.sum(0)),
+        (ops.PROD, lambda d: d.prod(0)),
+        (ops.MAX, lambda d: d.max(0)),
+        (ops.MIN, lambda d: d.min(0)),
+    ],
+)
+def test_allreduce_ops(op, oracle):
+    rng = np.random.RandomState(3)
+    data = rng.randn(4, 9)
+
+    def prog(comm):
+        return comm.allreduce(data[comm.rank], op=op)
+
+    for got in run_local(prog, 4):
+        np.testing.assert_allclose(got, oracle(data), rtol=1e-10)
+
+
+def test_allreduce_logical_ops():
+    data = np.array([[True, False, True], [True, True, False],
+                     [True, False, False], [True, True, True]])
+
+    def prog(comm):
+        return (
+            comm.allreduce(data[comm.rank], op=ops.LAND),
+            comm.allreduce(data[comm.rank], op=ops.LOR),
+        )
+
+    for land, lor in run_local(prog, 4):
+        np.testing.assert_array_equal(land, data.all(axis=0))
+        np.testing.assert_array_equal(lor, data.any(axis=0))
+
+
+def test_allreduce_scalar():
+    def prog(comm):
+        return comm.allreduce(comm.rank + 1, op=ops.SUM)
+
+    res = run_local(prog, 4)
+    assert all(r == 10 for r in res)
+    assert all(np.ndim(r) == 0 for r in res)
+
+
+@pytest.mark.parametrize("algo", ["ring", "doubling"])
+@pytest.mark.parametrize("n", POW2)
+def test_allgather(n, algo):
+    def prog(comm):
+        return comm.allgather(("rank", comm.rank), algorithm=algo)
+
+    for got in run_local(prog, n):
+        assert got == [("rank", r) for r in range(n)]
+
+
+@pytest.mark.parametrize("n", [3, 5, 7])
+def test_allgather_non_pow2(n):
+    def prog(comm):
+        return comm.allgather(comm.rank * 2, algorithm="ring")
+
+    for got in run_local(prog, n):
+        assert got == [r * 2 for r in range(n)]
+
+
+@pytest.mark.parametrize("n", NRANKS)
+def test_alltoall(n):
+    def prog(comm):
+        objs = [(comm.rank, dst) for dst in range(comm.size)]
+        return comm.alltoall(objs)
+
+    res = run_local(prog, n)
+    for dst, got in enumerate(res):
+        assert got == [(src, dst) for src in range(n)]
+
+
+@pytest.mark.parametrize("n", [1, 2, 5, 8])
+def test_barrier_completes(n):
+    def prog(comm):
+        for _ in range(3):
+            comm.barrier()
+        return True
+
+    assert all(run_local(prog, n))
+
+
+def test_scatter_gather():
+    def prog(comm):
+        mine = comm.scatter([f"item{d}" for d in range(comm.size)] if comm.rank == 1 else None,
+                            root=1)
+        assert mine == f"item{comm.rank}"
+        return comm.gather(mine.upper(), root=2)
+
+    res = run_local(prog, 4)
+    assert res[2] == [f"ITEM{r}" for r in range(4)]
+    assert res[0] is None and res[1] is None and res[3] is None
+
+
+# -- split / dup -----------------------------------------------------------
+
+
+def test_split_by_parity():
+    def prog(comm):
+        sub = comm.split(color=comm.rank % 2, key=comm.rank)
+        total = sub.allreduce(comm.rank, op=ops.SUM)
+        return sub.rank, sub.size, total
+
+    res = run_local(prog, 6)
+    for world_rank, (sub_rank, sub_size, total) in enumerate(res):
+        assert sub_size == 3
+        assert sub_rank == world_rank // 2
+        assert total == (0 + 2 + 4 if world_rank % 2 == 0 else 1 + 3 + 5)
+
+
+def test_split_key_reorders():
+    def prog(comm):
+        # reverse the ordering via key
+        sub = comm.split(color=0, key=-comm.rank)
+        return sub.rank
+
+    res = run_local(prog, 4)
+    assert res == [3, 2, 1, 0]
+
+
+def test_split_color_none_opts_out():
+    def prog(comm):
+        sub = comm.split(color=None if comm.rank == 0 else 7)
+        if comm.rank == 0:
+            assert sub is None
+            return None
+        return sub.size
+
+    res = run_local(prog, 4)
+    assert res[1:] == [3, 3, 3]
+
+
+def test_nested_split():
+    def prog(comm):
+        row = comm.split(color=comm.rank // 2, key=comm.rank)
+        col = comm.split(color=comm.rank % 2, key=comm.rank)
+        return (row.allreduce(comm.rank), col.allreduce(comm.rank))
+
+    res = run_local(prog, 4)
+    assert res == [(1, 2), (1, 4), (5, 2), (5, 4)]
+
+
+def test_dup_isolates_message_space():
+    def prog(comm):
+        dup = comm.dup()
+        if comm.rank == 0:
+            comm.send("on-parent", dest=1, tag=0)
+            dup.send("on-dup", dest=1, tag=0)
+            return None
+        # receive in the opposite order: contexts must keep them apart
+        got_dup = dup.recv(source=0, tag=0)
+        got_parent = comm.recv(source=0, tag=0)
+        return got_parent, got_dup
+
+    res = run_local(prog, 2)
+    assert res[1] == ("on-parent", "on-dup")
+
+
+def test_error_in_one_rank_propagates():
+    def prog(comm):
+        if comm.rank == 1:
+            raise RuntimeError("boom")
+        comm.recv(source=1)  # would deadlock without error propagation
+
+    with pytest.raises(RuntimeError, match="rank 1 failed"):
+        run_local(prog, 2)

@@ -1,0 +1,164 @@
+"""Schedule-generator unit tests (SURVEY.md §4 item 1): pure functions from
+(rank, size) to message schedules, property-tested so that every payload is
+delivered exactly once — the 'every message sent is received exactly once'
+invariant."""
+
+import numpy as np
+import pytest
+from hypothesis import given, settings
+from hypothesis import strategies as st
+
+from mpi_tpu import checker, schedules
+
+sizes = st.integers(min_value=1, max_value=16)
+pow2_sizes = st.sampled_from([1, 2, 4, 8, 16])
+
+
+@given(size=sizes, root=st.integers(0, 15))
+@settings(max_examples=60, deadline=None)
+def test_binomial_bcast_covers_all_ranks(size, root):
+    root = root % size
+    rounds = schedules.binomial_bcast_rounds(size, root)
+    checker.validate_rounds(rounds, size)
+    have = {root}
+    for pairs in rounds:
+        for s, d in pairs:
+            assert s in have, "sender must already hold the value"
+            assert d not in have, "receiver must not receive twice"
+            have.add(d)
+    assert have == set(range(size))
+    assert len(rounds) == max(0, (size - 1)).bit_length()
+
+
+@given(size=sizes, root=st.integers(0, 15))
+@settings(max_examples=60, deadline=None)
+def test_binomial_reduce_reaches_root(size, root):
+    root = root % size
+    rounds = schedules.binomial_reduce_rounds(size, root)
+    checker.validate_rounds(rounds, size)
+    # simulate: each rank holds a set of contributions; senders retire
+    holding = {r: {r} for r in range(size)}
+    for pairs in rounds:
+        for s, d in pairs:
+            holding[d] |= holding.pop(s)
+    assert set(holding) == {root}
+    assert holding[root] == set(range(size))
+
+
+@given(size=sizes)
+@settings(max_examples=40, deadline=None)
+def test_ring_allreduce_chunk_bookkeeping(size):
+    p = size
+    # simulate the ring: chunks[r][i] = set of contributions to chunk i at rank r
+    chunks = [[{r} for _ in range(p)] for r in range(p)]
+    for step in range(p - 1):
+        sent = {
+            r: (schedules.ring_rs_send_chunk(r, step, p),
+                chunks[r][schedules.ring_rs_send_chunk(r, step, p)])
+            for r in range(p)
+        }
+        for r in range(p):
+            src = (r - 1) % p
+            si, payload = sent[src]
+            ri = schedules.ring_rs_recv_chunk(r, step, p)
+            assert si == ri, "sent chunk index must equal receiver's expected index"
+            chunks[r][ri] = chunks[r][ri] | payload
+    # after reduce-scatter rank r fully owns chunk (r+1) % p
+    for r in range(p):
+        assert chunks[r][(r + 1) % p] == set(range(p))
+    # allgather phase distributes the reduced chunks everywhere
+    for step in range(p - 1):
+        sent = {
+            r: (schedules.ring_ag_send_chunk(r, step, p),
+                chunks[r][schedules.ring_ag_send_chunk(r, step, p)])
+            for r in range(p)
+        }
+        for r in range(p):
+            src = (r - 1) % p
+            si, payload = sent[src]
+            ri = schedules.ring_ag_recv_chunk(r, step, p)
+            assert si == ri
+            chunks[r][ri] = payload
+    for r in range(p):
+        for i in range(p):
+            assert chunks[r][i] == set(range(p)), f"rank {r} chunk {i} incomplete"
+
+
+@given(size=pow2_sizes)
+@settings(max_examples=20, deadline=None)
+def test_halving_masks_end_at_own_chunk(size):
+    if size == 1:
+        assert schedules.halving_masks(1) == []
+        return
+    masks = schedules.halving_masks(size)
+    assert len(masks) == size.bit_length() - 1
+    for r in range(size):
+        lo, hi = 0, size
+        for m in masks:
+            checker.validate_perm(schedules.xor_perm(size, m), size)
+            mid = (lo + hi) // 2
+            lo, hi = (mid, hi) if r & m else (lo, mid)
+        assert (lo, hi) == (r, r + 1)
+
+
+def test_halving_rejects_non_pow2():
+    with pytest.raises(ValueError):
+        schedules.halving_masks(6)
+
+
+@given(size=sizes)
+@settings(max_examples=40, deadline=None)
+def test_alltoall_rounds_deliver_every_block_once(size):
+    p = size
+    delivered = [[None] * p for _ in range(p)]  # delivered[dst][src] = block
+    for r in range(p):
+        delivered[r][r] = (r, r)
+    for k in schedules.alltoall_rounds(p):
+        checker.validate_perm(schedules.ring_perm(p, k), p)
+        for r in range(p):
+            dst = (r + k) % p
+            assert delivered[dst][r] is None
+            delivered[dst][r] = (r, dst)
+    for dst in range(p):
+        for src in range(p):
+            assert delivered[dst][src] == (src, dst)
+
+
+@given(size=sizes)
+@settings(max_examples=40, deadline=None)
+def test_dissemination_offsets_synchronize(size):
+    # knowledge-propagation argument: after all rounds every rank has
+    # (transitively) heard from every other rank
+    know = [{r} for r in range(size)]
+    for off in schedules.dissemination_offsets(size):
+        new = [set(k) for k in know]
+        for r in range(size):
+            new[r] |= know[(r - off) % size]
+        know = new
+    for r in range(size):
+        assert know[r] == set(range(size))
+
+
+def test_validate_perm_catches_duplicates():
+    with pytest.raises(checker.ScheduleError):
+        checker.validate_perm([(0, 1), (0, 2)], 4)
+    with pytest.raises(checker.ScheduleError):
+        checker.validate_perm([(0, 1), (2, 1)], 4)
+    with pytest.raises(checker.ScheduleError):
+        checker.validate_perm([(0, 9)], 4)
+    checker.validate_perm([(0, 1), (1, 0), (2, 3)], 4)
+
+
+def test_verify_matching():
+    logs = [
+        [("send", 1, 5)],
+        [("recv", 0, 5)],
+    ]
+    assert checker.verify_matching(logs) == []
+    logs = [[("send", 1, 5)], []]
+    assert len(checker.verify_matching(logs)) == 1
+    logs = [[], [("recv", 0, 5)]]
+    assert len(checker.verify_matching(logs)) == 1
+    # wildcard recv matches any source
+    logs = [[("send", 1, 3)], [("recv", -1, -1)]]
+    assert checker.verify_matching(logs) == []
