@@ -1,0 +1,173 @@
+#!/usr/bin/env python
+"""Headline benchmark — prints ONE JSON line.
+
+Measures the reference's config #1 (BASELINE.json:7: MPI_Allreduce(SUM) on
+1K float32, 2 ranks) on BOTH transports on this host, same algorithm
+(recursive halving), and reports the transport-swap speedup — the quantity
+the north-star is about (socket/pickle path vs XLA-collective path):
+
+* socket backend: 2 real rank processes over loopback TCP (the reference's
+  architecture), p50 of 200 allreduce calls;
+* SPMD backend: the same allreduce as one jitted shard_map program over 2
+  devices, p50 of 200 dispatches.
+
+On a host with >= 2 real TPU chips the SPMD leg runs over ICI and a second
+north-star measurement (256 MB ring-allreduce bus-bandwidth, BASELINE.json:5)
+is attempted; with one chip the SPMD leg uses 2 virtual CPU devices — an
+apples-to-apples same-host comparison.  Details land in BENCH_DETAILS.json.
+"""
+
+import json
+import os
+import statistics
+import subprocess
+import sys
+import tempfile
+
+REPO = os.path.dirname(os.path.abspath(__file__))
+
+SOCKET_PROG = """
+import os, sys, time, statistics
+sys.path.insert(0, {repo!r})
+import numpy as np
+import mpi_tpu
+
+comm = mpi_tpu.init()
+x = np.ones(1024, np.float32)
+for _ in range(20):
+    comm.allreduce(x, algorithm="recursive_halving")
+ts = []
+for _ in range(200):
+    t0 = time.perf_counter()
+    comm.allreduce(x, algorithm="recursive_halving")
+    ts.append(time.perf_counter() - t0)
+if comm.rank == 0:
+    with open(os.environ["BENCH_OUT"], "w") as f:
+        f.write(str(statistics.median(ts) * 1e6))
+mpi_tpu.finalize()
+"""
+
+SPMD_PROG = """
+import os, sys, time, statistics
+sys.path.insert(0, {repo!r})
+import jax
+if {force_cpu!r} == "yes":
+    os.environ["XLA_FLAGS"] = os.environ.get("XLA_FLAGS", "") + \
+        " --xla_force_host_platform_device_count=2"
+    jax.config.update("jax_platforms", "cpu")
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+from mpi_tpu.tpu import TpuCommunicator, default_mesh
+
+mesh = default_mesh(2)
+comm = TpuCommunicator("world", mesh)
+f = jax.jit(jax.shard_map(
+    lambda x: comm.allreduce(x, algorithm="recursive_halving"),
+    mesh=mesh, in_specs=P(), out_specs=P("world")))
+x = jnp.ones(1024, jnp.float32)
+f(x).block_until_ready()
+ts = []
+for _ in range(200):
+    t0 = time.perf_counter()
+    f(x).block_until_ready()
+    ts.append(time.perf_counter() - t0)
+with open(os.environ["BENCH_OUT"], "w") as fh:
+    fh.write(str(statistics.median(ts) * 1e6))
+"""
+
+NORTHSTAR_PROG = """
+import os, sys, time, statistics
+sys.path.insert(0, {repo!r})
+import jax, jax.numpy as jnp, json
+from jax.sharding import PartitionSpec as P
+from mpi_tpu.tpu import TpuCommunicator, default_mesh
+
+mesh = default_mesh()
+P_ = len(jax.devices())
+comm = TpuCommunicator("world", mesh)
+nbytes = 256 * 1024 * 1024
+n = nbytes // 4
+f = jax.jit(jax.shard_map(
+    lambda x: comm.allreduce(x, algorithm="ring"),
+    mesh=mesh, in_specs=P(), out_specs=P("world")))
+x = jnp.ones(n, jnp.float32)
+f(x).block_until_ready()
+ts = []
+for _ in range(10):
+    t0 = time.perf_counter()
+    f(x).block_until_ready()
+    ts.append(time.perf_counter() - t0)
+t = statistics.median(ts)
+busbw = nbytes * 2 * (P_ - 1) / P_ / t / 1e9
+with open(os.environ["BENCH_OUT"], "w") as fh:
+    json.dump({{"busbw_gbps": busbw, "t_s": t, "nranks": P_}}, fh)
+"""
+
+
+def _run_sub(code: str, env_extra: dict, timeout: float = 600.0) -> str:
+    with tempfile.TemporaryDirectory() as td:
+        out = os.path.join(td, "out.txt")
+        env = dict(os.environ)
+        env["BENCH_OUT"] = out
+        env.update(env_extra)
+        script = os.path.join(td, "prog.py")
+        with open(script, "w") as f:
+            f.write(code)
+        subprocess.run([sys.executable, script], env=env, check=True,
+                       timeout=timeout, cwd=REPO)
+        with open(out) as f:
+            return f.read()
+
+
+def measure_socket_p50() -> float:
+    sys.path.insert(0, REPO)
+    from mpi_tpu.launcher import launch
+
+    with tempfile.TemporaryDirectory() as td:
+        out = os.path.join(td, "out.txt")
+        script = os.path.join(td, "prog.py")
+        with open(script, "w") as f:
+            f.write(SOCKET_PROG.format(repo=REPO))
+        rc = launch(2, [script], env_extra={"BENCH_OUT": out}, timeout=300.0)
+        if rc != 0:
+            raise RuntimeError(f"socket bench failed with exit code {rc}")
+        with open(out) as f:
+            return float(f.read())
+
+
+def main() -> None:
+    import jax  # noqa: F401  (default platform: real TPU when present)
+
+    n_real = len(jax.devices())
+    details = {"devices": [str(d) for d in jax.devices()]}
+
+    socket_us = measure_socket_p50()
+    details["socket_2rank_1kf32_p50_us"] = socket_us
+
+    force_cpu = "yes" if n_real < 2 else "no"
+    spmd_us = float(_run_sub(SPMD_PROG.format(repo=REPO, force_cpu=force_cpu), {}))
+    details["spmd_2rank_1kf32_p50_us"] = spmd_us
+    details["spmd_leg_platform"] = "cpu-sim" if force_cpu == "yes" else "tpu-ici"
+
+    if n_real >= 2:
+        try:
+            details["northstar_256mb_ring"] = json.loads(
+                _run_sub(NORTHSTAR_PROG.format(repo=REPO), {})
+            )
+        except Exception as e:  # pragma: no cover - multichip only
+            details["northstar_error"] = str(e)
+
+    speedup = socket_us / spmd_us
+    with open(os.path.join(REPO, "BENCH_DETAILS.json"), "w") as f:
+        json.dump(details, f, indent=2)
+
+    print(json.dumps({
+        "metric": "allreduce_1kf32_2rank_p50_speedup_spmd_over_socket",
+        "value": round(speedup, 3),
+        "unit": "x",
+        "vs_baseline": round(speedup, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

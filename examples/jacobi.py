@@ -1,0 +1,77 @@
+"""Jacobi stencil with halo exchange (SURVEY.md §2 component #14, §3.5;
+BASELINE.json:11) — the Send/Recv stress test.
+
+2-D heat problem: the global top edge is held at 1.0, every other boundary
+at 0.0; the grid is decomposed by rows across ranks.  Each iteration
+exchanges one-row halos with both neighbors (``comm.shift`` — a sendrecv
+pair on the CPU backends, exactly one ``lax.ppermute`` each way on TPU) and
+sweeps a 5-point stencil; the convergence norm is an ``allreduce(MAX)``.
+
+    python -m mpi_tpu.launcher -n 4 examples/jacobi.py
+    python examples/jacobi.py --backend local -n 4
+    python examples/jacobi.py --backend tpu -n 8
+"""
+
+import argparse
+import os
+import sys
+
+try:
+    import mpi_tpu
+except ModuleNotFoundError:  # running from a fresh checkout without install
+    sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    import mpi_tpu
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from mpi_tpu import ops
+
+
+def jacobi_step(comm, local):
+    """One halo exchange + 5-point sweep on this rank's row block."""
+    # my last row goes down to rank+1; their last row arrives from rank-1
+    above = comm.shift(local[-1], offset=1, wrap=False, fill=0.0)
+    above = jnp.where(comm.rank == 0, jnp.ones_like(above), above)  # hot top edge
+    below = comm.shift(local[0], offset=-1, wrap=False, fill=0.0)
+    padded = jnp.concatenate([above[None], local, below[None]], axis=0)
+    north, south = padded[:-2], padded[2:]
+    west = jnp.pad(local[:, :-1], ((0, 0), (1, 0)))
+    east = jnp.pad(local[:, 1:], ((0, 0), (0, 1)))
+    new = 0.25 * (north + south + west + east)
+    # vertical side walls are fixed at 0
+    return new.at[:, 0].set(0.0).at[:, -1].set(0.0)
+
+
+def jacobi_program(comm, rows_per_rank: int = 16, cols: int = 32, iters: int = 100):
+    """Returns (final local block, global max-residual of the last sweep)."""
+    local = jnp.zeros((rows_per_rank, cols), jnp.float32)
+    for _ in range(iters):
+        new = jacobi_step(comm, local)
+        local, prev = new, local
+    residual = comm.allreduce(jnp.max(jnp.abs(local - prev)), op=ops.MAX)
+    return local, residual
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--backend", default=None, choices=[None, "socket", "local", "tpu"])
+    ap.add_argument("-n", "--nranks", type=int, default=None)
+    ap.add_argument("--rows", type=int, default=16, help="rows per rank")
+    ap.add_argument("--cols", type=int, default=32)
+    ap.add_argument("--iters", type=int, default=100)
+    args = ap.parse_args()
+
+    out = mpi_tpu.run(jacobi_program, backend=args.backend, nranks=args.nranks,
+                      rows_per_rank=args.rows, cols=args.cols, iters=args.iters)
+    # per-rank results: socket → (block, res); local → list of those; tpu → stacked
+    if isinstance(out, list):
+        res = float(np.asarray(out[0][1]))
+    else:
+        res = float(np.ravel(np.asarray(jax.device_get(out[1])))[0])
+    print(f"jacobi: {args.iters} iters, last-sweep max residual {res:.3e}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,56 @@
+"""Distributed π Monte-Carlo (SURVEY.md §2 component #13; BASELINE.json:11).
+
+Each rank samples independently and the hit counts are summed with
+``allreduce`` — the canonical 'first MPI program'.  Written once against the
+portable Communicator API, it runs unmodified on every backend (the
+source-compatibility contract, BASELINE.json:5):
+
+    python -m mpi_tpu.launcher -n 4 examples/pi.py          # socket ranks
+    python examples/pi.py --backend local -n 4              # threads
+    python examples/pi.py --backend tpu -n 8                # one SPMD program
+
+The program body is jax-numpy end-to-end, so the same code traces under
+shard_map (rank is a traced scalar there) and executes eagerly per-process
+on the CPU backends (rank is an int there).
+"""
+
+import argparse
+import os
+import sys
+
+try:
+    import mpi_tpu
+except ModuleNotFoundError:  # running from a fresh checkout without install
+    sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    import mpi_tpu
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from mpi_tpu import ops
+
+
+def pi_program(comm, n_per_rank: int = 200_000):
+    key = jax.random.fold_in(jax.random.PRNGKey(42), comm.rank)
+    pts = jax.random.uniform(key, (n_per_rank, 2))
+    hits = jnp.sum((pts * pts).sum(axis=1) <= 1.0, dtype=jnp.float32)
+    total = comm.allreduce(hits, op=ops.SUM)
+    return 4.0 * total / (n_per_rank * comm.size)
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--backend", default=None, choices=[None, "socket", "local", "tpu"])
+    ap.add_argument("-n", "--nranks", type=int, default=None)
+    ap.add_argument("--samples", type=int, default=200_000)
+    args = ap.parse_args()
+
+    result = mpi_tpu.run(pi_program, backend=args.backend, nranks=args.nranks,
+                         n_per_rank=args.samples)
+    est = float(np.ravel(np.asarray(jax.device_get(result)))[0])
+    print(f"pi ~= {est:.6f}  (error {abs(est - np.pi):.2e})")
+
+
+if __name__ == "__main__":
+    main()

@@ -115,10 +115,11 @@ class Communicator(ABC):
     # -- collectives -------------------------------------------------------
 
     @abstractmethod
-    def bcast(self, obj: Any, root: int = 0) -> Any: ...
+    def bcast(self, obj: Any, root: int = 0, algorithm: str = "auto") -> Any: ...
 
     @abstractmethod
-    def reduce(self, obj: Any, op: _ops.ReduceOp = _ops.SUM, root: int = 0) -> Any: ...
+    def reduce(self, obj: Any, op: _ops.ReduceOp = _ops.SUM, root: int = 0,
+               algorithm: str = "auto") -> Any: ...
 
     @abstractmethod
     def allreduce(self, obj: Any, op: _ops.ReduceOp = _ops.SUM,
@@ -240,12 +241,22 @@ class P2PCommunicator(Communicator):
             self._send_internal(obj, d, _TAG_SHIFT)
         if 0 <= s < p:
             return self._recv_internal(s, _TAG_SHIFT)
+        if fill is None:
+            return None
+        # array payloads get an array-shaped fill, matching the TPU backend's
+        # ppermute-hole semantics so the same program sees the same types
+        if hasattr(obj, "shape") and hasattr(obj, "dtype"):
+            return np.full_like(np.asarray(obj), fill)
         return fill
 
     # -- collectives -------------------------------------------------------
 
-    def bcast(self, obj: Any, root: int = 0) -> Any:
-        # Binomial tree, log2(P) rounds (BASELINE.json:8).
+    def bcast(self, obj: Any, root: int = 0, algorithm: str = "auto") -> Any:
+        # Binomial tree, log2(P) rounds (BASELINE.json:8).  'fused' (the TPU
+        # backend's XLA-collective path) has no socket analogue and aliases
+        # to the tree so portable programs run unchanged.
+        if algorithm not in ("auto", "tree", "fused"):
+            raise ValueError(f"unknown bcast algorithm {algorithm!r}")
         for pairs in schedules.binomial_bcast_rounds(self.size, root):
             for s, d in pairs:
                 if self._rank == s:
@@ -254,7 +265,10 @@ class P2PCommunicator(Communicator):
                     obj = self._recv_internal(s, _TAG_COLL)
         return obj
 
-    def reduce(self, obj: Any, op: _ops.ReduceOp = _ops.SUM, root: int = 0) -> Any:
+    def reduce(self, obj: Any, op: _ops.ReduceOp = _ops.SUM, root: int = 0,
+               algorithm: str = "auto") -> Any:
+        if algorithm not in ("auto", "tree", "fused"):  # 'fused' aliases tree here
+            raise ValueError(f"unknown reduce algorithm {algorithm!r}")
         arr, scalar = _as_array(obj)
         acc = arr.copy()
         for pairs in schedules.binomial_reduce_rounds(self.size, root):
@@ -342,7 +356,7 @@ class P2PCommunicator(Communicator):
 
     def allgather(self, obj: Any, algorithm: str = "auto") -> List[Any]:
         p, r = self.size, self._rank
-        if algorithm == "auto":
+        if algorithm in ("auto", "fused"):  # no fused path on sockets; best schedule
             algorithm = "doubling" if schedules.is_pow2(p) else "ring"
         items: List[Any] = [None] * p
         items[r] = obj
@@ -368,6 +382,8 @@ class P2PCommunicator(Communicator):
 
     def alltoall(self, objs: Sequence[Any], algorithm: str = "auto") -> List[Any]:
         p, r = self.size, self._rank
+        if algorithm not in ("auto", "fused", "pairwise"):
+            raise ValueError(f"unknown alltoall algorithm {algorithm!r}")
         if len(objs) != p:
             raise ValueError(f"alltoall needs one payload per rank ({p}), got {len(objs)}")
         result: List[Any] = [None] * p

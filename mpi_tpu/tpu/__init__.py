@@ -1,15 +1,21 @@
-"""backend=tpu — the headline SPMD backend (SURVEY.md §7 Milestones 1-2).
+"""backend=tpu — MPI semantics over a jax.sharding.Mesh (SURVEY.md §7 M1-M2).
 
-Under construction this round: run_spmd / TpuCommunicator land with
-Milestone 1.  This stub exists so ``mpi_tpu.run(fn, backend='tpu')`` fails
-with a clear message rather than an ImportError until then.
+Public surface:
+* :func:`run_spmd` / :func:`default_mesh` — run a portable MPI program as one
+  SPMD trace over the device mesh.
+* :class:`TpuCommunicator` — the Communicator bound to a mesh axis; fused XLA
+  collectives plus hand-scheduled ppermute algorithms (ring /
+  recursive-halving / tree / doubling / pairwise).
 """
 
-from __future__ import annotations
+from .communicator import SpmdSemanticsError, TpuCommunicator
+from .runner import default_mesh, run_spmd
+from . import collectives
 
-
-def run_spmd(*args, **kwargs):  # pragma: no cover - placeholder
-    raise NotImplementedError(
-        "the TPU backend is still being built this round; use backend='local' "
-        "or backend='socket' meanwhile"
-    )
+__all__ = [
+    "TpuCommunicator",
+    "SpmdSemanticsError",
+    "run_spmd",
+    "default_mesh",
+    "collectives",
+]

@@ -1,0 +1,393 @@
+"""TpuCommunicator — MPI semantics bound to a jax.sharding.Mesh axis.
+
+The headline backend (BASELINE.json:5): MPI_COMM_WORLD binds to a mesh over
+the TPU slice; point-to-point lowers to ``lax.ppermute``; collectives
+re-emit as ``lax.psum`` / ``lax.all_gather`` / ``lax.all_to_all`` over ICI
+('fused'), or as hand-scheduled ppermute algorithms ('ring',
+'recursive_halving', 'tree', 'doubling', 'pairwise' — mpi_tpu/tpu/
+collectives.py) preserving the reference's algorithm-selection dimension.
+
+The governing design decision (SURVEY.md §7): an MPI "rank" is a mesh-axis
+index inside ONE SPMD program, not an OS process.  Methods must be called
+inside the traced program (under ``run_spmd`` / ``jax.shard_map`` over this
+communicator's mesh); ``rank`` is a traced scalar, ``size`` is static.
+
+comm.split() maps to XLA's ``axis_index_groups``: sibling groups all execute
+the same program, each group communicating internally (SURVEY.md §3.4).
+Restrictions this implies — diagnosed loudly, never silently misdelivered
+(SURVEY.md §7 hard parts 1-3):
+
+* groups produced by split must be equal-sized (SPMD shapes are uniform);
+* per-rank dynamic control flow (``if rank == 0: comm.send(...)``) cannot be
+  traced; use the portable patterns instead: ``shift`` (halo exchange),
+  ``exchange`` (static pairwise pattern), or collectives;
+* arbitrary picklable payloads become arrays (jax pytrees) — the CPU
+  backends keep full pickle generality;
+* hand-scheduled algorithms ('ring', 'recursive_halving', 'tree', ...) build
+  their result out of ppermute steps, so shard_map's varying-manual-axes
+  tracker sees them as rank-varying even though the values are replicated;
+  promise a replicated out_spec only for 'fused' results, or route
+  hand-scheduled results through per-rank (sharded) out_specs as
+  ``run_spmd`` does.
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh
+
+from .. import ops as _ops
+from .. import schedules
+from ..checker import validate_perm
+from ..communicator import Communicator
+from . import collectives as algos
+
+Pair = Tuple[int, int]
+
+
+class SpmdSemanticsError(NotImplementedError):
+    """An MPI idiom with no SPMD analogue was used on the TPU backend."""
+
+
+def _unsupported(what: str, alternative: str):
+    return SpmdSemanticsError(
+        f"{what} has no per-rank analogue inside one traced SPMD program "
+        f"(SURVEY.md §7 hard parts): every rank executes the same trace, so "
+        f"rank-dependent message initiation cannot be expressed. {alternative}"
+    )
+
+
+class TpuCommunicator(Communicator):
+    """MPI communicator over one named axis of a jax Mesh.
+
+    ``groups=None`` covers the whole axis (MPI_COMM_WORLD).  After split(),
+    ``groups`` is a partition of the axis indices into equal-sized groups;
+    every method then operates group-locally (XLA axis_index_groups).
+    """
+
+    def __init__(self, axis_name: str, mesh: Mesh,
+                 groups: Optional[List[List[int]]] = None):
+        if axis_name not in mesh.axis_names:
+            raise ValueError(f"axis {axis_name!r} not in mesh axes {mesh.axis_names}")
+        self.axis_name = axis_name
+        self.mesh = mesh
+        self._axis_size = mesh.shape[axis_name]
+        if groups is not None:
+            sizes = {len(g) for g in groups}
+            if len(sizes) != 1:
+                raise ValueError(
+                    f"SPMD sub-communicators must be equal-sized, got group sizes "
+                    f"{sorted(len(g) for g in groups)}; pad your split colors "
+                    f"(XLA axis_index_groups requires a uniform partition)"
+                )
+            covered = sorted(i for g in groups for i in g)
+            if covered != list(range(self._axis_size)):
+                raise ValueError(
+                    f"groups must partition the whole axis 0..{self._axis_size - 1} "
+                    f"exactly once (every device executes the SPMD program); got {groups}"
+                )
+        self._groups = groups
+        # rank/group lookup tables, indexed by world axis-index
+        rank_of = np.arange(self._axis_size)
+        group_of = np.zeros(self._axis_size, dtype=np.int32)
+        if groups is not None:
+            for gi, g in enumerate(groups):
+                for pos, world in enumerate(g):
+                    rank_of[world] = pos
+                    group_of[world] = gi
+        self._rank_table = rank_of
+        self._group_table = group_of
+
+    # -- identity ----------------------------------------------------------
+
+    @property
+    def rank(self):
+        """Group-local rank — a *traced* scalar (valid inside the SPMD trace)."""
+        idx = lax.axis_index(self.axis_name)
+        if self._groups is None:
+            return idx
+        return jnp.asarray(self._rank_table)[idx]
+
+    @property
+    def size(self) -> int:
+        return self._axis_size if self._groups is None else len(self._groups[0])
+
+    @property
+    def group_id(self):
+        """Which sibling group this shard belongs to (traced; 0 if unsplit)."""
+        idx = lax.axis_index(self.axis_name)
+        return jnp.asarray(self._group_table)[idx]
+
+    @property
+    def axis_index_groups(self) -> Optional[List[List[int]]]:
+        return self._groups
+
+    @property
+    def _on_cpu(self) -> bool:
+        return self.mesh.devices.flat[0].platform == "cpu"
+
+    def _world_pairs(self, group_pairs: Sequence[Pair]) -> List[Pair]:
+        """Expand group-local (src, dst) pairs to world-level ppermute pairs
+        across all sibling groups; validated (checker = trace-time sanitizer)."""
+        if self._groups is None:
+            pairs = list(group_pairs)
+        else:
+            pairs = [(g[s], g[d]) for g in self._groups for (s, d) in group_pairs]
+        validate_perm(pairs, self._axis_size)
+        return pairs
+
+    # -- point-to-point ----------------------------------------------------
+
+    def send(self, obj: Any, dest: int, tag: int = 0) -> None:
+        raise _unsupported(
+            "MPI_Send", "Use comm.shift(x, offset) for neighbor patterns, "
+            "comm.exchange(x, pairs) for an arbitrary static pattern, or a collective."
+        )
+
+    def recv(self, source: int = -1, tag: int = -1, status=None) -> Any:
+        raise _unsupported(
+            "MPI_Recv", "Use comm.shift(x, offset) for neighbor patterns, "
+            "comm.exchange(x, pairs) for an arbitrary static pattern, or a collective."
+        )
+
+    def sendrecv(self, sendobj: Any, dest: int, source: int = -1,
+                 sendtag: int = 0, recvtag: int = -1, status=None) -> Any:
+        raise _unsupported(
+            "MPI_Sendrecv with per-rank dest/source",
+            "If the pattern is a uniform ring offset use comm.shift(x, offset); "
+            "if it is a fixed pattern use comm.exchange(x, pairs).",
+        )
+
+    def shift(self, obj, offset: int = 1, wrap: bool = True, fill: Any = None):
+        """Neighbor exchange as exactly one ``lax.ppermute`` (SURVEY.md §3.2:
+        the boundary crossing becomes an ICI DMA scheduled by XLA)."""
+        if not wrap and fill is None:
+            raise SpmdSemanticsError(
+                "shift(wrap=False) needs an explicit numeric fill on the TPU "
+                "backend: SPMD has no 'None at the boundary' (the CPU backends "
+                "return None there) — pass fill=<boundary value> so all "
+                "backends agree"
+            )
+        x = jnp.asarray(obj)
+        p = self.size
+        pairs = self._world_pairs(schedules.ring_perm(p, offset, wrap=wrap))
+        recvd = lax.ppermute(x, self.axis_name, pairs)
+        if not wrap and fill is not None:
+            receivers = [r for r in range(p) if 0 <= r - offset < p]
+            has_src = algos._mask_of(
+                [g[r] for g in (self._groups or [list(range(p))]) for r in receivers],
+                self._axis_size, self.axis_name)
+            recvd = jnp.where(has_src, recvd, jnp.full_like(recvd, fill))
+        return recvd
+
+    def exchange(self, obj, pairs: Sequence[Pair]):
+        """Static-pattern p2p: every (src, dst) in ``pairs`` (group-local
+        ranks) ships src's payload to dst in one ppermute.  This is the SPMD
+        spelling of a set of matched MPI_Send/MPI_Recv calls; ranks not
+        receiving get zeros."""
+        x = jnp.asarray(obj)
+        return lax.ppermute(x, self.axis_name, self._world_pairs(pairs))
+
+    # -- collectives -------------------------------------------------------
+
+    def bcast(self, obj, root: int = 0, algorithm: str = "auto"):
+        x = jnp.asarray(obj)
+        if algorithm == "auto":
+            algorithm = "fused"
+        if self.size == 1:
+            return self._degenerate(x)
+        if algorithm == "fused":
+            # masked psum: transfers one payload-sized reduction instead of
+            # materializing P gathered copies per device
+            if x.dtype == jnp.bool_:
+                return self.bcast(x.astype(jnp.uint8), root, "fused").astype(jnp.bool_)
+            masked = jnp.where(self.rank == root, x, jnp.zeros_like(x))
+            if self._groups is None or not self._on_cpu:
+                return lax.psum(masked, self.axis_name, axis_index_groups=self._groups)
+            # grouped psum is NotImplemented on the CPU simulator
+            return self._fused_allgather(x)[root]
+        if algorithm == "tree":
+            return algos.tree_bcast(x, self.axis_name, self.size, self.rank,
+                                    self._world_pairs, self._axis_size, root)
+        raise ValueError(f"unknown bcast algorithm {algorithm!r}")
+
+    def reduce(self, obj, op: _ops.ReduceOp = _ops.SUM, root: int = 0,
+               algorithm: str = "auto"):
+        """Root holds the reduction; all other ranks hold the op identity
+        (SPMD returns a value everywhere — the CPU backends return None off
+        root)."""
+        x = jnp.asarray(obj)
+        if algorithm == "auto":
+            algorithm = "tree"
+        if self.size == 1:
+            return self._degenerate(x)
+        if algorithm == "fused":
+            full = self.allreduce(x, op, algorithm="fused")
+            ident = jnp.full(x.shape, op.identity(np.dtype(x.dtype)), x.dtype)
+            return jnp.where(self.rank == root, full, ident)
+        if algorithm == "tree":
+            return algos.tree_reduce(x, self.axis_name, self.size, self.rank,
+                                     self._world_pairs, self._axis_size, op, root)
+        raise ValueError(f"unknown reduce algorithm {algorithm!r}")
+
+    def allreduce(self, obj, op: _ops.ReduceOp = _ops.SUM, algorithm: str = "auto"):
+        x = jnp.asarray(obj)
+        if algorithm == "auto":
+            algorithm = "fused"
+        if self.size == 1:
+            return self._degenerate(x)
+        if algorithm == "fused":
+            return self._fused_allreduce(x, op)
+        if algorithm == "ring":
+            return algos.ring_allreduce(x, self.axis_name, self.size, self.rank,
+                                        self._world_pairs, op)
+        if algorithm == "recursive_halving":
+            return algos.halving_allreduce(x, self.axis_name, self.size, self.rank,
+                                           self._world_pairs, op)
+        if algorithm == "reduce_bcast":
+            return self.bcast(self.reduce(x, op, 0, "tree"), 0, "tree")
+        raise ValueError(f"unknown allreduce algorithm {algorithm!r}")
+
+    def _degenerate(self, x):
+        """Size-1 communicator: the value is its own reduction, but a real
+        (no-op) collective must still be emitted on an unsplit comm so the
+        result is marked replicated over the axis (shard_map's VMA check);
+        with singleton groups the value genuinely stays rank-varying."""
+        if self._groups is None and x.dtype != jnp.bool_:
+            return lax.psum(x, self.axis_name)
+        return x
+
+    def _fused_allreduce(self, x, op: _ops.ReduceOp):
+        groups = self._groups
+        if op.name == "sum" and x.dtype != jnp.bool_:
+            # grouped psum is NotImplemented on the CPU simulator backend —
+            # fall through to gather+local-reduce there (same math)
+            if groups is None or not self._on_cpu:
+                return lax.psum(x, self.axis_name, axis_index_groups=groups)
+        elif op.name == "max":
+            return lax.pmax(x, self.axis_name, axis_index_groups=groups)
+        elif op.name == "min":
+            return lax.pmin(x, self.axis_name, axis_index_groups=groups)
+        return algos.tree_reduce_local(op, self._fused_allgather(x))
+
+    def _fused_allgather(self, x):
+        return lax.all_gather(x, self.axis_name, axis_index_groups=self._groups,
+                              tiled=False)
+
+    def allgather(self, obj, algorithm: str = "auto"):
+        """Returns the stacked [size, ...] array in group-rank order (the CPU
+        backends return a list; jnp.stack of that list is identical)."""
+        x = jnp.asarray(obj)
+        if algorithm == "auto":
+            algorithm = "fused"
+        if algorithm == "fused":
+            return self._fused_allgather(x)
+        if algorithm == "ring":
+            return algos.ring_allgather(x, self.axis_name, self.size, self.rank,
+                                        self._world_pairs)
+        if algorithm == "doubling":
+            return algos.doubling_allgather(x, self.axis_name, self.size, self.rank,
+                                            self._world_pairs)
+        raise ValueError(f"unknown allgather algorithm {algorithm!r}")
+
+    def alltoall(self, objs, algorithm: str = "auto"):
+        """``objs``: stacked [size, ...] array, block i destined for group
+        rank i; returns [size, ...] with block j received from rank j — the
+        Ulysses / expert-parallel primitive (SURVEY.md §2 strategy table)."""
+        x = jnp.asarray(objs)
+        if x.shape[0] != self.size:
+            raise ValueError(
+                f"alltoall payload needs leading dim == communicator size "
+                f"({self.size}), got {x.shape}"
+            )
+        if algorithm == "auto":
+            algorithm = "fused"
+        if self.size == 1:
+            return x
+        if algorithm == "fused":
+            return lax.all_to_all(x, self.axis_name, split_axis=0, concat_axis=0,
+                                  axis_index_groups=self._groups, tiled=False)
+        if algorithm == "pairwise":
+            return algos.pairwise_alltoall(x, self.axis_name, self.size, self.rank,
+                                           self._world_pairs)
+        raise ValueError(f"unknown alltoall algorithm {algorithm!r}")
+
+    def barrier(self) -> None:
+        """SPMD programs are globally scheduled; emit a tiny psum as an
+        explicit synchronization point (also an ICI liveness probe)."""
+        lax.psum(jnp.zeros((), jnp.float32), self.axis_name)
+
+    def scatter(self, objs, root: int = 0):
+        """``objs``: stacked [size, ...] meaningful at root; every rank gets
+        block ``rank``."""
+        x = jnp.asarray(objs)
+        blocks = self.bcast(x, root)
+        return lax.dynamic_index_in_dim(blocks, self.rank, 0, keepdims=False)
+
+    def gather(self, obj, root: int = 0):
+        """Stacked [size, ...] — contract guarantees it only at root (other
+        ranks get it too; SPMD gathers are symmetric)."""
+        return self.allgather(obj)
+
+    # -- communicator management (host-side, outside the trace) ------------
+
+    def split(self, color, key: int = 0):
+        raise _unsupported(
+            "comm.split(color, key) with per-rank color values",
+            "Colors must be known for every rank on the host: call "
+            "comm.split_all(colors, keys) with one color per world axis index, "
+            "or comm.split_by(lambda world_idx: color) — outside the jitted "
+            "program (SURVEY.md §3.4: split is host-side bookkeeping).",
+        )
+
+    def split_all(self, colors: Sequence[Optional[int]],
+                  keys: Optional[Sequence[int]] = None) -> "TpuCommunicator":
+        """MPI_Comm_split with the full color/key vectors (host-side).
+
+        ``colors[i]`` is the color of world axis-index i (``None`` is not
+        supported: every device runs the SPMD program, so the partition must
+        be total).  Each current group partitions internally by color,
+        ordered by (key, current group rank); resulting groups must be
+        equal-sized."""
+        if len(colors) != self._axis_size:
+            raise ValueError(
+                f"need one color per world axis index ({self._axis_size}), "
+                f"got {len(colors)}"
+            )
+        if any(c is None for c in colors):
+            raise ValueError(
+                "color=None (MPI_UNDEFINED) is not expressible in SPMD: every "
+                "device executes the program; give every rank a color"
+            )
+        keys = list(keys) if keys is not None else [0] * self._axis_size
+        parent_groups = self._groups or [list(range(self._axis_size))]
+        new_groups: List[List[int]] = []
+        for g in parent_groups:
+            buckets: dict = {}
+            for pos, world in enumerate(g):
+                buckets.setdefault(colors[world], []).append((keys[world], pos, world))
+            for c in sorted(buckets):
+                new_groups.append([w for _, _, w in sorted(buckets[c])])
+        return TpuCommunicator(self.axis_name, self.mesh, new_groups)
+
+    def split_by(self, color_fn, key_fn=None) -> "TpuCommunicator":
+        """split_all with functions of the world axis index."""
+        n = self._axis_size
+        return self.split_all(
+            [color_fn(i) for i in range(n)],
+            [key_fn(i) for i in range(n)] if key_fn else None,
+        )
+
+    def dup(self) -> "TpuCommunicator":
+        # SPMD collectives carry no message-matching state, so a dup is a
+        # fresh handle over the same groups.
+        return TpuCommunicator(self.axis_name, self.mesh, self._groups)
+
+    def free(self) -> None:
+        pass

@@ -1,0 +1,67 @@
+"""run_spmd — execute a portable MPI program as one SPMD trace over a Mesh.
+
+SURVEY.md §7 Milestone 1: the TPU-native translation of "N processes
+exchanging messages" is ``jax.shard_map`` over a device mesh; the launcher's
+job (L0) is done by the TPU runtime.  ``run_spmd(fn, *args)`` gives ``fn`` a
+TpuCommunicator and runs it on every device of the mesh; per-rank results
+come back stacked on a leading axis (rank order), mirroring
+``run_local``'s list-of-results.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from .communicator import TpuCommunicator
+
+
+def default_mesh(nranks: Optional[int] = None, axis_name: str = "world") -> Mesh:
+    """1-D mesh over the first ``nranks`` local devices (all, if None).
+
+    On a CPU host, virtual devices come from
+    ``XLA_FLAGS=--xla_force_host_platform_device_count=N`` (SURVEY.md §4
+    item 2 — the standard fake-multi-device fixture)."""
+    devs = jax.devices()
+    n = len(devs) if nranks is None else nranks
+    if n > len(devs):
+        raise ValueError(
+            f"requested {n} ranks but only {len(devs)} devices are visible; "
+            f"on CPU set XLA_FLAGS=--xla_force_host_platform_device_count={n}"
+        )
+    return Mesh(np.array(devs[:n]), (axis_name,))
+
+
+def run_spmd(
+    fn: Callable,
+    *args: Any,
+    nranks: Optional[int] = None,
+    mesh: Optional[Mesh] = None,
+    axis_name: str = "world",
+    jit: bool = True,
+    **kwargs: Any,
+):
+    """Run ``fn(comm, *args, **kwargs)`` as one SPMD program.
+
+    ``args`` are replicated to every rank; each rank's return value gets a
+    length-1 leading axis and the stacked [nranks, ...] result is returned
+    (index it by rank to mirror ``run_local``'s per-rank list)."""
+    if mesh is None:
+        mesh = default_mesh(nranks, axis_name)
+    comm = TpuCommunicator(axis_name, mesh)
+
+    def shard_fn(*a):
+        res = fn(comm, *a, **kwargs)
+        return jax.tree.map(lambda r: jnp.asarray(r)[None], res)
+
+    in_specs = tuple(P() for _ in args)
+    f = jax.shard_map(shard_fn, mesh=mesh, in_specs=in_specs,
+                      out_specs=P(axis_name))
+    if jit:
+        f = jax.jit(f)
+    return f(*args)

@@ -9,3 +9,10 @@ if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
 os.environ["JAX_PLATFORMS"] = "cpu"
 os.environ.setdefault("JAX_ENABLE_X64", "0")
+
+# The axon site hook (this machine's TPU tunnel) force-registers its platform
+# via jax.config, overriding JAX_PLATFORMS — override it back before any
+# backend initializes so the suite runs on the 8 virtual CPU devices.
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

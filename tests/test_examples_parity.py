@@ -1,0 +1,78 @@
+"""Source-compatibility / parity tests (SURVEY.md §4 item 4): the SAME
+example program, byte-for-byte, runs on the CPU backends and the TPU SPMD
+backend and produces matching results; Jacobi additionally matches a serial
+numpy oracle."""
+
+import numpy as np
+import pytest
+
+from examples.jacobi import jacobi_program
+from examples.pi import pi_program
+from mpi_tpu.tpu import run_spmd
+from mpi_tpu.transport.local import run_local
+
+NR = 4
+
+
+def _serial_jacobi(nrows, cols, iters):
+    grid = np.zeros((nrows + 2, cols), np.float32)
+    grid[0] = 1.0  # hot top edge (the rank-0 halo in the distributed version)
+    cur = grid.copy()
+    for _ in range(iters):
+        new = cur.copy()
+        inner = 0.25 * (cur[:-2] + cur[2:]
+                        + np.pad(cur[1:-1, :-1], ((0, 0), (1, 0)))
+                        + np.pad(cur[1:-1, 1:], ((0, 0), (0, 1))))
+        inner[:, 0] = 0.0
+        inner[:, -1] = 0.0
+        new[1:-1] = inner
+        prev, cur = cur, new
+    return cur[1:-1], np.max(np.abs(cur[1:-1] - prev[1:-1]))
+
+
+def test_pi_local_vs_tpu_identical():
+    local = run_local(pi_program, NR, kwargs={"n_per_rank": 5000})
+    tpu = np.ravel(np.asarray(run_spmd(pi_program, nranks=NR, n_per_rank=5000)))
+    # same rank-seeded RNG, same reduction → identical estimates
+    for r in range(NR):
+        np.testing.assert_allclose(float(np.asarray(local[r])), tpu[r], rtol=1e-6)
+    assert abs(tpu[0] - np.pi) < 0.1
+
+
+def test_jacobi_local_vs_tpu_vs_serial():
+    rows, cols, iters = 4, 16, 40
+    local = run_local(jacobi_program, NR,
+                      kwargs={"rows_per_rank": rows, "cols": cols, "iters": iters})
+    blocks_l = np.concatenate([np.asarray(b) for b, _ in local])
+    res_l = float(np.asarray(local[0][1]))
+
+    blocks_t, res_t = run_spmd(jacobi_program, nranks=NR, rows_per_rank=rows,
+                               cols=cols, iters=iters)
+    blocks_t = np.asarray(blocks_t).reshape(NR * rows, cols)
+    res_t = float(np.asarray(res_t).ravel()[0])
+
+    oracle_grid, oracle_res = _serial_jacobi(NR * rows, cols, iters)
+
+    np.testing.assert_allclose(blocks_l, blocks_t, rtol=1e-5, atol=1e-7)
+    np.testing.assert_allclose(blocks_l, oracle_grid, rtol=1e-4, atol=1e-6)
+    np.testing.assert_allclose(res_l, res_t, rtol=1e-4)
+    np.testing.assert_allclose(res_l, oracle_res, rtol=1e-3, atol=1e-7)
+
+
+@pytest.mark.slow
+def test_jacobi_socket_parity():
+    """The socket backend (the reference's transport) runs the same program
+    with the same numbers — the BASELINE.json:7 CPU config."""
+    from test_socket_backend import run_socket_world
+
+    rows, cols, iters = 4, 16, 20
+    res = run_socket_world(
+        lambda comm: jacobi_program(comm, rows_per_rank=rows, cols=cols, iters=iters),
+        2,
+    )
+    blocks_s = np.concatenate([np.asarray(b) for b, _ in res])
+    blocks_t, _ = run_spmd(jacobi_program, nranks=2, rows_per_rank=rows,
+                           cols=cols, iters=iters)
+    np.testing.assert_allclose(
+        blocks_s, np.asarray(blocks_t).reshape(2 * rows, cols), rtol=1e-5, atol=1e-7
+    )
