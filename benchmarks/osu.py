@@ -1,0 +1,321 @@
+"""OSU-style micro-benchmark suite (SURVEY.md §2 component #12;
+BASELINE.json:2,7-10).
+
+Benchmarks: ``latency`` (ping-pong), ``bcast``, ``reduce``, ``allreduce``,
+``allgather``, ``alltoall`` — swept over message sizes and algorithm
+variants on any backend.  Output is JSON lines so BASELINE.md tables
+regenerate mechanically (SURVEY.md §5 observability row).
+
+Bus-bandwidth follows the NCCL-tests convention (SURVEY.md §6):
+allreduce ``bytes × 2(P−1)/P ÷ t``; allgather/alltoall ``bytes × (P−1)/P ÷
+t`` where bytes is the full gathered/exchanged payload; bcast/reduce
+``bytes ÷ t``.
+
+Usage::
+
+    python -m benchmarks.osu --bench allreduce --backend local -n 4 \
+        --sizes 1KB:1MB:4 --algorithms ring,recursive_halving
+    python -m benchmarks.osu --bench latency --backend socket -n 2
+    python -m benchmarks.osu --bench allreduce --backend tpu -n 8 --sizes 4KB:4MB:4
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import statistics
+import sys
+import time
+from typing import Dict, List, Optional
+
+import numpy as np
+
+try:
+    import mpi_tpu
+except ModuleNotFoundError:  # fresh checkout without install
+    sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    import mpi_tpu
+
+_UNITS = {"": 1, "B": 1, "KB": 1 << 10, "MB": 1 << 20, "GB": 1 << 30}
+
+
+def parse_size(token: str) -> int:
+    token = token.strip().upper()
+    for suffix in ("GB", "MB", "KB", "B"):
+        if token.endswith(suffix):
+            return int(float(token[: -len(suffix)]) * _UNITS[suffix])
+    return int(token)
+
+
+def parse_sizes(spec: str) -> List[int]:
+    """``lo:hi:factor`` geometric sweep, or a comma list of sizes (bytes,
+    with optional KB/MB/GB suffix)."""
+    if ":" in spec:
+        lo_s, hi_s, fac_s = spec.split(":")
+        lo, hi, fac = parse_size(lo_s), parse_size(hi_s), float(fac_s)
+        if fac <= 1:
+            raise ValueError("sweep factor must be > 1")
+        if lo < 1:
+            raise ValueError(f"sweep start must be >= 1 byte, got {lo}")
+        sizes, cur = [], lo
+        while cur <= hi:
+            sizes.append(int(cur))
+            cur *= fac
+        return sizes
+    return [parse_size(t) for t in spec.split(",")]
+
+
+def busbw_gbps(bench: str, nbytes: int, p: int, seconds: float) -> float:
+    if seconds <= 0:
+        return float("inf")
+    if bench == "allreduce":
+        moved = nbytes * 2 * (p - 1) / p
+    elif bench in ("allgather", "alltoall"):
+        moved = nbytes * (p - 1) / p
+    else:  # bcast, reduce
+        moved = nbytes
+    return moved / seconds / 1e9
+
+
+# ---------------------------------------------------------------------------
+# CPU backends: the benchmark is itself a portable MPI program
+# ---------------------------------------------------------------------------
+
+
+def _cpu_collective_call(comm, bench: str, x: np.ndarray, algo: str):
+    if bench == "allreduce":
+        return comm.allreduce(x, algorithm=algo)
+    if bench == "bcast":
+        return comm.bcast(x if comm.rank == 0 else None, root=0, algorithm=algo)
+    if bench == "reduce":
+        return comm.reduce(x, root=0, algorithm=algo)
+    if bench == "allgather":
+        return comm.allgather(x, algorithm=algo)
+    if bench == "alltoall":
+        blocks = np.array_split(x, comm.size)
+        return comm.alltoall(blocks, algorithm=algo)
+    raise ValueError(f"unknown benchmark {bench!r}")
+
+
+def cpu_bench_program(comm, bench: str, sizes: List[int], algos: List[str],
+                      iters: int, warmup: int) -> List[Dict]:
+    """Runs on every rank; returns rows on rank 0, [] elsewhere."""
+    rows: List[Dict] = []
+    if bench == "latency":
+        # classic osu_latency: ping-pong between ranks 0 and 1
+        for nbytes in sizes:
+            payload = np.zeros(max(1, nbytes // 4), np.float32)
+            comm.barrier()
+            samples = []
+            for i in range(warmup + iters):
+                t0 = time.perf_counter()
+                if comm.rank == 0:
+                    comm.send(payload, dest=1, tag=1)
+                    comm.recv(source=1, tag=2)
+                elif comm.rank == 1:
+                    comm.recv(source=0, tag=1)
+                    comm.send(payload, dest=0, tag=2)
+                if i >= warmup:
+                    samples.append((time.perf_counter() - t0) / 2)  # one-way
+            comm.barrier()
+            if comm.rank == 0:
+                rows.append({"bench": "latency", "nranks": comm.size,
+                             "bytes": nbytes,
+                             "p50_us": statistics.median(samples) * 1e6})
+        return rows
+
+    for nbytes in sizes:
+        if bench == "allgather":
+            # nbytes is the TOTAL gathered payload (busbw convention; matches
+            # the TPU path): each rank contributes nbytes/P
+            x = np.zeros(max(1, nbytes // 4 // comm.size), np.float32)
+        else:
+            x = np.zeros(max(1, nbytes // 4), np.float32)
+        for algo in algos:
+            try:
+                comm.barrier()
+                samples = []
+                for i in range(warmup + iters):
+                    t0 = time.perf_counter()
+                    _cpu_collective_call(comm, bench, x, algo)
+                    dt = time.perf_counter() - t0
+                    if i >= warmup:
+                        samples.append(dt)
+                # report the slowest rank's median (collective completion time)
+                p50 = float(np.asarray(comm.allreduce(
+                    np.float64(statistics.median(samples)), op=mpi_tpu.MAX,
+                    algorithm="reduce_bcast")))
+            except ValueError as e:
+                if comm.rank == 0:
+                    rows.append({"bench": bench, "bytes": nbytes, "algorithm": algo,
+                                 "skipped": str(e)})
+                continue
+            if comm.rank == 0:
+                rows.append({
+                    "bench": bench, "nranks": comm.size, "bytes": nbytes,
+                    "algorithm": algo, "p50_us": p50 * 1e6,
+                    "busbw_gbps": busbw_gbps(bench, nbytes, comm.size, p50),
+                })
+    return rows
+
+
+# ---------------------------------------------------------------------------
+# TPU backend: one jitted shard_map program per (bench, size, algorithm)
+# ---------------------------------------------------------------------------
+
+
+def tpu_bench(bench: str, sizes: List[int], algos: List[str], iters: int,
+              warmup: int, nranks: Optional[int]) -> List[Dict]:
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    from mpi_tpu.tpu import TpuCommunicator, default_mesh
+
+    mesh = default_mesh(nranks)
+    p = mesh.shape["world"]
+    comm = TpuCommunicator("world", mesh)
+    rows: List[Dict] = []
+
+    def timed(fn, x) -> float:
+        fn(x).block_until_ready()  # compile + warm
+        for _ in range(max(0, warmup - 1)):
+            fn(x).block_until_ready()
+        samples = []
+        for _ in range(iters):
+            t0 = time.perf_counter()
+            fn(x).block_until_ready()
+            samples.append(time.perf_counter() - t0)
+        return statistics.median(samples)
+
+    for nbytes in sizes:
+        n = max(1, nbytes // 4)
+        for algo in algos:
+            try:
+                if bench == "latency":
+                    # round-trip ppermute ring step there and back
+                    def body(x):
+                        y = comm.shift(x, offset=1, wrap=True)
+                        return comm.shift(y, offset=-1, wrap=True)
+                elif bench == "allreduce":
+                    def body(x, a=algo):
+                        return comm.allreduce(x, algorithm=a)
+                elif bench == "bcast":
+                    def body(x, a=algo):
+                        return comm.bcast(x, root=0, algorithm=a)
+                elif bench == "reduce":
+                    def body(x, a=algo):
+                        return comm.reduce(x, root=0, algorithm=a)
+                elif bench == "allgather":
+                    def body(x, a=algo):
+                        return comm.allgather(x, algorithm=a)
+                elif bench == "alltoall":
+                    def body(x, a=algo):
+                        return comm.alltoall(x, algorithm=a)
+                else:
+                    raise ValueError(f"unknown benchmark {bench!r}")
+
+                if bench == "alltoall":
+                    blk = max(1, n // p)
+                    x = jnp.zeros((p, blk), jnp.float32)
+                elif bench == "allgather":
+                    x = jnp.zeros(max(1, n // p), jnp.float32)
+                else:
+                    x = jnp.zeros(n, jnp.float32)
+                fn = jax.jit(jax.shard_map(body, mesh=mesh, in_specs=P(),
+                                           out_specs=P("world")))
+                t = timed(fn, x)
+            except ValueError as e:
+                rows.append({"bench": bench, "bytes": nbytes, "algorithm": algo,
+                             "skipped": str(e)})
+                continue
+            row = {"bench": bench, "backend": "tpu",
+                   "platform": mesh.devices.flat[0].platform,
+                   "nranks": p, "bytes": nbytes, "algorithm": algo,
+                   "p50_us": t * 1e6}
+            if bench == "latency":
+                row["p50_us"] = t * 1e6 / 2
+            else:
+                row["busbw_gbps"] = busbw_gbps(bench, nbytes, p, t)
+            rows.append(row)
+    return rows
+
+
+# ---------------------------------------------------------------------------
+# CLI
+# ---------------------------------------------------------------------------
+
+ALL_BENCHES = ["latency", "bcast", "reduce", "allreduce", "allgather", "alltoall"]
+DEFAULT_ALGOS = {
+    "allreduce": ["ring", "recursive_halving", "fused"],
+    "bcast": ["tree", "fused"],
+    "reduce": ["tree", "fused"],
+    "allgather": ["ring", "doubling", "fused"],
+    "alltoall": ["pairwise", "fused"],
+    "latency": ["-"],
+}
+
+
+def run_bench(bench: str, backend: str, nranks: int, sizes: List[int],
+              algos: List[str], iters: int, warmup: int) -> List[Dict]:
+    if backend == "tpu":
+        return tpu_bench(bench, sizes, algos, iters, warmup, nranks)
+    # 'fused' is the TPU XLA-collective tier; on CPU backends it would alias
+    # to a schedule whose identity depends on message size — mislabeled rows.
+    algos = [a for a in (algos or []) if a != "fused"] or ["auto"]
+    if backend == "local":
+        results = mpi_tpu.run_local(
+            cpu_bench_program, nranks,
+            args=(bench, sizes, algos, iters, warmup))
+        rows = results[0]
+    else:  # socket: must already be under the launcher
+        if "MPI_TPU_RANK" in os.environ:
+            rows = cpu_bench_program(mpi_tpu.init(), bench, sizes, algos,
+                                     iters, warmup)
+        else:
+            raise SystemExit(
+                "backend=socket must run under the launcher:\n"
+                f"  python -m mpi_tpu.launcher -n {nranks} benchmarks/osu.py ..."
+            )
+    for r in rows:
+        r.setdefault("backend", backend)
+    return rows
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__,
+                                 formatter_class=argparse.RawDescriptionHelpFormatter)
+    ap.add_argument("--bench", default="allreduce",
+                    choices=ALL_BENCHES + ["all"])
+    ap.add_argument("--backend", default="local",
+                    choices=["socket", "local", "tpu"])
+    ap.add_argument("-n", "--nranks", type=int, default=4)
+    ap.add_argument("--sizes", default="1KB:1MB:8")
+    ap.add_argument("--algorithms", default=None,
+                    help="comma list; default: all for the chosen benchmark")
+    ap.add_argument("--iters", type=int, default=50)
+    ap.add_argument("--warmup", type=int, default=5)
+    ap.add_argument("--out", default=None, help="also append JSON lines here")
+    args = ap.parse_args(argv)
+
+    sizes = parse_sizes(args.sizes)
+    benches = ALL_BENCHES if args.bench == "all" else [args.bench]
+    sink = open(args.out, "a") if args.out else None
+    for bench in benches:
+        algos = (args.algorithms.split(",") if args.algorithms
+                 else DEFAULT_ALGOS[bench])
+        rows = run_bench(bench, args.backend, args.nranks, sizes, algos,
+                         args.iters, args.warmup)
+        for row in rows:
+            line = json.dumps(row)
+            print(line)
+            if sink:
+                sink.write(line + "\n")
+    if sink:
+        sink.close()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

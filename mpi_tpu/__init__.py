@@ -33,14 +33,14 @@ from .ops import SUM, PROD, MAX, MIN, LAND, LOR, LXOR, BAND, BOR, BXOR, ReduceOp
 from .communicator import Communicator, P2PCommunicator, Status
 from .transport.base import ANY_SOURCE, ANY_TAG
 from .transport.local import run_local
-from . import schedules, checker
+from . import schedules, checker, profiling, trace
 
 __all__ = [
     "__version__", "ops", "ReduceOp",
     "SUM", "PROD", "MAX", "MIN", "LAND", "LOR", "LXOR", "BAND", "BOR", "BXOR",
     "Communicator", "P2PCommunicator", "Status", "ANY_SOURCE", "ANY_TAG",
     "init", "finalize", "is_initialized", "run", "run_local",
-    "schedules", "checker", "COMM_WORLD",
+    "schedules", "checker", "profiling", "trace", "COMM_WORLD",
 ]
 
 _ENV_RANK = "MPI_TPU_RANK"

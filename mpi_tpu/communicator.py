@@ -163,7 +163,8 @@ class P2PCommunicator(Communicator):
     L3 composes L2 primitives).
     """
 
-    def __init__(self, transport: Transport, group: Sequence[int], context=0):
+    def __init__(self, transport: Transport, group: Sequence[int], context=0,
+                 recv_timeout: Optional[float] = None):
         self._t = transport
         self._group: Tuple[int, ...] = tuple(group)
         if transport.world_rank not in self._group:
@@ -174,6 +175,10 @@ class P2PCommunicator(Communicator):
         self._ctx = context
         self._nchildren = 0
         self._lock = threading.Lock()
+        # Failure-detection knob: with a timeout, a lost message surfaces as
+        # RecvTimeout (with the pending-message summary) instead of a hang —
+        # see transport/faulty.py for the fault-injection counterpart.
+        self.recv_timeout = recv_timeout
 
     # -- identity ----------------------------------------------------------
 
@@ -214,7 +219,8 @@ class P2PCommunicator(Communicator):
     def _recv_internal(self, source: int, tag: int,
                        status: Optional[Status] = None) -> Any:
         src_world = ANY_SOURCE if source == ANY_SOURCE else self._world(source)
-        obj, src, t = self._t.recv(src_world, self._ctx, tag)
+        obj, src, t = self._t.recv(src_world, self._ctx, tag,
+                                   timeout=self.recv_timeout)
         if status is not None:
             status.source = self._from_world(src)
             status.tag = t
@@ -282,6 +288,8 @@ class P2PCommunicator(Communicator):
     def allreduce(self, obj: Any, op: _ops.ReduceOp = _ops.SUM,
                   algorithm: str = "auto") -> Any:
         arr, scalar = _as_array(obj)
+        if algorithm == "fused":  # no fused path on sockets; best schedule
+            algorithm = "auto"
         if algorithm == "auto":
             # Latency-optimal recursive halving for small payloads on
             # power-of-two groups; bandwidth-optimal ring otherwise
@@ -443,12 +451,13 @@ class P2PCommunicator(Communicator):
             (k, cr) for cr, (c, k) in enumerate(infos) if c == color
         )
         group = [self._group[cr] for _, cr in members]
-        return P2PCommunicator(self._t, group, ctx)
+        return P2PCommunicator(self._t, group, ctx, recv_timeout=self.recv_timeout)
 
     def dup(self) -> "P2PCommunicator":
         self.barrier()  # collectiveness check + sync, like MPI_Comm_dup
         ctx = self._alloc_context()
-        return P2PCommunicator(self._t, self._group, ctx)
+        return P2PCommunicator(self._t, self._group, ctx,
+                               recv_timeout=self.recv_timeout)
 
     def free(self) -> None:
         pass

@@ -1,0 +1,86 @@
+"""Profiling / observability helpers (SURVEY.md §5: tracing row).
+
+* :func:`trace` — context manager around ``jax.profiler`` emitting a
+  Perfetto/XProf trace directory for the enclosed collectives.
+* :func:`timeit` — robust wall-clock timing of a jax callable
+  (``block_until_ready`` fencing, warmup, median/percentiles) — the
+  measurement core shared by bench.py and benchmarks/osu.py conventions.
+* :class:`CommStats` — per-op counters a Communicator wrapper can fill;
+  structured (JSON-able) so observability output stays mechanical.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import statistics
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List
+
+
+@contextlib.contextmanager
+def trace(log_dir: str):
+    """Profile the enclosed block with jax.profiler (XProf/Perfetto trace in
+    ``log_dir``); works on TPU and CPU."""
+    import jax
+
+    jax.profiler.start_trace(log_dir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+@dataclass
+class Timing:
+    p50_s: float
+    p10_s: float
+    p90_s: float
+    n: int
+
+    @property
+    def p50_us(self) -> float:
+        return self.p50_s * 1e6
+
+
+def timeit(fn: Callable[[], Any], iters: int = 50, warmup: int = 5) -> Timing:
+    """Median wall-clock of ``fn()`` with device-fence per call: any returned
+    jax arrays are blocked on, so async dispatch doesn't fake the numbers."""
+    import jax
+
+    def call():
+        out = fn()
+        jax.block_until_ready(out)
+        return out
+
+    for _ in range(warmup):
+        call()
+    samples: List[float] = []
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        call()
+        samples.append(time.perf_counter() - t0)
+    samples.sort()
+    n = len(samples)
+    return Timing(
+        p50_s=statistics.median(samples),
+        p10_s=samples[round(0.1 * (n - 1))],
+        p90_s=samples[round(0.9 * (n - 1))],
+        n=n,
+    )
+
+
+@dataclass
+class CommStats:
+    """Structured per-op counters (counts + bytes), JSON-able for logs."""
+
+    ops: Dict[str, int] = field(default_factory=dict)
+    bytes: Dict[str, int] = field(default_factory=dict)
+
+    def record(self, op: str, nbytes: int = 0) -> None:
+        self.ops[op] = self.ops.get(op, 0) + 1
+        self.bytes[op] = self.bytes.get(op, 0) + nbytes
+
+    def to_json(self) -> str:
+        return json.dumps({"ops": self.ops, "bytes": self.bytes})

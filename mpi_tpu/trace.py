@@ -1,0 +1,87 @@
+"""Communication tracing + matching verification (SURVEY.md §5: the
+framework's race-detector / sanitizer analogue).
+
+Two layers:
+
+* :class:`TracingTransport` — wraps any Transport at the plugin boundary and
+  records every send/recv with timestamps; works under ``run_local``'s
+  ``transport_wrapper`` hook or around a SocketTransport.
+* :func:`verify_run` — runs a portable MPI program on the thread backend
+  with tracing on every rank, then cross-checks the per-rank logs with
+  mpi_tpu.checker.verify_matching: unmatched sends (message leaks) and
+  unmatched receives are reported exactly like a message-race detector
+  would.  The TPU backend needs none of this at runtime — SPMD matching is
+  static — but the same user program can be linted here before being run
+  under shard_map.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+from . import checker
+from .transport.base import Transport
+
+
+class TracingTransport(Transport):
+    """Decorator transport: records (op, peer, ctx, tag, t) tuples."""
+
+    def __init__(self, inner: Transport) -> None:
+        self.inner = inner
+        self.world_rank = inner.world_rank
+        self.world_size = inner.world_size
+        self.mailbox = inner.mailbox
+        self.log: List[Tuple] = []
+        self._lock = threading.Lock()
+
+    def _record(self, entry: Tuple) -> None:
+        with self._lock:
+            self.log.append(entry)
+
+    def send(self, dest: int, ctx, tag: int, payload: Any) -> None:
+        self._record(("send", dest, ctx, tag, time.monotonic()))
+        self.inner.send(dest, ctx, tag, payload)
+
+    def recv(self, source: int, ctx, tag: int, timeout: Optional[float] = None):
+        payload, src, t = self.inner.recv(source, ctx, tag, timeout)
+        # record the *matched* source/tag (wildcards resolved), which is what
+        # matching verification needs
+        self._record(("recv", src, ctx, t, time.monotonic()))
+        return payload, src, t
+
+    def close(self) -> None:
+        self.inner.close()
+
+    def as_match_log(self) -> List[Tuple[str, int, int]]:
+        """Project to checker.verify_matching format: (op, peer, tag)."""
+        return [(op, peer, tag) for (op, peer, ctx, tag, _) in self.log]
+
+
+def verify_run(
+    fn: Callable,
+    nranks: int,
+    args: Sequence = (),
+    kwargs: Optional[Dict] = None,
+    timeout: float = 120.0,
+) -> Tuple[List[Any], List[str]]:
+    """Run ``fn(comm, *args)`` on the thread backend with full comm tracing;
+    return (per-rank results, problems).  ``problems`` is empty iff every
+    send was received and every recv was satisfied by a real send —
+    the dynamic analogue of the static ppermute checks."""
+    from .transport.local import run_local
+
+    traces: List[Optional[TracingTransport]] = [None] * nranks
+    lock = threading.Lock()
+
+    def wrapper(t: Transport) -> Transport:
+        tt = TracingTransport(t)
+        with lock:
+            traces[t.world_rank] = tt
+        return tt
+
+    results = run_local(fn, nranks, args=args, kwargs=kwargs, timeout=timeout,
+                        transport_wrapper=wrapper)
+    logs = [t.as_match_log() if t else [] for t in traces]
+    return results, checker.verify_matching(logs)

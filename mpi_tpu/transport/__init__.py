@@ -1,4 +1,5 @@
 from .base import ANY_SOURCE, ANY_TAG, Mailbox, RecvTimeout, Transport, TransportError
+from .faulty import FaultyTransport
 from .local import LocalTransport, LocalWorld, run_local
 from .socket import SocketTransport
 
@@ -13,4 +14,5 @@ __all__ = [
     "LocalWorld",
     "run_local",
     "SocketTransport",
+    "FaultyTransport",
 ]

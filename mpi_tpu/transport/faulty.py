@@ -1,0 +1,66 @@
+"""Fault-injection transport wrapper (SURVEY.md §5: 'a transport wrapper
+that drops/permutes in the CPU simulator').
+
+Wraps any Transport and injects configurable faults on the send path:
+
+* ``drop_every`` — silently drop every k-th message (models a lossy link;
+  the receiver's RecvTimeout then surfaces the hang the way a failure
+  detector would);
+* ``delay_s`` — sleep before delivering (models congestion; exposes
+  ordering assumptions that only hold under low latency);
+* ``duplicate_every`` — deliver every k-th message twice (models retry
+  storms; exposes non-idempotent receive logic).
+
+FIFO order per channel is preserved for non-faulted messages.  Use with
+``run_local(..., transport_wrapper=FaultyTransport.wrapper(...))`` and a
+recv ``timeout`` to turn silent deadlocks into diagnosable failures.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Optional
+
+from .base import Transport
+
+
+class FaultyTransport(Transport):
+    def __init__(self, inner: Transport, drop_every: int = 0,
+                 delay_s: float = 0.0, duplicate_every: int = 0) -> None:
+        self.inner = inner
+        self.world_rank = inner.world_rank
+        self.world_size = inner.world_size
+        self.mailbox = inner.mailbox
+        self.drop_every = drop_every
+        self.delay_s = delay_s
+        self.duplicate_every = duplicate_every
+        self._n = 0
+        self._lock = threading.Lock()
+        self.dropped = 0
+        self.duplicated = 0
+
+    @classmethod
+    def wrapper(cls, **kwargs):
+        """For run_local's transport_wrapper hook."""
+        return lambda inner: cls(inner, **kwargs)
+
+    def send(self, dest: int, ctx, tag: int, payload: Any) -> None:
+        with self._lock:
+            self._n += 1
+            n = self._n
+        if self.drop_every and n % self.drop_every == 0:
+            self.dropped += 1
+            return
+        if self.delay_s:
+            time.sleep(self.delay_s)
+        self.inner.send(dest, ctx, tag, payload)
+        if self.duplicate_every and n % self.duplicate_every == 0:
+            self.duplicated += 1
+            self.inner.send(dest, ctx, tag, payload)
+
+    def recv(self, source: int, ctx, tag: int, timeout: Optional[float] = None):
+        return self.inner.recv(source, ctx, tag, timeout)
+
+    def close(self) -> None:
+        self.inner.close()

@@ -54,6 +54,7 @@ def run_local(
     timeout: float = 120.0,
     copy_payloads: bool = True,
     transport_wrapper: Optional[Callable[[Transport], Transport]] = None,
+    recv_timeout: Optional[float] = None,
 ) -> List[Any]:
     """Run ``fn(comm, *args, **kwargs)`` on ``nranks`` in-process ranks;
     return the per-rank results as a list indexed by rank.
@@ -74,7 +75,7 @@ def run_local(
             t: Transport = LocalTransport(world, r)
             if transport_wrapper is not None:
                 t = transport_wrapper(t)
-            comm = P2PCommunicator(t, range(nranks))
+            comm = P2PCommunicator(t, range(nranks), recv_timeout=recv_timeout)
             results[r] = fn(comm, *args, **kwargs)
         except BaseException as e:  # noqa: BLE001 - propagated to caller below
             with lock:

@@ -1,0 +1,54 @@
+"""Benchmarks-as-tests (SURVEY.md §4 item 5): the OSU sweep runs in smoke
+mode under pytest — tiny sizes, assert completion and sane numbers; full
+sweeps are the CLI."""
+
+import numpy as np
+import pytest
+
+from benchmarks.osu import busbw_gbps, parse_size, parse_sizes, run_bench
+
+
+def test_parse_size():
+    assert parse_size("1024") == 1024
+    assert parse_size("4KB") == 4096
+    assert parse_size("2MB") == 2 << 20
+    assert parse_size("1GB") == 1 << 30
+
+
+def test_parse_sizes_sweep():
+    assert parse_sizes("1KB:16KB:4") == [1024, 4096, 16384]
+    assert parse_sizes("100,200") == [100, 200]
+    with pytest.raises(ValueError):
+        parse_sizes("1KB:1MB:1")
+
+
+def test_busbw_convention():
+    # allreduce: bytes * 2(P-1)/P / t  (NCCL convention, SURVEY.md §6)
+    assert busbw_gbps("allreduce", 8 << 30, 8, 2.0) == pytest.approx(
+        (8 << 30) * 1.75 / 2 / 1e9, rel=1e-6)
+    assert busbw_gbps("allgather", 1 << 30, 4, 1.0) == pytest.approx(0.75 * (1 << 30) / 1e9)
+    assert busbw_gbps("bcast", 10**9, 4, 1.0) == pytest.approx(1.0)
+
+
+@pytest.mark.parametrize("bench", ["latency", "allreduce", "allgather", "alltoall"])
+def test_local_smoke(bench):
+    rows = run_bench(bench, "local", 4, [1024], None if bench == "latency" else ["ring"]
+                     if bench in ("allreduce", "allgather") else ["pairwise"],
+                     iters=3, warmup=1)
+    rows = [r for r in rows if "skipped" not in r]
+    assert rows, "no benchmark rows produced"
+    for r in rows:
+        assert r["p50_us"] > 0
+        assert np.isfinite(r["p50_us"])
+
+
+@pytest.mark.parametrize("bench", ["allreduce", "bcast", "alltoall"])
+def test_tpu_smoke(bench):
+    algos = {"allreduce": ["ring", "fused"], "bcast": ["tree"],
+             "alltoall": ["fused"]}[bench]
+    rows = run_bench(bench, "tpu", 8, [1024], algos, iters=2, warmup=1)
+    rows = [r for r in rows if "skipped" not in r]
+    assert len(rows) == len(algos)
+    for r in rows:
+        assert r["p50_us"] > 0
+        assert r["busbw_gbps"] >= 0
