@@ -1,0 +1,89 @@
+"""Ulysses-style (DeepSpeed-Ulysses) sequence parallelism via all-to-all.
+
+The second long-context strategy the framework's primitives support
+(SURVEY.md §2 strategy table: "MPI_Alltoall (the Ulysses primitive) IS in
+scope"): ranks start sequence-sharded with all heads; one all-to-all
+re-shards to head-sharded with the full sequence; attention runs locally
+per head (exact, no online-softmax needed); a second all-to-all restores
+sequence sharding.  Communication is 2 all-to-alls per attention call
+instead of P-1 ring hops — the better trade when heads >= ranks and the
+interconnect favors all-to-all (ICI does).
+
+    python examples/ulysses_attention.py --backend tpu -n 8
+"""
+
+import argparse
+import math
+import os
+import sys
+
+try:
+    import mpi_tpu
+except ModuleNotFoundError:  # running from a fresh checkout without install
+    sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    import mpi_tpu
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def _seq_to_heads(comm, x):
+    """[s_local, H, d] → [S, H/P, d] via one all-to-all."""
+    s, H, d = x.shape
+    P = comm.size
+    blocks = x.reshape(s, P, H // P, d).transpose(1, 0, 2, 3)  # [P, s, H/P, d]
+    gathered = comm.alltoall(blocks)                           # [P, s, H/P, d]
+    return jnp.asarray(gathered).reshape(P * s, H // P, d)
+
+
+def _heads_to_seq(comm, x, s_local):
+    """[S, H/P, d] → [s_local, H, d] via the inverse all-to-all."""
+    S, Hp, d = x.shape
+    P = comm.size
+    blocks = x.reshape(P, s_local, Hp, d)                      # [P, s, H/P, d]
+    scattered = comm.alltoall(blocks)                          # [P, s, H/P, d]
+    return jnp.asarray(scattered).transpose(1, 0, 2, 3).reshape(s_local, P * Hp, d)
+
+
+def ulysses_attention(comm, q, k, v):
+    """Exact multi-head attention, sequence-sharded in and out.
+
+    q, k, v: [s_local, H, d] with H divisible by comm.size."""
+    s_local, H, d = q.shape
+    if H % comm.size:
+        raise ValueError(f"heads ({H}) must be divisible by ranks ({comm.size})")
+    qh, kh, vh = (_seq_to_heads(comm, t) for t in (q, k, v))   # [S, H/P, d]
+    scores = jnp.einsum("shd,thd->hst", qh, kh) / math.sqrt(d)
+    probs = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum("hst,thd->shd", probs, vh)                # [S, H/P, d]
+    return _heads_to_seq(comm, out, s_local)
+
+
+def ulysses_program(comm, seq_per_rank: int = 32, heads: int = 8, d: int = 16):
+    key = jax.random.fold_in(jax.random.PRNGKey(11), comm.rank)
+    kq, kk, kv = jax.random.split(key, 3)
+    shape = (seq_per_rank, heads, d)
+    q = jax.random.normal(kq, shape, jnp.float32)
+    k = jax.random.normal(kk, shape, jnp.float32)
+    v = jax.random.normal(kv, shape, jnp.float32)
+    return ulysses_attention(comm, q, k, v), q, k, v
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--backend", default=None, choices=[None, "socket", "local", "tpu"])
+    ap.add_argument("-n", "--nranks", type=int, default=None)
+    ap.add_argument("--seq-per-rank", type=int, default=32)
+    ap.add_argument("--heads", type=int, default=8)
+    args = ap.parse_args()
+
+    out = mpi_tpu.run(ulysses_program, backend=args.backend, nranks=args.nranks,
+                      seq_per_rank=args.seq_per_rank, heads=args.heads)
+    first = out[0] if isinstance(out, list) else out
+    o = np.asarray(jax.device_get(first[0] if isinstance(first, tuple) else first))
+    print(f"ulysses attention OK: local {o.shape}, |out| = {np.abs(o).mean():.4f}")
+
+
+if __name__ == "__main__":
+    main()

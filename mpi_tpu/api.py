@@ -25,6 +25,7 @@ __all__ = [
     "MPI_Comm_rank", "MPI_Comm_size", "MPI_Send", "MPI_Recv", "MPI_Sendrecv",
     "MPI_Bcast", "MPI_Reduce", "MPI_Allreduce", "MPI_Allgather", "MPI_Alltoall",
     "MPI_Barrier", "MPI_Comm_split", "MPI_Comm_dup", "MPI_Scatter", "MPI_Gather",
+    "MPI_Scan", "MPI_Reduce_scatter",
     "ANY_SOURCE", "ANY_TAG", "SUM", "PROD", "MAX", "MIN", "Status",
 ]
 
@@ -127,3 +128,13 @@ def MPI_Scatter(objs: Optional[Sequence[Any]], root: int = 0,
 
 def MPI_Gather(obj: Any, root: int = 0, comm: Optional[Communicator] = None) -> Any:
     return _world(comm).gather(obj, root)
+
+
+def MPI_Scan(obj: Any, op: ops.ReduceOp = ops.SUM,
+             comm: Optional[Communicator] = None) -> Any:
+    return _world(comm).scan(obj, op)
+
+
+def MPI_Reduce_scatter(blocks: Any, op: ops.ReduceOp = ops.SUM,
+                       comm: Optional[Communicator] = None) -> Any:
+    return _world(comm).reduce_scatter(blocks, op)

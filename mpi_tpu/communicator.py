@@ -68,6 +68,24 @@ def _unwrap(arr: np.ndarray, was_scalar: bool) -> Any:
     return arr[()] if was_scalar else arr
 
 
+def _maybe_stack(local_payload: Any, items: List[Any]) -> Any:
+    """Stack gathered results into a [P, ...] array ONLY when the local
+    payload was an array and every result agrees in shape/dtype — matching
+    the TPU backend's stacked convention without giving up the pickle
+    backends' heterogeneous-payload generality (a list otherwise)."""
+    if not (hasattr(local_payload, "shape") and hasattr(local_payload, "dtype")):
+        return items
+    arrs = []
+    for i in items:
+        if not (hasattr(i, "shape") and hasattr(i, "dtype")):
+            return items
+        a = np.asarray(i)
+        if arrs and (a.shape != arrs[0].shape or a.dtype != arrs[0].dtype):
+            return items
+        arrs.append(a)
+    return np.stack(arrs)
+
+
 class Communicator(ABC):
     """Abstract communicator: the API user MPI programs are written against."""
 
@@ -133,6 +151,32 @@ class Communicator(ABC):
 
     @abstractmethod
     def barrier(self) -> None: ...
+
+    def localize(self, obj: Any) -> Any:
+        """Mark ``obj`` as rank-local state (identity on process-backed
+        backends).  On the TPU backend this brands replicated values as
+        rank-varying, which matters for autodiff: jax's varying-axes-typed AD
+        auto-psums the cotangent of a *replicated* value used in a varying
+        computation, so MPI-style programs that take ``jax.grad`` w.r.t.
+        replicated parameters and then ``allreduce`` the gradients would
+        double-count by a factor of P.  Wrap per-rank model state in
+        ``comm.localize(...)`` once at creation and gradients stay local,
+        making the explicit allreduce the single point of synchronization on
+        every backend (see examples/data_parallel.py)."""
+        return obj
+
+    def scan(self, obj: Any, op: _ops.ReduceOp = _ops.SUM) -> Any:
+        """MPI_Scan [S]: inclusive prefix reduction — rank r gets the
+        reduction of ranks 0..r."""
+        raise NotImplementedError(f"{type(self).__name__} does not implement scan")
+
+    def reduce_scatter(self, blocks: Any, op: _ops.ReduceOp = _ops.SUM,
+                       algorithm: str = "auto") -> Any:
+        """MPI_Reduce_scatter_block [S]: ``blocks`` holds one block per rank
+        (leading dimension == size); rank r gets the reduction of everyone's
+        block r."""
+        raise NotImplementedError(
+            f"{type(self).__name__} does not implement reduce_scatter")
 
     def scatter(self, objs: Optional[Sequence[Any]], root: int = 0) -> Any:
         raise NotImplementedError(f"{type(self).__name__} does not implement scatter")
@@ -386,7 +430,7 @@ class P2PCommunicator(Communicator):
                 items[i] = v
         else:
             raise ValueError(f"unknown allgather algorithm {algorithm!r}")
-        return items
+        return _maybe_stack(obj, items)
 
     def alltoall(self, objs: Sequence[Any], algorithm: str = "auto") -> List[Any]:
         p, r = self.size, self._rank
@@ -400,7 +444,7 @@ class P2PCommunicator(Communicator):
         for k in schedules.alltoall_rounds(p):
             dst, src = (r + k) % p, (r - k) % p
             result[src] = self._sendrecv_internal(objs[dst], dst, src, _TAG_COLL)
-        return result
+        return _maybe_stack(objs, result)
 
     def barrier(self) -> None:
         # Dissemination barrier, ceil(log2 P) rounds [S].
@@ -408,6 +452,44 @@ class P2PCommunicator(Communicator):
         for off in schedules.dissemination_offsets(p):
             self._send_internal(None, (r + off) % p, _TAG_BARRIER)
             self._recv_internal((r - off) % p, _TAG_BARRIER)
+
+    def scan(self, obj: Any, op: _ops.ReduceOp = _ops.SUM) -> Any:
+        # Hillis-Steele inclusive scan: log2(P) rounds of distance-doubling
+        # partial prefixes [S].
+        arr, scalar = _as_array(obj)
+        acc = arr.copy()
+        p, r = self.size, self._rank
+        d = 1
+        while d < p:
+            if r + d < p:
+                self._send_internal(acc, r + d, _TAG_COLL)
+            if r - d >= 0:
+                recvd = self._recv_internal(r - d, _TAG_COLL)
+                acc = op.combine(recvd, acc)  # received prefix goes LEFT
+            d *= 2
+        return _unwrap(acc, scalar)
+
+    def reduce_scatter(self, blocks: Any, op: _ops.ReduceOp = _ops.SUM,
+                       algorithm: str = "auto") -> Any:
+        p, r = self.size, self._rank
+        if algorithm in ("auto", "fused"):
+            algorithm = "ring"
+        if algorithm != "ring":
+            raise ValueError(f"unknown reduce_scatter algorithm {algorithm!r}")
+        if len(blocks) != p:
+            raise ValueError(
+                f"reduce_scatter needs one block per rank ({p}), got {len(blocks)}")
+        chunks = [np.asarray(b).copy() for b in blocks]
+        was_scalar = chunks[0].ndim == 0
+        if p == 1:
+            return _unwrap(chunks[0], was_scalar)
+        right, left = (r + 1) % p, (r - 1) % p
+        for step in range(p - 1):
+            si = schedules.ring_rs_block_send_chunk(r, step, p)
+            ri = schedules.ring_rs_block_recv_chunk(r, step, p)
+            recvd = self._sendrecv_internal(chunks[si], right, left, _TAG_COLL)
+            chunks[ri] = op.combine(chunks[ri], recvd)
+        return _unwrap(np.asarray(chunks[r]), was_scalar)
 
     def scatter(self, objs: Optional[Sequence[Any]], root: int = 0) -> Any:
         if self._rank == root:

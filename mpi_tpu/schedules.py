@@ -104,6 +104,19 @@ def ring_ag_recv_chunk(rank, step: int, size: int):
     return (rank - step) % size
 
 
+# Reduce-scatter-to-rank variant: same ring, chunk indices shifted by one so
+# that after P-1 steps rank r holds the fully reduced chunk r (MPI
+# Reduce_scatter_block semantics) instead of chunk (r+1) mod P.
+
+
+def ring_rs_block_send_chunk(rank, step: int, size: int):
+    return (rank - step - 1) % size
+
+
+def ring_rs_block_recv_chunk(rank, step: int, size: int):
+    return (rank - step - 2) % size
+
+
 # ---------------------------------------------------------------------------
 # Recursive halving / doubling (allreduce, allgather — BASELINE.json:10)
 # ---------------------------------------------------------------------------

@@ -280,6 +280,34 @@ def doubling_allgather(
     return buf
 
 
+def ring_reduce_scatter(
+    x: jnp.ndarray,
+    axis_name: str,
+    size: int,
+    grank,
+    world_pairs: WorldPairs,
+    op: _ops.ReduceOp = _ops.SUM,
+) -> jnp.ndarray:
+    """Reduce-scatter ring on stacked [P, ...] blocks: P-1 ppermute steps;
+    rank r ends holding the fully reduced block r (the rs-to-rank chunk
+    indexing of mpi_tpu/schedules.py)."""
+    if x.shape[0] != size:
+        raise ValueError(f"need leading dim == {size}, got {x.shape}")
+    chunks = _ensure_varying(x, axis_name)
+    perm = world_pairs(schedules.ring_perm(size, 1))
+
+    def step(s, chunks):
+        si = schedules.ring_rs_block_send_chunk(grank, s, size)
+        ri = schedules.ring_rs_block_recv_chunk(grank, s, size)
+        send = lax.dynamic_index_in_dim(chunks, si, 0, keepdims=False)
+        recvd = lax.ppermute(send, axis_name, perm)
+        cur = lax.dynamic_index_in_dim(chunks, ri, 0, keepdims=False)
+        return lax.dynamic_update_index_in_dim(chunks, op.combine(cur, recvd), ri, 0)
+
+    chunks = lax.fori_loop(0, size - 1, step, chunks)
+    return lax.dynamic_index_in_dim(chunks, grank, 0, keepdims=False)
+
+
 # ---------------------------------------------------------------------------
 # Pairwise alltoall (BASELINE.json:9)
 # ---------------------------------------------------------------------------

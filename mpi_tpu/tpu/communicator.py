@@ -186,6 +186,19 @@ class TpuCommunicator(Communicator):
             recvd = jnp.where(has_src, recvd, jnp.full_like(recvd, fill))
         return recvd
 
+    def localize(self, obj):
+        """Brand a (replicated) value as rank-varying over this comm's axis.
+
+        See Communicator.localize: without this, ``jax.grad`` w.r.t. a
+        replicated closure constant inside shard_map yields the psum of
+        per-rank gradients (jax's varying-axes-typed AD), silently breaking
+        the MPI mental model where gradients are local until explicitly
+        reduced."""
+        import jax as _jax
+
+        return _jax.tree.map(
+            lambda x: algos._ensure_varying(jnp.asarray(x), self.axis_name), obj)
+
     def exchange(self, obj, pairs: Sequence[Pair]):
         """Static-pattern p2p: every (src, dst) in ``pairs`` (group-local
         ranks) ships src's payload to dst in one ppermute.  This is the SPMD
@@ -322,6 +335,51 @@ class TpuCommunicator(Communicator):
         """SPMD programs are globally scheduled; emit a tiny psum as an
         explicit synchronization point (also an ICI liveness probe)."""
         lax.psum(jnp.zeros((), jnp.float32), self.axis_name)
+
+    def scan(self, obj, op: _ops.ReduceOp = _ops.SUM):
+        """Hillis-Steele inclusive prefix reduction: log2(P) masked-ppermute
+        rounds; boundary holes are filled with the op identity so the
+        unconditional combine is exact."""
+        x = jnp.asarray(obj)
+        if self.size == 1:
+            return x
+        acc = x
+        # keep the identity as the dtype-typed numpy scalar — a float() round
+        # trip corrupts 64-bit integer identities (iinfo(int64).max etc.)
+        ident = op.identity(np.dtype(x.dtype))
+        d = 1
+        while d < self.size:
+            recvd = self.shift(acc, offset=d, wrap=False, fill=ident)
+            acc = op.combine(recvd, acc)  # received prefix goes LEFT
+            d *= 2
+        return acc
+
+    def reduce_scatter(self, blocks, op: _ops.ReduceOp = _ops.SUM,
+                       algorithm: str = "auto"):
+        """``blocks``: stacked [size, ...]; returns this rank's reduced block.
+        'fused' lowers to one ``lax.psum_scatter`` (reduce-scatter over ICI —
+        half of the ring-allreduce, and the gradient-sharding primitive of
+        ZeRO/FSDP-style training); 'ring' is the hand schedule."""
+        x = jnp.asarray(blocks)
+        if x.shape[0] != self.size:
+            raise ValueError(
+                f"reduce_scatter payload needs leading dim == communicator "
+                f"size ({self.size}), got {x.shape}")
+        if algorithm == "auto":
+            algorithm = "fused"
+        if self.size == 1:
+            return self._degenerate(x[0])
+        if algorithm == "fused":
+            if op.name == "sum":
+                return lax.psum_scatter(x, self.axis_name, scatter_dimension=0,
+                                        axis_index_groups=self._groups,
+                                        tiled=False)
+            # non-SUM: reduce locally after a fused alltoall of blocks
+            return algos.tree_reduce_local(op, self.alltoall(x, "fused"))
+        if algorithm == "ring":
+            return algos.ring_reduce_scatter(x, self.axis_name, self.size,
+                                             self.rank, self._world_pairs, op)
+        raise ValueError(f"unknown reduce_scatter algorithm {algorithm!r}")
 
     def scatter(self, objs, root: int = 0):
         """``objs``: stacked [size, ...] meaningful at root; every rank gets

@@ -1,0 +1,119 @@
+"""MPI_Scan + MPI_Reduce_scatter semantics on both backends vs numpy
+oracles, including cross-backend parity."""
+
+import numpy as np
+import pytest
+
+from mpi_tpu import ops
+from mpi_tpu.tpu import TpuCommunicator, default_mesh, run_spmd
+from mpi_tpu.transport.local import run_local
+
+P = 8
+
+
+@pytest.mark.parametrize("n", [1, 2, 3, 4, 8])
+def test_scan_local(n):
+    data = np.random.RandomState(0).randn(n, 5)
+
+    def prog(comm):
+        return comm.scan(data[comm.rank], op=ops.SUM)
+
+    res = run_local(prog, n)
+    for r in range(n):
+        np.testing.assert_allclose(res[r], data[: r + 1].sum(0), rtol=1e-10)
+
+
+def test_scan_local_max():
+    data = np.random.RandomState(1).randn(4, 3)
+
+    def prog(comm):
+        return comm.scan(data[comm.rank], op=ops.MAX)
+
+    res = run_local(prog, 4)
+    for r in range(4):
+        np.testing.assert_allclose(res[r], data[: r + 1].max(0))
+
+
+@pytest.mark.parametrize("op,oracle", [
+    (ops.SUM, lambda d, r: d[: r + 1].sum(0)),
+    (ops.MAX, lambda d, r: d[: r + 1].max(0)),
+])
+def test_scan_tpu(op, oracle):
+    data = np.asarray(np.random.RandomState(2).randn(P, 5), np.float32)
+
+    def prog(comm, x):
+        return comm.scan(x[comm.rank], op=op)
+
+    out = np.asarray(run_spmd(prog, data))
+    for r in range(P):
+        np.testing.assert_allclose(out[r], oracle(data, r), rtol=1e-5, atol=1e-6)
+
+
+@pytest.mark.parametrize("n", [2, 3, 4])
+def test_reduce_scatter_local(n):
+    data = np.random.RandomState(3).randn(n, n, 4)  # [src, block, k]
+
+    def prog(comm):
+        return comm.reduce_scatter(data[comm.rank], op=ops.SUM)
+
+    res = run_local(prog, n)
+    for r in range(n):
+        np.testing.assert_allclose(res[r], data[:, r].sum(0), rtol=1e-10)
+
+
+@pytest.mark.parametrize("algo", ["fused", "ring"])
+def test_reduce_scatter_tpu(algo):
+    data = np.asarray(np.random.RandomState(4).randn(P, P, 3), np.float32)
+
+    def prog(comm, x):
+        return comm.reduce_scatter(x[comm.rank], op=ops.SUM, algorithm=algo)
+
+    out = np.asarray(run_spmd(prog, data))
+    for r in range(P):
+        np.testing.assert_allclose(out[r], data[:, r].sum(0), rtol=1e-4, atol=1e-5)
+
+
+def test_reduce_scatter_tpu_max_fused():
+    data = np.asarray(np.random.RandomState(5).randn(P, P, 2), np.float32)
+
+    def prog(comm, x):
+        return comm.reduce_scatter(x[comm.rank], op=ops.MAX, algorithm="fused")
+
+    out = np.asarray(run_spmd(prog, data))
+    for r in range(P):
+        np.testing.assert_allclose(out[r], data[:, r].max(0), rtol=1e-5)
+
+
+def test_reduce_scatter_grouped():
+    mesh = default_mesh()
+    world = TpuCommunicator("world", mesh)
+    rows = world.split_by(lambda i: i // 4)
+    data = np.asarray(np.random.RandomState(6).randn(P, 4, 3), np.float32)
+
+    def prog(comm, x):
+        return rows.reduce_scatter(x[comm.rank], op=ops.SUM, algorithm="ring")
+
+    out = np.asarray(run_spmd(prog, data, mesh=mesh))
+    for r in range(P):
+        grp = slice(0, 4) if r < 4 else slice(4, 8)
+        np.testing.assert_allclose(out[r], data[grp, r % 4].sum(0),
+                                   rtol=1e-4, atol=1e-5)
+
+
+def test_allgather_alltoall_cpu_stack_arrays():
+    """Array payloads stack on CPU backends, matching TPU's [P, ...] result."""
+
+    def prog(comm):
+        g = comm.allgather(np.full(2, float(comm.rank)))
+        blocks = np.arange(comm.size * 3.0).reshape(comm.size, 3) + comm.rank * 100
+        a = comm.alltoall(blocks)
+        return g, a
+
+    res = run_local(prog, 4)
+    g0, a0 = res[0]
+    assert isinstance(g0, np.ndarray) and g0.shape == (4, 2)
+    assert isinstance(a0, np.ndarray) and a0.shape == (4, 3)
+    np.testing.assert_array_equal(g0[:, 0], [0, 1, 2, 3])
+    # a0[src] = src's block 0 = [0,1,2] + src*100
+    for src in range(4):
+        np.testing.assert_array_equal(a0[src], np.arange(3.0) + src * 100)
