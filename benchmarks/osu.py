@@ -223,8 +223,9 @@ def tpu_bench(bench: str, sizes: List[int], algos: List[str], iters: int,
                     x = jnp.zeros(max(1, n // p), jnp.float32)
                 else:
                     x = jnp.zeros(n, jnp.float32)
-                fn = jax.jit(jax.shard_map(body, mesh=mesh, in_specs=P(),
-                                           out_specs=P("world")))
+                fn = jax.jit(jax.shard_map(
+                    body, mesh=mesh, in_specs=P(), out_specs=P("world"),
+                    check_vma=(algo != "pallas_ring")))
                 t = timed(fn, x)
             except ValueError as e:
                 rows.append({"bench": bench, "bytes": nbytes, "algorithm": algo,
@@ -248,7 +249,7 @@ def tpu_bench(bench: str, sizes: List[int], algos: List[str], iters: int,
 
 ALL_BENCHES = ["latency", "bcast", "reduce", "allreduce", "allgather", "alltoall"]
 DEFAULT_ALGOS = {
-    "allreduce": ["ring", "recursive_halving", "fused"],
+    "allreduce": ["ring", "recursive_halving", "fused"],  # + pallas_ring (tpu, opt-in)
     "bcast": ["tree", "fused"],
     "reduce": ["tree", "fused"],
     "allgather": ["ring", "doubling", "fused"],

@@ -25,7 +25,8 @@ __all__ = [
     "MPI_Comm_rank", "MPI_Comm_size", "MPI_Send", "MPI_Recv", "MPI_Sendrecv",
     "MPI_Bcast", "MPI_Reduce", "MPI_Allreduce", "MPI_Allgather", "MPI_Alltoall",
     "MPI_Barrier", "MPI_Comm_split", "MPI_Comm_dup", "MPI_Scatter", "MPI_Gather",
-    "MPI_Scan", "MPI_Reduce_scatter",
+    "MPI_Scan", "MPI_Reduce_scatter", "MPI_Isend", "MPI_Irecv", "MPI_Wait",
+    "MPI_Test", "MPI_Waitall", "MPI_Probe", "MPI_Iprobe", "MPI_Wtime",
     "ANY_SOURCE", "ANY_TAG", "SUM", "PROD", "MAX", "MIN", "Status",
 ]
 
@@ -128,6 +129,44 @@ def MPI_Scatter(objs: Optional[Sequence[Any]], root: int = 0,
 
 def MPI_Gather(obj: Any, root: int = 0, comm: Optional[Communicator] = None) -> Any:
     return _world(comm).gather(obj, root)
+
+
+def MPI_Isend(obj: Any, dest: int, tag: int = 0,
+              comm: Optional[Communicator] = None):
+    return _world(comm).isend(obj, dest, tag)
+
+
+def MPI_Irecv(source: int = ANY_SOURCE, tag: int = ANY_TAG,
+              comm: Optional[Communicator] = None):
+    return _world(comm).irecv(source, tag)
+
+
+def MPI_Wait(request) -> Any:
+    return request.wait()
+
+
+def MPI_Test(request):
+    return request.test()
+
+
+def MPI_Waitall(requests) -> list:
+    return [r.wait() for r in requests]
+
+
+def MPI_Probe(source: int = ANY_SOURCE, tag: int = ANY_TAG,
+              comm: Optional[Communicator] = None, status=None) -> None:
+    _world(comm).probe(source, tag, status)
+
+
+def MPI_Iprobe(source: int = ANY_SOURCE, tag: int = ANY_TAG,
+               comm: Optional[Communicator] = None, status=None) -> bool:
+    return _world(comm).iprobe(source, tag, status)
+
+
+def MPI_Wtime() -> float:
+    import time
+
+    return time.perf_counter()
 
 
 def MPI_Scan(obj: Any, op: ops.ReduceOp = ops.SUM,

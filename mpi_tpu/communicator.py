@@ -86,6 +86,72 @@ def _maybe_stack(local_payload: Any, items: List[Any]) -> Any:
     return np.stack(arrs)
 
 
+class Request:
+    """Handle for a nonblocking operation (MPI_Request).
+
+    ``wait()`` blocks until completion and returns the payload (None for
+    sends); ``test()`` returns (done, payload-or-None) without blocking."""
+
+    def wait(self) -> Any:
+        raise NotImplementedError
+
+    def test(self) -> Tuple[bool, Any]:
+        raise NotImplementedError
+
+
+class _CompletedRequest(Request):
+    def __init__(self, value: Any = None):
+        self._value = value
+
+    def wait(self) -> Any:
+        return self._value
+
+    def test(self) -> Tuple[bool, Any]:
+        return True, self._value
+
+
+class _RecvRequest(Request):
+    """Outstanding receive.  Requests posted on the same (source, tag) key
+    complete in POSTED order regardless of wait()/test() call order (MPI
+    matching rule): completing a later request first drains its earlier
+    siblings from the shared posted-queue.  (Posted-order across *mixed*
+    wildcard and specific envelopes is not modeled — each exact key orders
+    independently.)"""
+
+    def __init__(self, comm: "P2PCommunicator", source: int, tag: int,
+                 queue: List["_RecvRequest"]):
+        self._comm, self._source, self._tag = comm, source, tag
+        self._queue = queue
+        self._done = False
+        self._value: Any = None
+        queue.append(self)
+
+    def _complete(self, payload: Any) -> None:
+        self._value, self._done = payload, True
+        if self in self._queue:
+            self._queue.remove(self)
+
+    def _poll_once(self):
+        src_world = (ANY_SOURCE if self._source == ANY_SOURCE
+                     else self._comm._world(self._source))
+        return self._comm._t.poll(src_world, self._comm._ctx, self._tag)
+
+    def wait(self) -> Any:
+        while not self._done:
+            head = self._queue[0]  # earliest posted request gets the message
+            head._complete(self._comm.recv(head._source, head._tag))
+        return self._value
+
+    def test(self) -> Tuple[bool, Any]:
+        while not self._done:
+            head = self._queue[0]
+            hit = head._poll_once()
+            if hit is None:
+                return False, None
+            head._complete(hit[0])
+        return True, self._value
+
+
 class Communicator(ABC):
     """Abstract communicator: the API user MPI programs are written against."""
 
@@ -223,6 +289,7 @@ class P2PCommunicator(Communicator):
         # RecvTimeout (with the pending-message summary) instead of a hang —
         # see transport/faulty.py for the fault-injection counterpart.
         self.recv_timeout = recv_timeout
+        self._irecv_queues: dict = {}
 
     # -- identity ----------------------------------------------------------
 
@@ -281,6 +348,47 @@ class P2PCommunicator(Communicator):
     def _sendrecv_internal(self, sendobj: Any, dest: int, source: int, tag: int) -> Any:
         self._send_internal(sendobj, dest, tag)
         return self._recv_internal(source, tag)
+
+    def isend(self, obj: Any, dest: int, tag: int = 0) -> Request:
+        """Nonblocking send (MPI_Isend).  Our sends are buffered (complete
+        locally once enqueued on the transport), so the request is
+        immediately complete — standard-mode semantics with system buffering
+        [S]."""
+        self.send(obj, dest, tag)
+        return _CompletedRequest()
+
+    def irecv(self, source: int = ANY_SOURCE, tag: int = ANY_TAG) -> Request:
+        """Nonblocking receive (MPI_Irecv): returns a Request; ``test()``
+        polls without blocking, ``wait()`` blocks.  Requests on the same
+        (source, tag) complete in posted order."""
+        _check_user_tag(tag)
+        with self._lock:
+            queue = self._irecv_queues.setdefault((source, tag), [])
+        return _RecvRequest(self, source, tag, queue)
+
+    def probe(self, source: int = ANY_SOURCE, tag: int = ANY_TAG,
+              status: Optional[Status] = None) -> None:
+        """Blocking MPI_Probe: wait until a matching message is enqueued
+        (without consuming it); fills ``status`` with its envelope."""
+        _check_user_tag(tag)
+        src_world = ANY_SOURCE if source == ANY_SOURCE else self._world(source)
+        s, t = self._t.peek(src_world, self._ctx, tag, timeout=self.recv_timeout)
+        if status is not None:
+            status.source = self._from_world(s)
+            status.tag = t
+
+    def iprobe(self, source: int = ANY_SOURCE, tag: int = ANY_TAG,
+               status: Optional[Status] = None) -> bool:
+        """Nonblocking MPI_Iprobe: True iff a matching message is queued."""
+        _check_user_tag(tag)
+        src_world = ANY_SOURCE if source == ANY_SOURCE else self._world(source)
+        hit = self._t.peek_nowait(src_world, self._ctx, tag)
+        if hit is None:
+            return False
+        if status is not None:
+            status.source = self._from_world(hit[0])
+            status.tag = hit[1]
+        return True
 
     def shift(self, obj: Any, offset: int = 1, wrap: bool = True, fill: Any = None) -> Any:
         p, r = self.size, self._rank

@@ -164,6 +164,28 @@ class TpuCommunicator(Communicator):
             "if it is a fixed pattern use comm.exchange(x, pairs).",
         )
 
+    def isend(self, obj: Any, dest: int, tag: int = 0):
+        raise _unsupported(
+            "MPI_Isend", "SPMD communication is compiled into the program; "
+            "use comm.shift / comm.exchange / collectives (XLA already "
+            "overlaps the DMAs).")
+
+    def irecv(self, source: int = -1, tag: int = -1):
+        raise _unsupported(
+            "MPI_Irecv", "SPMD communication is compiled into the program; "
+            "use comm.shift / comm.exchange / collectives (XLA already "
+            "overlaps the DMAs).")
+
+    def probe(self, source: int = -1, tag: int = -1, status=None):
+        raise _unsupported(
+            "MPI_Probe", "SPMD message arrival is static — there is nothing "
+            "to probe; restructure with shift/exchange/collectives.")
+
+    def iprobe(self, source: int = -1, tag: int = -1, status=None):
+        raise _unsupported(
+            "MPI_Iprobe", "SPMD message arrival is static — there is nothing "
+            "to probe; restructure with shift/exchange/collectives.")
+
     def shift(self, obj, offset: int = 1, wrap: bool = True, fill: Any = None):
         """Neighbor exchange as exactly one ``lax.ppermute`` (SURVEY.md §3.2:
         the boundary crossing becomes an ICI DMA scheduled by XLA)."""
@@ -260,6 +282,18 @@ class TpuCommunicator(Communicator):
         if algorithm == "ring":
             return algos.ring_allreduce(x, self.axis_name, self.size, self.rank,
                                         self._world_pairs, op)
+        if algorithm == "pallas_ring":
+            # in-kernel RDMA ring (mpi_tpu/tpu/pallas_ring.py): float32 SUM
+            # over the whole axis; interpreter on the CPU simulator
+            if self._groups is not None:
+                raise NotImplementedError(
+                    "pallas_ring runs on the full axis (ungrouped comms) for now")
+            if op.name != "sum":
+                raise NotImplementedError("pallas_ring supports SUM only for now")
+            from .pallas_ring import pallas_ring_allreduce
+
+            return pallas_ring_allreduce(x, self.axis_name, self.size,
+                                         interpret=self._on_cpu)
         if algorithm == "recursive_halving":
             return algos.halving_allreduce(x, self.axis_name, self.size, self.rank,
                                            self._world_pairs, op)

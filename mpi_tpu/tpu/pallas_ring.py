@@ -1,0 +1,186 @@
+"""Hand-rolled ring allreduce as a Pallas TPU kernel (RDMA over ICI).
+
+SURVEY.md §7 Milestone 3 anticipated this: "possibly a Pallas DMA ring if
+XLA's ppermute chaining leaves bandwidth on the table".  This kernel is that
+option, exposed as ``allreduce(..., algorithm='pallas_ring')``:
+
+* the buffer lives in HBM as P chunks; the classic 2(P-1)-step ring runs
+  INSIDE one kernel: reduce-scatter (P-1 inter-chip RDMAs + tiled VMEM adds)
+  then allgather (P-1 RDMAs written directly into the symmetric output
+  buffer on the neighbor);
+* per-step chunk transfers are chip-to-chip `make_async_remote_copy` DMAs —
+  no per-step kernel launches, no XLA-inserted copies between steps;
+* accumulation stages HBM→VMEM in `tile_rows`×128 tiles (VMEM is ~16 MB;
+  chunks can be tens of MB for the 256 MB north-star buffer);
+* a neighbor barrier (barrier semaphore) closes each step so the
+  double-buffered landing zone can never be overrun on hardware.  The
+  barrier is skipped under the Pallas interpreter (remote semaphore signal
+  is unimplemented there); interpreter runs validate the data path on the
+  virtual CPU mesh.
+
+Restrictions (v1, diagnosed): float32, SUM, the full (ungrouped) axis.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_LANES = 128
+_SUBLANES = 8  # float32 min tile height
+
+
+def _kernel(x_hbm, out_hbm, comm_hbm, a_vmem, b_vmem,
+            copy_sem_a, copy_sem_b, send_sem, recv_sem, *,
+            axis_name: str, size: int, rows: int, tile_rows: int,
+            use_barrier: bool):
+    my = lax.axis_index(axis_name)
+    right = lax.rem(my + 1, size)
+    left = lax.rem(my - 1 + size, size)
+
+    # working copy: out <- x (HBM -> HBM local DMA)
+    init = pltpu.make_async_copy(x_hbm, out_hbm, copy_sem_a)
+    init.start()
+    init.wait()
+
+    def neighbor_barrier():
+        if not use_barrier:
+            return
+        bar = pltpu.get_barrier_semaphore()
+        pltpu.semaphore_signal(bar, inc=1, device_id=left,
+                               device_id_type=pltpu.DeviceIdType.LOGICAL)
+        pltpu.semaphore_signal(bar, inc=1, device_id=right,
+                               device_id_type=pltpu.DeviceIdType.LOGICAL)
+        pltpu.semaphore_wait(bar, 2)
+
+    # entry sync: the first RDMA must not land on a chip whose kernel hasn't
+    # started (execution skew would let it write scratch not yet owned)
+    neighbor_barrier()
+
+    # ---- phase 1: reduce-scatter ring --------------------------------
+    for s in range(size - 1):
+        slot = s % 2
+        si = lax.rem(my - s + size, size)       # chunk I forward
+        ri = lax.rem(my - s - 1 + size, size)   # chunk I accumulate
+        rdma = pltpu.make_async_remote_copy(
+            src_ref=out_hbm.at[pl.ds(si * rows, rows)],
+            dst_ref=comm_hbm.at[slot],
+            send_sem=send_sem.at[slot],
+            recv_sem=recv_sem.at[slot],
+            device_id=right,
+            device_id_type=pltpu.DeviceIdType.LOGICAL,
+        )
+        rdma.start()
+        rdma.wait()  # my data left AND my left neighbor's chunk landed
+        for t in range(rows // tile_rows):
+            row0 = ri * rows + t * tile_rows
+            cp_a = pltpu.make_async_copy(
+                out_hbm.at[pl.ds(row0, tile_rows)], a_vmem, copy_sem_a)
+            cp_b = pltpu.make_async_copy(
+                comm_hbm.at[slot, pl.ds(t * tile_rows, tile_rows)],
+                b_vmem, copy_sem_b)
+            cp_a.start()
+            cp_b.start()
+            cp_a.wait()
+            cp_b.wait()
+            a_vmem[:] = a_vmem[:] + b_vmem[:]
+            cp_out = pltpu.make_async_copy(
+                a_vmem, out_hbm.at[pl.ds(row0, tile_rows)], copy_sem_a)
+            cp_out.start()
+            cp_out.wait()
+        neighbor_barrier()
+
+    # ---- phase 2: allgather ring -------------------------------------
+    # rank r now owns fully-reduced chunk (r+1) % P; forward it around.
+    # The receiving neighbor expects exactly the chunk index we send, so the
+    # RDMA writes straight into the symmetric slice of their output buffer.
+    for s in range(size - 1):
+        slot = s % 2
+        ci = lax.rem(my + 1 - s + size, size)
+        rdma = pltpu.make_async_remote_copy(
+            src_ref=out_hbm.at[pl.ds(ci * rows, rows)],
+            dst_ref=out_hbm.at[pl.ds(ci * rows, rows)],
+            send_sem=send_sem.at[slot],
+            recv_sem=recv_sem.at[slot],
+            device_id=right,
+            device_id_type=pltpu.DeviceIdType.LOGICAL,
+        )
+        rdma.start()
+        rdma.wait()
+        neighbor_barrier()
+
+
+def _geometry(n: int, size: int, tile_rows: int) -> Tuple[int, int]:
+    """rows per chunk (multiple of tile_rows) and padded element count."""
+    per_chunk = -(-n // size)
+    rows = -(-per_chunk // _LANES)
+    rows = -(-rows // tile_rows) * tile_rows
+    return rows, size * rows * _LANES
+
+
+def pallas_ring_allreduce(x: jnp.ndarray, axis_name: str, size: int,
+                          tile_rows: int = 256,
+                          interpret: bool = False) -> jnp.ndarray:
+    """SUM-allreduce ``x`` (float32) over ``axis_name`` with the in-kernel
+    RDMA ring.  Call inside shard_map over a mesh with that axis."""
+    if x.dtype != jnp.float32:
+        raise NotImplementedError(
+            f"pallas_ring allreduce is float32-only for now, got {x.dtype}")
+    if tile_rows % _SUBLANES or tile_rows < _SUBLANES:
+        raise ValueError(
+            f"tile_rows must be a positive multiple of {_SUBLANES} "
+            f"(float32 sublane tile), got {tile_rows}")
+    if size == 1:
+        return x
+    shape = x.shape
+    n = int(np.prod(shape)) if shape else 1
+    rows, padded = _geometry(n, size, tile_rows)
+    flat = x.reshape(-1)
+    if padded != n:
+        flat = jnp.pad(flat, (0, padded - n))
+    grid_in = flat.reshape(size * rows, _LANES)
+
+    # vma typing may be active even when the payload is replicated; probe
+    # with axis_index, which is varying exactly when check_vma is on
+    try:
+        vma_on = bool(jax.typeof(lax.axis_index(axis_name)).vma)
+    except AttributeError:
+        vma_on = False
+    if vma_on:
+        raise ValueError(
+            "pallas_ring needs check_vma=False on the enclosing shard_map "
+            "(Pallas kernels don't participate in varying-axes inference): "
+            "run_spmd(..., check_vma=False) or jax.shard_map(..., "
+            "check_vma=False)")
+
+    kern = functools.partial(
+        _kernel, axis_name=axis_name, size=size, rows=rows,
+        tile_rows=tile_rows, use_barrier=not interpret)
+    compiler_params = None if interpret else pltpu.CompilerParams(
+        collective_id=13, has_side_effects=True)
+    out = pl.pallas_call(
+        kern,
+        out_shape=jax.ShapeDtypeStruct((size * rows, _LANES), jnp.float32),
+        in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
+        out_specs=pl.BlockSpec(memory_space=pl.ANY),
+        scratch_shapes=[
+            pl.ANY((2, rows, _LANES), jnp.float32),      # RDMA landing zone
+            pltpu.VMEM((tile_rows, _LANES), jnp.float32),
+            pltpu.VMEM((tile_rows, _LANES), jnp.float32),
+            pltpu.SemaphoreType.DMA(()),
+            pltpu.SemaphoreType.DMA(()),
+            pltpu.SemaphoreType.DMA((2,)),
+            pltpu.SemaphoreType.DMA((2,)),
+        ],
+        compiler_params=compiler_params,
+        interpret=interpret,
+    )(grid_in)
+    return out.reshape(-1)[:n].reshape(shape)

@@ -44,13 +44,18 @@ def run_spmd(
     mesh: Optional[Mesh] = None,
     axis_name: str = "world",
     jit: bool = True,
+    check_vma: bool = True,
     **kwargs: Any,
 ):
     """Run ``fn(comm, *args, **kwargs)`` as one SPMD program.
 
     ``args`` are replicated to every rank; each rank's return value gets a
     length-1 leading axis and the stacked [nranks, ...] result is returned
-    (index it by rank to mirror ``run_local``'s per-rank list)."""
+    (index it by rank to mirror ``run_local``'s per-rank list).
+
+    ``check_vma=False`` disables shard_map's varying-axes typing — required
+    for programs using ``algorithm='pallas_ring'`` (Pallas kernels don't
+    participate in vma inference)."""
     if mesh is None:
         mesh = default_mesh(nranks, axis_name)
     comm = TpuCommunicator(axis_name, mesh)
@@ -61,7 +66,7 @@ def run_spmd(
 
     in_specs = tuple(P() for _ in args)
     f = jax.shard_map(shard_fn, mesh=mesh, in_specs=in_specs,
-                      out_specs=P(axis_name))
+                      out_specs=P(axis_name), check_vma=check_vma)
     if jit:
         f = jax.jit(f)
     return f(*args)

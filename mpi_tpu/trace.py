@@ -51,6 +51,13 @@ class TracingTransport(Transport):
         self._record(("recv", src, ctx, t, time.monotonic()))
         return payload, src, t
 
+    def poll(self, source: int, ctx, tag: int):
+        hit = self.inner.poll(source, ctx, tag)
+        if hit is not None:
+            _, src, t = hit
+            self._record(("recv", src, ctx, t, time.monotonic()))
+        return hit
+
     def close(self) -> None:
         self.inner.close()
 

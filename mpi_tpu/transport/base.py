@@ -63,22 +63,30 @@ class Mailbox:
             return t >= 0  # wildcards never match internal (negative) tags
         return t == tag
 
-    def match(
-        self, source: int, ctx, tag: int, timeout: Optional[float] = None
-    ) -> Tuple[Any, int, int]:
-        """Block until the oldest message matching (source, ctx, tag) arrives;
-        return (payload, src, tag)."""
+    def _scan_locked(self, source: int, ctx, tag: int,
+                     consume: bool) -> Optional[Tuple[Any, int, int]]:
+        """Oldest matching message as (payload, src, tag); pops iff consume.
+        Caller holds the lock."""
+        for i, item in enumerate(self._items):
+            if self._matches(item, source, ctx, tag):
+                s, _, t, payload = item
+                if consume:
+                    self._items.pop(i)
+                return payload, s, t
+        return None
+
+    def _blocking_scan(self, source: int, ctx, tag: int, consume: bool,
+                       timeout: Optional[float], what: str) -> Tuple[Any, int, int]:
         deadline = None if timeout is None else time.monotonic() + timeout
         with self._cv:
             while True:
-                for i, item in enumerate(self._items):
-                    if self._matches(item, source, ctx, tag):
-                        s, _, t, payload = self._items.pop(i)
-                        return payload, s, t
+                hit = self._scan_locked(source, ctx, tag, consume)
+                if hit is not None:
+                    return hit
                 if self._closed:
                     raise TransportError(
-                        f"transport closed while waiting for recv(source={source}, "
-                        f"ctx={ctx}, tag={tag})"
+                        f"transport closed while waiting for {what}"
+                        f"(source={source}, ctx={ctx}, tag={tag})"
                     )
                 if deadline is None:
                     self._cv.wait()
@@ -87,10 +95,50 @@ class Mailbox:
                     if remaining <= 0:
                         pending = [(s, c, t) for s, c, t, _ in self._items[:16]]
                         raise RecvTimeout(
-                            f"recv(source={source}, ctx={ctx}, tag={tag}) timed "
+                            f"{what}(source={source}, ctx={ctx}, tag={tag}) timed "
                             f"out after {timeout}s; pending={pending}"
                         )
                     self._cv.wait(remaining)
+
+    def match(
+        self, source: int, ctx, tag: int, timeout: Optional[float] = None
+    ) -> Tuple[Any, int, int]:
+        """Block until the oldest message matching (source, ctx, tag) arrives;
+        return (payload, src, tag)."""
+        return self._blocking_scan(source, ctx, tag, True, timeout, "recv")
+
+    def poll(self, source: int, ctx, tag: int) -> Optional[Tuple[Any, int, int]]:
+        """Non-blocking match: pop and return the oldest matching message, or
+        None if nothing matches right now (MPI_Test substrate).  Raises
+        TransportError on a closed, unmatched mailbox so polling loops fail
+        like blocking receives do instead of spinning forever."""
+        with self._lock:
+            hit = self._scan_locked(source, ctx, tag, True)
+            if hit is None and self._closed:
+                raise TransportError(
+                    f"transport closed while polling recv(source={source}, "
+                    f"ctx={ctx}, tag={tag})"
+                )
+            return hit
+
+    def peek_nowait(self, source: int, ctx, tag: int) -> Optional[Tuple[int, int]]:
+        """Non-blocking, non-consuming scan: (src, tag) of the oldest match,
+        or None (MPI_Iprobe substrate — keeps FIFO intact)."""
+        with self._lock:
+            hit = self._scan_locked(source, ctx, tag, False)
+            if hit is None and self._closed:
+                raise TransportError(
+                    f"transport closed while probing (source={source}, "
+                    f"ctx={ctx}, tag={tag})"
+                )
+            return None if hit is None else (hit[1], hit[2])
+
+    def peek(self, source: int, ctx, tag: int,
+             timeout: Optional[float] = None) -> Tuple[int, int]:
+        """Like match() but WITHOUT consuming: block until a matching message
+        is queued and return its (src, tag) — MPI_Probe semantics."""
+        _, s, t = self._blocking_scan(source, ctx, tag, False, timeout, "probe")
+        return s, t
 
     def pending_summary(self) -> List[Tuple[int, int, int]]:
         with self._lock:
@@ -124,6 +172,20 @@ class Transport(ABC):
         self, source: int, ctx, tag: int, timeout: Optional[float] = None
     ) -> Tuple[Any, int, int]:
         return self.mailbox.match(source, ctx, tag, timeout=timeout)
+
+    # Nonblocking/probe entry points live on the Transport (not reached into
+    # the mailbox by callers) so decorator transports (tracing, fault
+    # injection) see every completion path.
+
+    def poll(self, source: int, ctx, tag: int) -> Optional[Tuple[Any, int, int]]:
+        return self.mailbox.poll(source, ctx, tag)
+
+    def peek(self, source: int, ctx, tag: int,
+             timeout: Optional[float] = None) -> Tuple[int, int]:
+        return self.mailbox.peek(source, ctx, tag, timeout=timeout)
+
+    def peek_nowait(self, source: int, ctx, tag: int) -> Optional[Tuple[int, int]]:
+        return self.mailbox.peek_nowait(source, ctx, tag)
 
     def close(self) -> None:
         self.mailbox.close()

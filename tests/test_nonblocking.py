@@ -1,0 +1,164 @@
+"""Nonblocking p2p (Isend/Irecv/Request), Probe/Iprobe semantics."""
+
+import time
+
+import numpy as np
+import pytest
+
+from mpi_tpu import Status
+from mpi_tpu.transport.local import run_local
+
+
+def test_irecv_wait():
+    def prog(comm):
+        if comm.rank == 0:
+            req = comm.isend({"k": 1}, dest=1, tag=3)
+            assert req.test() == (True, None)
+            assert req.wait() is None
+            return None
+        req = comm.irecv(source=0, tag=3)
+        return req.wait()
+
+    res = run_local(prog, 2)
+    assert res[1] == {"k": 1}
+
+
+def test_irecv_test_polls_without_blocking():
+    def prog(comm):
+        if comm.rank == 0:
+            time.sleep(0.15)
+            comm.send("late", dest=1, tag=1)
+            return None
+        req = comm.irecv(source=0, tag=1)
+        done, _ = req.test()
+        assert not done, "message cannot have arrived yet"
+        deadline = time.monotonic() + 5
+        while True:
+            done, val = req.test()
+            if done:
+                return val
+            assert time.monotonic() < deadline
+            time.sleep(0.01)
+
+    res = run_local(prog, 2)
+    assert res[1] == "late"
+
+
+def test_multiple_outstanding_irecvs_fifo():
+    def prog(comm):
+        if comm.rank == 0:
+            for i in range(3):
+                comm.isend(i, dest=1, tag=7)
+            return None
+        reqs = [comm.irecv(source=0, tag=7) for _ in range(3)]
+        return [r.wait() for r in reqs]
+
+    res = run_local(prog, 2)
+    assert res[1] == [0, 1, 2]
+
+
+def test_probe_status_then_recv():
+    def prog(comm):
+        if comm.rank == 0:
+            comm.send(np.arange(5), dest=1, tag=42)
+            return None
+        st = Status()
+        comm.probe(source=-1, tag=-1, status=st)
+        assert (st.source, st.tag) == (0, 42)
+        # probe must not consume
+        got = comm.recv(source=st.source, tag=st.tag)
+        return got.sum()
+
+    res = run_local(prog, 2)
+    assert res[1] == 10
+
+
+def test_iprobe_preserves_fifo():
+    def prog(comm):
+        if comm.rank == 0:
+            comm.send("first", dest=1, tag=1)
+            comm.send("second", dest=1, tag=1)
+            return None
+        # wait for both to arrive
+        deadline = time.monotonic() + 5
+        while not comm.iprobe(source=0, tag=1):
+            assert time.monotonic() < deadline
+            time.sleep(0.005)
+        time.sleep(0.05)  # let the second arrive too
+        st = Status()
+        assert comm.iprobe(source=0, tag=1, status=st)
+        assert st.source == 0
+        a = comm.recv(source=0, tag=1)
+        b = comm.recv(source=0, tag=1)
+        return a, b
+
+    res = run_local(prog, 2)
+    assert res[1] == ("first", "second")
+
+
+def test_posted_order_completion_out_of_order_test():
+    """MPI matching rule: the first-POSTED request gets the first message,
+    even when a later request is tested/completed first."""
+
+    def prog(comm):
+        if comm.rank == 0:
+            comm.send("a", dest=1, tag=7)
+            comm.send("b", dest=1, tag=7)
+            return None
+        r1 = comm.irecv(source=0, tag=7)
+        r2 = comm.irecv(source=0, tag=7)
+        deadline = time.monotonic() + 5
+        while not r2.test()[0]:
+            assert time.monotonic() < deadline
+            time.sleep(0.005)
+        done, v1 = r1.test()
+        assert done
+        return v1, r2.wait()
+
+    res = run_local(prog, 2)
+    assert res[1] == ("a", "b")
+
+
+def test_trace_records_polled_receives():
+    """Receives completed via Request.test() polling must be visible to the
+    matching verifier (they flow through Transport.poll, not the mailbox)."""
+    from mpi_tpu.trace import verify_run
+
+    def prog(comm):
+        if comm.rank == 0:
+            comm.send(1, dest=1, tag=0)
+            return None
+        req = comm.irecv(source=0, tag=0)
+        while not req.test()[0]:
+            time.sleep(0.002)
+
+    _, problems = verify_run(prog, 2)
+    assert problems == []
+
+
+def test_poll_on_closed_transport_raises():
+    from mpi_tpu.transport.base import Mailbox, TransportError
+
+    mb = Mailbox()
+    mb.close()
+    with pytest.raises(TransportError):
+        mb.poll(0, 0, 1)
+    with pytest.raises(TransportError):
+        mb.peek_nowait(0, 0, 1)
+
+
+def test_tpu_nonblocking_diagnostics():
+    from mpi_tpu.tpu import SpmdSemanticsError, TpuCommunicator, default_mesh
+
+    comm = TpuCommunicator("world", default_mesh())
+    for call in (lambda: comm.isend(1, 0), comm.irecv, comm.probe, comm.iprobe):
+        with pytest.raises(SpmdSemanticsError):
+            call()
+
+
+def test_iprobe_false_when_empty():
+    def prog(comm):
+        assert not comm.iprobe(source=-1, tag=-1)
+        comm.barrier()
+
+    run_local(prog, 2)
