@@ -1,0 +1,97 @@
+"""2-D Jacobi stencil on a Cartesian process grid (MPI_Cart_create +
+Cart-shift halo exchanges; SURVEY.md §2 component #14 / §3.5 generalized to
+the 2-D decomposition).
+
+The global domain is tiled over a ``pr x pc`` Cartesian topology
+(``dims_create`` balances the factorization).  Each iteration exchanges
+one-row/one-column halos with all four neighbors — ``cart.exchange`` is a
+sendrecv pair per direction on the CPU backends and exactly one
+``lax.ppermute`` per direction on the SPMD backend — then sweeps the 5-point
+stencil.  The hot global top edge is 1.0, every other edge 0.0 (the same
+boundary problem as examples/jacobi.py, so the two decompositions can be
+cross-checked).
+
+    python -m mpi_tpu.launcher -n 4 examples/jacobi2d.py
+    python examples/jacobi2d.py --backend local -n 4
+    python examples/jacobi2d.py --backend tpu -n 8
+"""
+
+import argparse
+import os
+import sys
+
+try:
+    import mpi_tpu
+except ModuleNotFoundError:  # running from a fresh checkout without install
+    sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    import mpi_tpu
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from mpi_tpu import CartComm, dims_create, ops
+
+
+def jacobi2d_step(cart: CartComm, local):
+    """One 4-direction halo exchange + 5-point sweep on this rank's tile."""
+    pr, pc = cart.dims
+    row, col = cart.coords  # ints on CPU backends, traced scalars on SPMD
+    # dim 0 = rows of the process grid: my bottom row goes down (+1), the
+    # neighbor's bottom row arrives from above; and vice versa.
+    north = cart.exchange(local[-1], dim=0, disp=1, fill=0.0)
+    north = jnp.where(row == 0, jnp.ones_like(north), north)  # hot top edge
+    south = cart.exchange(local[0], dim=0, disp=-1, fill=0.0)
+    west = cart.exchange(local[:, -1], dim=1, disp=1, fill=0.0)
+    east = cart.exchange(local[:, 0], dim=1, disp=-1, fill=0.0)
+    padded = jnp.concatenate([north[None], local, south[None]], axis=0)
+    padded = jnp.concatenate(
+        [jnp.concatenate([jnp.zeros((1,), padded.dtype), west,
+                          jnp.zeros((1,), padded.dtype)])[:, None],
+         padded,
+         jnp.concatenate([jnp.zeros((1,), padded.dtype), east,
+                          jnp.zeros((1,), padded.dtype)])[:, None]],
+        axis=1)
+    new = 0.25 * (padded[:-2, 1:-1] + padded[2:, 1:-1]
+                  + padded[1:-1, :-2] + padded[1:-1, 2:])
+    # global side walls stay fixed at 0 on boundary tiles
+    keep_w = jnp.where(col == 0, 0.0, 1.0)
+    keep_e = jnp.where(col == pc - 1, 0.0, 1.0)
+    new = new.at[:, 0].mul(keep_w).at[:, -1].mul(keep_e)
+    return new
+
+
+def jacobi2d_program(comm, tile_rows: int = 8, tile_cols: int = 8,
+                     iters: int = 100, dims=None):
+    """Returns (final local tile, global max-residual of the last sweep)."""
+    dims = dims or dims_create(comm.size, 2)
+    cart = CartComm(comm, dims)
+    local = jnp.zeros((tile_rows, tile_cols), jnp.float32)
+    prev = local
+    for _ in range(iters):
+        new = jacobi2d_step(cart, local)
+        local, prev = new, local
+    residual = comm.allreduce(jnp.max(jnp.abs(local - prev)), op=ops.MAX)
+    return local, residual
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--backend", default=None, choices=[None, "socket", "local", "tpu"])
+    ap.add_argument("-n", "--nranks", type=int, default=None)
+    ap.add_argument("--rows", type=int, default=8, help="rows per tile")
+    ap.add_argument("--cols", type=int, default=8, help="cols per tile")
+    ap.add_argument("--iters", type=int, default=100)
+    args = ap.parse_args()
+
+    out = mpi_tpu.run(jacobi2d_program, backend=args.backend, nranks=args.nranks,
+                      tile_rows=args.rows, tile_cols=args.cols, iters=args.iters)
+    if isinstance(out, list):
+        res = float(np.asarray(out[0][1]))
+    else:
+        res = float(np.ravel(np.asarray(jax.device_get(out[1])))[0])
+    print(f"jacobi2d: {args.iters} iters, last-sweep max residual {res:.3e}")
+
+
+if __name__ == "__main__":
+    main()

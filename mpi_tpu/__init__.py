@@ -34,6 +34,8 @@ from .communicator import Communicator, P2PCommunicator, Request, Status
 from .transport.base import ANY_SOURCE, ANY_TAG
 from .transport.local import run_local
 from . import schedules, checker, profiling, trace
+from .topology import CartComm, cart_create, dims_create
+from .group import Group
 
 __all__ = [
     "__version__", "ops", "ReduceOp",
@@ -41,6 +43,7 @@ __all__ = [
     "Communicator", "P2PCommunicator", "Request", "Status", "ANY_SOURCE", "ANY_TAG",
     "init", "finalize", "is_initialized", "run", "run_local",
     "schedules", "checker", "profiling", "trace", "COMM_WORLD",
+    "CartComm", "cart_create", "dims_create", "Group",
 ]
 
 _ENV_RANK = "MPI_TPU_RANK"

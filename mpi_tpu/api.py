@@ -18,6 +18,7 @@ from typing import Any, Optional, Sequence
 
 from . import ops
 from .communicator import Communicator, Status
+from .group import Group
 from .transport.base import ANY_SOURCE, ANY_TAG
 
 __all__ = [
@@ -27,10 +28,22 @@ __all__ = [
     "MPI_Barrier", "MPI_Comm_split", "MPI_Comm_dup", "MPI_Scatter", "MPI_Gather",
     "MPI_Scan", "MPI_Reduce_scatter", "MPI_Isend", "MPI_Irecv", "MPI_Wait",
     "MPI_Test", "MPI_Waitall", "MPI_Probe", "MPI_Iprobe", "MPI_Wtime",
-    "ANY_SOURCE", "ANY_TAG", "SUM", "PROD", "MAX", "MIN", "Status",
+    "MPI_Exscan", "MPI_Op_create", "MPI_Maxloc", "MPI_Minloc",
+    "MPI_Gatherv", "MPI_Scatterv", "MPI_Allgatherv", "MPI_Alltoallv",
+    "MPI_Cart_create", "MPI_Dims_create", "MPI_Cart_coords", "MPI_Cart_rank",
+    "MPI_Cart_shift", "MPI_Cart_sub",
+    "MPI_Comm_group", "MPI_Comm_create", "MPI_Comm_create_group",
+    "MPI_Group_incl", "MPI_Group_excl", "MPI_Group_union",
+    "MPI_Group_intersection", "MPI_Group_difference", "MPI_Group_size",
+    "MPI_Group_rank", "MPI_Group_translate_ranks", "Group",
+    "ANY_SOURCE", "ANY_TAG", "SUM", "PROD", "MAX", "MIN",
+    "LAND", "LOR", "LXOR", "BAND", "BOR", "BXOR", "Status",
 ]
 
 SUM, PROD, MAX, MIN = ops.SUM, ops.PROD, ops.MAX, ops.MIN
+LAND, LOR, LXOR = ops.LAND, ops.LOR, ops.LXOR
+BAND, BOR, BXOR = ops.BAND, ops.BOR, ops.BXOR
+MPI_Op_create = ops.make_op
 
 
 def _world(comm: Optional[Communicator]) -> Communicator:
@@ -177,3 +190,113 @@ def MPI_Scan(obj: Any, op: ops.ReduceOp = ops.SUM,
 def MPI_Reduce_scatter(blocks: Any, op: ops.ReduceOp = ops.SUM,
                        comm: Optional[Communicator] = None) -> Any:
     return _world(comm).reduce_scatter(blocks, op)
+
+
+def MPI_Exscan(obj: Any, op: ops.ReduceOp = ops.SUM,
+               comm: Optional[Communicator] = None) -> Any:
+    return _world(comm).exscan(obj, op)
+
+
+def MPI_Allgatherv(obj: Any, counts: Sequence[int],
+                   comm: Optional[Communicator] = None) -> Any:
+    return _world(comm).allgatherv(obj, counts)
+
+
+def MPI_Gatherv(obj: Any, counts: Sequence[int], root: int = 0,
+                comm: Optional[Communicator] = None) -> Any:
+    return _world(comm).gatherv(obj, counts, root)
+
+
+def MPI_Scatterv(obj: Any, counts: Sequence[int], root: int = 0,
+                 comm: Optional[Communicator] = None) -> Any:
+    return _world(comm).scatterv(obj, counts, root)
+
+
+def MPI_Alltoallv(blocks: Any, counts: Sequence[Sequence[int]],
+                  comm: Optional[Communicator] = None) -> Any:
+    return _world(comm).alltoallv(blocks, counts)
+
+
+def MPI_Maxloc(obj: Any, comm: Optional[Communicator] = None):
+    """Allreduce with MPI_MAXLOC semantics: (max value, lowest rank with it)."""
+    return _world(comm).maxloc(obj)
+
+
+def MPI_Minloc(obj: Any, comm: Optional[Communicator] = None):
+    """Allreduce with MPI_MINLOC semantics: (min value, lowest rank with it)."""
+    return _world(comm).minloc(obj)
+
+
+def MPI_Cart_create(dims: Sequence[int], periods: Optional[Sequence[bool]] = None,
+                    comm: Optional[Communicator] = None):
+    from .topology import cart_create
+
+    return cart_create(_world(comm), dims, periods)
+
+
+def MPI_Dims_create(nnodes: int, ndims: int) -> list:
+    from .topology import dims_create
+
+    return dims_create(nnodes, ndims)
+
+
+def MPI_Cart_coords(cart, rank: int):
+    return cart.coords_of(rank)
+
+
+def MPI_Cart_rank(cart, coords: Sequence[int]):
+    return cart.rank_of(coords)
+
+
+def MPI_Cart_shift(cart, direction: int, disp: int = 1):
+    return cart.shift(direction, disp)
+
+
+def MPI_Cart_sub(cart, remain_dims: Sequence[bool]):
+    return cart.sub(remain_dims)
+
+
+def MPI_Comm_group(comm: Optional[Communicator] = None):
+    return _world(comm).group()
+
+
+def MPI_Comm_create(group, comm: Optional[Communicator] = None):
+    return _world(comm).create(group)
+
+
+# MPI-3 spells the non-collective-over-comm variant MPI_Comm_create_group;
+# our create() is already group-collective-only in spirit, so they coincide.
+MPI_Comm_create_group = MPI_Comm_create
+
+
+def MPI_Group_incl(group, positions: Sequence[int]):
+    return group.incl(positions)
+
+
+def MPI_Group_excl(group, positions: Sequence[int]):
+    return group.excl(positions)
+
+
+def MPI_Group_union(a, b):
+    return a.union(b)
+
+
+def MPI_Group_intersection(a, b):
+    return a.intersection(b)
+
+
+def MPI_Group_difference(a, b):
+    return a.difference(b)
+
+
+def MPI_Group_size(group) -> int:
+    return group.size
+
+
+def MPI_Group_rank(group, comm: Optional[Communicator] = None):
+    """This process's position in ``group`` (None = MPI_UNDEFINED)."""
+    return group.rank_of(_world(comm).rank)
+
+
+def MPI_Group_translate_ranks(group, positions: Sequence[int], other):
+    return group.translate(positions, other)

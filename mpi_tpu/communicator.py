@@ -196,6 +196,16 @@ class Communicator(ABC):
         ``lax.ppermute`` (SURVEY.md §3.2).
         """
 
+    def exchange(self, obj: Any, pairs: Sequence[Tuple[int, int]],
+                 fill: Any = None) -> Any:
+        """Static-pattern point-to-point: every ``(src, dst)`` in ``pairs``
+        ships src's payload to dst.  The portable spelling of a set of
+        matched Send/Recv calls — one ``lax.ppermute`` on TPU, buffered
+        send/recv pairs on process backends.  Ranks receiving nothing get
+        ``fill`` (array payloads get an array-shaped fill; TPU defaults the
+        hole to zeros)."""
+        raise NotImplementedError(f"{type(self).__name__} does not implement exchange")
+
     # -- collectives -------------------------------------------------------
 
     @abstractmethod
@@ -236,6 +246,36 @@ class Communicator(ABC):
         reduction of ranks 0..r."""
         raise NotImplementedError(f"{type(self).__name__} does not implement scan")
 
+    def exscan(self, obj: Any, op: _ops.ReduceOp = _ops.SUM) -> Any:
+        """MPI_Exscan [S]: exclusive prefix reduction — rank r gets the
+        reduction of ranks 0..r-1.  Rank 0 gets the op identity (MPI leaves
+        it undefined; a defined identity is the SPMD-portable choice and
+        makes ``scan == combine(exscan, local)`` hold on every rank).
+
+        Default implementation: inclusive scan + one boundary shift — works
+        on every backend that provides ``scan`` and ``shift``."""
+        scanned = self.scan(obj, op)
+        dtype = getattr(scanned, "dtype", None)
+        if dtype is None:
+            dtype = np.asarray(scanned).dtype
+        return self.shift(scanned, offset=1, wrap=False,
+                          fill=op.identity(np.dtype(dtype)))
+
+    def maxloc(self, obj: Any) -> Tuple[Any, Any]:
+        """MPI_MAXLOC [S]: elementwise (max value, lowest rank attaining it)."""
+        return self._allreduce_loc(obj, _ops.MAX)
+
+    def minloc(self, obj: Any) -> Tuple[Any, Any]:
+        """MPI_MINLOC [S]: elementwise (min value, lowest rank attaining it)."""
+        return self._allreduce_loc(obj, _ops.MIN)
+
+    def _allreduce_loc(self, obj: Any, op: _ops.ReduceOp) -> Tuple[Any, Any]:
+        best = self.allreduce(obj, op=op)
+        arr = np.asarray(obj)
+        cand = np.where(arr == np.asarray(best), self.rank, self.size)
+        loc = self.allreduce(cand.astype(np.int64), op=_ops.MIN)
+        return best, _unwrap(np.asarray(loc), arr.ndim == 0)
+
     def reduce_scatter(self, blocks: Any, op: _ops.ReduceOp = _ops.SUM,
                        algorithm: str = "auto") -> Any:
         """MPI_Reduce_scatter_block [S]: ``blocks`` holds one block per rank
@@ -250,6 +290,83 @@ class Communicator(ABC):
     def gather(self, obj: Any, root: int = 0) -> Optional[List[Any]]:
         raise NotImplementedError(f"{type(self).__name__} does not implement gather")
 
+    # -- vector (variable-count) collectives -------------------------------
+    #
+    # MPI_*v semantics [S] with counts as *static* Python ints, the portable
+    # common denominator: process backends have fully dynamic shapes, but the
+    # SPMD backend traces one program, so per-rank counts must be known at
+    # trace time.  Contract shared by all backends:
+    #   * ``counts[i]`` is the number of leading-axis rows rank i contributes
+    #     (or receives, for scatterv);
+    #   * inputs may be padded to ``max(counts)`` rows — only the first
+    #     ``counts[rank]`` rows of this rank's payload are used;
+    #   * allgatherv/gatherv return the ragged concatenation
+    #     [sum(counts), ...] (replicated everywhere on SPMD, root-only for
+    #     gatherv on process backends).
+
+    def allgatherv(self, obj: Any, counts: Sequence[int]) -> Any:
+        """MPI_Allgatherv [S]: concatenation of every rank's first
+        ``counts[rank]`` rows, in rank order."""
+        self._check_counts(counts)
+        items = self.allgather(self._take_rows(obj, counts[self.rank]))
+        return np.concatenate([np.asarray(it) for it in items], axis=0)
+
+    def gatherv(self, obj: Any, counts: Sequence[int],
+                root: int = 0) -> Optional[Any]:
+        """MPI_Gatherv [S]: like allgatherv, result only guaranteed at root."""
+        self._check_counts(counts)
+        items = self.gather(self._take_rows(obj, counts[self.rank]), root)
+        if items is None:
+            return None
+        return np.concatenate([np.asarray(it) for it in items], axis=0)
+
+    def scatterv(self, obj: Any, counts: Sequence[int], root: int = 0) -> Any:
+        """MPI_Scatterv [S]: root holds the [sum(counts), ...] concatenation;
+        rank r receives its ``counts[r]``-row slice.  (The SPMD backend
+        returns it padded to ``max(counts)`` rows — static shapes.)"""
+        self._check_counts(counts)
+        parts: Optional[List[Any]] = None
+        if self.rank == root:
+            offs = np.cumsum([0] + list(counts))
+            arr = np.asarray(obj)
+            if arr.shape[0] != offs[-1]:
+                raise ValueError(
+                    f"scatterv root payload needs sum(counts)={offs[-1]} rows, "
+                    f"got {arr.shape[0]}")
+            parts = [arr[offs[i]:offs[i + 1]] for i in range(self.size)]
+        return self.scatter(parts, root)
+
+    def alltoallv(self, blocks: Any, counts: Sequence[Sequence[int]]) -> Any:
+        """MPI_Alltoallv [S]: ``counts[i][j]`` rows travel from rank i to
+        rank j.  ``blocks[d]`` is the payload for rank d (first
+        ``counts[rank][d]`` rows used).  Returns one entry per source rank j
+        holding ``counts[j][rank]`` valid rows (exact on process backends;
+        padded to the global max count on SPMD)."""
+        self._check_counts_matrix(counts)
+        sendlist = [self._take_rows(blocks[d], counts[self.rank][d])
+                    for d in range(self.size)]
+        return self.alltoall(sendlist)
+
+    def _take_rows(self, obj: Any, count: int) -> np.ndarray:
+        arr = np.asarray(obj)
+        if arr.shape[0] < count:
+            raise ValueError(
+                f"rank {self.rank}: payload has {arr.shape[0]} rows but its "
+                f"declared count is {count}")
+        return arr[:count]
+
+    def _check_counts(self, counts: Sequence[int]) -> None:
+        if len(counts) != self.size:
+            raise ValueError(
+                f"need one count per rank ({self.size}), got {len(counts)}")
+        if any(int(c) < 0 for c in counts):
+            raise ValueError(f"counts must be >= 0, got {list(counts)}")
+
+    def _check_counts_matrix(self, counts: Sequence[Sequence[int]]) -> None:
+        if len(counts) != self.size or any(len(row) != self.size for row in counts):
+            raise ValueError(
+                f"alltoallv counts must be a {self.size}x{self.size} matrix")
+
     # -- communicator management ------------------------------------------
 
     @abstractmethod
@@ -260,6 +377,29 @@ class Communicator(ABC):
     @abstractmethod
     def dup(self) -> "Communicator":
         """New communicator over the same group with isolated message space."""
+
+    def split_by_rank(self, color_fn, key_fn=None) -> Optional["Communicator"]:
+        """``split`` with color/key as pure functions of the group-local rank
+        — the portable spelling (works on process backends, where each rank
+        evaluates its own color, AND on the SPMD backend, where the host
+        evaluates the functions for every rank — see TpuCommunicator)."""
+        return self.split(color_fn(self.rank),
+                          key_fn(self.rank) if key_fn else 0)
+
+    def group(self):
+        """MPI_Comm_group: this communicator's group (all ranks, in order)."""
+        from .group import Group
+
+        return Group(range(self.size))
+
+    def create(self, group) -> Optional["Communicator"]:
+        """MPI_Comm_create_group [S]: members of ``group`` (ranks of THIS
+        comm) get a new communicator ordered by group position; non-members
+        get None.  Collective over this communicator.  (The SPMD backend
+        can't return None — see TpuCommunicator.create.)"""
+        pos = group.rank_of(self.rank)
+        return self.split(0 if pos is not None else None,
+                          pos if pos is not None else 0)
 
     def free(self) -> None:
         """Release resources (no-op for sub-communicators by default)."""
@@ -404,6 +544,21 @@ class P2PCommunicator(Communicator):
         # array payloads get an array-shaped fill, matching the TPU backend's
         # ppermute-hole semantics so the same program sees the same types
         if hasattr(obj, "shape") and hasattr(obj, "dtype"):
+            return np.full_like(np.asarray(obj), fill)
+        return fill
+
+    def exchange(self, obj: Any, pairs: Sequence[Tuple[int, int]],
+                 fill: Any = None) -> Any:
+        from .checker import validate_perm
+
+        validate_perm(pairs, self.size)
+        dsts = [d for s, d in pairs if s == self._rank]
+        srcs = [s for s, d in pairs if d == self._rank]
+        for d in dsts:
+            self._send_internal(obj, d, _TAG_SHIFT)
+        if srcs:
+            return self._recv_internal(srcs[0], _TAG_SHIFT)
+        if fill is not None and hasattr(obj, "shape") and hasattr(obj, "dtype"):
             return np.full_like(np.asarray(obj), fill)
         return fill
 

@@ -112,6 +112,22 @@ def _id_true(dtype):
     raise TypeError(f"LAND has no identity for dtype {dtype}")
 
 
+def make_op(combine: Callable[[Any, Any], Any], identity: Any,
+            name: str = "user", commutative: bool = True) -> ReduceOp:
+    """MPI_Op_create analogue: build a user-defined reduction operator.
+
+    ``combine(a, b)`` must be associative (elementwise over arrays) and work
+    on both numpy arrays and jax tracers if the op is to run on the TPU
+    backend's hand-scheduled algorithms (they inline ``combine`` into the
+    traced program; the 'fused' path reduces locally after an all_gather).
+    ``identity`` is either a scalar or a callable ``np.dtype -> scalar``
+    giving the neutral element (used to pad masked / boundary exchanges).
+    """
+    ident_fn = identity if callable(identity) else (
+        lambda dtype, _v=identity: np.dtype(dtype).type(_v))
+    return ReduceOp(name, combine, ident_fn, commutative)
+
+
 SUM = ReduceOp("sum", operator.add, _id_sum)
 PROD = ReduceOp("prod", operator.mul, _id_prod)
 MAX = ReduceOp("max", _maximum, _id_max)

@@ -1,0 +1,206 @@
+"""Cartesian process topologies — MPI_Cart_create / shift / sub [S].
+
+SURVEY.md §2 component #14 motivates this: the Jacobi stencil's natural
+decomposition is an N-D grid of ranks with halo exchanges along each
+dimension.  MPI spells that MPI_Cart_create + MPI_Cart_shift + Sendrecv; the
+TPU-native spelling of the same shift is ONE ``lax.ppermute`` whose pairs are
+a *static* permutation of the mesh axis.  ``CartComm`` therefore reduces
+every topology operation to two portable Communicator primitives —
+``exchange(obj, pairs, fill)`` (static-pattern p2p) and
+``split_by_rank(color_fn, key_fn)`` (host-computable split) — and works
+unchanged over the socket, thread, and SPMD backends.
+
+Rank-to-coordinate numbering is row-major (C order), matching MPI's
+MPI_Cart_coords convention [S].
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, List, Optional, Sequence, Tuple
+
+from .communicator import Communicator
+
+Pair = Tuple[int, int]
+
+
+def dims_create(nnodes: int, ndims: int) -> List[int]:
+    """MPI_Dims_create [S]: factor ``nnodes`` into ``ndims`` balanced,
+    non-increasing dimensions."""
+    if nnodes <= 0 or ndims <= 0:
+        raise ValueError("nnodes and ndims must be positive")
+    dims = [1] * ndims
+    n = nnodes
+    # repeatedly peel the largest prime factor onto the smallest dimension
+    factors: List[int] = []
+    f = 2
+    while f * f <= n:
+        while n % f == 0:
+            factors.append(f)
+            n //= f
+        f += 1
+    if n > 1:
+        factors.append(n)
+    for f in sorted(factors, reverse=True):
+        dims[dims.index(min(dims))] *= f
+    return sorted(dims, reverse=True)
+
+
+class CartComm:
+    """A communicator with an attached N-D Cartesian topology.
+
+    Wraps (never mutates) an existing communicator whose size must equal
+    ``prod(dims)`` — MPI_Cart_create's "allow fewer ranks" escape hatch is
+    not portable to SPMD, where every device runs the program.
+    """
+
+    def __init__(self, comm: Communicator, dims: Sequence[int],
+                 periods: Optional[Sequence[bool]] = None):
+        dims = tuple(int(d) for d in dims)
+        if any(d <= 0 for d in dims):
+            raise ValueError(f"dims must be positive, got {dims}")
+        if math.prod(dims) != comm.size:
+            raise ValueError(
+                f"prod(dims)={math.prod(dims)} must equal comm.size={comm.size}")
+        periods = (tuple(bool(p) for p in periods) if periods is not None
+                   else (False,) * len(dims))
+        if len(periods) != len(dims):
+            raise ValueError("periods must have one entry per dimension")
+        self.comm = comm
+        self.dims = dims
+        self.periods = periods
+        # row-major strides: stride[i] = prod(dims[i+1:])
+        self._strides = tuple(
+            math.prod(dims[i + 1:]) for i in range(len(dims)))
+
+    # -- identity ----------------------------------------------------------
+
+    @property
+    def rank(self):
+        return self.comm.rank
+
+    @property
+    def size(self) -> int:
+        return self.comm.size
+
+    @property
+    def ndims(self) -> int:
+        return len(self.dims)
+
+    @property
+    def coords(self):
+        """This rank's coordinates.  Plain ints on process backends; traced
+        scalars on the SPMD backend (pure arithmetic on the traced rank)."""
+        r = self.comm.rank
+        return tuple((r // s) % d for s, d in zip(self._strides, self.dims))
+
+    # -- pure coordinate math (host-side, any rank) ------------------------
+
+    def coords_of(self, rank: int) -> Tuple[int, ...]:
+        """MPI_Cart_coords [S]."""
+        if not (0 <= rank < self.size):
+            raise ValueError(f"rank {rank} out of range for size {self.size}")
+        return tuple((rank // s) % d for s, d in zip(self._strides, self.dims))
+
+    def rank_of(self, coords: Sequence[int]) -> Optional[int]:
+        """MPI_Cart_rank [S]: periodic dimensions wrap; out-of-range
+        coordinates on non-periodic dimensions return None (MPI_PROC_NULL)."""
+        if len(coords) != self.ndims:
+            raise ValueError(f"need {self.ndims} coordinates, got {len(coords)}")
+        rank = 0
+        for c, d, p, s in zip(coords, self.dims, self.periods, self._strides):
+            c = int(c)
+            if p:
+                c %= d
+            elif not (0 <= c < d):
+                return None
+            rank += c * s
+        return rank
+
+    def shift(self, dim: int, disp: int = 1) -> Tuple[Optional[int], Optional[int]]:
+        """MPI_Cart_shift [S]: (source, dest) ranks for a displacement along
+        ``dim`` — the ranks this rank receives-from / sends-to.  None is
+        MPI_PROC_NULL.  Needs a concrete integer rank, so on the SPMD backend
+        (traced rank) use ``exchange`` / ``shift_perm`` instead."""
+        r = self.comm.rank
+        if not isinstance(r, int):
+            raise TypeError(
+                "CartComm.shift needs a concrete rank; inside an SPMD trace "
+                "the rank is traced — use cart.exchange(obj, dim, disp) "
+                "(the whole-mesh halo exchange) instead")
+        me = list(self.coords_of(r))
+        me[dim] += disp
+        dest = self.rank_of(me)
+        me = list(self.coords_of(r))
+        me[dim] -= disp
+        src = self.rank_of(me)
+        return src, dest
+
+    def shift_perm(self, dim: int, disp: int = 1) -> List[Pair]:
+        """The full static (src, dst) permutation of a shift along ``dim`` —
+        exactly the pairs of the one ``lax.ppermute`` the exchange lowers to."""
+        if not (0 <= dim < self.ndims):
+            raise ValueError(f"dim {dim} out of range for {self.ndims}-D topology")
+        pairs: List[Pair] = []
+        for r in range(self.size):
+            c = list(self.coords_of(r))
+            c[dim] += disp
+            dst = self.rank_of(c)
+            if dst is not None:
+                pairs.append((r, dst))
+        return pairs
+
+    # -- communication -----------------------------------------------------
+
+    def exchange(self, obj: Any, dim: int, disp: int = 1, fill: Any = None) -> Any:
+        """Halo exchange along one dimension: every rank sends ``obj`` to its
+        ``+disp`` neighbor and returns the payload from its ``-disp``
+        neighbor; boundary holes (non-periodic) are ``fill``."""
+        return self.comm.exchange(obj, self.shift_perm(dim, disp), fill=fill)
+
+    def sendrecv_shift(self, obj: Any, dim: int, disp: int = 1,
+                       fill: Any = None) -> Any:
+        """Alias of :meth:`exchange` under its MPI name (Cart_shift +
+        Sendrecv fused)."""
+        return self.exchange(obj, dim, disp, fill)
+
+    # -- topology management ----------------------------------------------
+
+    def sub(self, remain_dims: Sequence[bool]) -> "CartComm":
+        """MPI_Cart_sub [S]: drop the dimensions where ``remain_dims`` is
+        False; ranks sharing the dropped coordinates form each new
+        communicator, which keeps the remaining dimensions' topology."""
+        remain = tuple(bool(k) for k in remain_dims)
+        if len(remain) != self.ndims:
+            raise ValueError(f"need {self.ndims} remain flags, got {len(remain)}")
+        kept = [i for i, k in enumerate(remain) if k]
+        dropped = [i for i, k in enumerate(remain) if not k]
+
+        def color(rank: int) -> int:
+            c = self.coords_of(rank)
+            out = 0
+            for i in dropped:
+                out = out * self.dims[i] + c[i]
+            return out
+
+        def key(rank: int) -> int:
+            c = self.coords_of(rank)
+            out = 0
+            for i in kept:
+                out = out * self.dims[i] + c[i]
+            return out
+
+        sub = self.comm.split_by_rank(color, key)
+        return CartComm(sub,
+                        [self.dims[i] for i in kept] or [1],
+                        [self.periods[i] for i in kept] or [False])
+
+    def dup(self) -> "CartComm":
+        return CartComm(self.comm.dup(), self.dims, self.periods)
+
+
+def cart_create(comm: Communicator, dims: Sequence[int],
+                periods: Optional[Sequence[bool]] = None) -> CartComm:
+    """MPI_Cart_create [S] (reorder is meaningless here: ranks are mesh
+    positions already)."""
+    return CartComm(comm, dims, periods)

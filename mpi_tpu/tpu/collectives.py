@@ -61,7 +61,9 @@ def _ensure_varying(x: jnp.ndarray, axis_name: str) -> jnp.ndarray:
         return x
     if axis_name in vma:
         return x
-    return lax.pvary(x, (axis_name,))
+    if hasattr(lax, "pcast"):
+        return lax.pcast(x, (axis_name,), to="varying")
+    return lax.pvary(x, (axis_name,))  # pragma: no cover - pre-0.9 jax
 
 
 def _mask_of(ranks: Sequence[int], axis_size: int, axis_name: str):

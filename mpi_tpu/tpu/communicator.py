@@ -221,13 +221,19 @@ class TpuCommunicator(Communicator):
         return _jax.tree.map(
             lambda x: algos._ensure_varying(jnp.asarray(x), self.axis_name), obj)
 
-    def exchange(self, obj, pairs: Sequence[Pair]):
+    def exchange(self, obj, pairs: Sequence[Pair], fill: Any = None):
         """Static-pattern p2p: every (src, dst) in ``pairs`` (group-local
         ranks) ships src's payload to dst in one ppermute.  This is the SPMD
         spelling of a set of matched MPI_Send/MPI_Recv calls; ranks not
-        receiving get zeros."""
+        receiving get zeros (or ``fill`` when given)."""
         x = jnp.asarray(obj)
-        return lax.ppermute(x, self.axis_name, self._world_pairs(pairs))
+        world = self._world_pairs(pairs)
+        out = lax.ppermute(x, self.axis_name, world)
+        if fill is not None:
+            receivers = [d for _, d in world]
+            has_src = algos._mask_of(receivers, self._axis_size, self.axis_name)
+            out = jnp.where(has_src, out, jnp.full_like(out, fill))
+        return out
 
     # -- collectives -------------------------------------------------------
 
@@ -388,6 +394,14 @@ class TpuCommunicator(Communicator):
             d *= 2
         return acc
 
+    def _allreduce_loc(self, obj, op: _ops.ReduceOp):
+        # traced-rank spelling of Communicator._allreduce_loc (np.where can't
+        # consume the traced rank scalar)
+        x = jnp.asarray(obj)
+        best = self.allreduce(x, op=op)
+        cand = jnp.where(x == best, self.rank, self.size).astype(jnp.int32)
+        return best, self.allreduce(cand, op=_ops.MIN)
+
     def reduce_scatter(self, blocks, op: _ops.ReduceOp = _ops.SUM,
                        algorithm: str = "auto"):
         """``blocks``: stacked [size, ...]; returns this rank's reduced block.
@@ -426,6 +440,74 @@ class TpuCommunicator(Communicator):
         """Stacked [size, ...] — contract guarantees it only at root (other
         ranks get it too; SPMD gathers are symmetric)."""
         return self.allgather(obj)
+
+    # -- vector (variable-count) collectives -------------------------------
+    # Static counts + padded payloads: the SPMD spelling of MPI_*v (see
+    # Communicator.allgatherv docstring for the shared contract).
+
+    def allgatherv(self, obj, counts: Sequence[int]):
+        """Padded input [max(counts), ...]; returns the exact ragged
+        concatenation [sum(counts), ...] (static shape), replicated."""
+        self._check_counts(counts)
+        counts = [int(c) for c in counts]
+        x = jnp.asarray(obj)
+        maxc = max(counts) if counts else 0
+        if x.shape[0] < maxc:
+            raise ValueError(
+                f"allgatherv payload must be padded to max(counts)={maxc} "
+                f"rows (got {x.shape[0]}); SPMD shapes are static")
+        g = self.allgather(x[:maxc], algorithm="fused")
+        return jnp.concatenate(
+            [g[i, : counts[i]] for i in range(self.size)], axis=0)
+
+    def gatherv(self, obj, counts: Sequence[int], root: int = 0):
+        """SPMD gathers are symmetric: every rank gets the concatenation."""
+        return self.allgatherv(obj, counts)
+
+    def scatterv(self, obj, counts: Sequence[int], root: int = 0):
+        """Root's [sum(counts), ...] concatenation; every rank gets its slice
+        padded to [max(counts), ...] with zeros (static shapes)."""
+        self._check_counts(counts)
+        counts = [int(c) for c in counts]
+        x = jnp.asarray(obj)
+        total, maxc = sum(counts), (max(counts) if counts else 0)
+        if x.shape[0] != total:
+            raise ValueError(
+                f"scatterv payload needs sum(counts)={total} rows, got {x.shape[0]}")
+        if maxc == 0:
+            return x[:0]
+        blocks = self.bcast(x, root)
+        # tail padding so the dynamic slice never clamps away a short tail
+        pad = jnp.zeros((maxc,) + blocks.shape[1:], blocks.dtype)
+        padded = jnp.concatenate([blocks, pad], axis=0)
+        starts = np.concatenate([[0], np.cumsum(counts)[:-1]]).astype(np.int32)
+        start = jnp.asarray(starts)[self.rank]
+        sliced = lax.dynamic_slice_in_dim(padded, start, maxc, axis=0)
+        cnt = jnp.asarray(np.asarray(counts, np.int32))[self.rank]
+        mask = jnp.arange(maxc) < cnt
+        return jnp.where(mask.reshape((-1,) + (1,) * (sliced.ndim - 1)),
+                         sliced, jnp.zeros_like(sliced))
+
+    def alltoallv(self, blocks, counts: Sequence[Sequence[int]]):
+        """``blocks``: [size, maxc, ...] padded, block d for group rank d
+        with ``counts[rank][d]`` valid rows; returns [size, maxc, ...] where
+        block j (from rank j) has ``counts[j][rank]`` valid rows, the rest
+        zeroed.  maxc = global max of the counts matrix."""
+        self._check_counts_matrix(counts)
+        cmat = np.asarray([[int(c) for c in row] for row in counts], np.int32)
+        x = jnp.asarray(blocks)
+        maxc = int(cmat.max()) if cmat.size else 0
+        if x.shape[0] != self.size or (maxc and x.shape[1] < maxc):
+            raise ValueError(
+                f"alltoallv payload needs shape [size={self.size}, "
+                f">=max(counts)={maxc}, ...], got {x.shape}")
+        x = x[:, :maxc] if maxc else x
+        # zero this rank's padding rows so garbage never travels
+        cnt_row = jnp.asarray(cmat)[self.rank]  # [size]
+        mask = jnp.arange(maxc)[None, :] < cnt_row[:, None]
+        x = jnp.where(mask.reshape(mask.shape + (1,) * (x.ndim - 2)),
+                      x, jnp.zeros_like(x))
+        return self.alltoall(x, algorithm="fused")
 
     # -- communicator management (host-side, outside the trace) ------------
 
@@ -475,6 +557,41 @@ class TpuCommunicator(Communicator):
             [color_fn(i) for i in range(n)],
             [key_fn(i) for i in range(n)] if key_fn else None,
         )
+
+    def split_by_rank(self, color_fn, key_fn=None) -> "TpuCommunicator":
+        """``split`` with color/key as pure functions of the *group-local*
+        rank — the host evaluates them for every rank (the portable spelling
+        shared with the process backends; Communicator.split_by_rank)."""
+        n = self._axis_size
+        local = [int(self._rank_table[w]) for w in range(n)]
+        return self.split_all(
+            [color_fn(r) for r in local],
+            [key_fn(r) for r in local] if key_fn else None,
+        )
+
+    def create(self, group) -> "TpuCommunicator":
+        """MPI_Comm_create_group, SPMD shape: every device must keep running
+        the program, so non-members can't get None — instead the complement
+        ranks form sibling communicator(s) of the same size (required by the
+        uniform-partition rule) and every rank gets its own group's handle.
+        Equal-size complement is the SPMD-expressible subset of the MPI
+        semantics; anything else raises."""
+        ranks = list(group.ranks)
+        others = [r for r in range(self.size) if r not in set(ranks)]
+        if others and len(others) % len(ranks) != 0:
+            raise SpmdSemanticsError(
+                f"create(group) needs the non-member count ({len(others)}) to "
+                f"split into groups of the member size ({len(ranks)}): every "
+                f"device executes the SPMD program, so the complement must "
+                f"form equal-sized sibling communicators")
+
+        def color(r: int) -> int:
+            return 0 if r in set(ranks) else 1 + others.index(r) // len(ranks)
+
+        def key(r: int) -> int:
+            return ranks.index(r) if r in set(ranks) else others.index(r) % len(ranks)
+
+        return self.split_by_rank(color, key)
 
     def dup(self) -> "TpuCommunicator":
         # SPMD collectives carry no message-matching state, so a dup is a

@@ -1,0 +1,126 @@
+"""MPI_Group bookkeeping + MPI_Comm_create_group on both backends
+(SURVEY.md §2: rank bookkeeping above the plugin boundary; §4 items 1-2)."""
+
+import numpy as np
+import pytest
+
+from mpi_tpu import Group
+from mpi_tpu.transport.local import run_local
+from mpi_tpu.tpu import SpmdSemanticsError, run_spmd
+
+P = 8
+
+
+# -- pure group algebra ----------------------------------------------------
+
+
+def test_group_constructors():
+    g = Group(range(6))
+    assert g.size == 6
+    assert g.incl([4, 1, 0]).ranks == (4, 1, 0)  # ordered as listed
+    assert g.excl([0, 5]).ranks == (1, 2, 3, 4)
+    with pytest.raises(ValueError):
+        Group([1, 1])
+    with pytest.raises(ValueError):
+        g.incl([6])
+
+
+def test_group_set_algebra():
+    a = Group([0, 2, 4, 6])
+    b = Group([4, 5, 6, 7])
+    assert a.union(b).ranks == (0, 2, 4, 6, 5, 7)  # a's order first
+    assert a.intersection(b).ranks == (4, 6)
+    assert a.difference(b).ranks == (0, 2)
+    assert b.difference(a).ranks == (5, 7)
+
+
+def test_group_translate():
+    a = Group([3, 5, 7])
+    b = Group([7, 3])
+    assert a.translate([0, 1, 2], b) == [1, None, 0]
+    assert a.rank_of(5) == 1
+    assert a.rank_of(4) is None
+
+
+# -- comm.create on the process backend ------------------------------------
+
+
+def test_comm_create_local():
+    def prog(comm):
+        g = comm.group().incl([5, 3, 1])  # odd ranks, reordered
+        sub = comm.create(g)
+        if comm.rank in (1, 3, 5):
+            assert sub is not None
+            # group order defines the new ranks: 5->0, 3->1, 1->2
+            return sub.rank, float(np.asarray(sub.allreduce(comm.rank)))
+        assert sub is None
+        return None
+
+    res = run_local(prog, 6)
+    assert res[5] == (0, 9.0) and res[3] == (1, 9.0) and res[1] == (2, 9.0)
+    assert res[0] is None and res[2] is None and res[4] is None
+
+
+def test_comm_create_isolated_from_parent():
+    def prog(comm):
+        sub = comm.create(comm.group().excl([0]))
+        if sub is None:
+            comm.send("hello", dest=1, tag=3)
+            return None
+        got = comm.recv(source=0, tag=3) if comm.rank == 1 else None
+        sub.barrier()
+        return got
+
+    res = run_local(prog, 4)
+    assert res[1] == "hello"
+
+
+# -- comm.create on the SPMD backend ---------------------------------------
+
+
+def test_comm_create_spmd_halves():
+    def prog(comm, _):
+        g = comm.group().incl([0, 1, 2, 3])
+        sub = comm.create(g)  # complement 4..7 forms the sibling comm
+        return sub.allreduce(comm.rank.astype(np.float32))
+
+    out = np.ravel(np.asarray(run_spmd(prog, np.zeros(1, np.float32))))
+    assert list(out[:4]) == [6.0] * 4
+    assert list(out[4:]) == [22.0] * 4
+
+
+def test_comm_create_spmd_reorders():
+    def prog(comm, _):
+        g = comm.group().incl([7, 6, 5, 4, 3, 2, 1, 0])  # full reversal
+        sub = comm.create(g)
+        return sub.rank.astype(np.float32)
+
+    out = np.ravel(np.asarray(run_spmd(prog, np.zeros(1, np.float32))))
+    assert list(out) == [7.0, 6.0, 5.0, 4.0, 3.0, 2.0, 1.0, 0.0]
+
+
+def test_comm_create_spmd_uneven_rejected():
+    def prog(comm, _):
+        with pytest.raises(SpmdSemanticsError, match="equal-sized"):
+            comm.create(comm.group().incl([0, 1, 2]))  # 3 vs 5 complement
+        return comm.allreduce(np.float32(0))
+
+    run_spmd(prog, np.zeros(1, np.float32))
+
+
+def test_api_group_exports():
+    from mpi_tpu import api
+
+    g = api.MPI_Group_incl(Group(range(4)), [3, 0])
+    assert g.ranks == (3, 0)
+    assert api.MPI_Group_size(g) == 2
+    assert api.MPI_Group_translate_ranks(g, [0], Group([3])) == [0]
+
+
+def test_group_rank_of_traced_rank_raises_loudly():
+    def prog(comm, _):
+        with pytest.raises(TypeError, match="concrete integer rank"):
+            Group([0, 1]).rank_of(comm.rank)
+        return comm.allreduce(np.float32(0))
+
+    run_spmd(prog, np.zeros(1, np.float32))

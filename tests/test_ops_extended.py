@@ -1,0 +1,159 @@
+"""Exscan, user-defined reduction ops (MPI_Op_create), and MAXLOC/MINLOC —
+semantics vs numpy oracles on both the thread backend and the 8-device
+virtual-CPU SPMD backend (SURVEY.md §4 items 1-2)."""
+
+import numpy as np
+import pytest
+
+from mpi_tpu import ops
+from mpi_tpu.transport.local import run_local
+from mpi_tpu.tpu import run_spmd
+
+P = 8
+
+
+def _absmax(a, b):
+    # associative + commutative, works on numpy arrays and jax tracers alike
+    return ops._maximum(abs(a), abs(b))
+
+
+ABSMAX = ops.make_op(_absmax, 0.0, name="absmax")
+
+
+# -- exscan ----------------------------------------------------------------
+
+
+@pytest.mark.parametrize("n", [1, 2, 5, 8])
+def test_exscan_local(n):
+    rng = np.random.RandomState(0)
+    d = rng.randn(n, 6)
+
+    def prog(comm):
+        return comm.exscan(d[comm.rank], op=ops.SUM)
+
+    res = run_local(prog, n)
+    np.testing.assert_allclose(np.asarray(res[0]), np.zeros(6), atol=0)
+    for r in range(1, n):
+        np.testing.assert_allclose(res[r], d[:r].sum(0), rtol=1e-10)
+
+
+def test_exscan_local_scalar_prod():
+    def prog(comm):
+        return comm.exscan(np.float64(comm.rank + 2), op=ops.PROD)
+
+    res = run_local(prog, 4)
+    expect = [1.0, 2.0, 6.0, 24.0]  # identity, 2, 2*3, 2*3*4
+    for got, want in zip(res, expect):
+        assert float(np.asarray(got)) == want
+
+
+def test_exscan_spmd():
+    rng = np.random.RandomState(1)
+    d = np.asarray(rng.randn(P, 5), np.float32)
+
+    def prog(comm, x):
+        return comm.exscan(x[comm.rank], op=ops.SUM)
+
+    out = np.asarray(run_spmd(prog, d))
+    np.testing.assert_allclose(out[0], np.zeros(5), atol=0)
+    for r in range(1, P):
+        np.testing.assert_allclose(out[r], d[:r].sum(0), rtol=1e-5)
+
+
+def test_scan_exscan_consistency_spmd():
+    # scan == combine(exscan, local) on every rank
+    d = np.asarray(np.random.RandomState(2).randn(P, 3), np.float32)
+
+    def prog(comm, x):
+        mine = x[comm.rank]
+        return comm.scan(mine, ops.SUM) - comm.exscan(mine, ops.SUM) - mine
+
+    out = np.asarray(run_spmd(prog, d))
+    np.testing.assert_allclose(out, np.zeros((P, 3)), atol=1e-5)
+
+
+# -- user-defined ops ------------------------------------------------------
+
+
+@pytest.mark.parametrize("algo", ["auto", "ring", "reduce_bcast"])
+def test_custom_op_local(algo):
+    rng = np.random.RandomState(3)
+    d = rng.randn(4, 7)
+
+    def prog(comm):
+        return comm.allreduce(d[comm.rank], op=ABSMAX, algorithm=algo)
+
+    for got in run_local(prog, 4):
+        np.testing.assert_allclose(got, np.abs(d).max(0), rtol=1e-10)
+
+
+@pytest.mark.parametrize("algo", ["fused", "ring", "recursive_halving"])
+def test_custom_op_spmd(algo):
+    d = np.asarray(np.random.RandomState(4).randn(P, 6), np.float32)
+
+    def prog(comm, x):
+        return comm.allreduce(x[comm.rank], op=ABSMAX, algorithm=algo)
+
+    out = np.asarray(run_spmd(prog, d))
+    for r in range(P):
+        np.testing.assert_allclose(out[r], np.abs(d).max(0), rtol=1e-5)
+
+
+def test_custom_op_identity_callable():
+    cap = ops.make_op(lambda a, b: ops._minimum(a + b, 100.0),
+                      identity=lambda dt: np.dtype(dt).type(0), name="capsum")
+    assert cap.identity(np.float32) == 0
+    assert cap.combine(60.0, 70.0) == 100.0
+
+
+# -- maxloc / minloc -------------------------------------------------------
+
+
+def test_maxloc_minloc_local():
+    d = np.array([[3.0, -1.0], [7.0, -5.0], [7.0, 2.0], [0.0, -5.0]])
+
+    def prog(comm):
+        return comm.maxloc(d[comm.rank]), comm.minloc(d[comm.rank])
+
+    for (mx, mxr), (mn, mnr) in run_local(prog, 4):
+        np.testing.assert_allclose(mx, [7.0, 2.0])
+        np.testing.assert_array_equal(mxr, [1, 2])  # lowest rank wins the tie
+        np.testing.assert_allclose(mn, [0.0, -5.0])
+        np.testing.assert_array_equal(mnr, [3, 1])
+
+
+def test_maxloc_scalar_local():
+    def prog(comm):
+        val = [5.0, 9.0, 1.0, 9.0][comm.rank]
+        return comm.maxloc(val)
+
+    for mx, r in run_local(prog, 4):
+        assert float(mx) == 9.0 and int(r) == 1
+
+
+def test_maxloc_minloc_spmd():
+    d = np.asarray(np.random.RandomState(5).randn(P, 4), np.float32)
+
+    def prog(comm, x):
+        mx, mxr = comm.maxloc(x[comm.rank])
+        mn, mnr = comm.minloc(x[comm.rank])
+        return mx, mxr.astype(np.int32), mn, mnr.astype(np.int32)
+
+    mx, mxr, mn, mnr = run_spmd(prog, d)
+    for r in range(P):
+        np.testing.assert_allclose(np.asarray(mx)[r], d.max(0), rtol=1e-6)
+        np.testing.assert_array_equal(np.asarray(mxr)[r], d.argmax(0))
+        np.testing.assert_allclose(np.asarray(mn)[r], d.min(0), rtol=1e-6)
+        np.testing.assert_array_equal(np.asarray(mnr)[r], d.argmin(0))
+
+
+# -- flat API --------------------------------------------------------------
+
+
+def test_api_exports():
+    from mpi_tpu import api
+
+    for name in ("MPI_Exscan", "MPI_Op_create", "MPI_Maxloc", "MPI_Minloc",
+                 "LAND", "BXOR"):
+        assert hasattr(api, name)
+    assert api.MPI_Op_create is ops.make_op

@@ -1,0 +1,234 @@
+"""Cartesian topology (MPI_Cart_*) semantics on both backends, plus the 2-D
+Jacobi example's cross-backend / cross-decomposition parity (SURVEY.md §4
+item 4: same user program, byte-for-byte, on every backend)."""
+
+import numpy as np
+import pytest
+
+from mpi_tpu import CartComm, cart_create, dims_create, ops
+from mpi_tpu.topology import Pair  # noqa: F401  (re-export sanity)
+from mpi_tpu.transport.local import run_local
+from mpi_tpu.tpu import run_spmd
+
+from examples.jacobi import jacobi_program
+from examples.jacobi2d import jacobi2d_program
+
+P = 8
+
+
+# -- pure coordinate math --------------------------------------------------
+
+
+def test_dims_create_balanced():
+    assert dims_create(8, 2) == [4, 2]
+    assert dims_create(8, 3) == [2, 2, 2]
+    assert dims_create(12, 2) == [4, 3]
+    assert dims_create(7, 2) == [7, 1]
+    assert dims_create(1, 3) == [1, 1, 1]
+    assert np.prod(dims_create(360, 3)) == 360
+
+
+def test_coords_rank_roundtrip():
+    class FakeComm:
+        size, rank = 24, 0
+
+        def exchange(self, *a, **k):  # pragma: no cover
+            raise AssertionError
+
+    cart = CartComm(FakeComm(), (2, 3, 4))
+    for r in range(24):
+        assert cart.rank_of(cart.coords_of(r)) == r
+    assert cart.coords_of(0) == (0, 0, 0)
+    assert cart.coords_of(23) == (1, 2, 3)  # row-major (C order), like MPI
+    assert cart.coords_of(4) == (0, 1, 0)
+
+
+def test_rank_of_periodic_wrap_and_proc_null():
+    class FakeComm:
+        size, rank = 6, 0
+
+    cart = CartComm(FakeComm(), (2, 3), periods=(True, False))
+    assert cart.rank_of((-1, 0)) == cart.rank_of((1, 0))  # periodic wraps
+    assert cart.rank_of((0, -1)) is None  # MPI_PROC_NULL
+    assert cart.rank_of((0, 3)) is None
+
+
+def test_shift_perm_is_valid_partial_permutation():
+    from mpi_tpu.checker import validate_perm
+
+    class FakeComm:
+        size, rank = 12, 0
+
+    cart = CartComm(FakeComm(), (3, 4), periods=(True, False))
+    for dim in (0, 1):
+        for disp in (1, -1, 2):
+            pairs = cart.shift_perm(dim, disp)
+            validate_perm(pairs, 12)
+    # periodic dim: every rank sends and receives
+    assert len(cart.shift_perm(0, 1)) == 12
+    # non-periodic dim, |disp|=1: one column of senders drops out
+    assert len(cart.shift_perm(1, 1)) == 9
+
+
+def test_cart_size_mismatch_rejected():
+    class FakeComm:
+        size, rank = 5, 0
+
+    with pytest.raises(ValueError, match="prod"):
+        CartComm(FakeComm(), (2, 3))
+
+
+# -- shift / exchange on the process backend -------------------------------
+
+
+def test_cart_shift_local():
+    def prog(comm):
+        cart = cart_create(comm, (2, 3), periods=(False, True))
+        src0, dst0 = cart.shift(0, 1)
+        src1, dst1 = cart.shift(1, 1)
+        return cart.coords_of(comm.rank), src0, dst0, src1, dst1
+
+    res = run_local(prog, 6)
+    coords, src0, dst0, _, _ = res[0]  # rank 0 = (0, 0)
+    assert coords == (0, 0)
+    assert src0 is None and dst0 == 3  # non-periodic rows
+    _, _, _, src1, dst1 = res[2]  # rank 2 = (0, 2): periodic cols wrap
+    assert dst1 == 0 and src1 == 1
+
+
+def test_cart_exchange_local():
+    def prog(comm):
+        cart = cart_create(comm, (2, 2))
+        got = cart.exchange(np.float64(comm.rank), dim=1, disp=1, fill=-1.0)
+        return float(np.asarray(got))
+
+    res = run_local(prog, 4)
+    # (r, c) receives from (r, c-1); c=0 holes filled
+    assert res == [-1.0, 0.0, -1.0, 2.0]
+
+
+def test_cart_sub_local():
+    def prog(comm):
+        cart = cart_create(comm, (2, 3))
+        rows = cart.sub([False, True])   # keep cols: 2 comms of 3
+        cols = cart.sub([True, False])   # keep rows: 3 comms of 2
+        return (rows.size, rows.comm.allreduce(comm.rank),
+                cols.size, cols.comm.allreduce(comm.rank))
+
+    res = run_local(prog, 6)
+    for r, (rs, rsum, cs, csum) in enumerate(res):
+        row, col = divmod(r, 3)
+        assert rs == 3 and cs == 2
+        assert rsum == sum(3 * row + c for c in range(3))
+        assert csum == sum(col + 3 * rr for rr in range(2))
+
+
+# -- SPMD backend ----------------------------------------------------------
+
+
+def test_cart_exchange_spmd():
+    def prog(comm, _):
+        cart = cart_create(comm, (2, 4))
+        r = comm.rank.astype(np.float32)
+        from_left = cart.exchange(r, dim=1, disp=1, fill=-1.0)
+        from_above = cart.exchange(r, dim=0, disp=1, fill=-2.0)
+        return from_left, from_above
+
+    left, above = run_spmd(prog, np.zeros(1, np.float32))
+    left, above = np.ravel(np.asarray(left)), np.ravel(np.asarray(above))
+    for r in range(P):
+        row, col = divmod(r, 4)
+        assert left[r] == (r - 1 if col > 0 else -1.0)
+        assert above[r] == (r - 4 if row > 0 else -2.0)
+
+
+def test_cart_sub_spmd():
+    def prog(comm, _):
+        cart = cart_create(comm, (2, 4))
+        rows = cart.sub([False, True])  # 2 comms of 4 (same process row)
+        return rows.comm.allreduce(comm.rank.astype(np.float32))
+
+    out = np.ravel(np.asarray(run_spmd(prog, np.zeros(1, np.float32))))
+    assert list(out[:4]) == [0 + 1 + 2 + 3] * 4
+    assert list(out[4:]) == [4 + 5 + 6 + 7] * 4
+
+
+def test_cart_shift_inside_trace_raises():
+    from mpi_tpu.tpu import SpmdSemanticsError  # noqa: F401
+
+    def prog(comm, _):
+        cart = cart_create(comm, (2, 4))
+        with pytest.raises(TypeError, match="traced"):
+            cart.shift(0, 1)
+        return comm.allreduce(np.float32(0))
+
+    run_spmd(prog, np.zeros(1, np.float32))
+
+
+# -- jacobi2d parity -------------------------------------------------------
+
+
+def oracle_jacobi(rows, cols, iters):
+    """Single-process numpy oracle of the same boundary problem."""
+    g = np.zeros((rows, cols), np.float32)
+    prev = g
+    for _ in range(iters):
+        padded = np.zeros((rows + 2, cols + 2), np.float32)
+        padded[1:-1, 1:-1] = g
+        padded[0, 1:-1] = 1.0  # hot top edge
+        new = 0.25 * (padded[:-2, 1:-1] + padded[2:, 1:-1]
+                      + padded[1:-1, :-2] + padded[1:-1, 2:])
+        new[:, 0] = 0.0
+        new[:, -1] = 0.0
+        g, prev = new.astype(np.float32), g
+    return g, np.abs(g - prev).max()
+
+
+@pytest.mark.parametrize("dims", [(2, 2), (4, 1), (1, 4)])
+def test_jacobi2d_matches_oracle_local(dims):
+    tr, tc = 8 // dims[0], 8 // dims[1]
+    res = run_local(lambda comm: jacobi2d_program(
+        comm, tile_rows=tr, tile_cols=tc, iters=30, dims=dims), 4)
+    want, want_res = oracle_jacobi(8, 8, 30)
+    tiles = np.zeros((8, 8), np.float32)
+    for r, (tile, resid) in enumerate(res):
+        row, col = divmod(r, dims[1])
+        tiles[row * tr:(row + 1) * tr, col * tc:(col + 1) * tc] = np.asarray(tile)
+        np.testing.assert_allclose(float(np.asarray(resid)), want_res, rtol=1e-4)
+    np.testing.assert_allclose(tiles, want, atol=1e-6)
+
+
+def test_jacobi2d_matches_oracle_spmd():
+    dims = (2, 4)
+    tr, tc = 8 // dims[0], 16 // dims[1]
+
+    def prog(comm):
+        return jacobi2d_program(comm, tile_rows=tr, tile_cols=tc,
+                                iters=25, dims=dims)
+
+    tile, resid = run_spmd(prog)
+    tile = np.asarray(tile)
+    want, want_res = oracle_jacobi(8, 16, 25)
+    got = np.zeros((8, 16), np.float32)
+    for r in range(P):
+        row, col = divmod(r, dims[1])
+        got[row * tr:(row + 1) * tr, col * tc:(col + 1) * tc] = tile[r]
+    np.testing.assert_allclose(got, want, atol=1e-5)
+    np.testing.assert_allclose(float(np.ravel(np.asarray(resid))[0]),
+                               want_res, rtol=1e-3)
+
+
+def test_jacobi2d_1xN_matches_jacobi1d_spmd():
+    # dims (P, 1) reduces jacobi2d to the 1-D row decomposition of
+    # examples/jacobi.py — the two programs must agree to the bit
+    def prog2d(comm):
+        return jacobi2d_program(comm, tile_rows=4, tile_cols=12, iters=20,
+                                dims=(P, 1))
+
+    def prog1d(comm):
+        return jacobi_program(comm, rows_per_rank=4, cols=12, iters=20)
+
+    t2, r2 = run_spmd(prog2d)
+    t1, r1 = run_spmd(prog1d)
+    np.testing.assert_array_equal(np.asarray(t2), np.asarray(t1))
+    np.testing.assert_array_equal(np.asarray(r2), np.asarray(r1))

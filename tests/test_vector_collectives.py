@@ -1,0 +1,179 @@
+"""MPI_*v vector collectives (static counts, padded payloads) on the thread
+backend and the 8-device virtual-CPU SPMD backend — SURVEY.md §4 items 1-2.
+Contract: Communicator.allgatherv docstring (mpi_tpu/communicator.py)."""
+
+import numpy as np
+import pytest
+
+from mpi_tpu.transport.local import run_local
+from mpi_tpu.tpu import run_spmd
+
+P = 8
+COUNTS = [3, 1, 4, 1, 5, 0, 2, 6]  # includes a zero-contribution rank
+
+
+def ragged(n, counts, width=2, seed=0):
+    rng = np.random.RandomState(seed)
+    return [np.asarray(rng.randn(c, width), np.float32) for c in counts[:n]]
+
+
+# -- process backend -------------------------------------------------------
+
+
+@pytest.mark.parametrize("n", [1, 4, 8])
+def test_allgatherv_local(n):
+    counts = COUNTS[:n]
+    parts = ragged(n, counts)
+    want = np.concatenate(parts, axis=0)
+
+    def prog(comm):
+        return comm.allgatherv(parts[comm.rank], counts)
+
+    for got in run_local(prog, n):
+        np.testing.assert_allclose(got, want, rtol=1e-6)
+
+
+def test_allgatherv_accepts_padded_input_local():
+    counts = [2, 3]
+    parts = ragged(2, counts, seed=1)
+    padded = [np.concatenate([p, np.zeros((3 - len(p), 2), np.float32)])[:3]
+              for p in parts]
+
+    def prog(comm):
+        return comm.allgatherv(padded[comm.rank], counts)
+
+    for got in run_local(prog, 2):
+        np.testing.assert_allclose(got, np.concatenate(parts), rtol=1e-6)
+
+
+def test_gatherv_scatterv_roundtrip_local():
+    counts = [2, 0, 3, 1]
+    total = np.asarray(np.arange(6 * 4).reshape(6, 4), np.float64)
+
+    def prog(comm):
+        mine = comm.scatterv(total if comm.rank == 1 else None, counts, root=1)
+        assert mine.shape == (counts[comm.rank], 4)
+        back = comm.gatherv(mine, counts, root=2)
+        return back
+
+    res = run_local(prog, 4)
+    np.testing.assert_array_equal(res[2], total)
+    assert res[0] is None and res[1] is None and res[3] is None
+
+
+def test_alltoallv_local():
+    n = 4
+    counts = [[(i + j) % 3 for j in range(n)] for i in range(n)]
+
+    def prog(comm):
+        blocks = [np.full((3, 2), 10 * comm.rank + d, np.float32)
+                  for d in range(n)]
+        return comm.alltoallv(blocks, counts)
+
+    res = run_local(prog, n)
+    for me, got in enumerate(res):
+        for src in range(n):
+            c = counts[src][me]
+            np.testing.assert_allclose(
+                np.asarray(got[src]),
+                np.full((c, 2), 10 * src + me, np.float32))
+
+
+def test_counts_validation_local():
+    def prog(comm):
+        with pytest.raises(ValueError):
+            comm.allgatherv(np.zeros((2, 2)), [1])  # wrong length
+        with pytest.raises(ValueError):
+            comm.allgatherv(np.zeros((2, 2)), [1, -1])  # negative
+        with pytest.raises(ValueError):
+            comm.alltoallv([np.zeros((1, 1))] * 2, [[1, 1]])  # not square
+
+    run_local(prog, 2)
+
+
+# -- SPMD backend ----------------------------------------------------------
+
+
+def test_allgatherv_spmd():
+    counts = COUNTS
+    parts = ragged(P, counts, seed=2)
+    maxc = max(counts)
+    padded = np.stack([
+        np.concatenate([p, np.zeros((maxc - len(p), 2), np.float32)])
+        for p in parts])  # [P, maxc, 2]
+    want = np.concatenate(parts, axis=0)
+
+    def prog(comm, x):
+        return comm.allgatherv(x[comm.rank], counts)
+
+    out = np.asarray(run_spmd(prog, padded))
+    assert out.shape == (P, sum(counts), 2)
+    for r in range(P):
+        np.testing.assert_allclose(out[r], want, rtol=1e-6)
+
+
+def test_scatterv_spmd():
+    counts = [2, 1, 3, 0, 1, 2, 4, 3]
+    total = np.asarray(np.random.RandomState(3).randn(sum(counts), 3), np.float32)
+    maxc = max(counts)
+
+    def prog(comm, x):
+        return comm.scatterv(x, counts, root=0)
+
+    out = np.asarray(run_spmd(prog, total))
+    assert out.shape == (P, maxc, 3)
+    offs = np.concatenate([[0], np.cumsum(counts)])
+    for r in range(P):
+        np.testing.assert_allclose(out[r, : counts[r]],
+                                   total[offs[r]:offs[r + 1]], rtol=1e-6)
+        np.testing.assert_array_equal(out[r, counts[r]:],
+                                      np.zeros((maxc - counts[r], 3)))
+
+
+def test_alltoallv_spmd():
+    counts = [[(i + 2 * j) % 4 for j in range(P)] for i in range(P)]
+    maxc = max(max(r) for r in counts)
+
+    # rank i's block for dest d = value 100*i + d in every valid row
+    def prog(comm, _):
+        i = comm.rank
+        base = (100.0 * i
+                + np.arange(P, dtype=np.float32)[:, None, None]
+                + np.zeros((P, maxc, 1), np.float32))
+        out = comm.alltoallv(base, counts)
+        return out
+
+    out = np.asarray(run_spmd(prog, np.zeros(1, np.float32)))
+    for me in range(P):
+        for src in range(P):
+            c = counts[src][me]
+            np.testing.assert_allclose(
+                out[me, src, :c],
+                np.full((c, 1), 100.0 * src + me, np.float32))
+            np.testing.assert_array_equal(
+                out[me, src, c:], np.zeros((maxc - c, 1)))
+
+
+def test_gatherv_spmd_symmetric():
+    counts = [1, 2, 0, 1, 3, 2, 1, 2]
+    maxc = max(counts)
+    d = np.asarray(np.random.RandomState(4).randn(P, maxc, 2), np.float32)
+
+    def prog(comm, x):
+        return comm.gatherv(x[comm.rank], counts, root=3)
+
+    out = np.asarray(run_spmd(prog, d))
+    want = np.concatenate([d[i, : counts[i]] for i in range(P)], axis=0)
+    for r in range(P):
+        np.testing.assert_allclose(out[r], want, rtol=1e-6)
+
+
+def test_undercount_payload_rejected_local():
+    # declared count larger than the actual payload must raise, not truncate
+    def prog(comm):
+        with pytest.raises(ValueError, match="declared count"):
+            comm.allgatherv(np.zeros((1, 1)), [3, 3])
+        with pytest.raises(ValueError, match="declared count"):
+            comm.alltoallv([np.zeros((1, 1))] * 2, [[2, 2], [2, 2]])
+
+    run_local(prog, 2)
