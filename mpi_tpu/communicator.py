@@ -366,6 +366,9 @@ class Communicator(ABC):
         if len(counts) != self.size or any(len(row) != self.size for row in counts):
             raise ValueError(
                 f"alltoallv counts must be a {self.size}x{self.size} matrix")
+        if any(int(c) < 0 for row in counts for c in row):
+            raise ValueError(
+                f"alltoallv counts must be >= 0, got {[list(r) for r in counts]}")
 
     # -- communicator management ------------------------------------------
 
@@ -397,6 +400,10 @@ class Communicator(ABC):
         comm) get a new communicator ordered by group position; non-members
         get None.  Collective over this communicator.  (The SPMD backend
         can't return None — see TpuCommunicator.create.)"""
+        bad = [r for r in group.ranks if not (0 <= r < self.size)]
+        if bad:
+            raise ValueError(
+                f"group ranks {bad} out of range for a size-{self.size} communicator")
         pos = group.rank_of(self.rank)
         return self.split(0 if pos is not None else None,
                           pos if pos is not None else 0)

@@ -122,6 +122,8 @@ class CartComm:
         ``dim`` — the ranks this rank receives-from / sends-to.  None is
         MPI_PROC_NULL.  Needs a concrete integer rank, so on the SPMD backend
         (traced rank) use ``exchange`` / ``shift_perm`` instead."""
+        if not (0 <= dim < self.ndims):
+            raise ValueError(f"dim {dim} out of range for {self.ndims}-D topology")
         r = self.comm.rank
         if not isinstance(r, int):
             raise TypeError(

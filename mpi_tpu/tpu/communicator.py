@@ -501,7 +501,7 @@ class TpuCommunicator(Communicator):
             raise ValueError(
                 f"alltoallv payload needs shape [size={self.size}, "
                 f">=max(counts)={maxc}, ...], got {x.shape}")
-        x = x[:, :maxc] if maxc else x
+        x = x[:, :maxc]
         # zero this rank's padding rows so garbage never travels
         cnt_row = jnp.asarray(cmat)[self.rank]  # [size]
         mask = jnp.arange(maxc)[None, :] < cnt_row[:, None]
@@ -577,6 +577,10 @@ class TpuCommunicator(Communicator):
         Equal-size complement is the SPMD-expressible subset of the MPI
         semantics; anything else raises."""
         ranks = list(group.ranks)
+        bad = [r for r in ranks if not (0 <= r < self.size)]
+        if bad:
+            raise ValueError(
+                f"group ranks {bad} out of range for a size-{self.size} communicator")
         others = [r for r in range(self.size) if r not in set(ranks)]
         if others and len(others) % len(ranks) != 0:
             raise SpmdSemanticsError(

@@ -10,6 +10,7 @@ come back stacked on a leading axis (rank order), mirroring
 
 from __future__ import annotations
 
+import os
 from typing import Any, Callable, Optional
 
 import numpy as np
@@ -27,6 +28,19 @@ def default_mesh(nranks: Optional[int] = None, axis_name: str = "world") -> Mesh
     On a CPU host, virtual devices come from
     ``XLA_FLAGS=--xla_force_host_platform_device_count=N`` (SURVEY.md §4
     item 2 — the standard fake-multi-device fixture)."""
+    # Honor JAX_PLATFORMS even on hosts whose site hook force-registers a
+    # platform via jax.config (e.g. the axon TPU tunnel), which silently
+    # overrides the env var and would hide the virtual CPU devices.
+    plat = os.environ.get("JAX_PLATFORMS")
+    if plat and jax.config.jax_platforms != plat:
+        try:
+            jax.config.update("jax_platforms", plat)
+        except Exception as e:  # backend already initialized on another platform
+            import warnings
+
+            warnings.warn(
+                f"JAX_PLATFORMS={plat!r} could not be applied ({e}); "
+                f"devices stay on the already-initialized platform")
     devs = jax.devices()
     n = len(devs) if nranks is None else nranks
     if n > len(devs):

@@ -124,3 +124,22 @@ def test_group_rank_of_traced_rank_raises_loudly():
         return comm.allreduce(np.float32(0))
 
     run_spmd(prog, np.zeros(1, np.float32))
+
+
+def test_comm_create_out_of_range_rank_rejected():
+    def prog(comm):
+        from mpi_tpu.group import Group
+
+        with pytest.raises(ValueError):
+            comm.create(Group([0, 1, 99]))
+
+    run_local(prog, 4)
+
+
+def test_comm_create_spmd_out_of_range_rank_rejected():
+    from mpi_tpu.group import Group
+    from mpi_tpu.tpu import TpuCommunicator, default_mesh
+
+    comm = TpuCommunicator("world", default_mesh(8))
+    with pytest.raises(ValueError):
+        comm.create(Group([0, 1, 99]))

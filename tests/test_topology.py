@@ -232,3 +232,14 @@ def test_jacobi2d_1xN_matches_jacobi1d_spmd():
     t1, r1 = run_spmd(prog1d)
     np.testing.assert_array_equal(np.asarray(t2), np.asarray(t1))
     np.testing.assert_array_equal(np.asarray(r2), np.asarray(r1))
+
+
+def test_cart_shift_dim_out_of_range_rejected():
+    def prog(comm):
+        cart = cart_create(comm, (2, 3))
+        with pytest.raises(ValueError):
+            cart.shift(2, 1)
+        with pytest.raises(ValueError):
+            cart.shift(-1, 1)
+
+    run_local(prog, 6)

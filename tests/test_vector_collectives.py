@@ -177,3 +177,30 @@ def test_undercount_payload_rejected_local():
             comm.alltoallv([np.zeros((1, 1))] * 2, [[2, 2], [2, 2]])
 
     run_local(prog, 2)
+
+
+def test_alltoallv_negative_counts_rejected_local():
+    def prog(comm):
+        with pytest.raises(ValueError):
+            comm.alltoallv([np.zeros((2, 1))] * 2, [[-1, 2], [2, 2]])
+
+    run_local(prog, 2)
+
+
+def test_alltoallv_all_zero_counts_spmd():
+    from mpi_tpu.tpu import TpuCommunicator, default_mesh
+    import jax, jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    mesh = default_mesh(8)
+    comm = TpuCommunicator("world", mesh)
+    counts = [[0] * 8 for _ in range(8)]
+
+    def prog():
+        x = jnp.ones((8, 2, 1), jnp.float32)
+        out = comm.alltoallv(x, counts)
+        return out[None]
+
+    out = jax.jit(jax.shard_map(prog, mesh=mesh, in_specs=(),
+                                out_specs=P("world")))()
+    assert out.shape == (8, 8, 0, 1)
