@@ -52,8 +52,6 @@ import os, sys, time, statistics
 sys.path.insert(0, {repo!r})
 import jax
 if {force_cpu!r} == "yes":
-    os.environ["XLA_FLAGS"] = os.environ.get("XLA_FLAGS", "") + \
-        " --xla_force_host_platform_device_count=2"
     jax.config.update("jax_platforms", "cpu")
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
@@ -111,10 +109,30 @@ with open(os.environ["BENCH_OUT"], "w") as fh:
 """
 
 
-def _run_sub(code: str, env_extra: dict, timeout: float = 600.0) -> str:
+def _cpu_env() -> dict:
+    """Child env that deterministically yields a 2-device CPU jax.
+
+    On TPU-tunnel hosts a sitecustomize hook force-registers the TPU
+    platform whenever its pool env vars are present; racing it with
+    config updates after import is flaky.  Scrubbing the trigger vars
+    makes the hook a no-op, so the child is a plain CPU jax process.
+    """
+    import re
+
+    env = {k: v for k, v in os.environ.items()
+           if not k.startswith(("PALLAS_AXON", "AXON_"))}
+    env["JAX_PLATFORMS"] = "cpu"
+    flags = re.sub(r"--xla_force_host_platform_device_count=\d+", "",
+                   env.get("XLA_FLAGS", ""))
+    env["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=2").strip()
+    return env
+
+
+def _run_sub(code: str, env_extra: dict, timeout: float = 600.0,
+             env_base: dict | None = None) -> str:
     with tempfile.TemporaryDirectory() as td:
         out = os.path.join(td, "out.txt")
-        env = dict(os.environ)
+        env = dict(os.environ) if env_base is None else dict(env_base)
         env["BENCH_OUT"] = out
         env.update(env_extra)
         script = os.path.join(td, "prog.py")
@@ -152,7 +170,9 @@ def main() -> None:
     details["socket_2rank_1kf32_p50_us"] = socket_us
 
     force_cpu = "yes" if n_real < 2 else "no"
-    spmd_us = float(_run_sub(SPMD_PROG.format(repo=REPO, force_cpu=force_cpu), {}))
+    spmd_us = float(_run_sub(
+        SPMD_PROG.format(repo=REPO, force_cpu=force_cpu), {},
+        env_base=_cpu_env() if force_cpu == "yes" else None))
     details["spmd_2rank_1kf32_p50_us"] = spmd_us
     details["spmd_leg_platform"] = "cpu-sim" if force_cpu == "yes" else "tpu-ici"
 

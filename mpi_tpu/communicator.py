@@ -395,15 +395,24 @@ class Communicator(ABC):
 
         return Group(range(self.size))
 
+    def _check_group(self, group) -> None:
+        """Shared validation for create(): non-empty, ranks in range."""
+        ranks = list(group.ranks)
+        if not ranks:
+            raise ValueError(
+                "create(group) needs a non-empty group (MPI_GROUP_EMPTY has "
+                "no communicator)")
+        bad = [r for r in ranks if not (0 <= r < self.size)]
+        if bad:
+            raise ValueError(
+                f"group ranks {bad} out of range for a size-{self.size} communicator")
+
     def create(self, group) -> Optional["Communicator"]:
         """MPI_Comm_create_group [S]: members of ``group`` (ranks of THIS
         comm) get a new communicator ordered by group position; non-members
         get None.  Collective over this communicator.  (The SPMD backend
         can't return None — see TpuCommunicator.create.)"""
-        bad = [r for r in group.ranks if not (0 <= r < self.size)]
-        if bad:
-            raise ValueError(
-                f"group ranks {bad} out of range for a size-{self.size} communicator")
+        self._check_group(group)
         pos = group.rank_of(self.rank)
         return self.split(0 if pos is not None else None,
                           pos if pos is not None else 0)

@@ -576,11 +576,8 @@ class TpuCommunicator(Communicator):
         uniform-partition rule) and every rank gets its own group's handle.
         Equal-size complement is the SPMD-expressible subset of the MPI
         semantics; anything else raises."""
+        self._check_group(group)
         ranks = list(group.ranks)
-        bad = [r for r in ranks if not (0 <= r < self.size)]
-        if bad:
-            raise ValueError(
-                f"group ranks {bad} out of range for a size-{self.size} communicator")
         others = [r for r in range(self.size) if r not in set(ranks)]
         if others and len(others) % len(ranks) != 0:
             raise SpmdSemanticsError(

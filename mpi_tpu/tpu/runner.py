@@ -33,15 +33,17 @@ def default_mesh(nranks: Optional[int] = None, axis_name: str = "world") -> Mesh
     # overrides the env var and would hide the virtual CPU devices.
     plat = os.environ.get("JAX_PLATFORMS")
     if plat and jax.config.jax_platforms != plat:
-        try:
-            jax.config.update("jax_platforms", plat)
-        except Exception as e:  # backend already initialized on another platform
-            import warnings
-
-            warnings.warn(
-                f"JAX_PLATFORMS={plat!r} could not be applied ({e}); "
-                f"devices stay on the already-initialized platform")
+        jax.config.update("jax_platforms", plat)
     devs = jax.devices()
+    # config.update never raises post-init; detect a silently-ignored
+    # platform switch by inspecting what we actually got.
+    if plat and devs and devs[0].platform not in plat.split(","):
+        import warnings
+
+        warnings.warn(
+            f"JAX_PLATFORMS={plat!r} could not be applied (a "
+            f"{devs[0].platform!r} backend was already initialized); "
+            f"devices stay on the already-initialized platform")
     n = len(devs) if nranks is None else nranks
     if n > len(devs):
         raise ValueError(

@@ -143,3 +143,21 @@ def test_comm_create_spmd_out_of_range_rank_rejected():
     comm = TpuCommunicator("world", default_mesh(8))
     with pytest.raises(ValueError):
         comm.create(Group([0, 1, 99]))
+
+
+def test_comm_create_empty_group_rejected():
+    def prog(comm):
+        with pytest.raises(ValueError, match="non-empty"):
+            comm.create(Group([]))
+        return True
+
+    assert all(run_local(prog, 2))
+
+    def sprog(comm):
+        try:
+            comm.create(Group([]))
+        except ValueError:
+            return comm.rank * 0 + 1
+        return comm.rank * 0
+
+    assert np.all(np.asarray(run_spmd(sprog, nranks=4)) == 1)
