@@ -36,6 +36,7 @@ from .transport.local import run_local
 from . import schedules, checker, profiling, trace
 from .topology import CartComm, cart_create, dims_create
 from .group import Group
+from .window import GetFuture, P2PWindow
 
 __all__ = [
     "__version__", "ops", "ReduceOp",
@@ -44,6 +45,7 @@ __all__ = [
     "init", "finalize", "is_initialized", "run", "run_local",
     "schedules", "checker", "profiling", "trace", "COMM_WORLD",
     "CartComm", "cart_create", "dims_create", "Group",
+    "GetFuture", "P2PWindow",
 ]
 
 _ENV_RANK = "MPI_TPU_RANK"

@@ -28,11 +28,15 @@ __all__ = [
     "MPI_Barrier", "MPI_Comm_split", "MPI_Comm_dup", "MPI_Scatter", "MPI_Gather",
     "MPI_Scan", "MPI_Reduce_scatter", "MPI_Isend", "MPI_Irecv", "MPI_Wait",
     "MPI_Test", "MPI_Waitall", "MPI_Probe", "MPI_Iprobe", "MPI_Wtime",
+    "MPI_Send_init", "MPI_Recv_init", "MPI_Start", "MPI_Startall",
     "MPI_Exscan", "MPI_Op_create", "MPI_Maxloc", "MPI_Minloc",
     "MPI_Gatherv", "MPI_Scatterv", "MPI_Allgatherv", "MPI_Alltoallv",
     "MPI_Cart_create", "MPI_Dims_create", "MPI_Cart_coords", "MPI_Cart_rank",
     "MPI_Cart_shift", "MPI_Cart_sub",
+    "MPI_Neighbor_allgather", "MPI_Neighbor_alltoall",
     "MPI_Comm_group", "MPI_Comm_create", "MPI_Comm_create_group",
+    "MPI_Win_create", "MPI_Win_fence", "MPI_Win_free",
+    "MPI_Put", "MPI_Get", "MPI_Accumulate",
     "MPI_Group_incl", "MPI_Group_excl", "MPI_Group_union",
     "MPI_Group_intersection", "MPI_Group_difference", "MPI_Group_size",
     "MPI_Group_rank", "MPI_Group_translate_ranks", "Group",
@@ -300,3 +304,67 @@ def MPI_Group_rank(group, comm: Optional[Communicator] = None):
 
 def MPI_Group_translate_ranks(group, positions: Sequence[int], other):
     return group.translate(positions, other)
+
+
+# -- one-sided (RMA) -------------------------------------------------------
+
+
+def MPI_Win_create(init: Any, comm: Optional[Communicator] = None):
+    """Expose ``init`` (copied) as this rank's RMA window [S: MPI-2]."""
+    return _world(comm).win_create(init)
+
+
+def MPI_Win_fence(win) -> None:
+    win.fence()
+
+
+def MPI_Put(win, data: Any, target, loc: Any = None) -> None:
+    win.put(data, target, loc=loc)
+
+
+def MPI_Get(win, target, fill: Any = 0, loc: Any = None):
+    """Returns a GetFuture; ``.value`` is defined after the closing fence.
+    ``fill`` resolves ranks with no source in a pattern-form get."""
+    return win.get(target, fill=fill, loc=loc)
+
+
+def MPI_Accumulate(win, data: Any, target, op=ops.SUM, loc: Any = None) -> None:
+    win.accumulate(data, target, op=op, loc=loc)
+
+
+def MPI_Win_free(win) -> None:
+    win.free()
+
+
+# -- neighborhood collectives ----------------------------------------------
+
+
+def MPI_Neighbor_allgather(cart, obj: Any, fill: Any = None):
+    return cart.neighbor_allgather(obj, fill=fill)
+
+
+def MPI_Neighbor_alltoall(cart, objs: Sequence[Any], fill: Any = None):
+    return cart.neighbor_alltoall(objs, fill=fill)
+
+
+# -- persistent requests ---------------------------------------------------
+
+
+def MPI_Send_init(buf: Any, dest: int, tag: int = 0,
+                  comm: Optional[Communicator] = None):
+    return _world(comm).send_init(buf, dest, tag)
+
+
+def MPI_Recv_init(source: int = ANY_SOURCE, tag: int = ANY_TAG,
+                  buf: Any = None, comm: Optional[Communicator] = None):
+    return _world(comm).recv_init(source, tag, buf=buf)
+
+
+def MPI_Start(request):
+    return request.start()
+
+
+def MPI_Startall(requests: Sequence[Any]):
+    from .communicator import startall
+
+    return startall(requests)

@@ -152,6 +152,68 @@ class _RecvRequest(Request):
         return True, self._value
 
 
+class PersistentRequest(Request):
+    """A persistent operation (MPI_Send_init / MPI_Recv_init) [S].
+
+    Binds the argument list once; each ``start()`` launches one operation,
+    ``wait()`` completes it and returns the request to the inactive state
+    (ready to start again).  For sends the bound buffer is read at *start*
+    time (numpy buffers may be refilled in place between starts, the MPI
+    buffer-reuse idiom).  For receives, ``wait()`` returns the payload and
+    additionally copies it into the bound ``buf`` if one was given.
+    """
+
+    def __init__(self, comm: "P2PCommunicator", kind: str, buf: Any,
+                 peer: int, tag: int):
+        self._comm, self._kind, self._buf = comm, kind, buf
+        self._peer, self._tag = peer, tag
+        self._inner: Optional[Request] = None  # active sub-request
+
+    @property
+    def active(self) -> bool:
+        return self._inner is not None
+
+    def start(self) -> "PersistentRequest":
+        if self._inner is not None:
+            raise RuntimeError(
+                "start() on an active persistent request (MPI: erroneous "
+                "until the previous operation completes)")
+        if self._kind == "send":
+            payload = self._buf
+            if isinstance(payload, np.ndarray):
+                payload = payload.copy()  # snapshot: buffer owned until start
+            self._inner = self._comm.isend(payload, self._peer, self._tag)
+        else:
+            self._inner = self._comm.irecv(self._peer, self._tag)
+        return self
+
+    def wait(self) -> Any:
+        if self._inner is None:
+            return None  # [S] MPI_Wait on an inactive request: immediate no-op
+        value = self._inner.wait()
+        self._inner = None
+        if self._kind == "recv" and isinstance(self._buf, np.ndarray):
+            self._buf[...] = value
+        return value
+
+    def test(self) -> Tuple[bool, Any]:
+        if self._inner is None:
+            return True, None  # [S] inactive: flag=true, nothing pending
+        done, value = self._inner.test()
+        if done:
+            self._inner = None
+            if self._kind == "recv" and isinstance(self._buf, np.ndarray):
+                self._buf[...] = value
+        return done, value
+
+
+def startall(requests: Sequence[PersistentRequest]) -> List[PersistentRequest]:
+    """MPI_Startall [S]."""
+    for r in requests:
+        r.start()
+    return list(requests)
+
+
 class Communicator(ABC):
     """Abstract communicator: the API user MPI programs are written against."""
 
@@ -395,6 +457,13 @@ class Communicator(ABC):
 
         return Group(range(self.size))
 
+    def win_create(self, init: Any):
+        """MPI_Win_create [S]: expose a local buffer for one-sided RMA
+        (put/get/accumulate inside fence epochs — see mpi_tpu/window.py).
+        Collective; every rank contributes its local window contents."""
+        raise NotImplementedError(
+            f"{type(self).__name__} does not implement one-sided RMA")
+
     def _check_group(self, group) -> None:
         """Shared validation for create(): non-empty, ranks in range."""
         ranks = list(group.ranks)
@@ -522,6 +591,22 @@ class P2PCommunicator(Communicator):
             queue = self._irecv_queues.setdefault((source, tag), [])
         return _RecvRequest(self, source, tag, queue)
 
+    def send_init(self, buf: Any, dest: int, tag: int = 0) -> PersistentRequest:
+        """MPI_Send_init [S]: persistent send bound to ``buf``; each
+        ``start()`` snapshots the buffer and launches one send."""
+        _check_user_tag(tag)
+        self._world(dest)  # validate now, not at first start
+        return PersistentRequest(self, "send", buf, dest, tag)
+
+    def recv_init(self, source: int = ANY_SOURCE, tag: int = ANY_TAG,
+                  buf: Any = None) -> PersistentRequest:
+        """MPI_Recv_init [S]: persistent receive; each completed operation
+        returns the payload (and refills ``buf`` in place when given)."""
+        _check_user_tag(tag)
+        if source != ANY_SOURCE:
+            self._world(source)
+        return PersistentRequest(self, "recv", buf, source, tag)
+
     def probe(self, source: int = ANY_SOURCE, tag: int = ANY_TAG,
               status: Optional[Status] = None) -> None:
         """Blocking MPI_Probe: wait until a matching message is enqueued
@@ -577,6 +662,13 @@ class P2PCommunicator(Communicator):
         if fill is not None and hasattr(obj, "shape") and hasattr(obj, "dtype"):
             return np.full_like(np.asarray(obj), fill)
         return fill
+
+    # -- one-sided (RMA) ---------------------------------------------------
+
+    def win_create(self, init: Any):
+        from .window import P2PWindow
+
+        return P2PWindow(self, init)
 
     # -- collectives -------------------------------------------------------
 

@@ -166,6 +166,49 @@ class CartComm:
         Sendrecv fused)."""
         return self.exchange(obj, dim, disp, fill)
 
+    # -- neighborhood collectives [S: MPI-3 MPI_Neighbor_*] ----------------
+
+    def neighbors_of(self, rank: int) -> List[Optional[int]]:
+        """Neighbor ranks of ``rank`` in MPI's Cartesian neighbor order:
+        for each dimension, the −1 neighbor then the +1 neighbor
+        (None = MPI_PROC_NULL at a non-periodic boundary)."""
+        out: List[Optional[int]] = []
+        for dim in range(self.ndims):
+            for disp in (-1, +1):
+                c = list(self.coords_of(rank))
+                c[dim] += disp
+                out.append(self.rank_of(c))
+        return out
+
+    def neighbor_allgather(self, obj: Any, fill: Any = None) -> List[Any]:
+        """MPI_Neighbor_allgather [S]: every rank contributes ``obj``; each
+        rank returns ``[from −dim0, from +dim0, from −dim1, ...]`` — one
+        entry per neighbor (``fill`` at non-periodic boundaries).  Lowers to
+        2·ndims ppermutes on the SPMD backend."""
+        out: List[Any] = []
+        for dim in range(self.ndims):
+            # receive from the −dim neighbor = everyone ships one hop +dim
+            out.append(self.exchange(obj, dim, +1, fill=fill))
+            out.append(self.exchange(obj, dim, -1, fill=fill))
+        return out
+
+    def neighbor_alltoall(self, objs: Sequence[Any], fill: Any = None) -> List[Any]:
+        """MPI_Neighbor_alltoall [S]: ``objs`` holds one distinct payload per
+        neighbor in neighbor order (−dim0, +dim0, −dim1, ...); returns the
+        payloads received from each neighbor, same order.  The item you
+        address to your +dim neighbor arrives there as its −dim item."""
+        if len(objs) != 2 * self.ndims:
+            raise ValueError(
+                f"need one payload per neighbor (2·ndims = {2 * self.ndims}), "
+                f"got {len(objs)}")
+        out: List[Any] = []
+        for dim in range(self.ndims):
+            # my item for the +dim neighbor rides the +1 shift; what lands
+            # here on that shift is the −dim neighbor's +dim item
+            out.append(self.exchange(objs[2 * dim + 1], dim, +1, fill=fill))
+            out.append(self.exchange(objs[2 * dim], dim, -1, fill=fill))
+        return out
+
     # -- topology management ----------------------------------------------
 
     def sub(self, remain_dims: Sequence[bool]) -> "CartComm":

@@ -176,6 +176,19 @@ class TpuCommunicator(Communicator):
             "use comm.shift / comm.exchange / collectives (XLA already "
             "overlaps the DMAs).")
 
+    def send_init(self, buf: Any, dest: int, tag: int = 0):
+        raise _unsupported(
+            "MPI_Send_init", "the persistent-request idiom IS the compiled "
+            "program on this backend: jit the exchange once "
+            "(f = jax.jit(shard_map(lambda x: comm.exchange(x, pairs), ...)))"
+            " and call it repeatedly — start() is f(x).")
+
+    def recv_init(self, source: int = -1, tag: int = -1, buf: Any = None):
+        raise _unsupported(
+            "MPI_Recv_init", "the persistent-request idiom IS the compiled "
+            "program on this backend: jit the exchange once and call it "
+            "repeatedly.")
+
     def probe(self, source: int = -1, tag: int = -1, status=None):
         raise _unsupported(
             "MPI_Probe", "SPMD message arrival is static — there is nothing "
@@ -234,6 +247,13 @@ class TpuCommunicator(Communicator):
             has_src = algos._mask_of(receivers, self._axis_size, self.axis_name)
             out = jnp.where(has_src, out, jnp.full_like(out, fill))
         return out
+
+    # -- one-sided (RMA) ---------------------------------------------------
+
+    def win_create(self, init: Any):
+        from .window import TpuWindow
+
+        return TpuWindow(self, init)
 
     # -- collectives -------------------------------------------------------
 

@@ -1,0 +1,133 @@
+"""One-sided RMA on the SPMD/TPU backend: windows as functional state.
+
+The window is a per-rank array living inside the traced SPMD program; RMA
+calls queue static-pattern transfers, and ``fence()`` lowers the whole
+epoch to a sequence of ``lax.ppermute`` steps (one ICI hop per call) plus
+masked updates — the TPU-native reading of "remote memory access": the
+remote write IS an ICI DMA scheduled by XLA.
+
+Semantics match mpi_tpu/window.py exactly (issue order; writes before
+gets; fence closes the epoch) so the parity tests can diff backends
+bit-for-bit.  Rank-dynamic targets (int form) are diagnosed with
+SpmdSemanticsError — every device executes one trace, so the pattern must
+be static (SURVEY.md §7 hard parts).
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional, Tuple
+
+import jax.numpy as jnp
+from jax import lax
+
+from .. import ops as _ops
+from ..window import GetFuture, _normalize_pairs
+from . import collectives as algos
+
+Pair = Tuple[int, int]
+
+
+def _static_pairs(pairs, size: int) -> List[Pair]:
+    import numpy as np
+
+    if isinstance(pairs, (int, np.integer)):
+        from .communicator import _unsupported
+
+        raise _unsupported(
+            "rank-dynamic RMA (an int target rank)",
+            "Pass the static pattern form pairs=[(src, dst), ...] — the same "
+            "list on every rank, like Communicator.exchange.")
+    return _normalize_pairs(pairs, 0, size, allow_int=False)
+
+
+class TpuWindow:
+    """RMA window over a :class:`TpuCommunicator` (functional).
+
+    ``local`` tracks the current window value through fences; programs
+    thread it out of the traced function like any other jax value.
+    """
+
+    def __init__(self, comm, init: Any):
+        self._comm = comm
+        self._arr = jnp.asarray(init)
+        # queued ops, in issue order (pairs are group-local; they are
+        # world-mapped at fence via comm._world_pairs):
+        # ("put", data, pairs, loc, None) / ("acc", data, pairs, loc, op)
+        # ("get", None, pairs, loc, (fill, future))
+        self._queue: List[Tuple] = []
+        self._epoch = 0
+        self._freed = False
+
+    @property
+    def local(self):
+        """Current local window value (a traced array)."""
+        return self._arr
+
+    # -- epoch ops ---------------------------------------------------------
+
+    def put(self, data: Any, pairs, loc: Any = None) -> None:
+        """Queue a pattern put: (src, dst) ships src's ``data`` into dst's
+        window (at static index ``loc`` if given)."""
+        self._check_open()
+        norm = _static_pairs(pairs, self._comm.size)
+        self._queue.append(("put", jnp.asarray(data), norm, loc, None))
+
+    def accumulate(self, data: Any, pairs, op: _ops.ReduceOp = _ops.SUM,
+                   loc: Any = None) -> None:
+        """Queue a pattern accumulate: dst window[loc] = op(window[loc], data)."""
+        self._check_open()
+        norm = _static_pairs(pairs, self._comm.size)
+        self._queue.append(("acc", jnp.asarray(data), norm, loc, op))
+
+    def get(self, pairs, fill: Any = 0, loc: Any = None) -> GetFuture:
+        """Queue a pattern get; the future resolves at ``fence()`` to src's
+        window[loc] on each dst rank (``fill`` elsewhere — SPMD programs
+        produce a value on every rank)."""
+        self._check_open()
+        norm = _static_pairs(pairs, self._comm.size)
+        fut = GetFuture()
+        self._queue.append(("get", None, norm, loc, (fill, fut)))
+        return fut
+
+    def fence(self) -> None:
+        """Close the epoch: lower queued ops to ppermutes, in issue order;
+        writes land before gets are serviced (module docstring of
+        mpi_tpu/window.py — the cross-backend refinement)."""
+        self._check_open()
+        comm = self._comm
+        arr = self._arr
+        writes = [q for q in self._queue if q[0] != "get"]
+        gets = [q for q in self._queue if q[0] == "get"]
+        for kind, data, norm, loc, op in writes:
+            world = comm._world_pairs(norm)
+            incoming = lax.ppermute(data, comm.axis_name, world)
+            is_dst = algos._mask_of([d for _, d in world],
+                                    comm._axis_size, comm.axis_name)
+            if kind == "put":
+                updated = (incoming if loc is None
+                           else arr.at[loc].set(incoming))
+            else:
+                cur = arr if loc is None else arr[loc]
+                combined = op.combine(cur, incoming)
+                updated = (combined if loc is None
+                           else arr.at[loc].set(combined))
+            updated = jnp.broadcast_to(updated, arr.shape).astype(arr.dtype)
+            arr = jnp.where(is_dst, updated, arr)
+        for _, _, norm, loc, (fill, fut) in gets:
+            world = comm._world_pairs(norm)
+            src_val = arr if loc is None else arr[loc]
+            out = lax.ppermute(src_val, comm.axis_name, world)
+            is_dst = algos._mask_of([d for _, d in world],
+                                    comm._axis_size, comm.axis_name)
+            out = jnp.where(is_dst, out, jnp.full_like(out, fill))
+            fut._resolve(out)
+        self._arr = arr
+        self._queue.clear()
+        self._epoch += 1
+
+    def free(self) -> None:
+        self._freed = True
+
+    def _check_open(self) -> None:
+        if self._freed:
+            raise RuntimeError("operation on a freed Window")

@@ -1,0 +1,265 @@
+"""One-sided communication (MPI RMA): Window, Put/Get/Accumulate, Fence.
+
+Capability contract [S]: MPI-2 active-target RMA — ``MPI_Win_create`` exposes
+a local buffer; inside a fence epoch ranks issue ``MPI_Put`` / ``MPI_Get`` /
+``MPI_Accumulate`` at remote windows; all operations complete at the closing
+``MPI_Win_fence``.  (The reference checkout at /root/reference is empty this
+session — SURVEY.md §0 — so the MPI standard is the behavioral contract; the
+reference itself shows no RMA, making this a widening beyond parity.)
+
+Portable API (identical on the process backends and the SPMD/TPU backend):
+
+* operations take a static (src, dst) *pattern* — the same ``pairs`` list on
+  every rank, exactly like ``Communicator.exchange``.  That is the subset of
+  RMA expressible as one SPMD program (a ppermute per call); the process
+  backends additionally accept a plain ``int`` destination for classic
+  rank-dynamic MPI code (the TPU backend diagnoses that with
+  SpmdSemanticsError, per the framework's never-misdeliver rule).
+* ``get`` returns a :class:`GetFuture`; its ``.value`` is defined after the
+  closing fence on every backend.
+
+Epoch semantics (deterministic, identical across backends):
+
+1. operations are applied at the closing ``fence()``, in *issue order* —
+   the k-th RMA call of the epoch is applied before the (k+1)-th on every
+   backend (SPMD programs issue the same calls on all ranks, so issue order
+   is globally well defined; a per-call pattern is a partial permutation, so
+   there are no intra-call conflicts);
+2. within the epoch, puts/accumulates are applied to the window *before*
+   gets are serviced — a get in the same epoch observes the epoch's writes
+   (MPI leaves overlapping put+get undefined; we pick this refinement so the
+   backends agree bit-for-bit);
+3. ``fence()`` is collective over the communicator.
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from . import ops as _ops
+from .checker import validate_perm
+
+Pair = Tuple[int, int]
+
+# Internal tags (see communicator.py's internal-tag convention: negative,
+# never matched by user-level ANY_TAG).
+_TAG_RMA = -6
+_TAG_RMA_REPLY = -7
+
+
+class GetFuture:
+    """Result of ``Window.get``: defined after the closing fence."""
+
+    def __init__(self) -> None:
+        self._resolved = False
+        self._value: Any = None
+
+    def _resolve(self, value: Any) -> None:
+        self._value = value
+        self._resolved = True
+
+    @property
+    def value(self) -> Any:
+        if not self._resolved:
+            raise RuntimeError(
+                "GetFuture read before the closing fence: one-sided gets "
+                "complete at Window.fence() [S: MPI-2 active-target RMA]")
+        return self._value
+
+    def wait(self) -> Any:
+        return self.value
+
+
+def _normalize_pairs(pairs, my_rank: int, size: int,
+                     allow_int: bool) -> List[Pair]:
+    """Pattern form: validate the partial permutation. Int form (process
+    backends only): this rank targets ``pairs``; other ranks' targets are
+    unknown here, which is fine for the message-based backends."""
+    if isinstance(pairs, (int, np.integer)):
+        if not allow_int:
+            raise TypeError(
+                "rank-dynamic RMA (int destination) is only available on the "
+                "process backends; the SPMD backend needs the static pattern "
+                "form: pairs=[(src, dst), ...]")
+        dest = int(pairs)
+        if not (0 <= dest < size):
+            raise ValueError(f"target rank {dest} out of range for size {size}")
+        return [(my_rank, dest)]
+    pairs = [(int(s), int(d)) for s, d in pairs]
+    validate_perm(pairs, size)
+    return pairs
+
+
+class P2PWindow:
+    """RMA window over a :class:`~mpi_tpu.communicator.P2PCommunicator`.
+
+    The local buffer is a numpy array (copied from ``init``).  Operations
+    are queued and shipped at ``fence()`` with one message per peer (FIFO
+    per-pair transport ordering keeps epochs aligned without a barrier:
+    each rank sends exactly one RMA message per peer per epoch, and the
+    fence receives exactly one from each peer — source-specific receives,
+    NOT any-source, so a fast peer's next fence can never be consumed by a
+    slow peer's current one), followed by get replies.  Messages carry a
+    (window id, epoch) stamp that is asserted on receipt: fences of
+    different windows on one communicator must be identically ordered on
+    all ranks [S: collective-call ordering], and a violation is diagnosed,
+    never misdelivered.  Exiting ``fence()`` implies this rank's window has
+    its final epoch value — every peer's ops were received and applied.
+    """
+
+    def __init__(self, comm, init: Any):
+        self._comm = comm
+        self._buf = np.array(init)  # owned copy [S: MPI_Win_create memory]
+        self._wid = getattr(comm, "_win_counter", 0)
+        comm._win_counter = self._wid + 1
+        self._epoch = 0
+        # queued outgoing ops: per target comm-rank, list of
+        # (issue_idx, kind, payload, loc, opname)
+        self._out: dict = {}
+        # queued gets: (issue_idx, source_rank_or_None, loc, fill, future)
+        self._gets: List[Tuple] = []
+        self._issue = 0
+        self._freed = False
+
+    # -- epoch ops ---------------------------------------------------------
+
+    @property
+    def local(self) -> np.ndarray:
+        """The local window buffer (valid to read between fences)."""
+        return self._buf
+
+    def put(self, data: Any, pairs, loc: Any = None) -> None:
+        """Queue a put: for each (src, dst), src's ``data`` overwrites
+        dst's window (at ``loc`` if given, numpy basic-indexing)."""
+        self._check_open()
+        for s, d in _normalize_pairs(pairs, self._comm.rank,
+                                     self._comm.size, allow_int=True):
+            if s == self._comm.rank:
+                self._queue(d, "put", np.asarray(data), loc, None)
+        self._issue += 1
+
+    def accumulate(self, data: Any, pairs, op: _ops.ReduceOp = _ops.SUM,
+                   loc: Any = None) -> None:
+        """Queue an accumulate: dst's window[loc] = op(window[loc], data)."""
+        self._check_open()
+        for s, d in _normalize_pairs(pairs, self._comm.rank,
+                                     self._comm.size, allow_int=True):
+            if s == self._comm.rank:
+                self._queue(d, "acc", np.asarray(data), loc, op)
+        self._issue += 1
+
+    def get(self, pairs, fill: Any = 0, loc: Any = None) -> GetFuture:
+        """Queue a get: for each (src, dst), src's window[loc] arrives at
+        dst.  Returns a GetFuture (``.value`` after the closing fence, on
+        every rank).  Ranks that are not a dst in the pattern resolve to
+        ``fill`` (default 0, matching the SPMD backend, which must produce
+        a value on every rank)."""
+        self._check_open()
+        fut = GetFuture()
+        me = self._comm.rank
+        norm = _normalize_pairs(pairs, me, self._comm.size, allow_int=True)
+        srcs = [s for s, d in norm if d == me]
+        if isinstance(pairs, (int, np.integer)):
+            srcs = [int(pairs)]  # int form: I am the origin, reading pairs
+        src = srcs[0] if srcs else None  # None: resolve to fill at fence
+        self._gets.append((self._issue, src, loc, fill, fut))
+        self._issue += 1
+        return fut
+
+    def fence(self) -> None:
+        """Close the epoch: ship+apply all queued ops, resolve gets."""
+        self._check_open()
+        comm = self._comm
+        me, size = comm.rank, comm.size
+        # phase 1: one ops-message to every peer (possibly empty)
+        for r in range(size):
+            if r == me:
+                continue
+            ops_r = self._out.get(r, [])
+            gets_r = [(idx, loc) for idx, s, loc, _f, _ in self._gets
+                      if s == r]
+            comm._send_internal(
+                (self._wid, self._epoch, ops_r, gets_r), r, _TAG_RMA)
+        # phase 2: exactly one message from EACH peer (source-specific —
+        # see class docstring for why any-source would race)
+        incoming: List[Tuple[int, int, str, Any, Any, Optional[str]]] = []
+        get_reqs: dict = {}
+        for r in range(size):
+            if r == me:
+                continue
+            wid, epoch, ops_r, gets_r = comm._recv_internal(r, _TAG_RMA)
+            if (wid, epoch) != (self._wid, self._epoch):
+                raise RuntimeError(
+                    f"RMA fence mismatch: rank {r} is fencing window "
+                    f"{wid} epoch {epoch}, this rank window {self._wid} "
+                    f"epoch {self._epoch} — fences of windows on one "
+                    f"communicator must be identically ordered on all ranks")
+            for idx, kind, data, loc, op in ops_r:
+                incoming.append((idx, r, kind, data, loc, op))
+            if gets_r:
+                get_reqs[r] = gets_r
+        # my own ops targeting myself
+        for idx, kind, data, loc, op in self._out.get(me, []):
+            incoming.append((idx, me, kind, data, loc, op))
+        # apply puts/accumulates: issue order first (global in SPMD-aligned
+        # programs), source rank as the tie-break — see module docstring
+        for idx, src_rank, kind, data, loc, op in sorted(
+                incoming, key=lambda t: (t[0], t[1])):
+            self._apply(kind, data, loc, op)
+        # phase 3: service get requests against the post-write window
+        for r, reqs in get_reqs.items():
+            comm._send_internal(
+                [self._read(loc) for idx, loc in reqs], r, _TAG_RMA_REPLY)
+        by_src: dict = {}
+        for idx, s, loc, fill, fut in self._gets:
+            by_src.setdefault(s, []).append((loc, fill, fut))
+        for s, entries in by_src.items():
+            if s is None:  # no source in the pattern: the boundary fill
+                for loc, fill, fut in entries:
+                    fut._resolve(fill)
+                continue
+            if s == me:
+                for loc, fill, fut in entries:
+                    fut._resolve(self._read(loc))
+                continue
+            replies = comm._recv_internal(s, _TAG_RMA_REPLY)
+            for (loc, fill, fut), val in zip(entries, replies):
+                fut._resolve(val)
+        self._out.clear()
+        self._gets.clear()
+        self._issue = 0
+        self._epoch += 1
+
+    def free(self) -> None:
+        self._freed = True
+
+    # -- internals ---------------------------------------------------------
+
+    def _check_open(self) -> None:
+        if self._freed:
+            raise RuntimeError("operation on a freed Window")
+
+    def _queue(self, target: int, kind: str, data: np.ndarray, loc: Any,
+               op: Optional[_ops.ReduceOp]) -> None:
+        # the op object rides the transport with the data (built-in ops and
+        # module-level user combiners pickle; lambda user ops need the
+        # in-process 'local' backend)
+        self._out.setdefault(target, []).append(
+            (self._issue, kind, data, loc, op))
+
+    def _read(self, loc: Any) -> np.ndarray:
+        return np.copy(self._buf if loc is None else self._buf[loc])
+
+    def _apply(self, kind: str, data: np.ndarray, loc: Any,
+               op: Optional[_ops.ReduceOp]) -> None:
+        if kind == "put":
+            if loc is None:
+                self._buf[...] = data
+            else:
+                self._buf[loc] = data
+        elif loc is None:
+            self._buf[...] = op.combine(self._buf, data)
+        else:
+            self._buf[loc] = op.combine(self._buf[loc], data)

@@ -162,3 +162,96 @@ def test_iprobe_false_when_empty():
         comm.barrier()
 
     run_local(prog, 2)
+
+
+# -- persistent requests [S: MPI_Send_init / MPI_Recv_init] ------------------
+
+
+def test_persistent_ping_pong_buffer_reuse():
+    """The classic persistent pattern: bind once, refill the numpy buffer in
+    place, start/wait in a loop."""
+
+    def prog(comm):
+        peer = 1 - comm.rank
+        sbuf = np.zeros(2, np.float64)
+        rbuf = np.zeros(2, np.float64)
+        sreq = comm.send_init(sbuf, peer, tag=7)
+        rreq = comm.recv_init(peer, tag=7, buf=rbuf)
+        got = []
+        for it in range(3):
+            sbuf[...] = comm.rank * 100 + it  # refill in place
+            sreq.start()
+            rreq.start()
+            rreq.wait()
+            sreq.wait()
+            got.append(float(rbuf[0]))
+        return got
+
+    res = run_local(prog, 2)
+    assert res[0] == [100.0, 101.0, 102.0]
+    assert res[1] == [0.0, 1.0, 2.0]
+
+
+def test_persistent_snapshot_at_start():
+    """The send buffer is read at start(), not at wait() — mutating it after
+    start must not affect the in-flight message."""
+
+    def prog(comm):
+        peer = 1 - comm.rank
+        sbuf = np.array([1.0])
+        sreq = comm.send_init(sbuf, peer)
+        sreq.start()
+        sbuf[...] = 99.0  # too late for the in-flight send
+        val = comm.recv(peer)
+        sreq.wait()
+        return float(val[0])
+
+    assert run_local(prog, 2) == [1.0, 1.0]
+
+
+def test_persistent_state_machine_errors():
+    def prog(comm):
+        peer = 1 - comm.rank
+        req = comm.send_init(np.zeros(1), peer)
+        # [S] wait/test on an inactive persistent request: immediate no-op
+        assert req.wait() is None
+        assert req.test() == (True, None)
+        req.start()
+        try:
+            req.start()  # already active
+            return False
+        except RuntimeError:
+            pass
+        comm.recv(peer)
+        req.wait()
+        return True
+
+    assert all(run_local(prog, 2))
+
+
+def test_startall():
+    from mpi_tpu.communicator import startall
+
+    def prog(comm):
+        peer = 1 - comm.rank
+        sreq = comm.send_init(np.array([float(comm.rank)]), peer, tag=1)
+        rreq = comm.recv_init(peer, tag=1)
+        startall([sreq, rreq])
+        val = rreq.wait()
+        sreq.wait()
+        return float(val[0])
+
+    assert run_local(prog, 2) == [1.0, 0.0]
+
+
+def test_persistent_rejected_on_spmd():
+    from mpi_tpu.tpu import SpmdSemanticsError, run_spmd
+
+    def prog(comm):
+        try:
+            comm.send_init(np.zeros(1, np.float32), 0)
+        except SpmdSemanticsError:
+            return comm.rank * 0 + 1
+        return comm.rank * 0
+
+    assert np.all(np.asarray(run_spmd(prog, nranks=2)) == 1)

@@ -243,3 +243,88 @@ def test_cart_shift_dim_out_of_range_rejected():
             cart.shift(-1, 1)
 
     run_local(prog, 6)
+
+
+# -- neighborhood collectives [S: MPI-3 MPI_Neighbor_*] ---------------------
+
+
+def _neigh_allgather_prog(comm):
+    """2x4 periodic-x grid: gather rank ids from all 4 neighbors."""
+    cart = cart_create(comm, (2, 4), periods=(False, True))
+    got = cart.neighbor_allgather(np.float32(1.0) * comm.rank, fill=-1.0)
+    return tuple(got)
+
+
+def test_neighbor_allgather_parity():
+    res_local = run_local(_neigh_allgather_prog, P)
+    res_spmd = run_spmd(_neigh_allgather_prog, nranks=P)
+
+    def oracle(r):
+        # pure coordinate math: dims (2,4), periods (False, True)
+        dims, periods = (2, 4), (False, True)
+        strides = (4, 1)
+        def coords_of(rank):
+            return tuple((rank // s) % d for s, d in zip(strides, dims))
+        def rank_of(c):
+            rank = 0
+            for ci, d, p, s in zip(c, dims, periods, strides):
+                if p:
+                    ci %= d
+                elif not (0 <= ci < d):
+                    return None
+                rank += ci * s
+            return rank
+        out = []
+        for dim in range(2):
+            for disp in (-1, +1):
+                c = list(coords_of(r))
+                c[dim] += disp
+                out.append(rank_of(c))
+        return out
+
+    for r in range(P):
+        exp = [float(n) if n is not None else -1.0 for n in oracle(r)]
+        assert [float(x) for x in res_local[r]] == exp
+        assert [float(np.asarray(v)[r]) for v in res_spmd] == exp
+
+
+def _neigh_alltoall_prog(comm):
+    """1-D ring of P: send (rank*10+direction) to each neighbor."""
+    cart = cart_create(comm, (P,), periods=(True,))
+    left_item = np.float32(10.0) * comm.rank + 0.0   # for the −1 neighbor
+    right_item = np.float32(10.0) * comm.rank + 1.0  # for the +1 neighbor
+    got = cart.neighbor_alltoall([left_item, right_item], fill=-1.0)
+    return tuple(got)
+
+
+def test_neighbor_alltoall_parity():
+    res_local = run_local(_neigh_alltoall_prog, P)
+    res_spmd = run_spmd(_neigh_alltoall_prog, nranks=P)
+    for r in range(P):
+        left, right = (r - 1) % P, (r + 1) % P
+        exp = [left * 10.0 + 1.0,   # the −1 neighbor's "+1-direction" item
+               right * 10.0 + 0.0]  # the +1 neighbor's "−1-direction" item
+        assert [float(x) for x in res_local[r]] == exp
+        assert [float(np.asarray(v)[r]) for v in res_spmd] == exp
+
+
+def test_neighbor_alltoall_wrong_count():
+    def prog(comm):
+        cart = cart_create(comm, (2, 4))
+        try:
+            cart.neighbor_alltoall([1.0, 2.0])
+        except ValueError:
+            return True
+        return False
+
+    assert all(run_local(prog, P))
+
+
+def test_neighbors_of_order():
+    def prog2(comm):
+        cart = cart_create(comm, (2, 4), periods=(False, True))
+        return cart.neighbors_of(5)
+
+    res = run_local(prog2, P)
+    # rank 5 = coords (1, 1): -x → (0,1)=1, +x → (2,1)=None, -y → (1,0)=4, +y → (1,2)=6
+    assert res[0] == [1, None, 4, 6]

@@ -1,0 +1,215 @@
+"""One-sided RMA (Window / put / get / accumulate / fence) on both backends.
+
+Contract [S]: MPI-2 active-target RMA (mpi_tpu/window.py module docstring
+for the deterministic refinements).  Parity: the same portable program must
+produce identical windows on the process backends and the SPMD backend.
+"""
+
+import numpy as np
+import pytest
+
+import mpi_tpu
+from mpi_tpu import ops
+from mpi_tpu.transport.local import run_local
+from mpi_tpu.tpu import SpmdSemanticsError, run_spmd
+
+P = 4
+
+
+# -- portable programs (run on every backend) ------------------------------
+
+
+def ring_put_prog(comm):
+    """Each rank puts its rank-stamped vector into its right neighbor."""
+    win = comm.win_create(np.zeros(3, np.float32))
+    data = np.ones(3, np.float32) * (comm.rank + 1)  # rank-varying on TPU
+    pairs = [(r, (r + 1) % P) for r in range(P)]
+    win.put(data, pairs)
+    win.fence()
+    return win.local
+
+
+def accumulate_prog(comm):
+    """All ranks accumulate into rank pattern; two calls stack in issue order."""
+    win = comm.win_create(np.ones(2, np.float32))
+    mine = np.ones(2, np.float32) * comm.rank
+    pairs = [(r, (r + 1) % P) for r in range(P)]
+    win.accumulate(mine, pairs, op=ops.SUM)
+    win.accumulate(mine, pairs, op=ops.SUM)
+    win.fence()
+    return win.local
+
+
+def get_after_put_prog(comm):
+    """A get in the same epoch observes the epoch's puts (the documented
+    refinement)."""
+    win = comm.win_create(np.zeros((), np.float32))
+    val = np.float32(10.0) * comm.rank
+    put_pairs = [(r, (r + 1) % P) for r in range(P)]
+    get_pairs = [((r + 1) % P, r) for r in range(P)]  # read it back
+    win.put(val, put_pairs)
+    fut = win.get(get_pairs, fill=-1.0)
+    win.fence()
+    return fut.value
+
+
+def multi_epoch_prog(comm):
+    """Fences separate epochs; window state persists across them."""
+    win = comm.win_create(np.zeros(2, np.float32))
+    one = comm.localize(np.ones(2, np.float32))
+    all_self = [(r, r) for r in range(P)]
+    win.accumulate(one, all_self)
+    win.fence()
+    win.accumulate(one, all_self)
+    win.fence()
+    return win.local
+
+
+def loc_prog(comm):
+    """Sub-window addressing with a static loc."""
+    win = comm.win_create(np.zeros(4, np.float32))
+    v = np.ones(2, np.float32) * (comm.rank + 1)
+    pairs = [(r, (r + 1) % P) for r in range(P)]
+    win.put(v, pairs, loc=np.s_[1:3])
+    win.fence()
+    return win.local
+
+
+RING_PUT_EXPECT = np.stack(
+    [np.full(3, float((r - 1) % P) + 1.0, np.float32) for r in range(P)])
+
+
+@pytest.mark.parametrize("prog,expect", [
+    (ring_put_prog, RING_PUT_EXPECT),
+    (accumulate_prog, np.stack(
+        [1.0 + 2.0 * float((r - 1) % P) * np.ones(2, np.float32)
+         for r in range(P)])),
+    (get_after_put_prog, np.array(
+        [float(r) * 10.0 for r in range(P)], np.float32)),
+    (multi_epoch_prog, np.full((P, 2), 2.0, np.float32)),
+    (loc_prog, np.stack(
+        [np.array([0, (r - 1) % P + 1, (r - 1) % P + 1, 0], np.float32)
+         for r in range(P)])),
+])
+def test_rma_parity_local_vs_spmd(prog, expect):
+    got_local = np.stack([np.asarray(x) for x in run_local(prog, P)])
+    got_spmd = np.stack([np.asarray(x) for x in run_spmd(prog, nranks=P)])
+    np.testing.assert_allclose(got_local, np.asarray(expect), rtol=0, atol=0)
+    np.testing.assert_allclose(got_spmd, np.asarray(expect), rtol=0, atol=0)
+
+
+# -- process-backend-only behaviors ----------------------------------------
+
+
+def test_rma_dynamic_int_target_local():
+    """Classic rank-dynamic MPI RMA (int target) on the process backend."""
+
+    def prog(comm):
+        win = comm.win_create(np.zeros(1, np.float64))
+        if comm.rank != 0:
+            win.accumulate(np.array([float(comm.rank)]), 0)  # all into rank 0
+        win.fence()
+        return win.local[0]
+
+    res = run_local(prog, P)
+    assert res[0] == sum(range(1, P))
+    assert all(res[r] == 0.0 for r in range(1, P))
+
+
+def test_rma_dynamic_get_local():
+    def prog(comm):
+        win = comm.win_create(np.array([comm.rank * 2.0]))
+        fut = win.get((comm.rank + 1) % comm.size)  # read right neighbor
+        win.fence()
+        return fut.value[0]
+
+    res = run_local(prog, P)
+    assert res == [((r + 1) % P) * 2.0 for r in range(P)]
+
+
+def test_get_future_before_fence_raises():
+    def prog(comm):
+        win = comm.win_create(np.zeros(1))
+        fut = win.get((comm.rank + 1) % comm.size)
+        with pytest.raises(RuntimeError, match="closing fence"):
+            _ = fut.value
+        win.fence()
+        return fut.value is not None
+
+    assert all(run_local(prog, 2))
+
+
+def test_freed_window_rejected():
+    def prog(comm):
+        win = comm.win_create(np.zeros(1))
+        win.fence()
+        win.free()
+        with pytest.raises(RuntimeError, match="freed"):
+            win.fence()
+        return True
+
+    assert all(run_local(prog, 2))
+
+
+# -- SPMD-only diagnostics --------------------------------------------------
+
+
+def test_spmd_rejects_dynamic_int_target():
+    def prog(comm):
+        win = comm.win_create(np.zeros(1, np.float32))
+        try:
+            win.put(np.ones(1, np.float32), 0)
+        except SpmdSemanticsError:
+            return comm.rank * 0 + 1
+        return comm.rank * 0
+
+    assert np.all(np.asarray(run_spmd(prog, nranks=P)) == 1)
+
+
+def test_spmd_rma_inside_jit_compiles_once():
+    """The whole epoch lowers into one jitted program."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as Pspec
+
+    from mpi_tpu.tpu import TpuCommunicator, default_mesh
+
+    mesh = default_mesh(P)
+    comm = TpuCommunicator("world", mesh)
+
+    def step(x):
+        win = comm.win_create(x)
+        win.accumulate(x, [(r, (r + 1) % P) for r in range(P)])
+        win.fence()
+        return win.local
+
+    f = jax.jit(jax.shard_map(step, mesh=mesh, in_specs=Pspec("world"),
+                              out_specs=Pspec("world")))
+    x = jnp.arange(P * 2, dtype=jnp.float32).reshape(P, 2)
+    out = np.asarray(f(x))
+    expect = x + np.roll(np.asarray(x), 1, axis=0)
+    np.testing.assert_allclose(out, expect)
+
+
+def test_two_windows_interleaved_epochs_race():
+    """Regression: a fast rank's next fence (second window, same epoch
+    number) must not be consumed by a slow peer's current fence — phase-2
+    receives are source-specific, not any-source."""
+    import time
+
+    def prog(comm):
+        win1 = comm.win_create(np.zeros(2, np.float64))
+        win2 = comm.win_create(np.zeros(2, np.float64))
+        Pn = comm.size
+        ring = [(r, (r + 1) % Pn) for r in range(Pn)]
+        win1.put(np.full(2, comm.rank + 1.0), ring)
+        win1.fence()
+        if comm.rank == 1:
+            time.sleep(0.05)  # skew: rank 1 lags between the two fences
+        win2.put(np.full(2, comm.rank + 10.0), ring)
+        win2.fence()
+        return float(win1.local[0]), float(win2.local[0])
+
+    res = run_local(prog, P)
+    for r in range(P):
+        assert res[r] == ((r - 1) % P + 1.0, (r - 1) % P + 10.0), (r, res[r])
