@@ -76,13 +76,16 @@ def init(backend: Optional[str] = None) -> Communicator:
         backend = backend or os.environ.get(_ENV_BACKEND) or (
             "socket" if _ENV_RANK in os.environ else "self"
         )
-        if backend == "socket":
+        if backend in ("socket", "shm"):
             rank = int(os.environ[_ENV_RANK])
             size = int(os.environ[_ENV_SIZE])
             rdv = os.environ[_ENV_RDV]
-            from .transport.socket import SocketTransport
+            if backend == "socket":
+                from .transport.socket import SocketTransport as _T
+            else:
+                from .transport.shm import ShmTransport as _T
 
-            t = SocketTransport(rank, size, rdv)
+            t = _T(rank, size, rdv)
             _world = P2PCommunicator(t, range(size))
         elif backend in ("self", "local"):
             from .transport.local import LocalTransport, LocalWorld
@@ -134,7 +137,7 @@ def run(
     backend = backend or os.environ.get(_ENV_BACKEND) or (
         "socket" if _ENV_RANK in os.environ else "local"
     )
-    if backend in ("socket", "self"):
+    if backend in ("socket", "shm", "self"):
         return fn(init(backend), *args, **kwargs)
     if backend == "local":
         if nranks is None:

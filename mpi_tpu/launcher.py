@@ -33,10 +33,21 @@ def launch(
     argv: Sequence[str],
     env_extra: Optional[dict] = None,
     timeout: Optional[float] = None,
+    backend: Optional[str] = None,
 ) -> int:
-    """Run ``python argv...`` as ``nranks`` rank processes; return exit code."""
+    """Run ``python argv...`` as ``nranks`` rank processes; return exit code.
+
+    ``backend`` picks the rank transport ('socket' or 'shm'); default is the
+    MPI_TPU_BACKEND env var, then 'socket'."""
     if nranks < 1:
         raise ValueError(f"nranks must be >= 1, got {nranks}")
+    backend = backend or os.environ.get(ENV_BACKEND, "socket")
+    if backend == "shm":
+        # compile the native ring once, up front, instead of N ranks racing
+        # to the flock at import time
+        from .native import ensure_built
+
+        ensure_built()
     rdv = tempfile.mkdtemp(prefix="mpi_tpu_rdv_")
     procs: List[subprocess.Popen] = []
     try:
@@ -47,7 +58,7 @@ def launch(
                     ENV_RANK: str(r),
                     ENV_SIZE: str(nranks),
                     ENV_RDV: rdv,
-                    ENV_BACKEND: env.get(ENV_BACKEND, "socket"),
+                    ENV_BACKEND: backend,
                 }
             )
             if env_extra:
@@ -68,7 +79,23 @@ def launch(
             time.sleep(0.02)
     finally:
         _kill_all(procs)
+        _cleanup_shm(rdv)
         shutil.rmtree(rdv, ignore_errors=True)
+
+
+def _cleanup_shm(rdv: str) -> None:
+    """Unlink any shm ring segments a crashed rank left behind (ranks unlink
+    their own rings on clean close; this is the crash path)."""
+    import glob
+
+    from .transport.shm import shm_prefix
+
+    session = os.path.basename(rdv.rstrip("/"))
+    for path in glob.glob("/dev/shm/" + shm_prefix(session) + "*"):
+        try:
+            os.unlink(path)
+        except OSError:
+            pass
 
 
 def _kill_all(procs: List[subprocess.Popen]) -> None:
@@ -92,11 +119,14 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                         help="number of rank processes")
     parser.add_argument("--timeout", type=float, default=None,
                         help="kill all ranks after this many seconds")
+    parser.add_argument("--backend", choices=("socket", "shm"), default=None,
+                        help="rank transport (default: MPI_TPU_BACKEND or socket)")
     parser.add_argument("script", help="python script to run on every rank")
     parser.add_argument("script_args", nargs=argparse.REMAINDER,
                         help="arguments passed to the script")
     args = parser.parse_args(argv)
-    return launch(args.nranks, [args.script, *args.script_args], timeout=args.timeout)
+    return launch(args.nranks, [args.script, *args.script_args],
+                  timeout=args.timeout, backend=args.backend)
 
 
 if __name__ == "__main__":

@@ -1,0 +1,12 @@
+"""Native (C++) components and their build/load machinery.
+
+The framework's CPU data plane follows the reference's architecture
+(Python transports behind the Communicator plugin boundary) but adds a
+native shared-memory ring (shmring.cpp) as the fast same-host path —
+the role CUDA/NCCL-style native code plays in GPU frameworks is played
+here by XLA/ICI on the TPU side and by this ring on the host side.
+"""
+
+from .build import ensure_built, load_shmring
+
+__all__ = ["ensure_built", "load_shmring"]

@@ -1,0 +1,98 @@
+"""Build-on-first-use for the native shm ring (g++ -> _shmring.so).
+
+Many rank processes may import concurrently (the launcher spawns them in a
+burst), so the compile is serialized with an exclusive flock and lands via
+atomic rename; losers of the race find the finished .so.  The .so is cached
+next to the source and rebuilt whenever shmring.cpp is newer.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import fcntl
+import os
+import subprocess
+import tempfile
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_DIR, "shmring.cpp")
+_SO = os.path.join(_DIR, "_shmring.so")
+
+_lib = None
+
+
+class NativeBuildError(RuntimeError):
+    pass
+
+
+def ensure_built() -> str:
+    """Compile shmring.cpp if needed; return the path to the .so."""
+    if os.path.exists(_SO) and os.path.getmtime(_SO) >= os.path.getmtime(_SRC):
+        return _SO
+    lock_path = os.path.join(_DIR, ".build.lock")
+    with open(lock_path, "w") as lock:
+        fcntl.flock(lock, fcntl.LOCK_EX)
+        try:
+            if (os.path.exists(_SO)
+                    and os.path.getmtime(_SO) >= os.path.getmtime(_SRC)):
+                return _SO  # another process built it while we waited
+            fd, tmp = tempfile.mkstemp(suffix=".so", dir=_DIR)
+            os.close(fd)
+            cmd = ["g++", "-O3", "-std=c++17", "-shared", "-fPIC",
+                   "-o", tmp, _SRC, "-pthread"]
+            try:
+                proc = subprocess.run(cmd, capture_output=True, text=True)
+            except FileNotFoundError as e:
+                os.unlink(tmp)
+                raise NativeBuildError(
+                    "g++ not found; the shm backend needs the native "
+                    "toolchain (fall back to backend=socket)") from e
+            if proc.returncode != 0:
+                os.unlink(tmp)
+                raise NativeBuildError(
+                    f"shmring.cpp failed to compile:\n{proc.stderr[-2000:]}")
+            os.replace(tmp, _SO)
+            return _SO
+        finally:
+            fcntl.flock(lock, fcntl.LOCK_UN)
+
+
+def load_shmring() -> ctypes.CDLL:
+    """Load (building if necessary) and type the native library."""
+    global _lib
+    if _lib is not None:
+        return _lib
+    lib = ctypes.CDLL(ensure_built())
+    lib.shmring_create.restype = ctypes.c_void_p
+    lib.shmring_create.argtypes = [ctypes.c_char_p, ctypes.c_uint64]
+    lib.shmring_open.restype = ctypes.c_void_p
+    lib.shmring_open.argtypes = [ctypes.c_char_p, ctypes.c_double]
+    lib.shmring_avail.restype = ctypes.c_uint64
+    lib.shmring_avail.argtypes = [ctypes.c_void_p]
+    lib.shmring_write.restype = ctypes.c_int
+    lib.shmring_write.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                  ctypes.c_uint64, ctypes.c_double]
+    lib.shmring_read.restype = ctypes.c_int
+    lib.shmring_read.argtypes = [ctypes.c_void_p, ctypes.c_void_p,
+                                 ctypes.c_uint64, ctypes.c_double]
+    lib.shmring_close.restype = None
+    lib.shmring_close.argtypes = [ctypes.c_void_p]
+    lib.shmring_unlink.restype = ctypes.c_int
+    lib.shmring_unlink.argtypes = [ctypes.c_char_p]
+    lib.shmdb_create.restype = ctypes.c_void_p
+    lib.shmdb_create.argtypes = [ctypes.c_char_p]
+    lib.shmdb_open.restype = ctypes.c_void_p
+    lib.shmdb_open.argtypes = [ctypes.c_char_p, ctypes.c_double]
+    lib.shmdb_read.restype = ctypes.c_uint32
+    lib.shmdb_read.argtypes = [ctypes.c_void_p]
+    lib.shmdb_ring.restype = None
+    lib.shmdb_ring.argtypes = [ctypes.c_void_p]
+    lib.shmdb_wait.restype = ctypes.c_uint32
+    lib.shmdb_wait.argtypes = [ctypes.c_void_p, ctypes.c_uint32,
+                               ctypes.c_double]
+    lib.shmdb_close.restype = None
+    lib.shmdb_close.argtypes = [ctypes.c_void_p]
+    lib.shmdb_unlink.restype = ctypes.c_int
+    lib.shmdb_unlink.argtypes = [ctypes.c_char_p]
+    _lib = lib
+    return lib

@@ -1,0 +1,361 @@
+// Native data plane for the same-host transport: a single-producer /
+// single-consumer byte ring in POSIX shared memory, one ring per directed
+// rank pair, plus a per-receiver futex "doorbell" so a reader waiting on
+// many rings sleeps in the kernel and is woken by any sender — the same
+// wake-on-arrival behavior a blocking socket recv() gets, without the TCP
+// stack on the data path (mpi_tpu/transport/shm.py owns the protocol).
+//
+// Design notes:
+// * head/tail are monotonic byte counters (never wrapped), so fullness is
+//   simply head - tail; positions wrap with % capacity.
+// * Both write and read STREAM in available-space chunks, so frames larger
+//   than the ring capacity flow through without deadlock (the Python layer
+//   prefixes each frame with its length and reads exactly that many bytes).
+// * Empty/full waits are futexes on 32-bit seq words in the shared header
+//   (wseq bumps per produced chunk, rseq per consumed chunk); wakes are
+//   issued only when the waiter counter says someone is sleeping, so the
+//   uncontended path stays syscall-free.
+// * The consumer creates the ring (unlinking any stale segment first) and
+//   flips `magic` last with release ordering; producers open-and-wait.
+//
+// Built by mpi_tpu/native/build.py:  g++ -O3 -std=c++17 -shared -fPIC
+
+#include <atomic>
+#include <cerrno>
+#include <cstdint>
+#include <cstdlib>
+#include <cstring>
+#include <ctime>
+
+#include <fcntl.h>
+#include <linux/futex.h>
+#include <sched.h>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <sys/syscall.h>
+#include <unistd.h>
+
+namespace {
+
+constexpr uint32_t kMagic = 0x4D505452;  // "MPTR"
+constexpr size_t kDataOffset = 64;       // keep data cache-line separated
+
+struct Header {
+  std::atomic<uint64_t> head;   // total bytes written
+  std::atomic<uint64_t> tail;   // total bytes read
+  uint64_t capacity;
+  std::atomic<uint32_t> magic;
+  std::atomic<uint32_t> wseq;     // bumped per produced chunk
+  std::atomic<uint32_t> rseq;     // bumped per consumed chunk
+  std::atomic<uint32_t> wwait;    // sleepers on wseq (the reader)
+  std::atomic<uint32_t> rwait;    // sleepers on rseq (the writer)
+};
+static_assert(sizeof(Header) <= kDataOffset, "header must fit the pad");
+
+struct Ring {
+  Header* h;
+  uint8_t* data;
+  size_t maplen;
+  int fd;
+};
+
+double now_s() {
+  struct timespec ts;
+  clock_gettime(CLOCK_MONOTONIC, &ts);
+  return ts.tv_sec + ts.tv_nsec * 1e-9;
+}
+
+long sys_futex(std::atomic<uint32_t>* uaddr, int op, uint32_t val,
+               const struct timespec* timeout) {
+  return syscall(SYS_futex, (uint32_t*)uaddr, op, val, timeout, nullptr, 0);
+}
+
+// Sleep until *seq != seen or the step timeout elapses.  `waiters` is the
+// matching sleeper counter.  Returns false iff `deadline` (absolute,
+// negative = never) has passed.  The wait covers the full remaining time
+// (capped at 250ms as a lost-wakeup safety net) so an idle waiter costs
+// ~4 syscalls/s, not a poll loop.
+bool futex_wait_step(std::atomic<uint32_t>* seq, uint32_t seen,
+                     std::atomic<uint32_t>* waiters, double deadline) {
+  double remain = deadline < 0 ? 0.25 : deadline - now_s();
+  if (remain <= 0) return false;
+  if (remain > 0.25) remain = 0.25;
+  struct timespec ts;
+  ts.tv_sec = (time_t)remain;
+  ts.tv_nsec = (long)((remain - ts.tv_sec) * 1e9);
+  waiters->fetch_add(1, std::memory_order_seq_cst);
+  if (seq->load(std::memory_order_seq_cst) == seen) {
+    sys_futex(seq, FUTEX_WAIT, seen, &ts);
+  }
+  waiters->fetch_sub(1, std::memory_order_seq_cst);
+  return deadline < 0 || now_s() < deadline;
+}
+
+void bump_and_wake(std::atomic<uint32_t>* seq, std::atomic<uint32_t>* waiters) {
+  seq->fetch_add(1, std::memory_order_seq_cst);
+  if (waiters->load(std::memory_order_seq_cst) != 0) {
+    sys_futex(seq, FUTEX_WAKE, INT32_MAX, nullptr);
+  }
+}
+
+// Plain polling step for the setup paths (segment not yet mapped).
+bool poll_step(int& spins, double deadline) {
+  if (spins < 64) {
+    ++spins;
+    sched_yield();
+  } else {
+    struct timespec ts = {0, 200 * 1000};  // 200us
+    nanosleep(&ts, nullptr);
+  }
+  return deadline < 0 || now_s() < deadline;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Consumer side: (re)create the segment and initialize the header.
+void* shmring_create(const char* name, uint64_t capacity) {
+  shm_unlink(name);  // stale segment from a crashed run, if any
+  int fd = shm_open(name, O_CREAT | O_EXCL | O_RDWR, 0600);
+  if (fd < 0) return nullptr;
+  size_t maplen = kDataOffset + capacity;
+  if (ftruncate(fd, (off_t)maplen) != 0) {
+    close(fd);
+    shm_unlink(name);
+    return nullptr;
+  }
+  void* mem = mmap(nullptr, maplen, PROT_READ | PROT_WRITE, MAP_SHARED, fd, 0);
+  if (mem == MAP_FAILED) {
+    close(fd);
+    shm_unlink(name);
+    return nullptr;
+  }
+  Ring* r = new Ring;
+  r->h = (Header*)mem;
+  r->data = (uint8_t*)mem + kDataOffset;
+  r->maplen = maplen;
+  r->fd = fd;
+  memset(mem, 0, sizeof(Header));
+  r->h->capacity = capacity;
+  r->h->magic.store(kMagic, std::memory_order_release);
+  return r;
+}
+
+// Producer side: open an existing segment, waiting up to timeout_s for the
+// consumer to create and initialize it.
+void* shmring_open(const char* name, double timeout_s) {
+  double deadline = timeout_s < 0 ? -1.0 : now_s() + timeout_s;
+  int fd = -1;
+  int spins = 0;
+  for (;;) {
+    fd = shm_open(name, O_RDWR, 0600);
+    if (fd >= 0) break;
+    if (errno != ENOENT || !poll_step(spins, deadline)) return nullptr;
+  }
+  struct stat st;  // wait for the consumer's ftruncate
+  spins = 0;
+  for (;;) {
+    if (fstat(fd, &st) != 0) {
+      close(fd);
+      return nullptr;
+    }
+    if ((size_t)st.st_size > kDataOffset) break;
+    if (!poll_step(spins, deadline)) {
+      close(fd);
+      return nullptr;
+    }
+  }
+  size_t maplen = (size_t)st.st_size;
+  void* mem = mmap(nullptr, maplen, PROT_READ | PROT_WRITE, MAP_SHARED, fd, 0);
+  if (mem == MAP_FAILED) {
+    close(fd);
+    return nullptr;
+  }
+  Header* h = (Header*)mem;
+  spins = 0;
+  while (h->magic.load(std::memory_order_acquire) != kMagic) {
+    if (!poll_step(spins, deadline)) {
+      munmap(mem, maplen);
+      close(fd);
+      return nullptr;
+    }
+  }
+  Ring* r = new Ring;
+  r->h = h;
+  r->data = (uint8_t*)mem + kDataOffset;
+  r->maplen = maplen;
+  r->fd = fd;
+  return r;
+}
+
+uint64_t shmring_avail(void* ring) {
+  Ring* r = (Ring*)ring;
+  return r->h->head.load(std::memory_order_acquire) -
+         r->h->tail.load(std::memory_order_relaxed);
+}
+
+// Stream n bytes into the ring; 0 on success, -1 on timeout.
+int shmring_write(void* ring, const void* buf, uint64_t n, double timeout_s) {
+  Ring* r = (Ring*)ring;
+  Header* h = r->h;
+  const uint8_t* src = (const uint8_t*)buf;
+  const uint64_t cap = h->capacity;
+  uint64_t head = h->head.load(std::memory_order_relaxed);
+  uint64_t done = 0;
+  double deadline = timeout_s < 0 ? -1.0 : now_s() + timeout_s;
+  while (done < n) {
+    uint32_t seen = h->rseq.load(std::memory_order_seq_cst);
+    uint64_t tail = h->tail.load(std::memory_order_acquire);
+    uint64_t space = cap - (head - tail);
+    if (space == 0) {
+      if (!futex_wait_step(&h->rseq, seen, &h->rwait, deadline)) return -1;
+      continue;
+    }
+    uint64_t pos = head % cap;
+    uint64_t chunk = n - done;
+    if (chunk > space) chunk = space;
+    if (chunk > cap - pos) chunk = cap - pos;  // contiguous run
+    memcpy(r->data + pos, src + done, chunk);
+    done += chunk;
+    head += chunk;
+    h->head.store(head, std::memory_order_release);
+    bump_and_wake(&h->wseq, &h->wwait);
+  }
+  return 0;
+}
+
+// Stream exactly n bytes out of the ring; 0 on success, -1 on timeout.
+int shmring_read(void* ring, void* buf, uint64_t n, double timeout_s) {
+  Ring* r = (Ring*)ring;
+  Header* h = r->h;
+  uint8_t* dst = (uint8_t*)buf;
+  const uint64_t cap = h->capacity;
+  uint64_t tail = h->tail.load(std::memory_order_relaxed);
+  uint64_t done = 0;
+  double deadline = timeout_s < 0 ? -1.0 : now_s() + timeout_s;
+  while (done < n) {
+    uint32_t seen = h->wseq.load(std::memory_order_seq_cst);
+    uint64_t head = h->head.load(std::memory_order_acquire);
+    uint64_t avail = head - tail;
+    if (avail == 0) {
+      if (!futex_wait_step(&h->wseq, seen, &h->wwait, deadline)) return -1;
+      continue;
+    }
+    uint64_t pos = tail % cap;
+    uint64_t chunk = n - done;
+    if (chunk > avail) chunk = avail;
+    if (chunk > cap - pos) chunk = cap - pos;
+    memcpy(dst + done, r->data + pos, chunk);
+    done += chunk;
+    tail += chunk;
+    h->tail.store(tail, std::memory_order_release);
+    bump_and_wake(&h->rseq, &h->rwait);
+  }
+  return 0;
+}
+
+void shmring_close(void* ring) {
+  Ring* r = (Ring*)ring;
+  munmap((void*)r->h, r->maplen);
+  close(r->fd);
+  delete r;
+}
+
+int shmring_unlink(const char* name) { return shm_unlink(name); }
+
+// ---- doorbell: one futex seq per receiving rank ---------------------------
+// Senders ring it after delivering a complete frame into any of the
+// receiver's rings; the receiver's reader thread sleeps here when all its
+// rings are empty.  Layout: [magic][seq][waiters].
+
+struct Doorbell {
+  std::atomic<uint32_t> magic;
+  std::atomic<uint32_t> seq;
+  std::atomic<uint32_t> waiters;
+};
+
+void* shmdb_create(const char* name) {
+  shm_unlink(name);
+  int fd = shm_open(name, O_CREAT | O_EXCL | O_RDWR, 0600);
+  if (fd < 0) return nullptr;
+  if (ftruncate(fd, sizeof(Doorbell)) != 0) {
+    close(fd);
+    shm_unlink(name);
+    return nullptr;
+  }
+  void* mem = mmap(nullptr, sizeof(Doorbell), PROT_READ | PROT_WRITE,
+                   MAP_SHARED, fd, 0);
+  close(fd);
+  if (mem == MAP_FAILED) {
+    shm_unlink(name);
+    return nullptr;
+  }
+  Doorbell* d = (Doorbell*)mem;
+  d->seq.store(0, std::memory_order_relaxed);
+  d->waiters.store(0, std::memory_order_relaxed);
+  d->magic.store(kMagic, std::memory_order_release);
+  return d;
+}
+
+void* shmdb_open(const char* name, double timeout_s) {
+  double deadline = timeout_s < 0 ? -1.0 : now_s() + timeout_s;
+  int fd = -1;
+  int spins = 0;
+  for (;;) {
+    fd = shm_open(name, O_RDWR, 0600);
+    if (fd >= 0) break;
+    if (errno != ENOENT || !poll_step(spins, deadline)) return nullptr;
+  }
+  struct stat st;
+  spins = 0;
+  for (;;) {
+    if (fstat(fd, &st) != 0) {
+      close(fd);
+      return nullptr;
+    }
+    if ((size_t)st.st_size >= sizeof(Doorbell)) break;
+    if (!poll_step(spins, deadline)) {
+      close(fd);
+      return nullptr;
+    }
+  }
+  void* mem = mmap(nullptr, sizeof(Doorbell), PROT_READ | PROT_WRITE,
+                   MAP_SHARED, fd, 0);
+  close(fd);
+  if (mem == MAP_FAILED) return nullptr;
+  Doorbell* d = (Doorbell*)mem;
+  spins = 0;
+  while (d->magic.load(std::memory_order_acquire) != kMagic) {
+    if (!poll_step(spins, deadline)) {
+      munmap(mem, sizeof(Doorbell));
+      return nullptr;
+    }
+  }
+  return d;
+}
+
+uint32_t shmdb_read(void* db) {
+  return ((Doorbell*)db)->seq.load(std::memory_order_seq_cst);
+}
+
+void shmdb_ring(void* db) {
+  Doorbell* d = (Doorbell*)db;
+  bump_and_wake(&d->seq, &d->waiters);
+}
+
+// Sleep until seq != seen (or timeout); returns the current seq.
+uint32_t shmdb_wait(void* db, uint32_t seen, double timeout_s) {
+  Doorbell* d = (Doorbell*)db;
+  double deadline = timeout_s < 0 ? -1.0 : now_s() + timeout_s;
+  while (d->seq.load(std::memory_order_seq_cst) == seen) {
+    if (!futex_wait_step(&d->seq, seen, &d->waiters, deadline)) break;
+  }
+  return d->seq.load(std::memory_order_seq_cst);
+}
+
+void shmdb_close(void* db) { munmap(db, sizeof(Doorbell)); }
+
+int shmdb_unlink(const char* name) { return shm_unlink(name); }
+
+}  // extern "C"

@@ -1,0 +1,430 @@
+"""Shared-memory transport: the native same-host data plane.
+
+Same plugin seam as the socket transport (SURVEY.md §1 L1), different
+substrate: one native SPSC byte ring in POSIX shared memory per directed
+rank pair (mpi_tpu/native/shmring.cpp), no syscalls on the data path —
+a `memcpy` into the ring replaces the TCP stack.  Frames are
+``<u64 length><pickle(ctx, tag, obj)>``; the C side streams in chunks, so
+frames larger than the ring capacity flow without deadlock.
+
+Topology/ownership: every rank CREATES its P−1 incoming rings plus one
+futex *doorbell* at startup (consumer-owned; stale segments from crashed
+runs are unlinked first) and signals readiness through the rendezvous dir;
+senders open the peer's ring + doorbell on first send and ring the bell
+once the frame header is visible (see ``send`` for why the bell cannot
+wait for the full frame).
+
+Progress model: INLINE, like an MPI progress engine — whichever thread is
+blocked in ``recv``/``probe`` drains the rings itself, sleeping directly on
+the futex doorbell when they are empty.  A message therefore takes exactly
+one kernel wakeup (sender → receiving thread), with no intermediate reader
+thread hop; that is the latency edge over the socket transport, whose
+receiver pays reader-thread → condvar → user thread.  Threads that lose
+the progress-lock race fall back to waiting on the shared Mailbox, which
+the progressing thread feeds — matching semantics stay identical to every
+other CPU transport.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import pickle
+import struct
+import threading
+import time
+from typing import Any, Dict, Optional, Tuple
+
+from ..native import load_shmring
+from .base import ANY_SOURCE, Mailbox, RecvTimeout, Transport, TransportError
+
+_LEN = struct.Struct("<Q")
+_RING_BYTES = int(os.environ.get("MPI_TPU_SHM_RING_BYTES", 4 << 20))
+_OPEN_TIMEOUT = 60.0
+_WRITE_TIMEOUT = 120.0
+_PROGRESS_SLICE = 0.25  # max doorbell nap; re-checks deadline/closing
+
+
+def shm_prefix(session: str) -> str:
+    """Common /dev/shm name prefix of every segment of one session — the
+    launcher's crash-path cleanup globs on this, so the naming scheme lives
+    in exactly one place."""
+    return f"mt_{session}_"
+
+
+def _ring_name(session: str, src: int, dst: int) -> bytes:
+    # /dev/shm names: <=255 chars, one leading slash
+    return f"/{shm_prefix(session)}{src}_{dst}".encode()
+
+
+def _db_name(session: str, rank: int) -> bytes:
+    return f"/{shm_prefix(session)}db_{rank}".encode()
+
+
+class ShmTransport(Transport):
+    def __init__(self, rank: int, size: int, rdv_dir: str,
+                 ring_bytes: int = _RING_BYTES,
+                 connect_timeout: float = _OPEN_TIMEOUT) -> None:
+        super().__init__(rank, size)
+        self._lib = load_shmring()
+        self._session = os.path.basename(rdv_dir.rstrip("/"))
+        self._rdv = rdv_dir
+        self._connect_timeout = connect_timeout
+        self._ring_bytes = ring_bytes
+        self._closing = False
+
+        # consumer side: create my incoming rings + doorbell, then publish
+        self._in_rings: Dict[int, int] = {}
+        for src in range(size):
+            if src == rank:
+                continue
+            name = _ring_name(self._session, src, rank)
+            ring = self._lib.shmring_create(name, ring_bytes)
+            if not ring:
+                raise TransportError(
+                    f"rank {rank}: shmring_create({name!r}) failed")
+            self._in_rings[src] = ring
+        self._in_items = list(self._in_rings.items())
+        self._db = self._lib.shmdb_create(_db_name(self._session, rank))
+        if not self._db:
+            raise TransportError(f"rank {rank}: doorbell create failed")
+        tmp = os.path.join(rdv_dir, f".shm.{rank}.tmp")
+        with open(tmp, "w") as f:
+            f.write("ready")
+        os.replace(tmp, os.path.join(rdv_dir, f"shm.{rank}"))
+
+        # producer side: outgoing rings + doorbells open lazily on first send
+        self._out_rings: Dict[int, int] = {}
+        self._out_dbs: Dict[int, int] = {}
+        self._send_locks: Dict[int, threading.Lock] = {}
+        self._state_lock = threading.Lock()
+        # exactly one thread runs the progress engine at a time
+        self._progress_lock = threading.Lock()
+        # guards doorbell use on the lock-contended wait path (close()
+        # munmaps the doorbell under this, so no thread can be inside a
+        # shmdb_* call on freed memory)
+        self._db_lock = threading.Lock()
+        # Helper drainer: guarantees the buffered-send invariant
+        # (communicator.py: "transports buffer sends and drain receives on
+        # dedicated threads") even when NO thread of this rank is in recv —
+        # e.g. two ranks symmetric-sendrecv'ing frames bigger than the free
+        # ring space would otherwise deadlock in their sends.  It defers to
+        # user threads: it only drains when the progress lock is free.
+        self._user_waiters = 0  # hint: user threads inside _blocking_match
+        self._helper = threading.Thread(
+            target=self._helper_loop, name=f"mpi-tpu-shm-helper-{rank}",
+            daemon=True)
+        self._helper.start()
+
+    # -- progress engine (incoming) ----------------------------------------
+
+    def _helper_loop(self) -> None:
+        while not self._closing:
+            # Last-resort drainer only: while any user thread is receiving,
+            # IT owns the progress engine (one-wakeup latency path) and the
+            # helper must not steal the lock out from under it.
+            if self._user_waiters > 0:
+                time.sleep(0.05)
+                continue
+            if self._progress_lock.acquire(timeout=0.05):
+                try:
+                    if self._closing:
+                        return
+                    if self._user_waiters == 0:
+                        self._progress_wait(_PROGRESS_SLICE)
+                except TransportError:
+                    # _drain_once closed the mailbox, so every blocked
+                    # receiver sees the diagnosis; the helper's job here
+                    # is done — a dead peer means no more progress.
+                    return
+                finally:
+                    self._progress_lock.release()
+            else:
+                time.sleep(0.05)
+
+    def _drain_once(self) -> bool:
+        """Pull every complete-or-started frame out of the rings into the
+        Mailbox.  Returns True if anything was delivered.  Caller holds the
+        progress lock."""
+        lib = self._lib
+        progressed = False
+        for src, ring in self._in_items:
+            while lib.shmring_avail(ring) >= _LEN.size:
+                buf = ctypes.create_string_buffer(_LEN.size)
+                if lib.shmring_read(ring, buf, _LEN.size, _WRITE_TIMEOUT) != 0:
+                    self.mailbox.close()  # failure must reach blocked recvs
+                    raise TransportError(
+                        f"rank {self.world_rank}: header read from {src} "
+                        f"timed out")
+                (nbytes,) = _LEN.unpack(buf.raw)
+                payload = ctypes.create_string_buffer(nbytes)
+                # the sender streams; the in-C read futex-handshakes with it
+                if lib.shmring_read(ring, payload, nbytes,
+                                    _WRITE_TIMEOUT) != 0:
+                    self.mailbox.close()
+                    raise TransportError(
+                        f"rank {self.world_rank}: truncated frame from {src}")
+                try:
+                    ctx, tag, obj = pickle.loads(payload.raw)
+                except Exception as e:  # noqa: BLE001 - deliver the diagnosis
+                    self.mailbox.close()
+                    raise TransportError(
+                        f"rank {self.world_rank}: bad frame from {src}: {e}")
+                self.mailbox.deliver(src, ctx, tag, obj)
+                progressed = True
+        if progressed and self._db is not None:
+            # Local delivery-ring: threads that lost the progress-lock race
+            # wait on the doorbell (not the mailbox cv), so tell them their
+            # message may have landed.  One futex op, only on delivery.
+            self._lib.shmdb_ring(self._db)
+        return progressed
+
+    def _progress_wait(self, slice_s: float) -> None:
+        """One blocking progress step: drain; if nothing, nap on the
+        doorbell (seqlock pattern: snapshot bell → re-scan → wait, so a
+        frame landing between scan and wait still wakes us).  Caller holds
+        the progress lock AND has checked _closing after acquiring it —
+        close() tears the mappings down under this lock, so a stale call
+        here would hand NULL/freed pointers to C."""
+        lib = self._lib
+        if self._db is None:
+            return
+        if self._drain_once():
+            return
+        seen = lib.shmdb_read(self._db)
+        if any(lib.shmring_avail(ring) >= _LEN.size
+               for _, ring in self._in_items):
+            return
+        lib.shmdb_wait(self._db, seen, slice_s)
+        # Drain whatever the bell announced BEFORE handing the lock back:
+        # if this nap was the helper's and a user thread is queued behind
+        # the lock in a mailbox wait, returning undrained would strand the
+        # wakeup until that wait's full timeout slice expired.
+        self._drain_once()
+
+    def _blocking_match(self, op: str, source: int, ctx, tag: int,
+                        timeout: Optional[float],
+                        consume: bool) -> Tuple[Any, int, int]:
+        """Shared recv/probe loop: match from the Mailbox, progressing the
+        rings inline while we wait."""
+        deadline = None if timeout is None else time.monotonic() + timeout
+        self._user_waiters += 1  # GIL-approximate hint for the helper
+        try:
+            return self._match_loop(op, source, ctx, tag, timeout, deadline,
+                                    consume)
+        finally:
+            self._user_waiters -= 1
+
+    def _match_loop(self, op, source, ctx, tag, timeout, deadline, consume):
+        while True:
+            if consume:
+                hit = self.mailbox.poll(source, ctx, tag)
+            else:
+                pk = self.mailbox.peek_nowait(source, ctx, tag)
+                hit = None if pk is None else (None, pk[0], pk[1])
+            if hit is not None:
+                return hit
+            if self._closing:
+                raise TransportError(
+                    f"transport closed while waiting for {op}"
+                    f"(source={source}, ctx={ctx}, tag={tag})")
+            remaining = (None if deadline is None
+                         else deadline - time.monotonic())
+            if remaining is not None and remaining <= 0:
+                raise RecvTimeout(
+                    f"{op}(source={source}, ctx={ctx}, tag={tag}) timed out "
+                    f"after {timeout}s; pending={self.mailbox.pending_summary()}")
+            slice_s = _PROGRESS_SLICE
+            if remaining is not None:
+                slice_s = min(slice_s, remaining)
+            if self._progress_lock.acquire(blocking=False):
+                try:
+                    if self._closing:  # close() may have won the lock race
+                        continue       # loop re-raises via the check above
+                    self._progress_wait(slice_s)
+                finally:
+                    self._progress_lock.release()
+            else:
+                # Another thread holds the progress engine.  Wait on the
+                # DOORBELL, not the mailbox cv: the bell rings both on
+                # remote arrival and on local delivery (_drain_once), so we
+                # wake for either — never stranded for a full nap slice.
+                # Seqlock: snapshot, re-poll the mailbox, then wait.  The
+                # _db_lock excludes close()'s doorbell munmap for the whole
+                # read+wait window.
+                with self._db_lock:
+                    if self._closing or self._db is None:
+                        continue  # loop re-raises via the check above
+                    seen = self._lib.shmdb_read(self._db)
+                    if consume:
+                        hit = self.mailbox.poll(source, ctx, tag)
+                        if hit is not None:
+                            return hit
+                    else:
+                        pk = self.mailbox.peek_nowait(source, ctx, tag)
+                        if pk is not None:
+                            return None, pk[0], pk[1]
+                    self._lib.shmdb_wait(self._db, seen, slice_s)
+                continue
+
+    # -- Transport interface (incoming) ------------------------------------
+
+    def recv(self, source: int, ctx, tag: int,
+             timeout: Optional[float] = None) -> Tuple[Any, int, int]:
+        return self._blocking_match("recv", source, ctx, tag, timeout, True)
+
+    def poll(self, source: int, ctx, tag: int):
+        if self._progress_lock.acquire(blocking=False):
+            try:
+                if not self._closing:
+                    self._drain_once()
+            finally:
+                self._progress_lock.release()
+        return self.mailbox.poll(source, ctx, tag)
+
+    def peek(self, source: int, ctx, tag: int,
+             timeout: Optional[float] = None) -> Tuple[int, int]:
+        _, s, t = self._blocking_match("probe", source, ctx, tag, timeout,
+                                       False)
+        return s, t
+
+    def peek_nowait(self, source: int, ctx, tag: int):
+        if self._progress_lock.acquire(blocking=False):
+            try:
+                if not self._closing:
+                    self._drain_once()
+            finally:
+                self._progress_lock.release()
+        return self.mailbox.peek_nowait(source, ctx, tag)
+
+    # -- outgoing ----------------------------------------------------------
+
+    def _send_lock(self, dest: int) -> threading.Lock:
+        with self._state_lock:
+            if self._closing:
+                raise TransportError(
+                    f"rank {self.world_rank}: send on a closed transport")
+            lock = self._send_locks.get(dest)
+            if lock is None:
+                lock = self._send_locks[dest] = threading.Lock()
+            return lock
+
+    def _out_ring_locked(self, dest: int) -> int:
+        with self._state_lock:
+            ring = self._out_rings.get(dest)
+        if ring is not None:
+            return ring
+        # wait for the peer to have created its incoming rings
+        flag = os.path.join(self._rdv, f"shm.{dest}")
+        deadline = time.monotonic() + self._connect_timeout
+        while not os.path.exists(flag):
+            if time.monotonic() > deadline:
+                raise TransportError(
+                    f"rank {self.world_rank}: peer {dest} did not publish "
+                    f"shm readiness within {self._connect_timeout}s")
+            time.sleep(0.005)
+        name = _ring_name(self._session, self.world_rank, dest)
+        ring = self._lib.shmring_open(name, self._connect_timeout)
+        if not ring:
+            raise TransportError(
+                f"rank {self.world_rank}: shmring_open({name!r}) failed")
+        db = self._lib.shmdb_open(_db_name(self._session, dest),
+                                  self._connect_timeout)
+        if not db:
+            raise TransportError(
+                f"rank {self.world_rank}: doorbell open for {dest} failed")
+        with self._state_lock:
+            self._out_rings[dest] = ring
+            self._out_dbs[dest] = db
+        return ring
+
+    def send(self, dest: int, ctx, tag: int, payload: Any) -> None:
+        if not (0 <= dest < self.world_size):
+            raise ValueError(
+                f"dest {dest} out of range for world size {self.world_size}")
+        if self._closing:
+            raise TransportError(
+                f"rank {self.world_rank}: send on a closed transport")
+        if dest == self.world_rank:
+            copy = pickle.loads(
+                pickle.dumps(payload, protocol=pickle.HIGHEST_PROTOCOL))
+            self.mailbox.deliver(dest, ctx, tag, copy)
+            return
+        blob = pickle.dumps((ctx, tag, payload),
+                            protocol=pickle.HIGHEST_PROTOCOL)
+        small = _LEN.size + len(blob) <= self._ring_bytes // 2
+        with self._send_lock(dest):
+            if self._closing:  # close() may have held this lock before us
+                raise TransportError(
+                    f"rank {self.world_rank}: send on a closed transport")
+            ring = self._out_ring_locked(dest)
+            if small:
+                # one write, one bell — the whole frame lands before the
+                # receiver needs to move
+                if self._lib.shmring_write(ring, _LEN.pack(len(blob)) + blob,
+                                           _LEN.size + len(blob),
+                                           _WRITE_TIMEOUT) != 0:
+                    raise TransportError(
+                        f"rank {self.world_rank}: send to {dest} timed out")
+                self._lib.shmdb_ring(self._out_dbs[dest])
+                return
+            # Big frame: header first, then the bell, THEN the body — the
+            # frame can only finish once the receiver drains it, so the
+            # receiver must be woken before the body write starts; its
+            # body-read then futex-handshakes with the streaming write per
+            # chunk (in-ring wseq/rseq futexes), no further bell needed.
+            # Ringing only after a full-frame write would deadlock until
+            # the receiver's nap timeout for every frame bigger than the
+            # ring.
+            if (self._lib.shmring_write(ring, _LEN.pack(len(blob)), _LEN.size,
+                                        _WRITE_TIMEOUT) != 0):
+                raise TransportError(
+                    f"rank {self.world_rank}: send header to {dest} timed out")
+            self._lib.shmdb_ring(self._out_dbs[dest])
+            if self._lib.shmring_write(ring, blob, len(blob),
+                                       _WRITE_TIMEOUT) != 0:
+                raise TransportError(
+                    f"rank {self.world_rank}: send to {dest} timed out "
+                    f"({len(blob)} bytes; ring full for {_WRITE_TIMEOUT}s — "
+                    f"is the receiver alive?)")
+
+    # -- shutdown ----------------------------------------------------------
+
+    def close(self) -> None:
+        self._closing = True
+        if self._db:
+            self._lib.shmdb_ring(self._db)  # pop any thread out of its nap
+        if self._helper.is_alive():
+            self._helper.join(timeout=2.0)
+        # exclude in-flight receivers (progress lock) AND in-flight senders
+        # (every per-dest send lock) before unmapping anything a concurrent
+        # memcpy could still be streaming into
+        with self._state_lock:
+            send_locks = list(self._send_locks.values())
+        for lock in send_locks:
+            lock.acquire()
+        try:
+            with self._progress_lock:
+                with self._state_lock:
+                    for ring in self._out_rings.values():
+                        self._lib.shmring_close(ring)
+                    for db in self._out_dbs.values():
+                        self._lib.shmdb_close(db)
+                    self._out_rings.clear()
+                    self._out_dbs.clear()
+                for src, ring in self._in_rings.items():
+                    self._lib.shmring_close(ring)
+                    self._lib.shmring_unlink(
+                        _ring_name(self._session, src, self.world_rank))
+                self._in_rings.clear()
+                self._in_items = []
+                with self._db_lock:
+                    if self._db:
+                        self._lib.shmdb_close(self._db)
+                        self._lib.shmdb_unlink(
+                            _db_name(self._session, self.world_rank))
+                        self._db = None
+        finally:
+            for lock in send_locks:
+                lock.release()
+        self.mailbox.close()
