@@ -110,7 +110,8 @@ class ShmTransport(Transport):
         # e.g. two ranks symmetric-sendrecv'ing frames bigger than the free
         # ring space would otherwise deadlock in their sends.  It defers to
         # user threads: it only drains when the progress lock is free.
-        self._user_waiters = 0  # hint: user threads inside _blocking_match
+        self._user_waiters = 0  # user threads inside _blocking_match
+        self._waiters_lock = threading.Lock()  # += is not atomic under GIL
         self._helper = threading.Thread(
             target=self._helper_loop, name=f"mpi-tpu-shm-helper-{rank}",
             daemon=True)
@@ -208,12 +209,14 @@ class ShmTransport(Transport):
         """Shared recv/probe loop: match from the Mailbox, progressing the
         rings inline while we wait."""
         deadline = None if timeout is None else time.monotonic() + timeout
-        self._user_waiters += 1  # GIL-approximate hint for the helper
+        with self._waiters_lock:
+            self._user_waiters += 1
         try:
             return self._match_loop(op, source, ctx, tag, timeout, deadline,
                                     consume)
         finally:
-            self._user_waiters -= 1
+            with self._waiters_lock:
+                self._user_waiters -= 1
 
     def _match_loop(self, op, source, ctx, tag, timeout, deadline, consume):
         while True:
@@ -359,11 +362,22 @@ class ShmTransport(Transport):
                     f"rank {self.world_rank}: send on a closed transport")
             ring = self._out_ring_locked(dest)
             if small:
-                # one write, one bell — the whole frame lands before the
-                # receiver needs to move
-                if self._lib.shmring_write(ring, _LEN.pack(len(blob)) + blob,
-                                           _LEN.size + len(blob),
-                                           _WRITE_TIMEOUT) != 0:
+                # whole frame lands before the bell.  Tiny frames concat
+                # header+blob (one C call beats a second call's overhead);
+                # beyond that the extra full-payload memcpy of the concat
+                # costs more than the call, so write header and blob
+                # separately.
+                if len(blob) <= 8192:
+                    ok = self._lib.shmring_write(
+                        ring, _LEN.pack(len(blob)) + blob,
+                        _LEN.size + len(blob), _WRITE_TIMEOUT) == 0
+                else:
+                    ok = (self._lib.shmring_write(
+                              ring, _LEN.pack(len(blob)), _LEN.size,
+                              _WRITE_TIMEOUT) == 0
+                          and self._lib.shmring_write(
+                              ring, blob, len(blob), _WRITE_TIMEOUT) == 0)
+                if not ok:
                     raise TransportError(
                         f"rank {self.world_rank}: send to {dest} timed out")
                 self._lib.shmdb_ring(self._out_dbs[dest])
