@@ -144,7 +144,10 @@ def _run_sub(code: str, env_extra: dict, timeout: float = 600.0,
             return f.read()
 
 
-def measure_socket_p50() -> float:
+def measure_process_p50(backend: str) -> float:
+    """p50 of the 2-rank 1K-f32 allreduce over real rank processes on the
+    given transport ('socket' = the reference architecture, 'shm' = the
+    native data plane)."""
     sys.path.insert(0, REPO)
     from mpi_tpu.launcher import launch
 
@@ -153,9 +156,10 @@ def measure_socket_p50() -> float:
         script = os.path.join(td, "prog.py")
         with open(script, "w") as f:
             f.write(SOCKET_PROG.format(repo=REPO))
-        rc = launch(2, [script], env_extra={"BENCH_OUT": out}, timeout=300.0)
+        rc = launch(2, [script], env_extra={"BENCH_OUT": out}, timeout=300.0,
+                    backend=backend)
         if rc != 0:
-            raise RuntimeError(f"socket bench failed with exit code {rc}")
+            raise RuntimeError(f"{backend} bench failed with exit code {rc}")
         with open(out) as f:
             return float(f.read())
 
@@ -166,8 +170,12 @@ def main() -> None:
     n_real = len(jax.devices())
     details = {"devices": [str(d) for d in jax.devices()]}
 
-    socket_us = measure_socket_p50()
+    socket_us = measure_process_p50("socket")
     details["socket_2rank_1kf32_p50_us"] = socket_us
+    try:
+        details["shm_2rank_1kf32_p50_us"] = measure_process_p50("shm")
+    except Exception as e:  # native toolchain may be absent
+        details["shm_error"] = str(e)[:200]
 
     force_cpu = "yes" if n_real < 2 else "no"
     spmd_us = float(_run_sub(

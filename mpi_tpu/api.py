@@ -29,6 +29,10 @@ __all__ = [
     "MPI_Scan", "MPI_Reduce_scatter", "MPI_Isend", "MPI_Irecv", "MPI_Wait",
     "MPI_Test", "MPI_Waitall", "MPI_Probe", "MPI_Iprobe", "MPI_Wtime",
     "MPI_Send_init", "MPI_Recv_init", "MPI_Start", "MPI_Startall",
+    "MPI_Ibcast", "MPI_Ireduce", "MPI_Iallreduce", "MPI_Iallgather",
+    "MPI_Ialltoall", "MPI_Ibarrier", "MPI_Iscatter", "MPI_Igather",
+    "MPI_Get_processor_name", "MPI_Get_version", "MPI_Abort",
+    "MPI_Wtick", "MPI_Sendrecv_replace",
     "MPI_Exscan", "MPI_Op_create", "MPI_Maxloc", "MPI_Minloc",
     "MPI_Gatherv", "MPI_Scatterv", "MPI_Allgatherv", "MPI_Alltoallv",
     "MPI_Cart_create", "MPI_Dims_create", "MPI_Cart_coords", "MPI_Cart_rank",
@@ -368,3 +372,80 @@ def MPI_Startall(requests: Sequence[Any]):
     from .communicator import startall
 
     return startall(requests)
+
+
+# -- nonblocking collectives [S: MPI-3] ------------------------------------
+
+
+def MPI_Ibcast(obj: Any, root: int = 0, comm: Optional[Communicator] = None):
+    return _world(comm).ibcast(obj, root)
+
+
+def MPI_Ireduce(obj: Any, op=ops.SUM, root: int = 0,
+                comm: Optional[Communicator] = None):
+    return _world(comm).ireduce(obj, op, root)
+
+
+def MPI_Iallreduce(obj: Any, op=ops.SUM, algorithm: str = "auto",
+                   comm: Optional[Communicator] = None):
+    return _world(comm).iallreduce(obj, op, algorithm)
+
+
+def MPI_Iallgather(obj: Any, comm: Optional[Communicator] = None):
+    return _world(comm).iallgather(obj)
+
+
+def MPI_Ialltoall(objs: Sequence[Any], comm: Optional[Communicator] = None):
+    return _world(comm).ialltoall(objs)
+
+
+def MPI_Ibarrier(comm: Optional[Communicator] = None):
+    return _world(comm).ibarrier()
+
+
+def MPI_Iscatter(objs, root: int = 0, comm: Optional[Communicator] = None):
+    return _world(comm).iscatter(objs, root)
+
+
+def MPI_Igather(obj: Any, root: int = 0, comm: Optional[Communicator] = None):
+    return _world(comm).igather(obj, root)
+
+
+# -- environment inquiry & abort -------------------------------------------
+
+
+def MPI_Get_processor_name() -> str:
+    import socket
+
+    return socket.gethostname()
+
+
+def MPI_Get_version():
+    """(major, minor) of the MPI feature level this library tracks."""
+    return (3, 0)
+
+
+def MPI_Abort(code: int = 1, comm: Optional[Communicator] = None) -> None:
+    """Terminate this rank immediately with ``code``; under the launcher
+    the nonzero exit propagates and the remaining ranks are killed (the
+    L0 kill-all contract, SURVEY.md §2 component #1)."""
+    import os
+    import sys
+
+    sys.stderr.write(f"MPI_Abort(code={code})\n")
+    sys.stderr.flush()
+    os._exit(code)
+
+
+def MPI_Wtick() -> float:
+    import time
+
+    return time.get_clock_info("perf_counter").resolution
+
+
+def MPI_Sendrecv_replace(obj: Any, dest: int, source: int = ANY_SOURCE,
+                         sendtag: int = 0, recvtag: int = ANY_TAG,
+                         comm: Optional[Communicator] = None):
+    """MPI_Sendrecv_replace [S]: same buffer for send and receive — in this
+    library's value semantics, simply returns the received payload."""
+    return _world(comm).sendrecv(obj, dest, source, sendtag, recvtag)

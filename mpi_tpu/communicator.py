@@ -214,6 +214,35 @@ def startall(requests: Sequence[PersistentRequest]) -> List[PersistentRequest]:
     return list(requests)
 
 
+class _ThreadRequest(Request):
+    """Nonblocking collective in flight: the blocking algorithm runs on a
+    thread against an isolated context (see P2PCommunicator._nbc_comm)."""
+
+    def __init__(self, fn):
+        self._value: Any = None
+        self._error: Optional[BaseException] = None
+
+        def run():
+            try:
+                self._value = fn()
+            except BaseException as e:  # noqa: BLE001 - re-raised at wait()
+                self._error = e
+
+        self._thread = threading.Thread(target=run, daemon=True)
+        self._thread.start()
+
+    def wait(self) -> Any:
+        self._thread.join()
+        if self._error is not None:
+            raise self._error
+        return self._value
+
+    def test(self) -> Tuple[bool, Any]:
+        if self._thread.is_alive():
+            return False, None
+        return True, self.wait()
+
+
 class Communicator(ABC):
     """Abstract communicator: the API user MPI programs are written against."""
 
@@ -678,6 +707,7 @@ class P2PCommunicator(Communicator):
         # to the tree so portable programs run unchanged.
         if algorithm not in ("auto", "tree", "fused"):
             raise ValueError(f"unknown bcast algorithm {algorithm!r}")
+        self._world(root)  # validate
         for pairs in schedules.binomial_bcast_rounds(self.size, root):
             for s, d in pairs:
                 if self._rank == s:
@@ -690,6 +720,7 @@ class P2PCommunicator(Communicator):
                algorithm: str = "auto") -> Any:
         if algorithm not in ("auto", "tree", "fused"):  # 'fused' aliases tree here
             raise ValueError(f"unknown reduce algorithm {algorithm!r}")
+        self._world(root)  # validate
         arr, scalar = _as_array(obj)
         acc = arr.copy()
         for pairs in schedules.binomial_reduce_rounds(self.size, root):
@@ -911,6 +942,54 @@ class P2PCommunicator(Communicator):
         ctx = self._alloc_context()
         return P2PCommunicator(self._t, self._group, ctx,
                                recv_timeout=self.recv_timeout)
+
+    # -- nonblocking collectives [S: MPI-3 MPI_Ibcast & co.] ---------------
+
+    def _nbc_comm(self) -> "P2PCommunicator":
+        """Isolated-context clone for ONE nonblocking collective.  MPI
+        requires every rank to issue nonblocking collectives on a comm in
+        the same order, so the per-comm counter yields the same context on
+        every rank without communication; the "nbc" marker keeps the space
+        disjoint from split/dup's (ctx, int) children."""
+        with self._lock:
+            self._nbc_count = getattr(self, "_nbc_count", 0) + 1
+            k = self._nbc_count
+        return P2PCommunicator(self._t, self._group, (self._ctx, "nbc", k),
+                               recv_timeout=self.recv_timeout)
+
+    def ibcast(self, obj: Any, root: int = 0) -> Request:
+        c = self._nbc_comm()
+        return _ThreadRequest(lambda: c.bcast(obj, root))
+
+    def ireduce(self, obj: Any, op: _ops.ReduceOp = _ops.SUM,
+                root: int = 0) -> Request:
+        c = self._nbc_comm()
+        return _ThreadRequest(lambda: c.reduce(obj, op, root))
+
+    def iallreduce(self, obj: Any, op: _ops.ReduceOp = _ops.SUM,
+                   algorithm: str = "auto") -> Request:
+        c = self._nbc_comm()
+        return _ThreadRequest(lambda: c.allreduce(obj, op, algorithm))
+
+    def iallgather(self, obj: Any) -> Request:
+        c = self._nbc_comm()
+        return _ThreadRequest(lambda: c.allgather(obj))
+
+    def ialltoall(self, objs: Sequence[Any]) -> Request:
+        c = self._nbc_comm()
+        return _ThreadRequest(lambda: c.alltoall(objs))
+
+    def ibarrier(self) -> Request:
+        c = self._nbc_comm()
+        return _ThreadRequest(c.barrier)
+
+    def iscatter(self, objs: Optional[Sequence[Any]], root: int = 0) -> Request:
+        c = self._nbc_comm()
+        return _ThreadRequest(lambda: c.scatter(objs, root))
+
+    def igather(self, obj: Any, root: int = 0) -> Request:
+        c = self._nbc_comm()
+        return _ThreadRequest(lambda: c.gather(obj, root))
 
     def free(self) -> None:
         pass

@@ -45,7 +45,7 @@ from jax.sharding import Mesh
 from .. import ops as _ops
 from .. import schedules
 from ..checker import validate_perm
-from ..communicator import Communicator
+from ..communicator import Communicator, _CompletedRequest
 from . import collectives as algos
 
 Pair = Tuple[int, int]
@@ -247,6 +247,40 @@ class TpuCommunicator(Communicator):
             has_src = algos._mask_of(receivers, self._axis_size, self.axis_name)
             out = jnp.where(has_src, out, jnp.full_like(out, fill))
         return out
+
+    # -- nonblocking collectives -------------------------------------------
+    # In one traced SPMD program, "nonblocking" IS the compiler's job: XLA
+    # already overlaps independent collectives with compute in its schedule.
+    # The i* entry points therefore build the collective immediately and
+    # return an already-complete Request holding the traced value — the
+    # request/wait shape of portable MPI programs is preserved, and
+    # reordering for overlap is left to XLA, which does it better.
+
+    def ibcast(self, obj, root: int = 0):
+        return _CompletedRequest(self.bcast(obj, root))
+
+    def ireduce(self, obj, op: _ops.ReduceOp = _ops.SUM, root: int = 0):
+        return _CompletedRequest(self.reduce(obj, op, root))
+
+    def iallreduce(self, obj, op: _ops.ReduceOp = _ops.SUM,
+                   algorithm: str = "auto"):
+        return _CompletedRequest(self.allreduce(obj, op, algorithm))
+
+    def iallgather(self, obj):
+        return _CompletedRequest(self.allgather(obj))
+
+    def ialltoall(self, objs):
+        return _CompletedRequest(self.alltoall(objs))
+
+    def ibarrier(self):
+        self.barrier()
+        return _CompletedRequest(None)
+
+    def iscatter(self, objs, root: int = 0):
+        return _CompletedRequest(self.scatter(objs, root))
+
+    def igather(self, obj, root: int = 0):
+        return _CompletedRequest(self.gather(obj, root))
 
     # -- one-sided (RMA) ---------------------------------------------------
 

@@ -126,10 +126,15 @@ def launch_sim_hosts(nhosts: int, argv: Sequence[str],
         port = s.getsockname()[1]
 
     # a clean CPU environment: site hooks that force-register accelerator
-    # platforms read env at interpreter start, so scrub before spawn
+    # platforms read env at interpreter start, so scrub their trigger vars
+    # and replace PYTHONPATH (which may carry the hook's site dir) with the
+    # directory this mpi_tpu checkout lives in, so worker scripts can
+    # `import mpi_tpu` without installing the package
     env = {k: v for k, v in os.environ.items()
            if not k.startswith(("PALLAS_AXON", "AXON_"))}
-    env.pop("PYTHONPATH", None)
+    pkg_parent = os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))))
+    env["PYTHONPATH"] = pkg_parent
     env["JAX_PLATFORMS"] = "cpu"
     env["XLA_FLAGS"] = (
         f"--xla_force_host_platform_device_count={devices_per_host}")

@@ -355,41 +355,31 @@ class ShmTransport(Transport):
             return
         blob = pickle.dumps((ctx, tag, payload),
                             protocol=pickle.HIGHEST_PROTOCOL)
-        small = _LEN.size + len(blob) <= self._ring_bytes // 2
         with self._send_lock(dest):
             if self._closing:  # close() may have held this lock before us
                 raise TransportError(
                     f"rank {self.world_rank}: send on a closed transport")
             ring = self._out_ring_locked(dest)
-            if small:
-                # whole frame lands before the bell.  Tiny frames concat
-                # header+blob (one C call beats a second call's overhead);
-                # beyond that the extra full-payload memcpy of the concat
-                # costs more than the call, so write header and blob
-                # separately.
-                if len(blob) <= 8192:
-                    ok = self._lib.shmring_write(
+            if len(blob) <= 8192:
+                # tiny: concat header+blob — one C call beats a second
+                # call's overhead, the whole frame commits atomically, and
+                # the bell fires with the frame complete
+                if self._lib.shmring_write(
                         ring, _LEN.pack(len(blob)) + blob,
-                        _LEN.size + len(blob), _WRITE_TIMEOUT) == 0
-                else:
-                    ok = (self._lib.shmring_write(
-                              ring, _LEN.pack(len(blob)), _LEN.size,
-                              _WRITE_TIMEOUT) == 0
-                          and self._lib.shmring_write(
-                              ring, blob, len(blob), _WRITE_TIMEOUT) == 0)
-                if not ok:
+                        _LEN.size + len(blob), _WRITE_TIMEOUT) != 0:
                     raise TransportError(
                         f"rank {self.world_rank}: send to {dest} timed out")
                 self._lib.shmdb_ring(self._out_dbs[dest])
                 return
-            # Big frame: header first, then the bell, THEN the body — the
-            # frame can only finish once the receiver drains it, so the
-            # receiver must be woken before the body write starts; its
-            # body-read then futex-handshakes with the streaming write per
-            # chunk (in-ring wseq/rseq futexes), no further bell needed.
-            # Ringing only after a full-frame write would deadlock until
-            # the receiver's nap timeout for every frame bigger than the
-            # ring.
+            # Larger frames: header first, then the bell, THEN the body.
+            # The bell wakes the receiver before the body write so (a) a
+            # frame bigger than the ring streams against a live reader
+            # (ringing only after a full-frame write would deadlock until
+            # the receiver's nap timeout) and (b) a body-write timeout
+            # leaves a reader mid-frame, not an orphaned header silently
+            # misframing the stream.  The body-read futex-handshakes with
+            # the streaming write per chunk (in-ring wseq/rseq futexes),
+            # so no further bell is needed.
             if (self._lib.shmring_write(ring, _LEN.pack(len(blob)), _LEN.size,
                                         _WRITE_TIMEOUT) != 0):
                 raise TransportError(
