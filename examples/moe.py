@@ -1,0 +1,117 @@
+"""Expert parallelism: a mixture-of-experts layer over MPI_Alltoall.
+
+The EP strategy from the checklist (SURVEY.md §2 strategy table), expressed
+through the framework's primitives: each rank hosts ONE expert MLP; tokens
+are routed top-1, dispatched to their expert's rank with one all-to-all,
+transformed, and combined back with a second all-to-all — the exact
+communication shape of Switch-Transformer-style MoE, with static
+capacity-based routing so the whole layer stays one fixed-shape SPMD
+program (XLA-friendly: no dynamic shapes, drops handled by masking).
+
+    python examples/moe.py --backend tpu -n 8
+"""
+
+import argparse
+import os
+import sys
+
+try:
+    import mpi_tpu
+except ModuleNotFoundError:  # running from a fresh checkout without install
+    sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    import mpi_tpu
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def moe_layer(comm, x, w_router, w_in, w_out, capacity):
+    """One MoE layer, expert-parallel over ``comm``.
+
+    x: [T, D] local tokens.  w_router: [D, P] (replicated).  w_in/w_out:
+    THIS rank's expert weights ([D, F], [F, D]).  Tokens beyond
+    ``capacity`` per (source rank, expert) pair are dropped (output 0 —
+    combine with a residual in real models).  Returns [T, D].
+    """
+    P = comm.size
+    T, D = x.shape
+    logits = x @ w_router                                   # [T, P]
+    choice = jnp.argmax(logits, axis=-1)                    # [T]
+    gate = jax.nn.softmax(logits, axis=-1)[jnp.arange(T), choice]
+
+    # position of each token within its expert's dispatch block
+    onehot = (choice[:, None] == jnp.arange(P)[None, :])    # [T, P]
+    pos = jnp.cumsum(onehot, axis=0) - 1                    # [T, P]
+    slot = jnp.take_along_axis(pos, choice[:, None], 1)[:, 0]  # [T]
+    kept = slot < capacity
+
+    # scatter tokens into [P, C, D] blocks (out-of-capacity slots drop)
+    blocks = jnp.zeros((P, capacity, D), x.dtype)
+    blocks = blocks.at[choice, jnp.where(kept, slot, capacity)].set(
+        x, mode="drop")
+    recv = jnp.asarray(comm.alltoall(blocks))               # [P, C, D]
+
+    # this rank's expert transforms every token it received
+    h = jax.nn.gelu(recv @ w_in)                            # [P, C, F]
+    y = h @ w_out                                           # [P, C, D]
+
+    back = jnp.asarray(comm.alltoall(y))                    # [P, C, D]
+    # gather each local token's transformed value from (its expert, slot)
+    out = back[choice, jnp.where(kept, slot, 0)]            # [T, D]
+    return jnp.where(kept[:, None], out * gate[:, None], 0.0)
+
+
+def moe_oracle(x_all, w_router, w_in_all, w_out_all, capacity):
+    """Single-process reference: same routing/capacity rules, no comm.
+    x_all: [P, T, D]; w_in_all/w_out_all: stacked expert weights."""
+    P, T, D = x_all.shape
+    out = np.zeros_like(x_all)
+    for src in range(P):
+        x = np.asarray(x_all[src])
+        logits = x @ np.asarray(w_router)
+        choice = logits.argmax(-1)
+        e = np.exp(logits - logits.max(-1, keepdims=True))
+        gate = (e / e.sum(-1, keepdims=True))[np.arange(T), choice]
+        counts = np.zeros(P, int)
+        for t in range(T):
+            ex = choice[t]
+            if counts[ex] < capacity:
+                h = np.asarray(jax.nn.gelu(x[t] @ w_in_all[ex]))
+                out[src, t] = (h @ np.asarray(w_out_all[ex])) * gate[t]
+            counts[ex] += 1
+    return out
+
+
+def moe_program(comm, tokens_per_rank: int = 16, d: int = 8, f: int = 16,
+                capacity: int = 8):
+    P = comm.size
+    root = jax.random.PRNGKey(5)
+    x = jax.random.normal(jax.random.fold_in(root, comm.rank),
+                          (tokens_per_rank, d), jnp.float32)
+    w_router = jax.random.normal(jax.random.fold_in(root, 1000), (d, P),
+                                 jnp.float32)
+    w_in = jax.random.normal(jax.random.fold_in(root, 2000 + comm.rank),
+                             (d, f), jnp.float32) * 0.3
+    w_out = jax.random.normal(jax.random.fold_in(root, 3000 + comm.rank),
+                              (f, d), jnp.float32) * 0.3
+    return moe_layer(comm, x, w_router, w_in, w_out, capacity)
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--backend", default=None,
+                    choices=[None, "socket", "shm", "local", "tpu"])
+    ap.add_argument("-n", "--nranks", type=int, default=None)
+    ap.add_argument("--tokens-per-rank", type=int, default=16)
+    args = ap.parse_args()
+
+    out = mpi_tpu.run(moe_program, backend=args.backend, nranks=args.nranks,
+                      tokens_per_rank=args.tokens_per_rank)
+    first = out[0] if isinstance(out, list) else out
+    o = np.asarray(jax.device_get(first))
+    print(f"moe OK: local {o.shape}, |out| = {np.abs(o).mean():.4f}")
+
+
+if __name__ == "__main__":
+    main()

@@ -33,7 +33,7 @@ from .ops import SUM, PROD, MAX, MIN, LAND, LOR, LXOR, BAND, BOR, BXOR, ReduceOp
 from .communicator import Communicator, P2PCommunicator, Request, Status
 from .transport.base import ANY_SOURCE, ANY_TAG
 from .transport.local import run_local
-from . import schedules, checker, profiling, trace
+from . import schedules, checker, checkpoint, profiling, trace
 from .topology import CartComm, cart_create, dims_create
 from .group import Group
 from .window import GetFuture, P2PWindow
@@ -43,7 +43,7 @@ __all__ = [
     "SUM", "PROD", "MAX", "MIN", "LAND", "LOR", "LXOR", "BAND", "BOR", "BXOR",
     "Communicator", "P2PCommunicator", "Request", "Status", "ANY_SOURCE", "ANY_TAG",
     "init", "finalize", "is_initialized", "run", "run_local",
-    "schedules", "checker", "profiling", "trace", "COMM_WORLD",
+    "schedules", "checker", "checkpoint", "profiling", "trace", "COMM_WORLD",
     "CartComm", "cart_create", "dims_create", "Group",
     "GetFuture", "P2PWindow",
 ]

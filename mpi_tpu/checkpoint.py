@@ -1,0 +1,113 @@
+"""Checkpoint / resume — the slice-restart half of the fault story.
+
+SURVEY.md §5: the reference has no checkpoint capability (socket EOF ⇒
+crash); the TPU-native failure model is *slice restart + checkpoint* —
+detection surfaces through ``recv_timeout`` / ``FaultyTransport`` (see
+transport/faulty.py), and recovery is relaunch + restore.  Two surfaces:
+
+* process backends — ``save(path, state, comm)`` / ``load(path, comm)``:
+  each rank owns ``rank{r}/`` under ``path`` (numpy + pickle payloads);
+  save is collective (barrier'd, manifest written once) so a checkpoint
+  directory is either complete or detectably partial.
+* SPMD/TPU backend — ``save_sharded`` / ``load_sharded`` wrap orbax
+  (async-capable, TPU-native sharded IO): global jax Arrays are written
+  per-shard by the process that owns them and restored to the SAME
+  sharding layout, so a pod-scale training state round-trips without
+  ever being gathered to one host.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import pickle
+from typing import Any, Optional
+
+import numpy as np
+
+_MANIFEST = "manifest.json"
+_STATE = "state.pkl"
+
+
+def save(path: str, state: Any, comm=None) -> None:
+    """Collective checkpoint on a process-backend communicator: every rank
+    writes its own state pytree; rank 0 commits the manifest LAST, so a
+    directory with a manifest is complete."""
+    from . import init
+
+    comm = comm or init()
+    # re-saving over an existing checkpoint: invalidate it FIRST, so a
+    # crash mid-save can never leave an old manifest blessing mixed
+    # old/new rank states (the manifest == completeness contract)
+    if comm.rank == 0 and os.path.exists(os.path.join(path, _MANIFEST)):
+        os.unlink(os.path.join(path, _MANIFEST))
+    comm.barrier()
+    rank_dir = os.path.join(path, f"rank{comm.rank}")
+    os.makedirs(rank_dir, exist_ok=True)
+    with open(os.path.join(rank_dir, _STATE), "wb") as f:
+        pickle.dump(state, f, protocol=pickle.HIGHEST_PROTOCOL)
+    comm.barrier()  # every rank's state is on disk
+    if comm.rank == 0:
+        tmp = os.path.join(path, "." + _MANIFEST)
+        with open(tmp, "w") as f:
+            json.dump({"nranks": comm.size, "format": 1}, f)
+        os.replace(tmp, os.path.join(path, _MANIFEST))
+    comm.barrier()  # nobody returns before the checkpoint is committed
+
+
+def exists(path: str) -> bool:
+    """True iff ``path`` holds a COMPLETE checkpoint (manifest present)."""
+    return os.path.exists(os.path.join(path, _MANIFEST))
+
+
+def load(path: str, comm=None) -> Any:
+    """Restore this rank's state from a complete checkpoint; raises
+    FileNotFoundError on a missing/partial one, ValueError on a world-size
+    mismatch (a resumed job must match the checkpoint's geometry)."""
+    from . import init
+
+    comm = comm or init()
+    mpath = os.path.join(path, _MANIFEST)
+    if not os.path.exists(mpath):
+        raise FileNotFoundError(
+            f"no complete checkpoint at {path!r} (manifest missing — the "
+            f"save was interrupted before commit)")
+    with open(mpath) as f:
+        manifest = json.load(f)
+    if manifest["nranks"] != comm.size:
+        raise ValueError(
+            f"checkpoint was taken with {manifest['nranks']} ranks; this "
+            f"world has {comm.size}")
+    with open(os.path.join(path, f"rank{comm.rank}", _STATE), "rb") as f:
+        return pickle.load(f)
+
+
+# ---- SPMD / sharded (orbax) ----------------------------------------------
+
+
+def save_sharded(path: str, state: Any) -> None:
+    """Write a pytree of (possibly sharded, possibly multi-host) jax
+    Arrays via orbax; call OUTSIDE jit, same args on every process."""
+    import orbax.checkpoint as ocp
+
+    with ocp.StandardCheckpointer() as ckpt:
+        ckpt.save(os.path.abspath(path), state, force=True)
+
+
+def load_sharded(path: str, template: Any) -> Any:
+    """Restore a pytree saved by :func:`save_sharded`.  ``template`` is a
+    pytree of arrays or jax.ShapeDtypeStruct(shape, dtype, sharding=...)
+    giving the target shardings — restored shards land directly on the
+    right devices (no host-side gather)."""
+    import jax
+    import orbax.checkpoint as ocp
+
+    abstract_tree = jax.tree.map(
+        lambda x: (x if isinstance(x, jax.ShapeDtypeStruct)
+                   else jax.ShapeDtypeStruct(
+                       np.shape(x), np.asarray(x).dtype if not hasattr(x, "dtype")
+                       else x.dtype,
+                       sharding=getattr(x, "sharding", None))),
+        template)
+    with ocp.StandardCheckpointer() as ckpt:
+        return ckpt.restore(os.path.abspath(path), abstract_tree)

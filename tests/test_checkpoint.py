@@ -1,0 +1,145 @@
+"""Checkpoint / resume (SURVEY.md §5: slice-restart + checkpoint is the
+TPU-native failure story; detection lives in recv_timeout/FaultyTransport)."""
+
+import numpy as np
+import pytest
+
+from mpi_tpu import checkpoint, ops
+from mpi_tpu.transport.local import run_local
+
+P = 4
+
+
+def test_process_checkpoint_roundtrip(tmp_path):
+    path = str(tmp_path / "ck")
+
+    def prog(comm):
+        state = {"w": np.full(3, float(comm.rank)), "step": comm.rank * 10}
+        checkpoint.save(path, state, comm)
+        assert checkpoint.exists(path)
+        got = checkpoint.load(path, comm)
+        return float(got["w"][0]), got["step"]
+
+    res = run_local(prog, P)
+    assert res == [(float(r), r * 10) for r in range(P)]
+
+
+def test_partial_checkpoint_rejected(tmp_path):
+    path = str(tmp_path / "ck")
+
+    def prog(comm):
+        (tmp_path / "ck" / f"rank{comm.rank}").mkdir(parents=True, exist_ok=True)
+        # no manifest: simulates a crash between rank writes and commit
+        try:
+            checkpoint.load(path, comm)
+            return False
+        except FileNotFoundError:
+            return True
+
+    assert all(run_local(prog, 2))
+
+
+def test_world_size_mismatch_rejected(tmp_path):
+    path = str(tmp_path / "ck")
+
+    def prog(comm):
+        checkpoint.save(path, {"x": 1}, comm)
+        return True
+
+    assert all(run_local(prog, 2))
+
+    def prog4(comm):
+        try:
+            checkpoint.load(path, comm)
+            return False
+        except ValueError:
+            return True
+
+    assert all(run_local(prog4, 4))
+
+
+def test_resume_equivalence_jacobi(tmp_path):
+    """50 iters + checkpoint + restore + 50 iters == 100 iters straight
+    (the acceptance shape of resume)."""
+    from examples.jacobi import jacobi_step
+
+    path = str(tmp_path / "ck")
+
+    def straight(comm):
+        grid = np.zeros((16, 8))
+        grid[0, :] = 1.0
+        for _ in range(100):
+            grid = jacobi_step(comm, grid)
+        return grid
+
+    def resumed(comm):
+        grid = np.zeros((16, 8))
+        grid[0, :] = 1.0
+        for _ in range(50):
+            grid = jacobi_step(comm, grid)
+        checkpoint.save(path, {"grid": grid}, comm)
+        grid2 = checkpoint.load(path, comm)["grid"]
+        for _ in range(50):
+            grid2 = jacobi_step(comm, grid2)
+        return grid2
+
+    a = run_local(straight, 2)
+    b = run_local(resumed, 2)
+    for x, y in zip(a, b):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+
+def test_sharded_checkpoint_roundtrip(tmp_path):
+    """orbax path: a sharded global array round-trips to the same layout."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as Pspec
+
+    from mpi_tpu.tpu import default_mesh
+
+    mesh = default_mesh()  # all visible devices
+    n = len(jax.devices())
+    sh = NamedSharding(mesh, Pspec("world"))
+    x = jax.device_put(jnp.arange(n * 4, dtype=jnp.float32).reshape(n, 4), sh)
+    state = {"w": x, "b": jnp.ones(3)}
+    checkpoint.save_sharded(str(tmp_path / "sck"), state)
+    tpl = {"w": jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=sh),
+           "b": jnp.zeros(3)}
+    got = checkpoint.load_sharded(str(tmp_path / "sck"), tpl)
+    np.testing.assert_array_equal(np.asarray(got["w"]), np.asarray(x))
+    np.testing.assert_array_equal(np.asarray(got["b"]), np.ones(3))
+    assert got["w"].sharding == sh
+
+
+def test_resave_invalidates_manifest_first(tmp_path, monkeypatch):
+    """A crash between re-save start and commit must leave NO manifest —
+    never an old manifest blessing mixed old/new states.  (1-rank world:
+    a crashing rank would strand peers at the barrier, which is exactly
+    the hang the manifest protocol is designed around.)"""
+    import os as _os
+
+    import mpi_tpu.checkpoint as ck
+
+    path = str(tmp_path / "ck")
+
+    def prog(comm):
+        ck.save(path, {"step": 100}, comm)
+        assert ck.exists(path)
+        real_replace = _os.replace
+
+        def boom(src, dst):
+            if dst.endswith("manifest.json"):
+                raise RuntimeError("crash before commit")
+            return real_replace(src, dst)
+
+        monkeypatch.setattr("os.replace", boom)
+        try:
+            ck.save(path, {"step": 200}, comm)
+            return False
+        except RuntimeError:
+            pass
+        finally:
+            monkeypatch.setattr("os.replace", real_replace)
+        return not ck.exists(path)  # old manifest gone, no false blessing
+
+    assert all(run_local(prog, 1))
