@@ -87,6 +87,26 @@ nbytes = 256 * 1024 * 1024
 n = nbytes // 4
 x = jnp.ones(n, jnp.float32)
 result = {{"nranks": P_}}
+
+# ICI line-rate probe: a saturating pure-ppermute ring of the same
+# per-device payload — the denominator of the >=80%-of-line-rate
+# north-star (BASELINE.json:5; SURVEY.md section 6)
+try:
+    ring_pairs = [(i, (i + 1) % P_) for i in range(P_)]
+    probe = jax.jit(jax.shard_map(
+        lambda x: jax.lax.ppermute(x, "world", ring_pairs),
+        mesh=mesh, in_specs=P("world"), out_specs=P("world")))
+    xp = jnp.ones(n * P_, jnp.float32)  # nbytes per device
+    probe(xp).block_until_ready()
+    ts = []
+    for _ in range(10):
+        t0 = time.perf_counter()
+        probe(xp).block_until_ready()
+        ts.append(time.perf_counter() - t0)
+    t = statistics.median(ts)
+    result["ici_linerate_gbps_per_link"] = nbytes / t / 1e9
+except Exception as e:
+    result["linerate_error"] = str(e)[:300]
 for algo in ("ring", "fused", "pallas_ring"):
     try:
         f = jax.jit(jax.shard_map(

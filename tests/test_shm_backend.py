@@ -235,3 +235,25 @@ def test_shm_symmetric_big_sendrecv_no_deadlock():
 
     res = run_shm_world(prog, 2, timeout=60.0)
     assert res[0] == big[-1] * 2 and res[1] == big[-1]
+
+
+def test_shm_random_frame_sizes_roundtrip():
+    """Frame sizes straddling the tiny-concat threshold, the ring capacity,
+    and multiples thereof all roundtrip bit-exactly (framing property)."""
+    rng = np.random.RandomState(7)
+    sizes = [1, 100, 8191, 8192, 8193, 100_000, 256 * 1024 - 8,
+             256 * 1024, 256 * 1024 + 1, 700_000]
+    payloads = [rng.bytes(s) for s in sizes]
+
+    def prog(comm):
+        if comm.rank == 0:
+            for p in payloads:
+                comm.send(p, dest=1)
+            ok = comm.recv(source=1)
+            return ok
+        got = [comm.recv(source=0) for _ in payloads]
+        comm.send(all(g == p for g, p in zip(got, payloads)), dest=0)
+        return True
+
+    res = run_shm_world(prog, 2)
+    assert res[0] is True
