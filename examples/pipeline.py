@@ -94,7 +94,14 @@ def main():
 
     out = mpi_tpu.run(pipeline_program, backend=args.backend,
                       nranks=args.nranks, micro=args.micro)
-    last = out[-1]
+    # run() returns a per-rank list (local backend) or a stacked
+    # [nranks, M, B, D] array (tpu backend) — both want the LAST rank's
+    # output — but on process backends (socket/shm) it is already THIS
+    # rank's [M, B, D] result
+    if isinstance(out, list) or np.ndim(out) == 4:
+        last = out[-1]
+    else:
+        last = out
     o = np.asarray(jax.device_get(last))
     print(f"pipeline OK: outputs {o.shape} on the last stage, "
           f"|out| = {np.abs(o).mean():.4f}")

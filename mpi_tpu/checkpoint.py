@@ -6,9 +6,12 @@ detection surfaces through ``recv_timeout`` / ``FaultyTransport`` (see
 transport/faulty.py), and recovery is relaunch + restore.  Two surfaces:
 
 * process backends — ``save(path, state, comm)`` / ``load(path, comm)``:
-  each rank owns ``rank{r}/`` under ``path`` (numpy + pickle payloads);
-  save is collective (barrier'd, manifest written once) so a checkpoint
-  directory is either complete or detectably partial.
+  each save writes a fresh generation ``gen{k}/rank{r}/state.pkl`` under
+  ``path`` and commits it by atomically swinging ``manifest.json`` to
+  ``gen`` k once every rank's state is on disk — so ``path`` always holds
+  either the previous complete checkpoint or the new one, never a torn
+  mix (format-1 checkpoints, rank dirs directly under ``path``, are still
+  loadable).  Save is collective (barrier'd).
 * SPMD/TPU backend — ``save_sharded`` / ``load_sharded`` wrap orbax
   (async-capable, TPU-native sharded IO): global jax Arrays are written
   per-shard by the process that owns them and restored to the SAME
@@ -21,6 +24,7 @@ from __future__ import annotations
 import json
 import os
 import pickle
+import shutil
 from typing import Any, Optional
 
 import numpy as np
@@ -29,20 +33,44 @@ _MANIFEST = "manifest.json"
 _STATE = "state.pkl"
 
 
+def _read_manifest(path: str) -> Optional[dict]:
+    try:
+        with open(os.path.join(path, _MANIFEST)) as f:
+            return json.load(f)
+    except (FileNotFoundError, json.JSONDecodeError):
+        return None
+
+
+def _gen_dir(path: str, manifest: dict) -> str:
+    """State root of a committed checkpoint (format-1 compat: rank dirs
+    live directly under ``path``)."""
+    gen = manifest.get("gen")
+    return path if gen is None else os.path.join(path, f"gen{gen}")
+
+
 def save(path: str, state: Any, comm=None) -> None:
-    """Collective checkpoint on a process-backend communicator: every rank
-    writes its own state pytree; rank 0 commits the manifest LAST, so a
-    directory with a manifest is complete."""
+    """Collective checkpoint on a process-backend communicator.
+
+    Crash-safe re-save (generation scheme): every rank writes its state
+    pytree into a FRESH ``gen{k}/`` subdirectory, and only after all ranks
+    have finished does rank 0 atomically swing the manifest to the new
+    generation — so the previous good checkpoint at ``path`` stays
+    restorable through every instant of the save.  A crash before the
+    manifest swap leaves the old generation committed; a crash after it
+    leaves the new one (the orphaned directory is swept on the next save).
+    """
     from . import init
 
     comm = comm or init()
-    # re-saving over an existing checkpoint: invalidate it FIRST, so a
-    # crash mid-save can never leave an old manifest blessing mixed
-    # old/new rank states (the manifest == completeness contract)
-    if comm.rank == 0 and os.path.exists(os.path.join(path, _MANIFEST)):
-        os.unlink(os.path.join(path, _MANIFEST))
-    comm.barrier()
-    rank_dir = os.path.join(path, f"rank{comm.rank}")
+    prev = _read_manifest(path) if comm.rank == 0 else None
+    if comm.rank == 0:
+        prev_gen = -1 if prev is None else int(prev.get("gen", -1))
+        next_gen = prev_gen + 1
+    else:
+        next_gen = None
+    next_gen = comm.bcast(next_gen, root=0)
+    gen_dir = os.path.join(path, f"gen{next_gen}")
+    rank_dir = os.path.join(gen_dir, f"rank{comm.rank}")
     os.makedirs(rank_dir, exist_ok=True)
     with open(os.path.join(rank_dir, _STATE), "wb") as f:
         pickle.dump(state, f, protocol=pickle.HIGHEST_PROTOCOL)
@@ -50,8 +78,19 @@ def save(path: str, state: Any, comm=None) -> None:
     if comm.rank == 0:
         tmp = os.path.join(path, "." + _MANIFEST)
         with open(tmp, "w") as f:
-            json.dump({"nranks": comm.size, "format": 1}, f)
-        os.replace(tmp, os.path.join(path, _MANIFEST))
+            json.dump({"nranks": comm.size, "format": 2, "gen": next_gen}, f)
+        os.replace(tmp, os.path.join(path, _MANIFEST))  # the commit point
+        # everything but the committed generation is now unreferenced —
+        # sweep it ALL best-effort: older generations, orphans from saves
+        # that crashed after their own commit, and format-1 rank{r}/ dirs
+        keep = f"gen{next_gen}"
+        for entry in os.listdir(path):
+            if entry == keep or not (entry.startswith("gen")
+                                     or entry.startswith("rank")):
+                continue
+            victim = os.path.join(path, entry)
+            if os.path.isdir(victim):
+                shutil.rmtree(victim, ignore_errors=True)
     comm.barrier()  # nobody returns before the checkpoint is committed
 
 
@@ -78,7 +117,8 @@ def load(path: str, comm=None) -> Any:
         raise ValueError(
             f"checkpoint was taken with {manifest['nranks']} ranks; this "
             f"world has {comm.size}")
-    with open(os.path.join(path, f"rank{comm.rank}", _STATE), "rb") as f:
+    state_dir = _gen_dir(path, manifest)
+    with open(os.path.join(state_dir, f"rank{comm.rank}", _STATE), "rb") as f:
         return pickle.load(f)
 
 

@@ -23,6 +23,7 @@ API conventions (MPI-1.x semantics [S], pythonic spelling):
 
 from __future__ import annotations
 
+import pickle
 import threading
 from abc import ABC, abstractmethod
 from typing import Any, List, Optional, Sequence, Tuple
@@ -66,6 +67,23 @@ def _as_array(obj: Any) -> Tuple[np.ndarray, bool]:
 
 def _unwrap(arr: np.ndarray, was_scalar: bool) -> Any:
     return arr[()] if was_scalar else arr
+
+
+_JAX_ARRAY_TYPE: Optional[type] = None
+
+
+def _is_jax_array(x: Any) -> bool:
+    """jax Arrays are immutable by design — safe to alias, wasteful to
+    deep-copy (a pickle round-trip would force a device→host transfer).
+    The type is resolved once (failed imports are not cached by Python)."""
+    global _JAX_ARRAY_TYPE
+    if _JAX_ARRAY_TYPE is None:
+        try:
+            import jax
+            _JAX_ARRAY_TYPE = jax.Array
+        except Exception:  # noqa: BLE001 - no jax, no jax arrays
+            _JAX_ARRAY_TYPE = ()  # falsy sentinel: never matches
+    return isinstance(x, _JAX_ARRAY_TYPE) if _JAX_ARRAY_TYPE else False
 
 
 def _maybe_stack(local_payload: Any, items: List[Any]) -> Any:
@@ -179,9 +197,22 @@ class PersistentRequest(Request):
                 "start() on an active persistent request (MPI: erroneous "
                 "until the previous operation completes)")
         if self._kind == "send":
+            # Snapshot at start() time: the MPI buffer-reuse idiom lets the
+            # caller refill the bound buffer as soon as start() returns.
+            # Only a by-reference transport (local with copy_payloads=False)
+            # can alias that refill — serializing transports copy in send()
+            # anyway, so snapshotting there would double the work.  ndarrays
+            # get a cheap .copy(); other mutable payloads (lists, dicts,
+            # pytrees) a pickle round-trip; immutables pass through.
             payload = self._buf
-            if isinstance(payload, np.ndarray):
-                payload = payload.copy()  # snapshot: buffer owned until start
+            if self._comm._t.aliases_payloads:
+                if isinstance(payload, np.ndarray):
+                    payload = payload.copy()
+                elif not (isinstance(payload, (int, float, complex, bool,
+                                               str, bytes, type(None)))
+                          or _is_jax_array(payload)):
+                    payload = pickle.loads(pickle.dumps(
+                        payload, protocol=pickle.HIGHEST_PROTOCOL))
             self._inner = self._comm.isend(payload, self._peer, self._tag)
         else:
             self._inner = self._comm.irecv(self._peer, self._tag)

@@ -156,6 +156,12 @@ class Mailbox:
 class Transport(ABC):
     """Moves payloads between world ranks; owns a Mailbox for incoming traffic."""
 
+    # True only for transports that deliver payloads BY REFERENCE (the
+    # in-process local transport with copy_payloads=False): callers that
+    # honor MPI's buffer-reuse idiom (persistent requests) must snapshot
+    # mutable payloads themselves.  Serializing transports copy anyway.
+    aliases_payloads = False
+
     def __init__(self, world_rank: int, world_size: int) -> None:
         self.world_rank = world_rank
         self.world_size = world_size

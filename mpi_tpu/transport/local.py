@@ -34,6 +34,7 @@ class LocalTransport(Transport):
         super().__init__(rank, world.size)
         self._world = world
         self.mailbox = world.mailboxes[rank]
+        self.aliases_payloads = not world.copy_payloads
 
     def send(self, dest: int, ctx, tag: int, payload: Any) -> None:
         if not (0 <= dest < self.world_size):

@@ -100,10 +100,10 @@ class ShmTransport(Transport):
         self._state_lock = threading.Lock()
         # exactly one thread runs the progress engine at a time
         self._progress_lock = threading.Lock()
-        # guards doorbell use on the lock-contended wait path (close()
-        # munmaps the doorbell under this, so no thread can be inside a
-        # shmdb_* call on freed memory)
-        self._db_lock = threading.Lock()
+        # Our own doorbell mapping is NEVER munmapped (close() only unlinks
+        # the name; the 1-page mapping is reclaimed at process exit), so
+        # ring/read/wait on it need no lock against teardown — any thread
+        # may touch it at any time and close() just has to wake sleepers.
         # Helper drainer: guarantees the buffered-send invariant
         # (communicator.py: "transports buffer sends and drain receives on
         # dedicated threads") even when NO thread of this rank is in recv —
@@ -173,7 +173,7 @@ class ShmTransport(Transport):
                         f"rank {self.world_rank}: bad frame from {src}: {e}")
                 self.mailbox.deliver(src, ctx, tag, obj)
                 progressed = True
-        if progressed and self._db is not None:
+        if progressed:
             # Local delivery-ring: threads that lost the progress-lock race
             # wait on the doorbell (not the mailbox cv), so tell them their
             # message may have landed.  One futex op, only on delivery.
@@ -185,11 +185,10 @@ class ShmTransport(Transport):
         doorbell (seqlock pattern: snapshot bell → re-scan → wait, so a
         frame landing between scan and wait still wakes us).  Caller holds
         the progress lock AND has checked _closing after acquiring it —
-        close() tears the mappings down under this lock, so a stale call
-        here would hand NULL/freed pointers to C."""
+        close() tears the RING mappings down under this lock, so a stale
+        call here would hand freed ring pointers to C (the doorbell mapping
+        itself outlives close(); see __init__)."""
         lib = self._lib
-        if self._db is None:
-            return
         if self._drain_once():
             return
         seen = lib.shmdb_read(self._db)
@@ -252,22 +251,22 @@ class ShmTransport(Transport):
                 # DOORBELL, not the mailbox cv: the bell rings both on
                 # remote arrival and on local delivery (_drain_once), so we
                 # wake for either — never stranded for a full nap slice.
-                # Seqlock: snapshot, re-poll the mailbox, then wait.  The
-                # _db_lock excludes close()'s doorbell munmap for the whole
-                # read+wait window.
-                with self._db_lock:
-                    if self._closing or self._db is None:
-                        continue  # loop re-raises via the check above
-                    seen = self._lib.shmdb_read(self._db)
-                    if consume:
-                        hit = self.mailbox.poll(source, ctx, tag)
-                        if hit is not None:
-                            return hit
-                    else:
-                        pk = self.mailbox.peek_nowait(source, ctx, tag)
-                        if pk is not None:
-                            return None, pk[0], pk[1]
-                    self._lib.shmdb_wait(self._db, seen, slice_s)
+                # Seqlock: snapshot, re-poll the mailbox, then wait.  No
+                # teardown lock needed — our doorbell mapping outlives
+                # close() (see __init__), and close() rings it to pop us
+                # out of the nap into the _closing check above.
+                if self._closing:
+                    continue  # loop re-raises via the check above
+                seen = self._lib.shmdb_read(self._db)
+                if consume:
+                    hit = self.mailbox.poll(source, ctx, tag)
+                    if hit is not None:
+                        return hit
+                else:
+                    pk = self.mailbox.peek_nowait(source, ctx, tag)
+                    if pk is not None:
+                        return None, pk[0], pk[1]
+                self._lib.shmdb_wait(self._db, seen, slice_s)
                 continue
 
     # -- Transport interface (incoming) ------------------------------------
@@ -352,6 +351,11 @@ class ShmTransport(Transport):
             copy = pickle.loads(
                 pickle.dumps(payload, protocol=pickle.HIGHEST_PROTOCOL))
             self.mailbox.deliver(dest, ctx, tag, copy)
+            # ring our own bell: a thread parked in _match_loop's
+            # doorbell-wait branch (lost the progress-lock race) waits on
+            # the bell, not the mailbox cv — without this it would sleep
+            # its full nap slice before noticing the local delivery
+            self._lib.shmdb_ring(self._db)
             return
         blob = pickle.dumps((ctx, tag, payload),
                             protocol=pickle.HIGHEST_PROTOCOL)
@@ -422,12 +426,11 @@ class ShmTransport(Transport):
                         _ring_name(self._session, src, self.world_rank))
                 self._in_rings.clear()
                 self._in_items = []
-                with self._db_lock:
-                    if self._db:
-                        self._lib.shmdb_close(self._db)
-                        self._lib.shmdb_unlink(
-                            _db_name(self._session, self.world_rank))
-                        self._db = None
+                # unlink the doorbell NAME but keep the mapping alive: a
+                # waiter may still be inside shmdb_wait on it, and the
+                # 1-page mapping is reclaimed at process exit anyway
+                self._lib.shmdb_unlink(
+                    _db_name(self._session, self.world_rank))
         finally:
             for lock in send_locks:
                 lock.release()

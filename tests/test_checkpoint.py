@@ -111,11 +111,12 @@ def test_sharded_checkpoint_roundtrip(tmp_path):
     assert got["w"].sharding == sh
 
 
-def test_resave_invalidates_manifest_first(tmp_path, monkeypatch):
-    """A crash between re-save start and commit must leave NO manifest —
-    never an old manifest blessing mixed old/new states.  (1-rank world:
-    a crashing rank would strand peers at the barrier, which is exactly
-    the hang the manifest protocol is designed around.)"""
+def test_resave_crash_keeps_prior_generation(tmp_path, monkeypatch):
+    """A crash anywhere during a re-save must leave the PRIOR checkpoint
+    restorable: the new generation is written aside and the manifest swings
+    atomically only after every rank has committed its state.  (1-rank
+    world: a crashing rank would strand peers at the barrier, which is
+    exactly the hang the manifest protocol is designed around.)"""
     import os as _os
 
     import mpi_tpu.checkpoint as ck
@@ -140,6 +141,11 @@ def test_resave_invalidates_manifest_first(tmp_path, monkeypatch):
             pass
         finally:
             monkeypatch.setattr("os.replace", real_replace)
-        return not ck.exists(path)  # old manifest gone, no false blessing
+        # the old generation survived the crashed re-save
+        assert ck.exists(path)
+        assert ck.load(path, comm) == {"step": 100}
+        # and a subsequent clean re-save commits the new state
+        ck.save(path, {"step": 300}, comm)
+        return ck.load(path, comm) == {"step": 300}
 
     assert all(run_local(prog, 1))
