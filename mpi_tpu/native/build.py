@@ -69,12 +69,20 @@ def load_shmring() -> ctypes.CDLL:
     lib.shmring_open.argtypes = [ctypes.c_char_p, ctypes.c_double]
     lib.shmring_avail.restype = ctypes.c_uint64
     lib.shmring_avail.argtypes = [ctypes.c_void_p]
+    # buf params are c_void_p: accepts bytes, ctypes buffers, AND raw
+    # integer addresses (ndarray.ctypes.data) — the zero-copy array path
     lib.shmring_write.restype = ctypes.c_int
-    lib.shmring_write.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+    lib.shmring_write.argtypes = [ctypes.c_void_p, ctypes.c_void_p,
                                   ctypes.c_uint64, ctypes.c_double]
     lib.shmring_read.restype = ctypes.c_int
     lib.shmring_read.argtypes = [ctypes.c_void_p, ctypes.c_void_p,
                                  ctypes.c_uint64, ctypes.c_double]
+    lib.shmring_read_some.restype = ctypes.c_int64
+    lib.shmring_read_some.argtypes = [ctypes.c_void_p, ctypes.c_void_p,
+                                      ctypes.c_uint64, ctypes.c_double]
+    lib.shmring_write_some.restype = ctypes.c_int64
+    lib.shmring_write_some.argtypes = [ctypes.c_void_p, ctypes.c_void_p,
+                                       ctypes.c_uint64, ctypes.c_double]
     lib.shmring_close.restype = None
     lib.shmring_close.argtypes = [ctypes.c_void_p]
     lib.shmring_unlink.restype = ctypes.c_int

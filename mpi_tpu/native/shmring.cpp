@@ -255,6 +255,77 @@ int shmring_read(void* ring, void* buf, uint64_t n, double timeout_s) {
   return 0;
 }
 
+// Read UP TO n bytes (at least 1 unless timeout): returns the count, 0 on
+// timeout with nothing consumed.  The resumable half of shmring_read —
+// Python loops it in short slices so a dead peer or a teardown request is
+// noticed between slices instead of after one long in-C block, and large
+// frames can stream straight into their final buffer at an offset.
+int64_t shmring_read_some(void* ring, void* buf, uint64_t n, double timeout_s) {
+  Ring* r = (Ring*)ring;
+  Header* h = r->h;
+  uint8_t* dst = (uint8_t*)buf;
+  const uint64_t cap = h->capacity;
+  uint64_t tail = h->tail.load(std::memory_order_relaxed);
+  double deadline = timeout_s < 0 ? -1.0 : now_s() + timeout_s;
+  for (;;) {
+    uint32_t seen = h->wseq.load(std::memory_order_seq_cst);
+    uint64_t head = h->head.load(std::memory_order_acquire);
+    uint64_t avail = head - tail;
+    if (avail == 0) {
+      if (!futex_wait_step(&h->wseq, seen, &h->wwait, deadline)) return 0;
+      continue;
+    }
+    uint64_t chunk = n < avail ? n : avail;
+    uint64_t pos = tail % cap;
+    uint64_t run = cap - pos;
+    if (chunk <= run) {
+      memcpy(dst, r->data + pos, chunk);
+    } else {  // wraps: two runs, one call
+      memcpy(dst, r->data + pos, run);
+      memcpy(dst + run, r->data, chunk - run);
+    }
+    tail += chunk;
+    h->tail.store(tail, std::memory_order_release);
+    bump_and_wake(&h->rseq, &h->rwait);
+    return (int64_t)chunk;
+  }
+}
+
+// Write UP TO n bytes: returns the count, 0 on timeout with nothing
+// committed.  Resumable half of shmring_write (same rationale as
+// shmring_read_some).
+int64_t shmring_write_some(void* ring, const void* buf, uint64_t n,
+                           double timeout_s) {
+  Ring* r = (Ring*)ring;
+  Header* h = r->h;
+  const uint8_t* src = (const uint8_t*)buf;
+  const uint64_t cap = h->capacity;
+  uint64_t head = h->head.load(std::memory_order_relaxed);
+  double deadline = timeout_s < 0 ? -1.0 : now_s() + timeout_s;
+  for (;;) {
+    uint32_t seen = h->rseq.load(std::memory_order_seq_cst);
+    uint64_t tail = h->tail.load(std::memory_order_acquire);
+    uint64_t space = cap - (head - tail);
+    if (space == 0) {
+      if (!futex_wait_step(&h->rseq, seen, &h->rwait, deadline)) return 0;
+      continue;
+    }
+    uint64_t chunk = n < space ? n : space;
+    uint64_t pos = head % cap;
+    uint64_t run = cap - pos;
+    if (chunk <= run) {
+      memcpy(r->data + pos, src, chunk);
+    } else {
+      memcpy(r->data + pos, src, run);
+      memcpy(r->data, src + run, chunk - run);
+    }
+    head += chunk;
+    h->head.store(head, std::memory_order_release);
+    bump_and_wake(&h->wseq, &h->wwait);
+    return (int64_t)chunk;
+  }
+}
+
 void shmring_close(void* ring) {
   Ring* r = (Ring*)ring;
   munmap((void*)r->h, r->maplen);

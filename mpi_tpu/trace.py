@@ -33,6 +33,7 @@ class TracingTransport(Transport):
         self.world_rank = inner.world_rank
         self.world_size = inner.world_size
         self.mailbox = inner.mailbox
+        self.aliases_payloads = inner.aliases_payloads
         self.log: List[Tuple] = []
         self._lock = threading.Lock()
 

@@ -32,6 +32,7 @@ class FaultyTransport(Transport):
         self.world_rank = inner.world_rank
         self.world_size = inner.world_size
         self.mailbox = inner.mailbox
+        self.aliases_payloads = inner.aliases_payloads
         self.drop_every = drop_every
         self.delay_s = delay_s
         self.duplicate_every = duplicate_every

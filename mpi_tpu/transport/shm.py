@@ -4,8 +4,12 @@ Same plugin seam as the socket transport (SURVEY.md §1 L1), different
 substrate: one native SPSC byte ring in POSIX shared memory per directed
 rank pair (mpi_tpu/native/shmring.cpp), no syscalls on the data path —
 a `memcpy` into the ring replaces the TCP stack.  Frames are
-``<u64 length><pickle(ctx, tag, obj)>``; the C side streams in chunks, so
-frames larger than the ring capacity flow without deadlock.
+``<u64 flags|length>`` + body; the body is either a pickle envelope or a
+raw-array frame (meta + raw bytes, no pickle on the hot payload — see
+transport/codec.py).  Contiguous numpy arrays therefore move with exactly
+TWO memcpys end to end: sender's buffer → ring → receiver's result array.
+The C side streams in chunks, so frames larger than the ring capacity
+flow without deadlock.
 
 Topology/ownership: every rank CREATES its P−1 incoming rings plus one
 futex *doorbell* at startup (consumer-owned; stale segments from crashed
@@ -35,14 +39,33 @@ import threading
 import time
 from typing import Any, Dict, Optional, Tuple
 
+import numpy as np
+
 from ..native import load_shmring
+from . import codec
 from .base import ANY_SOURCE, Mailbox, RecvTimeout, Transport, TransportError
 
 _LEN = struct.Struct("<Q")
 _RING_BYTES = int(os.environ.get("MPI_TPU_SHM_RING_BYTES", 4 << 20))
 _OPEN_TIMEOUT = 60.0
-_WRITE_TIMEOUT = 120.0
+_WRITE_TIMEOUT = 120.0  # max time with NO progress before declaring a peer dead
 _PROGRESS_SLICE = 0.25  # max doorbell nap; re-checks deadline/closing
+_SMALL = 8192  # frames up to this commit in one ring write (atomic + 1 bell)
+# Bounded poll-spin before the futex nap: with spare cores the sender runs
+# concurrently, so a short spin catches the frame without paying the futex
+# wakeup + context switch.  On a 1-core box the sender CANNOT progress while
+# we spin (measured: yield-spinning made p50 ~20µs worse there), so the
+# default is off unless there are ≥2 CPUs.  MPI_TPU_SHM_SPIN_US overrides;
+# 0 disables.
+_SPIN_S = float(os.environ.get(
+    "MPI_TPU_SHM_SPIN_US",
+    "100" if (os.cpu_count() or 1) > 1 else "0")) * 1e-6
+
+
+def _addr(buf) -> int:
+    """Raw address of a bytes-like's buffer (zero-copy; caller must keep
+    ``buf`` alive across the native call)."""
+    return np.frombuffer(buf, dtype=np.uint8).ctypes.data
 
 
 def shm_prefix(session: str) -> str:
@@ -123,16 +146,23 @@ class ShmTransport(Transport):
         while not self._closing:
             # Last-resort drainer only: while any user thread is receiving,
             # IT owns the progress engine (one-wakeup latency path) and the
-            # helper must not steal the lock out from under it.
-            if self._user_waiters > 0:
-                time.sleep(0.05)
-                continue
-            if self._progress_lock.acquire(timeout=0.05):
+            # helper must stand down entirely.  The helper deliberately
+            # does NOT wait on the doorbell: it would share the futex with
+            # real receive waiters, so every delivery would wake one extra
+            # thread — a whole extra context switch per message on a
+            # 1-core box.  A 20Hz ring poll is plenty for its only job
+            # (the no-receiver symmetric-send overload case) and costs the
+            # hot path nothing.
+            time.sleep(0.05)
+            if self._closing:
+                return
+            if (self._user_waiters == 0
+                    and self._progress_lock.acquire(blocking=False)):
                 try:
                     if self._closing:
                         return
                     if self._user_waiters == 0:
-                        self._progress_wait(_PROGRESS_SLICE)
+                        self._drain_once()
                 except TransportError:
                     # _drain_once closed the mailbox, so every blocked
                     # receiver sees the diagnosis; the helper's job here
@@ -140,8 +170,76 @@ class ShmTransport(Transport):
                     return
                 finally:
                     self._progress_lock.release()
-            else:
-                time.sleep(0.05)
+
+    def _read_exact(self, ring: int, addr: int, n: int, src: int) -> None:
+        """Stream exactly ``n`` bytes from ``ring`` to the buffer at
+        ``addr``, in short native slices so teardown (``_closing``) and a
+        dead peer (no progress for _WRITE_TIMEOUT) are noticed promptly —
+        never one multi-minute block inside C (the round-1 advisor's
+        close()-hangs-2-minutes finding).  Caller holds the progress lock
+        and keeps the buffer's owner alive."""
+        done = 0
+        stall = time.monotonic() + _WRITE_TIMEOUT
+        while done < n:
+            got = self._lib.shmring_read_some(
+                ring, addr + done, n - done, _PROGRESS_SLICE)
+            if got:
+                done += got
+                stall = time.monotonic() + _WRITE_TIMEOUT
+                continue
+            if self._closing:
+                raise TransportError(
+                    f"rank {self.world_rank}: transport closed mid-frame "
+                    f"from {src}")
+            if time.monotonic() > stall:
+                self.mailbox.close()  # failure must reach blocked recvs
+                raise TransportError(
+                    f"rank {self.world_rank}: truncated frame from {src} "
+                    f"(no data for {_WRITE_TIMEOUT}s — is the sender alive?)")
+
+    def _read_frame(self, src: int, ring: int) -> Tuple[Any, int, Any]:
+        """Read one complete frame (header already known present).
+
+        Small frames (body ≤ _SMALL) are pulled in exactly TWO native
+        calls — header word, then the whole body into one buffer parsed
+        host-side — because on the latency path ctypes call overhead
+        (~1-3µs each) dwarfs an extra ≤8KB memcpy.  Only large raw frames
+        take the streamed zero-copy read into the final array."""
+        hdr = ctypes.create_string_buffer(_LEN.size)
+        self._read_exact(ring, ctypes.addressof(hdr), _LEN.size, src)
+        (word,) = _LEN.unpack(hdr.raw)
+        body = word & codec.LEN_MASK
+        try:
+            if word & codec.RAW_FLAG:
+                if body <= _SMALL:
+                    buf = ctypes.create_string_buffer(body)
+                    self._read_exact(ring, ctypes.addressof(buf), body, src)
+                    return codec.parse_raw_body(buf.raw)
+                mbuf = ctypes.create_string_buffer(codec.META.size)
+                self._read_exact(ring, ctypes.addressof(mbuf),
+                                 codec.META.size, src)
+                (mlen,) = codec.META.unpack(mbuf.raw)
+                meta = ctypes.create_string_buffer(mlen)
+                self._read_exact(ring, ctypes.addressof(meta), mlen, src)
+                ctx, tag, arr = codec.unpack_raw_meta(meta.raw)
+                if codec.META.size + mlen + arr.nbytes != body:
+                    raise ValueError(
+                        f"raw frame length mismatch: header says {body}, "
+                        f"meta implies {codec.META.size + mlen + arr.nbytes}")
+                # the single receive-side copy: ring -> final array
+                self._read_exact(ring, arr.ctypes.data, arr.nbytes, src)
+                return ctx, tag, arr
+            payload = ctypes.create_string_buffer(body) if body else b""
+            if body:
+                self._read_exact(ring, ctypes.addressof(payload), body, src)
+            ctx, tag, obj = pickle.loads(payload.raw if body else b"")
+            return ctx, tag, obj
+        except TransportError:
+            raise
+        except Exception as e:  # noqa: BLE001 - deliver the diagnosis
+            self.mailbox.close()
+            raise TransportError(
+                f"rank {self.world_rank}: bad frame from {src}: {e}")
 
     def _drain_once(self) -> bool:
         """Pull every complete-or-started frame out of the rings into the
@@ -151,26 +249,7 @@ class ShmTransport(Transport):
         progressed = False
         for src, ring in self._in_items:
             while lib.shmring_avail(ring) >= _LEN.size:
-                buf = ctypes.create_string_buffer(_LEN.size)
-                if lib.shmring_read(ring, buf, _LEN.size, _WRITE_TIMEOUT) != 0:
-                    self.mailbox.close()  # failure must reach blocked recvs
-                    raise TransportError(
-                        f"rank {self.world_rank}: header read from {src} "
-                        f"timed out")
-                (nbytes,) = _LEN.unpack(buf.raw)
-                payload = ctypes.create_string_buffer(nbytes)
-                # the sender streams; the in-C read futex-handshakes with it
-                if lib.shmring_read(ring, payload, nbytes,
-                                    _WRITE_TIMEOUT) != 0:
-                    self.mailbox.close()
-                    raise TransportError(
-                        f"rank {self.world_rank}: truncated frame from {src}")
-                try:
-                    ctx, tag, obj = pickle.loads(payload.raw)
-                except Exception as e:  # noqa: BLE001 - deliver the diagnosis
-                    self.mailbox.close()
-                    raise TransportError(
-                        f"rank {self.world_rank}: bad frame from {src}: {e}")
+                ctx, tag, obj = self._read_frame(src, ring)
                 self.mailbox.deliver(src, ctx, tag, obj)
                 progressed = True
         if progressed:
@@ -189,17 +268,24 @@ class ShmTransport(Transport):
         call here would hand freed ring pointers to C (the doorbell mapping
         itself outlives close(); see __init__)."""
         lib = self._lib
+        # Seqlock order: snapshot the bell BEFORE scanning, so a frame that
+        # lands after the scan has already bumped the bell past `seen` and
+        # shmdb_wait returns immediately — one ring scan, no lost wakeup.
+        seen = lib.shmdb_read(self._db)
         if self._drain_once():
             return
-        seen = lib.shmdb_read(self._db)
-        if any(lib.shmring_avail(ring) >= _LEN.size
-               for _, ring in self._in_items):
-            return
+        if _SPIN_S > 0.0:
+            spin_deadline = time.monotonic() + min(_SPIN_S, slice_s)
+            while time.monotonic() < spin_deadline:
+                os.sched_yield()  # 1-core friendly: lets the sender run
+                if self._drain_once():
+                    return
+                if self._closing:
+                    return
         lib.shmdb_wait(self._db, seen, slice_s)
         # Drain whatever the bell announced BEFORE handing the lock back:
-        # if this nap was the helper's and a user thread is queued behind
-        # the lock in a mailbox wait, returning undrained would strand the
-        # wakeup until that wait's full timeout slice expired.
+        # a user thread queued behind the lock in a doorbell wait would
+        # otherwise sit on an undrained ring until its next poll.
         self._drain_once()
 
     def _blocking_match(self, op: str, source: int, ctx, tag: int,
@@ -348,31 +434,49 @@ class ShmTransport(Transport):
             raise TransportError(
                 f"rank {self.world_rank}: send on a closed transport")
         if dest == self.world_rank:
-            copy = pickle.loads(
-                pickle.dumps(payload, protocol=pickle.HIGHEST_PROTOCOL))
-            self.mailbox.deliver(dest, ctx, tag, copy)
+            self.mailbox.deliver(dest, ctx, tag, codec.value_copy(payload))
             # ring our own bell: a thread parked in _match_loop's
             # doorbell-wait branch (lost the progress-lock race) waits on
             # the bell, not the mailbox cv — without this it would sleep
             # its full nap slice before noticing the local delivery
             self._lib.shmdb_ring(self._db)
             return
-        blob = pickle.dumps((ctx, tag, payload),
-                            protocol=pickle.HIGHEST_PROTOCOL)
+        arr = codec.as_raw_array(payload)
+        if arr is not None:
+            head = codec.pack_raw_meta(ctx, tag, arr)
+            body = len(head) + arr.nbytes
+            header = _LEN.pack(codec.RAW_FLAG | body)
+            with self._send_lock(dest):
+                if self._closing:  # close() may have held this lock first
+                    raise TransportError(
+                        f"rank {self.world_rank}: send on a closed transport")
+                ring = self._out_ring_locked(dest)
+                if body <= _SMALL:
+                    frame = header + head + arr.tobytes()
+                    self._write_all(ring, frame, len(frame), dest)
+                    self._lib.shmdb_ring(self._out_dbs[dest])
+                    return
+                # big frame: header+meta, bell, then the raw bytes straight
+                # from the array's own buffer — the single send-side copy
+                # is the in-C memcpy into the ring (see send() pickle path
+                # below for why the bell precedes the body)
+                prefix = header + head
+                self._write_all(ring, prefix, len(prefix), dest)
+                self._lib.shmdb_ring(self._out_dbs[dest])
+                self._write_all(ring, arr.ctypes.data, arr.nbytes, dest)
+            return
+        blob = codec.pack_pickle_body(ctx, tag, payload)
         with self._send_lock(dest):
             if self._closing:  # close() may have held this lock before us
                 raise TransportError(
                     f"rank {self.world_rank}: send on a closed transport")
             ring = self._out_ring_locked(dest)
-            if len(blob) <= 8192:
+            if len(blob) <= _SMALL:
                 # tiny: concat header+blob — one C call beats a second
                 # call's overhead, the whole frame commits atomically, and
                 # the bell fires with the frame complete
-                if self._lib.shmring_write(
-                        ring, _LEN.pack(len(blob)) + blob,
-                        _LEN.size + len(blob), _WRITE_TIMEOUT) != 0:
-                    raise TransportError(
-                        f"rank {self.world_rank}: send to {dest} timed out")
+                frame = _LEN.pack(len(blob)) + blob
+                self._write_all(ring, frame, len(frame), dest)
                 self._lib.shmdb_ring(self._out_dbs[dest])
                 return
             # Larger frames: header first, then the bell, THEN the body.
@@ -384,17 +488,38 @@ class ShmTransport(Transport):
             # misframing the stream.  The body-read futex-handshakes with
             # the streaming write per chunk (in-ring wseq/rseq futexes),
             # so no further bell is needed.
-            if (self._lib.shmring_write(ring, _LEN.pack(len(blob)), _LEN.size,
-                                        _WRITE_TIMEOUT) != 0):
-                raise TransportError(
-                    f"rank {self.world_rank}: send header to {dest} timed out")
+            header = _LEN.pack(len(blob))
+            self._write_all(ring, header, len(header), dest)
             self._lib.shmdb_ring(self._out_dbs[dest])
-            if self._lib.shmring_write(ring, blob, len(blob),
-                                       _WRITE_TIMEOUT) != 0:
+            self._write_all(ring, blob, len(blob), dest)
+
+    def _write_all(self, ring: int, buf, n: int, dest: int) -> None:
+        """Stream exactly ``n`` bytes into ``ring`` in short native slices
+        (same teardown/dead-peer rationale as _read_exact).  ``buf`` is
+        bytes (passed straight to C — the common whole-frame-fits case
+        costs ONE ctypes call) or a raw int address; the resume path
+        switches to address+offset arithmetic.  The caller keeps the
+        buffer's owner alive across the call."""
+        done = self._lib.shmring_write_some(ring, buf, n, _PROGRESS_SLICE)
+        if done == n:
+            return
+        addr = buf if isinstance(buf, int) else _addr(buf)
+        stall = time.monotonic() + _WRITE_TIMEOUT
+        while done < n:
+            if self._closing:
+                raise TransportError(
+                    f"rank {self.world_rank}: transport closed during send "
+                    f"to {dest}")
+            if time.monotonic() > stall:
                 raise TransportError(
                     f"rank {self.world_rank}: send to {dest} timed out "
-                    f"({len(blob)} bytes; ring full for {_WRITE_TIMEOUT}s — "
-                    f"is the receiver alive?)")
+                    f"({n} bytes; ring full for {_WRITE_TIMEOUT}s — is the "
+                    f"receiver alive?)")
+            got = self._lib.shmring_write_some(
+                ring, addr + done, n - done, _PROGRESS_SLICE)
+            if got:
+                done += got
+                stall = time.monotonic() + _WRITE_TIMEOUT
 
     # -- shutdown ----------------------------------------------------------
 

@@ -6,10 +6,13 @@ blocking matched receive.  This backend exists for two reasons (SURVEY.md §4
 item 4): it is the CPU fallback, and it is the source-compatibility proof —
 the same user program must run here and on backend=tpu.
 
-Wire format per message: a fixed header ``!QQ`` = (payload_len, seq) followed
-by ``payload_len`` bytes of pickle holding the envelope ``(ctx, tag, obj)`` —
-the context id is an arbitrary hashable (tree-path tuple), so it rides inside
-the pickle rather than a fixed-width header field.  The sender's world rank
+Wire format per message: a fixed header ``!QQ`` = (flags|payload_len, seq)
+followed by ``payload_len`` body bytes — either a pickle of the envelope
+``(ctx, tag, obj)``, or (RAW_FLAG set, see transport/codec.py) a raw-array
+frame whose numpy payload is sent straight from / received straight into
+the array buffer, never pickled.  The context id is an arbitrary hashable
+(tree-path tuple), so it rides inside the meta pickle rather than a
+fixed-width header field.  The sender's world rank
 is established once per connection by a hello frame (``!i``), not repeated
 per message.  Rank discovery is file-based rendezvous: each rank binds an
 OS-assigned port and publishes it as ``<rdv>/port.<rank>``; peers poll.  The
@@ -26,10 +29,11 @@ import threading
 import time
 from typing import Any, Dict, Optional
 
+from . import codec
 from .base import Transport, TransportError
 
 _HELLO = struct.Struct("!i")
-_HEADER = struct.Struct("!QQ")  # payload_len, seq
+_HEADER = struct.Struct("!QQ")  # flags|payload_len, seq
 _HOST = "127.0.0.1"
 
 
@@ -44,6 +48,22 @@ def _recv_exact(sock: socket.socket, n: int) -> Optional[bytes]:
             return None
         buf += chunk
     return bytes(buf)
+
+
+def _recv_into_exact(sock: socket.socket, view: memoryview) -> bool:
+    """Fill ``view`` completely from the socket — the receive-side
+    zero-copy path (bytes land straight in the final array)."""
+    got = 0
+    n = len(view)
+    while got < n:
+        try:
+            r = sock.recv_into(view[got:])
+        except OSError:
+            return False
+        if r == 0:
+            return False
+        got += r
+    return True
 
 
 class SocketTransport(Transport):
@@ -108,7 +128,27 @@ class SocketTransport(Transport):
             if head is None:
                 conn.close()
                 return
-            plen, _seq = _HEADER.unpack(head)
+            word, _seq = _HEADER.unpack(head)
+            plen = word & codec.LEN_MASK
+            if word & codec.RAW_FLAG:
+                # raw-array frame: tiny meta pickle, then the bytes stream
+                # straight into the freshly-allocated result array
+                mhead = _recv_exact(conn, codec.META.size)
+                if mhead is None:
+                    conn.close()
+                    return
+                (mlen,) = codec.META.unpack(mhead)
+                meta = _recv_exact(conn, mlen)
+                if meta is None:
+                    conn.close()
+                    return
+                ctx, tag, arr = codec.unpack_raw_meta(meta)
+                if arr.nbytes and not _recv_into_exact(
+                        conn, memoryview(arr).cast("B")):
+                    conn.close()
+                    return
+                self.mailbox.deliver(src, ctx, tag, arr)
+                continue
             payload = _recv_exact(conn, plen)
             if payload is None:
                 conn.close()
@@ -176,11 +216,29 @@ class SocketTransport(Transport):
         if not (0 <= dest < self.world_size):
             raise ValueError(f"dest {dest} out of range for world size {self.world_size}")
         if dest == self.world_rank:
-            # pickle round-trip preserves message (value) semantics
-            copy = pickle.loads(pickle.dumps(payload, protocol=pickle.HIGHEST_PROTOCOL))
-            self.mailbox.deliver(dest, ctx, tag, copy)
+            # value-semantics copy (cheap .copy() for arrays)
+            self.mailbox.deliver(dest, ctx, tag, codec.value_copy(payload))
             return
-        blob = pickle.dumps((ctx, tag, payload), protocol=pickle.HIGHEST_PROTOCOL)
+        arr = codec.as_raw_array(payload)
+        if arr is not None:
+            head = codec.pack_raw_meta(ctx, tag, arr)
+            body = len(head) + arr.nbytes
+            with self._send_lock(dest):
+                conn = self._get_conn_locked(dest)
+                self._seq += 1
+                prefix = _HEADER.pack(codec.RAW_FLAG | body, self._seq) + head
+                try:
+                    conn.sendall(prefix)
+                    if arr.nbytes:
+                        # sendall reads the array's buffer directly — the
+                        # payload is never pickled or re-copied host-side
+                        conn.sendall(memoryview(arr).cast("B"))
+                except OSError as e:
+                    raise TransportError(
+                        f"rank {self.world_rank}: send to rank {dest} "
+                        f"failed: {e}") from e
+            return
+        blob = codec.pack_pickle_body(ctx, tag, payload)
         with self._send_lock(dest):
             conn = self._get_conn_locked(dest)
             self._seq += 1

@@ -1,0 +1,144 @@
+"""Raw-array framing (transport/codec.py): numpy payloads cross the
+byte-stream transports without being pickled — same matching semantics,
+same values, every dtype/shape/backend combination, including frames
+larger than the shm ring capacity and the documented pickle fallbacks."""
+
+import numpy as np
+import pytest
+
+from mpi_tpu.transport import codec
+from tests.test_shm_backend import run_shm_world
+from tests.test_socket_backend import run_socket_world
+
+WORLDS = [("socket", run_socket_world), ("shm", run_shm_world)]
+
+
+# -- codec unit behavior ----------------------------------------------------
+
+
+def test_raw_eligibility():
+    assert codec.as_raw_array(np.arange(3)) is not None
+    assert codec.as_raw_array([1, 2, 3]) is None          # not an array
+    assert codec.as_raw_array(np.array([{}], object)) is None  # object dtype
+    rec = np.zeros(2, dtype=[("a", "i4"), ("b", "f8")])
+    assert codec.as_raw_array(rec) is None                 # structured/void
+    # non-contiguous input is compacted, values preserved
+    base = np.arange(12.0).reshape(3, 4)
+    sliced = base[:, ::2]
+    raw = codec.as_raw_array(sliced)
+    assert raw.flags["C_CONTIGUOUS"]
+    np.testing.assert_array_equal(raw, sliced)
+
+
+def test_meta_roundtrip():
+    arr = np.arange(6, dtype=np.int16).reshape(2, 3)
+    packed = codec.pack_raw_meta(("c",), 7, arr)
+    (mlen,) = codec.META.unpack(packed[:codec.META.size])
+    ctx, tag, out = codec.unpack_raw_meta(packed[codec.META.size:
+                                                 codec.META.size + mlen])
+    assert ctx == ("c",) and tag == 7
+    assert out.shape == arr.shape and out.dtype == arr.dtype
+
+
+# -- over the real transports ----------------------------------------------
+
+ARRAYS = [
+    np.array(3.5, np.float32),                      # 0-dim
+    np.empty((0, 4), np.float64),                   # empty
+    np.arange(1024, dtype=np.float32),              # small (one-write path)
+    np.random.RandomState(0).randn(1 << 16),        # 512KB f64 (big path)
+    np.arange(33, dtype=np.int8),                   # odd length
+    np.array([[True, False], [False, True]]),       # bool
+    np.arange(8, dtype=np.complex64),               # complex
+    (np.arange(40.0).reshape(5, 8))[::2, 1::3],     # non-contiguous view
+]
+
+
+@pytest.mark.parametrize("name,world", WORLDS, ids=[w[0] for w in WORLDS])
+def test_array_roundtrip_all_dtypes(name, world):
+    def prog(comm):
+        if comm.rank == 0:
+            for i, a in enumerate(ARRAYS):
+                comm.send(a, dest=1, tag=i)
+            return True
+        got = [comm.recv(source=0, tag=i) for i in range(len(ARRAYS))]
+        for a, g in zip(ARRAYS, got):
+            assert isinstance(g, np.ndarray)
+            assert g.dtype == a.dtype and g.shape == a.shape
+            np.testing.assert_array_equal(g, np.asarray(a))
+        return True
+
+    assert all(world(prog, 2))
+
+
+@pytest.mark.slow
+def test_shm_array_larger_than_ring_streams():
+    """A raw frame bigger than the 4MB ring must stream through — the
+    header/bell/body protocol against a live reader."""
+    big = np.random.RandomState(1).randn(3 << 19)  # 12 MB f64
+
+    def prog(comm):
+        if comm.rank == 0:
+            comm.send(big, dest=1)
+            return True
+        got = comm.recv(source=0)
+        np.testing.assert_array_equal(got, big)
+        return True
+
+    assert all(run_shm_world(prog, 2, timeout=120.0))
+
+
+@pytest.mark.parametrize("name,world", WORLDS, ids=[w[0] for w in WORLDS])
+def test_pickle_fallbacks_still_work(name, world):
+    """Object/structured arrays and plain objects ride the pickle frame."""
+    rec = np.zeros(3, dtype=[("a", "i4"), ("b", "f4")])
+    rec["a"] = [1, 2, 3]
+    payloads = [rec, {"k": np.arange(3)}, [1, "two", 3.0], None]
+
+    def prog(comm):
+        if comm.rank == 0:
+            for i, p in enumerate(payloads):
+                comm.send(p, dest=1, tag=i)
+            return True
+        got = [comm.recv(source=0, tag=i) for i in range(len(payloads))]
+        np.testing.assert_array_equal(got[0], rec)
+        np.testing.assert_array_equal(got[1]["k"], np.arange(3))
+        assert got[2] == [1, "two", 3.0] and got[3] is None
+        return True
+
+    assert all(world(prog, 2))
+
+
+@pytest.mark.parametrize("name,world", WORLDS, ids=[w[0] for w in WORLDS])
+def test_raw_self_send_copies(name, world):
+    """Self-sends keep value semantics: mutating after send must not
+    affect the delivered message."""
+    def prog(comm):
+        buf = np.arange(4.0)
+        comm.send(buf, dest=comm.rank, tag=5)
+        buf[:] = -1.0
+        got = comm.recv(source=comm.rank, tag=5)
+        np.testing.assert_array_equal(got, np.arange(4.0))
+        return True
+
+    assert all(world(prog, 1))
+
+
+@pytest.mark.parametrize("name,world", WORLDS, ids=[w[0] for w in WORLDS])
+def test_ndarray_subclasses_survive(name, world):
+    """MaskedArray must ride the pickle frame (raw frames would drop the
+    mask); behavior must match self-sends."""
+    ma = np.ma.masked_array([1.0, 2.0, 3.0], mask=[False, True, False])
+
+    def prog(comm):
+        peer = (comm.rank + 1) % comm.size
+        comm.send(ma, dest=peer, tag=1)
+        comm.send(ma, dest=comm.rank, tag=2)   # self-send
+        for tag in (1, 2):
+            got = comm.recv(tag=tag)
+            assert isinstance(got, np.ma.MaskedArray)
+            assert list(got.mask) == [False, True, False]
+            np.testing.assert_array_equal(got.compressed(), [1.0, 3.0])
+        return True
+
+    assert all(world(prog, 2))
