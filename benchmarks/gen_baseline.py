@@ -1,0 +1,253 @@
+"""Regenerate BASELINE.md mechanically from measured JSON (VERDICT round 1
+next-step #4: "BASELINE.md tables carry 1MB+ rows with a stated generation
+command, no hand-edited numbers").
+
+Runs the contract measurement matrix (BASELINE.json:7-10) on this host,
+appends every row to ``benchmarks/results/baseline.jsonl``, and rewrites
+``BASELINE.md`` from those rows.  Usage::
+
+    python -m benchmarks.gen_baseline            # full matrix (minutes)
+    python -m benchmarks.gen_baseline --quick    # tiny sizes (CI smoke)
+
+The matrix (sizes capped by this box's RAM/1-core reality; the 1GB tail of
+the BASELINE.json:10 sweep and the ★ north-star need the v5e-8 slice —
+bench.py runs those automatically when ≥2 real chips appear):
+
+* ring-vs-halving allreduce crossover: local 4 ranks, 4KB→64MB (:10)
+* bcast/reduce tree: local 4 ranks, 4KB→1MB (:8)
+* allgather + alltoall OSU sweep: local 4 ranks, 4KB→16MB (:9)
+* the same allreduce/allgather/alltoall sweeps on the TPU backend
+  (8-device CPU sim on this box; real ICI when chips are attached)
+* pingpong latency 1KB + windowed bw 16MB: socket AND shm rank processes
+  under the launcher (:7 + the native-transport comparison)
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+from typing import Dict, List
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+RESULTS = os.path.join(REPO, "benchmarks", "results")
+JSONL = os.path.join(RESULTS, "baseline.jsonl")
+
+
+def _env_cpu(ndev: int = 8) -> dict:
+    # bench.py owns the force-CPU recipe (site-hook scrubbing etc.) —
+    # one copy, shared
+    sys.path.insert(0, REPO)
+    import bench
+
+    return bench._cpu_env(ndev)
+
+
+def _run_rows(cmd: List[str], env: dict, label: str,
+              timeout: float = 1800.0) -> List[Dict]:
+    """Run a subprocess that prints JSON-line rows; collect them."""
+    proc = subprocess.run(cmd, capture_output=True, text=True, cwd=REPO,
+                          env=env, timeout=timeout)
+    if proc.returncode != 0:
+        return [{"error": proc.stderr[-400:], "cmd": label}]
+    return [json.loads(line) for line in proc.stdout.splitlines()
+            if line.strip().startswith("{")]
+
+
+def _osu(args: List[str], env: dict) -> List[Dict]:
+    return _run_rows([sys.executable, "-m", "benchmarks.osu", *args], env,
+                     " ".join(args))
+
+
+def _launched_osu(backend: str, args: List[str], env: dict) -> List[Dict]:
+    """osu under the launcher (2 real rank processes); rank 0 prints rows."""
+    return _run_rows(
+        [sys.executable, "-m", "mpi_tpu.launcher", "-n", "2",
+         "--backend", backend, "benchmarks/osu.py", "--backend", "socket",
+         *args],
+        env, backend + ": " + " ".join(args))
+
+
+def measure(quick: bool) -> List[Dict]:
+    env = _env_cpu()
+    big = "4KB:64KB:4" if quick else "4KB:64MB:4"
+    mid = "4KB:64KB:4" if quick else "4KB:16MB:4"
+    small = "4KB,64KB" if quick else "4KB,1MB"
+    it = ["--iters", "5", "--warmup", "2"] if quick else \
+         ["--iters", "15", "--warmup", "3"]
+    rows: List[Dict] = []
+    t0 = time.time()
+
+    def log(msg):
+        print(f"[gen_baseline +{time.time()-t0:6.0f}s] {msg}", flush=True)
+
+    log("allreduce crossover (local, 4 ranks)")
+    rows += _osu(["--bench", "allreduce", "--backend", "local", "-n", "4",
+                  "--sizes", big,
+                  "--algorithms", "ring,recursive_halving", *it], env)
+    log("bcast/reduce tree (local, 4 ranks)")
+    rows += _osu(["--bench", "bcast", "--backend", "local", "-n", "4",
+                  "--sizes", small, "--algorithms", "tree", *it], env)
+    rows += _osu(["--bench", "reduce", "--backend", "local", "-n", "4",
+                  "--sizes", small, "--algorithms", "tree", *it], env)
+    log("allgather/alltoall sweep (local, 4 ranks)")
+    rows += _osu(["--bench", "allgather", "--backend", "local", "-n", "4",
+                  "--sizes", mid, "--algorithms", "ring,doubling", *it], env)
+    rows += _osu(["--bench", "alltoall", "--backend", "local", "-n", "4",
+                  "--sizes", mid, "--algorithms", "pairwise", *it], env)
+    log("TPU-backend sweeps (8-dev mesh)")
+    rows += _osu(["--bench", "allreduce", "--backend", "tpu", "-n", "8",
+                  "--sizes", big,
+                  "--algorithms", "ring,recursive_halving,fused", *it], env)
+    rows += _osu(["--bench", "allgather", "--backend", "tpu", "-n", "8",
+                  "--sizes", mid, "--algorithms", "ring,fused", *it], env)
+    rows += _osu(["--bench", "alltoall", "--backend", "tpu", "-n", "8",
+                  "--sizes", mid, "--algorithms", "pairwise,fused", *it], env)
+    for backend in ("socket", "shm"):
+        log(f"pingpong + windowed bw ({backend} rank processes)")
+        rows += _launched_osu(backend, ["--bench", "latency",
+                                        "--sizes", "32,1KB", *it], env)
+        rows += _launched_osu(backend, ["--bench", "bw",
+                                        "--sizes", "1KB,16MB" if not quick
+                                        else "1KB", *it], env)
+    return rows
+
+
+# --------------------------------------------------------------------------
+# BASELINE.md rendering
+# --------------------------------------------------------------------------
+
+
+def _fmt_bytes(b: int) -> str:
+    for unit, div in (("GB", 1 << 30), ("MB", 1 << 20), ("KB", 1 << 10)):
+        if b >= div:
+            v = b / div
+            return f"{v:.0f}{unit}" if v == int(v) else f"{v:.1f}{unit}"
+    return f"{b}B"
+
+
+def _table(rows: List[Dict], cols: List[str], headers: List[str]) -> List[str]:
+    out = ["| " + " | ".join(headers) + " |",
+           "|" + "|".join("---" for _ in headers) + "|"]
+    for r in rows:
+        cells = []
+        for c in cols:
+            v = r.get(c, "")
+            if c == "bytes" and v != "":
+                v = _fmt_bytes(v)
+            elif isinstance(v, float):
+                v = f"{v:.3g}" if v < 1000 else f"{v:.0f}"
+            cells.append(str(v))
+        out.append("| " + " | ".join(cells) + " |")
+    return out
+
+
+def render(rows: List[Dict], quick: bool) -> str:
+    ok = [r for r in rows if "error" not in r and "skipped" not in r]
+
+    def pick(**kv):
+        return [r for r in ok
+                if all(r.get(k) == v for k, v in kv.items())]
+
+    lines = [
+        "# BASELINE",
+        "",
+        "**The reference (`mgawino/mpi`) has no published benchmark numbers.**",
+        "`BASELINE.json:13` is `\"published\": {}`; the reference checkout at",
+        "`/root/reference/` is an empty directory (zero files — SURVEY.md §0),",
+        "so every number below is **measured on this repo's own backends** —",
+        "the socket backend is the source-compatible reimplementation of the",
+        "reference's architecture, the TPU backend is the deliverable.",
+        "",
+        "**Generated mechanically** — do not hand-edit numbers.  Command:",
+        "",
+        "```", f"python -m benchmarks.gen_baseline{' --quick' if quick else ''}",
+        "```",
+        "",
+        f"Raw rows: `benchmarks/results/baseline.jsonl` "
+        f"({len(ok)} measurements).  Conventions (BASELINE.json:2): busbw =",
+        "NCCL-tests convention (allreduce `bytes×2(P−1)/P÷t`); p50 = median;",
+        "collective p50 = slowest rank's median.  Hardware: this box (1 CPU",
+        "core — multi-rank CPU numbers are contended upper bounds; TPU rows",
+        "say which platform they actually ran on).",
+        "",
+        "## Ring vs recursive-halving allreduce (BASELINE.json:10)",
+        "",
+        "### local backend (4 rank threads)", "",
+    ]
+    lines += _table(pick(bench="allreduce", backend="local"),
+                    ["bytes", "algorithm", "p50_us", "busbw_gbps"],
+                    ["size", "algorithm", "p50 (µs)", "busbw (GB/s)"])
+    lines += ["", "### tpu backend (8-device mesh)", ""]
+    lines += _table(pick(bench="allreduce", backend="tpu"),
+                    ["platform", "bytes", "algorithm", "p50_us", "busbw_gbps"],
+                    ["platform", "size", "algorithm", "p50 (µs)", "busbw (GB/s)"])
+    lines += ["", "## Tree bcast / reduce (BASELINE.json:8)", ""]
+    lines += _table(pick(bench="bcast") + pick(bench="reduce"),
+                    ["bench", "backend", "bytes", "algorithm", "p50_us"],
+                    ["bench", "backend", "size", "algorithm", "p50 (µs)"])
+    lines += ["", "## Allgather / alltoall OSU sweep (BASELINE.json:9)", ""]
+    lines += _table(pick(bench="allgather") + pick(bench="alltoall"),
+                    ["bench", "backend", "bytes", "algorithm", "p50_us",
+                     "busbw_gbps"],
+                    ["bench", "backend", "size", "algorithm", "p50 (µs)",
+                     "busbw (GB/s)"])
+    lines += ["", "## Point-to-point: latency + windowed bandwidth "
+              "(BASELINE.json:7; socket vs native shm)", ""]
+    lines += _table([r for r in ok if r["bench"] in ("latency", "bw")],
+                    ["bench", "backend", "bytes", "window", "p50_us",
+                     "bw_gbps"],
+                    ["bench", "backend", "size", "window", "p50 (µs)",
+                     "bw (GB/s)"])
+    lines += [
+        "",
+        "## North-star (BASELINE.json:5)",
+        "",
+        "★ ring-allreduce on 256MB f32 ≥80% of ICI line-rate on v5e-8: needs",
+        "≥2 real chips.  `bench.py` runs the measurement (NORTHSTAR_PROG +",
+        "ICI line-rate probe) automatically when they are visible AND runs",
+        "the identical program on an 8-device CPU sim at 8MB on every",
+        "invocation (`BENCH_DETAILS.json` → `northstar_sim_8dev`), so the",
+        "measurement path is rehearsed before hardware day.",
+        "",
+        "Errors/skips during generation:",
+        "",
+    ]
+    errs = [r for r in rows if "error" in r or "skipped" in r]
+    if errs:
+        for r in errs[:20]:
+            lines.append(f"- `{json.dumps(r)[:200]}`")
+    else:
+        lines.append("- none")
+    lines.append("")
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--quick", action="store_true",
+                    help="tiny sizes / few iters (CI smoke)")
+    ap.add_argument("--render-only", action="store_true",
+                    help="rewrite BASELINE.md from the existing jsonl")
+    args = ap.parse_args(argv)
+
+    os.makedirs(RESULTS, exist_ok=True)
+    if args.render_only:
+        with open(JSONL) as f:
+            rows = [json.loads(line) for line in f if line.strip()]
+    else:
+        rows = measure(args.quick)
+        with open(JSONL, "w") as f:
+            for r in rows:
+                f.write(json.dumps(r) + "\n")
+    with open(os.path.join(REPO, "BASELINE.md"), "w") as f:
+        f.write(render(rows, args.quick))
+    print(f"BASELINE.md regenerated from {len(rows)} rows")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

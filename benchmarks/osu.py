@@ -125,6 +125,43 @@ def cpu_bench_program(comm, bench: str, sizes: List[int], algos: List[str],
                              "p50_us": statistics.median(samples) * 1e6})
         return rows
 
+    if bench == "bw":
+        # classic osu_bw: rank 0 streams a WINDOW of nonblocking sends,
+        # rank 1 receives them all and acks once per window; unidirectional
+        # bandwidth = window_bytes / window_time.  The window keeps the
+        # pipe full — a single in-flight message (the latency test) can
+        # never saturate a transport.
+        for nbytes in sizes:
+            # cap in-flight bytes at 32MB so huge sizes don't exhaust RAM
+            window = max(2, min(64, (32 << 20) // max(1, nbytes)))
+            payload = np.zeros(max(1, nbytes // 4), np.float32)
+            comm.barrier()
+            samples = []
+            for i in range(warmup + iters):
+                t0 = time.perf_counter()
+                if comm.rank == 0:
+                    reqs = [comm.isend(payload, dest=1, tag=w)
+                            for w in range(window)]
+                    for r in reqs:
+                        r.wait()
+                    comm.recv(source=1, tag=10_000)  # window ack
+                elif comm.rank == 1:
+                    reqs = [comm.irecv(source=0, tag=w)
+                            for w in range(window)]
+                    for r in reqs:
+                        r.wait()
+                    comm.send(b"ack", dest=0, tag=10_000)
+                if i >= warmup:
+                    samples.append(time.perf_counter() - t0)
+            comm.barrier()
+            if comm.rank == 0:
+                t = statistics.median(samples)
+                rows.append({"bench": "bw", "nranks": comm.size,
+                             "bytes": nbytes, "window": window,
+                             "bw_gbps": window * nbytes / t / 1e9,
+                             "p50_us": t * 1e6})
+        return rows
+
     for nbytes in sizes:
         if bench == "allgather":
             # nbytes is the TOTAL gathered payload (busbw convention; matches
@@ -169,13 +206,14 @@ def tpu_bench(bench: str, sizes: List[int], algos: List[str], iters: int,
               warmup: int, nranks: Optional[int]) -> List[Dict]:
     import jax
     import jax.numpy as jnp
-    from jax.sharding import PartitionSpec as P
+    from jax.sharding import NamedSharding, PartitionSpec as P
 
     from mpi_tpu.tpu import TpuCommunicator, default_mesh
 
     mesh = default_mesh(nranks)
     p = mesh.shape["world"]
     comm = TpuCommunicator("world", mesh)
+    sharded = NamedSharding(mesh, P("world"))
     rows: List[Dict] = []
 
     def timed(fn, x) -> float:
@@ -189,44 +227,67 @@ def tpu_bench(bench: str, sizes: List[int], algos: List[str], iters: int,
             samples.append(time.perf_counter() - t0)
         return statistics.median(samples)
 
+    def my_slice(full):
+        """This rank's 1/p slice of a (value-)replicated result: keeps the
+        timed program's OUTPUT sharded too, so HBM stays O(n), and keeps
+        every hand-scheduled algorithm vma-clean (the output is allowed to
+        vary — no replication proof needed)."""
+        r = lax_axis(comm)
+        flat = full.reshape(p, -1)
+        return jax.lax.dynamic_slice(flat, (r, 0), (1, flat.shape[1]))
+
+    def lax_axis(c):
+        import jax.lax as lax
+
+        return lax.axis_index(c.axis_name)
+
     for nbytes in sizes:
         n = max(1, nbytes // 4)
         for algo in algos:
             try:
+                # inputs are SHARDED one per-rank buffer per device (a
+                # replicated in_spec would inflate HBM p× at north-star
+                # sizes — the SURVEY §7 trap VERDICT round 1 flagged)
                 if bench == "latency":
                     # round-trip ppermute ring step there and back
                     def body(x):
-                        y = comm.shift(x, offset=1, wrap=True)
-                        return comm.shift(y, offset=-1, wrap=True)
+                        y = comm.shift(x.reshape(-1), offset=1, wrap=True)
+                        return comm.shift(y, offset=-1, wrap=True)[None]
+                    xg = jnp.zeros((p, n), jnp.float32)
                 elif bench == "allreduce":
                     def body(x, a=algo):
-                        return comm.allreduce(x, algorithm=a)
+                        return my_slice(comm.allreduce(
+                            x.reshape(-1), algorithm=a))
+                    xg = jnp.zeros((p, n), jnp.float32)
                 elif bench == "bcast":
                     def body(x, a=algo):
-                        return comm.bcast(x, root=0, algorithm=a)
+                        return my_slice(comm.bcast(
+                            x.reshape(-1), root=0, algorithm=a))
+                    xg = jnp.zeros((p, n), jnp.float32)
                 elif bench == "reduce":
                     def body(x, a=algo):
-                        return comm.reduce(x, root=0, algorithm=a)
+                        return my_slice(comm.reduce(
+                            x.reshape(-1), root=0, algorithm=a))
+                    xg = jnp.zeros((p, n), jnp.float32)
                 elif bench == "allgather":
                     def body(x, a=algo):
-                        return comm.allgather(x, algorithm=a)
+                        return my_slice(comm.allgather(
+                            x.reshape(-1), algorithm=a))
+                    xg = jnp.zeros((p, max(1, n // p)), jnp.float32)
                 elif bench == "alltoall":
                     def body(x, a=algo):
-                        return comm.alltoall(x, algorithm=a)
+                        return comm.alltoall(x[0], algorithm=a)[None]
+                    xg = jnp.zeros((p, p, max(1, n // p)), jnp.float32)
                 else:
                     raise ValueError(f"unknown benchmark {bench!r}")
 
-                if bench == "alltoall":
-                    blk = max(1, n // p)
-                    x = jnp.zeros((p, blk), jnp.float32)
-                elif bench == "allgather":
-                    x = jnp.zeros(max(1, n // p), jnp.float32)
-                else:
-                    x = jnp.zeros(n, jnp.float32)
+                xg = jax.jit(lambda s=xg.shape: jnp.zeros(s, jnp.float32),
+                             out_shardings=sharded)()
                 fn = jax.jit(jax.shard_map(
-                    body, mesh=mesh, in_specs=P(), out_specs=P("world"),
+                    body, mesh=mesh, in_specs=P("world"),
+                    out_specs=P("world"),
                     check_vma=(algo != "pallas_ring")))
-                t = timed(fn, x)
+                t = timed(fn, xg)
             except ValueError as e:
                 rows.append({"bench": bench, "bytes": nbytes, "algorithm": algo,
                              "skipped": str(e)})
@@ -247,7 +308,8 @@ def tpu_bench(bench: str, sizes: List[int], algos: List[str], iters: int,
 # CLI
 # ---------------------------------------------------------------------------
 
-ALL_BENCHES = ["latency", "bcast", "reduce", "allreduce", "allgather", "alltoall"]
+ALL_BENCHES = ["latency", "bw", "bcast", "reduce", "allreduce", "allgather",
+               "alltoall"]
 DEFAULT_ALGOS = {
     "allreduce": ["ring", "recursive_halving", "fused"],  # + pallas_ring (tpu, opt-in)
     "bcast": ["tree", "fused"],
@@ -255,25 +317,38 @@ DEFAULT_ALGOS = {
     "allgather": ["ring", "doubling", "fused"],
     "alltoall": ["pairwise", "fused"],
     "latency": ["-"],
+    "bw": ["-"],
 }
 
 
 def run_bench(bench: str, backend: str, nranks: int, sizes: List[int],
-              algos: List[str], iters: int, warmup: int) -> List[Dict]:
+              algos: List[str], iters: int, warmup: int,
+              algos_explicit: bool = False) -> List[Dict]:
     if backend == "tpu":
+        if bench == "bw":
+            # SPMD has no standalone p2p stream; bandwidth tiers are the
+            # collective sweeps + bench.py's ICI line-rate probe
+            return [{"bench": "bw", "backend": "tpu",
+                     "skipped": "windowed p2p bw is a process-backend bench"}]
         return tpu_bench(bench, sizes, algos, iters, warmup, nranks)
-    # 'fused' is the TPU XLA-collective tier; on CPU backends it would alias
-    # to a schedule whose identity depends on message size — mislabeled rows.
-    algos = [a for a in (algos or []) if a != "fused"] or ["auto"]
+    if not algos_explicit:
+        # 'fused'/'pallas_ring' are TPU-backend tiers; drop them from the
+        # DEFAULT list on CPU backends ('fused' would alias to a size-
+        # dependent schedule — mislabeled rows).  Explicitly requested
+        # algorithms pass through and fail loudly per-row instead.
+        algos = [a for a in (algos or [])
+                 if a not in ("fused", "pallas_ring")] or ["auto"]
     if backend == "local":
         results = mpi_tpu.run_local(
             cpu_bench_program, nranks,
             args=(bench, sizes, algos, iters, warmup))
         rows = results[0]
-    else:  # socket: must already be under the launcher
+    else:  # socket/shm: must already be under the launcher
         if "MPI_TPU_RANK" in os.environ:
             rows = cpu_bench_program(mpi_tpu.init(), bench, sizes, algos,
                                      iters, warmup)
+            # label with the transport the launcher actually selected
+            backend = os.environ.get("MPI_TPU_BACKEND", backend)
         else:
             raise SystemExit(
                 "backend=socket must run under the launcher:\n"
@@ -290,7 +365,7 @@ def main(argv=None) -> int:
     ap.add_argument("--bench", default="allreduce",
                     choices=ALL_BENCHES + ["all"])
     ap.add_argument("--backend", default="local",
-                    choices=["socket", "local", "tpu"])
+                    choices=["socket", "shm", "local", "tpu"])
     ap.add_argument("-n", "--nranks", type=int, default=4)
     ap.add_argument("--sizes", default="1KB:1MB:8")
     ap.add_argument("--algorithms", default=None,
@@ -307,7 +382,8 @@ def main(argv=None) -> int:
         algos = (args.algorithms.split(",") if args.algorithms
                  else DEFAULT_ALGOS[bench])
         rows = run_bench(bench, args.backend, args.nranks, sizes, algos,
-                         args.iters, args.warmup)
+                         args.iters, args.warmup,
+                         algos_explicit=args.algorithms is not None)
         for row in rows:
             line = json.dumps(row)
             print(line)

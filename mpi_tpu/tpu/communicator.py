@@ -130,7 +130,13 @@ class TpuCommunicator(Communicator):
 
     @property
     def _on_cpu(self) -> bool:
-        return self.mesh.devices.flat[0].platform == "cpu"
+        try:
+            devices = self.mesh.devices
+        except ValueError:  # AbstractMesh (AOT lowering): target backend
+            import jax
+
+            return jax.default_backend() == "cpu"
+        return devices.flat[0].platform == "cpu"
 
     def _world_pairs(self, group_pairs: Sequence[Pair]) -> List[Pair]:
         """Expand group-local (src, dst) pairs to world-level ppermute pairs
@@ -233,6 +239,31 @@ class TpuCommunicator(Communicator):
 
         return _jax.tree.map(
             lambda x: algos._ensure_varying(jnp.asarray(x), self.axis_name), obj)
+
+    def replicate(self, obj, root: int = 0):
+        """Brand a VALUE-replicated but vma-varying pytree as replicated
+        over this comm's axis — the inverse of :meth:`localize`.
+
+        Hand-scheduled collectives (``algorithm='ring'`` / ``'tree'`` /
+        ``'pallas_ring'``) produce results that equal on every rank but are
+        opaque to shard_map's varying-axes inference, so a replicated
+        out_spec rejects them under ``check_vma=True``.  This routes the
+        value through ONE fused masked-psum (take root's copy, sum the
+        zeros elsewhere) — value-preserving, and typed replicated.  Costs
+        one real collective; skip it (or use ``check_vma=False``) on paths
+        where that matters."""
+        idx = lax.axis_index(self.axis_name) if self._groups is None else self.rank
+        root_t = jnp.asarray(root)
+
+        def one(x):
+            x = jnp.asarray(x)
+            masked = jnp.where(idx == root_t, x, jnp.zeros_like(x))
+            return lax.psum(masked, self.axis_name,
+                            axis_index_groups=self._groups)
+
+        import jax as _jax
+
+        return _jax.tree.map(one, obj)
 
     def exchange(self, obj, pairs: Sequence[Pair], fill: Any = None):
         """Static-pattern p2p: every (src, dst) in ``pairs`` (group-local
@@ -343,8 +374,8 @@ class TpuCommunicator(Communicator):
             return algos.ring_allreduce(x, self.axis_name, self.size, self.rank,
                                         self._world_pairs, op)
         if algorithm == "pallas_ring":
-            # in-kernel RDMA ring (mpi_tpu/tpu/pallas_ring.py): float32 SUM
-            # over the whole axis; interpreter on the CPU simulator
+            # in-kernel pipelined RDMA ring (mpi_tpu/tpu/pallas_ring.py):
+            # f32/bf16 SUM over the whole axis; interpreter on the CPU sim
             if self._groups is not None:
                 raise NotImplementedError(
                     "pallas_ring runs on the full axis (ungrouped comms) for now")
@@ -481,6 +512,18 @@ class TpuCommunicator(Communicator):
         if algorithm == "ring":
             return algos.ring_reduce_scatter(x, self.axis_name, self.size,
                                              self.rank, self._world_pairs, op)
+        if algorithm == "pallas_ring":
+            # in-kernel RDMA ring, reduce-scatter half only (the ZeRO
+            # gradient-sharding primitive at half the allreduce traffic)
+            if self._groups is not None:
+                raise NotImplementedError(
+                    "pallas_ring runs on the full axis (ungrouped comms) for now")
+            if op.name != "sum":
+                raise NotImplementedError("pallas_ring supports SUM only for now")
+            from .pallas_ring import pallas_ring_reduce_scatter
+
+            return pallas_ring_reduce_scatter(x, self.axis_name, self.size,
+                                              interpret=self._on_cpu)
         raise ValueError(f"unknown reduce_scatter algorithm {algorithm!r}")
 
     def scatter(self, objs, root: int = 0):

@@ -1,30 +1,49 @@
-"""Hand-rolled ring allreduce as a Pallas TPU kernel (RDMA over ICI).
+"""Hand-rolled ring collectives as Pallas TPU kernels (RDMA over ICI).
 
 SURVEY.md §7 Milestone 3 anticipated this: "possibly a Pallas DMA ring if
-XLA's ppermute chaining leaves bandwidth on the table".  This kernel is that
-option, exposed as ``allreduce(..., algorithm='pallas_ring')``:
+XLA's ppermute chaining leaves bandwidth on the table".  Exposed as
+``allreduce(..., algorithm='pallas_ring')`` and
+``reduce_scatter(..., algorithm='pallas_ring')``.
 
-* the buffer lives in HBM as P chunks; the classic 2(P-1)-step ring runs
-  INSIDE one kernel: reduce-scatter (P-1 inter-chip RDMAs + tiled VMEM adds)
-  then allgather (P-1 RDMAs written directly into the symmetric output
-  buffer on the neighbor);
-* per-step chunk transfers are chip-to-chip `make_async_remote_copy` DMAs —
-  no per-step kernel launches, no XLA-inserted copies between steps;
-* accumulation stages HBM→VMEM in `tile_rows`×128 tiles (VMEM is ~16 MB;
-  chunks can be tens of MB for the 256 MB north-star buffer);
-* a neighbor barrier (barrier semaphore) closes each step so the
-  double-buffered landing zone can never be overrun on hardware.  The
-  barrier is skipped under the Pallas interpreter (remote semaphore signal
-  is unimplemented there); interpreter runs validate the data path on the
-  virtual CPU mesh.
+Design (v2 — pipelined; v1 serialized every step behind an RDMA wait and a
+2-signal neighbor barrier, VERDICT round 1 "what's weak" #3):
 
-Restrictions (v1, diagnosed): float32, SUM, the full (ungrouped) axis.
+* One unified ring of ``2(P-1)`` steps inside a single kernel: steps
+  ``0..P-2`` are the reduce-scatter half (RDMA lands in a double-buffered
+  comm buffer, gets accumulated into the working copy), steps
+  ``P-1..2P-3`` are the allgather half (RDMA lands DIRECTLY in the
+  symmetric slice of the neighbor's output — no staging, no extra copy).
+* **Segment pipelining**: each chunk is split into K segments with
+  per-(parity, segment) DMA semaphores.  A segment's step-``u+1`` RDMA
+  starts the moment its step-``u`` accumulation stores — so while segment
+  i+1 of step u is still landing/accumulating, segment i of step u+1 is
+  already on the wire.  The RDMA ring streams behind the compute instead
+  of strictly alternating with it.
+* **Credit flow control** replaces the per-step neighbor barrier: after a
+  device consumes landing slot (parity, seg) it signals one credit to its
+  LEFT neighbor (the writer of that slot); a sender re-using the slot two
+  steps later first waits for that credit.  Cost: one remote semaphore
+  signal per consumed segment, off the critical path — versus v1's two
+  signals + a blocking wait per step for every device in lockstep.
+* Entry/exit neighbor barriers (one each) still bracket the kernel so an
+  RDMA can never land on a chip whose kernel hasn't started / has exited.
+* Accumulation stages HBM→VMEM in ``tile_rows``×128 tiles (VMEM is
+  ~16 MB; chunks can be tens of MB for the 256 MB north-star buffer).
+
+Under the Pallas **interpreter** (the CPU-mesh test path) remote
+semaphore signalling is unavailable, so barriers/credits are skipped and
+every RDMA is started+waited serially — same data path, no pipelining;
+the overlap logic itself is exercised by the AOT compile checks in the
+real-TPU test tier (tests/test_tpu_real.py).
+
+Supported: float32 AND bfloat16, SUM, the full (ungrouped) axis.
+Diagnosed restrictions: other dtypes/ops, grouped sub-communicators.
 """
 
 from __future__ import annotations
 
 import functools
-from typing import Tuple
+from typing import List, Tuple
 
 import numpy as np
 
@@ -35,24 +54,89 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 _LANES = 128
-_SUBLANES = 8  # float32 min tile height
+_SUBLANES = {jnp.dtype(jnp.float32): 8, jnp.dtype(jnp.bfloat16): 16}
+_MAX_SEGMENTS = 4
+
+
+def _segments(total_tiles: int) -> List[Tuple[int, int]]:
+    """Split a chunk of ``total_tiles`` row-tiles into ≤_MAX_SEGMENTS
+    contiguous (first_tile, num_tiles) pieces for the pipeline."""
+    k = min(_MAX_SEGMENTS, total_tiles)
+    base, extra = divmod(total_tiles, k)
+    segs, t0 = [], 0
+    for s in range(k):
+        n = base + (1 if s < extra else 0)
+        segs.append((t0, n))
+        t0 += n
+    return segs
 
 
 def _kernel(x_hbm, out_hbm, comm_hbm, a_vmem, b_vmem,
-            copy_sem_a, copy_sem_b, send_sem, recv_sem, *,
+            copy_sem_a, copy_sem_b, send_sem, recv_sem, credit_sem, *,
             axis_name: str, size: int, rows: int, tile_rows: int,
-            use_barrier: bool):
+            segs: List[Tuple[int, int]], rot: int, allgather: bool,
+            pipelined: bool):
+    """``rot`` shifts the chunk schedule: 0 → the ring ends with rank r
+    owning chunk (r+1)%P (allreduce layout); -1 → rank r owns chunk r
+    (reduce_scatter layout).  ``allgather=False`` stops after the
+    reduce-scatter half."""
     my = lax.axis_index(axis_name)
     right = lax.rem(my + 1, size)
     left = lax.rem(my - 1 + size, size)
+    P = size
+    n_rs = P - 1                       # reduce-scatter steps: u in [0, P-1)
+    n_steps = 2 * (P - 1) if allgather else n_rs
 
-    # working copy: out <- x (HBM -> HBM local DMA)
-    init = pltpu.make_async_copy(x_hbm, out_hbm, copy_sem_a)
-    init.start()
-    init.wait()
+    def send_chunk(u):
+        # chunk forwarded at step u (RS: the one accumulated at u-1;
+        # AG: the one received at u-1)
+        return lax.rem(my - u + rot + 2 * P, P)
+
+    def accum_chunk(u):
+        return lax.rem(my - u - 1 + rot + 2 * P, P)
+
+    def rdma(u, seg):
+        """The step-u RDMA for segment seg (symmetric SPMD descriptor:
+        names my outgoing copy AND the incoming one via my recv_sem)."""
+        t0, nt = segs[seg]
+        r0, nr = t0 * tile_rows, nt * tile_rows
+        slot = u % 2
+        if u < n_rs:  # reduce-scatter: land in the comm buffer
+            src = out_hbm.at[pl.ds(send_chunk(u) * rows + r0, nr)]
+            dst = comm_hbm.at[slot, pl.ds(r0, nr)]
+        else:         # allgather: land straight in the neighbor's output
+            # AG step a sends chunk (my+1-a) ≡ (my-u) mod P for u=P-1+a —
+            # the same unified send_chunk(u) as the RS half
+            c = send_chunk(u)
+            src = out_hbm.at[pl.ds(c * rows + r0, nr)]
+            dst = out_hbm.at[pl.ds(c * rows + r0, nr)]
+        return pltpu.make_async_remote_copy(
+            src_ref=src, dst_ref=dst,
+            send_sem=send_sem.at[slot, seg], recv_sem=recv_sem.at[slot, seg],
+            device_id=right, device_id_type=pltpu.DeviceIdType.LOGICAL)
+
+    def start_send(u, seg):
+        if pipelined:
+            if u >= 2:
+                # send-sem hygiene: my step-(u-2) send on this (slot, seg)
+                # must have fully left before the semaphore is re-armed
+                rdma(u - 2, seg).wait_send()
+                # flow control, BOTH halves: right re-uses this (parity,
+                # seg) recv semaphore from step u-2.  In the RS half its
+                # landing slot is also recycled (buffer hazard); in the AG
+                # half destinations are distinct but the counting recv
+                # semaphore is not — if this RDMA completed before the
+                # step-u-1 one, right's wait_recv(u-1) would unblock on
+                # OUR bytes and forward a chunk that hasn't landed.  So
+                # never run more than 2 steps ahead of right's consumption.
+                pltpu.semaphore_wait(credit_sem.at[u % 2, seg], 1)
+            rdma(u, seg).start()
+        else:
+            rdma(u, seg).start()
+            rdma(u, seg).wait()
 
     def neighbor_barrier():
-        if not use_barrier:
+        if not pipelined:
             return
         bar = pltpu.get_barrier_semaphore()
         pltpu.semaphore_signal(bar, inc=1, device_id=left,
@@ -61,61 +145,68 @@ def _kernel(x_hbm, out_hbm, comm_hbm, a_vmem, b_vmem,
                                device_id_type=pltpu.DeviceIdType.LOGICAL)
         pltpu.semaphore_wait(bar, 2)
 
-    # entry sync: the first RDMA must not land on a chip whose kernel hasn't
-    # started (execution skew would let it write scratch not yet owned)
+    # working copy: out <- x (HBM -> HBM local DMA)
+    init = pltpu.make_async_copy(x_hbm, out_hbm, copy_sem_a)
+    init.start()
+    init.wait()
+
+    # entry sync: the first RDMA must not land on a chip whose kernel
+    # hasn't started (execution skew would let it write unowned scratch)
     neighbor_barrier()
 
-    # ---- phase 1: reduce-scatter ring --------------------------------
-    for s in range(size - 1):
-        slot = s % 2
-        si = lax.rem(my - s + size, size)       # chunk I forward
-        ri = lax.rem(my - s - 1 + size, size)   # chunk I accumulate
-        rdma = pltpu.make_async_remote_copy(
-            src_ref=out_hbm.at[pl.ds(si * rows, rows)],
-            dst_ref=comm_hbm.at[slot],
-            send_sem=send_sem.at[slot],
-            recv_sem=recv_sem.at[slot],
-            device_id=right,
-            device_id_type=pltpu.DeviceIdType.LOGICAL,
-        )
-        rdma.start()
-        rdma.wait()  # my data left AND my left neighbor's chunk landed
-        for t in range(rows // tile_rows):
-            row0 = ri * rows + t * tile_rows
-            cp_a = pltpu.make_async_copy(
-                out_hbm.at[pl.ds(row0, tile_rows)], a_vmem, copy_sem_a)
-            cp_b = pltpu.make_async_copy(
-                comm_hbm.at[slot, pl.ds(t * tile_rows, tile_rows)],
-                b_vmem, copy_sem_b)
-            cp_a.start()
-            cp_b.start()
-            cp_a.wait()
-            cp_b.wait()
-            a_vmem[:] = a_vmem[:] + b_vmem[:]
-            cp_out = pltpu.make_async_copy(
-                a_vmem, out_hbm.at[pl.ds(row0, tile_rows)], copy_sem_a)
-            cp_out.start()
-            cp_out.wait()
-        neighbor_barrier()
+    # warm-up: step-0 sends carry original data — no dependency
+    for seg in range(len(segs)):
+        start_send(0, seg)
 
-    # ---- phase 2: allgather ring -------------------------------------
-    # rank r now owns fully-reduced chunk (r+1) % P; forward it around.
-    # The receiving neighbor expects exactly the chunk index we send, so the
-    # RDMA writes straight into the symmetric slice of their output buffer.
-    for s in range(size - 1):
-        slot = s % 2
-        ci = lax.rem(my + 1 - s + size, size)
-        rdma = pltpu.make_async_remote_copy(
-            src_ref=out_hbm.at[pl.ds(ci * rows, rows)],
-            dst_ref=out_hbm.at[pl.ds(ci * rows, rows)],
-            send_sem=send_sem.at[slot],
-            recv_sem=recv_sem.at[slot],
-            device_id=right,
-            device_id_type=pltpu.DeviceIdType.LOGICAL,
-        )
-        rdma.start()
-        rdma.wait()
-        neighbor_barrier()
+    for u in range(n_steps):
+        slot = u % 2
+        for seg in range(len(segs)):
+            t0, nt = segs[seg]
+            if pipelined:
+                rdma(u, seg).wait_recv()  # segment landed from the left
+            if u < n_rs:
+                # accumulate landing[slot, seg] into out[accum_chunk, seg]
+                ci = accum_chunk(u)
+                for t in range(t0, t0 + nt):
+                    row0 = ci * rows + t * tile_rows
+                    cp_a = pltpu.make_async_copy(
+                        out_hbm.at[pl.ds(row0, tile_rows)], a_vmem,
+                        copy_sem_a)
+                    cp_b = pltpu.make_async_copy(
+                        comm_hbm.at[slot, pl.ds(t * tile_rows, tile_rows)],
+                        b_vmem, copy_sem_b)
+                    cp_a.start()
+                    cp_b.start()
+                    cp_a.wait()
+                    cp_b.wait()
+                    a_vmem[:] = a_vmem[:] + b_vmem[:]
+                    cp_out = pltpu.make_async_copy(
+                        a_vmem, out_hbm.at[pl.ds(row0, tile_rows)],
+                        copy_sem_a)
+                    cp_out.start()
+                    cp_out.wait()
+            if pipelined and u + 2 < n_steps:
+                # step-u consumption done (RS: landing slot accumulated;
+                # AG: chunk landed) → credit the writer (my left), which
+                # re-arms this (parity, seg) at step u+2.  Guarded so
+                # every credit is consumed and the semaphore drains to
+                # zero by kernel exit (Mosaic checks).
+                pltpu.semaphore_signal(
+                    credit_sem.at[slot, seg], inc=1, device_id=left,
+                    device_id_type=pltpu.DeviceIdType.LOGICAL)
+            # this segment is now ready for the next hop
+            if u + 1 < n_steps:
+                start_send(u + 1, seg)
+
+    if pipelined:
+        # drain: my two newest sends per segment are still only started
+        for seg in range(len(segs)):
+            if n_steps >= 2:
+                rdma(n_steps - 2, seg).wait_send()
+            rdma(n_steps - 1, seg).wait_send()
+    # exit sync: don't let this chip's NEXT collective race a straggling
+    # neighbor still reading its landing zone
+    neighbor_barrier()
 
 
 def _geometry(n: int, size: int, tile_rows: int) -> Tuple[int, int]:
@@ -126,20 +217,41 @@ def _geometry(n: int, size: int, tile_rows: int) -> Tuple[int, int]:
     return rows, size * rows * _LANES
 
 
-def pallas_ring_allreduce(x: jnp.ndarray, axis_name: str, size: int,
-                          tile_rows: int = 256,
-                          interpret: bool = False) -> jnp.ndarray:
-    """SUM-allreduce ``x`` (float32) over ``axis_name`` with the in-kernel
-    RDMA ring.  Call inside shard_map over a mesh with that axis."""
-    if x.dtype != jnp.float32:
+def _check_args(x: jnp.ndarray, axis_name: str, size: int, tile_rows: int,
+                op: str) -> jnp.dtype:
+    dtype = jnp.dtype(x.dtype)
+    if dtype not in _SUBLANES:
         raise NotImplementedError(
-            f"pallas_ring allreduce is float32-only for now, got {x.dtype}")
-    if tile_rows % _SUBLANES or tile_rows < _SUBLANES:
+            f"pallas_ring supports float32/bfloat16 for now, got {x.dtype}")
+    if op != "sum":
+        raise NotImplementedError(
+            f"pallas_ring supports SUM for now, got {op!r}")
+    sub = _SUBLANES[dtype]
+    if tile_rows % sub or tile_rows < sub:
         raise ValueError(
-            f"tile_rows must be a positive multiple of {_SUBLANES} "
-            f"(float32 sublane tile), got {tile_rows}")
-    if size == 1:
-        return x
+            f"tile_rows must be a positive multiple of {sub} "
+            f"({dtype} sublane tile), got {tile_rows}")
+    # vma typing may be active even when the payload is replicated; probe
+    # with axis_index, which is varying exactly when check_vma is on
+    try:
+        vma_on = bool(jax.typeof(lax.axis_index(axis_name)).vma)
+    except (AttributeError, NameError):
+        vma_on = False  # no vma typing / not under shard_map (yet)
+    if vma_on:
+        raise ValueError(
+            "pallas_ring needs check_vma=False on the enclosing shard_map "
+            "(Pallas kernels don't participate in varying-axes inference): "
+            "run_spmd(..., check_vma=False) or jax.shard_map(..., "
+            "check_vma=False)")
+    return dtype
+
+
+def _launch(x: jnp.ndarray, axis_name: str, size: int, tile_rows: int,
+            interpret: bool, rot: int, allgather: bool,
+            collective_id: int) -> jnp.ndarray:
+    """Shared pallas_call setup for both ring collectives; returns the
+    padded [size*rows, _LANES] result grid."""
+    dtype = jnp.dtype(x.dtype)
     shape = x.shape
     n = int(np.prod(shape)) if shape else 1
     rows, padded = _geometry(n, size, tile_rows)
@@ -147,40 +259,82 @@ def pallas_ring_allreduce(x: jnp.ndarray, axis_name: str, size: int,
     if padded != n:
         flat = jnp.pad(flat, (0, padded - n))
     grid_in = flat.reshape(size * rows, _LANES)
-
-    # vma typing may be active even when the payload is replicated; probe
-    # with axis_index, which is varying exactly when check_vma is on
-    try:
-        vma_on = bool(jax.typeof(lax.axis_index(axis_name)).vma)
-    except AttributeError:
-        vma_on = False
-    if vma_on:
-        raise ValueError(
-            "pallas_ring needs check_vma=False on the enclosing shard_map "
-            "(Pallas kernels don't participate in varying-axes inference): "
-            "run_spmd(..., check_vma=False) or jax.shard_map(..., "
-            "check_vma=False)")
+    segs = _segments(rows // tile_rows)
 
     kern = functools.partial(
         _kernel, axis_name=axis_name, size=size, rows=rows,
-        tile_rows=tile_rows, use_barrier=not interpret)
+        tile_rows=tile_rows, segs=segs, rot=rot, allgather=allgather,
+        pipelined=not interpret)
     compiler_params = None if interpret else pltpu.CompilerParams(
-        collective_id=13, has_side_effects=True)
-    out = pl.pallas_call(
+        collective_id=collective_id, has_side_effects=True)
+    k = len(segs)
+    return pl.pallas_call(
         kern,
-        out_shape=jax.ShapeDtypeStruct((size * rows, _LANES), jnp.float32),
+        out_shape=jax.ShapeDtypeStruct((size * rows, _LANES), dtype),
         in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
         out_specs=pl.BlockSpec(memory_space=pl.ANY),
         scratch_shapes=[
-            pl.ANY((2, rows, _LANES), jnp.float32),      # RDMA landing zone
-            pltpu.VMEM((tile_rows, _LANES), jnp.float32),
-            pltpu.VMEM((tile_rows, _LANES), jnp.float32),
+            pl.ANY((2, rows, _LANES), dtype),            # RDMA landing zone
+            pltpu.VMEM((tile_rows, _LANES), dtype),
+            pltpu.VMEM((tile_rows, _LANES), dtype),
             pltpu.SemaphoreType.DMA(()),
             pltpu.SemaphoreType.DMA(()),
-            pltpu.SemaphoreType.DMA((2,)),
-            pltpu.SemaphoreType.DMA((2,)),
+            pltpu.SemaphoreType.DMA((2, k)),             # send (parity, seg)
+            pltpu.SemaphoreType.DMA((2, k)),             # recv (parity, seg)
+            pltpu.SemaphoreType.REGULAR((2, k)),         # landing credits
         ],
         compiler_params=compiler_params,
         interpret=interpret,
     )(grid_in)
+
+
+def pallas_ring_allreduce(x: jnp.ndarray, axis_name: str, size: int,
+                          tile_rows: int = 256,
+                          interpret: bool = False) -> jnp.ndarray:
+    """SUM-allreduce ``x`` (f32/bf16) over ``axis_name`` with the in-kernel
+    pipelined RDMA ring.  Call inside shard_map over a mesh with that
+    axis."""
+    _check_args(x, axis_name, size, tile_rows, "sum")
+    if size == 1:
+        return x
+    shape = x.shape
+    n = int(np.prod(shape)) if shape else 1
+    out = _launch(x, axis_name, size, tile_rows, interpret,
+                  rot=0, allgather=True, collective_id=13)
     return out.reshape(-1)[:n].reshape(shape)
+
+
+def pallas_ring_reduce_scatter(x: jnp.ndarray, axis_name: str, size: int,
+                               tile_rows: int = 256,
+                               interpret: bool = False) -> jnp.ndarray:
+    """SUM-reduce_scatter_block (the ZeRO primitive): ``x`` is the full
+    [P*block, ...] stack on every rank; rank r returns block r reduced
+    over all ranks.  Runs ONLY the reduce-scatter half of the ring —
+    half the wire traffic of the allreduce.
+
+    ``x``'s leading dimension must equal ``size`` (the communicator's
+    stacked-blocks convention, matching ``lax.psum_scatter`` tiled=False)."""
+    if x.ndim == 0 or x.shape[0] != size:
+        raise ValueError(
+            f"reduce_scatter needs leading dimension == ring size {size} "
+            f"(one block per rank), got shape {x.shape}")
+    _check_args(x, axis_name, size, tile_rows, "sum")
+    if size == 1:
+        return x[0]
+    block_shape = x.shape[1:]
+    block_n = int(np.prod(block_shape))
+    rows, _ = _geometry(block_n * size, size, tile_rows)
+    # lay each BLOCK into its own chunk of the grid so chunk boundaries
+    # align with block boundaries (per-block zero padding)
+    per_chunk = rows * _LANES
+    blocks = x.reshape(size, block_n)
+    pad = per_chunk - block_n
+    if pad:
+        blocks = jnp.pad(blocks, ((0, 0), (0, pad)))
+    grid = blocks.reshape(-1)
+    out = _launch(grid, axis_name, size, tile_rows, interpret,
+                  rot=-1, allgather=False, collective_id=14)
+    my = lax.axis_index(axis_name)
+    mine = lax.dynamic_slice(out.reshape(size, per_chunk), (my, 0),
+                             (1, per_chunk))
+    return mine.reshape(-1)[:block_n].reshape(block_shape)

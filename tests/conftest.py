@@ -1,18 +1,25 @@
 """Test fixture environment (SURVEY.md §4 item 2): force an 8-device virtual
 CPU platform BEFORE jax initializes, so every SPMD/mesh test runs multi-device
-on any machine.  CPU-backend tests don't touch jax and are unaffected."""
+on any machine.  CPU-backend tests don't touch jax and are unaffected.
+
+Real-TPU tier (SURVEY.md §4 item 3): ``MPI_TPU_TEST_TPU=1 pytest -m tpu``
+leaves the platform alone so tests/test_tpu_real.py runs on the actual
+chip; without the env var those tests see the CPU platform and self-skip."""
 
 import os
 
-flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
-os.environ["JAX_PLATFORMS"] = "cpu"
-os.environ.setdefault("JAX_ENABLE_X64", "0")
+if not os.environ.get("MPI_TPU_TEST_TPU"):
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8").strip()
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ.setdefault("JAX_ENABLE_X64", "0")
 
-# The axon site hook (this machine's TPU tunnel) force-registers its platform
-# via jax.config, overriding JAX_PLATFORMS — override it back before any
-# backend initializes so the suite runs on the 8 virtual CPU devices.
-import jax  # noqa: E402
+    # The axon site hook (this machine's TPU tunnel) force-registers its
+    # platform via jax.config, overriding JAX_PLATFORMS — override it back
+    # before any backend initializes so the suite runs on the 8 virtual CPU
+    # devices.
+    import jax
 
-jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_platforms", "cpu")

@@ -52,3 +52,23 @@ def test_tpu_smoke(bench):
     for r in rows:
         assert r["p50_us"] > 0
         assert r["busbw_gbps"] >= 0
+
+
+@pytest.mark.slow
+def test_gen_baseline_quick_regenerates(tmp_path, monkeypatch):
+    """The BASELINE.md generator runs its full matrix end-to-end in quick
+    mode and renders every section (the no-hand-edited-numbers contract)."""
+    import benchmarks.gen_baseline as gb
+
+    monkeypatch.setattr(gb, "RESULTS", str(tmp_path))
+    monkeypatch.setattr(gb, "JSONL", str(tmp_path / "baseline.jsonl"))
+    rows = gb.measure(quick=True)
+    ok = [r for r in rows if "error" not in r and "skipped" not in r]
+    assert len(ok) > 20, rows
+    text = gb.render(rows, quick=True)
+    for section in ("Ring vs recursive-halving", "Tree bcast / reduce",
+                    "Allgather / alltoall", "latency + windowed bandwidth",
+                    "North-star"):
+        assert section in text
+    # every backend family reported
+    assert {r.get("backend") for r in ok} >= {"local", "tpu", "socket", "shm"}
