@@ -1,0 +1,122 @@
+"""End-to-end failure story (VERDICT round 1, next-step #6): MPI_Abort's
+kill-all contract under the launcher, and a rank crash mid-collective
+surfacing as a diagnosable error on the survivors — never a hang — on
+BOTH process transports."""
+
+import os
+import subprocess
+import sys
+import textwrap
+import time
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+pytestmark = pytest.mark.slow
+
+ABORT_PROG = textwrap.dedent("""
+    import os, sys, time
+    sys.path.insert(0, {repo!r})
+    import mpi_tpu
+    from mpi_tpu import api
+
+    comm = mpi_tpu.init()
+    if comm.rank == 1:
+        api.MPI_Abort(13)
+    # every other rank would block forever; the launcher must kill them
+    marker = os.environ["MARKER_DIR"] + f"/survived.{{comm.rank}}"
+    try:
+        comm.recv(source=1, tag=9)           # never sent
+    finally:
+        pass
+    open(marker, "w").write("should not get here")
+""")
+
+CRASH_PROG = textwrap.dedent("""
+    import os, sys
+    sys.path.insert(0, {repo!r})
+    import numpy as np
+    import mpi_tpu
+    from mpi_tpu.transport.base import RecvTimeout, TransportError
+
+    comm = mpi_tpu.init()
+    comm.recv_timeout = 10.0  # the failure-detector knob (SURVEY.md §5)
+    if comm.rank == 1:
+        os._exit(42)  # die mid-collective, no cleanup
+    try:
+        # ring allreduce needs rank 1's message: must DIAGNOSE, not hang
+        comm.allreduce(np.ones(4, np.float32), algorithm="ring")
+    except (RecvTimeout, TransportError) as e:
+        print(f"rank {{comm.rank}} diagnosed: {{type(e).__name__}}", flush=True)
+        sys.exit(0)
+    sys.exit(5)  # collective impossibly succeeded
+""")
+
+
+def _launch(nranks, script_path, backend, env_extra=None, timeout=120.0):
+    env = dict(os.environ)
+    env.update(env_extra or {})
+    proc = subprocess.run(
+        [sys.executable, "-m", "mpi_tpu.launcher", "-n", str(nranks),
+         "--backend", backend, str(script_path)],
+        capture_output=True, text=True, cwd=REPO, env=env, timeout=timeout)
+    return proc
+
+
+@pytest.mark.parametrize("backend", ["socket", "shm"])
+def test_mpi_abort_kills_all_and_propagates(tmp_path, backend):
+    """MPI_Abort(13) on rank 1: exit code 13 propagates; ranks 0/2 (blocked
+    in a recv that can never complete) are killed — the run terminates well
+    inside the timeout and no survivor marker is written."""
+    script = tmp_path / "abort.py"
+    script.write_text(ABORT_PROG.format(repo=REPO))
+    t0 = time.monotonic()
+    proc = _launch(3, script, backend,
+                   env_extra={"MARKER_DIR": str(tmp_path)}, timeout=180.0)
+    took = time.monotonic() - t0
+    assert proc.returncode == 13, proc.stderr[-800:]
+    assert "MPI_Abort(code=13)" in proc.stderr
+    survivors = [f for f in os.listdir(tmp_path) if f.startswith("survived.")]
+    assert survivors == [], survivors
+    assert took < 120.0  # killed, not timed out
+
+
+@pytest.mark.parametrize("backend", ["socket", "shm"])
+def test_rank_crash_under_launcher_propagates_promptly(tmp_path, backend):
+    """Rank 1 dies (os._exit 42, no close handshake) mid-collective under
+    the launcher: code 42 propagates and the surviving rank is killed long
+    before any timeout — the L0 kill-all contract."""
+    script = tmp_path / "crash.py"
+    script.write_text(CRASH_PROG.format(repo=REPO))
+    t0 = time.monotonic()
+    proc = _launch(2, script, backend, timeout=180.0)
+    took = time.monotonic() - t0
+    assert proc.returncode == 42, proc.stderr[-500:]
+    assert took < 120.0  # killed, not hung to the harness timeout
+
+
+@pytest.mark.parametrize("backend", ["socket", "shm"])
+def test_rank_crash_without_launcher_diagnosed(tmp_path, backend):
+    """WITHOUT the launcher's kill-all, the survivor's transport itself
+    must surface the dead peer: the ring-allreduce recv raises
+    RecvTimeout/TransportError (the SURVEY §5 failure-detection analogue)
+    instead of hanging."""
+    script = tmp_path / "crash.py"
+    script.write_text(CRASH_PROG.format(repo=REPO))
+    rdv = tmp_path / "rdv"
+    rdv.mkdir()
+    procs = []
+    for r in range(2):
+        env = dict(os.environ)
+        env.update({"MPI_TPU_RANK": str(r), "MPI_TPU_SIZE": "2",
+                    "MPI_TPU_RDV": str(rdv), "MPI_TPU_BACKEND": backend})
+        procs.append(subprocess.Popen(
+            [sys.executable, str(script)], env=env, cwd=REPO,
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True))
+    out0, err0 = procs[0].communicate(timeout=150.0)
+    procs[1].wait(timeout=30.0)
+    assert procs[1].returncode == 42
+    assert "rank 0 diagnosed:" in out0, (
+        f"stdout={out0[-500:]!r} stderr={err0[-800:]!r}")
+    assert procs[0].returncode == 0, err0[-500:]
