@@ -1,0 +1,99 @@
+"""Regression tier for the driver's multichip entry points (VERDICT r2 #1).
+
+Round 2's red real-TPU test was a grouped ``lax.psum`` of an axis-invariant
+operand: jax 0.9's vma typing has NO grouped psum (``bind_psum_invariant``
+raises ``NotImplementedError`` for any ``axis_index_groups``), and the CPU
+sim never noticed because ``_fused_allreduce`` detoured grouped sums there —
+so ``dryrun_multichip ok`` was CPU-only evidence.  These tests *lower* (not
+just run) the same program on the CPU mesh, through the exact code path the
+TPU toolchain compiles (the detour is gone: grouped fused SUM is now
+``psum_scatter + all_gather`` on every platform,
+mpi_tpu/tpu/communicator.py ``_grouped_psum``).
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from mpi_tpu import ops
+from mpi_tpu.tpu import TpuCommunicator, default_mesh, run_spmd
+
+import __graft_entry__ as ge
+
+
+def test_lower_multichip_8():
+    """The FULL dryrun step traces + lowers (AbstractMesh, 8 devices)."""
+    lowered = ge.lower_multichip(8)
+    text = lowered.as_text()
+    assert "stablehlo" in text or "module" in text
+
+
+def test_dryrun_runs():
+    ge.dryrun_multichip(8)
+
+
+@pytest.mark.parametrize("invariant", [True, False])
+def test_grouped_fused_allreduce_of_any_vma(invariant):
+    """Grouped fused SUM accepts both replicated and varying operands.
+
+    The replicated case is the round-2 red test (loss replicated over 'mp'
+    after a tp-allreduce, then grouped-allreduced on the split comm)."""
+    mesh = default_mesh()
+    world = TpuCommunicator("world", mesh)
+    halves = world.split_by(lambda i: i // 4)
+
+    def prog(comm, x):
+        mine = x[comm.rank]
+        v = comm.allreduce(mine, algorithm="fused") if invariant else mine
+        return halves.allreduce(v, algorithm="fused")
+
+    x = np.arange(8.0, dtype=np.float32)
+    out = np.asarray(run_spmd(prog, x, mesh=mesh)).ravel()
+    if invariant:
+        # v = full-axis sum (replicated), then ×4 per half-group
+        np.testing.assert_allclose(out, np.full(8, x.sum() * 4, np.float32))
+    else:
+        lo, hi = x[:4].sum(), x[4:].sum()
+        np.testing.assert_allclose(out, [lo] * 4 + [hi] * 4)
+
+
+def test_grouped_fused_bcast_and_replicate_lower():
+    """bcast('fused') and replicate() on a split comm trace under
+    check_vma=True (both previously emitted the unimplementable grouped
+    psum)."""
+    mesh = default_mesh()
+    world = TpuCommunicator("world", mesh)
+    halves = world.split_by(lambda i: i // 4)
+
+    def prog(comm, x):
+        mine = x[comm.rank]
+        b = halves.bcast(mine, root=1, algorithm="fused")
+        r = halves.replicate(halves.allreduce(mine, algorithm="ring"))
+        return b + r
+
+    x = np.arange(8.0, dtype=np.float32)
+    out = np.asarray(run_spmd(prog, x, mesh=mesh)).ravel()
+    lo, hi = x[:4].sum(), x[4:].sum()
+    np.testing.assert_allclose(out, [x[1] + lo] * 4 + [x[5] + hi] * 4)
+
+
+def test_grouped_psum_scalar_and_odd_shapes():
+    """_grouped_psum pads non-multiples of the group size correctly."""
+    mesh = default_mesh()
+    world = TpuCommunicator("world", mesh)
+    quarters = world.split_by(lambda i: i // 2)  # 4 groups of 2
+
+    rng = np.random.RandomState(3)
+    for shape in [(), (1,), (3,), (5, 3)]:
+        def prog(comm, x):
+            return quarters.allreduce(x[comm.rank], algorithm="fused")
+
+        x = rng.randn(8, *shape).astype(np.float32)
+        out = np.asarray(run_spmd(prog, x, mesh=mesh)).reshape((8,) + shape)
+        for r in range(8):
+            g0 = (r // 2) * 2
+            np.testing.assert_allclose(out[r], x[g0] + x[g0 + 1],
+                                       rtol=1e-5, atol=1e-6)
