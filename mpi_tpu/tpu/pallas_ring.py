@@ -36,6 +36,23 @@ every RDMA is started+waited serially — same data path, no pipelining;
 the overlap logic itself is exercised by the AOT compile checks in the
 real-TPU test tier (tests/test_tpu_real.py).
 
+**Protocol invariants** — verified by the discrete-event model in
+``mpi_tpu/tpu/ring_model.py`` (exhaustive interleaving search for small
+(P, K); adversarial schedules with payload tracking up to P=8, K=4 —
+tests/test_pallas_protocol.py), since the pipelined path cannot execute
+on fewer than two chips:
+
+1. no deadlock under any event ordering respecting semaphore semantics
+   (each semaphore has a single waiter, so the op graph is a
+   conflict-free Petri net — verified, not assumed);
+2. an RDMA never lands in a (parity, segment) slot whose previous
+   payload is unconsumed (the credit handshake's guarantee);
+3. no buffer region is written while an in-flight RDMA reads it, on
+   either end;
+4. every semaphore drains to zero by kernel exit (Mosaic's invariant);
+5. payload correctness under every explored ordering (contribution-set
+   semantics, both collectives).
+
 Supported: float32 AND bfloat16, SUM, the full (ungrouped) axis.
 Diagnosed restrictions: other dtypes/ops, grouped sub-communicators.
 """

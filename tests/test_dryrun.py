@@ -14,11 +14,6 @@ mpi_tpu/tpu/communicator.py ``_grouped_psum``).
 import numpy as np
 import pytest
 
-import jax
-import jax.numpy as jnp
-from jax.sharding import PartitionSpec as P
-
-from mpi_tpu import ops
 from mpi_tpu.tpu import TpuCommunicator, default_mesh, run_spmd
 
 import __graft_entry__ as ge

@@ -1,0 +1,172 @@
+"""Execution evidence for the pipelined Pallas ring protocol (VERDICT r2
+next-step #2).
+
+The pipelined path of ``pallas_ring._kernel`` cannot execute without a
+multi-chip slice (interpreter = serial fallback; one real chip = P=1 early
+return), so its credit flow-control protocol is verified here against the
+discrete-event model in ``mpi_tpu/tpu/ring_model.py``:
+
+* **exhaustively** — every interleaving of device ops and split DMA
+  completions for the small (P, K) where the state space is enumerable;
+* **adversarially** — randomized + worst-case schedules (max-latency,
+  out-of-order LIFO completion, zero-latency) for P up to 8, K up to 4,
+  with full payload tracking;
+* **sensitively** — mutated protocols (credits removed, drain removed,
+  accumulation skipped) must be CAUGHT, proving the checker can fail.
+
+No jax involved: this is a pure-Python semaphore-level simulation.
+"""
+
+import pytest
+
+from mpi_tpu.tpu.ring_model import (
+    Accum, DmaStart, ProtocolViolation, RingSim, Signal, Wait,
+    device_program, explore_all,
+)
+
+ALLREDUCE = dict(rot=0, allgather=True)
+REDUCE_SCATTER = dict(rot=-1, allgather=False)
+
+
+# -- exhaustive: every interleaving of the small configs --------------------
+
+
+@pytest.mark.parametrize("P,K,coll", [
+    (2, 1, ALLREDUCE), (2, 1, REDUCE_SCATTER),
+    (2, 2, ALLREDUCE), (2, 2, REDUCE_SCATTER),
+    (3, 1, ALLREDUCE), (3, 1, REDUCE_SCATTER),
+], ids=["ar2x1", "rs2x1", "ar2x2", "rs2x2", "ar3x1", "rs3x1"])
+def test_exhaustive_no_deadlock_and_drain(P, K, coll):
+    """DFS over the full interleaving space: no reachable state deadlocks,
+    every terminal state has drained semaphores."""
+    visited = explore_all(P, K, **coll)
+    assert visited > 10  # the search actually explored something
+
+
+# -- adversarial schedules at scale, with payload tracking ------------------
+
+
+@pytest.mark.parametrize("policy", ["random", "eager_compute", "lazy_lifo",
+                                    "dma_first"])
+@pytest.mark.parametrize("coll", [ALLREDUCE, REDUCE_SCATTER],
+                         ids=["allreduce", "reduce_scatter"])
+def test_schedules_all_P_K(policy, coll):
+    for P in (2, 3, 4, 5, 8):
+        for K in (1, 2, 3, 4):
+            for seed in range(4):
+                sim = RingSim(P, K, **coll)
+                sim.run(policy=policy, seed=seed)
+                # run() calls check_final: drained sems + exact payloads
+
+
+def test_many_random_seeds_largest_config():
+    for seed in range(50):
+        RingSim(8, 4, **ALLREDUCE).run(policy="random", seed=seed)
+
+
+# -- sensitivity: broken protocols must be caught ---------------------------
+
+
+def _mutate(drop, P=4, K=2, coll=ALLREDUCE):
+    """Run all policies × seeds against a mutated program; return the
+    violations caught."""
+    def prog(my, P_, K_, *, rot, allgather):
+        ops = device_program(my, P_, K_, rot=rot, allgather=allgather)
+        return [op for op in ops if not drop(op)]
+
+    caught = []
+    for policy in ("random", "eager_compute", "lazy_lifo", "dma_first"):
+        for seed in range(10):
+            sim = RingSim(P, K, **coll, program_override=prog)
+            try:
+                sim.run(policy=policy, seed=seed)
+            except ProtocolViolation as e:
+                caught.append(str(e))
+    return caught
+
+
+def test_detector_catches_missing_credit_protocol():
+    """Without the credit handshake a sender can overwrite an unconsumed
+    landing slot — the model must observe it under some schedule."""
+    caught = _mutate(drop=lambda op: (
+        (isinstance(op, Wait) and op.sem[0] == "credit")
+        or (isinstance(op, Signal) and op.sem[0] == "credit")))
+    assert caught, "credit-free protocol ran clean under every schedule"
+    assert any("invariant 2" in c or "landing slot" in c for c in caught)
+
+
+def test_detector_catches_missing_credit_signal_deadlock():
+    """Credits waited on but never signalled: the ring must deadlock."""
+    caught = _mutate(drop=lambda op: (
+        isinstance(op, Signal) and op.sem[0] == "credit"))
+    assert caught and all("DEADLOCK" in c for c in caught)
+
+
+def test_detector_catches_missing_drain():
+    """Without the final wait_send drain, send semaphores survive kernel
+    exit (invariant 4) — or the run ends with DMAs in flight."""
+    def prog(my, P_, K_, *, rot, allgather):
+        ops = device_program(my, P_, K_, rot=rot, allgather=allgather)
+        # drain = the block of ("send",...) waits before the exit barrier
+        exit_bar = len(ops) - 3
+        body = [op for i, op in enumerate(ops)
+                if not (i < exit_bar and i >= exit_bar - 2 * K_
+                        and isinstance(op, Wait) and op.sem[0] == "send")]
+        return body
+
+    K = 2
+    caught = []
+    for policy in ("eager_compute", "random"):
+        for seed in range(10):
+            sim = RingSim(4, K, **ALLREDUCE, program_override=prog)
+            try:
+                sim.run(policy=policy, seed=seed)
+            except ProtocolViolation as e:
+                caught.append(str(e))
+    assert caught, "drain-free protocol ran clean under every schedule"
+
+
+def test_detector_catches_skipped_accumulation():
+    """Dropping an Accum leaves its landing slot full → the next arrival
+    on that slot trips invariant 2, or the data check trips invariant 5."""
+    caught = _mutate(drop=lambda op: isinstance(op, Accum) and op.u == 1
+                     and op.seg == 0)
+    assert caught
+    assert any("invariant 2" in c or "invariant 5" in c
+               or "landing slot" in c or "data wrong" in c for c in caught)
+
+
+def test_detector_catches_wrong_chunk_schedule():
+    """An off-by-one in the chunk rotation lands the reduced block on the
+    wrong rank.  (A uniform rot shift is a *symmetry* of the full
+    allreduce, so the detectable mutation is the reduce-scatter layout:
+    rot=0 instead of the required rot=-1.)"""
+    caught = []
+    for seed in range(5):
+        sim = RingSim(4, 1, rot=0, allgather=False)
+        try:
+            sim.run(policy="random", seed=seed)
+        except ProtocolViolation as e:
+            caught.append(str(e))
+    assert caught and any("data wrong" in c or "invariant 5" in c
+                          for c in caught)
+
+
+# -- the model's schedule matches the kernel's chunk indexing ---------------
+
+
+def test_program_shape_matches_kernel_counts():
+    """Structural cross-check: op counts follow the kernel's loop bounds."""
+    for P in (2, 3, 4, 8):
+        for K in (1, 2, 4):
+            n_steps = 2 * (P - 1)
+            ops = device_program(0, P, K, rot=0, allgather=True)
+            dmas = [op for op in ops if isinstance(op, DmaStart)]
+            # one warm-up send per segment + one per (step, seg) except last
+            assert len(dmas) == K * n_steps
+            accums = [op for op in ops if isinstance(op, Accum)]
+            assert len(accums) == K * (P - 1)
+            credits = [op for op in ops
+                       if isinstance(op, Signal) and op.sem[0] == "credit"]
+            # credits stop 2 steps before the end
+            assert len(credits) == K * max(0, n_steps - 2)
