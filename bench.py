@@ -121,13 +121,26 @@ except Exception as e:
 sharded = NamedSharding(mesh, P("world"))
 make_sharded = jax.jit(lambda: jnp.ones((P_, n), jnp.float32),
                        out_shardings=sharded)
-for algo in ("ring", "fused", "pallas_ring"):
+from mpi_tpu.tpu import pallas_ring as _pr
+
+def _algo_fn(a):
+    if a == "pallas_ring_unidir":
+        return lambda x: _pr.pallas_ring_allreduce(
+            x.reshape(-1), "world", P_, bidirectional=False,
+            interpret=jax.devices()[0].platform == "cpu")
+    return lambda x: comm.allreduce(x.reshape(-1), algorithm=a)
+
+# per-direction traffic of the bidirectional kernel (counter-rotating
+# rings split each chunk's tiles between the two ICI link directions)
+result["pallas_ring_flows"] = _pr.flow_summary(n, P_)
+
+for algo in ("ring", "fused", "pallas_ring", "pallas_ring_unidir"):
     try:
         # hand-scheduled results (ring/pallas_ring) are replicated in
         # value but not provably so to the vma checker with out_specs=P();
         # only the fused XLA collective carries the replication type
         f = jax.jit(jax.shard_map(
-            lambda x, a=algo: comm.allreduce(x.reshape(-1), algorithm=a),
+            lambda x, a=algo: _algo_fn(a)(x),
             mesh=mesh, in_specs=P("world"), out_specs=P(),
             check_vma=(algo == "fused")))
         xg = make_sharded()

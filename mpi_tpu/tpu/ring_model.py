@@ -89,18 +89,27 @@ class ProtocolViolation(AssertionError):
     pass
 
 
-def _send_chunk(my: int, u: int, P: int, rot: int) -> int:
-    return (my - u + rot) % P          # pallas_ring._kernel send_chunk
+def _send_chunk(my: int, u: int, P: int, rot: int, dirn: int) -> int:
+    # pallas_ring._kernel send_chunk; dirn=-1 is the mirror ring
+    return (my - u + rot) % P if dirn > 0 else (my + u - rot) % P
 
-def _accum_chunk(my: int, u: int, P: int, rot: int) -> int:
-    return (my - u - 1 + rot) % P      # pallas_ring._kernel accum_chunk
+def _accum_chunk(my: int, u: int, P: int, rot: int, dirn: int) -> int:
+    # pallas_ring._kernel accum_chunk
+    return (my - u - 1 + rot) % P if dirn > 0 else (my + u + 1 - rot) % P
 
 
 def device_program(my: int, P: int, K: int, *, rot: int,
-                   allgather: bool) -> List[object]:
+                   allgather: bool,
+                   dirs: Optional[Tuple[int, ...]] = None) -> List[object]:
     """The pipelined ``_kernel`` body for device ``my`` as a static op list
-    (the pipelined=True body of pallas_ring._kernel)."""
+    (the pipelined=True body of pallas_ring._kernel).
+
+    ``dirs`` gives the direction of each flow (+1 right-going, -1
+    left-going mirror ring); default: K unidirectional flows.  A flow's
+    credit goes to its upstream writer — left for +1, right for -1."""
     left, right = (my - 1) % P, (my + 1) % P
+    dirs = dirs or (1,) * K
+    F = len(dirs)
     n_rs = P - 1
     n_steps = 2 * (P - 1) if allgather else n_rs
     ops: List[object] = []
@@ -109,26 +118,27 @@ def device_program(my: int, P: int, K: int, *, rot: int,
     ops += [Signal(left, ("bar",)), Signal(right, ("bar",)),
             Wait(("bar",), 2)]
     # warm-up sends, u=0 (no dependency: step-0 payload is original data)
-    for seg in range(K):
-        ops.append(DmaStart(0, seg))
+    for fi in range(F):
+        ops.append(DmaStart(0, fi))
     for u in range(n_steps):
         slot = u % 2
-        for seg in range(K):
-            ops.append(Wait(("recv", slot, seg), 1))     # rdma(u).wait_recv()
+        for fi in range(F):
+            writer = left if dirs[fi] > 0 else right
+            ops.append(Wait(("recv", slot, fi), 1))      # rdma(u).wait_recv()
             if u < n_rs:
-                ops.append(Accum(u, seg))                # VMEM accumulate
+                ops.append(Accum(u, fi))                 # VMEM accumulate
             if u + 2 < n_steps:                          # credit the writer
-                ops.append(Signal(left, ("credit", slot, seg)))
+                ops.append(Signal(writer, ("credit", slot, fi)))
             if u + 1 < n_steps:                          # start_send(u + 1):
                 if u + 1 >= 2:                           # wait_send + credit gate
-                    ops.append(Wait(("send", (u + 1) % 2, seg), 1))
-                    ops.append(Wait(("credit", (u + 1) % 2, seg), 1))
-                ops.append(DmaStart(u + 1, seg))
-    # drain: the two newest sends per segment are still in flight
-    for seg in range(K):
+                    ops.append(Wait(("send", (u + 1) % 2, fi), 1))
+                    ops.append(Wait(("credit", (u + 1) % 2, fi), 1))
+                ops.append(DmaStart(u + 1, fi))
+    # drain: the two newest sends per flow are still in flight
+    for fi in range(F):
         if n_steps >= 2:
-            ops.append(Wait(("send", (n_steps - 2) % 2, seg), 1))
-        ops.append(Wait(("send", (n_steps - 1) % 2, seg), 1))
+            ops.append(Wait(("send", (n_steps - 2) % 2, fi), 1))
+        ops.append(Wait(("send", (n_steps - 1) % 2, fi), 1))
     # exit neighbor_barrier()
     ops += [Signal(left, ("bar",)), Signal(right, ("bar",)),
             Wait(("bar",), 2)]
@@ -164,27 +174,32 @@ class RingSim:
 
     def __init__(self, P: int, K: int, *, rot: int, allgather: bool,
                  track_data: bool = True,
-                 program_override=None):
+                 program_override=None,
+                 dirs: Optional[Tuple[int, ...]] = None):
         if P < 2:
             raise ValueError("ring needs P >= 2")
         self.P, self.K = P, K
+        self.dirs = tuple(dirs) if dirs else (1,) * K
+        F = len(self.dirs)
         self.rot, self.allgather = rot, allgather
         self.n_rs = P - 1
         self.n_steps = 2 * (P - 1) if allgather else P - 1
         prog_fn = program_override or device_program
-        self.progs = [prog_fn(d, P, K, rot=rot, allgather=allgather)
+        self.progs = [prog_fn(d, P, K, rot=rot, allgather=allgather,
+                              dirs=self.dirs)
                       for d in range(P)]
         self.pc = [0] * P
         self.sems: List[Dict[SemKey, int]] = [dict() for _ in range(P)]
         self.dmas: List[Dma] = []
         self.track_data = track_data
-        # out[d][(chunk, seg)] = set of contributions (rank, chunk, seg)
+        # out[d][(chunk, flow)] = set of contributions (rank, chunk, flow)
+        # (flows own disjoint tile ranges, so a flow index IS a region)
         self.out = [{(c, s): frozenset([(d, c, s)])
-                     for c in range(P) for s in range(K)}
+                     for c in range(P) for s in range(F)}
                     for d in range(P)]
-        # comm[d][(slot, seg)] = (state, payload); landing zone double buffer
+        # comm[d][(slot, flow)] = (state, payload); landing double buffer
         self.comm = [{(sl, s): ("empty", frozenset())
-                      for sl in range(2) for s in range(K)}
+                      for sl in range(2) for s in range(F)}
                      for d in range(P)]
         self.trace: List[str] = []
 
@@ -210,16 +225,17 @@ class RingSim:
 
     # -- event execution ----------------------------------------------------
 
-    def _mk_dma(self, d: int, u: int, seg: int) -> Dma:
+    def _mk_dma(self, d: int, u: int, fi: int) -> Dma:
         P, rot = self.P, self.rot
-        right = (d + 1) % P
-        c = _send_chunk(d, u, P, rot)
-        payload = self.out[d][(c, seg)] if self.track_data else frozenset()
+        dirn = self.dirs[fi]
+        target = (d + 1) % P if dirn > 0 else (d - 1) % P
+        c = _send_chunk(d, u, P, rot, dirn)
+        payload = self.out[d][(c, fi)] if self.track_data else frozenset()
         if u < self.n_rs:
-            return Dma(d, u, seg, "started", payload, (c, seg), right,
-                       dst_slot=(u % 2, seg), dst_region=None)
-        return Dma(d, u, seg, "started", payload, (c, seg), right,
-                   dst_slot=None, dst_region=(c, seg))
+            return Dma(d, u, fi, "started", payload, (c, fi), target,
+                       dst_slot=(u % 2, fi), dst_region=None)
+        return Dma(d, u, fi, "started", payload, (c, fi), target,
+                   dst_slot=None, dst_region=(c, fi))
 
     def step(self, event: Tuple) -> None:
         kind = event[0]
@@ -290,7 +306,7 @@ class RingSim:
             raise ProtocolViolation(
                 f"dev{d} accumulated empty landing slot {slot} at step {u} "
                 f"(wait_recv matched a different copy)")
-        ci = _accum_chunk(d, u, self.P, self.rot)
+        ci = _accum_chunk(d, u, self.P, self.rot, self.dirs[seg])
         region = (ci, seg)
         for dma in self.dmas:
             if (dma.phase == "started" and dma.src == d
@@ -323,11 +339,11 @@ class RingSim:
                         f"(invariant 4: must drain to zero)")
         if not self.track_data:
             return
-        P, K = self.P, self.K
+        P, F = self.P, len(self.dirs)
         if self.allgather:
             for d in range(P):
                 for c in range(P):
-                    for s in range(K):
+                    for s in range(F):
                         got = self.out[d][(c, s)]
                         want = frozenset((r, c, s) for r in range(P))
                         if got != want:
@@ -338,7 +354,7 @@ class RingSim:
         else:
             for d in range(P):
                 c = d  # rot=-1: the last RS step accumulates chunk ``my``
-                for s in range(K):
+                for s in range(F):
                     got = self.out[d][(c, s)]
                     want = frozenset((r, c, s) for r in range(P))
                     if got != want:
@@ -404,13 +420,15 @@ class RingSim:
 
 
 def explore_all(P: int, K: int, *, rot: int, allgather: bool,
+                dirs: Optional[Tuple[int, ...]] = None,
                 max_states: int = 2_000_000) -> int:
     """Exhaustive DFS over every interleaving (protocol state, no payload
     tracking): every reachable state must have an enabled event unless the
     run is complete, and every terminal state must have drained semaphores.
     Returns the number of distinct states visited."""
     def fresh():
-        return RingSim(P, K, rot=rot, allgather=allgather, track_data=False)
+        return RingSim(P, K, rot=rot, allgather=allgather, track_data=False,
+                       dirs=dirs)
 
     seen = set()
     root = fresh()

@@ -11,15 +11,21 @@ import bench
 
 @pytest.mark.slow
 def test_northstar_prog_runs_on_8dev_sim():
+    # 4MB: big enough that each chunk has >=2 row-tiles, engaging the
+    # bidirectional split (at tiny sizes it correctly degrades to one ring)
     out = bench._run_sub(
         bench.NORTHSTAR_PROG.format(repo=bench.REPO),
-        {"NS_BYTES": str(1 << 20), "NS_ITERS": "2"},
+        {"NS_BYTES": str(4 << 20), "NS_ITERS": "2"},
         env_base=bench._cpu_env(8))
     r = json.loads(out)
     assert r["nranks"] == 8
-    assert r["nbytes"] == 1 << 20
+    assert r["nbytes"] == 4 << 20
     assert r["ici_linerate_gbps_per_link"] > 0, r.get("linerate_error")
-    for algo in ("ring", "fused", "pallas_ring"):
+    for algo in ("ring", "fused", "pallas_ring", "pallas_ring_unidir"):
         assert isinstance(r.get(algo), dict), r.get(algo + "_error")
         assert r[algo]["busbw_gbps"] > 0
     assert "pct_of_linerate" in r["pallas_ring"]
+    # the counter-rotating split really puts traffic on both directions
+    fl = r["pallas_ring_flows"]
+    assert fl["right_bytes_per_chunk"] > 0
+    assert fl["left_bytes_per_chunk"] > 0

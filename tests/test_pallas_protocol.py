@@ -64,14 +64,71 @@ def test_many_random_seeds_largest_config():
         RingSim(8, 4, **ALLREDUCE).run(policy="random", seed=seed)
 
 
+# -- bidirectional (counter-rotating) flows ---------------------------------
+
+
+@pytest.mark.parametrize("P,coll", [
+    (2, ALLREDUCE), (2, REDUCE_SCATTER),
+], ids=["ar2", "rs2"])
+def test_exhaustive_bidirectional(P, coll):
+    """Full interleaving space with one flow per direction.  (P=3
+    exhaustive takes minutes — the adversarial sweeps below cover it.)"""
+    visited = explore_all(P, 2, dirs=(1, -1), **coll)
+    assert visited > 10
+
+
+@pytest.mark.parametrize("policy", ["random", "eager_compute", "lazy_lifo",
+                                    "dma_first"])
+@pytest.mark.parametrize("coll", [ALLREDUCE, REDUCE_SCATTER],
+                         ids=["allreduce", "reduce_scatter"])
+def test_bidirectional_schedules(policy, coll):
+    """Counter-rotating flow layouts (including asymmetric tile splits,
+    mirroring pallas_ring._flows for odd tile counts) across P and seeds."""
+    for P in (2, 3, 4, 5, 8):
+        for dirs in [(1, -1), (1, 1, -1), (1, 1, -1, -1),
+                     (1, 1, 1, 1, -1, -1, -1, -1)]:
+            for seed in range(3):
+                sim = RingSim(P, len(dirs), dirs=dirs, **coll)
+                sim.run(policy=policy, seed=seed)
+
+
+def test_bidirectional_detector_catches_swapped_credit_direction():
+    """Crediting the wrong neighbor on the mirror ring must deadlock or
+    corrupt: a -1 flow's writer is its RIGHT neighbor."""
+    def prog(my, P_, K_, *, rot, allgather, dirs=None):
+        ops = device_program(my, P_, K_, rot=rot, allgather=allgather,
+                             dirs=dirs)
+        fixed = []
+        for op in ops:
+            if isinstance(op, Signal) and op.sem[0] == "credit" \
+                    and dirs[op.sem[2]] < 0:
+                # mis-send the mirror ring's credit to the left neighbor
+                fixed.append(Signal((my - 1) % P_, op.sem, op.inc))
+            else:
+                fixed.append(op)
+        return fixed
+
+    caught = []
+    for policy in ("random", "eager_compute"):
+        for seed in range(5):
+            sim = RingSim(4, 2, dirs=(1, -1), **ALLREDUCE,
+                          program_override=prog)
+            try:
+                sim.run(policy=policy, seed=seed)
+            except ProtocolViolation as e:
+                caught.append(str(e))
+    assert caught, "swapped credit direction ran clean"
+
+
 # -- sensitivity: broken protocols must be caught ---------------------------
 
 
 def _mutate(drop, P=4, K=2, coll=ALLREDUCE):
     """Run all policies × seeds against a mutated program; return the
     violations caught."""
-    def prog(my, P_, K_, *, rot, allgather):
-        ops = device_program(my, P_, K_, rot=rot, allgather=allgather)
+    def prog(my, P_, K_, *, rot, allgather, dirs=None):
+        ops = device_program(my, P_, K_, rot=rot, allgather=allgather,
+                             dirs=dirs)
         return [op for op in ops if not drop(op)]
 
     caught = []
@@ -105,8 +162,9 @@ def test_detector_catches_missing_credit_signal_deadlock():
 def test_detector_catches_missing_drain():
     """Without the final wait_send drain, send semaphores survive kernel
     exit (invariant 4) — or the run ends with DMAs in flight."""
-    def prog(my, P_, K_, *, rot, allgather):
-        ops = device_program(my, P_, K_, rot=rot, allgather=allgather)
+    def prog(my, P_, K_, *, rot, allgather, dirs=None):
+        ops = device_program(my, P_, K_, rot=rot, allgather=allgather,
+                             dirs=dirs)
         # drain = the block of ("send",...) waits before the exit barrier
         exit_bar = len(ops) - 3
         body = [op for i, op in enumerate(ops)
