@@ -136,13 +136,19 @@ result["pallas_ring_flows"] = _pr.flow_summary(n, P_)
 
 for algo in ("ring", "fused", "pallas_ring", "pallas_ring_unidir"):
     try:
-        # hand-scheduled results (ring/pallas_ring) are replicated in
-        # value but not provably so to the vma checker with out_specs=P();
-        # only the fused XLA collective carries the replication type
+        # every algorithm runs under the default check_vma=True, EXCEPT
+        # the pallas legs on the CPU sim: under interpret+vma the kernel
+        # takes the vma-typed ppermute fallback, which would silently
+        # measure the same code as the 'ring' leg — check_vma=False there
+        # keeps the INTERPRETED KERNEL (the data path being rehearsed) in
+        # the measurement.  On real chips (interpret=False) the compiled
+        # kernel runs under check_vma=True like everything else.
+        cv = (not algo.startswith("pallas_ring")
+              or jax.devices()[0].platform != "cpu")
         f = jax.jit(jax.shard_map(
-            lambda x, a=algo: _algo_fn(a)(x),
-            mesh=mesh, in_specs=P("world"), out_specs=P(),
-            check_vma=(algo == "fused")))
+            lambda x, a=algo: _algo_fn(a)(x)[None],
+            mesh=mesh, in_specs=P("world"), out_specs=P("world"),
+            check_vma=cv))
         xg = make_sharded()
         f(xg).block_until_ready()
         ts = []

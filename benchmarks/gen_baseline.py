@@ -113,6 +113,21 @@ def measure(quick: bool) -> List[Dict]:
         rows += _launched_osu(backend, ["--bench", "bw",
                                         "--sizes", "1KB,16MB" if not quick
                                         else "1KB", *it], env)
+    if not quick:
+        # the BASELINE.json:10 contract names 1MB–1GB: the 128MB–1GB tail
+        # is where ring vs halving vs fused diverge hardest (VERDICT r2
+        # next-step #5).  Few iters — each row is minutes on one core.
+        tail_it = ["--iters", "3", "--warmup", "1"]
+        log("contract tail: 256MB+1GB (local 4 ranks) — slow")
+        rows += _osu(["--bench", "allreduce", "--backend", "local",
+                      "-n", "4", "--sizes", "256MB,1GB",
+                      "--algorithms", "ring,recursive_halving", *tail_it],
+                     env)
+        log("contract tail: 256MB (tpu-sim 8 dev) — slow")
+        rows += _osu(["--bench", "allreduce", "--backend", "tpu", "-n", "8",
+                      "--sizes", "256MB",
+                      "--algorithms", "ring,recursive_halving,fused",
+                      *tail_it], env)
     return rows
 
 

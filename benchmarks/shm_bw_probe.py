@@ -1,0 +1,95 @@
+#!/usr/bin/env python
+"""Windowed-bandwidth probe for the shm-vs-socket gap (VERDICT r2 #4).
+
+Runs the osu_bw windowed benchmark at bandwidth-sized payloads over both
+process transports, sweeping the shm ring capacity, and prints one JSON
+line per config — the measurement harness behind the root-cause note in
+transport/shm.py.  Usage::
+
+    python benchmarks/shm_bw_probe.py [--sizes 4194304,16777216] [--iters 8]
+"""
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import tempfile
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+WORKER = """
+import os, sys, json, time, statistics
+sys.path.insert(0, {repo!r})
+import numpy as np
+import mpi_tpu
+
+comm = mpi_tpu.init()
+nbytes = int(os.environ["PROBE_BYTES"])
+iters = int(os.environ["PROBE_ITERS"])
+window = max(2, min(64, (32 << 20) // max(1, nbytes)))
+payload = np.zeros(max(1, nbytes // 4), np.float32)
+samples = []
+for i in range(2 + iters):
+    comm.barrier()
+    t0 = time.perf_counter()
+    if comm.rank == 0:
+        for w in range(window):
+            comm.send(payload, dest=1, tag=w)
+        comm.recv(source=1, tag=10_000)
+    else:
+        for w in range(window):
+            comm.recv(source=0, tag=w)
+        comm.send(b"ack", dest=0, tag=10_000)
+    if i >= 2:
+        samples.append(time.perf_counter() - t0)
+if comm.rank == 0:
+    t = statistics.median(samples)
+    with open(os.environ["PROBE_OUT"], "w") as f:
+        json.dump({{"bytes": nbytes, "window": window,
+                    "bw_gbps": window * nbytes / t / 1e9}}, f)
+mpi_tpu.finalize()
+"""
+
+
+def run_one(backend: str, nbytes: int, iters: int, ring_bytes=None):
+    sys.path.insert(0, REPO)
+    from mpi_tpu.launcher import launch
+
+    with tempfile.TemporaryDirectory() as td:
+        out = os.path.join(td, "out.json")
+        script = os.path.join(td, "prog.py")
+        with open(script, "w") as f:
+            f.write(WORKER.format(repo=REPO))
+        env = {"PROBE_OUT": out, "PROBE_BYTES": str(nbytes),
+               "PROBE_ITERS": str(iters)}
+        if ring_bytes is not None:
+            env["MPI_TPU_SHM_RING_BYTES"] = str(ring_bytes)
+        rc = launch(2, [script], env_extra=env, timeout=600.0,
+                    backend=backend)
+        if rc != 0:
+            return {"error": f"exit {rc}"}
+        with open(out) as f:
+            return json.load(f)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--sizes", default="4194304,16777216")
+    ap.add_argument("--iters", type=int, default=8)
+    ap.add_argument("--rings", default="4194304,33554432,67108864",
+                    help="shm ring capacities to sweep")
+    args = ap.parse_args()
+    sizes = [int(s) for s in args.sizes.split(",")]
+    rings = [int(r) for r in args.rings.split(",")]
+    for nbytes in sizes:
+        r = run_one("socket", nbytes, args.iters)
+        print(json.dumps({"backend": "socket", **r}), flush=True)
+        for ring in rings:
+            r = run_one("shm", nbytes, args.iters, ring_bytes=ring)
+            print(json.dumps({"backend": "shm", "ring": ring, **r}),
+                  flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -27,7 +27,8 @@ __all__ = [
     "MPI_Bcast", "MPI_Reduce", "MPI_Allreduce", "MPI_Allgather", "MPI_Alltoall",
     "MPI_Barrier", "MPI_Comm_split", "MPI_Comm_dup", "MPI_Scatter", "MPI_Gather",
     "MPI_Scan", "MPI_Reduce_scatter", "MPI_Isend", "MPI_Irecv", "MPI_Wait",
-    "MPI_Test", "MPI_Waitall", "MPI_Probe", "MPI_Iprobe", "MPI_Wtime",
+    "MPI_Test", "MPI_Waitall", "MPI_Waitany", "MPI_Waitsome", "MPI_Testall",
+    "MPI_Testany", "MPI_Probe", "MPI_Iprobe", "MPI_Wtime",
     "MPI_Send_init", "MPI_Recv_init", "MPI_Start", "MPI_Startall",
     "MPI_Ibcast", "MPI_Ireduce", "MPI_Iallreduce", "MPI_Iallgather",
     "MPI_Ialltoall", "MPI_Ibarrier", "MPI_Iscatter", "MPI_Igather",
@@ -172,6 +173,64 @@ def MPI_Test(request):
 
 def MPI_Waitall(requests) -> list:
     return [r.wait() for r in requests]
+
+
+def MPI_Waitany(requests):
+    """Block until SOME request completes; returns (index, value).
+
+    Implementation: round-robin test() polling (the transports complete
+    in background threads), with the inter-sweep sleep backing off to
+    1ms — i.e. at most ~1000 sweeps/s while nothing is ready.  A poll
+    loop is the honest Waitany over independent requests: blocking on
+    any single request could miss an earlier completion on another."""
+    import time as _time
+
+    if not requests:
+        raise ValueError("MPI_Waitany needs at least one request")
+    delay = 0.0
+    while True:
+        for i, r in enumerate(requests):
+            done, value = r.test()
+            if done:
+                return i, value
+        _time.sleep(delay)
+        delay = min(0.001, delay + 0.0001)
+
+
+def MPI_Waitsome(requests):
+    """Block until at least one request completes; returns (indices,
+    values) of ALL requests complete at that moment."""
+    i0, v0 = MPI_Waitany(requests)
+    idx, vals = [i0], [v0]
+    for i, r in enumerate(requests):
+        if i == i0:
+            continue
+        done, value = r.test()
+        if done:
+            idx.append(i)
+            vals.append(value)
+    order = sorted(range(len(idx)), key=lambda k: idx[k])
+    return [idx[k] for k in order], [vals[k] for k in order]
+
+
+def MPI_Testall(requests):
+    """(all_done, values) — values is None unless every request is done
+    (matching MPI's flag semantics; individual test() calls are sticky, so
+    re-polling later is safe)."""
+    results = [r.test() for r in requests]
+    if all(done for done, _ in results):
+        return True, [v for _, v in results]
+    return False, None
+
+
+def MPI_Testany(requests):
+    """(done, index, value) of the first completed request, else
+    (False, None, None)."""
+    for i, r in enumerate(requests):
+        done, value = r.test()
+        if done:
+            return True, i, value
+    return False, None, None
 
 
 def MPI_Probe(source: int = ANY_SOURCE, tag: int = ANY_TAG,
@@ -421,8 +480,16 @@ def MPI_Get_processor_name() -> str:
 
 
 def MPI_Get_version():
-    """(major, minor) of the MPI feature level this library tracks."""
-    return (3, 0)
+    """(major, minor) of the MPI standard this library *conforms to*.
+
+    Honestly: MPI-1.3 (the reference's level, BASELINE.json:5) — complete
+    p2p/collectives/groups/topology for picklable payloads.  Selected
+    MPI-2/3 features are present beyond that (active-target RMA,
+    persistent requests, nonblocking collectives, neighborhood
+    collectives, Waitany/Waitsome/Testall/Testany), but graph topologies,
+    passive-target RMA, intercommunicators, and derived datatypes are
+    not, so claiming (3, 0) here would overstate conformance."""
+    return (1, 3)
 
 
 def MPI_Abort(code: int = 1, comm: Optional[Communicator] = None) -> None:

@@ -55,15 +55,25 @@ def validate_rounds(rounds: Sequence[Sequence[Pair]], size: int) -> None:
             raise ScheduleError(f"round {i}: {e}") from e
 
 
-def verify_matching(logs: Sequence[Sequence[tuple]]) -> List[str]:
+def verify_matching(logs: Sequence[Sequence[tuple]],
+                    strict_fifo: bool = True) -> List[str]:
     """Cross-check per-rank communication logs for unmatched traffic.
 
     ``logs[r]`` is rank r's ordered op log; entries are tuples
     ``('send', dst, tag)`` or ``('recv', src, tag)`` (src/tag may be the
-    wildcard -1).  Returns a list of human-readable problems (empty = clean):
-    sends with no matching recv, recvs with no matching send.  Matching is
-    FIFO per (src, dst) channel, mirroring the transports' ordering guarantee
-    (SURVEY.md §2 component #2: FIFO per (source, tag) [S]).
+    wildcard -1).  Returns a list of human-readable problems (empty =
+    clean): sends with no matching recv, recvs with no matching send.
+
+    ``strict_fifo=True`` (default): a specific-tag recv must match the
+    HEAD of its (src, dst) channel — a recv whose tag only matches a
+    deeper send is flagged.  MPI's envelope semantics permit skipping
+    differently-tagged sends, and this library's Mailbox implements that;
+    but a program that *relies* on it deadlocks on any strict-FIFO
+    channel transport and reorders silently elsewhere, which is exactly
+    the class of bug a sanitizer exists to flag (VERDICT r1 weak #6 /
+    r2 weak #5: head-only matching).  Pass ``strict_fifo=False`` to check
+    against pure MPI envelope semantics instead (first send with the
+    SAME tag on the channel — per-(src, tag) FIFO).
     """
     problems: List[str] = []
     size = len(logs)
@@ -83,18 +93,33 @@ def verify_matching(logs: Sequence[Sequence[tuple]]) -> List[str]:
                 [(s, r) for s in range(size)] if src == -1 else [(src, r)]
             )
             matched = False
+            # pass 1: a channel whose HEAD matches — legal in both modes
+            # (scan ALL candidates first so a wildcard recv is not blamed
+            # for skipping a queue when another sender's head matches)
             for ch in candidates:
                 q = sends.get(ch)
-                if not q:
-                    continue
-                if tag == -1 or q[0] == tag or tag in q:
-                    # consume the first tag-compatible send on this channel
-                    if tag == -1 or q[0] == tag:
-                        q.popleft()
-                    else:
-                        q.remove(tag)
+                if q and (tag == -1 or q[0] == tag):
+                    q.popleft()
                     matched = True
                     break
+            if not matched:
+                # pass 2: deep same-tag match (MPI envelope semantics;
+                # flagged in strict mode — relies on tag reordering)
+                for ch in candidates:
+                    q = sends.get(ch)
+                    if q and tag in q:
+                        if strict_fifo:
+                            problems.append(
+                                f"rank {r}: recv(src={src}, tag={tag}) "
+                                f"matches send #{list(q).index(tag)} on "
+                                f"channel {ch[0]}->{ch[1]} but the channel "
+                                f"head has tag {q[0]} — out-of-FIFO match "
+                                f"(deadlocks a strict-FIFO transport; "
+                                f"reorder sends/recvs or verify with "
+                                f"strict_fifo=False)")
+                        q.remove(tag)
+                        matched = True
+                        break
             if not matched:
                 problems.append(f"rank {r}: recv(src={src}, tag={tag}) has no matching send")
     for (s, d), q in sends.items():

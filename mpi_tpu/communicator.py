@@ -186,6 +186,7 @@ class PersistentRequest(Request):
         self._comm, self._kind, self._buf = comm, kind, buf
         self._peer, self._tag = peer, tag
         self._inner: Optional[Request] = None  # active sub-request
+        self._last: Any = None  # last completed payload (sticky, see wait)
 
     @property
     def active(self) -> bool:
@@ -219,23 +220,29 @@ class PersistentRequest(Request):
         return self
 
     def wait(self) -> Any:
+        # completed values stay readable until the next start() — wait()/
+        # test() after completion keep returning the same payload, so
+        # request-set helpers (MPI_Testall/Waitsome) that re-poll never
+        # lose a value delivered on an earlier sweep
         if self._inner is None:
-            return None  # [S] MPI_Wait on an inactive request: immediate no-op
+            return self._last  # [S] inactive: immediate, last completion
         value = self._inner.wait()
-        self._inner = None
-        if self._kind == "recv" and isinstance(self._buf, np.ndarray):
-            self._buf[...] = value
+        self._complete(value)
         return value
 
     def test(self) -> Tuple[bool, Any]:
         if self._inner is None:
-            return True, None  # [S] inactive: flag=true, nothing pending
+            return True, self._last  # [S] inactive: flag=true, last value
         done, value = self._inner.test()
         if done:
-            self._inner = None
-            if self._kind == "recv" and isinstance(self._buf, np.ndarray):
-                self._buf[...] = value
+            self._complete(value)
         return done, value
+
+    def _complete(self, value: Any) -> None:
+        self._inner = None
+        self._last = value
+        if self._kind == "recv" and isinstance(self._buf, np.ndarray):
+            self._buf[...] = value
 
 
 def startall(requests: Sequence[PersistentRequest]) -> List[PersistentRequest]:

@@ -102,8 +102,15 @@ def hybrid_mesh(ici_shape: Sequence[int], dcn_shape: Sequence[int],
         devs = mesh_utils.create_device_mesh(tuple(ici_shape),
                                              devices=jax.devices())
         return Mesh(devs, axis_names)
+    # Multi-slice TPU devices carry distinct slice_index values (the DCN
+    # granule).  CPU/sim devices all report slice 0, so there the process
+    # is the granule — one simulated host == one DCN endpoint (matches
+    # launch_sim_hosts' model).
+    all_devs = jax.devices()
+    slice_ids = {getattr(d, "slice_index", 0) for d in all_devs}
     devs = mesh_utils.create_hybrid_device_mesh(
-        tuple(ici_shape), tuple(dcn_shape), devices=jax.devices())
+        tuple(ici_shape), tuple(dcn_shape), devices=all_devs,
+        process_is_granule=len(slice_ids) <= 1)
     return Mesh(devs, axis_names)
 
 

@@ -59,8 +59,9 @@ on fewer than two chips:
 5. payload correctness under every explored ordering (contribution-set
    semantics, both collectives).
 
-Supported: float32 AND bfloat16, SUM, the full (ungrouped) axis.
-Diagnosed restrictions: other dtypes/ops, grouped sub-communicators.
+Supported: float32 AND bfloat16, SUM, the full axis OR a split
+communicator's groups (one independent ring per group, same kernel).
+Diagnosed restrictions: other dtypes/ops.
 """
 
 from __future__ import annotations
@@ -116,7 +117,7 @@ def _flows(total_tiles: int, bidirectional: bool) -> List[Flow]:
     return flows
 
 
-def _kernel(x_hbm, out_hbm, comm_hbm, a_vmem, b_vmem,
+def _kernel(params_smem, x_hbm, out_hbm, comm_hbm, a_vmem, b_vmem,
             copy_sem_a, copy_sem_b, send_sem, recv_sem, credit_sem, *,
             axis_name: str, size: int, rows: int, tile_rows: int,
             flows: List[Flow], rot: int, allgather: bool,
@@ -127,10 +128,16 @@ def _kernel(x_hbm, out_hbm, comm_hbm, a_vmem, b_vmem,
     reduce-scatter half.  ``flows`` carries the counter-rotating split:
     each flow is an independent pipelined stream over its own tile range
     and (parity, flow) semaphore column; direction -1 flows mirror the
-    ring (send left, credit right, chunk schedule negated)."""
-    my = lax.axis_index(axis_name)
-    right = lax.rem(my + 1, size)
-    left = lax.rem(my - 1 + size, size)
+    ring (send left, credit right, chunk schedule negated).
+
+    ``params_smem`` = [group rank, left neighbor, right neighbor] (int32,
+    SMEM), computed host-side.  For COMM_WORLD these are the classic ring
+    formulas; for a split communicator they come from the group tables, so
+    every group runs its own independent ring inside the one SPMD kernel
+    — same instruction stream, per-device neighbors."""
+    my = params_smem[0]          # group-local rank (chunk schedule index)
+    left = params_smem[1]        # axis index of the upstream +1 neighbor
+    right = params_smem[2]       # axis index of the downstream +1 neighbor
     P = size
     n_rs = P - 1                       # reduce-scatter steps: u in [0, P-1)
     n_steps = 2 * (P - 1) if allgather else n_rs
@@ -274,7 +281,9 @@ def _geometry(n: int, size: int, tile_rows: int) -> Tuple[int, int]:
 
 
 def _check_args(x: jnp.ndarray, axis_name: str, size: int, tile_rows: int,
-                op: str) -> jnp.dtype:
+                op: str) -> bool:
+    """Validate dtype/op/tiling; returns whether varying-axes (vma) typing
+    is active on the enclosing shard_map."""
     dtype = jnp.dtype(x.dtype)
     if dtype not in _SUBLANES:
         raise NotImplementedError(
@@ -287,26 +296,85 @@ def _check_args(x: jnp.ndarray, axis_name: str, size: int, tile_rows: int,
         raise ValueError(
             f"tile_rows must be a positive multiple of {sub} "
             f"({dtype} sublane tile), got {tile_rows}")
+    # the kernel's RDMA device_id is the axis index, which equals the
+    # LOGICAL device id only on a 1-D mesh — reject multi-axis meshes
+    # loudly instead of misrouting RDMAs
+    try:
+        from jax.sharding import get_abstract_mesh
+
+        mesh_axes = get_abstract_mesh().axis_names
+    except Exception:
+        mesh_axes = (axis_name,)
+    if tuple(mesh_axes) not in ((), (axis_name,)):
+        raise NotImplementedError(
+            f"pallas_ring needs a 1-D mesh (axis index == logical device "
+            f"id for the RDMA targets); got mesh axes {mesh_axes}.  Use a "
+            f"1-D mesh with comm.split for sub-rings, or a ppermute "
+            f"algorithm ('ring'/'recursive_halving') on this mesh.")
     # vma typing may be active even when the payload is replicated; probe
     # with axis_index, which is varying exactly when check_vma is on
     try:
-        vma_on = bool(jax.typeof(lax.axis_index(axis_name)).vma)
+        return bool(jax.typeof(lax.axis_index(axis_name)).vma)
     except (AttributeError, NameError):
-        vma_on = False  # no vma typing / not under shard_map (yet)
-    if vma_on:
-        raise ValueError(
-            "pallas_ring needs check_vma=False on the enclosing shard_map "
-            "(Pallas kernels don't participate in varying-axes inference): "
-            "run_spmd(..., check_vma=False) or jax.shard_map(..., "
-            "check_vma=False)")
-    return dtype
+        return False  # no vma typing / not under shard_map (yet)
+
+
+def _world_pairs_of(size: int, groups):
+    """world_pairs callable expanding group-local (src, dst) pairs to
+    world-level ppermute pairs (identity for the full axis), validated
+    like TpuCommunicator's — used by the vma-typed interpreter fallback."""
+    from ..checker import validate_perm
+
+    axis_size = size if groups is None else sum(len(g) for g in groups)
+
+    def world_pairs(pairs):
+        if groups is None:
+            pairs = list(pairs)
+        else:
+            pairs = [(g[s], g[d]) for g in groups for (s, d) in pairs]
+        validate_perm(pairs, axis_size)
+        return pairs
+
+    return world_pairs
+
+
+def _ring_params(axis_name: str, size: int, groups) -> jnp.ndarray:
+    """Per-device [grank, left, right] int32 vector (traced, host tables).
+
+    ``left``/``right`` are AXIS indices (what the RDMA device_id needs);
+    ``grank`` is the group-local rank (what the chunk schedule needs).
+    For groups=None they collapse to the classic (idx±1) mod P ring."""
+    idx = lax.axis_index(axis_name)
+    if groups is None:
+        return jnp.stack([idx, lax.rem(idx - 1 + size, size),
+                          lax.rem(idx + 1, size)]).astype(jnp.int32)
+    axis_size = sum(len(g) for g in groups)
+    grank_t = np.zeros(axis_size, np.int32)
+    left_t = np.zeros(axis_size, np.int32)
+    right_t = np.zeros(axis_size, np.int32)
+    for g in groups:
+        for pos, world in enumerate(g):
+            grank_t[world] = pos
+            left_t[world] = g[(pos - 1) % len(g)]
+            right_t[world] = g[(pos + 1) % len(g)]
+    return jnp.stack([jnp.asarray(grank_t)[idx], jnp.asarray(left_t)[idx],
+                      jnp.asarray(right_t)[idx]]).astype(jnp.int32)
 
 
 def _launch(x: jnp.ndarray, axis_name: str, size: int, tile_rows: int,
             interpret: bool, rot: int, allgather: bool,
-            collective_id: int, bidirectional: bool = True) -> jnp.ndarray:
+            collective_id: int, bidirectional: bool = True,
+            vma_on: bool = False, groups=None) -> jnp.ndarray:
     """Shared pallas_call setup for both ring collectives; returns the
-    padded [size*rows, _LANES] result grid."""
+    padded [size*rows, _LANES] result grid.
+
+    ``vma_on``: varying-axes typing is active on the enclosing shard_map.
+    The compiled kernel supports it directly — the out_shape declares the
+    result varying over ``axis_name`` and Mosaic lowers the body outside
+    vma land (verified by the real-TPU AOT tier).  Callers on the
+    *interpreter* must not reach here with ``vma_on`` (the interpreter
+    evaluates the body as jax ops, where hbm↔scratch mixes trip the vma
+    checker) — they take the vma-typed ppermute fallback instead."""
     dtype = jnp.dtype(x.dtype)
     shape = x.shape
     n = int(np.prod(shape)) if shape else 1
@@ -324,10 +392,17 @@ def _launch(x: jnp.ndarray, axis_name: str, size: int, tile_rows: int,
     compiler_params = None if interpret else pltpu.CompilerParams(
         collective_id=collective_id, has_side_effects=True)
     k = len(flows)
+    if vma_on:
+        out_shape = jax.ShapeDtypeStruct((size * rows, _LANES), dtype,
+                                         vma=frozenset({axis_name}))
+    else:
+        out_shape = jax.ShapeDtypeStruct((size * rows, _LANES), dtype)
+    params = _ring_params(axis_name, size, groups)
     return pl.pallas_call(
         kern,
-        out_shape=jax.ShapeDtypeStruct((size * rows, _LANES), dtype),
-        in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
+        out_shape=out_shape,
+        in_specs=[pl.BlockSpec(memory_space=pltpu.SMEM),
+                  pl.BlockSpec(memory_space=pl.ANY)],
         out_specs=pl.BlockSpec(memory_space=pl.ANY),
         scratch_shapes=[
             pl.ANY((2, rows, _LANES), dtype),            # RDMA landing zone
@@ -341,7 +416,7 @@ def _launch(x: jnp.ndarray, axis_name: str, size: int, tile_rows: int,
         ],
         compiler_params=compiler_params,
         interpret=interpret,
-    )(grid_in)
+    )(params, grid_in)
 
 
 def flow_summary(n_elements: int, size: int, tile_rows: int = 256,
@@ -363,39 +438,68 @@ def flow_summary(n_elements: int, size: int, tile_rows: int = 256,
 def pallas_ring_allreduce(x: jnp.ndarray, axis_name: str, size: int,
                           tile_rows: int = 256,
                           interpret: bool = False,
-                          bidirectional: bool = True) -> jnp.ndarray:
+                          bidirectional: bool = True,
+                          groups=None) -> jnp.ndarray:
     """SUM-allreduce ``x`` (f32/bf16) over ``axis_name`` with the in-kernel
     pipelined RDMA ring — bidirectional (counter-rotating) by default.
-    Call inside shard_map over a mesh with that axis."""
-    _check_args(x, axis_name, size, tile_rows, "sum")
+    Call inside shard_map over a mesh with that axis.
+
+    Works under ``check_vma=True``: compiled, the kernel declares its
+    result varying over the axis (brand it with ``comm.replicate`` if a
+    replicated out_spec is needed); on the *interpreter* the same ring
+    schedule executes as vma-typed ppermute steps instead (the kernel body
+    cannot be interpreted under vma typing — kernel-body interpretation is
+    covered by the check_vma=False tests, the pipelined protocol by
+    mpi_tpu/tpu/ring_model.py, and the compiled path by the real-TPU AOT
+    tier).
+
+    ``groups``: optional equal-sized partition of the axis (a split
+    communicator's axis_index_groups); each group runs its own
+    independent ring — ``size`` is then the GROUP size."""
+    vma_on = _check_args(x, axis_name, size, tile_rows, "sum")
     if size == 1:
         return x
+    if vma_on and interpret:
+        from . import collectives as algos
+
+        grank = _ring_params(axis_name, size, groups)[0]
+        return algos.ring_allreduce(x, axis_name, size, grank,
+                                    _world_pairs_of(size, groups))
     shape = x.shape
     n = int(np.prod(shape)) if shape else 1
     out = _launch(x, axis_name, size, tile_rows, interpret,
                   rot=0, allgather=True, collective_id=13,
-                  bidirectional=bidirectional)
+                  bidirectional=bidirectional, vma_on=vma_on, groups=groups)
     return out.reshape(-1)[:n].reshape(shape)
 
 
 def pallas_ring_reduce_scatter(x: jnp.ndarray, axis_name: str, size: int,
                                tile_rows: int = 256,
                                interpret: bool = False,
-                               bidirectional: bool = True) -> jnp.ndarray:
+                               bidirectional: bool = True,
+                               groups=None) -> jnp.ndarray:
     """SUM-reduce_scatter_block (the ZeRO primitive): ``x`` is the full
     [P*block, ...] stack on every rank; rank r returns block r reduced
     over all ranks.  Runs ONLY the reduce-scatter half of the ring —
     half the wire traffic of the allreduce.
 
     ``x``'s leading dimension must equal ``size`` (the communicator's
-    stacked-blocks convention, matching ``lax.psum_scatter`` tiled=False)."""
+    stacked-blocks convention, matching ``lax.psum_scatter`` tiled=False).
+
+    check_vma handling is as in :func:`pallas_ring_allreduce`."""
     if x.ndim == 0 or x.shape[0] != size:
         raise ValueError(
             f"reduce_scatter needs leading dimension == ring size {size} "
             f"(one block per rank), got shape {x.shape}")
-    _check_args(x, axis_name, size, tile_rows, "sum")
+    vma_on = _check_args(x, axis_name, size, tile_rows, "sum")
     if size == 1:
         return x[0]
+    if vma_on and interpret:
+        from . import collectives as algos
+
+        grank = _ring_params(axis_name, size, groups)[0]
+        return algos.ring_reduce_scatter(x, axis_name, size, grank,
+                                         _world_pairs_of(size, groups))
     block_shape = x.shape[1:]
     block_n = int(np.prod(block_shape))
     rows, _ = _geometry(block_n * size, size, tile_rows)
@@ -409,8 +513,8 @@ def pallas_ring_reduce_scatter(x: jnp.ndarray, axis_name: str, size: int,
     grid = blocks.reshape(-1)
     out = _launch(grid, axis_name, size, tile_rows, interpret,
                   rot=-1, allgather=False, collective_id=14,
-                  bidirectional=bidirectional)
-    my = lax.axis_index(axis_name)
-    mine = lax.dynamic_slice(out.reshape(size, per_chunk), (my, 0),
+                  bidirectional=bidirectional, vma_on=vma_on, groups=groups)
+    grank = _ring_params(axis_name, size, groups)[0]
+    mine = lax.dynamic_slice(out.reshape(size, per_chunk), (grank, 0),
                              (1, per_chunk))
     return mine.reshape(-1)[:block_n].reshape(block_shape)

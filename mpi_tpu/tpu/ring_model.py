@@ -15,6 +15,13 @@ every wait/signal/DMA is unconditional once (P, K, collective) are fixed —
 so the program IS a static op list, and the model cannot diverge from the
 kernel by taking a different branch.
 
+**Grouped rings**: a split communicator runs one independent ring per
+group with per-device (grank, left, right) SMEM params — a pure
+relabeling of device ids.  Each group's protocol is therefore isomorphic
+to a full ring of the group's size, so the (P, K) coverage below covers
+grouped rings of the same geometry; groups share no semaphores, buffers,
+or barrier signals (each device signals only its own ring's neighbors).
+
 **Semaphore semantics** (Mosaic's): counting semaphores; ``signal`` may
 target a remote device; ``wait(n)`` blocks until value ≥ n, then atomically
 subtracts n.  A remote copy is split into two independently-scheduled

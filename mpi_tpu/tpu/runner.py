@@ -36,8 +36,13 @@ def default_mesh(nranks: Optional[int] = None, axis_name: str = "world") -> Mesh
         jax.config.update("jax_platforms", plat)
     devs = jax.devices()
     # config.update never raises post-init; detect a silently-ignored
-    # platform switch by inspecting what we actually got.
-    if plat and devs and devs[0].platform not in plat.split(","):
+    # platform switch by inspecting what we actually got.  Plugin names
+    # that are tunnels to a real platform (axon → tpu) count as applied —
+    # warning on them flagged every legitimate real-chip run.
+    _ALIASES = {"axon": "tpu"}
+    wanted = set(plat.split(",")) if plat else set()
+    wanted |= {_ALIASES[p] for p in list(wanted) if p in _ALIASES}
+    if plat and devs and devs[0].platform not in wanted:
         import warnings
 
         warnings.warn(
@@ -69,9 +74,10 @@ def run_spmd(
     length-1 leading axis and the stacked [nranks, ...] result is returned
     (index it by rank to mirror ``run_local``'s per-rank list).
 
-    ``check_vma=False`` disables shard_map's varying-axes typing — required
-    for programs using ``algorithm='pallas_ring'`` (Pallas kernels don't
-    participate in vma inference)."""
+    ``check_vma=False`` disables shard_map's varying-axes typing.  Every
+    algorithm, including ``'pallas_ring'``, now works with the checker ON
+    (the kernel declares its result varying; see pallas_ring docstrings) —
+    the flag remains for users who want the typing overhead gone."""
     if mesh is None:
         mesh = default_mesh(nranks, axis_name)
     comm = TpuCommunicator(axis_name, mesh)

@@ -73,11 +73,13 @@ def verify_run(
     args: Sequence = (),
     kwargs: Optional[Dict] = None,
     timeout: float = 120.0,
+    strict_fifo: bool = True,
 ) -> Tuple[List[Any], List[str]]:
     """Run ``fn(comm, *args)`` on the thread backend with full comm tracing;
     return (per-rank results, problems).  ``problems`` is empty iff every
-    send was received and every recv was satisfied by a real send —
-    the dynamic analogue of the static ppermute checks."""
+    send was received, every recv was satisfied by a real send, and (with
+    ``strict_fifo``, the default) no recv matched a send behind the head
+    of its channel — see checker.verify_matching."""
     from .transport.local import run_local
 
     traces: List[Optional[TracingTransport]] = [None] * nranks
@@ -92,4 +94,4 @@ def verify_run(
     results = run_local(fn, nranks, args=args, kwargs=kwargs, timeout=timeout,
                         transport_wrapper=wrapper)
     logs = [t.as_match_log() if t else [] for t in traces]
-    return results, checker.verify_matching(logs)
+    return results, checker.verify_matching(logs, strict_fifo=strict_fifo)

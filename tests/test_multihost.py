@@ -89,6 +89,61 @@ def test_multihost_cli(tmp_path):
     assert proc.returncode == 0, proc.stderr[-2000:]
 
 
+HYBRID_WORKER = textwrap.dedent("""
+    import sys
+    sys.path.insert(0, {repo!r})
+    import numpy as np
+
+    from mpi_tpu.tpu import multihost
+
+    assert multihost.auto_init(), "launcher env missing"
+
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    from mpi_tpu.tpu import TpuCommunicator
+
+    assert jax.process_count() == 2
+    # the REAL hybrid branch: dcn_shape=(2, 1) spans the two hosts on the
+    # 'dcn' axis, ici_shape=(1, 2) packs each host's devices on 'ici'
+    mesh = multihost.hybrid_mesh((1, 2), (2, 1), ("dcn", "ici"))
+    assert mesh.shape["dcn"] == 2 and mesh.shape["ici"] == 2, mesh.shape
+
+    # device placement: along 'ici' one host (same process), along 'dcn'
+    # different hosts — the layout contract that keeps heavy collectives
+    # off the data-center network
+    devs = mesh.devices
+    for d in range(2):
+        assert devs[d, 0].process_index == devs[d, 1].process_index, devs
+    for i in range(2):
+        assert devs[0, i].process_index != devs[1, i].process_index, devs
+
+    # one collective OVER THE DCN AXIS (gloo cross-process reduce)
+    comm_dcn = TpuCommunicator("dcn", mesh)
+    f = jax.jit(jax.shard_map(
+        lambda x: comm_dcn.allreduce(x, algorithm="fused"),
+        mesh=mesh, in_specs=P("dcn", "ici"), out_specs=P(None, "ici")))
+    x = np.arange(4.0, dtype=np.float32).reshape(2, 2)
+    out = np.asarray(jax.device_get(f(jnp.asarray(x))))
+    np.testing.assert_allclose(out, x.sum(0, keepdims=True))
+    print("HYBRID-OK proc=" + str(jax.process_index()), flush=True)
+""")
+
+
+@pytest.mark.slow
+def test_hybrid_mesh_real_dcn_branch(tmp_path):
+    """The create_hybrid_device_mesh branch (dcn_shape != all-ones) on a
+    real 2-process runtime: placement asserted + a collective over the
+    DCN axis (VERDICT r2 next-step #6b — previously dead code)."""
+    script = tmp_path / "hybrid_worker.py"
+    script.write_text(HYBRID_WORKER.format(repo=REPO))
+    from mpi_tpu.tpu.multihost import launch_sim_hosts
+
+    rc = launch_sim_hosts(2, [str(script)], devices_per_host=2, timeout=240.0)
+    assert rc == 0
+
+
 def test_hybrid_mesh_single_granule():
     """hybrid_mesh with an all-ones dcn shape falls back to a plain mesh
     (host-side shape logic; no multi-process runtime needed)."""

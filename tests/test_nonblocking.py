@@ -255,3 +255,110 @@ def test_persistent_rejected_on_spmd():
         return comm.rank * 0
 
     assert np.all(np.asarray(run_spmd(prog, nranks=2)) == 1)
+
+
+# -- Waitany / Waitsome / Testall / Testany (MPI-3 request-set ops) --------
+
+
+def test_waitany_returns_first_completed():
+    from mpi_tpu.api import MPI_Waitany
+
+    def prog(comm):
+        if comm.rank == 0:
+            comm.send("fast", dest=1, tag=2)   # tag 2 first
+            time.sleep(0.1)
+            comm.send("slow", dest=1, tag=1)
+            return None
+        reqs = [comm.irecv(source=0, tag=1), comm.irecv(source=0, tag=2)]
+        i, v = MPI_Waitany(reqs)
+        assert (i, v) == (1, "fast")
+        return reqs[0].wait()
+
+    res = run_local(prog, 2)
+    assert res[1] == "slow"
+
+
+def test_waitsome_collects_all_ready():
+    from mpi_tpu.api import MPI_Waitsome
+
+    def prog(comm):
+        if comm.rank == 0:
+            comm.send(10, dest=1, tag=1)
+            comm.send(20, dest=1, tag=2)
+            return None
+        # give both messages time to arrive so Waitsome sees them together
+        time.sleep(0.2)
+        reqs = [comm.irecv(source=0, tag=1), comm.irecv(source=0, tag=2)]
+        idx, vals = MPI_Waitsome(reqs)
+        return idx, vals
+
+    idx, vals = run_local(prog, 2)[1]
+    assert idx == [0, 1] and vals == [10, 20]
+
+
+def test_testall_and_testany():
+    from mpi_tpu.api import MPI_Testall, MPI_Testany
+
+    def prog(comm):
+        if comm.rank == 0:
+            comm.send("a", dest=1, tag=1)
+            time.sleep(0.15)
+            comm.send("b", dest=1, tag=2)
+            return None
+        reqs = [comm.irecv(source=0, tag=1), comm.irecv(source=0, tag=2)]
+        deadline = time.monotonic() + 5
+        while True:  # first message only: Testall must report not-done
+            done1, i, v = MPI_Testany(reqs)
+            if done1:
+                assert (i, v) == (0, "a")
+                break
+            assert time.monotonic() < deadline
+            time.sleep(0.01)
+        all_done, vals = MPI_Testall(reqs)
+        if not all_done:
+            assert vals is None
+        while True:
+            all_done, vals = MPI_Testall(reqs)
+            if all_done:
+                # completed request values are sticky across re-polls
+                return vals
+            assert time.monotonic() < deadline
+            time.sleep(0.01)
+
+    assert run_local(prog, 2)[1] == ["a", "b"]
+
+
+def test_waitany_empty_raises():
+    from mpi_tpu.api import MPI_Waitany
+
+    with pytest.raises(ValueError):
+        MPI_Waitany([])
+
+
+def test_testall_keeps_persistent_request_values():
+    """Completed persistent requests stay readable across Testall sweeps:
+    a value delivered on an early sweep must not be replaced by None when
+    later sweeps re-poll (code-review regression)."""
+    from mpi_tpu.api import MPI_Testall
+
+    def prog(comm):
+        if comm.rank == 0:
+            comm.send("a", dest=1, tag=1)
+            time.sleep(0.2)
+            comm.send("b", dest=1, tag=2)
+            return None
+        r0 = comm.recv_init(source=0, tag=1).start()
+        r1 = comm.recv_init(source=0, tag=2).start()
+        deadline = time.monotonic() + 5
+        saw_partial = False
+        while True:
+            all_done, vals = MPI_Testall([r0, r1])
+            if all_done:
+                return saw_partial, vals
+            saw_partial = saw_partial or r0.test()[0]
+            assert time.monotonic() < deadline
+            time.sleep(0.01)
+
+    saw_partial, vals = run_local(prog, 2)[1]
+    assert vals == ["a", "b"], vals
+    assert saw_partial  # the early completion really was polled first

@@ -46,32 +46,90 @@ def test_pallas_ring_via_communicator():
         np.testing.assert_allclose(out[r], data.sum(0), rtol=1e-4, atol=1e-5)
 
 
-def test_pallas_ring_vma_diagnostic():
-    """With vma typing on, the pallas path must fail with guidance, not a
-    cryptic pallas internal error."""
+def test_pallas_ring_under_check_vma():
+    """algorithm='pallas_ring' works under the DEFAULT check_vma=True
+    (VERDICT r2 next-step #7): on the interpreter the ring executes as
+    vma-typed ppermute steps; compiled, the kernel itself declares its
+    result varying (real-TPU AOT tier covers that leg)."""
     from mpi_tpu.tpu import run_spmd
 
-    data = np.zeros((8, 16), np.float32)
+    data = np.asarray(np.random.RandomState(5).randn(8, 48), np.float32)
 
     def prog(comm, x):
         return comm.allreduce(x[comm.rank], algorithm="pallas_ring")
 
-    with pytest.raises(Exception, match="check_vma"):
-        run_spmd(prog, data)  # default check_vma=True
+    out = np.asarray(run_spmd(prog, data))  # default check_vma=True
+    for r in range(8):
+        np.testing.assert_allclose(out[r], data.sum(0), rtol=1e-4, atol=1e-5)
+
+
+def test_pallas_reduce_scatter_under_check_vma():
+    from mpi_tpu.tpu import run_spmd
+
+    P_, block = 4, 96
+    data = np.asarray(np.random.RandomState(6).randn(P_, P_, block),
+                      np.float32)
+
+    def prog(comm, x):
+        return comm.reduce_scatter(x[comm.rank], algorithm="pallas_ring")
+
+    out = np.asarray(run_spmd(prog, data, nranks=P_))
+    np.testing.assert_allclose(out, data.sum(0), rtol=1e-4, atol=1e-5)
 
 
 def test_pallas_ring_diagnostics():
     mesh = default_mesh()
     comm = TpuCommunicator("world", mesh)
-    sub = comm.split_by(lambda i: i % 2)
     from mpi_tpu import ops
 
-    with pytest.raises(NotImplementedError, match="ungrouped"):
-        sub.allreduce(jnp.zeros(8), algorithm="pallas_ring")
     with pytest.raises(NotImplementedError, match="SUM"):
         comm.allreduce(jnp.zeros(8), op=ops.MAX, algorithm="pallas_ring")
     with pytest.raises(NotImplementedError, match="float32"):
         pallas_ring_allreduce(jnp.zeros(8, jnp.int32), "world", 8)
+
+
+@pytest.mark.parametrize("check_vma", [False, True])
+def test_pallas_ring_grouped(check_vma):
+    """A split communicator selects pallas_ring: one independent ring per
+    group, driven by the SMEM (grank, left, right) params (VERDICT r2
+    missing #4 — previously the one algorithm a split comm couldn't use)."""
+    from mpi_tpu.tpu import run_spmd
+
+    data = np.asarray(np.random.RandomState(11).randn(8, 200), np.float32)
+    mesh = default_mesh()
+    world = TpuCommunicator("world", mesh)
+    # interleaved groups: evens and odds (non-contiguous world indices)
+    sub = world.split_by(lambda i: i % 2)
+
+    def prog(comm, x):
+        return sub.allreduce(x[comm.rank], algorithm="pallas_ring")
+
+    out = np.asarray(run_spmd(prog, data, mesh=mesh, check_vma=check_vma))
+    evens, odds = data[0::2].sum(0), data[1::2].sum(0)
+    for r in range(8):
+        np.testing.assert_allclose(out[r], evens if r % 2 == 0 else odds,
+                                   rtol=1e-4, atol=1e-5)
+
+
+@pytest.mark.parametrize("check_vma", [False, True])
+def test_pallas_reduce_scatter_grouped(check_vma):
+    from mpi_tpu.tpu import run_spmd
+
+    block = 72
+    data = np.asarray(np.random.RandomState(12).randn(8, 4, block),
+                      np.float32)
+    mesh = default_mesh()
+    world = TpuCommunicator("world", mesh)
+    rows = world.split_by(lambda i: i // 4)  # [[0..3], [4..7]]
+
+    def prog(comm, x):
+        return rows.reduce_scatter(x[comm.rank], algorithm="pallas_ring")
+
+    out = np.asarray(run_spmd(prog, data, mesh=mesh, check_vma=check_vma))
+    lo, hi = data[:4].sum(0), data[4:].sum(0)  # [4, block] each
+    for r in range(8):
+        expect = lo[r % 4] if r < 4 else hi[r % 4]
+        np.testing.assert_allclose(out[r], expect, rtol=1e-4, atol=1e-5)
 
 
 @pytest.mark.parametrize("nranks,n", [(2, 4096), (4, 20000)])
@@ -149,3 +207,22 @@ def test_pallas_ring_reduce_scatter_via_communicator():
     out = np.asarray(run_spmd(prog, data, nranks=P_, check_vma=False))
     oracle = data.sum(0)  # [P, block]
     np.testing.assert_allclose(out, oracle, rtol=1e-4, atol=1e-5)
+
+
+def test_pallas_ring_rejects_multi_axis_mesh():
+    """RDMA device ids are axis indices == logical ids only on a 1-D
+    mesh; a 2-D mesh must be rejected loudly, not misrouted."""
+    import numpy as np_
+    from jax.sharding import Mesh
+
+    devs = np_.array(jax.devices()[:8]).reshape(2, 4)
+    mesh = Mesh(devs, ("dp", "mp"))
+    comm = TpuCommunicator("mp", mesh)
+
+    def f(x):
+        return comm.allreduce(x, algorithm="pallas_ring")
+
+    with pytest.raises(Exception, match="1-D mesh"):
+        jax.jit(jax.shard_map(f, mesh=mesh, in_specs=P("dp", "mp"),
+                              out_specs=P("dp", "mp")))(
+            jnp.zeros((8, 512), jnp.float32))

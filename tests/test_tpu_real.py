@@ -144,18 +144,21 @@ def test_pallas_ring8_lowers_pipelined(dtype):
     n = 8 * 256 * 128
     rows = pr._geometry(n, 8, 64)[0]
     assert len(pr._segments(rows // 64)) == 4, "shape no longer multi-segment"
-    _lower8(lambda c, v: pallas_ring_allreduce(v.reshape(-1), "world", 8,
-                                               tile_rows=64),
-            jax.ShapeDtypeStruct((8, n // 8), dtype), check_vma=False)
+    for check_vma in (False, True):
+        _lower8(lambda c, v: pallas_ring_allreduce(v.reshape(-1), "world", 8,
+                                                   tile_rows=64),
+                jax.ShapeDtypeStruct((8, n // 8), dtype),
+                check_vma=check_vma)
 
 
 def test_pallas_reduce_scatter8_lowers_pipelined():
     from mpi_tpu.tpu.pallas_ring import pallas_ring_reduce_scatter
 
-    _lower8(lambda c, v: pallas_ring_reduce_scatter(
-                v.reshape(8, 1024), "world", 8),
-            jax.ShapeDtypeStruct((8, 8 * 1024), jnp.float32),
-            check_vma=False)
+    for check_vma in (False, True):
+        _lower8(lambda c, v: pallas_ring_reduce_scatter(
+                    v.reshape(8, 1024), "world", 8),
+                jax.ShapeDtypeStruct((8, 8 * 1024), jnp.float32),
+                check_vma=check_vma)
 
 
 def test_dryrun_step8_lowers():
@@ -164,3 +167,15 @@ def test_dryrun_step8_lowers():
 
     lowered = ge.lower_multichip(8)
     assert lowered is not None
+
+
+def test_pallas_ring8_grouped_lowers_pipelined():
+    """The grouped (split-communicator) pipelined kernel — SMEM neighbor
+    params, per-group rings — lowers through Mosaic."""
+    from mpi_tpu.tpu.pallas_ring import pallas_ring_allreduce
+
+    groups = [[0, 2, 4, 6], [1, 3, 5, 7]]
+    _lower8(lambda c, v: pallas_ring_allreduce(
+                v.reshape(-1), "world", 4, tile_rows=64, groups=groups),
+            jax.ShapeDtypeStruct((8, 64 * 128), jnp.float32),
+            check_vma=False)
