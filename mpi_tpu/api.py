@@ -175,8 +175,19 @@ def MPI_Waitall(requests) -> list:
     return [r.wait() for r in requests]
 
 
+def _retired(r) -> bool:
+    return getattr(r, "_retired", False)
+
+
 def MPI_Waitany(requests):
-    """Block until SOME request completes; returns (index, value).
+    """Block until some request completes; returns (index, value).
+
+    MPI sets completed requests to MPI_REQUEST_NULL so the next Waitany
+    moves on; the analogue here: a request RETURNED by Waitany/Waitsome/
+    Testany is marked retired and skipped by later calls on the same set
+    — a ``for _ in range(len(reqs)): MPI_Waitany(reqs)`` drain loop visits
+    every request exactly once.  When every request is retired, returns
+    (None, None) (MPI_UNDEFINED).
 
     Implementation: round-robin test() polling (the transports complete
     in background threads), with the inter-sweep sleep backing off to
@@ -189,24 +200,35 @@ def MPI_Waitany(requests):
         raise ValueError("MPI_Waitany needs at least one request")
     delay = 0.0
     while True:
+        live = False
         for i, r in enumerate(requests):
+            if _retired(r):
+                continue
+            live = True
             done, value = r.test()
             if done:
+                r._retired = True
                 return i, value
+        if not live:
+            return None, None  # MPI_UNDEFINED: no active requests left
         _time.sleep(delay)
         delay = min(0.001, delay + 0.0001)
 
 
 def MPI_Waitsome(requests):
-    """Block until at least one request completes; returns (indices,
-    values) of ALL requests complete at that moment."""
+    """Block until at least one un-retired request completes; returns
+    (indices, values) of ALL requests complete at that moment, retiring
+    them (see MPI_Waitany).  ``(None, None)`` when nothing is active."""
     i0, v0 = MPI_Waitany(requests)
+    if i0 is None:
+        return None, None
     idx, vals = [i0], [v0]
     for i, r in enumerate(requests):
-        if i == i0:
+        if i == i0 or _retired(r):
             continue
         done, value = r.test()
         if done:
+            r._retired = True
             idx.append(i)
             vals.append(value)
     order = sorted(range(len(idx)), key=lambda k: idx[k])
@@ -215,8 +237,9 @@ def MPI_Waitsome(requests):
 
 def MPI_Testall(requests):
     """(all_done, values) — values is None unless every request is done
-    (matching MPI's flag semantics; individual test() calls are sticky, so
-    re-polling later is safe)."""
+    (matching MPI's flag semantics).  Completed requests keep their value
+    across re-polls (and Testall does NOT retire anything: its contract
+    is a snapshot of the whole set, repeatable by design)."""
     results = [r.test() for r in requests]
     if all(done for done, _ in results):
         return True, [v for _, v in results]
@@ -224,11 +247,14 @@ def MPI_Testall(requests):
 
 
 def MPI_Testany(requests):
-    """(done, index, value) of the first completed request, else
-    (False, None, None)."""
+    """(done, index, value) of the first completed un-retired request
+    (which it retires, see MPI_Waitany), else (False, None, None)."""
     for i, r in enumerate(requests):
+        if _retired(r):
+            continue
         done, value = r.test()
         if done:
+            r._retired = True
             return True, i, value
     return False, None, None
 

@@ -24,6 +24,9 @@ from __future__ import annotations
 
 import pickle
 import struct
+import sys
+import threading
+import weakref
 from typing import Any, Optional, Tuple
 
 import numpy as np
@@ -59,11 +62,88 @@ def pack_raw_meta(ctx, tag: int, arr: np.ndarray) -> bytes:
     return META.pack(len(meta)) + meta
 
 
+class _BufferPool:
+    """Recycles large receive buffers between messages.
+
+    Why: at bandwidth sizes the receiver's dominant cost on this class of
+    box is not the copy but the PAGE FAULTS of touching a freshly-mmapped
+    destination — measured on the 16MB stream: 48.8k minor faults, 84ms
+    system time of a 120ms wall (one fault per 4KB page, every message,
+    because glibc munmaps large frees).  Handing each recv an
+    already-faulted buffer removes that entire pass.
+
+    Safety: the user owns the returned array indefinitely, so a buffer is
+    recycled only when proven unreachable — a ``weakref.finalize`` on the
+    handed-out view fires after the view is collected, and the callback
+    re-checks the backing buffer's refcount so any still-alive user alias
+    (numpy collapses ``.base`` chains to the backing buffer) vetoes the
+    recycle."""
+
+    def __init__(self, min_bytes: int = 1 << 20,
+                 max_total: int = 256 << 20, max_per_size: int = 3):
+        self._min, self._max_total = min_bytes, max_total
+        self._max_per_size = max_per_size
+        self._free: dict = {}      # nbytes -> [uint8 arrays]
+        self._total = 0
+        # RLock: _maybe_recycle runs inside weakref.finalize callbacks; a
+        # cyclic-GC collection triggered while the lock is held can run
+        # ANOTHER pooled array's finalizer on the same thread — a plain
+        # Lock would self-deadlock there
+        self._lock = threading.RLock()
+        # Self-calibrate the no-alias refcount through the EXACT production
+        # path (a hand-derived constant broke the alias veto: the finalize
+        # registry's ref structure is an implementation detail).  CPython
+        # fires the finalize synchronously when the probe's refcount hits
+        # zero, so _maybe_recycle records the baseline inline.
+        self._baseline: Optional[int] = None
+        probe = self.empty((self._min,), np.dtype(np.uint8))
+        del probe
+        if self._baseline is None:  # pragma: no cover - non-refcount VM
+            self._baseline = -1     # disables recycling (pool = plain empty)
+
+    def empty(self, shape, dtype: np.dtype) -> np.ndarray:
+        n = int(np.prod(shape)) if shape else 1
+        nbytes = n * dtype.itemsize
+        if nbytes < self._min:
+            return np.empty(shape, dtype)
+        with self._lock:
+            stack = self._free.get(nbytes)
+            buf = stack.pop() if stack else None
+            if buf is not None:
+                self._total -= nbytes
+        if buf is None:
+            buf = np.empty(nbytes, np.uint8)
+        arr = buf.view(dtype).reshape(shape)
+        weakref.finalize(arr, self._maybe_recycle, buf)
+        return arr
+
+    def _maybe_recycle(self, buf: np.ndarray) -> None:
+        refs = sys.getrefcount(buf)
+        if self._baseline is None:
+            self._baseline = refs  # calibration probe, not recycled
+            return
+        # anything beyond the calibrated no-alias baseline is a live user
+        # alias (numpy collapses subview .base chains onto the backing
+        # buffer): drop the buffer instead of recycling aliased memory
+        if self._baseline < 0 or refs > self._baseline:
+            return
+        nbytes = buf.nbytes
+        with self._lock:
+            stack = self._free.setdefault(nbytes, [])
+            if (len(stack) < self._max_per_size
+                    and self._total + nbytes <= self._max_total):
+                stack.append(buf)
+                self._total += nbytes
+
+
+RECV_POOL = _BufferPool()
+
+
 def unpack_raw_meta(meta: bytes) -> Tuple[Any, int, np.ndarray]:
     """Decode a raw frame's meta pickle; returns (ctx, tag, empty array to
-    read the raw bytes into)."""
+    read the raw bytes into — pooled at bandwidth sizes, see _BufferPool)."""
     ctx, tag, dtype_str, shape = pickle.loads(meta)
-    return ctx, tag, np.empty(shape, dtype=np.dtype(dtype_str))
+    return ctx, tag, RECV_POOL.empty(shape, np.dtype(dtype_str))
 
 
 def parse_raw_body(body: bytes) -> Tuple[Any, int, np.ndarray]:

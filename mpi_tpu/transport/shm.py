@@ -27,6 +27,19 @@ receiver pays reader-thread → condvar → user thread.  Threads that lose
 the progress-lock race fall back to waiting on the shared Mailbox, which
 the progressing thread feeds — matching semantics stay identical to every
 other CPU transport.
+
+Bandwidth root-cause note (the round-2 "shm loses at 16MB" finding): the
+ring itself streams 16MB frames cross-process at >5 GB/s on this 1-core
+box; the transport's measured 1.6 GB/s was the RECEIVER faulting in every
+page of each message's freshly-mmapped destination array (48.8k minor
+faults / 84ms system time per 192MB — glibc munmaps large frees, so the
+warm pages never came back).  The fix is transport-agnostic: recv
+destinations come from ``codec.RECV_POOL``, which recycles large buffers
+once they are provably unaliased.  With pooled destinations the 16MB
+windowed bandwidth is ~6.4 GB/s vs the socket path's ~2.5 (kernel-copy
+bound), i.e. the zero-copy thesis of this module holds once the
+page-fault tax is removed; see benchmarks/shm_bw_probe.py for the
+measurement harness.
 """
 
 from __future__ import annotations

@@ -142,3 +142,43 @@ def test_ndarray_subclasses_survive(name, world):
         return True
 
     assert all(world(prog, 2))
+
+
+def test_recv_pool_recycles_and_vetoes_aliases():
+    """The large-recv buffer pool (the 16MB-bandwidth fix: one page fault
+    per destination page per message otherwise dominates the receiver's
+    time) must reuse clean buffers and NEVER recycle aliased memory."""
+    import numpy as np
+    from mpi_tpu.transport.codec import _BufferPool
+
+    pool = _BufferPool(min_bytes=1 << 20)
+    a = pool.empty((1 << 20,), np.dtype(np.uint8))
+    backing = a.base.ctypes.data
+    a[:] = 7
+    del a
+    b = pool.empty((1 << 20,), np.dtype(np.uint8))
+    assert b.base.ctypes.data == backing  # recycled
+
+    alias = b[:16]
+    del b
+    c = pool.empty((1 << 20,), np.dtype(np.uint8))
+    assert c.base.ctypes.data != backing  # alias vetoed the recycle
+    c[:] = 9
+    assert alias.tobytes() != b"\x09" * 16  # user data never clobbered
+
+    # small allocations bypass the pool entirely
+    s = pool.empty((16,), np.dtype(np.float32))
+    assert s.base is None
+
+
+def test_recv_pool_different_dtypes_share_storage():
+    import numpy as np
+    from mpi_tpu.transport.codec import _BufferPool
+
+    pool = _BufferPool(min_bytes=1 << 20)
+    a = pool.empty((1 << 18,), np.dtype(np.float32))  # 1MB
+    backing = a.base.ctypes.data
+    del a
+    b = pool.empty((1 << 16, 2), np.dtype(np.complex64))  # also 1MB
+    assert b.base.ctypes.data == backing
+    assert b.shape == (1 << 16, 2) and b.dtype == np.complex64

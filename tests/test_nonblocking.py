@@ -362,3 +362,25 @@ def test_testall_keeps_persistent_request_values():
     saw_partial, vals = run_local(prog, 2)[1]
     assert vals == ["a", "b"], vals
     assert saw_partial  # the early completion really was polled first
+
+
+def test_waitany_drain_loop_visits_each_request_once():
+    """MPI_REQUEST_NULL analogue: a returned request is retired, so the
+    canonical drain loop never returns the same completion twice nor
+    starves the slower request (code-review regression)."""
+    from mpi_tpu.api import MPI_Waitany
+
+    def prog(comm):
+        if comm.rank == 0:
+            comm.send("first", dest=1, tag=1)
+            time.sleep(0.15)
+            comm.send("second", dest=1, tag=2)
+            return None
+        reqs = [comm.irecv(source=0, tag=1), comm.irecv(source=0, tag=2)]
+        got = [MPI_Waitany(reqs) for _ in range(2)]
+        exhausted = MPI_Waitany(reqs)
+        return got, exhausted
+
+    got, exhausted = run_local(prog, 2)[1]
+    assert got == [(0, "first"), (1, "second")], got
+    assert exhausted == (None, None)
