@@ -363,6 +363,16 @@ class TpuCommunicator(Communicator):
         raise ValueError(f"unknown reduce algorithm {algorithm!r}")
 
     def allreduce(self, obj, op: _ops.ReduceOp = _ops.SUM, algorithm: str = "auto"):
+        """``algorithm='auto'`` resolves to 'fused' at every size: on the
+        measured 8-dev sim sweep (BASELINE.md, regenerated by
+        benchmarks/gen_baseline.py) the fused XLA collective beats the
+        hand schedules across 4KB-256MB (e.g. 16MB: 0.61 GB/s busbw vs
+        ring 0.22 / halving 0.35; 256MB: 0.29 vs 0.12 / 0.11) — XLA's own
+        ring is pipelined and fuses with neighbors, which the explicit
+        ppermute schedules forgo.  On real ICI re-measure before changing
+        this (the CPU backend's auto has a measured size crossover,
+        communicator.py; the pallas_ring exists for where XLA's choice
+        leaves ICI bandwidth unused)."""
         x = jnp.asarray(obj)
         if algorithm == "auto":
             algorithm = "fused"
