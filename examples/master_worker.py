@@ -1,0 +1,119 @@
+"""Master/worker task farm — the canonical dynamic MPI-1 pattern [S].
+
+Rank 0 hands out work items one at a time; whichever worker returns a
+result first gets the next item (self-balancing under uneven task costs).
+This is the textbook use of tags + MPI_Waitany, and it is deliberately
+rank-dynamic: a master branching on *which* worker answered cannot be one
+SPMD trace, so this example is PROCESS-BACKENDS ONLY (socket/shm/local) —
+the framework's designed division of labor (SURVEY.md §7 hard part 1):
+dynamic orchestration runs host-side; the per-item compute can itself be
+a jitted TPU program.
+
+Run::
+
+    python -m mpi_tpu.launcher -n 4 examples/master_worker.py
+    python examples/master_worker.py --backend local -n 4
+"""
+
+from __future__ import annotations
+
+import argparse
+import math
+import os
+import sys
+
+try:
+    import mpi_tpu  # noqa: F401
+except ModuleNotFoundError:  # fresh checkout without install
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+
+TAG_WORK, TAG_RESULT, TAG_STOP = 1, 2, 3
+
+
+def _task(x: int) -> float:
+    # deliberately uneven cost: larger x → more iterations
+    acc = 0.0
+    for k in range(1, 50 * (x % 7 + 1)):
+        acc += math.sin(x * k) / k
+    return acc
+
+
+def run(comm, n_tasks: int = 40):
+    """Returns (on rank 0) the list of all task results, task-indexed."""
+    from mpi_tpu.api import MPI_Waitany
+
+    if comm.size < 2:
+        return [_task(i) for i in range(n_tasks)]
+
+    if comm.rank == 0:
+        results = [None] * n_tasks
+        next_task = 0
+        # prime workers with one item each; surplus workers (more workers
+        # than tasks) are stopped immediately and get NO result irecv —
+        # a pending receive from a stopped worker could never complete
+        primed = []
+        for w in range(1, comm.size):
+            if next_task < n_tasks:
+                comm.send(next_task, dest=w, tag=TAG_WORK)
+                next_task += 1
+                primed.append(w)
+            else:
+                comm.send(None, dest=w, tag=TAG_STOP)
+        # one outstanding irecv per ACTIVE worker; Waitany picks whichever
+        # finishes first, and its slot index maps back through `primed`
+        reqs = [comm.irecv(source=w, tag=TAG_RESULT) for w in primed]
+        outstanding = len(primed)
+        while outstanding:
+            i, payload = MPI_Waitany(reqs)
+            task_id, value = payload
+            results[task_id] = value
+            worker = primed[i]
+            if next_task < n_tasks:
+                comm.send(next_task, dest=worker, tag=TAG_WORK)
+                next_task += 1
+                reqs[i] = comm.irecv(source=worker, tag=TAG_RESULT)
+            else:
+                comm.send(None, dest=worker, tag=TAG_STOP)
+                outstanding -= 1
+        return results
+
+    # worker loop: task ids arrive with TAG_WORK until a TAG_STOP
+    from mpi_tpu import ANY_TAG
+    from mpi_tpu.communicator import Status
+
+    while True:
+        status = Status()
+        item = comm.recv(source=0, tag=ANY_TAG, status=status)
+        if status.tag == TAG_STOP:
+            return None
+        comm.send((item, _task(item)), dest=0, tag=TAG_RESULT)
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--backend", default=None)
+    ap.add_argument("-n", "--nranks", type=int, default=None)
+    ap.add_argument("--tasks", type=int, default=40)
+    args = ap.parse_args()
+
+    import mpi_tpu
+
+    if args.backend in (None, "socket", "shm"):
+        comm = mpi_tpu.init(args.backend)
+        res = run(comm, args.tasks)
+        if comm.rank == 0:
+            done = sum(r is not None for r in res)
+            print(f"master_worker: {done}/{args.tasks} tasks done, "
+                  f"sum={sum(res):.4f}")
+        mpi_tpu.finalize()
+    else:
+        out = mpi_tpu.run(lambda c: run(c, args.tasks),
+                          backend=args.backend, nranks=args.nranks)
+        res = out[0]
+        print(f"master_worker: {sum(r is not None for r in res)}/"
+              f"{args.tasks} tasks done, sum={sum(res):.4f}")
+
+
+if __name__ == "__main__":
+    main()

@@ -34,7 +34,9 @@ from .communicator import Communicator, P2PCommunicator, Request, Status
 from .transport.base import ANY_SOURCE, ANY_TAG
 from .transport.local import run_local
 from . import schedules, checker, checkpoint, profiling, trace
-from .topology import CartComm, cart_create, dims_create
+from .topology import (CartComm, GraphComm, cart_create,
+                       dims_create, dist_graph_create_adjacent,
+                       graph_create)
 from .group import Group
 from .window import GetFuture, P2PWindow
 
@@ -44,7 +46,8 @@ __all__ = [
     "Communicator", "P2PCommunicator", "Request", "Status", "ANY_SOURCE", "ANY_TAG",
     "init", "finalize", "is_initialized", "run", "run_local",
     "schedules", "checker", "checkpoint", "profiling", "trace", "COMM_WORLD",
-    "CartComm", "cart_create", "dims_create", "Group",
+    "CartComm", "GraphComm", "cart_create", "graph_create",
+    "dist_graph_create_adjacent", "dims_create", "Group",
     "GetFuture", "P2PWindow",
 ]
 

@@ -37,6 +37,7 @@ __all__ = [
     "MPI_Exscan", "MPI_Op_create", "MPI_Maxloc", "MPI_Minloc",
     "MPI_Gatherv", "MPI_Scatterv", "MPI_Allgatherv", "MPI_Alltoallv",
     "MPI_Cart_create", "MPI_Dims_create", "MPI_Cart_coords", "MPI_Cart_rank",
+    "MPI_Graph_create", "MPI_Dist_graph_create_adjacent",
     "MPI_Cart_shift", "MPI_Cart_sub",
     "MPI_Neighbor_allgather", "MPI_Neighbor_alltoall",
     "MPI_Comm_group", "MPI_Comm_create", "MPI_Comm_create_group",
@@ -327,6 +328,20 @@ def MPI_Cart_create(dims: Sequence[int], periods: Optional[Sequence[bool]] = Non
     return cart_create(_world(comm), dims, periods)
 
 
+def MPI_Graph_create(edges, comm: Optional[Communicator] = None):
+    """Arbitrary directed process graph from the global edge list [S]."""
+    from .topology import graph_create
+
+    return graph_create(_world(comm), edges)
+
+
+def MPI_Dist_graph_create_adjacent(sources, destinations,
+                                   comm: Optional[Communicator] = None):
+    from .topology import dist_graph_create_adjacent
+
+    return dist_graph_create_adjacent(_world(comm), sources, destinations)
+
+
 def MPI_Dims_create(nnodes: int, ndims: int) -> list:
     from .topology import dims_create
 
@@ -512,9 +527,10 @@ def MPI_Get_version():
     p2p/collectives/groups/topology for picklable payloads.  Selected
     MPI-2/3 features are present beyond that (active-target RMA,
     persistent requests, nonblocking collectives, neighborhood
-    collectives, Waitany/Waitsome/Testall/Testany), but graph topologies,
-    passive-target RMA, intercommunicators, and derived datatypes are
-    not, so claiming (3, 0) here would overstate conformance."""
+    collectives, Waitany/Waitsome/Testall/Testany, graph topologies with
+    neighborhood collectives), but passive-target RMA, intercommunicators,
+    and derived datatypes are not, so claiming (3, 0) here would overstate
+    conformance."""
     return (1, 3)
 
 

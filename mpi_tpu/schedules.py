@@ -176,3 +176,37 @@ def dissemination_offsets(size: int) -> List[int]:
         offs.append(k)
         k *= 2
     return offs
+
+
+def graph_rounds(edges: Sequence[Pair], size: int) -> List[List[Pair]]:
+    """Decompose an arbitrary directed edge set into partial-permutation
+    rounds (greedy edge coloring): within a round no rank sends twice and
+    no rank receives twice — exactly ``lax.ppermute``'s precondition, so a
+    graph-neighborhood collective lowers to one ppermute per round.  Round
+    count ≤ 2·max(in_degree, out_degree) − 1 (bipartite greedy bound);
+    self-edges are rejected (express local reuse in user code)."""
+    seen = set()
+    remaining: List[Pair] = []
+    for s, d in edges:
+        if not (0 <= s < size and 0 <= d < size):
+            raise ValueError(f"edge ({s}, {d}) out of range for size {size}")
+        if s == d:
+            raise ValueError(f"self-edge ({s}, {d}): keep local data local")
+        if (s, d) not in seen:
+            seen.add((s, d))
+            remaining.append((s, d))
+    rounds: List[List[Pair]] = []
+    while remaining:
+        used_s, used_d = set(), set()
+        this_round, rest = [], []
+        for e in remaining:
+            s, d = e
+            if s in used_s or d in used_d:
+                rest.append(e)
+            else:
+                used_s.add(s)
+                used_d.add(d)
+                this_round.append(e)
+        rounds.append(this_round)
+        remaining = rest
+    return rounds

@@ -249,3 +249,203 @@ def cart_create(comm: Communicator, dims: Sequence[int],
     """MPI_Cart_create [S] (reorder is meaningless here: ranks are mesh
     positions already)."""
     return CartComm(comm, dims, periods)
+
+
+class GraphComm:
+    """Arbitrary directed process graphs — MPI_(Dist_)graph topologies [S].
+
+    SPMD-compatible spelling: the GLOBAL edge list is given (identical on
+    every rank), so the whole neighborhood structure is static — exactly
+    what one traced program needs.  ``dist_graph_create_adjacent`` builds
+    it from MPI's per-rank adjacency spelling on the process backends (an
+    allgather of local edges, as real MPI implementations do internally).
+
+    Communication decomposes into partial-permutation rounds
+    (``schedules.graph_rounds`` — greedy edge coloring), each lowering to
+    one ``comm.exchange`` (= one ``lax.ppermute`` on the SPMD backend):
+    the same portable-primitives-only recipe as :class:`CartComm`.
+
+    Result convention (matches the vector collectives): the process
+    backends return exact in-neighbor-ordered lists; the SPMD backend,
+    whose shapes are static, returns a stacked ``[max_in_degree, ...]``
+    array padded with ``fill`` — rows ``[:in_degree(r)]`` match the list.
+    """
+
+    def __init__(self, comm: Communicator, edges: Sequence[Pair],
+                 in_order: Optional[Sequence[Sequence[int]]] = None,
+                 out_order: Optional[Sequence[Sequence[int]]] = None):
+        from . import schedules
+
+        self.comm = comm
+        size = comm.size
+        self._rounds = schedules.graph_rounds(edges, size)  # validates
+        # neighbor order is the INPUT edge-list order — never the
+        # coloring's round order, which would silently permute results;
+        # dist_graph_create_adjacent overrides with each rank's OWN
+        # sources/destinations order (the MPI contract) via
+        # in_order/out_order
+        seen = set()
+        self.edges = [e for e in ((int(s), int(d)) for s, d in edges)
+                      if not (e in seen or seen.add(e))]
+        self._in: List[List[int]] = [[] for _ in range(size)]
+        self._out: List[List[int]] = [[] for _ in range(size)]
+        for s, d in self.edges:  # one O(E) pass
+            self._in[d].append(s)
+            self._out[s].append(d)
+        for given, derived, what in ((in_order, self._in, "in_order"),
+                                     (out_order, self._out, "out_order")):
+            if given is None:
+                continue
+            for r in range(size):
+                if sorted(given[r]) != sorted(derived[r]):
+                    raise ValueError(
+                        f"{what}[{r}]={list(given[r])} names a different "
+                        f"neighbor set than the edges ({derived[r]})")
+                derived[r] = [int(x) for x in given[r]]
+        # round index of each (src, dst) edge
+        self._round_of = {e: k for k, rnd in enumerate(self._rounds)
+                          for e in rnd}
+
+    # -- static queries (host-side) ----------------------------------------
+
+    @property
+    def size(self) -> int:
+        return self.comm.size
+
+    @property
+    def rank(self):
+        return self.comm.rank
+
+    @property
+    def n_rounds(self) -> int:
+        return len(self._rounds)
+
+    @property
+    def max_in_degree(self) -> int:
+        return max((len(n) for n in self._in), default=0)
+
+    @property
+    def max_out_degree(self) -> int:
+        return max((len(n) for n in self._out), default=0)
+
+    def in_neighbors_of(self, rank: int) -> List[int]:
+        """MPI_Dist_graph_neighbors, incoming half (edge-list order)."""
+        return list(self._in[rank])
+
+    def out_neighbors_of(self, rank: int) -> List[int]:
+        return list(self._out[rank])
+
+    # -- neighborhood collectives [S: MPI-3 MPI_Neighbor_* over graphs] ----
+
+    def _spmd(self) -> bool:
+        return not isinstance(self.comm.rank, int)
+
+    def _spmd_gather_receipts(self, receipts: List[Any], fill: Any):
+        """Reorder per-round receipts into per-in-neighbor slots (SPMD
+        result shape: stacked [max_in_degree, ...] padded with fill —
+        slot k of rank r's output = the round its k-th in-edge ran in;
+        padded rows point at round 0 and are overwritten with fill)."""
+        import jax.numpy as jnp
+
+        from jax import lax
+
+        size, maxd = self.size, self.max_in_degree
+        if not receipts or maxd == 0:  # edgeless graph: static empty stack
+            shape = () if not receipts else jnp.asarray(receipts[0]).shape
+            return jnp.zeros((0,) + shape)
+        table = [[self._round_of[(s, r)] for s in self._in[r]]
+                 + [0] * (maxd - len(self._in[r])) for r in range(size)]
+        me = lax.axis_index(self.comm.axis_name)
+        stacked = jnp.stack([jnp.asarray(x) for x in receipts])
+        out = jnp.take(stacked, jnp.asarray(table)[me], axis=0)
+        deg = jnp.asarray([len(self._in[r]) for r in range(size)])[me]
+        mask = (jnp.arange(maxd) < deg).reshape(
+            (maxd,) + (1,) * (out.ndim - 1))
+        return jnp.where(mask, out, jnp.full_like(out, fill))
+
+    def neighbor_allgather(self, obj: Any, fill: Any = 0):
+        """Every rank contributes ``obj``; each rank receives one payload
+        per IN-neighbor (see class docstring for the per-backend result
+        shape).  ``n_rounds`` exchanges total."""
+        receipts = [self.comm.exchange(obj, rnd, fill=fill)
+                    for rnd in self._rounds]
+        if not self._spmd():
+            r = self.comm.rank
+            return [receipts[self._round_of[(s, r)]] for s in self._in[r]]
+        return self._spmd_gather_receipts(receipts, fill)
+
+    def neighbor_alltoall(self, objs: Sequence[Any], fill: Any = 0):
+        """One DISTINCT payload per OUT-neighbor (out-neighbor order;
+        stacked [max_out_degree, ...] on the SPMD backend); returns the
+        payloads received from each in-neighbor (allgather conventions)."""
+        receipts = []
+        if not self._spmd():
+            r = self.comm.rank
+            if len(objs) != len(self._out[r]):
+                raise ValueError(
+                    f"rank {r}: need one payload per out-neighbor "
+                    f"({len(self._out[r])}), got {len(objs)}")
+            for k, rnd in enumerate(self._rounds):
+                mine = next((d for (s, d) in rnd if s == r), None)
+                payload = (objs[self._out[r].index(mine)]
+                           if mine is not None else None)
+                receipts.append(self.comm.exchange(payload, rnd, fill=fill))
+            return [receipts[self._round_of[(s, r)]] for s in self._in[r]]
+        import jax.numpy as jnp
+
+        from jax import lax
+
+        x = jnp.asarray(objs)
+        size, maxd = self.size, self.max_out_degree
+        if x.shape[0] != maxd:
+            raise ValueError(
+                f"SPMD neighbor_alltoall payload needs leading dim == "
+                f"max_out_degree ({maxd}), got {x.shape}")
+        # which out-block each rank ships in round k (0 when idle: the
+        # exchange pattern has no edge from an idle rank, so the payload
+        # choice is irrelevant — nothing is sent)
+        send_slot = [[next((self._out[r].index(d) for (s, d) in rnd
+                            if s == r), 0) for r in range(size)]
+                     for rnd in self._rounds]
+        me = lax.axis_index(self.comm.axis_name)
+        receipts = []
+        for k, rnd in enumerate(self._rounds):
+            slot = jnp.asarray(send_slot[k])[me]
+            payload = lax.dynamic_index_in_dim(x, slot, 0, keepdims=False)
+            receipts.append(self.comm.exchange(payload, rnd, fill=fill))
+        return self._spmd_gather_receipts(receipts, fill)
+
+
+def graph_create(comm: Communicator, edges: Sequence[Pair]) -> GraphComm:
+    """MPI_Dist_graph_create with the global edge list [S] (the
+    SPMD-compatible spelling; identical on every rank)."""
+    return GraphComm(comm, edges)
+
+
+def dist_graph_create_adjacent(comm: Communicator,
+                               sources: Sequence[int],
+                               destinations: Sequence[int]) -> GraphComm:
+    """MPI_Dist_graph_create_adjacent [S]: every rank names ITS incoming
+    ``sources`` and outgoing ``destinations``; the global edge list is the
+    allgathered union (what MPI implementations build internally).
+    Process backends only — the allgather of per-rank Python lists has no
+    SPMD analogue; use :func:`graph_create` there."""
+    r = comm.rank
+    if not isinstance(r, int):
+        raise TypeError(
+            "dist_graph_create_adjacent needs per-rank adjacency lists, "
+            "which an SPMD trace cannot collect — pass the global edge "
+            "list to graph_create instead")
+    local = ([int(s) for s in sources], [int(d) for d in destinations])
+    gathered = comm.allgather(local)  # [(sources, destinations)] per rank
+    seen, edges = set(), []
+    for rk, (srcs, dsts) in enumerate(gathered):
+        for e in ([(s, rk) for s in srcs] + [(rk, d) for d in dsts]):
+            if e not in seen:
+                seen.add(e)
+                edges.append(e)
+    # each rank's neighbor ORDER is its own sources/destinations order
+    # (the MPI contract), not the union scan order
+    return GraphComm(comm, edges,
+                     in_order=[srcs for srcs, _ in gathered],
+                     out_order=[dsts for _, dsts in gathered])

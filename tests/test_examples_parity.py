@@ -76,3 +76,33 @@ def test_jacobi_socket_parity():
     np.testing.assert_allclose(
         blocks_s, np.asarray(blocks_t).reshape(2 * rows, cols), rtol=1e-5, atol=1e-7
     )
+
+
+def test_master_worker_matches_serial_oracle():
+    """The dynamic task farm (tags + Waitany, self-balancing) returns every
+    task's result exactly once, equal to the serial computation."""
+    from examples.master_worker import _task, run as mw_run
+
+    NT = 25
+    res = run_local(lambda c: mw_run(c, NT), 4)
+    oracle = [_task(i) for i in range(NT)]
+    got = res[0]
+    assert len(got) == NT and all(r is not None for r in got)
+    np.testing.assert_allclose(got, oracle)
+    # workers return None
+    assert res[1] is None and res[3] is None
+
+
+def test_master_worker_single_rank_degenerates():
+    from examples.master_worker import _task, run as mw_run
+
+    res = run_local(lambda c: mw_run(c, 7), 1)
+    np.testing.assert_allclose(res[0], [_task(i) for i in range(7)])
+
+
+def test_master_worker_more_workers_than_tasks():
+    """Surplus workers are stopped at priming and get no dangling irecv."""
+    from examples.master_worker import _task, run as mw_run
+
+    res = run_local(lambda c: mw_run(c, 2), 6)  # 5 workers, 2 tasks
+    np.testing.assert_allclose(res[0], [_task(0), _task(1)])

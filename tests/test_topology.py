@@ -328,3 +328,150 @@ def test_neighbors_of_order():
     res = run_local(prog2, P)
     # rank 5 = coords (1, 1): -x → (0,1)=1, +x → (2,1)=None, -y → (1,0)=4, +y → (1,2)=6
     assert res[0] == [1, None, 4, 6]
+
+
+# -- graph topologies (MPI_(Dist_)graph) ------------------------------------
+
+
+def test_graph_rounds_partial_permutations():
+    from mpi_tpu import checker, schedules
+
+    edges = [(0, 1), (0, 2), (1, 2), (2, 0), (3, 0), (1, 3), (2, 3)]
+    rounds = schedules.graph_rounds(edges, 4)
+    for rnd in rounds:
+        checker.validate_perm(rnd, 4)
+    flat = [e for rnd in rounds for e in rnd]
+    assert sorted(flat) == sorted(set(edges))
+    with pytest.raises(ValueError, match="self-edge"):
+        schedules.graph_rounds([(1, 1)], 4)
+    with pytest.raises(ValueError, match="out of range"):
+        schedules.graph_rounds([(0, 9)], 4)
+
+
+def test_graph_neighbor_allgather_local():
+    from mpi_tpu.topology import graph_create
+
+    edges = [(0, 1), (1, 2), (2, 3), (3, 0), (0, 2), (3, 1)]
+
+    def prog(comm):
+        g = graph_create(comm, edges)
+        got = g.neighbor_allgather(("from", comm.rank))
+        return g.in_neighbors_of(comm.rank), got
+
+    res = run_local(prog, 4)
+    for r in range(4):
+        in_nb, got = res[r]
+        assert got == [("from", s) for s in in_nb], (r, in_nb, got)
+
+
+def test_graph_neighbor_alltoall_local():
+    from mpi_tpu.topology import graph_create
+
+    edges = [(0, 1), (1, 2), (2, 3), (3, 0), (0, 2), (3, 1)]
+
+    def prog(comm):
+        g = graph_create(comm, edges)
+        me = comm.rank
+        objs = [("pkt", me, d) for d in g.out_neighbors_of(me)]
+        return g.in_neighbors_of(me), g.neighbor_alltoall(objs)
+
+    res = run_local(prog, 4)
+    for r in range(4):
+        in_nb, got = res[r]
+        assert got == [("pkt", s, r) for s in in_nb], (r, in_nb, got)
+
+
+def test_dist_graph_create_adjacent_matches_global():
+    from mpi_tpu.topology import dist_graph_create_adjacent, graph_create
+
+    edges = [(0, 1), (1, 2), (2, 0), (0, 2)]
+
+    def prog(comm):
+        g_global = graph_create(comm, edges)
+        me = comm.rank
+        g_adj = dist_graph_create_adjacent(
+            comm,
+            sources=g_global.in_neighbors_of(me),
+            destinations=g_global.out_neighbors_of(me))
+        return (sorted(g_adj.edges) == sorted(g_global.edges),
+                g_adj.neighbor_allgather(me * 10))
+
+    res = run_local(prog, 3)
+    for r in range(3):
+        same, got = res[r]
+        assert same
+        in_nb = [s for (s, d) in edges if d == r]
+        assert got == [s * 10 for s in in_nb]
+
+
+def test_dist_graph_adjacent_respects_each_ranks_order():
+    """MPI contract: results are ordered by each rank's OWN sources list,
+    even when it disagrees with every other ordering (code-review
+    regression: the union scan order must not leak through)."""
+    from mpi_tpu.topology import dist_graph_create_adjacent
+
+    # rank 2 receives from 0 and 1; it names them REVERSED
+    def prog(comm):
+        me = comm.rank
+        sources = {0: [], 1: [], 2: [1, 0]}[me]
+        dests = {0: [2], 1: [2], 2: []}[me]
+        g = dist_graph_create_adjacent(comm, sources, dests)
+        return g.neighbor_allgather(me * 10)
+
+    res = run_local(prog, 3)
+    assert res[2] == [10, 0]  # from rank 1 FIRST — rank 2's stated order
+
+
+def test_graph_neighbor_allgather_tpu_parity():
+    """SPMD result: stacked [max_in_degree, ...] padded with fill; rows
+    [:in_degree] equal the process-backend list."""
+    import jax.numpy as jnp
+
+    from mpi_tpu.topology import graph_create
+    from mpi_tpu.tpu import TpuCommunicator, default_mesh, run_spmd
+
+    edges = [(0, 1), (1, 2), (2, 3), (3, 4), (4, 5), (5, 6), (6, 7),
+             (7, 0), (0, 4), (2, 6), (5, 1)]
+    mesh = default_mesh(8)
+    world = TpuCommunicator("world", mesh)
+    g = graph_create(world, edges)
+
+    def prog(comm, x):
+        return g.neighbor_allgather(x[comm.rank], fill=-1.0)
+
+    data = np.arange(8.0, dtype=np.float32) * 10
+    out = np.asarray(run_spmd(prog, data, mesh=mesh))
+    out = out.reshape(8, g.max_in_degree)
+    for r in range(8):
+        in_nb = g.in_neighbors_of(r)
+        np.testing.assert_allclose(out[r, :len(in_nb)],
+                                   [data[s] for s in in_nb])
+        np.testing.assert_allclose(out[r, len(in_nb):], -1.0)
+
+
+def test_graph_neighbor_alltoall_tpu_parity():
+    from mpi_tpu.topology import graph_create
+    from mpi_tpu.tpu import TpuCommunicator, default_mesh, run_spmd
+
+    edges = [(0, 1), (1, 2), (2, 3), (3, 0), (0, 2), (3, 1)]
+    mesh = default_mesh(4)
+    world = TpuCommunicator("world", mesh)
+    g = graph_create(world, edges)
+    maxo = g.max_out_degree
+
+    # payload block for out-neighbor slot k on rank r: 100*r + k
+    blocks = np.zeros((4, maxo), np.float32)
+    for r in range(4):
+        for k in range(maxo):
+            blocks[r, k] = 100 * r + k
+
+    def prog(comm, x):
+        return g.neighbor_alltoall(x[comm.rank][:, None], fill=-1.0)
+
+    out = np.asarray(run_spmd(prog, blocks, mesh=mesh, nranks=4))
+    out = out.reshape(4, g.max_in_degree)
+    for r in range(4):
+        in_nb = g.in_neighbors_of(r)
+        expect = [100 * s + g.out_neighbors_of(s).index(r) for s in in_nb]
+        np.testing.assert_allclose(out[r, :len(in_nb)], expect)
+        np.testing.assert_allclose(out[r, len(in_nb):], -1.0)
