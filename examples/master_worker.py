@@ -99,6 +99,12 @@ def main() -> None:
 
     import mpi_tpu
 
+    if args.backend == "tpu":
+        raise SystemExit(
+            "master_worker is rank-dynamic by design (the master branches "
+            "on which worker answered) — that has no SPMD spelling, so the "
+            "tpu backend is not supported; run it on socket/shm/local and "
+            "jit the per-task compute instead (module docstring)")
     if args.backend in (None, "socket", "shm"):
         comm = mpi_tpu.init(args.backend)
         res = run(comm, args.tasks)

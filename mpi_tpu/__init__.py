@@ -34,6 +34,7 @@ from .communicator import Communicator, P2PCommunicator, Request, Status
 from .transport.base import ANY_SOURCE, ANY_TAG
 from .transport.local import run_local
 from . import schedules, checker, checkpoint, profiling, trace
+from .intercomm import InterComm, create_intercomm
 from .topology import (CartComm, GraphComm, cart_create,
                        dims_create, dist_graph_create_adjacent,
                        graph_create)
@@ -46,7 +47,8 @@ __all__ = [
     "Communicator", "P2PCommunicator", "Request", "Status", "ANY_SOURCE", "ANY_TAG",
     "init", "finalize", "is_initialized", "run", "run_local",
     "schedules", "checker", "checkpoint", "profiling", "trace", "COMM_WORLD",
-    "CartComm", "GraphComm", "cart_create", "graph_create",
+    "CartComm", "GraphComm", "InterComm", "create_intercomm",
+    "cart_create", "graph_create",
     "dist_graph_create_adjacent", "dims_create", "Group",
     "GetFuture", "P2PWindow",
 ]

@@ -38,6 +38,8 @@ __all__ = [
     "MPI_Gatherv", "MPI_Scatterv", "MPI_Allgatherv", "MPI_Alltoallv",
     "MPI_Cart_create", "MPI_Dims_create", "MPI_Cart_coords", "MPI_Cart_rank",
     "MPI_Graph_create", "MPI_Dist_graph_create_adjacent",
+    "MPI_Intercomm_create", "MPI_Intercomm_merge",
+    "MPI_Comm_remote_size", "MPI_Comm_test_inter",
     "MPI_Cart_shift", "MPI_Cart_sub",
     "MPI_Neighbor_allgather", "MPI_Neighbor_alltoall",
     "MPI_Comm_group", "MPI_Comm_create", "MPI_Comm_create_group",
@@ -328,6 +330,28 @@ def MPI_Cart_create(dims: Sequence[int], periods: Optional[Sequence[bool]] = Non
     return cart_create(_world(comm), dims, periods)
 
 
+def MPI_Intercomm_create(group_a, group_b,
+                         comm: Optional[Communicator] = None):
+    """Two-group intercommunicator from explicit disjoint parent-rank
+    groups (the host-side spelling of the leader/bridge protocol — see
+    mpi_tpu/intercomm.py); returns None on non-member ranks."""
+    from .intercomm import create_intercomm
+
+    return create_intercomm(_world(comm), group_a, group_b)
+
+
+def MPI_Intercomm_merge(intercomm, high: bool = False):
+    return intercomm.merge(high)
+
+
+def MPI_Comm_remote_size(intercomm) -> int:
+    return intercomm.remote_size
+
+
+def MPI_Comm_test_inter(comm) -> bool:
+    return getattr(comm, "is_inter", False)
+
+
 def MPI_Graph_create(edges, comm: Optional[Communicator] = None):
     """Arbitrary directed process graph from the global edge list [S]."""
     from .topology import graph_create
@@ -528,9 +552,9 @@ def MPI_Get_version():
     MPI-2/3 features are present beyond that (active-target RMA,
     persistent requests, nonblocking collectives, neighborhood
     collectives, Waitany/Waitsome/Testall/Testany, graph topologies with
-    neighborhood collectives), but passive-target RMA, intercommunicators,
-    and derived datatypes are not, so claiming (3, 0) here would overstate
-    conformance."""
+    neighborhood collectives, intercommunicators with merge), but
+    passive-target RMA and derived datatypes are not, so claiming (3, 0)
+    here would overstate conformance."""
     return (1, 3)
 
 

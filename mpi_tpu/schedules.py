@@ -178,23 +178,32 @@ def dissemination_offsets(size: int) -> List[int]:
     return offs
 
 
-def graph_rounds(edges: Sequence[Pair], size: int) -> List[List[Pair]]:
-    """Decompose an arbitrary directed edge set into partial-permutation
-    rounds (greedy edge coloring): within a round no rank sends twice and
-    no rank receives twice — exactly ``lax.ppermute``'s precondition, so a
-    graph-neighborhood collective lowers to one ppermute per round.  Round
-    count ≤ 2·max(in_degree, out_degree) − 1 (bipartite greedy bound);
-    self-edges are rejected (express local reuse in user code)."""
+def dedupe_edges(edges: Sequence[Pair], size: int) -> List[Pair]:
+    """Validate a directed edge list and drop duplicates, keeping the
+    FIRST occurrence's position (neighbor order is input order — the
+    dist_graph contract).  Self-edges are rejected (keep local data
+    local); shared by graph_rounds and topology.GraphComm."""
     seen = set()
-    remaining: List[Pair] = []
+    out: List[Pair] = []
     for s, d in edges:
+        s, d = int(s), int(d)
         if not (0 <= s < size and 0 <= d < size):
             raise ValueError(f"edge ({s}, {d}) out of range for size {size}")
         if s == d:
             raise ValueError(f"self-edge ({s}, {d}): keep local data local")
         if (s, d) not in seen:
             seen.add((s, d))
-            remaining.append((s, d))
+            out.append((s, d))
+    return out
+
+
+def graph_rounds(edges: Sequence[Pair], size: int) -> List[List[Pair]]:
+    """Decompose an arbitrary directed edge set into partial-permutation
+    rounds (greedy edge coloring): within a round no rank sends twice and
+    no rank receives twice — exactly ``lax.ppermute``'s precondition, so a
+    graph-neighborhood collective lowers to one ppermute per round.  Round
+    count ≤ 2·max(in_degree, out_degree) − 1 (bipartite greedy bound)."""
+    remaining = dedupe_edges(edges, size)
     rounds: List[List[Pair]] = []
     while remaining:
         used_s, used_d = set(), set()

@@ -278,15 +278,13 @@ class GraphComm:
 
         self.comm = comm
         size = comm.size
-        self._rounds = schedules.graph_rounds(edges, size)  # validates
         # neighbor order is the INPUT edge-list order — never the
         # coloring's round order, which would silently permute results;
         # dist_graph_create_adjacent overrides with each rank's OWN
         # sources/destinations order (the MPI contract) via
         # in_order/out_order
-        seen = set()
-        self.edges = [e for e in ((int(s), int(d)) for s, d in edges)
-                      if not (e in seen or seen.add(e))]
+        self.edges = schedules.dedupe_edges(edges, size)
+        self._rounds = schedules.graph_rounds(self.edges, size)
         self._in: List[List[int]] = [[] for _ in range(size)]
         self._out: List[List[int]] = [[] for _ in range(size)]
         for s, d in self.edges:  # one O(E) pass

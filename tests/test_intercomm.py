@@ -1,0 +1,193 @@
+"""Intercommunicators (MPI_Intercomm_create/merge, two-group semantics)."""
+
+import numpy as np
+import pytest
+
+from mpi_tpu import create_intercomm, ops
+from mpi_tpu.communicator import Status
+from mpi_tpu.intercomm import PROC_NULL, ROOT
+from mpi_tpu.transport.local import run_local
+
+A, B = [0, 1, 2], [3, 4]  # 3-rank group coupled to a 2-rank group
+
+
+def _mk(comm):
+    return create_intercomm(comm, A, B)
+
+
+def test_identity_and_sizes():
+    def prog(comm):
+        ic = _mk(comm)
+        return ic.rank, ic.size, ic.remote_size, ic.is_inter
+
+    res = run_local(prog, 5)
+    assert res[0] == (0, 3, 2, True)
+    assert res[2] == (2, 3, 2, True)
+    assert res[3] == (0, 2, 3, True)
+    assert res[4] == (1, 2, 3, True)
+
+
+def test_p2p_addresses_remote_group():
+    def prog(comm):
+        ic = _mk(comm)
+        if comm.rank in A:
+            # A-rank i sends to B-rank i%2 with its own id
+            ic.send(("from-A", ic.rank), dest=ic.rank % 2, tag=5)
+            return None
+        got = []
+        st = Status()
+        for _ in range(2 if ic.rank == 0 else 1):
+            got.append((ic.recv(source=-1, tag=5, status=st), st.source))
+        return sorted(got)
+
+    res = run_local(prog, 5)
+    # B-rank 0 (world 3) hears from A-ranks 0 and 2; B-rank 1 from A-rank 1
+    assert [v for v, _ in res[3]] == [("from-A", 0), ("from-A", 2)]
+    assert all(0 <= s < 3 for _, s in res[3])  # sources are REMOTE ranks
+    assert res[4] == [(("from-A", 1), 1)]
+
+
+def test_rooted_bcast():
+    def prog(comm):
+        ic = _mk(comm)
+        if comm.rank in A:
+            root = ROOT if ic.rank == 1 else PROC_NULL
+            return ic.bcast(("payload", 42), root)
+        return ic.bcast(None, 1)  # root is A-rank 1, seen from B
+
+    res = run_local(prog, 5)
+    assert res[3] == res[4] == ("payload", 42)
+
+
+def test_allgather_and_allreduce_cross_group():
+    def prog(comm):
+        ic = _mk(comm)
+        mine = 10 * (ic.rank + 1) if comm.rank in A else -(ic.rank + 1)
+        return ic.allgather(mine), ic.allreduce(mine, op=ops.SUM)
+
+    res = run_local(prog, 5)
+    # A side sees B's contributions; B side sees A's
+    assert res[0] == ([-1, -2], -3)
+    assert res[3] == ([10, 20, 30], 60)
+
+
+def test_alltoall_cross_group():
+    def prog(comm):
+        ic = _mk(comm)
+        objs = [(ic.rank, j) for j in range(ic.remote_size)]
+        return ic.alltoall(objs)
+
+    res = run_local(prog, 5)
+    assert res[0] == [(0, 0), (1, 0)]      # A-rank 0 hears from B-ranks 0,1
+    assert res[3] == [(0, 0), (1, 0), (2, 0)]
+    assert res[4] == [(0, 1), (1, 1), (2, 1)]
+
+
+def test_merge_orders_low_group_first():
+    def prog(comm):
+        ic = _mk(comm)
+        merged = ic.merge(high=comm.rank in B)  # A low, B high
+        return merged.rank, merged.size, merged.allreduce(comm.rank)
+
+    res = run_local(prog, 5)
+    assert [res[r][0] for r in range(5)] == [0, 1, 2, 3, 4]
+    assert all(r[1] == 5 and r[2] == sum(range(5)) for r in res)
+
+
+def test_merge_high_group_first():
+    def prog(comm):
+        ic = _mk(comm)
+        merged = ic.merge(high=comm.rank in A)  # B low this time
+        return merged.rank
+
+    res = run_local(prog, 5)
+    assert [res[r] for r in range(5)] == [2, 3, 4, 0, 1]
+
+
+def test_nonmembers_get_none_and_validation():
+    def prog(comm):
+        ic = create_intercomm(comm, [0], [2])
+        return None if ic is None else ic.rank
+
+    res = run_local(prog, 4)
+    assert res == [0, None, 0, None]
+
+    def bad(comm):
+        try:
+            create_intercomm(comm, [0, 1], [1, 2])
+        except ValueError as e:
+            return "disjoint" in str(e)
+
+    assert all(run_local(bad, 3))
+
+
+def test_intercomm_isolated_from_parent_traffic():
+    """Intercomm p2p must never match a recv on the parent communicator
+    (fresh context via split)."""
+    def prog(comm):
+        ic = _mk(comm)
+        if comm.rank == 0:
+            ic.send("inter", dest=0, tag=7)      # to B-rank 0 == world 3
+            comm.send("intra", dest=3, tag=7)    # parent-path message
+            return None
+        if comm.rank == 3:
+            intra = comm.recv(source=0, tag=7)
+            inter = ic.recv(source=0, tag=7)
+            return intra, inter
+        return None
+
+    res = run_local(prog, 5)
+    assert res[3] == ("intra", "inter")
+
+
+def test_spmd_backend_diagnostic():
+    from mpi_tpu.tpu import TpuCommunicator, default_mesh
+
+    comm = TpuCommunicator("world", default_mesh())
+    with pytest.raises(NotImplementedError, match="split_by"):
+        create_intercomm(comm, [0, 1], [2, 3])
+
+
+def test_create_accepts_group_objects_and_validates():
+    from mpi_tpu import Group
+
+    def prog(comm):
+        ic = create_intercomm(comm, Group([0, 1]), Group([2]))
+        out = None if ic is None else (ic.rank, ic.remote_size)
+        try:
+            create_intercomm(comm, [0, 0], [1])
+            dup_ok = False
+        except ValueError:
+            dup_ok = True
+        try:
+            create_intercomm(comm, [0], [])
+            empty_ok = False
+        except ValueError:
+            empty_ok = True
+        return out, dup_ok, empty_ok
+
+    res = run_local(prog, 3)
+    assert res[0] == ((0, 1), True, True)
+    assert res[2] == ((0, 2), True, True)
+
+
+def test_wildcard_recv_cannot_steal_collective_payload():
+    """Internal collective tags are negative: a user ANY_TAG recv must
+    never match a bcast payload (code-review regression)."""
+    def prog(comm):
+        ic = _mk(comm)
+        if comm.rank in A:
+            root = ROOT if ic.rank == 0 else PROC_NULL
+            ic.bcast("SECRET", root)
+            if ic.rank == 0:
+                ic.send("user-msg", dest=0, tag=9)
+            return None
+        if ic.rank == 0:
+            got = ic.recv(source=-1, tag=-1)   # wildcard BEFORE bcast recv
+            secret = ic.bcast(None, 0)
+            return got, secret
+        return None, ic.bcast(None, 0)
+
+    res = run_local(prog, 5)
+    assert res[3] == ("user-msg", "SECRET")
+    assert res[4][1] == "SECRET"
