@@ -44,6 +44,7 @@ __all__ = [
     "MPI_Neighbor_allgather", "MPI_Neighbor_alltoall",
     "MPI_Comm_group", "MPI_Comm_create", "MPI_Comm_create_group",
     "MPI_Win_create", "MPI_Win_fence", "MPI_Win_free",
+    "MPI_Win_lock", "MPI_Win_unlock",
     "MPI_Put", "MPI_Get", "MPI_Accumulate",
     "MPI_Group_incl", "MPI_Group_excl", "MPI_Group_union",
     "MPI_Group_intersection", "MPI_Group_difference", "MPI_Group_size",
@@ -460,6 +461,15 @@ def MPI_Accumulate(win, data: Any, target, op=ops.SUM, loc: Any = None) -> None:
     win.accumulate(data, target, op=op, loc=loc)
 
 
+def MPI_Win_lock(win, rank: int, exclusive: bool = True) -> None:
+    """MPI_Win_lock [S]: passive-target epoch (process backends)."""
+    win.lock(rank, exclusive)
+
+
+def MPI_Win_unlock(win, rank: int) -> None:
+    win.unlock(rank)
+
+
 def MPI_Win_free(win) -> None:
     win.free()
 
@@ -552,9 +562,10 @@ def MPI_Get_version():
     MPI-2/3 features are present beyond that (active-target RMA,
     persistent requests, nonblocking collectives, neighborhood
     collectives, Waitany/Waitsome/Testall/Testany, graph topologies with
-    neighborhood collectives, intercommunicators with merge), but
-    passive-target RMA and derived datatypes are not, so claiming (3, 0)
-    here would overstate conformance."""
+    neighborhood collectives, intercommunicators with merge,
+    passive-target RMA lock/unlock on the process backends), but derived
+    datatypes and a few request-set/env corners are not, so claiming
+    (3, 0) here would overstate conformance."""
     return (1, 3)
 
 

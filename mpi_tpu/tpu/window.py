@@ -47,6 +47,29 @@ class TpuWindow:
     thread it out of the traced function like any other jax value.
     """
 
+    @staticmethod
+    def _no_passive(*_a, **_k):
+        raise NotImplementedError(
+            "passive-target RMA (Win_lock/unlock) has no SPMD spelling — "
+            "one traced program cannot leave a device's window passively "
+            "accessible mid-trace; use fence epochs (active target) on "
+            "this backend, or the process backends for lock/unlock")
+
+    def lock(self, rank: int, exclusive: bool = True):
+        self._no_passive()
+
+    def unlock(self, rank: int):
+        self._no_passive()
+
+    def put_at(self, rank: int, data=None, loc=None):
+        self._no_passive()
+
+    def get_at(self, rank: int, loc=None):
+        self._no_passive()
+
+    def accumulate_at(self, rank: int, data=None, op=None, loc=None):
+        self._no_passive()
+
     def __init__(self, comm, init: Any):
         self._comm = comm
         self._arr = jnp.asarray(init)

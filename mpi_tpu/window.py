@@ -47,6 +47,8 @@ Pair = Tuple[int, int]
 # never matched by user-level ANY_TAG).
 _TAG_RMA = -6
 _TAG_RMA_REPLY = -7
+_TAG_PASSIVE = -8        # origin -> target window server
+_TAG_PASSIVE_REPLY = -9  # server -> origin (lock grant / get data / acks)
 
 
 class GetFuture:
@@ -122,6 +124,10 @@ class P2PWindow:
         self._gets: List[Tuple] = []
         self._issue = 0
         self._freed = False
+        # passive-target server (win_create is collective [S], so the
+        # context allocation below is deterministic on every rank, and
+        # every rank has a live server before any origin can lock it)
+        self._ensure_server()
 
     # -- epoch ops ---------------------------------------------------------
 
@@ -232,7 +238,218 @@ class P2PWindow:
         self._issue = 0
         self._epoch += 1
 
+    # -- passive target (MPI-2 MPI_Win_lock/unlock) [S] --------------------
+    # A per-window SERVER THREAD on an isolated child context services
+    # lock/put/get/accumulate/unlock requests without the target's user
+    # code participating — true one-sided access, unlike the fence epochs
+    # above.  Exclusive locks serialize writers; shared locks admit
+    # concurrent readers (readers-writer with FIFO handoff).  Ops issued
+    # inside a lock epoch are applied at the target in issue order (FIFO
+    # per-pair transport ordering); ``unlock`` acks only after everything
+    # sent under the lock has been applied — MPI's completion-at-unlock.
+    # Self-targeted epochs bypass messaging and apply under the server's
+    # mutex (deadlock-free on every transport).
+
+    def _ensure_server(self):
+        import threading
+
+        from .communicator import P2PCommunicator
+
+        if getattr(self, "_srv_thread", None) is not None:
+            return
+        # isolated child context (deterministic: same _alloc_context
+        # sequence on every rank since win_create is collective); NO
+        # recv_timeout — the server idles between requests by design
+        ctx = self._comm._alloc_context()
+        self._srv_comm = P2PCommunicator(self._comm._t, self._comm._group,
+                                         ctx, recv_timeout=None)
+        self._srv_mutex = threading.Lock()   # buffer + lock-state guard
+        self._lock_state: dict = {"holders": set(), "excl": None,
+                                  "queue": []}
+        self._srv_errors: dict = {}
+        t = threading.Thread(target=self._serve, daemon=True,
+                             name=f"win{self._wid}-server")
+        self._srv_thread = t
+        t.start()
+
+    def _serve(self) -> None:
+        from .communicator import Status
+        from .transport.base import ANY_SOURCE
+
+        c = self._srv_comm
+        st = Status()
+        while True:
+            try:
+                msg = c._recv_internal(ANY_SOURCE, _TAG_PASSIVE, st)
+            except Exception:  # transport closed (finalize) → done
+                return
+            src = st.source
+            kind = msg[0]
+            if kind == "stop":
+                return
+            # every branch is guarded: a bad op (shape mismatch, bad loc,
+            # failing combiner) must NEVER kill the server — it is recorded
+            # (or replied) and re-raised at the ORIGIN, and serving
+            # continues (code-review: a dead server turned one bad put
+            # into a permanent hang of every later lock on this rank)
+            try:
+                if kind == "lock":
+                    self._request_lock(
+                        src, exclusive=msg[1],
+                        notify=lambda r=src: c._send_internal(
+                            ("granted",), r, _TAG_PASSIVE_REPLY))
+                elif kind == "unlock":
+                    with self._srv_mutex:
+                        err = self._srv_errors.pop(src, None)
+                        self._srv_release(src)
+                    c._send_internal(("unlocked", err), src,
+                                     _TAG_PASSIVE_REPLY)
+                elif kind == "get":
+                    try:
+                        with self._srv_mutex:
+                            val = self._read(msg[1])
+                        reply = ("ok", val)
+                    except Exception as e:  # noqa: BLE001 - to origin
+                        reply = ("err", f"{type(e).__name__}: {e}")
+                    c._send_internal(reply, src, _TAG_PASSIVE_REPLY)
+                else:  # "put" / "acc": no reply — errors surface at unlock
+                    try:
+                        _, data, loc, op = msg
+                        with self._srv_mutex:
+                            self._apply("put" if kind == "put" else "acc",
+                                        data, loc, op)
+                    except Exception as e:  # noqa: BLE001 - to origin
+                        with self._srv_mutex:
+                            self._srv_errors.setdefault(
+                                src, f"{type(e).__name__}: {e}")
+            except Exception:  # reply-send failure: peer gone; keep serving
+                pass
+
+    def _request_lock(self, src: int, exclusive: bool, notify) -> None:
+        """Single grant path for remote AND self requesters: grant now if
+        admissible, else join the FIFO queue; ``notify`` fires (under no
+        lock) when granted."""
+        with self._srv_mutex:
+            s = self._lock_state
+            ok = (s["excl"] is None and not s["holders"]) if exclusive \
+                else (s["excl"] is None and not s["queue"])
+            if ok:
+                s["holders"].add(src)
+                if exclusive:
+                    s["excl"] = src
+            else:
+                s["queue"].append((src, exclusive, notify))
+        if ok:
+            notify()
+
+    def _srv_release(self, src: int) -> None:
+        # caller holds _srv_mutex
+        s = self._lock_state
+        s["holders"].discard(src)
+        if s["excl"] == src:
+            s["excl"] = None
+        granted = []
+        while s["queue"]:
+            nxt, excl, notify = s["queue"][0]
+            can = (s["excl"] is None and not s["holders"]) if excl \
+                else s["excl"] is None
+            if not can:
+                break
+            s["queue"].pop(0)
+            s["holders"].add(nxt)
+            if excl:
+                s["excl"] = nxt
+            granted.append(notify)
+            if excl:
+                break
+        for notify in granted:
+            notify()
+
+    def lock(self, rank: int, exclusive: bool = True) -> None:
+        """MPI_Win_lock [S]: open a passive-target access epoch at
+        ``rank``'s window (blocks until granted).  ``exclusive=False`` is
+        MPI_LOCK_SHARED."""
+        self._check_open()
+        self._ensure_server()
+        if rank == self._comm.rank:
+            # self-lock joins the SAME FIFO queue as remote requesters
+            # (fair handoff; an out-of-queue spin could starve under
+            # sustained remote contention)
+            import threading
+
+            granted = threading.Event()
+            self._request_lock(self._comm.rank, exclusive, granted.set)
+            granted.wait()
+            return
+        self._srv_comm._send_internal(("lock", exclusive), rank,
+                                      _TAG_PASSIVE)
+        reply = self._srv_comm._recv_internal(rank, _TAG_PASSIVE_REPLY)
+        assert reply == ("granted",)
+
+    def unlock(self, rank: int) -> None:
+        """MPI_Win_unlock [S]: close the epoch; on return every op issued
+        under the lock has been applied at the target.  An op that FAILED
+        at the target (bad loc/shape/op) re-raises here, at the origin."""
+        self._check_open()
+        if rank == self._comm.rank:
+            with self._srv_mutex:
+                err = self._srv_errors.pop(self._comm.rank, None)
+                self._srv_release(self._comm.rank)
+            if err:
+                raise RuntimeError(f"passive RMA op failed at target "
+                                   f"{rank}: {err}")
+            return
+        self._srv_comm._send_internal(("unlock",), rank, _TAG_PASSIVE)
+        reply = self._srv_comm._recv_internal(rank, _TAG_PASSIVE_REPLY)
+        assert reply[0] == "unlocked"
+        if reply[1]:
+            raise RuntimeError(
+                f"passive RMA op failed at target {rank}: {reply[1]}")
+
+    def put_at(self, rank: int, data: Any, loc: Any = None) -> None:
+        """Passive put at ``rank`` (call between lock/unlock; applied in
+        issue order, complete at unlock)."""
+        self._check_open()
+        if rank == self._comm.rank:
+            with self._srv_mutex:
+                self._apply("put", np.asarray(data), loc, None)
+            return
+        self._srv_comm._send_internal(("put", np.asarray(data), loc, None),
+                                      rank, _TAG_PASSIVE)
+
+    def accumulate_at(self, rank: int, data: Any,
+                      op: _ops.ReduceOp = _ops.SUM, loc: Any = None) -> None:
+        self._check_open()
+        if rank == self._comm.rank:
+            with self._srv_mutex:
+                self._apply("acc", np.asarray(data), loc, op)
+            return
+        self._srv_comm._send_internal(("acc", np.asarray(data), loc, op),
+                                      rank, _TAG_PASSIVE)
+
+    def get_at(self, rank: int, loc: Any = None) -> Any:
+        """Passive get from ``rank``'s window; returns the value
+        immediately (a strengthening of MPI's complete-at-unlock)."""
+        self._check_open()
+        if rank == self._comm.rank:
+            with self._srv_mutex:
+                return self._read(loc)
+        self._srv_comm._send_internal(("get", loc), rank, _TAG_PASSIVE)
+        tag, val = self._srv_comm._recv_internal(rank, _TAG_PASSIVE_REPLY)
+        if tag == "err":
+            raise RuntimeError(f"passive RMA get failed at target "
+                               f"{rank}: {val}")
+        return val
+
     def free(self) -> None:
+        if getattr(self, "_srv_thread", None) is not None:
+            try:
+                self._srv_comm._send_internal(
+                    ("stop",), self._comm.rank, _TAG_PASSIVE)
+            except Exception:
+                pass
+            self._srv_thread.join(timeout=2.0)
+            self._srv_thread = None
         self._freed = True
 
     # -- internals ---------------------------------------------------------

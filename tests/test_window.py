@@ -213,3 +213,178 @@ def test_two_windows_interleaved_epochs_race():
     res = run_local(prog, P)
     for r in range(P):
         assert res[r] == ((r - 1) % P + 1.0, (r - 1) % P + 10.0), (r, res[r])
+
+
+# -- passive target (MPI_Win_lock/unlock) -----------------------------------
+
+
+def test_passive_put_get_without_target_participation():
+    """True one-sided: the target NEVER calls a window op while the origin
+    locks, writes, reads, unlocks — the per-window server thread services
+    everything."""
+    import time
+
+    def prog(comm):
+        win = comm.win_create(np.zeros(4, np.float32))
+        comm.barrier()
+        if comm.rank == 0:
+            win.lock(1)
+            win.put_at(1, np.arange(4.0, dtype=np.float32))
+            win.accumulate_at(1, np.ones(4, np.float32))
+            got = win.get_at(1)
+            win.unlock(1)
+            comm.barrier()  # release the passive target
+            return got
+        # rank 1 (and others): computing, never touching the window
+        comm.barrier()
+        return np.copy(win.local)
+
+    res = run_local(prog, 3)
+    np.testing.assert_allclose(res[0], np.arange(4.0) + 1)
+    np.testing.assert_allclose(res[1], np.arange(4.0) + 1)  # target sees it
+    np.testing.assert_allclose(res[2], 0.0)
+
+
+def test_exclusive_lock_serializes_accumulates():
+    """N ranks × K lock/acc/unlock epochs on one target: the counter ends
+    exactly N*K — no lost updates under mutual exclusion."""
+    def prog(comm):
+        win = comm.win_create(np.zeros((), np.int64))
+        comm.barrier()
+        K = 10
+        for _ in range(K):
+            win.lock(0)
+            cur = win.get_at(0)
+            win.put_at(0, cur + 1)  # read-modify-write needs the lock
+            win.unlock(0)
+        comm.barrier()
+        return int(win.local)
+
+    res = run_local(prog, 4)
+    assert res[0] == 4 * 10, res
+
+
+def test_shared_locks_admit_concurrent_readers():
+    def prog(comm):
+        win = comm.win_create(np.full(2, comm.rank, np.float32))
+        comm.barrier()
+        target = (comm.rank + 1) % comm.size
+        win.lock(target, exclusive=False)
+        got = win.get_at(target)
+        win.unlock(target)
+        comm.barrier()
+        return got
+
+    res = run_local(prog, 4)
+    for r in range(4):
+        np.testing.assert_allclose(res[r], (r + 1) % 4)
+
+
+def test_self_lock_epoch():
+    def prog(comm):
+        win = comm.win_create(np.zeros(2, np.float32))
+        win.lock(comm.rank)
+        win.put_at(comm.rank, np.full(2, 7.0, np.float32))
+        got = win.get_at(comm.rank)
+        win.unlock(comm.rank)
+        win.free()
+        return got
+
+    res = run_local(prog, 2)
+    np.testing.assert_allclose(res[0], 7.0)
+
+
+def test_passive_and_fence_epochs_coexist_sequentially():
+    def prog(comm):
+        win = comm.win_create(np.zeros(2, np.float32))
+        # fence epoch first
+        win.accumulate(np.ones(2, np.float32), [(r, (r + 1) % comm.size)
+                                                for r in range(comm.size)])
+        win.fence()
+        comm.barrier()
+        # then a passive epoch
+        if comm.rank == 0:
+            win.lock(1)
+            win.accumulate_at(1, np.full(2, 10.0, np.float32))
+            win.unlock(1)
+        comm.barrier()
+        return np.copy(win.local)
+
+    res = run_local(prog, 3)
+    np.testing.assert_allclose(res[1], 11.0)
+    np.testing.assert_allclose(res[0], 1.0)
+
+
+def test_tpu_window_passive_diagnostic():
+    import jax.numpy as jnp
+
+    from mpi_tpu.tpu import TpuCommunicator, default_mesh
+
+    comm = TpuCommunicator("world", default_mesh())
+    win = comm.win_create(jnp.zeros(2))
+    with pytest.raises(NotImplementedError, match="fence epochs"):
+        win.lock(0)
+
+
+def test_passive_op_failure_surfaces_at_unlock_and_server_survives():
+    """A bad op (shape mismatch) must re-raise at the ORIGIN's unlock —
+    and the target's server must keep serving later epochs (code-review
+    regression: a dead server turned one bad put into a permanent hang)."""
+    def prog(comm):
+        win = comm.win_create(np.zeros(4, np.float32))
+        comm.barrier()
+        if comm.rank == 0:
+            win.lock(1)
+            win.put_at(1, np.ones(3, np.float32), loc=slice(0, 2))  # bad
+            try:
+                win.unlock(1)
+                failed = False
+            except RuntimeError as e:
+                failed = "failed at target" in str(e)
+            # the server must still serve a SECOND, clean epoch
+            win.lock(1)
+            win.put_at(1, np.full(4, 5.0, np.float32))
+            win.unlock(1)
+            comm.barrier()
+            return failed
+        comm.barrier()
+        return np.copy(win.local)
+
+    res = run_local(prog, 2)
+    assert res[0] is True
+    np.testing.assert_allclose(res[1], 5.0)
+
+
+def test_passive_get_failure_raises_at_origin():
+    def prog(comm):
+        win = comm.win_create(np.zeros(4, np.float32))
+        comm.barrier()
+        out = None
+        if comm.rank == 0:
+            win.lock(1, exclusive=False)
+            try:
+                win.get_at(1, loc=slice(0, 99, 0))  # zero step: bad loc
+            except RuntimeError as e:
+                out = "get failed" in str(e)
+            win.unlock(1)
+        comm.barrier()
+        return out
+
+    assert run_local(prog, 2)[0] is True
+
+
+def test_self_lock_queues_fairly_with_remote():
+    """Self-locks join the same FIFO queue as remote requesters: under
+    contention on rank 0's window, rank 0's own lock(0) completes."""
+    def prog(comm):
+        win = comm.win_create(np.zeros((), np.int64))
+        comm.barrier()
+        for _ in range(8):
+            win.lock(0)
+            win.put_at(0, win.get_at(0) + 1)
+            win.unlock(0)
+        comm.barrier()
+        return int(win.local)
+
+    res = run_local(prog, 4)  # rank 0 self-locks while 1-3 hammer it
+    assert res[0] == 4 * 8
