@@ -34,11 +34,43 @@ def launch(
     env_extra: Optional[dict] = None,
     timeout: Optional[float] = None,
     backend: Optional[str] = None,
+    restarts: int = 0,
 ) -> int:
     """Run ``python argv...`` as ``nranks`` rank processes; return exit code.
 
     ``backend`` picks the rank transport ('socket' or 'shm'); default is the
-    MPI_TPU_BACKEND env var, then 'socket'."""
+    MPI_TPU_BACKEND env var, then 'socket'.
+
+    ``restarts``: the elastic-recovery knob (SURVEY.md §5 failure story) —
+    after a nonzero exit or a hang (timeout), the WHOLE world is killed and
+    relaunched up to this many times.  Paired with crash-safe checkpoints
+    (mpi_tpu.checkpoint: generation-committed save/load), a rank program
+    that reloads its last checkpoint at startup resumes where the crashed
+    attempt left off — the same restart-from-checkpoint model a TPU slice
+    preemption needs.  MPI_TPU_ATTEMPT carries the attempt number to the
+    ranks."""
+    last = 0
+    for attempt in range(restarts + 1):
+        extra = dict(env_extra or {})
+        extra["MPI_TPU_ATTEMPT"] = str(attempt)
+        try:
+            last = _launch_once(nranks, argv, extra, timeout, backend)
+        except TimeoutError:
+            if attempt == restarts:
+                raise
+            continue
+        if last == 0:
+            return 0
+    return last
+
+
+def _launch_once(
+    nranks: int,
+    argv: Sequence[str],
+    env_extra: Optional[dict] = None,
+    timeout: Optional[float] = None,
+    backend: Optional[str] = None,
+) -> int:
     if nranks < 1:
         raise ValueError(f"nranks must be >= 1, got {nranks}")
     backend = backend or os.environ.get(ENV_BACKEND, "socket")
@@ -121,12 +153,16 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                         help="kill all ranks after this many seconds")
     parser.add_argument("--backend", choices=("socket", "shm"), default=None,
                         help="rank transport (default: MPI_TPU_BACKEND or socket)")
+    parser.add_argument("--restarts", type=int, default=0,
+                        help="relaunch the world up to N times after a "
+                             "crash/hang (resume from checkpoints)")
     parser.add_argument("script", help="python script to run on every rank")
     parser.add_argument("script_args", nargs=argparse.REMAINDER,
                         help="arguments passed to the script")
     args = parser.parse_args(argv)
     return launch(args.nranks, [args.script, *args.script_args],
-                  timeout=args.timeout, backend=args.backend)
+                  timeout=args.timeout, backend=args.backend,
+                  restarts=args.restarts)
 
 
 if __name__ == "__main__":

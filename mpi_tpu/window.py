@@ -257,12 +257,23 @@ class P2PWindow:
 
         if getattr(self, "_srv_thread", None) is not None:
             return
-        # isolated child context (deterministic: same _alloc_context
-        # sequence on every rank since win_create is collective); NO
-        # recv_timeout — the server idles between requests by design
+        # TWO isolated child contexts (deterministic: same _alloc_context
+        # sequence on every rank since win_create is collective):
+        # * _srv_comm — requests + lock grants.  NO recv_timeout: the
+        #   server idles between requests by design, and a lock wait is
+        #   unbounded by design (another rank may hold the lock
+        #   arbitrarily long — a timeout there would be a false failure).
+        # * _org_comm — unlock acks + get replies, BOUNDED work at a live
+        #   target.  Inherits the parent's recv_timeout so a crashed
+        #   target surfaces as RecvTimeout, not a hang (the framework's
+        #   failure-detection contract).
         ctx = self._comm._alloc_context()
+        ctx2 = self._comm._alloc_context()
         self._srv_comm = P2PCommunicator(self._comm._t, self._comm._group,
                                          ctx, recv_timeout=None)
+        self._org_comm = P2PCommunicator(self._comm._t, self._comm._group,
+                                         ctx2,
+                                         recv_timeout=self._comm.recv_timeout)
         self._srv_mutex = threading.Lock()   # buffer + lock-state guard
         self._lock_state: dict = {"holders": set(), "excl": None,
                                   "queue": []}
@@ -302,8 +313,8 @@ class P2PWindow:
                     with self._srv_mutex:
                         err = self._srv_errors.pop(src, None)
                         self._srv_release(src)
-                    c._send_internal(("unlocked", err), src,
-                                     _TAG_PASSIVE_REPLY)
+                    self._org_comm._send_internal(("unlocked", err), src,
+                                                  _TAG_PASSIVE_REPLY)
                 elif kind == "get":
                     try:
                         with self._srv_mutex:
@@ -311,7 +322,8 @@ class P2PWindow:
                         reply = ("ok", val)
                     except Exception as e:  # noqa: BLE001 - to origin
                         reply = ("err", f"{type(e).__name__}: {e}")
-                    c._send_internal(reply, src, _TAG_PASSIVE_REPLY)
+                    self._org_comm._send_internal(reply, src,
+                                                  _TAG_PASSIVE_REPLY)
                 else:  # "put" / "acc": no reply — errors surface at unlock
                     try:
                         _, data, loc, op = msg
@@ -400,7 +412,7 @@ class P2PWindow:
                                    f"{rank}: {err}")
             return
         self._srv_comm._send_internal(("unlock",), rank, _TAG_PASSIVE)
-        reply = self._srv_comm._recv_internal(rank, _TAG_PASSIVE_REPLY)
+        reply = self._org_comm._recv_internal(rank, _TAG_PASSIVE_REPLY)
         assert reply[0] == "unlocked"
         if reply[1]:
             raise RuntimeError(
@@ -412,7 +424,11 @@ class P2PWindow:
         self._check_open()
         if rank == self._comm.rank:
             with self._srv_mutex:
-                self._apply("put", np.asarray(data), loc, None)
+                try:
+                    self._apply("put", np.asarray(data), loc, None)
+                except Exception as e:  # noqa: BLE001 - surfaces at unlock
+                    self._srv_errors.setdefault(
+                        rank, f"{type(e).__name__}: {e}")
             return
         self._srv_comm._send_internal(("put", np.asarray(data), loc, None),
                                       rank, _TAG_PASSIVE)
@@ -422,7 +438,11 @@ class P2PWindow:
         self._check_open()
         if rank == self._comm.rank:
             with self._srv_mutex:
-                self._apply("acc", np.asarray(data), loc, op)
+                try:
+                    self._apply("acc", np.asarray(data), loc, op)
+                except Exception as e:  # noqa: BLE001 - surfaces at unlock
+                    self._srv_errors.setdefault(
+                        rank, f"{type(e).__name__}: {e}")
             return
         self._srv_comm._send_internal(("acc", np.asarray(data), loc, op),
                                       rank, _TAG_PASSIVE)
@@ -432,10 +452,14 @@ class P2PWindow:
         immediately (a strengthening of MPI's complete-at-unlock)."""
         self._check_open()
         if rank == self._comm.rank:
-            with self._srv_mutex:
-                return self._read(loc)
+            try:
+                with self._srv_mutex:
+                    return self._read(loc)
+            except Exception as e:  # noqa: BLE001 - same contract as remote
+                raise RuntimeError(f"passive RMA get failed at target "
+                                   f"{rank}: {type(e).__name__}: {e}")
         self._srv_comm._send_internal(("get", loc), rank, _TAG_PASSIVE)
-        tag, val = self._srv_comm._recv_internal(rank, _TAG_PASSIVE_REPLY)
+        tag, val = self._org_comm._recv_internal(rank, _TAG_PASSIVE_REPLY)
         if tag == "err":
             raise RuntimeError(f"passive RMA get failed at target "
                                f"{rank}: {val}")

@@ -120,3 +120,65 @@ def test_rank_crash_without_launcher_diagnosed(tmp_path, backend):
     assert "rank 0 diagnosed:" in out0, (
         f"stdout={out0[-500:]!r} stderr={err0[-800:]!r}")
     assert procs[0].returncode == 0, err0[-500:]
+
+
+RESTART_WORKER = textwrap.dedent("""
+    import os, sys
+    sys.path.insert(0, {repo!r})
+    import numpy as np
+    import mpi_tpu
+    from mpi_tpu import checkpoint
+
+    comm = mpi_tpu.init()
+    ckpt = os.path.join({ckpt!r}, "state")
+    state = (checkpoint.load(ckpt, comm) if checkpoint.exists(ckpt)
+             else {{"step": 0, "acc": np.zeros(4)}})
+    start = state["step"]
+    for step in range(start, 6):
+        state = {{"step": step + 1, "acc": state["acc"] + comm.rank + step}}
+        checkpoint.save(ckpt, state, comm)
+        if step == 2 and os.environ["MPI_TPU_ATTEMPT"] == "0" \\
+                and comm.rank == 1:
+            os._exit(41)  # simulated mid-run crash on the first attempt
+    total = comm.allreduce(float(state["acc"].sum()))
+    if comm.rank == 0:
+        with open(os.path.join({ckpt!r}, "result.txt"), "w") as f:
+            f.write(f"{{state['step']}} {{total}}")
+    mpi_tpu.finalize()
+""")
+
+
+@pytest.mark.parametrize("backend", ["socket", "shm"])
+def test_restart_resumes_from_checkpoint(tmp_path, backend):
+    """The complete failure story (SURVEY.md §5): a rank dies mid-run on
+    attempt 0; the launcher kills the world, relaunches, and the program
+    resumes from its last committed checkpoint — finishing with exactly
+    the state a crash-free run produces."""
+    from mpi_tpu.launcher import launch
+
+    script = tmp_path / "worker.py"
+    script.write_text(RESTART_WORKER.format(repo=REPO,
+                                            ckpt=str(tmp_path)))
+    rc = launch(2, [str(script)], timeout=120.0, backend=backend,
+                restarts=2)
+    assert rc == 0
+    step, total = (tmp_path / "result.txt").read_text().split()
+    assert step == "6"
+    # oracle: acc accumulates (rank + step) 4-wide for steps 0..5
+    expect = sum(4.0 * (r + s) for r in (0, 1) for s in range(6))
+    assert float(total) == expect
+
+
+def test_restarts_exhausted_propagates_failure(tmp_path):
+    from mpi_tpu.launcher import launch
+
+    script = tmp_path / "always_crash.py"
+    script.write_text(textwrap.dedent(f"""
+        import os, sys
+        sys.path.insert(0, {REPO!r})
+        import mpi_tpu
+        comm = mpi_tpu.init()
+        os._exit(43)
+    """))
+    rc = launch(2, [str(script)], timeout=60.0, restarts=1)
+    assert rc == 43

@@ -388,3 +388,50 @@ def test_self_lock_queues_fairly_with_remote():
 
     res = run_local(prog, 4)  # rank 0 self-locks while 1-3 hammer it
     assert res[0] == 4 * 8
+
+
+def test_self_target_errors_follow_remote_contract():
+    """Self-targeted op failures defer to unlock as RuntimeError — same
+    type, same call site as the remote path (code-review regression)."""
+    def prog(comm):
+        win = comm.win_create(np.zeros(4, np.float32))
+        win.lock(comm.rank)
+        win.put_at(comm.rank, np.ones(3, np.float32), loc=slice(0, 2))
+        try:
+            win.unlock(comm.rank)
+            return False
+        except RuntimeError as e:
+            return "failed at target" in str(e)
+
+    assert all(run_local(prog, 2))
+
+
+def test_passive_reply_waits_honor_recv_timeout():
+    """A crashed target surfaces as RecvTimeout at the origin's get/unlock
+    (the failure-detection contract), not a hang."""
+    from mpi_tpu.transport.base import RecvTimeout
+
+    def prog(comm):
+        comm.recv_timeout = 0.5
+        win = comm.win_create(np.zeros(2, np.float32))
+        if comm.rank == 0:
+            win.lock(1)
+            # rank 1 "crashes" (never services further): simulate by
+            # freeing its server — stop message kills the serve loop
+            comm.recv(source=1, tag=99)  # wait until rank 1's server died
+            try:
+                win.get_at(1)
+                return False
+            except (RecvTimeout, RuntimeError) as e:
+                return isinstance(e, RecvTimeout) or "timed out" in str(e)
+        else:
+            win._srv_comm._send_internal(("stop",), comm.rank, -8)
+            win._srv_thread.join(timeout=5)
+            comm.send(b"dead", dest=0, tag=99)
+            comm.barrier_dummy = None
+            import time
+            time.sleep(1.2)
+            return True
+
+    res = run_local(prog, 2)
+    assert res[0] is True
