@@ -51,6 +51,19 @@ from . import collectives as algos
 Pair = Tuple[int, int]
 
 
+def _pallas_op_name(op: _ops.ReduceOp) -> str:
+    """The pallas kernel's combiner key for ``op`` — gated by object
+    IDENTITY against the built-ins, so a user ``make_op`` that happens to
+    reuse the name 'max' can never be silently swapped for jnp.maximum."""
+    for builtin in (_ops.SUM, _ops.MAX, _ops.MIN):
+        if op is builtin:
+            return op.name
+    raise NotImplementedError(
+        f"pallas_ring supports the built-in SUM/MAX/MIN ops, got {op!r}; "
+        f"use a ppermute algorithm ('ring'/'recursive_halving') for other "
+        f"reductions")
+
+
 class SpmdSemanticsError(NotImplementedError):
     """An MPI idiom with no SPMD analogue was used on the TPU backend."""
 
@@ -385,14 +398,13 @@ class TpuCommunicator(Communicator):
                                         self._world_pairs, op)
         if algorithm == "pallas_ring":
             # in-kernel pipelined RDMA ring (mpi_tpu/tpu/pallas_ring.py):
-            # f32/bf16 SUM; split comms run one independent ring per group
-            if op.name != "sum":
-                raise NotImplementedError("pallas_ring supports SUM only for now")
+            # f32/bf16 sum/max/min; split comms run one ring per group
             from .pallas_ring import pallas_ring_allreduce
 
             return pallas_ring_allreduce(x, self.axis_name, self.size,
                                          interpret=self._on_cpu,
-                                         groups=self._groups)
+                                         groups=self._groups,
+                                         op=_pallas_op_name(op))
         if algorithm == "recursive_halving":
             return algos.halving_allreduce(x, self.axis_name, self.size, self.rank,
                                            self._world_pairs, op)
@@ -545,13 +557,12 @@ class TpuCommunicator(Communicator):
         if algorithm == "pallas_ring":
             # in-kernel RDMA ring, reduce-scatter half only (the ZeRO
             # gradient-sharding primitive at half the allreduce traffic)
-            if op.name != "sum":
-                raise NotImplementedError("pallas_ring supports SUM only for now")
             from .pallas_ring import pallas_ring_reduce_scatter
 
             return pallas_ring_reduce_scatter(x, self.axis_name, self.size,
                                               interpret=self._on_cpu,
-                                              groups=self._groups)
+                                              groups=self._groups,
+                                              op=_pallas_op_name(op))
         raise ValueError(f"unknown reduce_scatter algorithm {algorithm!r}")
 
     def scatter(self, objs, root: int = 0):

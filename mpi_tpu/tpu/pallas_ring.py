@@ -59,7 +59,7 @@ on fewer than two chips:
 5. payload correctness under every explored ordering (contribution-set
    semantics, both collectives).
 
-Supported: float32 AND bfloat16, SUM, the full axis OR a split
+Supported: float32 AND bfloat16; SUM, MAX, MIN; the full axis OR a split
 communicator's groups (one independent ring per group, same kernel).
 Diagnosed restrictions: other dtypes/ops.
 """
@@ -121,7 +121,7 @@ def _kernel(params_smem, x_hbm, out_hbm, comm_hbm, a_vmem, b_vmem,
             copy_sem_a, copy_sem_b, send_sem, recv_sem, credit_sem, *,
             axis_name: str, size: int, rows: int, tile_rows: int,
             flows: List[Flow], rot: int, allgather: bool,
-            pipelined: bool):
+            pipelined: bool, combine=None):
     """``rot`` shifts the chunk schedule: 0 → the ring ends with rank r
     owning chunk (r+1)%P (allreduce layout); -1 → rank r owns chunk r
     (reduce_scatter layout).  ``allgather=False`` stops after the
@@ -241,7 +241,8 @@ def _kernel(params_smem, x_hbm, out_hbm, comm_hbm, a_vmem, b_vmem,
                     cp_b.start()
                     cp_a.wait()
                     cp_b.wait()
-                    a_vmem[:] = a_vmem[:] + b_vmem[:]
+                    a_vmem[:] = (a_vmem[:] + b_vmem[:] if combine is None
+                                 else combine(a_vmem[:], b_vmem[:]))
                     cp_out = pltpu.make_async_copy(
                         a_vmem, out_hbm.at[pl.ds(row0, tile_rows)],
                         copy_sem_a)
@@ -280,6 +281,16 @@ def _geometry(n: int, size: int, tile_rows: int) -> Tuple[int, int]:
     return rows, size * rows * _LANES
 
 
+# Elementwise combiners: positions only ever combine with the SAME
+# position of other ranks' chunks, so the zero padding of _geometry can
+# never contaminate a real lane — any identity works for the pad.
+_COMBINES = {
+    "sum": None,  # None → the kernel's inlined add (the common path)
+    "max": lambda a, b: jnp.maximum(a, b),
+    "min": lambda a, b: jnp.minimum(a, b),
+}
+
+
 def _check_args(x: jnp.ndarray, axis_name: str, size: int, tile_rows: int,
                 op: str) -> bool:
     """Validate dtype/op/tiling; returns whether varying-axes (vma) typing
@@ -288,9 +299,9 @@ def _check_args(x: jnp.ndarray, axis_name: str, size: int, tile_rows: int,
     if dtype not in _SUBLANES:
         raise NotImplementedError(
             f"pallas_ring supports float32/bfloat16 for now, got {x.dtype}")
-    if op != "sum":
+    if op not in _COMBINES:
         raise NotImplementedError(
-            f"pallas_ring supports SUM for now, got {op!r}")
+            f"pallas_ring supports {sorted(_COMBINES)} for now, got {op!r}")
     sub = _SUBLANES[dtype]
     if tile_rows % sub or tile_rows < sub:
         raise ValueError(
@@ -364,7 +375,8 @@ def _ring_params(axis_name: str, size: int, groups) -> jnp.ndarray:
 def _launch(x: jnp.ndarray, axis_name: str, size: int, tile_rows: int,
             interpret: bool, rot: int, allgather: bool,
             collective_id: int, bidirectional: bool = True,
-            vma_on: bool = False, groups=None) -> jnp.ndarray:
+            vma_on: bool = False, groups=None,
+            op: str = "sum") -> jnp.ndarray:
     """Shared pallas_call setup for both ring collectives; returns the
     padded [size*rows, _LANES] result grid.
 
@@ -388,7 +400,7 @@ def _launch(x: jnp.ndarray, axis_name: str, size: int, tile_rows: int,
     kern = functools.partial(
         _kernel, axis_name=axis_name, size=size, rows=rows,
         tile_rows=tile_rows, flows=flows, rot=rot, allgather=allgather,
-        pipelined=not interpret)
+        pipelined=not interpret, combine=_COMBINES[op])
     compiler_params = None if interpret else pltpu.CompilerParams(
         collective_id=collective_id, has_side_effects=True)
     k = len(flows)
@@ -439,10 +451,11 @@ def pallas_ring_allreduce(x: jnp.ndarray, axis_name: str, size: int,
                           tile_rows: int = 256,
                           interpret: bool = False,
                           bidirectional: bool = True,
-                          groups=None) -> jnp.ndarray:
-    """SUM-allreduce ``x`` (f32/bf16) over ``axis_name`` with the in-kernel
-    pipelined RDMA ring — bidirectional (counter-rotating) by default.
-    Call inside shard_map over a mesh with that axis.
+                          groups=None, op: str = "sum") -> jnp.ndarray:
+    """Allreduce ``x`` (f32/bf16; ``op`` in 'sum'/'max'/'min') over
+    ``axis_name`` with the in-kernel pipelined RDMA ring — bidirectional
+    (counter-rotating) by default.  Call inside shard_map over a mesh
+    with that axis.
 
     Works under ``check_vma=True``: compiled, the kernel declares its
     result varying over the axis (brand it with ``comm.replicate`` if a
@@ -456,20 +469,23 @@ def pallas_ring_allreduce(x: jnp.ndarray, axis_name: str, size: int,
     ``groups``: optional equal-sized partition of the axis (a split
     communicator's axis_index_groups); each group runs its own
     independent ring — ``size`` is then the GROUP size."""
-    vma_on = _check_args(x, axis_name, size, tile_rows, "sum")
+    vma_on = _check_args(x, axis_name, size, tile_rows, op)
     if size == 1:
         return x
     if vma_on and interpret:
+        from ..ops import BY_NAME
         from . import collectives as algos
 
         grank = _ring_params(axis_name, size, groups)[0]
         return algos.ring_allreduce(x, axis_name, size, grank,
-                                    _world_pairs_of(size, groups))
+                                    _world_pairs_of(size, groups),
+                                    op=BY_NAME[op])
     shape = x.shape
     n = int(np.prod(shape)) if shape else 1
     out = _launch(x, axis_name, size, tile_rows, interpret,
                   rot=0, allgather=True, collective_id=13,
-                  bidirectional=bidirectional, vma_on=vma_on, groups=groups)
+                  bidirectional=bidirectional, vma_on=vma_on, groups=groups,
+                  op=op)
     return out.reshape(-1)[:n].reshape(shape)
 
 
@@ -477,8 +493,9 @@ def pallas_ring_reduce_scatter(x: jnp.ndarray, axis_name: str, size: int,
                                tile_rows: int = 256,
                                interpret: bool = False,
                                bidirectional: bool = True,
-                               groups=None) -> jnp.ndarray:
-    """SUM-reduce_scatter_block (the ZeRO primitive): ``x`` is the full
+                               groups=None, op: str = "sum") -> jnp.ndarray:
+    """Reduce-scatter-block (the ZeRO primitive; ``op`` in
+    'sum'/'max'/'min'): ``x`` is the full
     [P*block, ...] stack on every rank; rank r returns block r reduced
     over all ranks.  Runs ONLY the reduce-scatter half of the ring —
     half the wire traffic of the allreduce.
@@ -491,15 +508,17 @@ def pallas_ring_reduce_scatter(x: jnp.ndarray, axis_name: str, size: int,
         raise ValueError(
             f"reduce_scatter needs leading dimension == ring size {size} "
             f"(one block per rank), got shape {x.shape}")
-    vma_on = _check_args(x, axis_name, size, tile_rows, "sum")
+    vma_on = _check_args(x, axis_name, size, tile_rows, op)
     if size == 1:
         return x[0]
     if vma_on and interpret:
+        from ..ops import BY_NAME
         from . import collectives as algos
 
         grank = _ring_params(axis_name, size, groups)[0]
         return algos.ring_reduce_scatter(x, axis_name, size, grank,
-                                         _world_pairs_of(size, groups))
+                                         _world_pairs_of(size, groups),
+                                         op=BY_NAME[op])
     block_shape = x.shape[1:]
     block_n = int(np.prod(block_shape))
     rows, _ = _geometry(block_n * size, size, tile_rows)
@@ -513,7 +532,8 @@ def pallas_ring_reduce_scatter(x: jnp.ndarray, axis_name: str, size: int,
     grid = blocks.reshape(-1)
     out = _launch(grid, axis_name, size, tile_rows, interpret,
                   rot=-1, allgather=False, collective_id=14,
-                  bidirectional=bidirectional, vma_on=vma_on, groups=groups)
+                  bidirectional=bidirectional, vma_on=vma_on, groups=groups,
+                  op=op)
     grank = _ring_params(axis_name, size, groups)[0]
     mine = lax.dynamic_slice(out.reshape(size, per_chunk), (grank, 0),
                              (1, per_chunk))

@@ -82,10 +82,30 @@ def test_pallas_ring_diagnostics():
     comm = TpuCommunicator("world", mesh)
     from mpi_tpu import ops
 
-    with pytest.raises(NotImplementedError, match="SUM"):
-        comm.allreduce(jnp.zeros(8), op=ops.MAX, algorithm="pallas_ring")
+    with pytest.raises(NotImplementedError, match="built-in"):
+        comm.allreduce(jnp.zeros(8), op=ops.PROD, algorithm="pallas_ring")
     with pytest.raises(NotImplementedError, match="float32"):
         pallas_ring_allreduce(jnp.zeros(8, jnp.int32), "world", 8)
+
+
+@pytest.mark.parametrize("opname,npop", [("max", np.max), ("min", np.min)])
+@pytest.mark.parametrize("check_vma", [False, True])
+def test_pallas_ring_max_min(opname, npop, check_vma):
+    """MAX/MIN ride the same kernel with a swapped combiner (positions
+    only combine with the same position, so zero padding can't leak)."""
+    from mpi_tpu import ops
+    from mpi_tpu.tpu import run_spmd
+
+    data = np.asarray(np.random.RandomState(21).randn(8, 130), np.float32)
+    op = getattr(ops, opname.upper())
+
+    def prog(comm, x):
+        return comm.allreduce(x[comm.rank], op=op, algorithm="pallas_ring")
+
+    out = np.asarray(run_spmd(prog, data, check_vma=check_vma))
+    expect = npop(data, axis=0)
+    for r in range(8):
+        np.testing.assert_allclose(out[r], expect, rtol=1e-6, atol=1e-6)
 
 
 @pytest.mark.parametrize("check_vma", [False, True])
@@ -226,3 +246,38 @@ def test_pallas_ring_rejects_multi_axis_mesh():
         jax.jit(jax.shard_map(f, mesh=mesh, in_specs=P("dp", "mp"),
                               out_specs=P("dp", "mp")))(
             jnp.zeros((8, 512), jnp.float32))
+
+
+@pytest.mark.parametrize("opname,npop", [("max", np.max), ("min", np.min)])
+@pytest.mark.parametrize("check_vma", [False, True])
+def test_pallas_reduce_scatter_max_min(opname, npop, check_vma):
+    from mpi_tpu import ops
+    from mpi_tpu.tpu import run_spmd
+
+    P_, block = 4, 96
+    data = np.asarray(np.random.RandomState(22).randn(P_, P_, block),
+                      np.float32)
+    op = getattr(ops, opname.upper())
+
+    def prog(comm, x):
+        return comm.reduce_scatter(x[comm.rank], op=op,
+                                   algorithm="pallas_ring")
+
+    out = np.asarray(run_spmd(prog, data, nranks=P_, check_vma=check_vma))
+    np.testing.assert_allclose(out, npop(data, axis=0), rtol=1e-6, atol=1e-6)
+
+
+def test_pallas_ring_rejects_user_op_with_builtin_name():
+    """A make_op combiner named 'max' must NOT silently run jnp.maximum
+    (code-review regression: identity gate, not name gate)."""
+    from mpi_tpu import ops
+    from mpi_tpu.tpu import run_spmd
+
+    fake_max = ops.make_op(lambda a, b: a + b, name="max", identity=0.0)
+
+    def prog(comm, x):
+        return comm.allreduce(x[comm.rank], op=fake_max,
+                              algorithm="pallas_ring")
+
+    with pytest.raises(NotImplementedError, match="built-in"):
+        run_spmd(prog, np.zeros((8, 16), np.float32))

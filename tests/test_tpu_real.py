@@ -179,3 +179,13 @@ def test_pallas_ring8_grouped_lowers_pipelined():
                 v.reshape(-1), "world", 4, tile_rows=64, groups=groups),
             jax.ShapeDtypeStruct((8, 64 * 128), jnp.float32),
             check_vma=False)
+
+
+def test_pallas_ring8_max_lowers_pipelined():
+    """The swapped-combiner (MAX) pipelined kernel lowers through Mosaic."""
+    from mpi_tpu.tpu.pallas_ring import pallas_ring_allreduce
+
+    _lower8(lambda c, v: pallas_ring_allreduce(
+                v.reshape(-1), "world", 8, tile_rows=64, op="max"),
+            jax.ShapeDtypeStruct((8, 64 * 128), jnp.float32),
+            check_vma=False)

@@ -415,22 +415,22 @@ def test_passive_reply_waits_honor_recv_timeout():
         comm.recv_timeout = 0.5
         win = comm.win_create(np.zeros(2, np.float32))
         if comm.rank == 0:
-            win.lock(1)
-            # rank 1 "crashes" (never services further): simulate by
-            # freeing its server — stop message kills the serve loop
-            comm.recv(source=1, tag=99)  # wait until rank 1's server died
+            win.lock(1)               # grant while the server is alive
+            comm.send(b"locked", dest=1, tag=98)
+            comm.recv(source=1, tag=99)  # rank 1's server is now dead
             try:
                 win.get_at(1)
                 return False
             except (RecvTimeout, RuntimeError) as e:
                 return isinstance(e, RecvTimeout) or "timed out" in str(e)
         else:
+            comm.recv(source=0, tag=98)  # rank 0 holds the lock
+            # rank 1 "crashes": stop its server so nothing replies
             win._srv_comm._send_internal(("stop",), comm.rank, -8)
             win._srv_thread.join(timeout=5)
             comm.send(b"dead", dest=0, tag=99)
-            comm.barrier_dummy = None
             import time
-            time.sleep(1.2)
+            time.sleep(1.2)  # stay alive while rank 0 times out
             return True
 
     res = run_local(prog, 2)
