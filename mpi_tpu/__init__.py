@@ -33,7 +33,7 @@ from .ops import SUM, PROD, MAX, MIN, LAND, LOR, LXOR, BAND, BOR, BXOR, ReduceOp
 from .communicator import Communicator, P2PCommunicator, Request, Status
 from .transport.base import ANY_SOURCE, ANY_TAG
 from .transport.local import run_local
-from . import schedules, checker, checkpoint, profiling, trace
+from . import datatypes, schedules, checker, checkpoint, profiling, trace
 from .intercomm import InterComm, create_intercomm
 from .topology import (CartComm, GraphComm, cart_create,
                        dims_create, dist_graph_create_adjacent,

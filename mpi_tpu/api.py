@@ -16,7 +16,7 @@ from __future__ import annotations
 
 from typing import Any, Optional, Sequence
 
-from . import ops
+from . import datatypes, ops
 from .communicator import Communicator, Status
 from .group import Group
 from .transport.base import ANY_SOURCE, ANY_TAG
@@ -49,6 +49,11 @@ __all__ = [
     "MPI_Group_incl", "MPI_Group_excl", "MPI_Group_union",
     "MPI_Group_intersection", "MPI_Group_difference", "MPI_Group_size",
     "MPI_Group_rank", "MPI_Group_translate_ranks", "Group",
+    "MPI_Type_contiguous", "MPI_Type_vector", "MPI_Type_indexed",
+    "MPI_Type_create_subarray", "MPI_Type_create_struct",
+    "MPI_Type_create_resized", "MPI_Type_commit", "MPI_Type_free",
+    "MPI_Type_size", "MPI_Type_get_extent",
+    "MPI_Pack", "MPI_Unpack", "MPI_Pack_size", "Datatype",
     "ANY_SOURCE", "ANY_TAG", "SUM", "PROD", "MAX", "MIN",
     "LAND", "LOR", "LXOR", "BAND", "BOR", "BXOR", "Status",
 ]
@@ -97,14 +102,32 @@ def MPI_Comm_size(comm: Optional[Communicator] = None) -> int:
     return _world(comm).size
 
 
-def MPI_Send(obj: Any, dest: int, tag: int = 0, comm: Optional[Communicator] = None) -> None:
+def MPI_Send(obj: Any, dest: int, tag: int = 0, comm: Optional[Communicator] = None,
+             datatype: Optional[datatypes.Datatype] = None, count: int = 1) -> None:
+    """With ``datatype=``, ``obj`` is the typed base buffer and the wire
+    payload is ``datatype.pack(obj, count)`` — the MPI typed-send spelling
+    (strided columns, halo faces, structs; mpi_tpu/datatypes.py)."""
+    if datatype is not None:
+        obj = datatype.pack(obj, count)
     _world(comm).send(obj, dest, tag)
 
 
 def MPI_Recv(source: int = ANY_SOURCE, tag: int = ANY_TAG,
              comm: Optional[Communicator] = None,
-             status: Optional[Status] = None) -> Any:
-    return _world(comm).recv(source, tag, status)
+             status: Optional[Status] = None,
+             datatype: Optional[datatypes.Datatype] = None,
+             buf: Optional[Any] = None, count: int = 1) -> Any:
+    """With ``datatype=`` and ``buf=``, the received contiguous payload is
+    scattered into ``buf`` in-place (the typed-recv spelling); ``buf`` is
+    returned."""
+    if (buf is None) != (datatype is None):
+        raise ValueError("typed MPI_Recv needs BOTH datatype= and buf= "
+                         "(one without the other would silently drop the "
+                         "layout or leave buf unfilled)")
+    obj = _world(comm).recv(source, tag, status)
+    if datatype is not None:
+        return datatype.unpack(obj, buf, count)
+    return obj
 
 
 def MPI_Sendrecv(sendobj: Any, dest: int, source: int = ANY_SOURCE,
@@ -593,3 +616,35 @@ def MPI_Sendrecv_replace(obj: Any, dest: int, source: int = ANY_SOURCE,
     """MPI_Sendrecv_replace [S]: same buffer for send and receive — in this
     library's value semantics, simply returns the received payload."""
     return _world(comm).sendrecv(obj, dest, source, sendtag, recvtag)
+
+
+# -- derived datatypes (MPI-1 ch.3; mpi_tpu/datatypes.py) -------------------
+
+MPI_Type_contiguous = datatypes.type_contiguous
+MPI_Type_vector = datatypes.type_vector
+MPI_Type_indexed = datatypes.type_indexed
+MPI_Type_create_subarray = datatypes.type_create_subarray
+MPI_Type_create_struct = datatypes.type_create_struct
+MPI_Type_create_resized = datatypes.type_create_resized
+MPI_Pack = datatypes.pack
+MPI_Unpack = datatypes.unpack
+MPI_Pack_size = datatypes.pack_size
+Datatype = datatypes.Datatype
+
+
+def MPI_Type_commit(datatype: datatypes.Datatype) -> datatypes.Datatype:
+    return datatype.commit()
+
+
+def MPI_Type_free(datatype: datatypes.Datatype) -> None:
+    datatype.free()
+
+
+def MPI_Type_size(datatype: datatypes.Datatype) -> int:
+    return datatype.size
+
+
+def MPI_Type_get_extent(datatype: datatypes.Datatype):
+    """(lower bound, extent) in bytes — lb is folded into the index map,
+    so it reports 0 (resized types shift the map instead)."""
+    return (0, datatype.extent_bytes)

@@ -1,0 +1,323 @@
+"""Derived datatypes — MPI-1 chapter-3 layout descriptors [S].
+
+The reference is MPI-1-level (BASELINE.json:5; SURVEY.md §0.1), and MPI-1's
+type-constructor family (contiguous / vector / indexed / struct, plus
+MPI_Pack/Unpack) is how real MPI programs describe non-contiguous payloads:
+matrix columns, sub-blocks, halo faces.  A C MPI implements them as strided
+memcpy loops executed at send time.  The TPU-native translation is different
+and better suited to XLA: a committed datatype compiles ONCE into a flat
+*gather index vector* over the base-typed buffer, and then
+
+* ``pack``   = ``buf.flat[idx]``            (numpy take / one fusable
+* ``unpack`` = ``out.flat[idx] = data``      lax.gather-scatter on device)
+
+so the same index map drives the process backends (numpy) and jit-traced
+SPMD code (``pack_jax`` / ``unpack_jax`` — the indices are static trace-time
+constants, exactly what XLA wants: no dynamic shapes, no per-element loops).
+
+Units and composition follow MPI semantics: displacements/strides in the
+element constructors are in units of the *base type's extent*; heterogeneous
+``type_create_struct`` drops to a byte-based map (base dtype uint8, byte
+displacements), which is also what lets numpy structured dtypes interoperate.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Sequence, Union
+
+import numpy as np
+
+__all__ = [
+    "Datatype", "type_contiguous", "type_vector", "type_indexed",
+    "type_create_subarray", "type_create_struct", "type_create_resized",
+    "from_structured", "pack", "unpack", "pack_size",
+]
+
+BaseLike = Union[str, type, np.dtype, "Datatype"]
+
+
+def _as_base(base: BaseLike) -> "Datatype":
+    if isinstance(base, Datatype):
+        return base
+    dt = np.dtype(base)
+    if dt.names:  # structured dtype: byte-based map over its fields
+        return from_structured(dt)
+    return Datatype(dt, np.arange(1, dtype=np.int64), 1)
+
+
+class Datatype:
+    """A committed layout: ``indices`` are element offsets (units of
+    ``base_dtype``) selected by one instance; ``extent`` is the span one
+    instance occupies when instances are replicated (``count > 1`` or an
+    outer constructor), mirroring MPI extent semantics [S]."""
+
+    __slots__ = ("base_dtype", "indices", "extent", "_committed")
+
+    def __init__(self, base_dtype: np.dtype, indices: np.ndarray, extent: int):
+        self.base_dtype = np.dtype(base_dtype)
+        self.indices = np.asarray(indices, dtype=np.int64).reshape(-1)
+        self.extent = int(extent)
+        self._committed = False
+
+    # -- introspection (MPI_Type_size / MPI_Type_get_extent) ---------------
+
+    @property
+    def size(self) -> int:
+        """Bytes of actual data one instance transfers (MPI_Type_size)."""
+        return int(self.indices.size * self.base_dtype.itemsize)
+
+    @property
+    def count(self) -> int:
+        """Base elements one instance transfers."""
+        return int(self.indices.size)
+
+    @property
+    def extent_bytes(self) -> int:
+        return self.extent * self.base_dtype.itemsize
+
+    def commit(self) -> "Datatype":
+        """MPI_Type_commit: validate the map (duplicate offsets would make
+        unpack order-dependent; negatives would alias from the end)."""
+        if self.indices.size and int(self.indices.min()) < 0:
+            raise ValueError("datatype has negative element displacements")
+        if np.unique(self.indices).size != self.indices.size:
+            raise ValueError("datatype maps the same element twice "
+                             "(overlapping blocks) — unpack would be "
+                             "order-dependent")
+        self._committed = True
+        return self
+
+    def free(self) -> None:
+        """MPI_Type_free (bookkeeping only — no resources to release)."""
+        self._committed = False
+
+    def __repr__(self) -> str:  # pragma: no cover - cosmetic
+        return (f"Datatype(base={self.base_dtype}, count={self.count}, "
+                f"extent={self.extent})")
+
+    # -- replication helper ------------------------------------------------
+
+    def _tiled(self, count: int) -> np.ndarray:
+        if count == 1:
+            return self.indices
+        offs = np.arange(count, dtype=np.int64) * self.extent
+        return (self.indices[None, :] + offs[:, None]).reshape(-1)
+
+    def _flat_view(self, buf: Any, writeback: bool = False) -> np.ndarray:
+        if writeback and not isinstance(buf, np.ndarray):
+            raise TypeError(f"unpack target must be an ndarray, got "
+                            f"{type(buf).__name__}")
+        a = np.asarray(buf)
+        if writeback and not a.flags["C_CONTIGUOUS"]:
+            # ascontiguousarray would copy and the scatter would land in
+            # the copy — a silent no-op on the caller's buffer
+            raise TypeError("unpack target must be C-contiguous (got a "
+                            "strided view; unpack into the owning array "
+                            "and describe the view with the datatype)")
+        a = np.ascontiguousarray(a)
+        if self.base_dtype == np.uint8 and a.dtype != np.uint8:
+            a = a.view(np.uint8)
+        elif a.dtype != self.base_dtype:
+            raise TypeError(f"buffer dtype {a.dtype} != datatype base "
+                            f"{self.base_dtype}")
+        return a.reshape(-1)
+
+    def _checked_indices(self, count: int, limit: int) -> np.ndarray:
+        idx = self._tiled(count)
+        if idx.size and int(idx.min()) < 0:
+            raise ValueError("datatype has negative element displacements")
+        if idx.size and int(idx.max()) >= limit:
+            raise ValueError(f"datatype touches element {int(idx.max())} but "
+                             f"buffer has {limit}")
+        return idx
+
+    # -- host (numpy) path -------------------------------------------------
+
+    def pack(self, buf: Any, count: int = 1) -> np.ndarray:
+        """Gather ``count`` instances from ``buf`` into a contiguous array."""
+        flat = self._flat_view(buf)
+        idx = self._checked_indices(count, flat.size)
+        return flat[idx].copy()
+
+    def unpack(self, packed: Any, out: np.ndarray, count: int = 1) -> np.ndarray:
+        """Scatter a contiguous ``packed`` array into ``out`` in-place."""
+        flat = self._flat_view(out, writeback=True)
+        idx = self._checked_indices(count, flat.size)
+        data = np.asarray(packed).reshape(-1)
+        if data.dtype != self.base_dtype:
+            raise TypeError(f"packed payload dtype {data.dtype} != datatype "
+                            f"base {self.base_dtype}")
+        if data.size != idx.size:
+            raise ValueError(f"packed payload has {data.size} elements, "
+                             f"datatype expects {idx.size}")
+        flat[idx] = data
+        return out
+
+    # -- device (jit-traceable) path ---------------------------------------
+
+    def pack_jax(self, x: Any, count: int = 1):
+        """Same gather under jit: indices are trace-time constants, so this
+        lowers to one static lax.gather XLA can fuse."""
+        import jax.numpy as jnp
+
+        x = jnp.asarray(x)
+        idx = self._checked_indices(count, x.size)  # static: checked at trace
+        return jnp.take(x.reshape(-1), idx, axis=0)
+
+    def unpack_jax(self, packed: Any, out: Any, count: int = 1):
+        """Functional scatter: returns ``out`` with the instances placed."""
+        import jax.numpy as jnp
+
+        o = jnp.asarray(out)
+        idx = self._checked_indices(count, o.size)  # static: checked at trace
+        flat = o.reshape(-1).at[idx].set(jnp.asarray(packed).reshape(-1))
+        return flat.reshape(o.shape)
+
+
+# -- constructors (MPI_Type_*) ---------------------------------------------
+
+
+def type_contiguous(count: int, base: BaseLike) -> Datatype:
+    """MPI_Type_contiguous: ``count`` back-to-back instances of ``base``."""
+    b = _as_base(base)
+    return Datatype(b.base_dtype, b._tiled(int(count)), int(count) * b.extent)
+
+
+def type_vector(count: int, blocklength: int, stride: int,
+                base: BaseLike) -> Datatype:
+    """MPI_Type_vector: ``count`` blocks of ``blocklength`` instances,
+    block starts ``stride`` base-extents apart (a strided matrix column:
+    ``type_vector(nrows, 1, ncols, float64)``)."""
+    b = _as_base(base)
+    count, blocklength, stride = int(count), int(blocklength), int(stride)
+    starts = np.arange(count, dtype=np.int64) * stride * b.extent
+    block = b._tiled(blocklength)
+    idx = (starts[:, None] + block[None, :]).reshape(-1)
+    extent = ((count - 1) * stride + blocklength) * b.extent if count else 0
+    return Datatype(b.base_dtype, idx, extent)
+
+
+def type_indexed(blocklengths: Sequence[int], displacements: Sequence[int],
+                 base: BaseLike) -> Datatype:
+    """MPI_Type_indexed: irregular blocks at arbitrary displacements
+    (units of the base extent)."""
+    b = _as_base(base)
+    if len(blocklengths) != len(displacements):
+        raise ValueError("blocklengths and displacements differ in length")
+    parts = []
+    span = 0
+    for n, d in zip(blocklengths, displacements):
+        n, d = int(n), int(d)
+        parts.append(d * b.extent + b._tiled(n))
+        span = max(span, (d + n) * b.extent)
+    idx = np.concatenate(parts) if parts else np.empty(0, np.int64)
+    return Datatype(b.base_dtype, idx, span)
+
+
+def type_create_subarray(sizes: Sequence[int], subsizes: Sequence[int],
+                         starts: Sequence[int], base: BaseLike) -> Datatype:
+    """MPI_Type_create_subarray (C order): the n-D sub-block
+    ``[start : start+subsize]`` per dim of an n-D array — THE datatype for
+    halo faces and tiled I/O.  Extent spans the whole array, so ``count``
+    instances mean consecutive whole arrays (matching MPI)."""
+    b = _as_base(base)
+    sizes = [int(s) for s in sizes]
+    subsizes = [int(s) for s in subsizes]
+    starts = [int(s) for s in starts]
+    if not (len(sizes) == len(subsizes) == len(starts)):
+        raise ValueError("sizes/subsizes/starts rank mismatch")
+    for s, sub, st in zip(sizes, subsizes, starts):
+        if st < 0 or sub < 0 or st + sub > s:
+            raise ValueError(f"subarray [{st}:{st + sub}] out of bounds "
+                             f"for size {s}")
+    # element offsets of the sub-block in the row-major full array
+    grid = np.ix_(*[np.arange(st, st + sub) for st, sub in zip(starts, subsizes)])
+    flat_idx = np.ravel_multi_index(np.broadcast_arrays(*grid), sizes)
+    idx = np.asarray(flat_idx, dtype=np.int64).reshape(-1)
+    n_elems = int(np.prod(sizes)) if sizes else 1
+    # compose with a non-trivial base by expanding each element slot
+    if b.count != 1 or b.extent != 1:
+        idx = (idx[:, None] * b.extent + b.indices[None, :]).reshape(-1)
+        n_elems *= b.extent
+    return Datatype(b.base_dtype, idx, n_elems)
+
+
+def type_create_struct(blocklengths: Sequence[int],
+                       displacements: Sequence[int],
+                       types: Sequence[BaseLike]) -> Datatype:
+    """MPI_Type_create_struct: heterogeneous blocks at *byte* displacements.
+    Compiles to a byte-based map (base uint8) — the contiguous packed form
+    is raw bytes, interoperable with numpy structured dtypes."""
+    if not (len(blocklengths) == len(displacements) == len(types)):
+        raise ValueError("struct constructor argument lengths differ")
+    parts = []
+    span = 0
+    for n, d, t in zip(blocklengths, displacements, types):
+        b = _as_base(t)
+        n, d = int(n), int(d)
+        item = b._tiled(n) * b.base_dtype.itemsize  # element→byte offsets
+        byte_idx = (item[:, None]
+                    + np.arange(b.base_dtype.itemsize, dtype=np.int64)[None, :]
+                    ).reshape(-1) + d
+        parts.append(byte_idx)
+        span = max(span, d + n * b.extent_bytes)
+    idx = np.concatenate(parts) if parts else np.empty(0, np.int64)
+    return Datatype(np.dtype(np.uint8), idx, span)
+
+
+def type_create_resized(base: BaseLike, lb: int, extent: int) -> Datatype:
+    """MPI_Type_create_resized: same map, adjusted extent (units of the base
+    dtype; ``lb`` shifts the map, matching a lower-bound marker)."""
+    b = _as_base(base)
+    return Datatype(b.base_dtype, b.indices + int(lb), int(extent))
+
+
+def from_structured(dtype: Any) -> Datatype:
+    """A numpy structured dtype as a (byte-based) MPI struct — including
+    its padding holes, which are skipped exactly like MPI_UB gaps."""
+    dt = np.dtype(dtype)
+    if not dt.names:
+        raise ValueError(f"{dt} is not a structured dtype")
+    lens, disps, types = [], [], []
+    for name in dt.names:
+        fdt, off = dt.fields[name][0], dt.fields[name][1]
+        if fdt.subdtype is not None:
+            sub, shape = fdt.subdtype
+            lens.append(int(np.prod(shape)))
+            types.append(sub)
+        else:
+            lens.append(1)
+            types.append(fdt)
+        disps.append(off)
+    out = type_create_struct(lens, disps, types)
+    return Datatype(out.base_dtype, out.indices, dt.itemsize)
+
+
+# -- MPI_Pack / MPI_Unpack --------------------------------------------------
+
+
+def pack(buf: Any, datatype: Datatype, count: int = 1,
+         position: Optional[bytearray] = None) -> bytes:
+    """MPI_Pack: append ``count`` instances to ``position`` (a growing
+    bytearray standing in for the MPI position cursor) and return the
+    packed bytes added."""
+    data = datatype.pack(buf, count).tobytes()
+    if position is not None:
+        position.extend(data)
+    return data
+
+
+def unpack(packed: Union[bytes, bytearray, memoryview], datatype: Datatype,
+           out: np.ndarray, count: int = 1, offset: int = 0) -> int:
+    """MPI_Unpack: consume ``count`` instances from ``packed`` starting at
+    byte ``offset`` into ``out``; returns the new offset."""
+    nbytes = datatype.size * count
+    chunk = np.frombuffer(bytes(packed[offset:offset + nbytes]),
+                          dtype=datatype.base_dtype)
+    datatype.unpack(chunk, out, count)
+    return offset + nbytes
+
+
+def pack_size(count: int, datatype: Datatype) -> int:
+    """MPI_Pack_size: bytes needed for ``count`` instances."""
+    return datatype.size * int(count)

@@ -1,0 +1,287 @@
+"""Derived datatypes (mpi_tpu/datatypes.py): index-map constructors vs
+numpy slicing oracles, composition, pack/unpack round trips, the jit
+path, and typed send/recv over the local backend."""
+
+import numpy as np
+import pytest
+from hypothesis import given, settings
+from hypothesis import strategies as st
+
+from mpi_tpu import datatypes as dt
+from mpi_tpu.transport.local import run_local
+
+
+# -- constructors vs slicing oracles ---------------------------------------
+
+
+def test_contiguous():
+    t = dt.type_contiguous(5, np.float64).commit()
+    buf = np.arange(10.0)
+    assert np.array_equal(t.pack(buf), buf[:5])
+    assert t.size == 5 * 8 and t.extent == 5
+
+
+def test_vector_matrix_column():
+    a = np.arange(20.0).reshape(4, 5)
+    col = dt.type_vector(4, 1, 5, np.float64).commit()
+    assert np.array_equal(col.pack(a), a[:, 0])
+    # column j: pack from the flattened buffer offset j — use indexed shift
+    shifted = dt.Datatype(col.base_dtype, col.indices + 2, col.extent)
+    assert np.array_equal(shifted.pack(a), a[:, 2])
+
+
+def test_vector_blocks():
+    t = dt.type_vector(3, 2, 4, np.int32).commit()
+    buf = np.arange(12, dtype=np.int32)
+    assert np.array_equal(t.pack(buf), [0, 1, 4, 5, 8, 9])
+    assert t.extent == (3 - 1) * 4 + 2
+
+
+def test_indexed():
+    t = dt.type_indexed([2, 1, 3], [0, 4, 7], np.int64).commit()
+    buf = np.arange(10)
+    assert np.array_equal(t.pack(buf), [0, 1, 4, 7, 8, 9])
+
+
+def test_subarray_2d():
+    full = np.arange(30.0).reshape(5, 6)
+    t = dt.type_create_subarray([5, 6], [2, 3], [1, 2], np.float64).commit()
+    assert np.array_equal(t.pack(full).reshape(2, 3), full[1:3, 2:5])
+    # extent spans the whole array: count=2 walks consecutive arrays
+    two = np.stack([full, full * 10])
+    packed = t.pack(two, count=2)
+    assert np.array_equal(packed[6:].reshape(2, 3), full[1:3, 2:5] * 10)
+
+
+def test_subarray_3d():
+    full = np.arange(2 * 3 * 4).reshape(2, 3, 4)
+    t = dt.type_create_subarray([2, 3, 4], [1, 2, 2], [1, 0, 1], np.int64).commit()
+    assert np.array_equal(t.pack(full).reshape(1, 2, 2), full[1:2, 0:2, 1:3])
+
+
+def test_composition_vector_of_contiguous():
+    pair = dt.type_contiguous(2, np.float32)
+    t = dt.type_vector(3, 1, 2, pair).commit()  # every other pair
+    buf = np.arange(12, dtype=np.float32)
+    assert np.array_equal(t.pack(buf), [0, 1, 4, 5, 8, 9])
+
+
+def test_struct_and_structured_dtype():
+    rec = np.dtype([("a", np.int32), ("b", np.float64), ("c", np.int8)])
+    t = dt.from_structured(rec).commit()
+    buf = np.zeros(3, dtype=rec)
+    buf["a"] = [1, 2, 3]
+    buf["b"] = [0.5, 1.5, 2.5]
+    buf["c"] = [7, 8, 9]
+    packed = t.pack(buf, count=3)
+    out = np.zeros(3, dtype=rec)
+    t.unpack(packed, out, count=3)
+    assert np.array_equal(out["a"], buf["a"])
+    assert np.array_equal(out["b"], buf["b"])
+    assert np.array_equal(out["c"], buf["c"])
+    # size counts field bytes only; extent includes padding holes
+    assert t.size == 4 + 8 + 1
+    assert t.extent == rec.itemsize
+
+
+def test_struct_heterogeneous_manual():
+    t = dt.type_create_struct([2, 1], [0, 8], [np.int32, np.float64]).commit()
+    raw = bytearray(16)
+    np.frombuffer(raw, np.int32, 2, 0)[:] = [11, 22]
+    np.frombuffer(raw, np.float64, 1, 8)[:] = [3.25]
+    packed = t.pack(np.frombuffer(bytes(raw), np.uint8))
+    out = np.zeros(16, np.uint8)
+    t.unpack(packed, out)
+    assert np.array_equal(np.frombuffer(out, np.int32, 2, 0), [11, 22])
+    assert np.frombuffer(out, np.float64, 1, 8)[0] == 3.25
+
+
+def test_resized_extent():
+    t = dt.type_create_resized(dt.type_contiguous(2, np.int32), 0, 4).commit()
+    buf = np.arange(10, dtype=np.int32)
+    assert np.array_equal(t.pack(buf, count=2), [0, 1, 4, 5])
+
+
+# -- validation -------------------------------------------------------------
+
+
+def test_commit_rejects_overlap():
+    bad = dt.type_indexed([2, 2], [0, 1], np.int32)
+    with pytest.raises(ValueError, match="twice"):
+        bad.commit()
+
+
+def test_pack_bounds_checked():
+    t = dt.type_vector(4, 1, 5, np.float64)
+    with pytest.raises(ValueError, match="buffer has"):
+        t.pack(np.zeros(10))
+
+
+def test_dtype_mismatch_rejected():
+    t = dt.type_contiguous(2, np.float64)
+    with pytest.raises(TypeError):
+        t.pack(np.zeros(4, np.float32))
+
+
+def test_unpack_rejects_noncontiguous_target():
+    """A strided view as the unpack target would scatter into a silent
+    copy — must be rejected, not quietly dropped."""
+    t = dt.type_vector(4, 1, 5, np.float64).commit()
+    grid = np.zeros((4, 5))
+    payload = np.arange(4.0)
+    with pytest.raises(TypeError, match="C-contiguous"):
+        t.unpack(payload, grid.T)
+    with pytest.raises(TypeError, match="ndarray"):
+        t.unpack(payload, [0.0] * 20)
+
+
+def test_negative_displacement_rejected_even_uncommitted():
+    """Without the check, Python negative indexing would alias the buffer
+    tail instead of erroring."""
+    bad = dt.type_indexed([1], [-1], np.float64)  # commit() not called
+    with pytest.raises(ValueError, match="negative"):
+        bad.pack(np.arange(4.0))
+    with pytest.raises(ValueError, match="negative"):
+        bad.unpack(np.zeros(1), np.zeros(4))
+
+
+def test_subarray_bounds_rejected():
+    with pytest.raises(ValueError, match="out of bounds"):
+        dt.type_create_subarray([4, 4], [2, 2], [3, 0], np.float32)
+
+
+def test_jax_paths_bounds_checked():
+    """jnp.take would silently clamp/fill OOB — the static check must fire
+    at trace time like the numpy path does."""
+    t = dt.type_vector(4, 1, 5, np.float64).commit()
+    with pytest.raises(ValueError, match="buffer has"):
+        t.pack_jax(np.arange(10.0))
+    with pytest.raises(ValueError, match="buffer has"):
+        t.unpack_jax(np.zeros(4), np.zeros(10))
+
+
+def test_unpack_dtype_mismatch_rejected():
+    t = dt.type_contiguous(3, np.int64).commit()
+    with pytest.raises(TypeError, match="payload dtype"):
+        t.unpack(np.array([1.9, 2.9, -3.9]), np.zeros(3, np.int64))
+
+
+def test_recv_buf_without_datatype_rejected():
+    from mpi_tpu import api
+
+    def prog(comm):
+        if comm.rank == 0:
+            comm.send(np.arange(3.0), dest=1)
+            return None
+        with pytest.raises(ValueError, match="BOTH"):
+            api.MPI_Recv(source=0, comm=comm, buf=np.zeros(3))
+        return comm.recv(source=0)  # drain the message
+
+    run_local(prog, 2)
+
+
+# -- pack/unpack round trip property ---------------------------------------
+
+
+@settings(max_examples=60, deadline=None)
+@given(st.integers(1, 5), st.integers(1, 4), st.integers(1, 8),
+       st.integers(1, 3))
+def test_vector_roundtrip_property(count, blocklen, stride, instances):
+    stride = max(stride, blocklen)
+    t = dt.type_vector(count, blocklen, stride, np.float64).commit()
+    need = t.extent * instances
+    buf = np.random.default_rng(0).normal(size=need)
+    packed = t.pack(buf, instances)
+    out = np.full(need, np.nan)
+    t.unpack(packed, out, instances)
+    idx = np.concatenate([t.indices + i * t.extent for i in range(instances)])
+    assert np.array_equal(out[idx], buf[idx])
+    mask = np.ones(need, bool)
+    mask[idx] = False
+    assert np.all(np.isnan(out[mask]))  # untouched holes stay untouched
+
+
+# -- MPI_Pack / MPI_Unpack ---------------------------------------------------
+
+
+def test_pack_unpack_position_cursor():
+    t = dt.type_vector(2, 1, 3, np.int32).commit()
+    buf = np.arange(6, dtype=np.int32)
+    cursor = bytearray()
+    dt.pack(buf, t, 1, cursor)
+    dt.pack(buf * 10, t, 1, cursor)
+    assert len(cursor) == 2 * dt.pack_size(1, t)
+    out1 = np.zeros(6, np.int32)
+    out2 = np.zeros(6, np.int32)
+    off = dt.unpack(cursor, t, out1)
+    dt.unpack(cursor, t, out2, offset=off)
+    assert out1[0] == 0 and out1[3] == 3
+    assert out2[0] == 0 and out2[3] == 30
+
+
+# -- jit path ---------------------------------------------------------------
+
+
+def test_pack_jax_matches_numpy():
+    import jax
+
+    a = np.arange(24.0, dtype=np.float32).reshape(4, 6)
+    t = dt.type_create_subarray([4, 6], [2, 3], [1, 2], np.float32).commit()
+    jpacked = jax.jit(t.pack_jax)(a)
+    assert np.array_equal(np.asarray(jpacked), t.pack(a))
+    out = jax.jit(t.unpack_jax)(jpacked, np.zeros_like(a))
+    expect = np.zeros_like(a)
+    t.unpack(t.pack(a), expect)
+    assert np.array_equal(np.asarray(out), expect)
+
+
+# -- typed send/recv over a real backend ------------------------------------
+
+
+def test_typed_send_recv_column_local_backend():
+    """Rank 0 sends column 2 of its matrix; rank 1 scatters it into
+    column 0 of a zero matrix — the classic MPI_Type_vector demo."""
+    from mpi_tpu import api
+
+    a = np.arange(20.0).reshape(4, 5)
+
+    def prog(comm):
+        col = dt.type_vector(4, 1, 5, np.float64).commit()
+        if comm.rank == 0:
+            api.MPI_Send(a, dest=1, comm=comm,
+                         datatype=dt.Datatype(col.base_dtype,
+                                              col.indices + 2, col.extent))
+            return None
+        out = np.zeros((4, 5))
+        api.MPI_Recv(source=0, comm=comm, datatype=col, buf=out)
+        return out
+
+    res = run_local(prog, 2)
+    assert np.array_equal(res[1][:, 0], a[:, 2])
+    assert np.all(res[1][:, 1:] == 0)
+
+
+def test_typed_halo_exchange_subarray():
+    """2-rank halo exchange of interior edge columns using subarray types —
+    the Jacobi-face pattern the constructor exists for."""
+    from mpi_tpu import api
+
+    n = 6
+
+    def prog(comm):
+        grid = np.full((n, n), float(comm.rank + 1))
+        send_col = 1 if comm.rank == 1 else n - 2
+        recv_col = n - 1 if comm.rank == 0 else 0
+        tsend = dt.type_create_subarray([n, n], [n, 1], [0, send_col],
+                                        np.float64).commit()
+        trecv = dt.type_create_subarray([n, n], [n, 1], [0, recv_col],
+                                        np.float64).commit()
+        other = 1 - comm.rank
+        payload = comm.sendrecv(tsend.pack(grid), other, other)
+        trecv.unpack(payload, grid)
+        return grid
+
+    res = run_local(prog, 2)
+    assert np.all(res[0][:, n - 1] == 2.0)
+    assert np.all(res[0][:, : n - 1] == 1.0)
+    assert np.all(res[1][:, 0] == 1.0)
