@@ -33,7 +33,7 @@ from .ops import SUM, PROD, MAX, MIN, LAND, LOR, LXOR, BAND, BOR, BXOR, ReduceOp
 from .communicator import Communicator, P2PCommunicator, Request, Status
 from .transport.base import ANY_SOURCE, ANY_TAG
 from .transport.local import run_local
-from . import datatypes, schedules, checker, checkpoint, profiling, trace
+from . import datatypes, errors, schedules, checker, checkpoint, profiling, trace
 from .intercomm import InterComm, create_intercomm
 from .topology import (CartComm, GraphComm, cart_create,
                        dims_create, dist_graph_create_adjacent,
@@ -155,7 +155,27 @@ def run(
     raise ValueError(f"unknown backend {backend!r}")
 
 
+_self_comm: Optional[P2PCommunicator] = None
+
+
+def comm_self() -> P2PCommunicator:
+    """MPI_COMM_SELF [S]: the size-1 communicator containing only this
+    process — independent of (and usable alongside) any world backend.
+    Collectives on it are identities; it is the conventional home for
+    per-process libraries (e.g. opening an MPI-IO file privately)."""
+    global _self_comm
+    with _world_lock:
+        if _self_comm is None:
+            from .transport.local import LocalTransport, LocalWorld
+
+            _self_comm = P2PCommunicator(LocalTransport(LocalWorld(1), 0),
+                                         range(1))
+        return _self_comm
+
+
 def __getattr__(name: str):
     if name == "COMM_WORLD":
         return init()
+    if name == "COMM_SELF":
+        return comm_self()
     raise AttributeError(f"module 'mpi_tpu' has no attribute {name!r}")

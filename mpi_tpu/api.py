@@ -16,7 +16,8 @@ from __future__ import annotations
 
 from typing import Any, Optional, Sequence
 
-from . import datatypes, ops
+from . import datatypes, errors, ops
+from . import communicator as _comm
 from .communicator import Communicator, Status
 from .group import Group
 from .transport.base import ANY_SOURCE, ANY_TAG
@@ -54,6 +55,14 @@ __all__ = [
     "MPI_Type_create_resized", "MPI_Type_commit", "MPI_Type_free",
     "MPI_Type_size", "MPI_Type_get_extent",
     "MPI_Pack", "MPI_Unpack", "MPI_Pack_size", "Datatype",
+    "MPI_COMM_SELF", "MPI_Get_count", "MPI_Get_elements",
+    "MPI_SUCCESS", "MPI_ERRORS_ARE_FATAL", "MPI_ERRORS_RETURN",
+    "MPI_Error_class", "MPI_Error_string", "ErrorCode",
+    "MPI_Comm_set_errhandler", "MPI_Comm_get_errhandler",
+    "MPI_Errhandler_create",
+    "MPI_Comm_create_keyval", "MPI_Comm_free_keyval", "MPI_COMM_DUP_FN",
+    "MPI_COMM_NULL_COPY_FN", "MPI_NO_COPY", "Keyval",
+    "MPI_Comm_set_attr", "MPI_Comm_get_attr", "MPI_Comm_delete_attr",
     "ANY_SOURCE", "ANY_TAG", "SUM", "PROD", "MAX", "MIN",
     "LAND", "LOR", "LXOR", "BAND", "BOR", "BXOR", "Status",
 ]
@@ -70,6 +79,19 @@ def _world(comm: Optional[Communicator]) -> Communicator:
     from . import init
 
     return init()
+
+
+def _call(comm: Optional[Communicator], method: str, *args: Any, **kwargs: Any) -> Any:
+    """Invoke a communicator method under its error handler (MPI-1 §7,
+    mpi_tpu/errors.py): ERRORS_ARE_FATAL propagates the exception,
+    ERRORS_RETURN yields an ErrorCode in place of the result, a callable
+    handler decides.  This boundary is the MPI_* layer only — the object
+    API stays exception-raising (pythonic)."""
+    c = _world(comm)
+    try:
+        return getattr(c, method)(*args, **kwargs)
+    except Exception as exc:  # noqa: BLE001 - classified by the handler
+        return errors.invoke_handler(c, exc)
 
 
 def MPI_Init(backend: Optional[str] = None) -> Communicator:
@@ -107,9 +129,12 @@ def MPI_Send(obj: Any, dest: int, tag: int = 0, comm: Optional[Communicator] = N
     """With ``datatype=``, ``obj`` is the typed base buffer and the wire
     payload is ``datatype.pack(obj, count)`` — the MPI typed-send spelling
     (strided columns, halo faces, structs; mpi_tpu/datatypes.py)."""
-    if datatype is not None:
-        obj = datatype.pack(obj, count)
-    _world(comm).send(obj, dest, tag)
+    c = _world(comm)
+    try:
+        payload = datatype.pack(obj, count) if datatype is not None else obj
+        return c.send(payload, dest, tag)
+    except Exception as exc:  # noqa: BLE001 - pack errors honor the handler too
+        return errors.invoke_handler(c, exc)
 
 
 def MPI_Recv(source: int = ANY_SOURCE, tag: int = ANY_TAG,
@@ -120,51 +145,56 @@ def MPI_Recv(source: int = ANY_SOURCE, tag: int = ANY_TAG,
     """With ``datatype=`` and ``buf=``, the received contiguous payload is
     scattered into ``buf`` in-place (the typed-recv spelling); ``buf`` is
     returned."""
-    if (buf is None) != (datatype is None):
-        raise ValueError("typed MPI_Recv needs BOTH datatype= and buf= "
-                         "(one without the other would silently drop the "
-                         "layout or leave buf unfilled)")
-    obj = _world(comm).recv(source, tag, status)
-    if datatype is not None:
-        return datatype.unpack(obj, buf, count)
-    return obj
+    c = _world(comm)
+    try:
+        if (buf is None) != (datatype is None):
+            raise ValueError("typed MPI_Recv needs BOTH datatype= and buf= "
+                             "(one without the other would silently drop the "
+                             "layout or leave buf unfilled)")
+        obj = c.recv(source, tag, status)
+        if datatype is not None:
+            return datatype.unpack(obj, buf, count)
+        return obj
+    except Exception as exc:  # noqa: BLE001 - unpack errors honor the handler;
+        # a handler's fallback value is returned as-is, never unpacked into buf
+        return errors.invoke_handler(c, exc)
 
 
 def MPI_Sendrecv(sendobj: Any, dest: int, source: int = ANY_SOURCE,
                  sendtag: int = 0, recvtag: int = ANY_TAG,
                  comm: Optional[Communicator] = None) -> Any:
-    return _world(comm).sendrecv(sendobj, dest, source, sendtag, recvtag)
+    return _call(comm, "sendrecv", sendobj, dest, source, sendtag, recvtag)
 
 
 def MPI_Bcast(obj: Any, root: int = 0, comm: Optional[Communicator] = None) -> Any:
-    return _world(comm).bcast(obj, root)
+    return _call(comm, "bcast", obj, root)
 
 
 def MPI_Reduce(obj: Any, op: ops.ReduceOp = ops.SUM, root: int = 0,
                comm: Optional[Communicator] = None) -> Any:
-    return _world(comm).reduce(obj, op, root)
+    return _call(comm, "reduce", obj, op, root)
 
 
 def MPI_Allreduce(obj: Any, op: ops.ReduceOp = ops.SUM, algorithm: str = "auto",
                   comm: Optional[Communicator] = None) -> Any:
-    return _world(comm).allreduce(obj, op, algorithm)
+    return _call(comm, "allreduce", obj, op, algorithm)
 
 
 def MPI_Allgather(obj: Any, comm: Optional[Communicator] = None) -> Any:
-    return _world(comm).allgather(obj)
+    return _call(comm, "allgather", obj)
 
 
 def MPI_Alltoall(objs: Sequence[Any], comm: Optional[Communicator] = None) -> Any:
-    return _world(comm).alltoall(objs)
+    return _call(comm, "alltoall", objs)
 
 
 def MPI_Barrier(comm: Optional[Communicator] = None) -> None:
-    _world(comm).barrier()
+    return _call(comm, "barrier")  # None, or ErrorCode under ERRORS_RETURN
 
 
 def MPI_Comm_split(color: Optional[int], key: int = 0,
                    comm: Optional[Communicator] = None) -> Optional[Communicator]:
-    return _world(comm).split(color, key)
+    return _call(comm, "split", color, key)
 
 
 def MPI_Comm_dup(comm: Optional[Communicator] = None) -> Communicator:
@@ -173,11 +203,11 @@ def MPI_Comm_dup(comm: Optional[Communicator] = None) -> Communicator:
 
 def MPI_Scatter(objs: Optional[Sequence[Any]], root: int = 0,
                 comm: Optional[Communicator] = None) -> Any:
-    return _world(comm).scatter(objs, root)
+    return _call(comm, "scatter", objs, root)
 
 
 def MPI_Gather(obj: Any, root: int = 0, comm: Optional[Communicator] = None) -> Any:
-    return _world(comm).gather(obj, root)
+    return _call(comm, "gather", obj, root)
 
 
 def MPI_Isend(obj: Any, dest: int, tag: int = 0,
@@ -645,6 +675,93 @@ def MPI_Type_size(datatype: datatypes.Datatype) -> int:
 
 
 def MPI_Type_get_extent(datatype: datatypes.Datatype):
-    """(lower bound, extent) in bytes — lb is folded into the index map,
-    so it reports 0 (resized types shift the map instead)."""
-    return (0, datatype.extent_bytes)
+    """(lower bound, extent) in bytes."""
+    return (datatype.lb * datatype.base_dtype.itemsize, datatype.extent_bytes)
+
+
+def MPI_COMM_SELF() -> Communicator:
+    """The size-1 communicator containing only this process [S]."""
+    import mpi_tpu
+
+    return mpi_tpu.comm_self()
+
+
+def _datatype_bytes(datatype) -> int:
+    if isinstance(datatype, datatypes.Datatype):
+        return datatype.size
+    import numpy as np
+
+    return np.dtype(datatype).itemsize
+
+
+def MPI_Get_count(status: Status, datatype) -> Optional[int]:
+    """Instances of ``datatype`` in the received payload; None
+    (MPI_UNDEFINED) when the payload was an opaque object, the status
+    came from a probe (envelope only), or the size is not a whole
+    multiple of the datatype.  ``datatype`` is a Datatype or dtype-like."""
+    nbytes = _datatype_bytes(datatype)
+    if status.count_bytes is None or nbytes == 0 or \
+            status.count_bytes % nbytes:
+        return None
+    return status.count_bytes // nbytes
+
+
+def MPI_Get_elements(status: Status, datatype) -> Optional[int]:
+    """Base-element count of the received payload (MPI_Get_elements:
+    counts primitive elements even when a partial instance arrived)."""
+    if isinstance(datatype, datatypes.Datatype):
+        item = datatype.base_dtype.itemsize
+    else:
+        item = _datatype_bytes(datatype)
+    if status.count_bytes is None or item == 0 or status.count_bytes % item:
+        return None
+    return status.count_bytes // item
+
+
+# -- error handling (MPI-1 ch.7; mpi_tpu/errors.py) -------------------------
+
+MPI_SUCCESS = errors.MPI_SUCCESS
+MPI_ERRORS_ARE_FATAL = errors.ERRORS_ARE_FATAL
+MPI_ERRORS_RETURN = errors.ERRORS_RETURN
+MPI_Error_class = errors.error_class
+MPI_Error_string = errors.error_string
+ErrorCode = errors.ErrorCode
+
+
+def MPI_Comm_set_errhandler(handler, comm: Optional[Communicator] = None) -> None:
+    """ERRORS_ARE_FATAL (default), ERRORS_RETURN, or ``handler(comm, exc)``."""
+    _world(comm).set_errhandler(handler)
+
+
+def MPI_Comm_get_errhandler(comm: Optional[Communicator] = None):
+    return _world(comm).get_errhandler()
+
+
+def MPI_Errhandler_create(fn):
+    """MPI_Errhandler_create: any ``fn(comm, exc)`` callable IS a handler."""
+    return fn
+
+
+# -- attribute caching (MPI-1 ch.5.7 keyvals) -------------------------------
+
+MPI_Comm_create_keyval = _comm.create_keyval
+MPI_COMM_DUP_FN = _comm.dup_fn
+MPI_COMM_NULL_COPY_FN = None
+MPI_NO_COPY = _comm.NO_COPY
+Keyval = _comm.Keyval
+
+
+def MPI_Comm_free_keyval(keyval) -> None:
+    """The keyval object is the handle; freeing is garbage collection."""
+
+
+def MPI_Comm_set_attr(keyval, value, comm: Optional[Communicator] = None) -> None:
+    _world(comm).set_attr(keyval, value)
+
+
+def MPI_Comm_get_attr(keyval, comm: Optional[Communicator] = None):
+    return _world(comm).get_attr(keyval)
+
+
+def MPI_Comm_delete_attr(keyval, comm: Optional[Communicator] = None) -> None:
+    _world(comm).delete_attr(keyval)

@@ -42,13 +42,27 @@ _TAG_SPLIT = -5
 
 
 class Status:
-    """Result metadata for a receive (MPI_Status analogue)."""
+    """Result metadata for a receive (MPI_Status analogue).
 
-    __slots__ = ("source", "tag")
+    ``count_bytes`` is the received payload's size when it is a sized
+    buffer (ndarray / bytes), None for opaque pickled objects and for
+    probe (which sees only the envelope) — the MPI_UNDEFINED analogue.
+    MPI_Get_count/MPI_Get_elements (api.py) divide it by a datatype."""
+
+    __slots__ = ("source", "tag", "count_bytes")
 
     def __init__(self) -> None:
         self.source = ANY_SOURCE
         self.tag = ANY_TAG
+        self.count_bytes: Optional[int] = None
+
+    def _set_count(self, obj: Any) -> None:
+        if hasattr(obj, "nbytes"):
+            self.count_bytes = int(obj.nbytes)
+        elif isinstance(obj, (bytes, bytearray, memoryview)):
+            self.count_bytes = len(obj)
+        else:
+            self.count_bytes = None
 
     def __repr__(self) -> str:  # pragma: no cover - cosmetic
         return f"Status(source={self.source}, tag={self.tag})"
@@ -281,8 +295,89 @@ class _ThreadRequest(Request):
         return True, self.wait()
 
 
+class Keyval:
+    """Attribute key (MPI_Comm_create_keyval [S]).
+
+    ``copy_fn(comm, value) -> new value`` decides what a dup'd communicator
+    inherits; return :data:`NO_COPY` (or set ``copy_fn=None``, the
+    MPI_COMM_NULL_COPY_FN default) to not propagate.  ``delete_fn(comm,
+    value)`` runs when the attribute is deleted or overwritten."""
+
+    __slots__ = ("copy_fn", "delete_fn", "name")
+
+    def __init__(self, copy_fn=None, delete_fn=None, name: str = ""):
+        self.copy_fn = copy_fn
+        self.delete_fn = delete_fn
+        self.name = name
+
+    def __repr__(self) -> str:  # pragma: no cover - cosmetic
+        return f"Keyval({self.name or hex(id(self))})"
+
+
+NO_COPY = object()  # sentinel a copy_fn returns to veto propagation
+
+
+def dup_fn(comm, value):
+    """MPI_COMM_DUP_FN: propagate the value as-is on dup."""
+    return value
+
+
+def create_keyval(copy_fn=None, delete_fn=None, name: str = "") -> Keyval:
+    """MPI_Comm_create_keyval.  The keyval OBJECT is the key (no integer
+    handle table to leak); free_keyval is garbage collection."""
+    return Keyval(copy_fn, delete_fn, name)
+
+
 class Communicator(ABC):
     """Abstract communicator: the API user MPI programs are written against."""
+
+    # -- attribute caching (MPI-1 §5.7 keyvals) ----------------------------
+    # Host-side bookkeeping only (never touches the transport or device),
+    # so it lives on the ABC and every backend inherits it.
+
+    def set_attr(self, keyval: Keyval, value: Any) -> None:
+        """MPI_Comm_set_attr; overwriting runs the old value's delete_fn."""
+        attrs = self.__dict__.setdefault("_attrs", {})
+        if keyval in attrs and keyval.delete_fn is not None:
+            keyval.delete_fn(self, attrs[keyval])
+        attrs[keyval] = value
+
+    def get_attr(self, keyval: Keyval) -> Any:
+        """MPI_Comm_get_attr: the value, or None when unset (the flag=false
+        analogue)."""
+        return self.__dict__.get("_attrs", {}).get(keyval)
+
+    def delete_attr(self, keyval: Keyval) -> None:
+        """MPI_Comm_delete_attr: remove + run delete_fn (no-op when unset)."""
+        attrs = self.__dict__.get("_attrs", {})
+        if keyval in attrs:
+            value = attrs.pop(keyval)
+            if keyval.delete_fn is not None:
+                keyval.delete_fn(self, value)
+
+    def _copy_attrs_to(self, new: "Communicator") -> "Communicator":
+        """Dup-time attribute propagation per MPI copy-callback semantics."""
+        for keyval, value in self.__dict__.get("_attrs", {}).items():
+            if keyval.copy_fn is None:
+                continue
+            copied = keyval.copy_fn(self, value)
+            if copied is not NO_COPY:
+                new.set_attr(keyval, copied)
+        return new
+
+    # -- error handling (MPI-1 §7; mpi_tpu/errors.py) ----------------------
+    # The object API always raises; the flat MPI_* layer consults this
+    # handler at its boundary (ERRORS_ARE_FATAL default = propagate).
+
+    def set_errhandler(self, handler) -> None:
+        """MPI_Comm_set_errhandler: ERRORS_ARE_FATAL, ERRORS_RETURN, or a
+        callable ``handler(comm, exc)``."""
+        self._errhandler = handler
+
+    def get_errhandler(self):
+        from .errors import ERRORS_ARE_FATAL
+
+        return getattr(self, "_errhandler", ERRORS_ARE_FATAL)
 
     # -- identity ----------------------------------------------------------
 
@@ -627,6 +722,7 @@ class P2PCommunicator(Communicator):
         if status is not None:
             status.source = self._from_world(src)
             status.tag = t
+            status._set_count(obj)
         return obj
 
     def sendrecv(self, sendobj: Any, dest: int, source: int = ANY_SOURCE,
@@ -978,8 +1074,9 @@ class P2PCommunicator(Communicator):
     def dup(self) -> "P2PCommunicator":
         self.barrier()  # collectiveness check + sync, like MPI_Comm_dup
         ctx = self._alloc_context()
-        return P2PCommunicator(self._t, self._group, ctx,
-                               recv_timeout=self.recv_timeout)
+        return self._copy_attrs_to(
+            P2PCommunicator(self._t, self._group, ctx,
+                            recv_timeout=self.recv_timeout))
 
     # -- nonblocking collectives [S: MPI-3 MPI_Ibcast & co.] ---------------
 

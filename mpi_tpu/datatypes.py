@@ -51,12 +51,14 @@ class Datatype:
     instance occupies when instances are replicated (``count > 1`` or an
     outer constructor), mirroring MPI extent semantics [S]."""
 
-    __slots__ = ("base_dtype", "indices", "extent", "_committed")
+    __slots__ = ("base_dtype", "indices", "extent", "lb", "_committed")
 
-    def __init__(self, base_dtype: np.dtype, indices: np.ndarray, extent: int):
+    def __init__(self, base_dtype: np.dtype, indices: np.ndarray, extent: int,
+                 lb: int = 0):
         self.base_dtype = np.dtype(base_dtype)
         self.indices = np.asarray(indices, dtype=np.int64).reshape(-1)
         self.extent = int(extent)
+        self.lb = int(lb)  # bookkeeping only (get_extent); never shifts the map
         self._committed = False
 
     # -- introspection (MPI_Type_size / MPI_Type_get_extent) ---------------
@@ -129,6 +131,15 @@ class Datatype:
         if idx.size and int(idx.max()) >= limit:
             raise ValueError(f"datatype touches element {int(idx.max())} but "
                              f"buffer has {limit}")
+        if count > 1 and self.indices.size and \
+                self.extent <= int(self.indices.max()):
+            # instances can interleave only when the extent is inside the
+            # map's span — only then pay for the uniqueness check
+            if np.unique(idx).size != idx.size:
+                raise ValueError(
+                    f"replicating {count} instances at extent {self.extent} "
+                    "maps the same element twice (instances overlap) — "
+                    "unpack would be order-dependent")
         return idx
 
     # -- host (numpy) path -------------------------------------------------
@@ -266,10 +277,12 @@ def type_create_struct(blocklengths: Sequence[int],
 
 
 def type_create_resized(base: BaseLike, lb: int, extent: int) -> Datatype:
-    """MPI_Type_create_resized: same map, adjusted extent (units of the base
-    dtype; ``lb`` shifts the map, matching a lower-bound marker)."""
+    """MPI_Type_create_resized: same typemap (displacements UNCHANGED —
+    lb/extent are bookkeeping markers in MPI, not shifts [S]); ``extent``
+    (units of the base dtype) controls where replicated instances land;
+    ``lb`` is recorded for MPI_Type_get_extent."""
     b = _as_base(base)
-    return Datatype(b.base_dtype, b.indices + int(lb), int(extent))
+    return Datatype(b.base_dtype, b.indices, int(extent), lb=int(lb))
 
 
 def from_structured(dtype: Any) -> Datatype:

@@ -1,0 +1,178 @@
+"""MPI error classes and error handlers (MPI-1 §7 error handling [S]).
+
+Pythonic contract, stated honestly rather than emulated blindly:
+
+* The object API (``comm.send(...)`` etc.) raises Python exceptions —
+  that IS this library's native error reporting, and with the default
+  handler an uncaught exception kills the rank, which the launcher
+  escalates to kill-all (the MPI_ERRORS_ARE_FATAL behavior, SURVEY.md §2
+  component #1's exit-code contract).
+* The flat ``MPI_*`` layer (api.py) additionally honors per-communicator
+  error handlers, like the C API:
+    - :data:`ERRORS_ARE_FATAL` (default) — exceptions propagate;
+    - :data:`ERRORS_RETURN` — the call returns an :class:`ErrorCode`
+      (an int subclass carrying the error class and the exception) in
+      place of its result, the closest value-semantics analogue of C's
+      "return the code, results via out-params";
+    - any callable ``handler(comm, exc)`` — its return value becomes the
+      call's result (custom MPI_Errhandler).
+* :func:`error_class` classifies an exception into the standard MPI
+  error-class constants; :func:`error_string` renders them.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+__all__ = [
+    "MPI_SUCCESS", "MPI_ERR_BUFFER", "MPI_ERR_COUNT", "MPI_ERR_TYPE",
+    "MPI_ERR_TAG", "MPI_ERR_COMM", "MPI_ERR_RANK", "MPI_ERR_REQUEST",
+    "MPI_ERR_ROOT", "MPI_ERR_GROUP", "MPI_ERR_OP", "MPI_ERR_TOPOLOGY",
+    "MPI_ERR_DIMS", "MPI_ERR_ARG", "MPI_ERR_UNKNOWN", "MPI_ERR_TRUNCATE",
+    "MPI_ERR_OTHER", "MPI_ERR_INTERN", "MPI_ERR_PENDING", "MPI_ERR_IO",
+    "ERRORS_ARE_FATAL", "ERRORS_RETURN", "ErrorCode",
+    "error_class", "error_string",
+]
+
+MPI_SUCCESS = 0
+MPI_ERR_BUFFER = 1
+MPI_ERR_COUNT = 2
+MPI_ERR_TYPE = 3
+MPI_ERR_TAG = 4
+MPI_ERR_COMM = 5
+MPI_ERR_RANK = 6
+MPI_ERR_REQUEST = 7
+MPI_ERR_ROOT = 8
+MPI_ERR_GROUP = 9
+MPI_ERR_OP = 10
+MPI_ERR_TOPOLOGY = 11
+MPI_ERR_DIMS = 12
+MPI_ERR_ARG = 13
+MPI_ERR_UNKNOWN = 14
+MPI_ERR_TRUNCATE = 15
+MPI_ERR_OTHER = 16
+MPI_ERR_INTERN = 17
+MPI_ERR_PENDING = 18
+MPI_ERR_IO = 19
+
+_STRINGS = {
+    MPI_SUCCESS: "no error",
+    MPI_ERR_BUFFER: "invalid buffer",
+    MPI_ERR_COUNT: "invalid count",
+    MPI_ERR_TYPE: "invalid datatype",
+    MPI_ERR_TAG: "invalid tag",
+    MPI_ERR_COMM: "invalid communicator",
+    MPI_ERR_RANK: "invalid rank",
+    MPI_ERR_REQUEST: "invalid request",
+    MPI_ERR_ROOT: "invalid root",
+    MPI_ERR_GROUP: "invalid group",
+    MPI_ERR_OP: "invalid reduce operation",
+    MPI_ERR_TOPOLOGY: "invalid topology",
+    MPI_ERR_DIMS: "invalid dimensions",
+    MPI_ERR_ARG: "invalid argument",
+    MPI_ERR_UNKNOWN: "unknown error",
+    MPI_ERR_TRUNCATE: "message truncated on receive",
+    MPI_ERR_OTHER: "known error not in this list",
+    MPI_ERR_INTERN: "internal error",
+    MPI_ERR_PENDING: "pending operation (timeout)",
+    MPI_ERR_IO: "I/O error",
+}
+
+
+class _FatalHandler:
+    def __repr__(self) -> str:  # pragma: no cover - cosmetic
+        return "ERRORS_ARE_FATAL"
+
+
+class _ReturnHandler:
+    def __repr__(self) -> str:  # pragma: no cover - cosmetic
+        return "ERRORS_RETURN"
+
+
+ERRORS_ARE_FATAL = _FatalHandler()
+ERRORS_RETURN = _ReturnHandler()
+
+
+class ErrorCode(int):
+    """An MPI error code: an int (comparable to the MPI_ERR_* constants)
+    that also carries the originating exception for diagnosis."""
+
+    exception: Optional[BaseException]
+
+    def __new__(cls, code: int, exception: Optional[BaseException] = None):
+        self = super().__new__(cls, code)
+        self.exception = exception
+        return self
+
+    @classmethod
+    def from_exception(cls, exc: BaseException) -> "ErrorCode":
+        return cls(error_class(exc), exc)
+
+    def __repr__(self) -> str:  # pragma: no cover - cosmetic
+        return (f"ErrorCode({int(self)}: {error_string(int(self))}"
+                f"{f', from {self.exception!r}' if self.exception else ''})")
+
+
+# word-pattern → class, first hit wins; keep specific words before generic
+# ones.  \b boundaries so short keys don't fire inside unrelated words
+# ("op" in "open", "source" in "resource", "tag" in "storage").
+import re as _re
+
+_CLASSIFY = [(_re.compile(p), c) for p, c in [
+    (r"\btags?\b", MPI_ERR_TAG),
+    (r"\branks?\b", MPI_ERR_RANK),
+    (r"\bdest\b", MPI_ERR_RANK),
+    (r"\bsource\b", MPI_ERR_RANK),
+    (r"\broot\b", MPI_ERR_ROOT),
+    (r"\bcounts?\b", MPI_ERR_COUNT),
+    (r"truncat", MPI_ERR_TRUNCATE),
+    (r"payload has", MPI_ERR_TRUNCATE),
+    (r"\bdatatype\b", MPI_ERR_TYPE),
+    (r"\bdtype\b", MPI_ERR_TYPE),
+    (r"\bcommunicator\b", MPI_ERR_COMM),
+    (r"\bgroups?\b", MPI_ERR_GROUP),
+    (r"\balgorithm\b", MPI_ERR_OP),
+    (r"\bops?\b", MPI_ERR_OP),
+    (r"topolog", MPI_ERR_TOPOLOGY),
+    (r"\bdims?\b", MPI_ERR_DIMS),
+    (r"\bbuffers?\b", MPI_ERR_BUFFER),
+    (r"\bfiles?\b", MPI_ERR_IO),
+]]
+
+
+def error_class(exc: Any) -> int:
+    """Classify an exception (or an ErrorCode) into an MPI error class."""
+    if isinstance(exc, ErrorCode):
+        return int(exc)
+    if isinstance(exc, int):
+        return exc
+    from .transport.base import RecvTimeout  # local import: no cycle at load
+
+    if isinstance(exc, RecvTimeout):
+        return MPI_ERR_PENDING
+    if isinstance(exc, (OSError, IOError)):
+        return MPI_ERR_IO
+    msg = str(exc).lower()
+    if isinstance(exc, (TypeError,)) and ("dtype" in msg or "datatype" in msg):
+        return MPI_ERR_TYPE
+    if isinstance(exc, (ValueError, KeyError, IndexError, TypeError)):
+        for pat, code in _CLASSIFY:
+            if pat.search(msg):
+                return code
+        return MPI_ERR_ARG
+    return MPI_ERR_OTHER
+
+
+def error_string(code: int) -> str:
+    return _STRINGS.get(int(code), f"invalid error class {int(code)}")
+
+
+def invoke_handler(comm: Any, exc: BaseException) -> Any:
+    """Dispatch ``exc`` through ``comm``'s error handler (api.py boundary)."""
+    get = getattr(comm, "get_errhandler", None)
+    handler = get() if get is not None else ERRORS_ARE_FATAL
+    if handler is ERRORS_ARE_FATAL:
+        raise exc
+    if handler is ERRORS_RETURN:
+        return ErrorCode.from_exception(exc)
+    return handler(comm, exc)

@@ -758,7 +758,8 @@ class TpuCommunicator(Communicator):
     def dup(self) -> "TpuCommunicator":
         # SPMD collectives carry no message-matching state, so a dup is a
         # fresh handle over the same groups.
-        return TpuCommunicator(self.axis_name, self.mesh, self._groups)
+        return self._copy_attrs_to(
+            TpuCommunicator(self.axis_name, self.mesh, self._groups))
 
     def free(self) -> None:
         pass

@@ -1,0 +1,81 @@
+"""Attribute caching (MPI-1 §5.7 keyvals): set/get/delete, delete_fn
+hooks, and dup-time copy-callback semantics on both backend families."""
+
+import os
+
+import numpy as np
+import pytest
+
+from mpi_tpu import api, communicator as comm_mod
+from mpi_tpu.transport.local import run_local
+
+
+def test_set_get_delete_roundtrip():
+    def prog(comm):
+        kv = comm_mod.create_keyval(name="answer")
+        assert comm.get_attr(kv) is None
+        comm.set_attr(kv, 42)
+        assert comm.get_attr(kv) == 42
+        comm.delete_attr(kv)
+        assert comm.get_attr(kv) is None
+        comm.delete_attr(kv)  # idempotent
+
+    run_local(prog, 2)
+
+
+def test_delete_fn_runs_on_delete_and_overwrite():
+    def prog(comm):
+        log = []
+        kv = comm_mod.create_keyval(
+            delete_fn=lambda c, v: log.append(v), name="logged")
+        comm.set_attr(kv, "a")
+        comm.set_attr(kv, "b")  # overwrite deletes "a"
+        comm.delete_attr(kv)
+        return log
+
+    res = run_local(prog, 1)
+    assert res[0] == ["a", "b"]
+
+
+def test_dup_copy_semantics():
+    def prog(comm):
+        kept = comm_mod.create_keyval(copy_fn=comm_mod.dup_fn, name="kept")
+        private = comm_mod.create_keyval(name="private")  # NULL_COPY_FN
+        vetoed = comm_mod.create_keyval(
+            copy_fn=lambda c, v: comm_mod.NO_COPY, name="vetoed")
+        doubled = comm_mod.create_keyval(
+            copy_fn=lambda c, v: v * 2, name="doubled")
+        for kv, v in [(kept, "k"), (private, "p"), (vetoed, "v"), (doubled, 21)]:
+            comm.set_attr(kv, v)
+        d = comm.dup()
+        return (d.get_attr(kept), d.get_attr(private),
+                d.get_attr(vetoed), d.get_attr(doubled),
+                comm.get_attr(private))
+
+    for got in run_local(prog, 2):
+        assert got == ("k", None, None, 42, "p")
+
+
+def test_attrs_on_tpu_backend_dup():
+    import mpi_tpu
+
+    def prog(comm):
+        kv = comm_mod.create_keyval(copy_fn=comm_mod.dup_fn, name="tpu-kept")
+        comm.set_attr(kv, "x")
+        assert comm.dup().get_attr(kv) == "x"
+        return comm.allreduce(1)
+
+    res = mpi_tpu.run(prog, backend="tpu", nranks=None)
+    assert int(np.asarray(res)[0]) >= 1
+
+
+def test_attr_api_layer():
+    def prog(comm):
+        kv = api.MPI_Comm_create_keyval(copy_fn=api.MPI_COMM_DUP_FN)
+        api.MPI_Comm_set_attr(kv, {"cfg": 1}, comm=comm)
+        assert api.MPI_Comm_get_attr(kv, comm=comm) == {"cfg": 1}
+        api.MPI_Comm_delete_attr(kv, comm=comm)
+        assert api.MPI_Comm_get_attr(kv, comm=comm) is None
+        api.MPI_Comm_free_keyval(kv)
+
+    run_local(prog, 1)

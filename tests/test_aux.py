@@ -190,3 +190,50 @@ def test_verify_matching_wildcard_prefers_head_across_channels():
         [("recv", -1, 5), ("recv", 0, 3), ("recv", 0, 5)],
     ]
     assert checker.verify_matching(logs) == []
+
+
+# -- MPI_COMM_SELF + MPI_Get_count (round 3) --------------------------------
+
+
+def test_comm_self_is_size_one_and_cached():
+    import mpi_tpu
+    from mpi_tpu import api
+
+    s1 = api.MPI_COMM_SELF()
+    assert s1.size == 1 and s1.rank == 0
+    assert api.MPI_COMM_SELF() is s1
+    assert mpi_tpu.COMM_SELF is s1
+    # collectives are identities; p2p to self works
+    assert s1.allreduce(5) == 5
+    s1.send("x", dest=0, tag=3)
+    assert s1.recv(source=0, tag=3) == "x"
+
+
+def test_get_count_and_elements():
+    import numpy as np
+
+    from mpi_tpu import Status, api
+    from mpi_tpu import datatypes as dt
+    from mpi_tpu.transport.local import run_local
+
+    def prog(comm):
+        if comm.rank == 0:
+            comm.send(np.zeros(12, np.float64), dest=1)
+            comm.send({"opaque": True}, dest=1)
+            return None
+        st = Status()
+        comm.recv(source=0, status=st)
+        pair = dt.type_contiguous(2, np.float64).commit()
+        counts = (api.MPI_Get_count(st, np.float64),
+                  api.MPI_Get_count(st, pair),
+                  api.MPI_Get_count(st, np.float32),
+                  api.MPI_Get_elements(st, pair))
+        st2 = Status()
+        comm.recv(source=0, status=st2)
+        return counts, api.MPI_Get_count(st2, np.float64)
+
+    res = run_local(prog, 2)
+    (n64, npair, n32, nelem), opaque = res[1]
+    assert n64 == 12 and npair == 6 and nelem == 12
+    assert n32 == 24  # 96 bytes / 4
+    assert opaque is None  # pickled dict: MPI_UNDEFINED

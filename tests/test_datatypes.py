@@ -285,3 +285,53 @@ def test_typed_halo_exchange_subarray():
     assert np.all(res[0][:, n - 1] == 2.0)
     assert np.all(res[0][:, : n - 1] == 1.0)
     assert np.all(res[1][:, 0] == 1.0)
+
+
+def test_resized_lb_is_marker_not_shift():
+    """MPI_Type_create_resized leaves typemap displacements unchanged; lb
+    only affects the reported extent bookkeeping [S]."""
+    from mpi_tpu import api
+
+    t = dt.type_create_resized(np.int32, 1, 3).commit()
+    assert np.array_equal(t.pack(np.arange(4, dtype=np.int32)), [0])
+    assert api.MPI_Type_get_extent(t) == (4, 12)
+
+
+def test_tiled_overlap_rejected():
+    """Instances replicated at an extent inside the map's span would
+    overlap — order-dependent unpack must be rejected."""
+    t = dt.type_create_resized(dt.type_contiguous(2, np.int32), 0, 1).commit()
+    with pytest.raises(ValueError, match="overlap"):
+        t.unpack(np.arange(4, dtype=np.int32), np.zeros(3, np.int32), count=2)
+    with pytest.raises(ValueError, match="overlap"):
+        t.pack(np.zeros(8, np.int32), count=2)
+
+
+def test_errhandler_covers_typed_paths():
+    """Pack/unpack failures inside typed MPI_Send/MPI_Recv honor the
+    communicator's error handler; a custom handler's fallback is returned
+    as-is, never scattered into buf."""
+    from mpi_tpu import api, errors
+
+    def prog(comm):
+        comm.set_errhandler(errors.ERRORS_RETURN)
+        t = dt.type_contiguous(2, np.float64).commit()
+        if comm.rank == 0:
+            comm.send(np.arange(3.0), dest=1)
+            # pack error on send side returns a code too
+            code = api.MPI_Send(np.zeros(1), dest=1, comm=comm, datatype=t)
+            assert isinstance(code, errors.ErrorCode)
+            comm.send(np.arange(2.0), dest=1)  # keep rank 1's drain happy
+            return None
+        buf = np.zeros(2)
+        code = api.MPI_Recv(source=0, comm=comm, datatype=t, buf=buf)
+        assert isinstance(code, errors.ErrorCode)
+        assert code == errors.MPI_ERR_TRUNCATE
+        comm.set_errhandler(lambda c, e: "fallback")
+        assert api.MPI_Recv(source=77, comm=comm, datatype=t, buf=buf) \
+            == "fallback"
+        assert np.all(buf == 0)
+        comm.set_errhandler(errors.ERRORS_ARE_FATAL)
+        return comm.recv(source=0)
+
+    run_local(prog, 2)

@@ -1,0 +1,111 @@
+"""Error handling (mpi_tpu/errors.py): classification, ERRORS_RETURN at
+the MPI_* boundary, custom handlers, and the fatal default."""
+
+import numpy as np
+import pytest
+
+from mpi_tpu import api, errors
+from mpi_tpu.transport.base import RecvTimeout
+from mpi_tpu.transport.local import run_local
+
+
+# -- classification ---------------------------------------------------------
+
+
+def test_error_class_classification():
+    assert errors.error_class(ValueError("user tags must be >= 0")) == errors.MPI_ERR_TAG
+    assert errors.error_class(KeyError("rank 9 not in communicator")) == errors.MPI_ERR_RANK
+    assert errors.error_class(TypeError("buffer dtype float32 != datatype base float64")) \
+        == errors.MPI_ERR_TYPE
+    assert errors.error_class(RecvTimeout("no message")) == errors.MPI_ERR_PENDING
+    assert errors.error_class(OSError("broken pipe")) == errors.MPI_ERR_IO
+    assert errors.error_class(RuntimeError("boom")) == errors.MPI_ERR_OTHER
+    assert errors.error_class(ValueError("unknown allreduce algorithm 'x'")) \
+        == errors.MPI_ERR_OP
+
+
+def test_error_string():
+    assert errors.error_string(errors.MPI_SUCCESS) == "no error"
+    assert "rank" in errors.error_string(errors.MPI_ERR_RANK)
+    assert "invalid error class" in errors.error_string(999)
+
+
+def test_error_code_carries_exception():
+    exc = ValueError("bad tag -3")
+    code = errors.ErrorCode.from_exception(exc)
+    assert code == errors.MPI_ERR_TAG  # compares as int
+    assert code.exception is exc
+    assert errors.error_class(code) == errors.MPI_ERR_TAG
+
+
+# -- handler dispatch at the MPI_* boundary ---------------------------------
+
+
+def test_errors_are_fatal_default_raises():
+    def prog(comm):
+        assert comm.get_errhandler() is errors.ERRORS_ARE_FATAL
+        with pytest.raises(ValueError):
+            api.MPI_Send("x", dest=99, comm=comm)
+
+    run_local(prog, 2)
+
+
+def test_errors_return_yields_code():
+    def prog(comm):
+        comm.set_errhandler(errors.ERRORS_RETURN)
+        code = api.MPI_Send("x", dest=99, comm=comm)
+        assert isinstance(code, errors.ErrorCode)
+        assert code == errors.MPI_ERR_RANK
+        # a successful call is unaffected
+        assert api.MPI_Allreduce(1, comm=comm) == comm.size
+        # bad algorithm through a collective also returns, not raises
+        bad = api.MPI_Allreduce(1, algorithm="nope", comm=comm)
+        assert isinstance(bad, errors.ErrorCode)
+        comm.set_errhandler(errors.ERRORS_ARE_FATAL)
+
+    run_local(prog, 2)
+
+
+def test_custom_handler_called_with_comm_and_exc():
+    def prog(comm):
+        seen = {}
+
+        def handler(c, exc):
+            seen["comm"], seen["exc"] = c, exc
+            return "fallback"
+
+        comm.set_errhandler(handler)
+        out = api.MPI_Recv(source=42, comm=comm)
+        assert out == "fallback"
+        assert seen["comm"] is comm and isinstance(seen["exc"], Exception)
+
+    run_local(prog, 1)
+
+
+def test_errhandler_is_per_communicator():
+    def prog(comm):
+        dup = comm.dup()
+        dup.set_errhandler(errors.ERRORS_RETURN)
+        # dup returns a code; the original still raises
+        assert isinstance(api.MPI_Send("x", dest=99, comm=dup),
+                          errors.ErrorCode)
+        with pytest.raises(ValueError):
+            api.MPI_Send("x", dest=99, comm=comm)
+
+    run_local(prog, 2)
+
+
+def test_typed_recv_error_path_skips_unpack():
+    """Under ERRORS_RETURN a failed typed recv must return the code, not
+    try to unpack it into buf."""
+    from mpi_tpu import datatypes as dt
+
+    def prog(comm):
+        comm.set_errhandler(errors.ERRORS_RETURN)
+        t = dt.type_contiguous(2, np.float64).commit()
+        buf = np.zeros(2)
+        out = api.MPI_Recv(source=57, comm=comm, datatype=t, buf=buf)
+        assert isinstance(out, errors.ErrorCode)
+        assert np.all(buf == 0)
+
+    run_local(prog, 1)
