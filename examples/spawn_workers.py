@@ -1,0 +1,50 @@
+"""Dynamic process management demo: a master grows its own worker pool.
+
+The master (started alone) spawns a fresh 3-rank worker world at runtime
+(MPI_Comm_spawn), scatters work over the parent-child intercommunicator,
+and reduces the partial results — no launcher restart, the job resizes
+itself.  Run:
+
+    python -m mpi_tpu.launcher -n 1 examples/spawn_workers.py
+"""
+
+import os
+import sys
+
+if __name__ == "__main__":
+    sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import mpi_tpu
+from mpi_tpu import spawn
+
+NWORKERS = 3
+SAMPLES = 40_000
+
+if spawn.comm_get_parent() is None:
+    # ---- parent side (any -n: the spawn is collective, rank 0 masters) ----
+    comm = mpi_tpu.COMM_WORLD
+    inter = spawn.comm_spawn([os.path.abspath(__file__)], NWORKERS, comm=comm)
+    if comm.rank == 0:
+        for j in range(NWORKERS):
+            inter.send(("pi-samples", SAMPLES, j), dest=j)
+        hits, total = 0, 0
+        for j in range(NWORKERS):
+            h, n = inter.recv(source=j)
+            hits, total = hits + h, total + n
+        print(f"master: pi ~= {4.0 * hits / total:.6f} from {total} samples "
+              f"across {NWORKERS} spawned workers")
+    comm.barrier()  # workers answered before rank 0 releases the world
+    inter.free()
+else:
+    # ---- spawned worker side ----
+    import numpy as np
+
+    comm = mpi_tpu.COMM_WORLD          # the worker world
+    parent = spawn.comm_get_parent()
+    kind, n, seed = parent.recv(source=0)
+    assert kind == "pi-samples"
+    rng = np.random.default_rng(seed)
+    xy = rng.random((n, 2))
+    hits = int(((xy * xy).sum(axis=1) <= 1.0).sum())
+    comm.barrier()                     # worker-world collective works too
+    parent.send((hits, n), dest=0)

@@ -39,6 +39,7 @@ from .topology import (CartComm, GraphComm, cart_create,
                        dims_create, dist_graph_create_adjacent,
                        graph_create)
 from .group import Group
+from .spawn import comm_get_parent, comm_spawn, comm_spawn_multiple
 from .window import GetFuture, P2PWindow
 
 __all__ = [
@@ -51,6 +52,7 @@ __all__ = [
     "cart_create", "graph_create",
     "dist_graph_create_adjacent", "dims_create", "Group",
     "GetFuture", "P2PWindow",
+    "comm_spawn", "comm_spawn_multiple", "comm_get_parent",
 ]
 
 _ENV_RANK = "MPI_TPU_RANK"
@@ -155,22 +157,26 @@ def run(
     raise ValueError(f"unknown backend {backend!r}")
 
 
-_self_comm: Optional[P2PCommunicator] = None
+_self_store = threading.local()
 
 
 def comm_self() -> P2PCommunicator:
     """MPI_COMM_SELF [S]: the size-1 communicator containing only this
     process — independent of (and usable alongside) any world backend.
     Collectives on it are identities; it is the conventional home for
-    per-process libraries (e.g. opening an MPI-IO file privately)."""
-    global _self_comm
-    with _world_lock:
-        if _self_comm is None:
-            from .transport.local import LocalTransport, LocalWorld
+    per-process libraries (e.g. opening an MPI-IO file privately).
 
-            _self_comm = P2PCommunicator(LocalTransport(LocalWorld(1), 0),
-                                         range(1))
-        return _self_comm
+    Per-THREAD, not per-process: the local backend simulates ranks as
+    threads, and a process-global SELF would share one mailbox across
+    those ranks (self-sends could be stolen cross-rank).  For an ordinary
+    single-threaded rank process the two scopes coincide."""
+    comm = getattr(_self_store, "comm", None)
+    if comm is None:
+        from .transport.local import LocalTransport, LocalWorld
+
+        comm = P2PCommunicator(LocalTransport(LocalWorld(1), 0), range(1))
+        _self_store.comm = comm
+    return comm
 
 
 def __getattr__(name: str):

@@ -63,6 +63,7 @@ __all__ = [
     "MPI_Comm_create_keyval", "MPI_Comm_free_keyval", "MPI_COMM_DUP_FN",
     "MPI_COMM_NULL_COPY_FN", "MPI_NO_COPY", "Keyval",
     "MPI_Comm_set_attr", "MPI_Comm_get_attr", "MPI_Comm_delete_attr",
+    "MPI_Comm_spawn", "MPI_Comm_spawn_multiple", "MPI_Comm_get_parent",
     "ANY_SOURCE", "ANY_TAG", "SUM", "PROD", "MAX", "MIN",
     "LAND", "LOR", "LXOR", "BAND", "BOR", "BXOR", "Status",
 ]
@@ -198,7 +199,7 @@ def MPI_Comm_split(color: Optional[int], key: int = 0,
 
 
 def MPI_Comm_dup(comm: Optional[Communicator] = None) -> Communicator:
-    return _world(comm).dup()
+    return _call(comm, "dup")
 
 
 def MPI_Scatter(objs: Optional[Sequence[Any]], root: int = 0,
@@ -212,12 +213,12 @@ def MPI_Gather(obj: Any, root: int = 0, comm: Optional[Communicator] = None) -> 
 
 def MPI_Isend(obj: Any, dest: int, tag: int = 0,
               comm: Optional[Communicator] = None):
-    return _world(comm).isend(obj, dest, tag)
+    return _call(comm, "isend", obj, dest, tag)
 
 
 def MPI_Irecv(source: int = ANY_SOURCE, tag: int = ANY_TAG,
               comm: Optional[Communicator] = None):
-    return _world(comm).irecv(source, tag)
+    return _call(comm, "irecv", source, tag)
 
 
 def MPI_Wait(request) -> Any:
@@ -318,12 +319,12 @@ def MPI_Testany(requests):
 
 def MPI_Probe(source: int = ANY_SOURCE, tag: int = ANY_TAG,
               comm: Optional[Communicator] = None, status=None) -> None:
-    _world(comm).probe(source, tag, status)
+    return _call(comm, "probe", source, tag, status)
 
 
 def MPI_Iprobe(source: int = ANY_SOURCE, tag: int = ANY_TAG,
                comm: Optional[Communicator] = None, status=None) -> bool:
-    return _world(comm).iprobe(source, tag, status)
+    return _call(comm, "iprobe", source, tag, status)
 
 
 def MPI_Wtime() -> float:
@@ -334,47 +335,47 @@ def MPI_Wtime() -> float:
 
 def MPI_Scan(obj: Any, op: ops.ReduceOp = ops.SUM,
              comm: Optional[Communicator] = None) -> Any:
-    return _world(comm).scan(obj, op)
+    return _call(comm, "scan", obj, op)
 
 
 def MPI_Reduce_scatter(blocks: Any, op: ops.ReduceOp = ops.SUM,
                        comm: Optional[Communicator] = None) -> Any:
-    return _world(comm).reduce_scatter(blocks, op)
+    return _call(comm, "reduce_scatter", blocks, op)
 
 
 def MPI_Exscan(obj: Any, op: ops.ReduceOp = ops.SUM,
                comm: Optional[Communicator] = None) -> Any:
-    return _world(comm).exscan(obj, op)
+    return _call(comm, "exscan", obj, op)
 
 
 def MPI_Allgatherv(obj: Any, counts: Sequence[int],
                    comm: Optional[Communicator] = None) -> Any:
-    return _world(comm).allgatherv(obj, counts)
+    return _call(comm, "allgatherv", obj, counts)
 
 
 def MPI_Gatherv(obj: Any, counts: Sequence[int], root: int = 0,
                 comm: Optional[Communicator] = None) -> Any:
-    return _world(comm).gatherv(obj, counts, root)
+    return _call(comm, "gatherv", obj, counts, root)
 
 
 def MPI_Scatterv(obj: Any, counts: Sequence[int], root: int = 0,
                  comm: Optional[Communicator] = None) -> Any:
-    return _world(comm).scatterv(obj, counts, root)
+    return _call(comm, "scatterv", obj, counts, root)
 
 
 def MPI_Alltoallv(blocks: Any, counts: Sequence[Sequence[int]],
                   comm: Optional[Communicator] = None) -> Any:
-    return _world(comm).alltoallv(blocks, counts)
+    return _call(comm, "alltoallv", blocks, counts)
 
 
 def MPI_Maxloc(obj: Any, comm: Optional[Communicator] = None):
     """Allreduce with MPI_MAXLOC semantics: (max value, lowest rank with it)."""
-    return _world(comm).maxloc(obj)
+    return _call(comm, "maxloc", obj)
 
 
 def MPI_Minloc(obj: Any, comm: Optional[Communicator] = None):
     """Allreduce with MPI_MINLOC semantics: (min value, lowest rank with it)."""
-    return _world(comm).minloc(obj)
+    return _call(comm, "minloc", obj)
 
 
 def MPI_Cart_create(dims: Sequence[int], periods: Optional[Sequence[bool]] = None,
@@ -543,12 +544,12 @@ def MPI_Neighbor_alltoall(cart, objs: Sequence[Any], fill: Any = None):
 
 def MPI_Send_init(buf: Any, dest: int, tag: int = 0,
                   comm: Optional[Communicator] = None):
-    return _world(comm).send_init(buf, dest, tag)
+    return _call(comm, "send_init", buf, dest, tag)
 
 
 def MPI_Recv_init(source: int = ANY_SOURCE, tag: int = ANY_TAG,
                   buf: Any = None, comm: Optional[Communicator] = None):
-    return _world(comm).recv_init(source, tag, buf=buf)
+    return _call(comm, "recv_init", source, tag, buf=buf)
 
 
 def MPI_Start(request):
@@ -565,37 +566,37 @@ def MPI_Startall(requests: Sequence[Any]):
 
 
 def MPI_Ibcast(obj: Any, root: int = 0, comm: Optional[Communicator] = None):
-    return _world(comm).ibcast(obj, root)
+    return _call(comm, "ibcast", obj, root)
 
 
 def MPI_Ireduce(obj: Any, op=ops.SUM, root: int = 0,
                 comm: Optional[Communicator] = None):
-    return _world(comm).ireduce(obj, op, root)
+    return _call(comm, "ireduce", obj, op, root)
 
 
 def MPI_Iallreduce(obj: Any, op=ops.SUM, algorithm: str = "auto",
                    comm: Optional[Communicator] = None):
-    return _world(comm).iallreduce(obj, op, algorithm)
+    return _call(comm, "iallreduce", obj, op, algorithm)
 
 
 def MPI_Iallgather(obj: Any, comm: Optional[Communicator] = None):
-    return _world(comm).iallgather(obj)
+    return _call(comm, "iallgather", obj)
 
 
 def MPI_Ialltoall(objs: Sequence[Any], comm: Optional[Communicator] = None):
-    return _world(comm).ialltoall(objs)
+    return _call(comm, "ialltoall", objs)
 
 
 def MPI_Ibarrier(comm: Optional[Communicator] = None):
-    return _world(comm).ibarrier()
+    return _call(comm, "ibarrier")
 
 
 def MPI_Iscatter(objs, root: int = 0, comm: Optional[Communicator] = None):
-    return _world(comm).iscatter(objs, root)
+    return _call(comm, "iscatter", objs, root)
 
 
 def MPI_Igather(obj: Any, root: int = 0, comm: Optional[Communicator] = None):
-    return _world(comm).igather(obj, root)
+    return _call(comm, "igather", obj, root)
 
 
 # -- environment inquiry & abort -------------------------------------------
@@ -645,7 +646,7 @@ def MPI_Sendrecv_replace(obj: Any, dest: int, source: int = ANY_SOURCE,
                          comm: Optional[Communicator] = None):
     """MPI_Sendrecv_replace [S]: same buffer for send and receive — in this
     library's value semantics, simply returns the received payload."""
-    return _world(comm).sendrecv(obj, dest, source, sendtag, recvtag)
+    return _call(comm, "sendrecv", obj, dest, source, sendtag, recvtag)
 
 
 # -- derived datatypes (MPI-1 ch.3; mpi_tpu/datatypes.py) -------------------
@@ -765,3 +766,28 @@ def MPI_Comm_get_attr(keyval, comm: Optional[Communicator] = None):
 
 def MPI_Comm_delete_attr(keyval, comm: Optional[Communicator] = None) -> None:
     _world(comm).delete_attr(keyval)
+
+
+# -- dynamic process management (MPI-2 ch.5; mpi_tpu/spawn.py) --------------
+
+
+def MPI_Comm_spawn(command: Sequence[str], maxprocs: int, root: int = 0,
+                   comm: Optional[Communicator] = None):
+    """Spawn ``maxprocs`` ranks of ``python command...`` as a new world;
+    returns the parent-child intercommunicator."""
+    from .spawn import comm_spawn
+
+    return comm_spawn(command, maxprocs, comm, root)
+
+
+def MPI_Comm_spawn_multiple(segments, root: int = 0,
+                            comm: Optional[Communicator] = None):
+    from .spawn import comm_spawn_multiple
+
+    return comm_spawn_multiple(segments, comm, root)
+
+
+def MPI_Comm_get_parent():
+    from .spawn import comm_get_parent
+
+    return comm_get_parent()

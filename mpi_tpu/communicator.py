@@ -356,13 +356,22 @@ class Communicator(ABC):
                 keyval.delete_fn(self, value)
 
     def _copy_attrs_to(self, new: "Communicator") -> "Communicator":
-        """Dup-time attribute propagation per MPI copy-callback semantics."""
+        """Dup-time attribute propagation per MPI copy-callback semantics
+        (+ error-handler inheritance, which dup also owes)."""
         for keyval, value in self.__dict__.get("_attrs", {}).items():
             if keyval.copy_fn is None:
                 continue
             copied = keyval.copy_fn(self, value)
             if copied is not NO_COPY:
                 new.set_attr(keyval, copied)
+        return self._inherit_errhandler(new)
+
+    def _inherit_errhandler(self, new: "Communicator") -> "Communicator":
+        """MPI: a newly created communicator inherits the parent's error
+        handler [S, MPI-3.1 §8.3] — dup AND split/create (attributes, by
+        contrast, propagate only through dup's copy callbacks)."""
+        if "_errhandler" in self.__dict__:
+            new._errhandler = self._errhandler
         return new
 
     # -- error handling (MPI-1 §7; mpi_tpu/errors.py) ----------------------
@@ -1069,7 +1078,9 @@ class P2PCommunicator(Communicator):
             (k, cr) for cr, (c, k) in enumerate(infos) if c == color
         )
         group = [self._group[cr] for _, cr in members]
-        return P2PCommunicator(self._t, group, ctx, recv_timeout=self.recv_timeout)
+        return self._inherit_errhandler(
+            P2PCommunicator(self._t, group, ctx,
+                            recv_timeout=self.recv_timeout))
 
     def dup(self) -> "P2PCommunicator":
         self.barrier()  # collectiveness check + sync, like MPI_Comm_dup

@@ -124,17 +124,21 @@ class Datatype:
                             f"{self.base_dtype}")
         return a.reshape(-1)
 
-    def _checked_indices(self, count: int, limit: int) -> np.ndarray:
+    def _checked_indices(self, count: int, limit: int,
+                         writeback: bool = False) -> np.ndarray:
         idx = self._tiled(count)
         if idx.size and int(idx.min()) < 0:
             raise ValueError("datatype has negative element displacements")
         if idx.size and int(idx.max()) >= limit:
             raise ValueError(f"datatype touches element {int(idx.max())} but "
                              f"buffer has {limit}")
-        if count > 1 and self.indices.size and \
+        if writeback and count > 1 and self.indices.size and \
                 self.extent <= int(self.indices.max()):
-            # instances can interleave only when the extent is inside the
-            # map's span — only then pay for the uniqueness check
+            # RECEIVE side only: MPI permits overlapping send typemaps
+            # (reading an element twice is well-defined); an overlapping
+            # unpack would be order-dependent.  Instances can interleave
+            # only when the extent is inside the map's span — only then
+            # pay for the uniqueness check.
             if np.unique(idx).size != idx.size:
                 raise ValueError(
                     f"replicating {count} instances at extent {self.extent} "
@@ -153,7 +157,7 @@ class Datatype:
     def unpack(self, packed: Any, out: np.ndarray, count: int = 1) -> np.ndarray:
         """Scatter a contiguous ``packed`` array into ``out`` in-place."""
         flat = self._flat_view(out, writeback=True)
-        idx = self._checked_indices(count, flat.size)
+        idx = self._checked_indices(count, flat.size, writeback=True)
         data = np.asarray(packed).reshape(-1)
         if data.dtype != self.base_dtype:
             raise TypeError(f"packed payload dtype {data.dtype} != datatype "
@@ -180,7 +184,7 @@ class Datatype:
         import jax.numpy as jnp
 
         o = jnp.asarray(out)
-        idx = self._checked_indices(count, o.size)  # static: checked at trace
+        idx = self._checked_indices(count, o.size, writeback=True)  # static
         flat = o.reshape(-1).at[idx].set(jnp.asarray(packed).reshape(-1))
         return flat.reshape(o.shape)
 

@@ -91,6 +91,7 @@ class InterComm:
             # st.source is a union-comm rank; report the REMOTE-group rank
             status.tag = st.tag
             status.source = self._remote.index(st.source)
+            status.count_bytes = st.count_bytes
         return obj
 
     def isend(self, obj: Any, dest: int, tag: int = 0):

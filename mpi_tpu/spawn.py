@@ -1,0 +1,157 @@
+"""Dynamic process management — MPI_Comm_spawn [S: MPI-2 ch.5].
+
+Parents collectively spawn a NEW world of child rank processes and get an
+:class:`~mpi_tpu.intercomm.InterComm` to it; children find their side with
+:func:`comm_get_parent`.  The classic master/worker elasticity primitive:
+a running job grows itself without restarting the launcher.
+
+Wiring (all file-rendezvous TCP, like the launcher's worlds):
+
+* the CHILD WORLD is an ordinary socket world of ``maxprocs`` ranks over a
+  fresh rendezvous dir — children just call ``mpi_tpu.init()`` (or touch
+  ``COMM_WORLD``) exactly like launcher-started programs;
+* the PARENT-CHILD BRIDGE is a second socket transport over its own
+  rendezvous dir spanning P parents + C children: parents take bridge
+  ranks 0..P-1 (their ``comm`` rank order), children P..P+C-1.  Rank
+  discovery is lazy (port files + polling), so parents can build their
+  bridge endpoint before any child has started.
+
+The spawning communicator can be any process-backend comm (world or a
+split subset) — the bridge binds to ITS members.  SPMD communicators
+cannot spawn OS processes; the diagnostic points to the launcher.
+"""
+
+from __future__ import annotations
+
+import atexit
+import os
+import shutil
+import subprocess
+import sys
+import tempfile
+from typing import Any, List, Optional, Sequence, Tuple
+
+from .communicator import Communicator, P2PCommunicator
+from .intercomm import InterComm
+
+ENV_PARENT_RDV = "MPI_TPU_PARENT_RDV"
+ENV_PARENT_SIZE = "MPI_TPU_PARENT_SIZE"
+ENV_PARENT_TOTAL = "MPI_TPU_PARENT_TOTAL"
+
+# Popen handles of everything this process spawned: children are
+# independent jobs (MPI semantics: spawn does not wait), but keeping the
+# handles lets atexit reap finished ones instead of leaving zombies.
+_spawned: List[subprocess.Popen] = []
+_tmpdirs: List[str] = []
+_parent_intercomm: Optional[InterComm] = None
+
+
+def _cleanup() -> None:  # pragma: no cover - exit path
+    for p in _spawned:
+        p.poll()
+    for d in _tmpdirs:
+        shutil.rmtree(d, ignore_errors=True)
+
+
+atexit.register(_cleanup)
+
+
+def _bridge_comm(bridge_rank: int, total: int, rdv: str) -> P2PCommunicator:
+    from .transport.socket import SocketTransport
+
+    t = SocketTransport(bridge_rank, total, rdv)
+    return P2PCommunicator(t, range(total))
+
+
+def comm_spawn(argv: Sequence[str], maxprocs: int,
+               comm: Optional[Communicator] = None, root: int = 0,
+               env_extra: Optional[dict] = None) -> InterComm:
+    """MPI_Comm_spawn: start ``maxprocs`` ranks of ``python argv...`` as a
+    new world; returns the parent side of the parent-child intercomm.
+    Collective over ``comm`` (default: this process's world); only
+    ``root`` actually forks the children."""
+    segments = [(list(argv), int(maxprocs))]
+    return _spawn_segments(segments, comm, root, env_extra)
+
+
+def comm_spawn_multiple(segments: Sequence[Tuple[Sequence[str], int]],
+                        comm: Optional[Communicator] = None, root: int = 0,
+                        env_extra: Optional[dict] = None) -> InterComm:
+    """MPI_Comm_spawn_multiple: one child WORLD running different
+    executables — ``segments`` is [(argv, maxprocs), ...]; child ranks are
+    assigned segment by segment, in order [S]."""
+    segs = [(list(a), int(n)) for a, n in segments]
+    return _spawn_segments(segs, comm, root, env_extra)
+
+
+def _spawn_segments(segments: List[Tuple[List[str], int]],
+                    comm: Optional[Communicator], root: int,
+                    env_extra: Optional[dict]) -> InterComm:
+    if comm is None:
+        from . import init
+
+        comm = init()
+    if not isinstance(comm, P2PCommunicator):
+        raise NotImplementedError(
+            "comm_spawn forks OS processes — a process-backend feature; "
+            "an SPMD program's world is a device mesh, not a process pool "
+            "(start more ranks with mpi_tpu.launcher instead)")
+    nchildren = sum(n for _, n in segments)
+    if nchildren < 1:
+        raise ValueError("maxprocs must total >= 1")
+    p = comm.size
+    total = p + nchildren
+    # root makes the rendezvous dirs; everyone learns them collectively
+    if comm.rank == root:
+        bridge_rdv = tempfile.mkdtemp(prefix="mpi_tpu_spawn_bridge_")
+        child_rdv = tempfile.mkdtemp(prefix="mpi_tpu_spawn_world_")
+        _tmpdirs.extend([bridge_rdv, child_rdv])
+        dirs = (bridge_rdv, child_rdv)
+    else:
+        dirs = None
+    bridge_rdv, child_rdv = comm.bcast(dirs, root)
+    # every parent opens its bridge endpoint BEFORE children are forked:
+    # port files are published immediately, connections form lazily
+    union = _bridge_comm(comm.rank, total, bridge_rdv)
+    if comm.rank == root:
+        from .launcher import ENV_BACKEND, ENV_RANK, ENV_RDV, ENV_SIZE
+
+        child_rank = 0
+        for argv, n in segments:
+            for _ in range(n):
+                env = dict(os.environ)
+                env.update({
+                    ENV_RANK: str(child_rank),
+                    ENV_SIZE: str(nchildren),
+                    ENV_RDV: child_rdv,
+                    ENV_BACKEND: "socket",
+                    ENV_PARENT_RDV: bridge_rdv,
+                    ENV_PARENT_SIZE: str(p),
+                    ENV_PARENT_TOTAL: str(total),
+                })
+                if env_extra:
+                    env.update(env_extra)
+                _spawned.append(
+                    subprocess.Popen([sys.executable, *argv], env=env))
+                child_rank += 1
+    return InterComm(union, list(range(p)), list(range(p, total)))
+
+
+def comm_get_parent() -> Optional[InterComm]:
+    """MPI_Comm_get_parent: in a spawned child, the intercomm to the
+    spawning parents (cached); None in a world that was not spawned."""
+    global _parent_intercomm
+    if _parent_intercomm is not None:
+        return _parent_intercomm
+    rdv = os.environ.get(ENV_PARENT_RDV)
+    if rdv is None:
+        return None
+    from . import init
+
+    world = init()  # my child world: rank/size from the launcher-style env
+    psize = int(os.environ[ENV_PARENT_SIZE])
+    total = int(os.environ[ENV_PARENT_TOTAL])
+    union = _bridge_comm(psize + world.rank, total, rdv)
+    _parent_intercomm = InterComm(union, list(range(psize, total)),
+                                  list(range(psize)))
+    return _parent_intercomm

@@ -709,7 +709,8 @@ class TpuCommunicator(Communicator):
                 buckets.setdefault(colors[world], []).append((keys[world], pos, world))
             for c in sorted(buckets):
                 new_groups.append([w for _, _, w in sorted(buckets[c])])
-        return TpuCommunicator(self.axis_name, self.mesh, new_groups)
+        return self._inherit_errhandler(
+            TpuCommunicator(self.axis_name, self.mesh, new_groups))
 
     def split_by(self, color_fn, key_fn=None) -> "TpuCommunicator":
         """split_all with functions of the world axis index."""

@@ -297,14 +297,15 @@ def test_resized_lb_is_marker_not_shift():
     assert api.MPI_Type_get_extent(t) == (4, 12)
 
 
-def test_tiled_overlap_rejected():
-    """Instances replicated at an extent inside the map's span would
-    overlap — order-dependent unpack must be rejected."""
+def test_tiled_overlap_rejected_on_unpack_only():
+    """Instances replicated at an extent inside the map's span overlap:
+    order-dependent UNPACK must be rejected, while the overlapping SEND
+    typemap stays legal (MPI permits reading an element twice)."""
     t = dt.type_create_resized(dt.type_contiguous(2, np.int32), 0, 1).commit()
     with pytest.raises(ValueError, match="overlap"):
         t.unpack(np.arange(4, dtype=np.int32), np.zeros(3, np.int32), count=2)
-    with pytest.raises(ValueError, match="overlap"):
-        t.pack(np.zeros(8, np.int32), count=2)
+    packed = t.pack(np.arange(8, dtype=np.int32), count=2)
+    assert np.array_equal(packed, [0, 1, 1, 2])  # element 1 read twice: fine
 
 
 def test_errhandler_covers_typed_paths():

@@ -109,3 +109,48 @@ def test_typed_recv_error_path_skips_unpack():
         assert np.all(buf == 0)
 
     run_local(prog, 1)
+
+
+def test_errhandler_inherited_by_dup_and_split():
+    """MPI-3.1 §8.3: new communicators inherit the parent's handler."""
+    def prog(comm):
+        comm.set_errhandler(errors.ERRORS_RETURN)
+        d = comm.dup()
+        s = comm.split(0)
+        for c in (d, s):
+            assert isinstance(api.MPI_Send("x", dest=99, comm=c),
+                              errors.ErrorCode)
+        comm.set_errhandler(errors.ERRORS_ARE_FATAL)
+
+    run_local(prog, 2)
+
+
+def test_errhandler_covers_v_variants_and_probe():
+    """The whole flat layer honors ERRORS_RETURN, not just the first
+    dozen calls (round-3 review finding)."""
+    def prog(comm):
+        comm.set_errhandler(errors.ERRORS_RETURN)
+        assert isinstance(api.MPI_Scatterv(np.zeros(4), [2, 2], root=99,
+                                           comm=comm), errors.ErrorCode)
+        assert isinstance(api.MPI_Sendrecv_replace("x", dest=99, comm=comm),
+                          errors.ErrorCode)
+        assert isinstance(api.MPI_Isend("x", dest=99, comm=comm),
+                          errors.ErrorCode)
+        comm.set_errhandler(errors.ERRORS_ARE_FATAL)
+
+    run_local(prog, 2)
+
+
+def test_comm_self_is_per_thread_in_local_ranks():
+    """Thread-simulated ranks must not share one SELF mailbox (review
+    finding: cross-rank self-send theft)."""
+    def prog(comm):
+        s = api.MPI_COMM_SELF()
+        s.send(("mine", comm.rank), dest=0, tag=1)
+        comm.barrier()  # both ranks' self-sends are in flight here
+        got = s.recv(source=0, tag=1)
+        assert got == ("mine", comm.rank)
+        return id(s)
+
+    ids = run_local(prog, 2)
+    assert ids[0] != ids[1]

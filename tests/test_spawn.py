@@ -1,0 +1,114 @@
+"""Dynamic process management (mpi_tpu/spawn.py): comm_spawn children get
+a working world of their own plus the parent-child intercomm."""
+
+import os
+import sys
+import textwrap
+
+import pytest
+
+import mpi_tpu
+from mpi_tpu import spawn
+from mpi_tpu.transport.local import run_local
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+WORKER = textwrap.dedent("""\
+    import sys
+    sys.path.insert(0, {repo!r})
+    import mpi_tpu
+    from mpi_tpu import spawn
+
+    comm = mpi_tpu.COMM_WORLD          # the CHILD world
+    parent = spawn.comm_get_parent()
+    assert parent is not None and parent.is_inter
+    assert spawn.comm_get_parent() is parent  # cached
+    assert parent.remote_size == {nparents}
+    assert parent.size == comm.size
+    x = parent.recv(source=0)          # work item from parent rank 0
+    total = comm.allreduce(x + comm.rank)   # child-world collective works
+    if comm.rank == 0:
+        parent.send(("result", total), dest=0)
+    """)
+
+
+def _worker_script(tmp_path, nparents: int) -> str:
+    path = tmp_path / "spawn_worker.py"
+    path.write_text(WORKER.format(repo=REPO, nparents=nparents))
+    return str(path)
+
+
+def test_spawn_from_standalone_parent(tmp_path):
+    script = _worker_script(tmp_path, nparents=1)
+    parent = mpi_tpu.comm_self()
+    inter = spawn.comm_spawn([script], 2, comm=parent)
+    assert inter.remote_size == 2 and inter.size == 1
+    for j in range(2):
+        inter.send(10, dest=j)
+    kind, total = inter.recv(source=0)
+    # children allreduce (10 + rank) over their 2-rank world: 10+0 + 10+1
+    assert (kind, total) == ("result", 21)
+    inter.free()
+
+
+def test_spawn_from_multirank_parent(tmp_path):
+    """Two in-process parent ranks spawn one shared child world; child
+    bridge addressing reaches the right parent."""
+    script = _worker_script(tmp_path, nparents=2)
+
+    def prog(comm):
+        inter = spawn.comm_spawn([script], 2, comm=comm, root=0)
+        assert inter.remote_size == 2 and inter.size == 2
+        if comm.rank == 0:
+            inter.send(5, dest=0)
+            inter.send(5, dest=1)
+            out = inter.recv(source=0)
+        else:
+            out = None
+        comm.barrier()
+        inter.free()
+        return out
+
+    res = run_local(prog, 2)
+    assert res[0] == ("result", 11)  # (5+0) + (5+1)
+
+
+def test_spawn_multiple_segments(tmp_path):
+    """spawn_multiple: two different scripts share ONE child world with
+    segment-ordered ranks."""
+    a = tmp_path / "seg_a.py"
+    b = tmp_path / "seg_b.py"
+    common = textwrap.dedent(f"""\
+        import sys
+        sys.path.insert(0, {REPO!r})
+        import mpi_tpu
+        from mpi_tpu import spawn
+        comm = mpi_tpu.COMM_WORLD
+        parent = spawn.comm_get_parent()
+        """)
+    a.write_text(common + textwrap.dedent("""\
+        roles = comm.allgather("a")
+        if comm.rank == 0:
+            parent.send(roles, dest=0)
+        """))
+    b.write_text(common + 'comm.allgather("b")\n')
+    parent = mpi_tpu.comm_self()
+    inter = spawn.comm_spawn_multiple([([str(a)], 1), ([str(b)], 2)],
+                                      comm=parent)
+    assert inter.remote_size == 3
+    roles = inter.recv(source=0)
+    assert roles == ["a", "b", "b"]
+    inter.free()
+
+
+def test_spawn_rejects_spmd_comm():
+    def prog(comm):
+        with pytest.raises(NotImplementedError, match="launcher"):
+            spawn.comm_spawn(["x.py"], 1, comm=comm)
+        return 0
+
+    mpi_tpu.run(prog, backend="tpu", nranks=None)
+
+
+def test_get_parent_none_when_not_spawned():
+    assert spawn.comm_get_parent() is None
