@@ -64,6 +64,17 @@ __all__ = [
     "MPI_COMM_NULL_COPY_FN", "MPI_NO_COPY", "Keyval",
     "MPI_Comm_set_attr", "MPI_Comm_get_attr", "MPI_Comm_delete_attr",
     "MPI_Comm_spawn", "MPI_Comm_spawn_multiple", "MPI_Comm_get_parent",
+    "MPI_File_open", "MPI_File_close", "MPI_File_delete",
+    "MPI_File_read_at", "MPI_File_write_at",
+    "MPI_File_read_at_all", "MPI_File_write_at_all",
+    "MPI_File_seek", "MPI_File_get_position", "MPI_File_read", "MPI_File_write",
+    "MPI_File_read_shared", "MPI_File_write_shared", "MPI_File_seek_shared",
+    "MPI_File_set_view", "MPI_File_get_view",
+    "MPI_File_get_size", "MPI_File_set_size", "MPI_File_preallocate",
+    "MPI_File_sync",
+    "MPI_MODE_RDONLY", "MPI_MODE_WRONLY", "MPI_MODE_RDWR", "MPI_MODE_CREATE",
+    "MPI_MODE_EXCL", "MPI_MODE_APPEND", "MPI_MODE_DELETE_ON_CLOSE",
+    "MPI_SEEK_SET", "MPI_SEEK_CUR", "MPI_SEEK_END",
     "ANY_SOURCE", "ANY_TAG", "SUM", "PROD", "MAX", "MIN",
     "LAND", "LOR", "LXOR", "BAND", "BOR", "BXOR", "Status",
 ]
@@ -791,3 +802,99 @@ def MPI_Comm_get_parent():
     from .spawn import comm_get_parent
 
     return comm_get_parent()
+
+
+# -- MPI-IO (MPI-2 ch.9; mpi_tpu/io.py) -------------------------------------
+
+from . import io as _io  # noqa: E402 - grouped with its API block
+
+MPI_MODE_RDONLY = _io.MODE_RDONLY
+MPI_MODE_WRONLY = _io.MODE_WRONLY
+MPI_MODE_RDWR = _io.MODE_RDWR
+MPI_MODE_CREATE = _io.MODE_CREATE
+MPI_MODE_EXCL = _io.MODE_EXCL
+MPI_MODE_APPEND = _io.MODE_APPEND
+MPI_MODE_DELETE_ON_CLOSE = _io.MODE_DELETE_ON_CLOSE
+MPI_SEEK_SET, MPI_SEEK_CUR, MPI_SEEK_END = _io.SEEK_SET, _io.SEEK_CUR, _io.SEEK_END
+MPI_File_delete = _io.file_delete
+
+
+def MPI_File_open(path: str, amode: int = _io.MODE_RDWR,
+                  comm: Optional[Communicator] = None,
+                  shared: bool = False) -> "_io.File":
+    return _io.file_open(_world(comm), path, amode, shared)
+
+
+def MPI_File_close(fh: "_io.File") -> None:
+    fh.close()
+
+
+def MPI_File_read_at(fh, offset: int, count: int):
+    return fh.read_at(offset, count)
+
+
+def MPI_File_write_at(fh, offset: int, data: Any) -> int:
+    return fh.write_at(offset, data)
+
+
+def MPI_File_read_at_all(fh, offset: int, count: int):
+    return fh.read_at_all(offset, count)
+
+
+def MPI_File_write_at_all(fh, offset: int, data: Any) -> int:
+    return fh.write_at_all(offset, data)
+
+
+def MPI_File_seek(fh, offset: int, whence: int = _io.SEEK_SET) -> None:
+    fh.seek(offset, whence)
+
+
+def MPI_File_get_position(fh) -> int:
+    return fh.get_position()
+
+
+def MPI_File_read(fh, count: int):
+    return fh.read(count)
+
+
+def MPI_File_write(fh, data: Any) -> int:
+    return fh.write(data)
+
+
+def MPI_File_read_shared(fh, count: int):
+    return fh.read_shared(count)
+
+
+def MPI_File_write_shared(fh, data: Any) -> int:
+    return fh.write_shared(data)
+
+
+def MPI_File_seek_shared(fh, offset: int) -> None:
+    fh.seek_shared(offset)
+
+
+def MPI_File_set_view(fh, disp: int = 0, etype: Any = None,
+                      filetype=None) -> None:
+    import numpy as _np
+
+    fh.set_view(disp, etype if etype is not None else _np.uint8, filetype)
+
+
+def MPI_File_get_view(fh):
+    return fh.get_view()
+
+
+def MPI_File_get_size(fh) -> int:
+    return fh.get_size()
+
+
+def MPI_File_set_size(fh, size: int) -> None:
+    fh.set_size(size)
+
+
+def MPI_File_preallocate(fh, size: int) -> None:
+    fh.preallocate(size)
+
+
+def MPI_File_sync(fh) -> None:
+    fh.sync()

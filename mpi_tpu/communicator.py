@@ -1138,7 +1138,13 @@ class P2PCommunicator(Communicator):
         return _ThreadRequest(lambda: c.gather(obj, root))
 
     def free(self) -> None:
-        pass
+        """Sub-communicators share the world transport: no-op.  A comm
+        flagged as OWNING its transport (the spawn bridge, which has a
+        dedicated socket world) closes it — otherwise every comm_spawn
+        would leak a listener fd + reader threads."""
+        if getattr(self, "_owns_transport", False):
+            self._owns_transport = False
+            self.close_transport()
 
     def close_transport(self) -> List[Tuple[int, int, int]]:
         """Finalize-time shutdown: returns any unexpected pending messages

@@ -1,0 +1,402 @@
+"""MPI-IO — parallel file I/O (MPI-2 ch.9 [S]).
+
+The reference library (SURVEY.md §0: MPI-1-level, no I/O chapter in
+evidence) owes none of this; it is a beyond-parity subsystem completing
+the MPI-2 surface.  Scope and design:
+
+* **Explicit offsets** (``read_at``/``write_at``) are independent
+  ``os.pread``/``os.pwrite`` on a per-rank fd — offsets are in *etype*
+  units within the current **file view**.
+* **File views** (``set_view``) reuse mpi_tpu/datatypes.py: the filetype's
+  committed index map IS the view — visible element ``i`` lands at file
+  element ``indices[i % k] + (i // k) * extent`` (k = map size), and runs
+  of consecutive file bytes are coalesced before hitting the OS, so a
+  strided view costs one syscall per contiguous run, not per element.
+* **Individual file pointers** (``seek``/``read``/``write``) are plain
+  per-rank state.
+* **Shared file pointers** (``read_shared``/``write_shared``) are a
+  fetch-and-add on a passive-target RMA window hosted at rank 0
+  (mpi_tpu/window.py lock/unlock gives the atomicity) — the MPI-IO
+  shared pointer is exactly a distributed counter.
+* **Collective I/O** (``write_at_all``/``read_at_all``) implements
+  two-phase collective buffering for writes: when the epoch's total
+  payload is small enough to ship, ranks send their (byte-run, data)
+  lists to an aggregator that applies them as one sorted sweep — the
+  ROMIO optimization that turns P interleaved strided writes into a
+  sequential pass; large payloads fall back to independent writes
+  inside the same barrier bracket.
+
+Process backends only (the fd and the window server live on ranks); for
+sharded device arrays use mpi_tpu.checkpoint (orbax) — that is the
+TPU-native bulk-I/O path.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, List, Optional, Tuple
+
+import numpy as np
+
+from .communicator import Communicator, P2PCommunicator
+from .datatypes import Datatype
+
+__all__ = [
+    "File", "file_open",
+    "MODE_RDONLY", "MODE_WRONLY", "MODE_RDWR", "MODE_CREATE", "MODE_EXCL",
+    "MODE_APPEND", "MODE_DELETE_ON_CLOSE",
+    "SEEK_SET", "SEEK_CUR", "SEEK_END",
+]
+
+MODE_RDONLY = 1
+MODE_WRONLY = 2
+MODE_RDWR = 4
+MODE_CREATE = 8
+MODE_EXCL = 16
+MODE_APPEND = 32
+MODE_DELETE_ON_CLOSE = 64
+
+SEEK_SET, SEEK_CUR, SEEK_END = 0, 1, 2
+
+_TAG_TWOPHASE = -30  # internal tag (negative: invisible to user wildcards)
+
+# write_at_all ships runs to the aggregator only below this total;
+# above it, shipping costs more than it saves and ranks write directly.
+_COLLECTIVE_BUFFER_LIMIT = 8 << 20
+
+
+class File:
+    """An open parallel file (MPI_File).  Construct via :func:`file_open`."""
+
+    def __init__(self, comm: Communicator, path: str, amode: int):
+        if not isinstance(comm, P2PCommunicator):
+            raise NotImplementedError(
+                "MPI-IO files live on process ranks (fds + window server); "
+                "open with a process-backend comm (COMM_WORLD under the "
+                "launcher, or COMM_SELF for private files).  For sharded "
+                "device arrays use mpi_tpu.checkpoint (orbax).")
+        if not (amode & (MODE_RDONLY | MODE_WRONLY | MODE_RDWR)):
+            raise ValueError("amode needs one of MODE_RDONLY/WRONLY/RDWR")
+        self._comm = comm
+        self._path = path
+        self._amode = amode
+        # collective create/truncate decisions happen once, at rank 0;
+        # the OUTCOME is broadcast so a failure raises on every rank
+        # instead of deadlocking peers in the barrier
+        err: Optional[str] = None
+        if comm.rank == 0:
+            try:
+                if amode & MODE_CREATE:
+                    flags = os.O_CREAT | (os.O_EXCL if amode & MODE_EXCL else 0)
+                    fd = os.open(path, flags | os.O_RDWR, 0o644)
+                    os.close(fd)
+                elif not os.path.exists(path):
+                    raise OSError(f"file {path!r} does not exist "
+                                  "(open without MODE_CREATE)")
+            except OSError as e:
+                err = f"{type(e).__name__}: {e}"
+        err = comm.bcast(err, 0)
+        if err is not None:
+            raise OSError(f"collective open failed at rank 0: {err}")
+        oflag = (os.O_RDONLY if amode & MODE_RDONLY and
+                 not (amode & (MODE_WRONLY | MODE_RDWR)) else os.O_RDWR)
+        self._fd = os.open(path, oflag)
+        # the view: displacement (bytes) + etype + optional filetype map
+        self._disp = 0
+        self._etype = np.dtype(np.uint8)
+        self._filetype: Optional[Datatype] = None
+        self._pos = 0            # individual pointer, etype units in view
+        self._shared_win = None  # lazy: passive-target counter at rank 0
+        self._open = True
+        if amode & MODE_APPEND:
+            self._pos = self._visible_end()
+
+    # -- views -------------------------------------------------------------
+
+    def set_view(self, disp: int = 0, etype: Any = np.uint8,
+                 filetype: Optional[Datatype] = None) -> None:
+        """MPI_File_set_view: offsets become etype-relative, the filetype's
+        index map selects which file elements this rank sees.  Collective
+        (each rank passes its OWN view — that is the point: disjoint
+        filetypes partition the file)."""
+        et = np.dtype(etype)
+        if filetype is not None:
+            if filetype.base_dtype != et and filetype.base_dtype != np.uint8:
+                raise ValueError(
+                    f"filetype base {filetype.base_dtype} != etype {et}")
+            filetype.commit()  # no overlap within one instance
+            if filetype.indices.size and \
+                    filetype.extent <= int(filetype.indices.max()):
+                # the view tiles the map indefinitely: adjacent instances
+                # must not interleave onto the same file elements either
+                # (a write through such a view silently drops data)
+                two = np.concatenate([filetype.indices,
+                                      filetype.indices + filetype.extent])
+                if np.unique(two).size != two.size:
+                    raise ValueError(
+                        "filetype instances overlap when tiled (extent "
+                        f"{filetype.extent} is inside the map's span) — "
+                        "writes through this view would silently collide")
+        self._disp = int(disp)
+        self._etype = et
+        self._filetype = filetype
+        self._pos = 0
+        self._comm.barrier()
+
+    def get_view(self):
+        return (self._disp, self._etype, self._filetype)
+
+    # -- offset translation ------------------------------------------------
+
+    def _byte_runs(self, offset: int, nelems: int) -> List[Tuple[int, int]]:
+        """Visible [offset, offset+nelems) etype elements → coalesced
+        (file_byte_offset, nbytes) runs."""
+        es = self._etype.itemsize
+        if nelems <= 0:
+            return []
+        if self._filetype is None:
+            return [(self._disp + offset * es, nelems * es)]
+        ft = self._filetype
+        k = ft.indices.size
+        if k == 0:
+            raise ValueError("filetype selects zero elements")
+        i = np.arange(offset, offset + nelems, dtype=np.int64)
+        file_elems = ft.indices[i % k] + (i // k) * ft.extent
+        if ft.base_dtype == np.uint8 and es != 1:
+            raise ValueError("byte-based filetype with non-byte etype is "
+                             "ambiguous; build the filetype over the etype")
+        starts = self._disp + file_elems * es
+        # coalesce consecutive elements into runs (vectorized: a run break
+        # is wherever the gap between neighbors is not exactly one element)
+        breaks = np.flatnonzero(np.diff(starts) != es)
+        run_starts = starts[np.concatenate(([0], breaks + 1))]
+        counts = np.diff(np.concatenate(([0], breaks + 1, [starts.size])))
+        return [(int(s), int(c) * es) for s, c in zip(run_starts, counts)]
+
+    # -- explicit offsets (independent) ------------------------------------
+
+    def write_at(self, offset: int, data: Any) -> int:
+        """pwrite ``data`` (coerced to etype) at view-relative ``offset``
+        (etype units); returns elements written."""
+        self._check_open()
+        arr = np.ascontiguousarray(np.asarray(data, dtype=self._etype))
+        view = memoryview(arr).cast("B")
+        pos = 0
+        for start, nbytes in self._byte_runs(int(offset), arr.size):
+            os.pwrite(self._fd, view[pos:pos + nbytes], start)
+            pos += nbytes
+        return arr.size
+
+    def read_at(self, offset: int, count: int) -> np.ndarray:
+        """pread ``count`` etype elements at view-relative ``offset``;
+        short reads at EOF return a shorter array (MPI: count via
+        Get_count)."""
+        self._check_open()
+        chunks = []
+        for start, nbytes in self._byte_runs(int(offset), int(count)):
+            b = os.pread(self._fd, nbytes, start)
+            chunks.append(b)
+            if len(b) < nbytes:  # EOF inside a run
+                break
+        raw = b"".join(chunks)
+        es = self._etype.itemsize
+        return np.frombuffer(raw[: len(raw) - len(raw) % es],
+                             dtype=self._etype).copy()
+
+    # -- individual file pointer -------------------------------------------
+
+    def _visible_end(self) -> int:
+        """Number of VISIBLE etype elements the file currently holds under
+        this view (SEEK_END must count through the filetype, not raw
+        bytes — other ranks' elements are not ours)."""
+        es = self._etype.itemsize
+        nbytes = self.get_size() - self._disp
+        if nbytes <= 0:
+            return 0
+        if self._filetype is None:
+            return nbytes // es
+        ft = self._filetype
+        inst_bytes = ft.extent * es
+        full = nbytes // inst_bytes
+        rem = nbytes % inst_bytes
+        extra = int(np.sum((ft.indices + 1) * es <= rem))
+        return int(full) * ft.indices.size + extra
+
+    def seek(self, offset: int, whence: int = SEEK_SET) -> None:
+        self._check_open()
+        if whence == SEEK_SET:
+            pos = int(offset)
+        elif whence == SEEK_CUR:
+            pos = self._pos + int(offset)
+        elif whence == SEEK_END:
+            pos = self._visible_end() + int(offset)
+        else:
+            raise ValueError(f"bad whence {whence}")
+        if pos < 0:
+            raise ValueError(f"negative file position {pos}")
+        self._pos = pos  # assigned only after validation
+
+    def get_position(self) -> int:
+        return self._pos
+
+    def write(self, data: Any) -> int:
+        n = self.write_at(self._pos, data)
+        self._pos += n
+        return n
+
+    def read(self, count: int) -> np.ndarray:
+        out = self.read_at(self._pos, count)
+        self._pos += out.size
+        return out
+
+    # -- shared file pointer -----------------------------------------------
+
+    def _shared_fetch_add(self, n: int) -> int:
+        """Atomic fetch-and-add on the rank-0-hosted shared pointer."""
+        if self._shared_win is None:
+            # collective lazy init would hang (only callers reach here);
+            # create eagerly instead the first time ANY shared op is used
+            raise RuntimeError(
+                "shared file pointer not initialized — open the file with "
+                "file_open(..., shared=True) (collective) to use "
+                "read_shared/write_shared")
+        w = self._shared_win
+        w.lock(0, exclusive=True)
+        old = int(np.asarray(w.get_at(0)).reshape(-1)[0])
+        w.put_at(0, np.asarray([old + n], dtype=np.int64))
+        w.unlock(0)
+        return old
+
+    def init_shared(self) -> None:
+        """Collective: create the shared-pointer window (done automatically
+        by ``file_open(..., shared=True)``)."""
+        if self._shared_win is None:
+            self._shared_win = self._comm.win_create(
+                np.zeros(1, dtype=np.int64))
+
+    def seek_shared(self, offset: int) -> None:
+        """Collective in MPI; here rank-atomic: set the shared pointer."""
+        w = self._shared_win
+        if w is None:
+            raise RuntimeError("file not opened with shared=True")
+        w.lock(0, exclusive=True)
+        w.put_at(0, np.asarray([int(offset)], dtype=np.int64))
+        w.unlock(0)
+
+    def write_shared(self, data: Any) -> int:
+        """MPI_File_write_shared: each call atomically claims the next
+        region of the file — ranks' records never overlap, order is
+        whatever the pointer race decides [S]."""
+        arr = np.asarray(data, dtype=self._etype)
+        at = self._shared_fetch_add(arr.size)
+        return self.write_at(at, arr)
+
+    def read_shared(self, count: int) -> np.ndarray:
+        at = self._shared_fetch_add(int(count))
+        return self.read_at(at, count)
+
+    # -- collective I/O ----------------------------------------------------
+
+    def write_at_all(self, offset: int, data: Any) -> int:
+        """MPI_File_write_at_all with two-phase collective buffering:
+        small strided epochs aggregate at rank 0 and hit the file as ONE
+        offset-sorted sweep; large payloads write independently inside
+        the same barrier bracket."""
+        self._check_open()
+        arr = np.ascontiguousarray(np.asarray(data, dtype=self._etype))
+        total = self._comm.allreduce(arr.nbytes)
+        if total > _COLLECTIVE_BUFFER_LIMIT:
+            n = self.write_at(offset, arr)
+            self._comm.barrier()
+            return n
+        # phase 1: ship (run, bytes) lists to the aggregator
+        runs = self._byte_runs(int(offset), arr.size)
+        view = memoryview(arr).cast("B")
+        payload, pos = [], 0
+        for start, nbytes in runs:
+            payload.append((start, bytes(view[pos:pos + nbytes])))
+            pos += nbytes
+        if self._comm.rank == 0:
+            everyone = [payload] + [
+                self._comm._recv_internal(r, _TAG_TWOPHASE)
+                for r in range(1, self._comm.size)]
+            # phase 2: one sorted sequential sweep
+            flat = sorted((s, b) for rankruns in everyone for s, b in rankruns)
+            for start, blob in flat:
+                os.pwrite(self._fd, blob, start)
+        else:
+            self._comm._send_internal(payload, 0, _TAG_TWOPHASE)
+        self._comm.barrier()
+        return arr.size
+
+    def read_at_all(self, offset: int, count: int) -> np.ndarray:
+        """Collective read: barrier-bracketed independent preads (reads
+        need no write-ordering phase; the bracket gives the collective
+        completion semantics)."""
+        self._comm.barrier()
+        out = self.read_at(offset, count)
+        self._comm.barrier()
+        return out
+
+    # -- sizes / sync / lifecycle ------------------------------------------
+
+    def get_size(self) -> int:
+        self._check_open()
+        return os.fstat(self._fd).st_size
+
+    def set_size(self, size: int) -> None:
+        """Collective truncate/extend."""
+        self._check_open()
+        if self._comm.rank == 0:
+            os.ftruncate(self._fd, int(size))
+        self._comm.barrier()
+
+    def preallocate(self, size: int) -> None:
+        self.set_size(max(self.get_size(), int(size)))
+
+    def sync(self) -> None:
+        self._check_open()
+        os.fsync(self._fd)
+
+    def close(self) -> None:
+        """Collective close; honors MODE_DELETE_ON_CLOSE."""
+        if not self._open:
+            return
+        os.fsync(self._fd)
+        self._comm.barrier()
+        os.close(self._fd)
+        self._open = False
+        if self._shared_win is not None:
+            self._shared_win.free()
+            self._shared_win = None
+        if self._amode & MODE_DELETE_ON_CLOSE and self._comm.rank == 0:
+            try:
+                os.unlink(self._path)
+            except OSError:
+                pass
+        self._comm.barrier()
+
+    def _check_open(self) -> None:
+        if not self._open:
+            raise RuntimeError("file is closed")
+
+    def __enter__(self) -> "File":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+def file_open(comm: Communicator, path: str, amode: int = MODE_RDWR,
+              shared: bool = False) -> File:
+    """MPI_File_open (collective).  ``shared=True`` additionally creates
+    the shared-file-pointer window (needed for read/write_shared)."""
+    f = File(comm, path, amode)
+    if shared:
+        f.init_shared()
+    return f
+
+
+def file_delete(path: str) -> None:
+    """MPI_File_delete."""
+    os.unlink(path)

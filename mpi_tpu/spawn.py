@@ -60,7 +60,9 @@ def _bridge_comm(bridge_rank: int, total: int, rdv: str) -> P2PCommunicator:
     from .transport.socket import SocketTransport
 
     t = SocketTransport(bridge_rank, total, rdv)
-    return P2PCommunicator(t, range(total))
+    comm = P2PCommunicator(t, range(total))
+    comm._owns_transport = True  # intercomm.free() closes the bridge socket
+    return comm
 
 
 def comm_spawn(argv: Sequence[str], maxprocs: int,

@@ -1,0 +1,306 @@
+"""MPI-IO (mpi_tpu/io.py): explicit offsets, views over datatype maps,
+individual/shared pointers, two-phase collective writes."""
+
+import os
+
+import numpy as np
+import pytest
+
+import mpi_tpu
+from mpi_tpu import datatypes as dt
+from mpi_tpu import io as mio
+from mpi_tpu.transport.local import run_local
+
+
+def _self():
+    return mpi_tpu.comm_self()
+
+
+# -- independent explicit-offset I/O ----------------------------------------
+
+
+def test_write_read_at_roundtrip(tmp_path):
+    path = str(tmp_path / "a.bin")
+    with mio.file_open(_self(), path, mio.MODE_CREATE | mio.MODE_RDWR) as f:
+        f.set_view(etype=np.float64)
+        data = np.arange(8.0)
+        assert f.write_at(2, data) == 8
+        out = f.read_at(2, 8)
+        assert np.array_equal(out, data)
+        assert f.get_size() == 10 * 8
+
+
+def test_short_read_at_eof(tmp_path):
+    path = str(tmp_path / "b.bin")
+    with mio.file_open(_self(), path, mio.MODE_CREATE | mio.MODE_RDWR) as f:
+        f.set_view(etype=np.int32)
+        f.write_at(0, np.arange(4, dtype=np.int32))
+        assert f.read_at(2, 10).size == 2  # short, not an error
+        assert f.read_at(9, 5).size == 0
+
+
+def test_individual_pointer_and_seek(tmp_path):
+    path = str(tmp_path / "c.bin")
+    with mio.file_open(_self(), path, mio.MODE_CREATE | mio.MODE_RDWR) as f:
+        f.set_view(etype=np.int16)
+        f.write(np.arange(5, dtype=np.int16))
+        assert f.get_position() == 5
+        f.seek(-2, mio.SEEK_CUR)
+        assert np.array_equal(f.read(2), [3, 4])
+        f.seek(0, mio.SEEK_END)
+        assert f.get_position() == 5
+        f.seek(1, mio.SEEK_SET)
+        assert np.array_equal(f.read(1), [1])
+
+
+def test_open_modes(tmp_path):
+    path = str(tmp_path / "d.bin")
+    with pytest.raises(OSError, match="does not exist"):
+        mio.file_open(_self(), path, mio.MODE_RDONLY)
+    f = mio.file_open(_self(), path, mio.MODE_CREATE | mio.MODE_WRONLY |
+                      mio.MODE_DELETE_ON_CLOSE)
+    f.write_at(0, np.zeros(4, np.uint8))
+    f.close()
+    assert not os.path.exists(path)  # DELETE_ON_CLOSE
+    with pytest.raises(ValueError, match="amode"):
+        mio.file_open(_self(), path, mio.MODE_CREATE)
+
+
+def test_set_size_and_append(tmp_path):
+    path = str(tmp_path / "e.bin")
+    with mio.file_open(_self(), path, mio.MODE_CREATE | mio.MODE_RDWR) as f:
+        f.set_size(16)
+        assert f.get_size() == 16
+    with mio.file_open(_self(), path, mio.MODE_RDWR | mio.MODE_APPEND) as f:
+        assert f.get_position() == 16  # APPEND starts at EOF
+
+
+# -- views (the datatype integration) ----------------------------------------
+
+
+def test_strided_view_partitions_file(tmp_path):
+    """Two ranks with complementary vector filetypes interleave records
+    without overlap — the canonical MPI-IO view demo."""
+    path = str(tmp_path / "view.bin")
+
+    def prog(comm):
+        ft = dt.type_vector(4, 1, 2, np.float64)  # every other element
+        shifted = dt.Datatype(ft.base_dtype, ft.indices + comm.rank,
+                              ft.extent)
+        f = mio.file_open(comm, path, mio.MODE_CREATE | mio.MODE_RDWR)
+        f.set_view(disp=0, etype=np.float64, filetype=shifted)
+        f.write_at(0, np.full(4, float(comm.rank + 1)))
+        f.close()
+        return None
+
+    run_local(prog, 2)
+    whole = np.fromfile(path, dtype=np.float64)
+    assert np.array_equal(whole, [1, 2] * 4)
+
+
+def test_view_displacement_and_coalescing(tmp_path):
+    path = str(tmp_path / "disp.bin")
+    with mio.file_open(_self(), path, mio.MODE_CREATE | mio.MODE_RDWR) as f:
+        # header of 3 bytes, then a contiguous float32 block: one run
+        f.set_view(disp=3, etype=np.float32)
+        runs = f._byte_runs(0, 5)
+        assert runs == [(3, 20)]
+        sub = dt.type_vector(2, 2, 3, np.float32)  # 2 elems, skip 1
+        f.set_view(disp=3, etype=np.float32, filetype=sub)
+        assert f._byte_runs(0, 4) == [(3, 8), (3 + 12, 8)]
+
+
+def test_subarray_view_tiled_matrix(tmp_path):
+    """Each rank owns a column block of a 4x4 row-major matrix file via a
+    subarray filetype."""
+    path = str(tmp_path / "mat.bin")
+
+    def prog(comm):
+        ft = dt.type_create_subarray([4, 4], [4, 2], [0, 2 * comm.rank],
+                                     np.float32)
+        f = mio.file_open(comm, path, mio.MODE_CREATE | mio.MODE_RDWR)
+        f.set_view(etype=np.float32, filetype=ft)
+        f.write_at(0, np.full(8, float(comm.rank + 1), np.float32))
+        f.close()
+        return None
+
+    run_local(prog, 2)
+    m = np.fromfile(path, dtype=np.float32).reshape(4, 4)
+    assert np.all(m[:, :2] == 1.0) and np.all(m[:, 2:] == 2.0)
+
+
+# -- collective I/O ----------------------------------------------------------
+
+
+def test_write_at_all_two_phase(tmp_path):
+    """Interleaved strided collective write aggregates at rank 0 and the
+    file comes out bit-exact."""
+    path = str(tmp_path / "coll.bin")
+    n = 16
+
+    def prog(comm):
+        ft = dt.type_vector(n, 1, comm.size, np.int64)
+        mine = dt.Datatype(ft.base_dtype, ft.indices + comm.rank, ft.extent)
+        f = mio.file_open(comm, path, mio.MODE_CREATE | mio.MODE_RDWR)
+        f.set_view(etype=np.int64, filetype=mine)
+        f.write_at_all(0, np.arange(n, dtype=np.int64) * comm.size + comm.rank)
+        out = f.read_at_all(0, n)
+        f.close()
+        return out
+
+    res = run_local(prog, 4)
+    whole = np.fromfile(path, dtype=np.int64)
+    assert np.array_equal(whole, np.arange(4 * n))
+    for r, out in enumerate(res):
+        assert np.array_equal(out, np.arange(n) * 4 + r)
+
+
+def test_write_at_all_large_falls_back(tmp_path):
+    """Above the collective-buffer limit every rank writes directly; the
+    result is identical."""
+    path = str(tmp_path / "big.bin")
+    nbytes = mio._COLLECTIVE_BUFFER_LIMIT  # total 2x limit over 2 ranks
+
+    def prog(comm):
+        f = mio.file_open(comm, path, mio.MODE_CREATE | mio.MODE_RDWR)
+        block = np.full(nbytes, comm.rank + 1, np.uint8)
+        f.write_at_all(comm.rank * nbytes, block)
+        f.close()
+        return None
+
+    run_local(prog, 2)
+    whole = np.fromfile(path, dtype=np.uint8)
+    assert whole.size == 2 * nbytes
+    assert np.all(whole[:nbytes] == 1) and np.all(whole[nbytes:] == 2)
+
+
+# -- shared file pointer -----------------------------------------------------
+
+
+def test_write_shared_claims_disjoint_regions(tmp_path):
+    path = str(tmp_path / "shared.bin")
+    per = 64
+
+    def prog(comm):
+        f = mio.file_open(comm, path, mio.MODE_CREATE | mio.MODE_RDWR,
+                          shared=True)
+        f.write_shared(np.full(per, comm.rank, np.uint8))
+        comm.barrier()
+        size = f.get_size()
+        f.close()
+        return size
+
+    res = run_local(prog, 3)
+    assert all(s == 3 * per for s in res)
+    whole = np.fromfile(path, dtype=np.uint8)
+    # every rank's record is contiguous and intact, in SOME order
+    seen = sorted(int(whole[i * per]) for i in range(3))
+    assert seen == [0, 1, 2]
+    for i in range(3):
+        assert np.all(whole[i * per:(i + 1) * per] == whole[i * per])
+
+
+def test_shared_requires_flag(tmp_path):
+    path = str(tmp_path / "noshared.bin")
+    with mio.file_open(_self(), path, mio.MODE_CREATE | mio.MODE_RDWR) as f:
+        with pytest.raises(RuntimeError, match="shared=True"):
+            f.write_shared(np.zeros(4, np.uint8))
+
+
+# -- API layer + TPU gating --------------------------------------------------
+
+
+def test_api_layer_roundtrip(tmp_path):
+    from mpi_tpu.api import (MPI_File_close, MPI_File_open, MPI_File_read_at,
+                             MPI_File_set_view, MPI_File_write_at,
+                             MPI_MODE_CREATE, MPI_MODE_RDWR)
+
+    path = str(tmp_path / "api.bin")
+    fh = MPI_File_open(path, MPI_MODE_CREATE | MPI_MODE_RDWR, comm=_self())
+    MPI_File_set_view(fh, etype=np.float32)
+    MPI_File_write_at(fh, 0, np.arange(4, dtype=np.float32))
+    assert np.array_equal(MPI_File_read_at(fh, 0, 4), np.arange(4))
+    MPI_File_close(fh)
+
+
+def test_io_rejects_spmd_comm(tmp_path):
+    def prog(comm):
+        with pytest.raises(NotImplementedError, match="orbax"):
+            mio.file_open(comm, "/tmp/x.bin", mio.MODE_CREATE | mio.MODE_RDWR)
+        return 0
+
+    mpi_tpu.run(prog, backend="tpu", nranks=None)
+
+
+# -- round-3 review regressions ---------------------------------------------
+
+
+def test_collective_open_failure_raises_everywhere(tmp_path):
+    """A create/existence failure at rank 0 must raise on ALL ranks, not
+    deadlock the others in the open barrier."""
+    path = str(tmp_path / "excl.bin")
+    open(path, "wb").close()
+
+    def prog(comm):
+        comm.recv_timeout = 20.0
+        with pytest.raises(OSError, match="rank 0"):
+            mio.file_open(comm, path,
+                          mio.MODE_CREATE | mio.MODE_EXCL | mio.MODE_RDWR)
+        with pytest.raises(OSError, match="rank 0"):
+            mio.file_open(comm, str(tmp_path / "missing.bin"),
+                          mio.MODE_RDONLY)
+        return "ok"
+
+    assert run_local(prog, 2) == ["ok", "ok"]
+
+
+def test_overlapping_tiled_view_rejected(tmp_path):
+    path = str(tmp_path / "ovl.bin")
+    bad = dt.type_create_resized(dt.type_contiguous(2, np.int32), 0, 1)
+    with mio.file_open(_self(), path, mio.MODE_CREATE | mio.MODE_RDWR) as f:
+        with pytest.raises(ValueError, match="overlap"):
+            f.set_view(etype=np.int32, filetype=bad)
+
+
+def test_seek_end_respects_view(tmp_path):
+    path = str(tmp_path / "seekend.bin")
+    with mio.file_open(_self(), path, mio.MODE_CREATE | mio.MODE_RDWR) as f:
+        f.set_view(disp=4, etype=np.int32)
+        f.write_at(0, np.arange(3, dtype=np.int32))
+        f.seek(0, mio.SEEK_END)
+        assert f.get_position() == 3  # not (16 bytes)//4 == 4
+        # strided view: only MY elements count
+        ft = dt.type_vector(8, 1, 2, np.int32)
+        f.set_view(disp=0, etype=np.int32, filetype=ft)
+        f.seek(0, mio.SEEK_END)
+        assert f.get_position() == 2  # elements 0 and 2 of 16 bytes
+
+
+def test_seek_failure_leaves_position_intact(tmp_path):
+    path = str(tmp_path / "seekfail.bin")
+    with mio.file_open(_self(), path, mio.MODE_CREATE | mio.MODE_RDWR) as f:
+        f.set_view(etype=np.uint8)
+        f.seek(5)
+        with pytest.raises(ValueError, match="negative"):
+            f.seek(-9, mio.SEEK_CUR)
+        assert f.get_position() == 5
+
+
+def test_spawn_bridge_transport_closed_on_free(tmp_path):
+    """intercomm.free() on a spawn bridge closes its dedicated socket
+    transport (review: fd/thread leak per spawn wave)."""
+    from mpi_tpu import spawn as sp
+
+    script = tmp_path / "noop_worker.py"
+    script.write_text(
+        "import sys\n"
+        f"sys.path.insert(0, {os.path.dirname(os.path.dirname(os.path.abspath(__file__)))!r})\n"
+        "import mpi_tpu\nfrom mpi_tpu import spawn\n"
+        "comm = mpi_tpu.COMM_WORLD\n"
+        "parent = spawn.comm_get_parent()\n"
+        "parent.send('done', dest=0)\n")
+    inter = sp.comm_spawn([str(script)], 1, comm=mpi_tpu.comm_self())
+    assert inter.recv(source=0) == "done"
+    t = inter._u._t
+    inter.free()
+    assert getattr(t, "_closing", True)  # transport actually closed
