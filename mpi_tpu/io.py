@@ -65,6 +65,31 @@ _TAG_TWOPHASE = -30  # internal tag (negative: invisible to user wildcards)
 _COLLECTIVE_BUFFER_LIMIT = 8 << 20
 
 
+def _pwrite_full(fd: int, view, offset: int) -> None:
+    """pwrite the whole buffer (one syscall caps at ~2GiB on Linux; a
+    short write here would silently truncate the transfer)."""
+    pos = 0
+    n = len(view)
+    while pos < n:
+        w = os.pwrite(fd, view[pos:], offset + pos)
+        if w <= 0:
+            raise OSError(f"pwrite returned {w} at offset {offset + pos}")
+        pos += w
+
+
+def _pread_full(fd: int, nbytes: int, offset: int) -> bytes:
+    """pread until ``nbytes`` or true EOF (a capped syscall is not EOF)."""
+    chunks = []
+    got = 0
+    while got < nbytes:
+        b = os.pread(fd, nbytes - got, offset + got)
+        if not b:
+            break  # EOF
+        chunks.append(b)
+        got += len(b)
+    return b"".join(chunks)
+
+
 class File:
     """An open parallel file (MPI_File).  Construct via :func:`file_open`."""
 
@@ -125,18 +150,22 @@ class File:
                 raise ValueError(
                     f"filetype base {filetype.base_dtype} != etype {et}")
             filetype.commit()  # no overlap within one instance
-            if filetype.indices.size and \
-                    filetype.extent <= int(filetype.indices.max()):
-                # the view tiles the map indefinitely: adjacent instances
-                # must not interleave onto the same file elements either
-                # (a write through such a view silently drops data)
-                two = np.concatenate([filetype.indices,
-                                      filetype.indices + filetype.extent])
-                if np.unique(two).size != two.size:
+            if filetype.indices.size:
+                if filetype.extent <= 0:
+                    raise ValueError("filetype extent must be positive "
+                                     "for a view (it is the tiling period)")
+                # The view tiles the map indefinitely: element i of
+                # instance 0 collides with element j of instance m iff
+                # indices[i] == indices[j] + m*extent — i.e. iff two
+                # indices are congruent mod extent.  Distinct residues ⇔
+                # no overlap at ANY shift (not just adjacent instances).
+                res = filetype.indices % filetype.extent
+                if np.unique(res).size != res.size:
                     raise ValueError(
-                        "filetype instances overlap when tiled (extent "
-                        f"{filetype.extent} is inside the map's span) — "
-                        "writes through this view would silently collide")
+                        "filetype instances overlap when tiled (two "
+                        "element displacements are congruent modulo the "
+                        f"extent {filetype.extent}) — writes through this "
+                        "view would silently collide")
         self._disp = int(disp)
         self._etype = et
         self._filetype = filetype
@@ -183,7 +212,7 @@ class File:
         view = memoryview(arr).cast("B")
         pos = 0
         for start, nbytes in self._byte_runs(int(offset), arr.size):
-            os.pwrite(self._fd, view[pos:pos + nbytes], start)
+            _pwrite_full(self._fd, view[pos:pos + nbytes], start)
             pos += nbytes
         return arr.size
 
@@ -194,9 +223,9 @@ class File:
         self._check_open()
         chunks = []
         for start, nbytes in self._byte_runs(int(offset), int(count)):
-            b = os.pread(self._fd, nbytes, start)
+            b = _pread_full(self._fd, nbytes, start)
             chunks.append(b)
-            if len(b) < nbytes:  # EOF inside a run
+            if len(b) < nbytes:  # true EOF inside a run
                 break
         raw = b"".join(chunks)
         es = self._etype.itemsize
@@ -323,7 +352,7 @@ class File:
             # phase 2: one sorted sequential sweep
             flat = sorted((s, b) for rankruns in everyone for s, b in rankruns)
             for start, blob in flat:
-                os.pwrite(self._fd, blob, start)
+                _pwrite_full(self._fd, memoryview(blob), start)
         else:
             self._comm._send_internal(payload, 0, _TAG_TWOPHASE)
         self._comm.barrier()

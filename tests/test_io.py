@@ -257,9 +257,15 @@ def test_collective_open_failure_raises_everywhere(tmp_path):
 def test_overlapping_tiled_view_rejected(tmp_path):
     path = str(tmp_path / "ovl.bin")
     bad = dt.type_create_resized(dt.type_contiguous(2, np.int32), 0, 1)
+    # indices [0,2] at extent 1: instances 0 and 2 collide — a shift-2
+    # overlap the adjacent-instance check used to miss (review round 3)
+    gap = dt.type_create_resized(dt.type_vector(2, 1, 2, np.int32), 0, 1)
     with mio.file_open(_self(), path, mio.MODE_CREATE | mio.MODE_RDWR) as f:
-        with pytest.raises(ValueError, match="overlap"):
-            f.set_view(etype=np.int32, filetype=bad)
+        for ft in (bad, gap):
+            with pytest.raises(ValueError, match="overlap|congruent"):
+                f.set_view(etype=np.int32, filetype=ft)
+        # non-overlapping strided view still accepted (residues distinct)
+        f.set_view(etype=np.int32, filetype=dt.type_vector(4, 1, 2, np.int32))
 
 
 def test_seek_end_respects_view(tmp_path):
@@ -303,4 +309,4 @@ def test_spawn_bridge_transport_closed_on_free(tmp_path):
     assert inter.recv(source=0) == "done"
     t = inter._u._t
     inter.free()
-    assert getattr(t, "_closing", True)  # transport actually closed
+    assert t._closing  # transport actually closed (no vacuous default)
