@@ -33,7 +33,7 @@ __all__ = [
     "MPI_Send_init", "MPI_Recv_init", "MPI_Start", "MPI_Startall",
     "MPI_Ibcast", "MPI_Ireduce", "MPI_Iallreduce", "MPI_Iallgather",
     "MPI_Ialltoall", "MPI_Ibarrier", "MPI_Iscatter", "MPI_Igather",
-    "MPI_Get_processor_name", "MPI_Get_version", "MPI_Abort",
+    "MPI_Get_processor_name", "MPI_Get_version", "MPI_Get_library_version", "MPI_Abort",
     "MPI_Wtick", "MPI_Sendrecv_replace",
     "MPI_Exscan", "MPI_Op_create", "MPI_Maxloc", "MPI_Minloc",
     "MPI_Gatherv", "MPI_Scatterv", "MPI_Allgatherv", "MPI_Alltoallv",
@@ -622,16 +622,28 @@ def MPI_Get_processor_name() -> str:
 def MPI_Get_version():
     """(major, minor) of the MPI standard this library *conforms to*.
 
-    Honestly: MPI-1.3 (the reference's level, BASELINE.json:5) — complete
-    p2p/collectives/groups/topology for picklable payloads.  Selected
-    MPI-2/3 features are present beyond that (active-target RMA,
-    persistent requests, nonblocking collectives, neighborhood
-    collectives, Waitany/Waitsome/Testall/Testany, graph topologies with
-    neighborhood collectives, intercommunicators with merge,
-    passive-target RMA lock/unlock on the process backends), but derived
-    datatypes and a few request-set/env corners are not, so claiming
-    (3, 0) here would overstate conformance."""
-    return (1, 3)
+    MPI-2.0 as of round 3: MPI-1 is complete (p2p, collectives, groups,
+    topology, derived datatypes + Pack/Unpack, error handlers, attribute
+    caching/keyvals, COMM_SELF, Get_count) and every MPI-2 chapter has
+    its core: one-sided RMA (active fence + passive lock/unlock),
+    dynamic process management (Comm_spawn/spawn_multiple/get_parent),
+    MPI-IO (open/views/explicit offsets/individual + shared pointers/
+    collective two-phase writes), intercommunicators.  Selected MPI-3
+    features exist beyond that (nonblocking collectives, neighborhood
+    collectives on cartesian AND distributed-graph topologies,
+    Waitany/Waitsome/Testall/Testany, Mprobe-free matched receive via
+    per-comm contexts).  Known MPI-2 gaps, so (2, 0) and not higher:
+    no Info objects (kwargs serve that role), no MPI_Pack_external /
+    external32 wire format, no C/Fortran interop chapter (meaningless
+    here), shared-pointer ordered collectives (read_ordered) absent."""
+    return (2, 0)
+
+
+def MPI_Get_library_version() -> str:
+    from .version import __version__
+
+    return f"mpi_tpu {__version__} (TPU-native: XLA/ICI collectives + " \
+           f"socket/shm process transports)"
 
 
 def MPI_Abort(code: int = 1, comm: Optional[Communicator] = None) -> None:

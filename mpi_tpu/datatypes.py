@@ -170,12 +170,26 @@ class Datatype:
 
     # -- device (jit-traceable) path ---------------------------------------
 
+    def _jax_byte_view(self, x):
+        """Byte-based maps index BYTES: bitcast the buffer to a uint8
+        stream (the jit spelling of _flat_view's ``a.view(np.uint8)``)."""
+        from jax import lax as jlax
+
+        import jax.numpy as jnp
+
+        if x.dtype == jnp.uint8:
+            return x.reshape(-1)
+        return jlax.bitcast_convert_type(x.reshape(-1), jnp.uint8).reshape(-1)
+
     def pack_jax(self, x: Any, count: int = 1):
         """Same gather under jit: indices are trace-time constants, so this
         lowers to one static lax.gather XLA can fuse."""
         import jax.numpy as jnp
 
         x = jnp.asarray(x)
+        self._check_jax_dtype(x)
+        if self.base_dtype == np.uint8:
+            x = self._jax_byte_view(x)
         idx = self._checked_indices(count, x.size)  # static: checked at trace
         return jnp.take(x.reshape(-1), idx, axis=0)
 
@@ -183,10 +197,43 @@ class Datatype:
         """Functional scatter: returns ``out`` with the instances placed."""
         import jax.numpy as jnp
 
+        from jax import lax as jlax
+
         o = jnp.asarray(out)
-        idx = self._checked_indices(count, o.size, writeback=True)  # static
-        flat = o.reshape(-1).at[idx].set(jnp.asarray(packed).reshape(-1))
+        self._check_jax_dtype(o)
+        data = jnp.asarray(packed).reshape(-1)
+        if self.base_dtype == np.uint8:
+            flat = self._jax_byte_view(o)
+        else:
+            flat = o.reshape(-1)
+        # same strictness as the host path: exact payload dtype and size
+        if data.dtype != flat.dtype:
+            raise TypeError(f"packed payload dtype {data.dtype} != datatype "
+                            f"base {flat.dtype}")
+        idx = self._checked_indices(count, flat.size, writeback=True)  # static
+        if data.size != idx.size:
+            raise ValueError(f"packed payload has {data.size} elements, "
+                             f"datatype expects {idx.size}")
+        flat = flat.at[idx].set(data)
+        if self.base_dtype == np.uint8 and o.dtype != jnp.uint8:
+            flat = jlax.bitcast_convert_type(
+                flat.reshape(-1, np.dtype(o.dtype).itemsize), o.dtype)
         return flat.reshape(o.shape)
+
+    def _check_jax_dtype(self, x) -> None:
+        """Same strictness as the numpy path — indices are ELEMENT offsets,
+        so a buffer of a different dtype would be a silent reinterpretation.
+        Compared against jax's CANONICALIZED base dtype (float64 maps to
+        float32 under the default x64-off config — that narrowing is jax's
+        documented behavior, not a layout error); byte-based maps are
+        exempt, as on the host path."""
+        if self.base_dtype == np.uint8:
+            return
+        from jax import dtypes as _jd
+
+        if x.dtype != _jd.canonicalize_dtype(self.base_dtype):
+            raise TypeError(f"buffer dtype {x.dtype} != datatype base "
+                            f"{self.base_dtype}")
 
 
 # -- constructors (MPI_Type_*) ---------------------------------------------

@@ -159,13 +159,17 @@ class File:
                 # indices[i] == indices[j] + m*extent — i.e. iff two
                 # indices are congruent mod extent.  Distinct residues ⇔
                 # no overlap at ANY shift (not just adjacent instances).
-                res = filetype.indices % filetype.extent
-                if np.unique(res).size != res.size:
-                    raise ValueError(
-                        "filetype instances overlap when tiled (two "
-                        "element displacements are congruent modulo the "
-                        f"extent {filetype.extent}) — writes through this "
-                        "view would silently collide")
+                # MPI permits overlapping filetypes on READ-ONLY files
+                # (overlap is erroneous only for writing), so gate on amode.
+                if self._amode & (MODE_WRONLY | MODE_RDWR):
+                    res = filetype.indices % filetype.extent
+                    if np.unique(res).size != res.size:
+                        raise ValueError(
+                            "filetype instances overlap when tiled (two "
+                            "element displacements are congruent modulo the "
+                            f"extent {filetype.extent}) — writes through "
+                            "this view would silently collide (legal on a "
+                            "MODE_RDONLY file)")
         self._disp = int(disp)
         self._etype = et
         self._filetype = filetype

@@ -310,3 +310,15 @@ def test_spawn_bridge_transport_closed_on_free(tmp_path):
     t = inter._u._t
     inter.free()
     assert t._closing  # transport actually closed (no vacuous default)
+
+
+def test_overlapping_view_allowed_readonly(tmp_path):
+    """MPI-2: an overlapping filetype is legal on a MODE_RDONLY file —
+    only writes through an overlap are erroneous."""
+    path = str(tmp_path / "ro.bin")
+    np.arange(8, dtype=np.int32).tofile(path)
+    ovl = dt.type_create_resized(dt.type_contiguous(2, np.int32), 0, 1)
+    with mio.file_open(_self(), path, mio.MODE_RDONLY) as f:
+        f.set_view(etype=np.int32, filetype=ovl)  # accepted
+        # visible elements walk the overlapped tiling: 0,1,1,2,...
+        assert np.array_equal(f.read_at(0, 4), [0, 1, 1, 2])
