@@ -69,6 +69,10 @@ __all__ = [
     "MPI_File_read_at_all", "MPI_File_write_at_all",
     "MPI_File_seek", "MPI_File_get_position", "MPI_File_read", "MPI_File_write",
     "MPI_File_read_shared", "MPI_File_write_shared", "MPI_File_seek_shared",
+    "MPI_File_write_ordered", "MPI_File_read_ordered",
+    "Info", "MPI_INFO_NULL", "MPI_Info_create", "MPI_Info_set",
+    "MPI_Info_get", "MPI_Info_delete", "MPI_Info_dup", "MPI_Info_free",
+    "MPI_Info_get_nkeys",
     "MPI_File_set_view", "MPI_File_get_view",
     "MPI_File_get_size", "MPI_File_set_size", "MPI_File_preallocate",
     "MPI_File_sync",
@@ -633,9 +637,9 @@ def MPI_Get_version():
     collectives on cartesian AND distributed-graph topologies,
     Waitany/Waitsome/Testall/Testany, Mprobe-free matched receive via
     per-comm contexts).  Known MPI-2 gaps, so (2, 0) and not higher:
-    no Info objects (kwargs serve that role), no MPI_Pack_external /
+    no MPI_Pack_external /
     external32 wire format, no C/Fortran interop chapter (meaningless
-    here), shared-pointer ordered collectives (read_ordered) absent."""
+    here), no MPI_Register_datarep."""
     return (2, 0)
 
 
@@ -795,12 +799,12 @@ def MPI_Comm_delete_attr(keyval, comm: Optional[Communicator] = None) -> None:
 
 
 def MPI_Comm_spawn(command: Sequence[str], maxprocs: int, root: int = 0,
-                   comm: Optional[Communicator] = None):
+                   comm: Optional[Communicator] = None, info=None):
     """Spawn ``maxprocs`` ranks of ``python command...`` as a new world;
     returns the parent-child intercommunicator."""
     from .spawn import comm_spawn
 
-    return comm_spawn(command, maxprocs, comm, root)
+    return comm_spawn(command, maxprocs, comm, root, info=info)
 
 
 def MPI_Comm_spawn_multiple(segments, root: int = 0,
@@ -833,8 +837,8 @@ MPI_File_delete = _io.file_delete
 
 def MPI_File_open(path: str, amode: int = _io.MODE_RDWR,
                   comm: Optional[Communicator] = None,
-                  shared: bool = False) -> "_io.File":
-    return _io.file_open(_world(comm), path, amode, shared)
+                  shared: bool = False, info=None) -> "_io.File":
+    return _io.file_open(_world(comm), path, amode, shared, info)
 
 
 def MPI_File_close(fh: "_io.File") -> None:
@@ -910,3 +914,52 @@ def MPI_File_preallocate(fh, size: int) -> None:
 
 def MPI_File_sync(fh) -> None:
     fh.sync()
+
+
+def MPI_File_write_ordered(fh, data: Any) -> int:
+    return fh.write_ordered(data)
+
+
+def MPI_File_read_ordered(fh, count: int):
+    return fh.read_ordered(count)
+
+
+# -- Info objects (MPI-2) ----------------------------------------------------
+# An Info is a string-keyed hint dictionary; this library's spelling IS a
+# dict (the docstring of MPI_Get_version used to name this as the gap).
+
+class Info(dict):
+    """MPI_Info: string key/value hints.  ``MPI_File_open(..., info=)``
+    and ``MPI_Comm_spawn(..., info=)`` accept one (advisory no-ops
+    currently); exists so MPI-2 code ports without surgery."""
+
+
+MPI_INFO_NULL = None
+
+
+def MPI_Info_create() -> Info:
+    return Info()
+
+
+def MPI_Info_set(info: Info, key: str, value: str) -> None:
+    info[str(key)] = str(value)
+
+
+def MPI_Info_get(info: Info, key: str, default: Optional[str] = None):
+    return info.get(key, default)
+
+
+def MPI_Info_delete(info: Info, key: str) -> None:
+    info.pop(key, None)
+
+
+def MPI_Info_dup(info: Info) -> Info:
+    return Info(info)
+
+
+def MPI_Info_free(info: Info) -> None:
+    info.clear()
+
+
+def MPI_Info_get_nkeys(info: Info) -> int:
+    return len(info)

@@ -328,6 +328,46 @@ class File:
         at = self._shared_fetch_add(int(count))
         return self.read_at(at, count)
 
+    # -- ordered shared-pointer collectives --------------------------------
+
+    def _ordered_base(self, nelems: int) -> int:
+        """Collective: claim a contiguous region ordered BY RANK (the
+        MPI_File_*_ordered contract [S]): an exscan of sizes gives each
+        rank its offset; rank size-1 advances the shared pointer past the
+        whole epoch."""
+        if self._shared_win is None:
+            raise RuntimeError("file not opened with shared=True")
+        sizes = self._comm.allgather(int(nelems))
+        if not isinstance(sizes, (list, tuple)):  # stacked array form
+            sizes = [int(s) for s in np.asarray(sizes).reshape(-1)]
+        prefix = sum(sizes[: self._comm.rank])
+        total = sum(sizes)
+        # one rank advances the pointer for the whole epoch, atomically
+        if self._comm.rank == 0:
+            base = self._shared_fetch_add(total)
+        else:
+            base = None
+        base = self._comm.bcast(base, 0)
+        self._comm.barrier()
+        return int(base) + prefix
+
+    def write_ordered(self, data: Any) -> int:
+        """MPI_File_write_ordered: like write_shared but records land in
+        RANK ORDER — collective."""
+        arr = np.asarray(data, dtype=self._etype)
+        at = self._ordered_base(arr.size)
+        n = self.write_at(at, arr)
+        self._comm.barrier()
+        return n
+
+    def read_ordered(self, count: int) -> np.ndarray:
+        """MPI_File_read_ordered: collective rank-ordered read through the
+        shared pointer."""
+        at = self._ordered_base(int(count))
+        out = self.read_at(at, count)
+        self._comm.barrier()
+        return out
+
     # -- collective I/O ----------------------------------------------------
 
     def write_at_all(self, offset: int, data: Any) -> int:
@@ -421,9 +461,11 @@ class File:
 
 
 def file_open(comm: Communicator, path: str, amode: int = MODE_RDWR,
-              shared: bool = False) -> File:
+              shared: bool = False, info: Optional[dict] = None) -> File:
     """MPI_File_open (collective).  ``shared=True`` additionally creates
-    the shared-file-pointer window (needed for read/write_shared)."""
+    the shared-file-pointer window (needed for read/write_shared).
+    ``info``: MPI_Info hints — accepted and currently advisory no-ops
+    (collective buffering is always on below _COLLECTIVE_BUFFER_LIMIT)."""
     f = File(comm, path, amode)
     if shared:
         f.init_shared()

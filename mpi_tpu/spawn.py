@@ -67,11 +67,13 @@ def _bridge_comm(bridge_rank: int, total: int, rdv: str) -> P2PCommunicator:
 
 def comm_spawn(argv: Sequence[str], maxprocs: int,
                comm: Optional[Communicator] = None, root: int = 0,
-               env_extra: Optional[dict] = None) -> InterComm:
+               env_extra: Optional[dict] = None,
+               info: Optional[dict] = None) -> InterComm:
     """MPI_Comm_spawn: start ``maxprocs`` ranks of ``python argv...`` as a
     new world; returns the parent side of the parent-child intercomm.
     Collective over ``comm`` (default: this process's world); only
     ``root`` actually forks the children."""
+    del info  # MPI_Info hints: accepted, advisory no-ops
     segments = [(list(argv), int(maxprocs))]
     return _spawn_segments(segments, comm, root, env_extra)
 

@@ -474,6 +474,14 @@ class TpuCommunicator(Communicator):
         if algorithm == "doubling":
             return algos.doubling_allgather(x, self.axis_name, self.size, self.rank,
                                             self._world_pairs)
+        if algorithm == "pallas_ring":
+            # allgather-only mode of the in-kernel RDMA ring: P-1 pipelined
+            # land-direct steps (mpi_tpu/tpu/pallas_ring.py)
+            from .pallas_ring import pallas_ring_allgather
+
+            return pallas_ring_allgather(x, self.axis_name, self.size,
+                                         interpret=self._on_cpu,
+                                         groups=self._groups)
         raise ValueError(f"unknown allgather algorithm {algorithm!r}")
 
     def alltoall(self, objs, algorithm: str = "auto"):

@@ -121,7 +121,7 @@ def _kernel(params_smem, x_hbm, out_hbm, comm_hbm, a_vmem, b_vmem,
             copy_sem_a, copy_sem_b, send_sem, recv_sem, credit_sem, *,
             axis_name: str, size: int, rows: int, tile_rows: int,
             flows: List[Flow], rot: int, allgather: bool,
-            pipelined: bool, combine=None):
+            pipelined: bool, combine=None, rs: bool = True):
     """``rot`` shifts the chunk schedule: 0 → the ring ends with rank r
     owning chunk (r+1)%P (allreduce layout); -1 → rank r owns chunk r
     (reduce_scatter layout).  ``allgather=False`` stops after the
@@ -139,8 +139,11 @@ def _kernel(params_smem, x_hbm, out_hbm, comm_hbm, a_vmem, b_vmem,
     left = params_smem[1]        # axis index of the upstream +1 neighbor
     right = params_smem[2]       # axis index of the downstream +1 neighbor
     P = size
-    n_rs = P - 1                       # reduce-scatter steps: u in [0, P-1)
-    n_steps = 2 * (P - 1) if allgather else n_rs
+    # rs=False is the ALLGATHER-ONLY mode: zero reduce-scatter steps, P-1
+    # land-direct steps — the same unified schedule starting at the AG half
+    # (each rank's own chunk circulates; no accumulation, half the steps)
+    n_rs = P - 1 if rs else 0          # reduce-scatter steps: u in [0, n_rs)
+    n_steps = n_rs + (P - 1 if allgather else 0)
 
     def send_chunk(u, dirn):
         # chunk forwarded at step u (RS: the one accumulated at u-1;
@@ -207,8 +210,16 @@ def _kernel(params_smem, x_hbm, out_hbm, comm_hbm, a_vmem, b_vmem,
                                device_id_type=pltpu.DeviceIdType.LOGICAL)
         pltpu.semaphore_wait(bar, 2)
 
-    # working copy: out <- x (HBM -> HBM local DMA)
-    init = pltpu.make_async_copy(x_hbm, out_hbm, copy_sem_a)
+    # working copy: out <- x (HBM -> HBM local DMA).  In the ag-only mode
+    # x is just MY block: it seeds chunk ``my`` and every other chunk is
+    # fully overwritten by an incoming land-direct RDMA before any read
+    # (send_chunk(u) = the chunk received at step u-1), so no size*block
+    # zero grid is ever materialized or streamed (review round 3).
+    if rs:
+        init = pltpu.make_async_copy(x_hbm, out_hbm, copy_sem_a)
+    else:
+        init = pltpu.make_async_copy(
+            x_hbm, out_hbm.at[pl.ds(my * rows, rows)], copy_sem_a)
     init.start()
     init.wait()
 
@@ -376,7 +387,7 @@ def _launch(x: jnp.ndarray, axis_name: str, size: int, tile_rows: int,
             interpret: bool, rot: int, allgather: bool,
             collective_id: int, bidirectional: bool = True,
             vma_on: bool = False, groups=None,
-            op: str = "sum") -> jnp.ndarray:
+            op: str = "sum", rs: bool = True) -> jnp.ndarray:
     """Shared pallas_call setup for both ring collectives; returns the
     padded [size*rows, _LANES] result grid.
 
@@ -390,17 +401,23 @@ def _launch(x: jnp.ndarray, axis_name: str, size: int, tile_rows: int,
     dtype = jnp.dtype(x.dtype)
     shape = x.shape
     n = int(np.prod(shape)) if shape else 1
-    rows, padded = _geometry(n, size, tile_rows)
-    flat = x.reshape(-1)
-    if padded != n:
-        flat = jnp.pad(flat, (0, padded - n))
-    grid_in = flat.reshape(size * rows, _LANES)
+    if rs:
+        rows, padded = _geometry(n, size, tile_rows)
+        flat = x.reshape(-1)
+        if padded != n:
+            flat = jnp.pad(flat, (0, padded - n))
+        grid_in = flat.reshape(size * rows, _LANES)
+    else:
+        # ag-only: x is ONE pre-padded chunk ([rows, _LANES] worth); the
+        # kernel seeds chunk ``my`` with it and the ring fills the rest
+        rows = n // _LANES
+        grid_in = x.reshape(rows, _LANES)
     flows = _flows(rows // tile_rows, bidirectional)
 
     kern = functools.partial(
         _kernel, axis_name=axis_name, size=size, rows=rows,
         tile_rows=tile_rows, flows=flows, rot=rot, allgather=allgather,
-        pipelined=not interpret, combine=_COMBINES[op])
+        pipelined=not interpret, combine=_COMBINES[op], rs=rs)
     compiler_params = None if interpret else pltpu.CompilerParams(
         collective_id=collective_id, has_side_effects=True)
     k = len(flows)
@@ -417,7 +434,9 @@ def _launch(x: jnp.ndarray, axis_name: str, size: int, tile_rows: int,
                   pl.BlockSpec(memory_space=pl.ANY)],
         out_specs=pl.BlockSpec(memory_space=pl.ANY),
         scratch_shapes=[
-            pl.ANY((2, rows, _LANES), dtype),            # RDMA landing zone
+            # RDMA landing zone — unused (1-row stub) in the ag-only mode,
+            # where RDMAs land directly in the output
+            pl.ANY((2, rows if rs else 1, _LANES), dtype),
             pltpu.VMEM((tile_rows, _LANES), dtype),
             pltpu.VMEM((tile_rows, _LANES), dtype),
             pltpu.SemaphoreType.DMA(()),
@@ -487,6 +506,44 @@ def pallas_ring_allreduce(x: jnp.ndarray, axis_name: str, size: int,
                   bidirectional=bidirectional, vma_on=vma_on, groups=groups,
                   op=op)
     return out.reshape(-1)[:n].reshape(shape)
+
+
+def pallas_ring_allgather(x: jnp.ndarray, axis_name: str, size: int,
+                          tile_rows: int = 256,
+                          interpret: bool = False,
+                          bidirectional: bool = True,
+                          groups=None) -> jnp.ndarray:
+    """Allgather: every rank contributes its block ``x``; returns the
+    stacked [size, *x.shape] grid in rank order.  The ALLGATHER-ONLY mode
+    of the unified ring kernel: P-1 pipelined land-direct RDMA steps (no
+    accumulation — each rank's chunk circulates straight into every
+    output), same credits/barriers/counter-rotating flows as the
+    allreduce.  f32/bf16; check_vma handling as in
+    :func:`pallas_ring_allreduce`."""
+    vma_on = _check_args(x, axis_name, size, tile_rows, "sum")
+    grank = _ring_params(axis_name, size, groups)[0]
+    if size == 1:
+        return x[None]
+    if vma_on and interpret:
+        from . import collectives as algos
+
+        return algos.ring_allgather(x, axis_name, size, grank,
+                                    _world_pairs_of(size, groups))
+    block_shape = x.shape
+    block_n = int(np.prod(block_shape)) if block_shape else 1
+    rows, _ = _geometry(block_n * size, size, tile_rows)
+    per_chunk = rows * _LANES
+    flat = x.reshape(-1)
+    if per_chunk != block_n:
+        flat = jnp.pad(flat, (0, per_chunk - block_n))
+    # only MY padded block crosses into the kernel — it seeds chunk
+    # ``grank`` in-kernel; every other chunk is written by the ring
+    out = _launch(flat, axis_name, size, tile_rows, interpret,
+                  rot=0, allgather=True, collective_id=15,
+                  bidirectional=bidirectional, vma_on=vma_on, groups=groups,
+                  rs=False)
+    out = out.reshape(size, per_chunk)[:, :block_n]
+    return out.reshape((size,) + block_shape)
 
 
 def pallas_ring_reduce_scatter(x: jnp.ndarray, axis_name: str, size: int,

@@ -106,7 +106,7 @@ def _accum_chunk(my: int, u: int, P: int, rot: int, dirn: int) -> int:
 
 
 def device_program(my: int, P: int, K: int, *, rot: int,
-                   allgather: bool,
+                   allgather: bool, rs: bool = True,
                    dirs: Optional[Tuple[int, ...]] = None) -> List[object]:
     """The pipelined ``_kernel`` body for device ``my`` as a static op list
     (the pipelined=True body of pallas_ring._kernel).
@@ -117,8 +117,9 @@ def device_program(my: int, P: int, K: int, *, rot: int,
     left, right = (my - 1) % P, (my + 1) % P
     dirs = dirs or (1,) * K
     F = len(dirs)
-    n_rs = P - 1
-    n_steps = 2 * (P - 1) if allgather else n_rs
+    # rs=False models the kernel's ALLGATHER-ONLY mode (zero RS steps)
+    n_rs = P - 1 if rs else 0
+    n_steps = n_rs + (P - 1 if allgather else 0)
     ops: List[object] = []
 
     # entry neighbor_barrier()
@@ -180,6 +181,7 @@ class RingSim:
     """One simulation run of P devices under a pluggable event policy."""
 
     def __init__(self, P: int, K: int, *, rot: int, allgather: bool,
+                 rs: bool = True,
                  track_data: bool = True,
                  program_override=None,
                  dirs: Optional[Tuple[int, ...]] = None):
@@ -188,20 +190,31 @@ class RingSim:
         self.P, self.K = P, K
         self.dirs = tuple(dirs) if dirs else (1,) * K
         F = len(self.dirs)
-        self.rot, self.allgather = rot, allgather
-        self.n_rs = P - 1
-        self.n_steps = 2 * (P - 1) if allgather else P - 1
+        self.rot, self.allgather, self.rs = rot, allgather, rs
+        self.n_rs = P - 1 if rs else 0
+        self.n_steps = self.n_rs + (P - 1 if allgather else 0)
         prog_fn = program_override or device_program
-        self.progs = [prog_fn(d, P, K, rot=rot, allgather=allgather,
-                              dirs=self.dirs)
-                      for d in range(P)]
+        kw = dict(rot=rot, allgather=allgather, dirs=self.dirs)
+        import inspect
+
+        sig = inspect.signature(prog_fn)
+        if "rs" in sig.parameters or any(
+                p.kind is inspect.Parameter.VAR_KEYWORD
+                for p in sig.parameters.values()):
+            kw["rs"] = rs
+        elif not rs:
+            raise ValueError("program_override does not model rs=False")
+        self.progs = [prog_fn(d, P, K, **kw) for d in range(P)]
         self.pc = [0] * P
         self.sems: List[Dict[SemKey, int]] = [dict() for _ in range(P)]
         self.dmas: List[Dma] = []
         self.track_data = track_data
         # out[d][(chunk, flow)] = set of contributions (rank, chunk, flow)
         # (flows own disjoint tile ranges, so a flow index IS a region)
-        self.out = [{(c, s): frozenset([(d, c, s)])
+        # allreduce/RS: every chunk holds the device's own contribution;
+        # ag-only: only the device's OWN chunk starts populated
+        self.out = [{(c, s): (frozenset([(d, c, s)])
+                              if rs or c == d else frozenset())
                      for c in range(P) for s in range(F)}
                     for d in range(P)]
         # comm[d][(slot, flow)] = (state, payload); landing double buffer
@@ -347,6 +360,19 @@ class RingSim:
         if not self.track_data:
             return
         P, F = self.P, len(self.dirs)
+        if not self.rs:
+            # ag-only: chunk c everywhere = device c's original block
+            for d in range(P):
+                for c in range(P):
+                    for s in range(F):
+                        got = self.out[d][(c, s)]
+                        want = frozenset([(c, c, s)])
+                        if got != want:
+                            raise ProtocolViolation(
+                                f"allgather data wrong on dev{d} chunk {c} "
+                                f"seg {s}: {sorted(got)} != {sorted(want)} "
+                                f"(invariant 5)")
+            return
         if self.allgather:
             for d in range(P):
                 for c in range(P):
@@ -427,6 +453,7 @@ class RingSim:
 
 
 def explore_all(P: int, K: int, *, rot: int, allgather: bool,
+                rs: bool = True,
                 dirs: Optional[Tuple[int, ...]] = None,
                 max_states: int = 2_000_000) -> int:
     """Exhaustive DFS over every interleaving (protocol state, no payload
@@ -434,8 +461,8 @@ def explore_all(P: int, K: int, *, rot: int, allgather: bool,
     run is complete, and every terminal state must have drained semaphores.
     Returns the number of distinct states visited."""
     def fresh():
-        return RingSim(P, K, rot=rot, allgather=allgather, track_data=False,
-                       dirs=dirs)
+        return RingSim(P, K, rot=rot, allgather=allgather, rs=rs,
+                       track_data=False, dirs=dirs)
 
     seen = set()
     root = fresh()

@@ -322,3 +322,26 @@ def test_overlapping_view_allowed_readonly(tmp_path):
         f.set_view(etype=np.int32, filetype=ovl)  # accepted
         # visible elements walk the overlapped tiling: 0,1,1,2,...
         assert np.array_equal(f.read_at(0, 4), [0, 1, 1, 2])
+
+
+def test_write_read_ordered_rank_order(tmp_path):
+    """write_ordered records land in RANK order (vs write_shared's race
+    order), with ragged per-rank sizes."""
+    path = str(tmp_path / "ordered.bin")
+
+    def prog(comm):
+        f = mio.file_open(comm, path, mio.MODE_CREATE | mio.MODE_RDWR,
+                          shared=True)
+        n = comm.rank + 1  # ragged: 1, 2, 3 elements
+        f.write_ordered(np.full(n, comm.rank, np.uint8))
+        comm.barrier()
+        back = f.read_ordered(n)  # second epoch starts after the first
+        f.close()
+        return back
+
+    res = run_local(prog, 3)
+    whole = np.fromfile(path, dtype=np.uint8)
+    assert np.array_equal(whole, [0, 1, 1, 2, 2, 2])
+    # the ordered read consumed nothing new (EOF): per-rank shorts
+    for r, back in enumerate(res):
+        assert back.size == 0

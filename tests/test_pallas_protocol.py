@@ -26,6 +26,7 @@ from mpi_tpu.tpu.ring_model import (
 
 ALLREDUCE = dict(rot=0, allgather=True)
 REDUCE_SCATTER = dict(rot=-1, allgather=False)
+ALLGATHER = dict(rot=0, allgather=True, rs=False)  # ag-only kernel mode
 
 
 # -- exhaustive: every interleaving of the small configs --------------------
@@ -35,7 +36,9 @@ REDUCE_SCATTER = dict(rot=-1, allgather=False)
     (2, 1, ALLREDUCE), (2, 1, REDUCE_SCATTER),
     (2, 2, ALLREDUCE), (2, 2, REDUCE_SCATTER),
     (3, 1, ALLREDUCE), (3, 1, REDUCE_SCATTER),
-], ids=["ar2x1", "rs2x1", "ar2x2", "rs2x2", "ar3x1", "rs3x1"])
+    (2, 2, ALLGATHER), (3, 1, ALLGATHER),
+], ids=["ar2x1", "rs2x1", "ar2x2", "rs2x2", "ar3x1", "rs3x1",
+        "ag2x2", "ag3x1"])
 def test_exhaustive_no_deadlock_and_drain(P, K, coll):
     """DFS over the full interleaving space: no reachable state deadlocks,
     every terminal state has drained semaphores."""
@@ -48,8 +51,8 @@ def test_exhaustive_no_deadlock_and_drain(P, K, coll):
 
 @pytest.mark.parametrize("policy", ["random", "eager_compute", "lazy_lifo",
                                     "dma_first"])
-@pytest.mark.parametrize("coll", [ALLREDUCE, REDUCE_SCATTER],
-                         ids=["allreduce", "reduce_scatter"])
+@pytest.mark.parametrize("coll", [ALLREDUCE, REDUCE_SCATTER, ALLGATHER],
+                         ids=["allreduce", "reduce_scatter", "allgather"])
 def test_schedules_all_P_K(policy, coll):
     for P in (2, 3, 4, 5, 8):
         for K in (1, 2, 3, 4):

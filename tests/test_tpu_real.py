@@ -189,3 +189,15 @@ def test_pallas_ring8_max_lowers_pipelined():
                 v.reshape(-1), "world", 8, tile_rows=64, op="max"),
             jax.ShapeDtypeStruct((8, 64 * 128), jnp.float32),
             check_vma=False)
+
+
+def test_pallas_allgather8_lowers_pipelined():
+    """The allgather-only kernel mode (rs=False: zero RS steps, P-1
+    land-direct steps) lowers through Mosaic for an 8-device ring."""
+    from mpi_tpu.tpu.pallas_ring import pallas_ring_allgather
+
+    for check_vma in (False, True):
+        _lower8(lambda c, v: pallas_ring_allgather(
+                    v.reshape(-1), "world", 8, tile_rows=64),
+                jax.ShapeDtypeStruct((8, 64 * 128 * 4), jnp.float32),
+                check_vma=check_vma)
