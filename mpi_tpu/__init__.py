@@ -30,7 +30,7 @@ from typing import Any, Callable, Optional
 from .version import __version__
 from . import ops
 from .ops import SUM, PROD, MAX, MIN, LAND, LOR, LXOR, BAND, BOR, BXOR, ReduceOp
-from .communicator import Communicator, P2PCommunicator, Request, Status
+from .communicator import Communicator, Message, P2PCommunicator, Request, Status
 from .transport.base import ANY_SOURCE, ANY_TAG
 from .transport.local import run_local
 from . import datatypes, errors, io, schedules, checker, checkpoint, profiling, trace
@@ -45,7 +45,7 @@ from .window import GetFuture, P2PWindow
 __all__ = [
     "__version__", "ops", "ReduceOp",
     "SUM", "PROD", "MAX", "MIN", "LAND", "LOR", "LXOR", "BAND", "BOR", "BXOR",
-    "Communicator", "P2PCommunicator", "Request", "Status", "ANY_SOURCE", "ANY_TAG",
+    "Communicator", "Message", "P2PCommunicator", "Request", "Status", "ANY_SOURCE", "ANY_TAG",
     "init", "finalize", "is_initialized", "run", "run_local",
     "schedules", "checker", "checkpoint", "profiling", "trace", "COMM_WORLD", "io",
     "CartComm", "GraphComm", "InterComm", "create_intercomm",

@@ -30,6 +30,7 @@ __all__ = [
     "MPI_Scan", "MPI_Reduce_scatter", "MPI_Isend", "MPI_Irecv", "MPI_Wait",
     "MPI_Test", "MPI_Waitall", "MPI_Waitany", "MPI_Waitsome", "MPI_Testall",
     "MPI_Testany", "MPI_Probe", "MPI_Iprobe", "MPI_Wtime",
+    "MPI_Mprobe", "MPI_Improbe", "MPI_Mrecv",
     "MPI_Send_init", "MPI_Recv_init", "MPI_Start", "MPI_Startall",
     "MPI_Ibcast", "MPI_Ireduce", "MPI_Iallreduce", "MPI_Iallgather",
     "MPI_Ialltoall", "MPI_Ibarrier", "MPI_Iscatter", "MPI_Igather",
@@ -55,6 +56,7 @@ __all__ = [
     "MPI_Type_create_resized", "MPI_Type_commit", "MPI_Type_free",
     "MPI_Type_size", "MPI_Type_get_extent",
     "MPI_Pack", "MPI_Unpack", "MPI_Pack_size", "Datatype",
+    "MPI_Pack_external", "MPI_Unpack_external",
     "MPI_COMM_SELF", "MPI_Get_count", "MPI_Get_elements",
     "MPI_SUCCESS", "MPI_ERRORS_ARE_FATAL", "MPI_ERRORS_RETURN",
     "MPI_Error_class", "MPI_Error_string", "ErrorCode",
@@ -635,11 +637,11 @@ def MPI_Get_version():
     collective two-phase writes), intercommunicators.  Selected MPI-3
     features exist beyond that (nonblocking collectives, neighborhood
     collectives on cartesian AND distributed-graph topologies,
-    Waitany/Waitsome/Testall/Testany, Mprobe-free matched receive via
-    per-comm contexts).  Known MPI-2 gaps, so (2, 0) and not higher:
-    no MPI_Pack_external /
-    external32 wire format, no C/Fortran interop chapter (meaningless
-    here), no MPI_Register_datarep."""
+    Waitany/Waitsome/Testall/Testany, matched probe Mprobe/Improbe/
+    Mrecv).  Known MPI-2 gaps, so (2, 0) and not higher:
+    no C/Fortran interop chapter (meaningless here), no
+    MPI_Register_datarep (external32 itself IS supported via
+    MPI_Pack_external/MPI_Unpack_external)."""
     return (2, 0)
 
 
@@ -685,6 +687,8 @@ MPI_Type_create_subarray = datatypes.type_create_subarray
 MPI_Type_create_struct = datatypes.type_create_struct
 MPI_Type_create_resized = datatypes.type_create_resized
 MPI_Pack = datatypes.pack
+MPI_Pack_external = datatypes.pack_external
+MPI_Unpack_external = datatypes.unpack_external
 MPI_Unpack = datatypes.unpack
 MPI_Pack_size = datatypes.pack_size
 Datatype = datatypes.Datatype
@@ -963,3 +967,21 @@ def MPI_Info_free(info: Info) -> None:
 
 def MPI_Info_get_nkeys(info: Info) -> int:
     return len(info)
+
+
+def MPI_Mprobe(source: int = ANY_SOURCE, tag: int = ANY_TAG,
+               comm: Optional[Communicator] = None,
+               status: Optional[Status] = None):
+    """Matched probe (MPI-3): returns an MPI_Message no other receive can
+    steal; consume with MPI_Mrecv."""
+    return _call(comm, "mprobe", source, tag, status)
+
+
+def MPI_Improbe(source: int = ANY_SOURCE, tag: int = ANY_TAG,
+                comm: Optional[Communicator] = None,
+                status: Optional[Status] = None):
+    return _call(comm, "improbe", source, tag, status)
+
+
+def MPI_Mrecv(message, status: Optional[Status] = None):
+    return message.recv(status)

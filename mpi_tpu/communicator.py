@@ -64,6 +64,12 @@ class Status:
         else:
             self.count_bytes = None
 
+    def _fill(self, source: int, tag: int, payload: Any) -> None:
+        """The one envelope-fill site (recv, mprobe/improbe, Mrecv)."""
+        self.source = source
+        self.tag = tag
+        self._set_count(payload)
+
     def __repr__(self) -> str:  # pragma: no cover - cosmetic
         return f"Status(source={self.source}, tag={self.tag})"
 
@@ -116,6 +122,31 @@ def _maybe_stack(local_payload: Any, items: List[Any]) -> Any:
             return items
         arrs.append(a)
     return np.stack(arrs)
+
+
+class Message:
+    """A matched-probe message handle (MPI_Message [S: MPI-3 ch.3.8]).
+
+    Produced by ``comm.mprobe``/``comm.improbe``; the message is already
+    OUT of the matching queues, so it can only be consumed here."""
+
+    __slots__ = ("source", "tag", "_payload", "_consumed")
+
+    def __init__(self, payload: Any, source: int, tag: int):
+        self._payload = payload
+        self.source = source
+        self.tag = tag
+        self._consumed = False
+
+    def recv(self, status: Optional[Status] = None) -> Any:
+        """MPI_Mrecv: consume the matched message (exactly once)."""
+        if self._consumed:
+            raise RuntimeError("MPI_Mrecv on an already-consumed message")
+        self._consumed = True
+        if status is not None:
+            status._fill(self.source, self.tag, self._payload)
+        payload, self._payload = self._payload, None
+        return payload
 
 
 class Request:
@@ -729,9 +760,7 @@ class P2PCommunicator(Communicator):
         obj, src, t = self._t.recv(src_world, self._ctx, tag,
                                    timeout=self.recv_timeout)
         if status is not None:
-            status.source = self._from_world(src)
-            status.tag = t
-            status._set_count(obj)
+            status._fill(self._from_world(src), t, obj)
         return obj
 
     def sendrecv(self, sendobj: Any, dest: int, source: int = ANY_SOURCE,
@@ -789,6 +818,35 @@ class P2PCommunicator(Communicator):
         if status is not None:
             status.source = self._from_world(s)
             status.tag = t
+
+    def mprobe(self, source: int = ANY_SOURCE, tag: int = ANY_TAG,
+               status: Optional[Status] = None) -> "Message":
+        """MPI_Mprobe [S: MPI-3 matched probe]: block for a matching
+        message and REMOVE it from matching — no other receive (wildcard
+        or not) can steal it; consume it later with ``message.recv()``.
+        The thread-safe probe+recv idiom MPI_Probe cannot provide."""
+        _check_user_tag(tag)
+        src_world = ANY_SOURCE if source == ANY_SOURCE else self._world(source)
+        obj, src, t = self._t.recv(src_world, self._ctx, tag,
+                                   timeout=self.recv_timeout)
+        msg = Message(obj, self._from_world(src), t)
+        if status is not None:
+            status._fill(msg.source, msg.tag, obj)
+        return msg
+
+    def improbe(self, source: int = ANY_SOURCE, tag: int = ANY_TAG,
+                status: Optional[Status] = None) -> Optional["Message"]:
+        """MPI_Improbe: non-blocking mprobe — a Message, or None."""
+        _check_user_tag(tag)
+        src_world = ANY_SOURCE if source == ANY_SOURCE else self._world(source)
+        hit = self._t.poll(src_world, self._ctx, tag)
+        if hit is None:
+            return None
+        obj, src, t = hit
+        msg = Message(obj, self._from_world(src), t)
+        if status is not None:
+            status._fill(msg.source, msg.tag, obj)
+        return msg
 
     def iprobe(self, source: int = ANY_SOURCE, tag: int = ANY_TAG,
                status: Optional[Status] = None) -> bool:

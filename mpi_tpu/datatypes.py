@@ -31,6 +31,7 @@ __all__ = [
     "Datatype", "type_contiguous", "type_vector", "type_indexed",
     "type_create_subarray", "type_create_struct", "type_create_resized",
     "from_structured", "pack", "unpack", "pack_size",
+    "pack_external", "unpack_external",
 ]
 
 BaseLike = Union[str, type, np.dtype, "Datatype"]
@@ -51,14 +52,21 @@ class Datatype:
     instance occupies when instances are replicated (``count > 1`` or an
     outer constructor), mirroring MPI extent semantics [S]."""
 
-    __slots__ = ("base_dtype", "indices", "extent", "lb", "_committed")
+    __slots__ = ("base_dtype", "indices", "extent", "lb", "elem_sizes",
+                 "_committed")
 
     def __init__(self, base_dtype: np.dtype, indices: np.ndarray, extent: int,
-                 lb: int = 0):
+                 lb: int = 0, elem_sizes: Optional[np.ndarray] = None):
         self.base_dtype = np.dtype(base_dtype)
         self.indices = np.asarray(indices, dtype=np.int64).reshape(-1)
         self.extent = int(extent)
         self.lb = int(lb)  # bookkeeping only (get_extent); never shifts the map
+        # byte-based (struct) maps only: per-ELEMENT byte lengths of one
+        # packed instance, in packed order — what external32 needs to
+        # byteswap field-wise (a whole-stream swap would be a no-op on
+        # uint8).  None ⇔ not a struct map / unknown (external32 refuses).
+        self.elem_sizes = (None if elem_sizes is None
+                           else np.asarray(elem_sizes, dtype=np.int64))
         self._committed = False
 
     # -- introspection (MPI_Type_size / MPI_Type_get_extent) ---------------
@@ -313,6 +321,7 @@ def type_create_struct(blocklengths: Sequence[int],
     if not (len(blocklengths) == len(displacements) == len(types)):
         raise ValueError("struct constructor argument lengths differ")
     parts = []
+    sizes = []  # per-element byte lengths, packed order (for external32)
     span = 0
     for n, d, t in zip(blocklengths, displacements, types):
         b = _as_base(t)
@@ -322,9 +331,17 @@ def type_create_struct(blocklengths: Sequence[int],
                     + np.arange(b.base_dtype.itemsize, dtype=np.int64)[None, :]
                     ).reshape(-1) + d
         parts.append(byte_idx)
+        if b.base_dtype == np.uint8:
+            sizes.append(None if b.elem_sizes is None
+                         else np.tile(b.elem_sizes, n))
+        else:
+            sizes.append(np.full(n * b.count, b.base_dtype.itemsize,
+                                 np.int64))
         span = max(span, d + n * b.extent_bytes)
     idx = np.concatenate(parts) if parts else np.empty(0, np.int64)
-    return Datatype(np.dtype(np.uint8), idx, span)
+    es = (np.concatenate(sizes) if sizes and all(s is not None for s in sizes)
+          else None)
+    return Datatype(np.dtype(np.uint8), idx, span, elem_sizes=es)
 
 
 def type_create_resized(base: BaseLike, lb: int, extent: int) -> Datatype:
@@ -354,7 +371,8 @@ def from_structured(dtype: Any) -> Datatype:
             types.append(fdt)
         disps.append(off)
     out = type_create_struct(lens, disps, types)
-    return Datatype(out.base_dtype, out.indices, dt.itemsize)
+    return Datatype(out.base_dtype, out.indices, dt.itemsize,
+                    elem_sizes=out.elem_sizes)
 
 
 # -- MPI_Pack / MPI_Unpack --------------------------------------------------
@@ -385,3 +403,57 @@ def unpack(packed: Union[bytes, bytearray, memoryview], datatype: Datatype,
 def pack_size(count: int, datatype: Datatype) -> int:
     """MPI_Pack_size: bytes needed for ``count`` instances."""
     return datatype.size * int(count)
+
+
+# -- external32 (MPI_Pack_external [S]) -------------------------------------
+
+
+def _swap_struct_bytes(raw: np.ndarray, datatype: Datatype,
+                       count: int) -> np.ndarray:
+    """Reverse each element's byte run in a packed struct stream (the
+    field-wise endianness flip; a whole-stream swap is a no-op on uint8)."""
+    if datatype.elem_sizes is None:
+        raise NotImplementedError(
+            "external32 needs per-element sizes, which this byte-based "
+            "datatype does not carry (composed byte maps); pack the "
+            "fields with elementary/struct datatypes instead")
+    import sys
+
+    if sys.byteorder == "big":  # memory order already IS external32
+        return raw
+    sizes = np.tile(datatype.elem_sizes, count)
+    out = raw.copy()
+    pos = 0
+    for s in sizes:
+        s = int(s)
+        if s > 1:
+            out[pos:pos + s] = out[pos:pos + s][::-1]
+        pos += s
+    return out
+
+
+def pack_external(buf: Any, datatype: Datatype, count: int = 1) -> bytes:
+    """MPI_Pack_external("external32"): the portable big-endian wire
+    format — same gather as :func:`pack`, bytes emitted big-endian so
+    heterogeneous receivers agree.  Struct (byte-based) maps byteswap
+    FIELD-WISE via the per-element sizes recorded at construction."""
+    data = datatype.pack(buf, count)
+    if datatype.base_dtype == np.uint8:
+        return _swap_struct_bytes(data, datatype, count).tobytes()
+    return data.astype(data.dtype.newbyteorder(">"), copy=False).tobytes()
+
+
+def unpack_external(packed: Any, datatype: Datatype, out: np.ndarray,
+                    count: int = 1, offset: int = 0) -> int:
+    """MPI_Unpack_external: consume big-endian instances; returns the new
+    byte offset."""
+    nbytes = datatype.size * count
+    chunk = bytes(packed[offset:offset + nbytes])
+    if datatype.base_dtype == np.uint8:
+        host = _swap_struct_bytes(np.frombuffer(chunk, np.uint8),
+                                  datatype, count)
+        datatype.unpack(host, out, count)
+        return offset + nbytes
+    be = np.frombuffer(chunk, dtype=datatype.base_dtype.newbyteorder(">"))
+    datatype.unpack(be.astype(datatype.base_dtype), out, count)
+    return offset + nbytes

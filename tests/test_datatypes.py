@@ -403,3 +403,45 @@ def test_unpack_jax_validates_payload():
         c.unpack_jax(np.array([7, 8], np.int32), np.zeros(4, np.float32))
     with pytest.raises(ValueError, match="payload has"):
         c.unpack_jax(np.float32(5.0), np.zeros(4, np.float32))
+
+
+def test_pack_external_big_endian_roundtrip():
+    """external32 wire bytes are big-endian regardless of host order."""
+    t = dt.type_vector(2, 1, 2, np.int32).commit()
+    buf = np.array([0x01020304, 0, 0x0A0B0C0D, 0], np.int32)
+    wire = dt.pack_external(buf, t)
+    assert wire == bytes([1, 2, 3, 4, 0x0A, 0x0B, 0x0C, 0x0D])
+    out = np.zeros(4, np.int32)
+    used = dt.unpack_external(wire, t, out)
+    assert used == 8
+    assert np.array_equal(out, [0x01020304, 0, 0x0A0B0C0D, 0])
+
+
+def test_pack_external_struct_field_wise():
+    """Struct (byte-based) maps byteswap FIELD-WISE — a whole-stream
+    swap on uint8 is a no-op and would leak host endianness (review
+    round 3)."""
+    t = dt.type_create_struct([1, 1], [0, 4], [np.int32, np.int16]).commit()
+    buf = np.zeros(8, np.uint8)
+    np.frombuffer(buf, np.int32, 1, 0)[:] = [0x01020304]
+    np.frombuffer(buf, np.int16, 1, 4)[:] = [0x0A0B]
+    wire = dt.pack_external(buf, t)
+    assert wire == bytes([1, 2, 3, 4, 0x0A, 0x0B])  # big-endian per field
+    out = np.zeros(8, np.uint8)
+    dt.unpack_external(wire, t, out)
+    assert np.frombuffer(out, np.int32, 1, 0)[0] == 0x01020304
+    assert np.frombuffer(out, np.int16, 1, 4)[0] == 0x0A0B
+
+
+def test_pack_external_structured_dtype_and_count():
+    rec = np.dtype([("a", np.int32), ("b", np.int16)])
+    t = dt.from_structured(rec).commit()
+    buf = np.zeros(2, rec)
+    buf["a"] = [0x01020304, 0x11121314]
+    buf["b"] = [0x0A0B, 0x1A1B]
+    wire = dt.pack_external(buf, t, count=2)
+    assert wire[:4] == bytes([1, 2, 3, 4]) and wire[4:6] == bytes([0x0A, 0x0B])
+    assert wire[6:10] == bytes([0x11, 0x12, 0x13, 0x14])
+    out = np.zeros(2, rec)
+    dt.unpack_external(wire, t, out, count=2)
+    assert np.array_equal(out["a"], buf["a"]) and np.array_equal(out["b"], buf["b"])

@@ -384,3 +384,55 @@ def test_waitany_drain_loop_visits_each_request_once():
     got, exhausted = run_local(prog, 2)[1]
     assert got == [(0, "first"), (1, "second")], got
     assert exhausted == (None, None)
+
+
+# -- matched probe (MPI-3 Mprobe/Mrecv, round 3) ----------------------------
+
+
+def test_mprobe_removes_from_matching():
+    """After mprobe, a wildcard recv CANNOT steal the matched message —
+    the guarantee plain probe lacks."""
+    import numpy as np
+
+    from mpi_tpu import Status
+
+    def prog(comm):
+        if comm.rank == 0:
+            comm.send(np.arange(4.0), dest=1, tag=5)
+            comm.send("other", dest=1, tag=6)
+            return None
+        st = Status()
+        msg = comm.mprobe(source=0, tag=5, status=st)
+        assert st.tag == 5 and st.count_bytes == 32
+        # the tag-5 message is out of matching: ANY_TAG sees only tag 6
+        assert comm.recv(source=0, tag=-1) == "other"
+        got = msg.recv()
+        assert np.array_equal(got, np.arange(4.0))
+        with pytest.raises(RuntimeError, match="already-consumed"):
+            msg.recv()
+        return True
+
+    assert run_local(prog, 2)[1] is True
+
+
+def test_improbe_nonblocking():
+    def prog(comm):
+        if comm.rank == 0:
+            assert comm.improbe(source=1, tag=9) is None  # nothing yet
+            comm.barrier()
+            comm.barrier()
+            # message definitely delivered between the barriers
+            for _ in range(2000):
+                m = comm.improbe(source=1, tag=9)
+                if m is not None:
+                    return m.recv()
+                import time
+
+                time.sleep(0.001)
+            raise AssertionError("improbe never matched")
+        comm.barrier()
+        comm.send({"x": 1}, dest=0, tag=9)
+        comm.barrier()
+        return None
+
+    assert run_local(prog, 2)[0] == {"x": 1}
