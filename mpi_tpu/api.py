@@ -984,4 +984,10 @@ def MPI_Improbe(source: int = ANY_SOURCE, tag: int = ANY_TAG,
 
 
 def MPI_Mrecv(message, status: Optional[Status] = None):
-    return message.recv(status)
+    try:
+        return message.recv(status)
+    except Exception as exc:  # noqa: BLE001 - same boundary as every MPI_*
+        c = getattr(message, "_comm", None)
+        if c is None:
+            raise
+        return errors.invoke_handler(c, exc)

@@ -130,13 +130,14 @@ class Message:
     Produced by ``comm.mprobe``/``comm.improbe``; the message is already
     OUT of the matching queues, so it can only be consumed here."""
 
-    __slots__ = ("source", "tag", "_payload", "_consumed")
+    __slots__ = ("source", "tag", "_payload", "_consumed", "_comm")
 
-    def __init__(self, payload: Any, source: int, tag: int):
+    def __init__(self, payload: Any, source: int, tag: int, comm=None):
         self._payload = payload
         self.source = source
         self.tag = tag
         self._consumed = False
+        self._comm = comm  # lets MPI_Mrecv honor the comm's errhandler
 
     def recv(self, status: Optional[Status] = None) -> Any:
         """MPI_Mrecv: consume the matched message (exactly once)."""
@@ -829,7 +830,7 @@ class P2PCommunicator(Communicator):
         src_world = ANY_SOURCE if source == ANY_SOURCE else self._world(source)
         obj, src, t = self._t.recv(src_world, self._ctx, tag,
                                    timeout=self.recv_timeout)
-        msg = Message(obj, self._from_world(src), t)
+        msg = Message(obj, self._from_world(src), t, comm=self)
         if status is not None:
             status._fill(msg.source, msg.tag, obj)
         return msg
@@ -843,7 +844,7 @@ class P2PCommunicator(Communicator):
         if hit is None:
             return None
         obj, src, t = hit
-        msg = Message(obj, self._from_world(src), t)
+        msg = Message(obj, self._from_world(src), t, comm=self)
         if status is not None:
             status._fill(msg.source, msg.tag, obj)
         return msg

@@ -43,6 +43,9 @@ def _as_base(base: BaseLike) -> "Datatype":
     dt = np.dtype(base)
     if dt.names:  # structured dtype: byte-based map over its fields
         return from_structured(dt)
+    if dt == np.uint8:  # MPI_BYTE: endian-neutral, external32 identity
+        return Datatype(dt, np.arange(1, dtype=np.int64), 1,
+                        elem_sizes=np.ones(1, np.int64))
     return Datatype(dt, np.arange(1, dtype=np.int64), 1)
 
 
@@ -247,10 +250,19 @@ class Datatype:
 # -- constructors (MPI_Type_*) ---------------------------------------------
 
 
+def _tile_es(b: "Datatype", n: int) -> Optional[np.ndarray]:
+    """Replicate a byte-based base's per-element sizes through a derived
+    constructor (element order is preserved by every constructor)."""
+    if b.base_dtype != np.uint8 or b.elem_sizes is None:
+        return None
+    return np.tile(b.elem_sizes, n)
+
+
 def type_contiguous(count: int, base: BaseLike) -> Datatype:
     """MPI_Type_contiguous: ``count`` back-to-back instances of ``base``."""
     b = _as_base(base)
-    return Datatype(b.base_dtype, b._tiled(int(count)), int(count) * b.extent)
+    return Datatype(b.base_dtype, b._tiled(int(count)), int(count) * b.extent,
+                    elem_sizes=_tile_es(b, int(count)))
 
 
 def type_vector(count: int, blocklength: int, stride: int,
@@ -264,7 +276,8 @@ def type_vector(count: int, blocklength: int, stride: int,
     block = b._tiled(blocklength)
     idx = (starts[:, None] + block[None, :]).reshape(-1)
     extent = ((count - 1) * stride + blocklength) * b.extent if count else 0
-    return Datatype(b.base_dtype, idx, extent)
+    return Datatype(b.base_dtype, idx, extent,
+                    elem_sizes=_tile_es(b, count * blocklength))
 
 
 def type_indexed(blocklengths: Sequence[int], displacements: Sequence[int],
@@ -281,7 +294,8 @@ def type_indexed(blocklengths: Sequence[int], displacements: Sequence[int],
         parts.append(d * b.extent + b._tiled(n))
         span = max(span, (d + n) * b.extent)
     idx = np.concatenate(parts) if parts else np.empty(0, np.int64)
-    return Datatype(b.base_dtype, idx, span)
+    total = sum(int(n) for n in blocklengths)
+    return Datatype(b.base_dtype, idx, span, elem_sizes=_tile_es(b, total))
 
 
 def type_create_subarray(sizes: Sequence[int], subsizes: Sequence[int],
@@ -306,10 +320,12 @@ def type_create_subarray(sizes: Sequence[int], subsizes: Sequence[int],
     idx = np.asarray(flat_idx, dtype=np.int64).reshape(-1)
     n_elems = int(np.prod(sizes)) if sizes else 1
     # compose with a non-trivial base by expanding each element slot
+    n_sel = idx.size
     if b.count != 1 or b.extent != 1:
         idx = (idx[:, None] * b.extent + b.indices[None, :]).reshape(-1)
         n_elems *= b.extent
-    return Datatype(b.base_dtype, idx, n_elems)
+    return Datatype(b.base_dtype, idx, n_elems,
+                    elem_sizes=_tile_es(b, n_sel))
 
 
 def type_create_struct(blocklengths: Sequence[int],
@@ -334,6 +350,11 @@ def type_create_struct(blocklengths: Sequence[int],
         if b.base_dtype == np.uint8:
             sizes.append(None if b.elem_sizes is None
                          else np.tile(b.elem_sizes, n))
+        elif b.base_dtype.kind == "c":
+            # complex = two independently-endian components: swapping the
+            # whole element would also swap real/imag order on the wire
+            sizes.append(np.full(n * b.count * 2,
+                                 b.base_dtype.itemsize // 2, np.int64))
         else:
             sizes.append(np.full(n * b.count, b.base_dtype.itemsize,
                                  np.int64))
@@ -350,7 +371,8 @@ def type_create_resized(base: BaseLike, lb: int, extent: int) -> Datatype:
     (units of the base dtype) controls where replicated instances land;
     ``lb`` is recorded for MPI_Type_get_extent."""
     b = _as_base(base)
-    return Datatype(b.base_dtype, b.indices, int(extent), lb=int(lb))
+    return Datatype(b.base_dtype, b.indices, int(extent), lb=int(lb),
+                    elem_sizes=b.elem_sizes)
 
 
 def from_structured(dtype: Any) -> Datatype:
@@ -422,13 +444,26 @@ def _swap_struct_bytes(raw: np.ndarray, datatype: Datatype,
     if sys.byteorder == "big":  # memory order already IS external32
         return raw
     sizes = np.tile(datatype.elem_sizes, count)
+    uniq = np.unique(sizes)
+    if uniq.size == 1:
+        s = int(uniq[0])
+        if s <= 1:
+            return raw
+        return np.ascontiguousarray(raw.reshape(-1, s)[:, ::-1]).reshape(-1)
+    # mixed field sizes: reverse runs of equal size in vectorized groups
     out = raw.copy()
+    bounds = np.concatenate(([0], np.cumsum(sizes)))
     pos = 0
-    for s in sizes:
-        s = int(s)
+    while pos < sizes.size:
+        s = int(sizes[pos])
+        end = pos
+        while end < sizes.size and sizes[end] == s:
+            end += 1
         if s > 1:
-            out[pos:pos + s] = out[pos:pos + s][::-1]
-        pos += s
+            b0, b1 = int(bounds[pos]), int(bounds[end])
+            out[b0:b1] = np.ascontiguousarray(
+                out[b0:b1].reshape(-1, s)[:, ::-1]).reshape(-1)
+        pos = end
     return out
 
 

@@ -445,3 +445,45 @@ def test_pack_external_structured_dtype_and_count():
     out = np.zeros(2, rec)
     dt.unpack_external(wire, t, out, count=2)
     assert np.array_equal(out["a"], buf["a"]) and np.array_equal(out["b"], buf["b"])
+
+
+def test_pack_external_complex_component_wise():
+    """complex members swap per 4/8-byte COMPONENT, not per element —
+    whole-element reversal would swap real/imag on the wire."""
+    t = dt.type_create_struct([1], [0], [np.complex64]).commit()
+    buf = np.zeros(8, np.uint8)
+    np.frombuffer(buf, np.complex64)[:] = [1 + 2j]
+    wire = dt.pack_external(buf, t)
+    assert wire == bytes.fromhex("3f80000040000000")  # real then imag, BE
+    out = np.zeros(8, np.uint8)
+    dt.unpack_external(wire, t, out)
+    assert np.frombuffer(out, np.complex64)[0] == 1 + 2j
+
+
+def test_pack_external_bytes_and_resized():
+    """MPI_BYTE external32 is the identity; resized structs keep their
+    swap metadata."""
+    byte_t = dt.type_contiguous(4, np.uint8).commit()
+    assert dt.pack_external(np.arange(4, dtype=np.uint8), byte_t) == bytes([0, 1, 2, 3])
+    mixed = dt.type_create_struct([1, 2], [0, 4], [np.int32, np.uint8]).commit()
+    buf = np.zeros(6, np.uint8)
+    np.frombuffer(buf, np.int32, 1, 0)[:] = [0x01020304]
+    buf[4:6] = [9, 8]
+    assert dt.pack_external(buf, mixed) == bytes([1, 2, 3, 4, 9, 8])
+    rs = dt.type_create_resized(mixed, 0, 8).commit()
+    assert dt.pack_external(np.zeros(8, np.uint8), rs) is not None
+
+
+def test_mrecv_honors_errhandler():
+    from mpi_tpu import api, errors
+
+    def prog(comm):
+        comm.set_errhandler(errors.ERRORS_RETURN)
+        comm.send("x", dest=0, tag=1)
+        msg = comm.mprobe(source=0, tag=1)
+        assert api.MPI_Mrecv(msg) == "x"
+        code = api.MPI_Mrecv(msg)  # second consume: ErrorCode, not raise
+        assert isinstance(code, errors.ErrorCode)
+        comm.set_errhandler(errors.ERRORS_ARE_FATAL)
+
+    run_local(prog, 1)
