@@ -6,6 +6,7 @@ import numpy as np
 import pytest
 
 from benchmarks.osu import busbw_gbps, parse_size, parse_sizes, run_bench
+from mpi_tpu.transport.local import run_local
 
 
 def test_parse_size():
@@ -72,3 +73,23 @@ def test_gen_baseline_quick_regenerates(tmp_path, monkeypatch):
         assert section in text
     # every backend family reported
     assert {r.get("backend") for r in ok} >= {"local", "tpu", "socket", "shm"}
+
+
+def test_io_bench_smoke():
+    """The IOR-style MPI-IO bench runs every pattern with sane
+    bandwidths; the bench's read epochs assert content correctness
+    themselves (own-record fill values; cross-rank clobbers fail)."""
+    import benchmarks.io_bench as iob
+
+    class A:
+        sizes = [4096]
+        blocks = 3
+        iters = 1
+        patterns = list(iob.PATTERNS)
+
+    rows_by_rank = run_local(lambda c: iob.worker(c, A), 4)
+    rows = rows_by_rank[0]
+    assert len(rows) == 3
+    for r in rows:
+        assert r["write_gbps"] > 0 and r["read_gbps"] > 0
+        assert r["nranks"] == 4
