@@ -96,9 +96,12 @@ def _bench_pattern(comm, path: str, pattern: str, size: int,
 
 
 def worker(comm, args) -> List[dict]:
+    import shutil
+
     rows = []
-    with tempfile.TemporaryDirectory() as td:
-        base = comm.bcast(td, 0)
+    base = comm.bcast(tempfile.mkdtemp(prefix="io_bench_")
+                      if comm.rank == 0 else None, 0)
+    try:
         for pattern in args.patterns:
             for size in args.sizes:
                 path = os.path.join(base, f"io_{pattern}_{size}.bin")
@@ -108,6 +111,10 @@ def worker(comm, args) -> List[dict]:
                     print(json.dumps(row), flush=True)
                 rows.append(row)
                 comm.barrier()
+    finally:
+        comm.barrier()
+        if comm.rank == 0:
+            shutil.rmtree(base, ignore_errors=True)
     return rows
 
 

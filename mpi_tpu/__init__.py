@@ -39,7 +39,8 @@ from .topology import (CartComm, GraphComm, cart_create,
                        dims_create, dist_graph_create_adjacent,
                        graph_create)
 from .group import Group
-from .spawn import comm_get_parent, comm_spawn, comm_spawn_multiple
+from .spawn import (comm_accept, comm_connect, comm_get_parent, comm_spawn,
+                    comm_spawn_multiple, close_port, open_port)
 from .window import GetFuture, P2PWindow
 
 __all__ = [
@@ -53,6 +54,7 @@ __all__ = [
     "dist_graph_create_adjacent", "dims_create", "Group",
     "GetFuture", "P2PWindow",
     "comm_spawn", "comm_spawn_multiple", "comm_get_parent",
+    "open_port", "close_port", "comm_accept", "comm_connect",
 ]
 
 _ENV_RANK = "MPI_TPU_RANK"

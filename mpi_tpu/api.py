@@ -66,6 +66,7 @@ __all__ = [
     "MPI_COMM_NULL_COPY_FN", "MPI_NO_COPY", "Keyval",
     "MPI_Comm_set_attr", "MPI_Comm_get_attr", "MPI_Comm_delete_attr",
     "MPI_Comm_spawn", "MPI_Comm_spawn_multiple", "MPI_Comm_get_parent",
+    "MPI_Open_port", "MPI_Close_port", "MPI_Comm_accept", "MPI_Comm_connect",
     "MPI_File_open", "MPI_File_close", "MPI_File_delete",
     "MPI_File_read_at", "MPI_File_write_at",
     "MPI_File_read_at_all", "MPI_File_write_at_all",
@@ -822,6 +823,32 @@ def MPI_Comm_get_parent():
     from .spawn import comm_get_parent
 
     return comm_get_parent()
+
+
+def MPI_Open_port() -> str:
+    from .spawn import open_port
+
+    return open_port()
+
+
+def MPI_Close_port(port_name: str) -> None:
+    from .spawn import close_port
+
+    close_port(port_name)
+
+
+def MPI_Comm_accept(port_name: str, root: int = 0,
+                    comm: Optional[Communicator] = None):
+    from .spawn import comm_accept
+
+    return comm_accept(port_name, comm, root)
+
+
+def MPI_Comm_connect(port_name: str, root: int = 0,
+                     comm: Optional[Communicator] = None):
+    from .spawn import comm_connect
+
+    return comm_connect(port_name, comm, root)
 
 
 # -- MPI-IO (MPI-2 ch.9; mpi_tpu/io.py) -------------------------------------

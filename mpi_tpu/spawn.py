@@ -159,3 +159,171 @@ def comm_get_parent() -> Optional[InterComm]:
     _parent_intercomm = InterComm(union, list(range(psize, total)),
                                   list(range(psize)))
     return _parent_intercomm
+
+
+# -- establishing communication between independent jobs --------------------
+# (MPI-2 ch.5.4: MPI_Open_port / MPI_Comm_accept / MPI_Comm_connect [S])
+#
+# Protocol (port dir = a mailbox of handshake files; every round gets its
+# OWN fresh bridge rendezvous so ports are reusable and close_port after
+# establishment cannot break lazy peer discovery — round-3 review):
+#
+#   connect root:  writes  connect.<uuid>.json  {size: B, reply_dir: D}
+#                  (D is a CLIENT-owned tempdir — the reply must not live
+#                  in the port dir, where a server's close_port right
+#                  after accept() returns could delete it before the
+#                  client reads it)
+#   accept root:   CLAIMS one request by atomic rename to claimed.<uuid>,
+#                  makes a fresh bridge rdv dir, writes D/accept.json
+#                  {size: A, rdv: <bridge dir>}
+#   connect root:  polls D/accept.json, then cleans D up itself
+#
+# Both sides then build the bridge world (acceptors 0..A-1, connectors
+# A..A+B-1) over the per-round rdv.  Concurrent clients queue naturally
+# (one claim per accept call); meta files are consumed by the rename.
+
+
+def open_port() -> str:
+    """MPI_Open_port: a name another, independently started job can
+    connect to.  Spelled as a rendezvous directory (the same file-based
+    discovery the transports use); pass it out of band (argv, env, a
+    file) like an MPI port string.  NOT auto-deleted: the port must
+    outlive its creator until :func:`close_port`."""
+    return tempfile.mkdtemp(prefix="mpi_tpu_port_")
+
+
+def close_port(port_name: str) -> None:
+    """MPI_Close_port.  Safe after accept/connect returned: each round's
+    bridge uses its own rendezvous dir, not the port dir."""
+    shutil.rmtree(port_name, ignore_errors=True)
+
+
+def _publish(path: str, payload: dict) -> None:
+    import json
+
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(payload, f)
+    os.replace(tmp, path)  # atomic publish
+
+
+def _poll_for(fn, timeout: float, what: str):
+    import time
+
+    deadline = time.monotonic() + timeout
+    while True:
+        got = fn()
+        if got is not None:
+            return got
+        if time.monotonic() > deadline:
+            raise TimeoutError(
+                f"no peer {what} within {timeout}s (is the other side "
+                f"running?)")
+        time.sleep(0.02)
+
+
+def _root_exchange(comm, root: int, fn):
+    """Run ``fn`` at root, broadcast (outcome, value) so a root failure
+    raises on EVERY rank instead of deadlocking peers in the bcast (the
+    io.py collective-open pattern)."""
+    if comm.rank == root:
+        try:
+            result = ("ok", fn())
+        except Exception as e:  # noqa: BLE001 - re-raised everywhere below
+            result = ("err", f"{type(e).__name__}: {e}")
+    else:
+        result = None
+    kind, value = comm.bcast(result, root)
+    if kind == "err":
+        raise TimeoutError(f"port handshake failed at root: {value}")
+    return value
+
+
+def comm_accept(port_name: str, comm: Optional[Communicator] = None,
+                root: int = 0, timeout: float = 120.0) -> InterComm:
+    """MPI_Comm_accept: collective over the server job's ``comm``; blocks
+    until a client job calls :func:`comm_connect` on the same port, then
+    returns the intercommunicator (clients are the remote group).
+    Reusable: call it again on the same port for the next client."""
+    comm = _require_process_comm(comm, "comm_accept")
+
+    def handshake():
+        import json
+
+        def try_claim():
+            for name in sorted(os.listdir(port_name)):
+                if name.startswith("connect.") and name.endswith(".json"):
+                    token = name[len("connect."):-len(".json")]
+                    claimed = os.path.join(port_name, f"claimed.{token}")
+                    try:
+                        os.rename(os.path.join(port_name, name), claimed)
+                    except OSError:
+                        continue  # another round won the race
+                    with open(claimed) as f:
+                        meta = json.load(f)
+                    os.unlink(claimed)
+                    return int(meta["size"]), meta["reply_dir"]
+            return None
+
+        remote, reply_dir = _poll_for(try_claim, timeout,
+                                      f"connected to port {port_name!r}")
+        rdv = tempfile.mkdtemp(prefix="mpi_tpu_bridge_")
+        _tmpdirs.append(rdv)  # bridge rdv dies with the server process
+        _publish(os.path.join(reply_dir, "accept.json"),
+                 {"size": comm.size, "rdv": rdv})
+        return remote, rdv
+
+    remote, rdv = _root_exchange(comm, root, handshake)
+    total = comm.size + remote
+    union = _bridge_comm(comm.rank, total, rdv)
+    return InterComm(union, list(range(comm.size)),
+                     list(range(comm.size, total)))
+
+
+def comm_connect(port_name: str, comm: Optional[Communicator] = None,
+                 root: int = 0, timeout: float = 120.0) -> InterComm:
+    """MPI_Comm_connect: the client side of :func:`comm_accept`."""
+    comm = _require_process_comm(comm, "comm_connect")
+
+    def handshake():
+        import json
+        import uuid
+
+        token = uuid.uuid4().hex
+        reply_dir = tempfile.mkdtemp(prefix="mpi_tpu_reply_")
+        _publish(os.path.join(port_name, f"connect.{token}.json"),
+                 {"size": comm.size, "reply_dir": reply_dir})
+        reply = os.path.join(reply_dir, "accept.json")
+
+        def read_reply():
+            try:
+                with open(reply) as f:
+                    meta = json.load(f)
+                return int(meta["size"]), meta["rdv"]
+            except (OSError, ValueError, KeyError):
+                return None
+
+        try:
+            accept_size, rdv = _poll_for(read_reply, timeout,
+                                         f"accepted at port {port_name!r}")
+        finally:
+            shutil.rmtree(reply_dir, ignore_errors=True)
+        return accept_size, rdv
+
+    accept_size, rdv = _root_exchange(comm, root, handshake)
+    total = accept_size + comm.size
+    union = _bridge_comm(accept_size + comm.rank, total, rdv)
+    return InterComm(union, list(range(accept_size, total)),
+                     list(range(accept_size)))
+
+
+def _require_process_comm(comm, what: str) -> P2PCommunicator:
+    if comm is None:
+        from . import init
+
+        comm = init()
+    if not isinstance(comm, P2PCommunicator):
+        raise NotImplementedError(
+            f"{what} is a process-backend feature (it binds OS sockets); "
+            "SPMD worlds cannot establish socket connections")
+    return comm

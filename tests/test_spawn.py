@@ -112,3 +112,111 @@ def test_spawn_rejects_spmd_comm():
 
 def test_get_parent_none_when_not_spawned():
     assert spawn.comm_get_parent() is None
+
+
+# -- connect/accept between independent jobs (MPI-2 ch.5.4, round 3) --------
+
+
+def test_connect_accept_joins_independent_jobs(tmp_path):
+    """A server job (2 in-process ranks) accepts a client job (thread
+    world started independently); p2p + inter-collectives flow across."""
+    import threading
+
+    port = spawn.open_port()
+    results = {}
+
+    def server():
+        def prog(comm):
+            inter = spawn.comm_accept(port, comm=comm)
+            assert inter.remote_size == 1 and inter.size == 2
+            if comm.rank == 0:
+                got = inter.recv(source=0)
+                inter.send(got * 2, dest=0)
+            comm.barrier()
+            theirs = inter.allgather(("srv", comm.rank))
+            inter.free()
+            return theirs
+
+        results["server"] = run_local(prog, 2)
+
+    def client():
+        def prog(comm):
+            inter = spawn.comm_connect(port, comm=comm)
+            assert inter.remote_size == 2 and inter.size == 1
+            inter.send(21, dest=0)
+            assert inter.recv(source=0) == 42
+            theirs = inter.allgather(("cli", comm.rank))
+            inter.free()
+            return theirs
+
+        results["client"] = run_local(prog, 1)
+
+    ts = threading.Thread(target=server)
+    tc = threading.Thread(target=client)
+    ts.start(); tc.start()
+    ts.join(120); tc.join(120)
+    assert not ts.is_alive() and not tc.is_alive()
+    # each side sees the REMOTE group's contributions in remote rank order
+    assert results["server"][0] == [("cli", 0)]
+    assert results["client"][0] == [("srv", 0), ("srv", 1)]
+    spawn.close_port(port)
+
+
+def test_connect_timeout_is_loud(tmp_path):
+    port = spawn.open_port()
+    with pytest.raises(TimeoutError, match="other side"):
+        spawn.comm_connect(port, comm=mpi_tpu.comm_self(), timeout=0.3)
+    spawn.close_port(port)
+
+
+def test_port_reusable_and_close_after_accept_safe():
+    """A server accepts TWO sequential clients on one port (per-round
+    bridge rendezvous), and close_port after establishment does not break
+    later intercomm traffic (review round 3)."""
+    import threading
+
+    port = spawn.open_port()
+    results = {}
+
+    def server():
+        comm = mpi_tpu.comm_self()
+        inters = [spawn.comm_accept(port, comm=comm) for _ in range(2)]
+        spawn.close_port(port)  # port gone; bridges must keep working
+        got = []
+        for inter in inters:
+            x = inter.recv(source=0)
+            inter.send(x * 10, dest=0)
+            got.append(x)
+            inter.free()
+        results["server"] = sorted(got)
+
+    def client(k):
+        comm = mpi_tpu.comm_self()
+        inter = spawn.comm_connect(port, comm=comm)
+        inter.send(k, dest=0)
+        results[f"cli{k}"] = inter.recv(source=0)
+        inter.free()
+
+    ts = threading.Thread(target=server)
+    t1 = threading.Thread(target=client, args=(1,))
+    t2 = threading.Thread(target=client, args=(2,))
+    ts.start(); t1.start(); t2.start()
+    for t in (ts, t1, t2):
+        t.join(90)
+    assert not any(t.is_alive() for t in (ts, t1, t2))
+    assert results["server"] == [1, 2]
+    assert results["cli1"] == 10 and results["cli2"] == 20
+
+
+def test_accept_timeout_raises_on_every_rank():
+    """A handshake timeout must raise everywhere, not strand non-root
+    ranks in the outcome bcast (review round 3)."""
+    port = spawn.open_port()
+
+    def prog(comm):
+        with pytest.raises(TimeoutError, match="handshake|other side"):
+            spawn.comm_accept(port, comm=comm, timeout=0.3)
+        return "ok"
+
+    assert run_local(prog, 2) == ["ok", "ok"]
+    spawn.close_port(port)
