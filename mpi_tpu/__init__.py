@@ -40,7 +40,8 @@ from .topology import (CartComm, GraphComm, cart_create,
                        graph_create)
 from .group import Group
 from .spawn import (comm_accept, comm_connect, comm_get_parent, comm_spawn,
-                    comm_spawn_multiple, close_port, open_port)
+                    comm_spawn_multiple, close_port, lookup_name, open_port,
+                    publish_name, unpublish_name)
 from .window import GetFuture, P2PWindow
 
 __all__ = [
@@ -55,6 +56,7 @@ __all__ = [
     "GetFuture", "P2PWindow",
     "comm_spawn", "comm_spawn_multiple", "comm_get_parent",
     "open_port", "close_port", "comm_accept", "comm_connect",
+    "publish_name", "unpublish_name", "lookup_name",
 ]
 
 _ENV_RANK = "MPI_TPU_RANK"

@@ -47,6 +47,8 @@ __all__ = [
     "MPI_Comm_group", "MPI_Comm_create", "MPI_Comm_create_group",
     "MPI_Win_create", "MPI_Win_fence", "MPI_Win_free",
     "MPI_Win_lock", "MPI_Win_unlock",
+    "MPI_Win_post", "MPI_Win_start", "MPI_Win_complete", "MPI_Win_wait",
+    "MPI_Win_test",
     "MPI_Put", "MPI_Get", "MPI_Accumulate",
     "MPI_Group_incl", "MPI_Group_excl", "MPI_Group_union",
     "MPI_Group_intersection", "MPI_Group_difference", "MPI_Group_size",
@@ -67,6 +69,7 @@ __all__ = [
     "MPI_Comm_set_attr", "MPI_Comm_get_attr", "MPI_Comm_delete_attr",
     "MPI_Comm_spawn", "MPI_Comm_spawn_multiple", "MPI_Comm_get_parent",
     "MPI_Open_port", "MPI_Close_port", "MPI_Comm_accept", "MPI_Comm_connect",
+    "MPI_Publish_name", "MPI_Unpublish_name", "MPI_Lookup_name",
     "MPI_File_open", "MPI_File_close", "MPI_File_delete",
     "MPI_File_read_at", "MPI_File_write_at",
     "MPI_File_read_at_all", "MPI_File_write_at_all",
@@ -851,6 +854,24 @@ def MPI_Comm_connect(port_name: str, root: int = 0,
     return comm_connect(port_name, comm, root)
 
 
+def MPI_Publish_name(service_name: str, port_name: str) -> None:
+    from .spawn import publish_name
+
+    publish_name(service_name, port_name)
+
+
+def MPI_Unpublish_name(service_name: str) -> None:
+    from .spawn import unpublish_name
+
+    unpublish_name(service_name)
+
+
+def MPI_Lookup_name(service_name: str) -> str:
+    from .spawn import lookup_name
+
+    return lookup_name(service_name)
+
+
 # -- MPI-IO (MPI-2 ch.9; mpi_tpu/io.py) -------------------------------------
 
 from . import io as _io  # noqa: E402 - grouped with its API block
@@ -1018,3 +1039,24 @@ def MPI_Mrecv(message, status: Optional[Status] = None):
         if c is None:
             raise
         return errors.invoke_handler(c, exc)
+
+
+def MPI_Win_post(win, group) -> None:
+    """PSCW exposure epoch: expose ``win`` to origin ranks ``group``."""
+    win.post(group)
+
+
+def MPI_Win_start(win, group) -> None:
+    win.start(group)
+
+
+def MPI_Win_complete(win) -> None:
+    win.complete()
+
+
+def MPI_Win_wait(win) -> None:
+    win.wait()
+
+
+def MPI_Win_test(win) -> bool:
+    return win.test()

@@ -262,16 +262,23 @@ def comm_accept(port_name: str, comm: Optional[Communicator] = None,
                     with open(claimed) as f:
                         meta = json.load(f)
                     os.unlink(claimed)
-                    return int(meta["size"]), meta["reply_dir"]
+                    # publish INSIDE the claim step: a timed-out client's
+                    # stale request (its reply_dir already deleted) must
+                    # be skipped, not poison the port for live clients
+                    rdv = tempfile.mkdtemp(prefix="mpi_tpu_bridge_")
+                    try:
+                        _publish(os.path.join(meta["reply_dir"],
+                                              "accept.json"),
+                                 {"size": comm.size, "rdv": rdv})
+                    except OSError:
+                        shutil.rmtree(rdv, ignore_errors=True)
+                        continue  # dead requester; keep scanning
+                    _tmpdirs.append(rdv)  # dies with the server process
+                    return int(meta["size"]), rdv
             return None
 
-        remote, reply_dir = _poll_for(try_claim, timeout,
-                                      f"connected to port {port_name!r}")
-        rdv = tempfile.mkdtemp(prefix="mpi_tpu_bridge_")
-        _tmpdirs.append(rdv)  # bridge rdv dies with the server process
-        _publish(os.path.join(reply_dir, "accept.json"),
-                 {"size": comm.size, "rdv": rdv})
-        return remote, rdv
+        return _poll_for(try_claim, timeout,
+                         f"connected to port {port_name!r}")
 
     remote, rdv = _root_exchange(comm, root, handshake)
     total = comm.size + remote
@@ -327,3 +334,67 @@ def _require_process_comm(comm, what: str) -> P2PCommunicator:
             f"{what} is a process-backend feature (it binds OS sockets); "
             "SPMD worlds cannot establish socket connections")
     return comm
+
+
+# -- name service (MPI_Publish_name / MPI_Lookup_name [S: MPI-2 ch.5.4.4]) --
+# A registry directory maps service names to port strings.  Default:
+# a fixed per-user dir under the system tempdir; override with
+# MPI_TPU_NAMESERVICE for cluster-shared filesystems.
+
+ENV_NAMESERVICE = "MPI_TPU_NAMESERVICE"
+
+
+def _name_dir() -> str:
+    d = os.environ.get(ENV_NAMESERVICE)
+    if d is None:
+        d = os.path.join(tempfile.gettempdir(),
+                         f"mpi_tpu_names_{os.getuid()}")
+    os.makedirs(d, mode=0o700, exist_ok=True)
+    return d
+
+
+def _name_path(service_name: str) -> str:
+    if "/" in service_name or service_name.startswith("."):
+        raise ValueError(f"service names must be plain tokens, got "
+                         f"{service_name!r}")
+    return os.path.join(_name_dir(), service_name)
+
+
+def publish_name(service_name: str, port_name: str) -> None:
+    """MPI_Publish_name: make ``port_name`` discoverable as
+    ``service_name`` (atomic; re-publishing overwrites)."""
+    path = _name_path(service_name)
+    tmp = path + f".tmp{os.getpid()}"
+    with open(tmp, "w") as f:
+        f.write(port_name)
+    os.replace(tmp, path)
+
+
+def unpublish_name(service_name: str) -> None:
+    """MPI_Unpublish_name."""
+    try:
+        os.unlink(_name_path(service_name))
+    except FileNotFoundError:
+        pass
+
+
+def lookup_name(service_name: str, timeout: float = 0.0) -> str:
+    """MPI_Lookup_name: the port published under ``service_name``.
+    ``timeout > 0`` waits for the service to appear (the usual
+    client-starts-first race)."""
+    path = _name_path(service_name)
+
+    def read():
+        try:
+            with open(path) as f:
+                return f.read().strip() or None
+        except OSError:
+            return None
+
+    if timeout <= 0:
+        got = read()
+        if got is None:
+            raise LookupError(f"no service published under "
+                              f"{service_name!r} (registry: {_name_dir()})")
+        return got
+    return _poll_for(read, timeout, f"published service {service_name!r}")

@@ -49,6 +49,7 @@ _TAG_RMA = -6
 _TAG_RMA_REPLY = -7
 _TAG_PASSIVE = -8        # origin -> target window server
 _TAG_PASSIVE_REPLY = -9  # server -> origin (lock grant / get data / acks)
+_TAG_PSCW_POST = -10     # target -> origin: window posted (MPI_Win_start waits)
 
 
 class GetFuture:
@@ -278,6 +279,9 @@ class P2PWindow:
         self._lock_state: dict = {"holders": set(), "excl": None,
                                   "queue": []}
         self._srv_errors: dict = {}
+        self._pscw_cv = threading.Condition(self._srv_mutex)
+        self._pscw_pending: set = set()      # origins my post still waits on
+        self._pscw_targets = None            # my open access epoch
         t = threading.Thread(target=self._serve, daemon=True,
                              name=f"win{self._wid}-server")
         self._srv_thread = t
@@ -314,6 +318,18 @@ class P2PWindow:
                         err = self._srv_errors.pop(src, None)
                         self._srv_release(src)
                     self._org_comm._send_internal(("unlocked", err), src,
+                                                  _TAG_PASSIVE_REPLY)
+                elif kind == "pscw_complete":
+                    # arrives on the SAME FIFO channel as this origin's
+                    # RMA ops, so every op of its epoch has been applied
+                    # by the time the exposure epoch can close; the ack
+                    # carries any recorded op error back to the origin
+                    # (completion-at-close, like unlock)
+                    with self._pscw_cv:
+                        err = self._srv_errors.pop(src, None)
+                        self._pscw_pending.discard(src)
+                        self._pscw_cv.notify_all()
+                    self._org_comm._send_internal(("pscw_done", err), src,
                                                   _TAG_PASSIVE_REPLY)
                 elif kind == "get":
                     try:
@@ -464,6 +480,114 @@ class P2PWindow:
             raise RuntimeError(f"passive RMA get failed at target "
                                f"{rank}: {val}")
         return val
+
+    # -- generalized active target (PSCW [S: MPI_Win_post/start/
+    # complete/wait]) — the third RMA synchronization mode, alongside
+    # fence (active) and lock/unlock (passive).  Target side: post(group)
+    # exposes the window to those origins, wait() blocks until they all
+    # completed.  Origin side: start(group) opens an access epoch at
+    # those targets (blocks until each posted), issue put_at/get_at/
+    # accumulate_at, complete() closes it.  The completion notification
+    # rides the same FIFO server channel as the epoch's ops, so a
+    # target's wait() cannot return before the ops are applied.
+
+    def post(self, group) -> None:
+        """MPI_Win_post: expose my window to origin ranks ``group``
+        (non-blocking)."""
+        self._check_open()
+        self._ensure_server()
+        ranks = [int(r) for r in getattr(group, "ranks", group)]
+        with self._pscw_cv:
+            if self._pscw_pending:
+                raise RuntimeError(
+                    "MPI_Win_post while a previous exposure epoch is "
+                    "still open (call win.wait() first)")
+            self._pscw_pending = set(ranks)
+        me = self._comm.rank
+        for r in ranks:
+            if r != me:
+                self._org_comm._send_internal(("posted",), r,
+                                              _TAG_PSCW_POST)
+
+    def start(self, group) -> None:
+        """MPI_Win_start: open an access epoch at target ranks ``group``;
+        blocks until each target posted."""
+        self._check_open()
+        self._ensure_server()
+        if self._pscw_targets is not None:
+            raise RuntimeError("MPI_Win_start while a previous access "
+                               "epoch is still open (call win.complete())")
+        ranks = [int(r) for r in getattr(group, "ranks", group)]
+        me = self._comm.rank
+        for t in ranks:
+            if t != me:
+                msg = self._org_comm._recv_internal(t, _TAG_PSCW_POST)
+                assert msg == ("posted",)
+        self._pscw_targets = ranks
+
+    def complete(self) -> None:
+        """MPI_Win_complete: close the access epoch; ops are applied at
+        each target before its wait() returns."""
+        self._check_open()
+        if getattr(self, "_pscw_targets", None) is None:
+            raise RuntimeError("MPI_Win_complete without MPI_Win_start")
+        me = self._comm.rank
+        targets, self._pscw_targets = self._pscw_targets, None
+        errs = []
+        for t in targets:
+            if t == me:
+                with self._pscw_cv:
+                    err = self._srv_errors.pop(me, None)
+                    self._pscw_pending.discard(me)
+                    self._pscw_cv.notify_all()
+                if err:
+                    errs.append((me, err))
+            else:
+                self._srv_comm._send_internal(("pscw_complete",), t,
+                                              _TAG_PASSIVE)
+        for t in targets:
+            if t != me:
+                tag, err = self._org_comm._recv_internal(
+                    t, _TAG_PASSIVE_REPLY)
+                assert tag == "pscw_done"
+                if err:
+                    errs.append((t, err))
+        if errs:
+            raise RuntimeError(
+                "PSCW op(s) failed at target(s): " +
+                "; ".join(f"rank {t}: {e}" for t, e in errs))
+
+    def wait(self) -> None:
+        """MPI_Win_wait: close the exposure epoch — blocks until every
+        posted origin called complete()."""
+        self._check_open()
+        if getattr(self, "_pscw_cv", None) is None:
+            return  # no exposure epoch was ever opened
+        import time
+
+        deadline = (None if self._comm.recv_timeout is None
+                    else time.monotonic() + self._comm.recv_timeout)
+        with self._pscw_cv:
+            while self._pscw_pending:
+                budget = None
+                if deadline is not None:
+                    budget = deadline - time.monotonic()
+                    if budget <= 0:
+                        from .transport.base import RecvTimeout
+
+                        raise RecvTimeout(
+                            f"MPI_Win_wait: origins {sorted(self._pscw_pending)} "
+                            f"never completed within {self._comm.recv_timeout}s")
+                self._pscw_cv.wait(budget)
+
+    def test(self) -> bool:
+        """MPI_Win_test: nonblocking wait — True iff the exposure epoch
+        is closed."""
+        self._check_open()
+        if getattr(self, "_pscw_cv", None) is None:
+            return True
+        with self._pscw_cv:
+            return not self._pscw_pending
 
     def free(self) -> None:
         if getattr(self, "_srv_thread", None) is not None:

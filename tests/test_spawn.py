@@ -220,3 +220,81 @@ def test_accept_timeout_raises_on_every_rank():
 
     assert run_local(prog, 2) == ["ok", "ok"]
     spawn.close_port(port)
+
+
+def test_name_service_roundtrip(tmp_path, monkeypatch):
+    monkeypatch.setenv(spawn.ENV_NAMESERVICE, str(tmp_path))
+    port = spawn.open_port()
+    spawn.publish_name("ocean-model", port)
+    assert spawn.lookup_name("ocean-model") == port
+    with pytest.raises(LookupError, match="no service"):
+        spawn.lookup_name("atmosphere")
+    with pytest.raises(ValueError, match="plain tokens"):
+        spawn.publish_name("../evil", port)
+    spawn.unpublish_name("ocean-model")
+    with pytest.raises(LookupError):
+        spawn.lookup_name("ocean-model")
+    spawn.unpublish_name("ocean-model")  # idempotent
+    spawn.close_port(port)
+
+
+def test_name_service_with_connect_accept(tmp_path, monkeypatch):
+    """The full ch.5.4 flow: server publishes a name, client looks it up
+    and connects."""
+    import threading
+
+    monkeypatch.setenv(spawn.ENV_NAMESERVICE, str(tmp_path))
+    results = {}
+
+    def server():
+        port = spawn.open_port()
+        spawn.publish_name("calc", port)
+        inter = spawn.comm_accept(port, comm=mpi_tpu.comm_self())
+        inter.send(inter.recv(source=0) ** 2, dest=0)
+        inter.free()
+        spawn.unpublish_name("calc")
+        spawn.close_port(port)
+
+    def client():
+        port = spawn.lookup_name("calc", timeout=30)
+        inter = spawn.comm_connect(port, comm=mpi_tpu.comm_self())
+        inter.send(12, dest=0)
+        results["got"] = inter.recv(source=0)
+        inter.free()
+
+    ts = threading.Thread(target=server)
+    tc = threading.Thread(target=client)
+    ts.start(); tc.start()
+    ts.join(60); tc.join(60)
+    assert results["got"] == 144
+
+
+def test_stale_connect_request_skipped(tmp_path):
+    """A timed-out client's stale request must not poison the port: the
+    next accept skips it and serves the live client (review round 3)."""
+    import threading
+
+    port = spawn.open_port()
+    # dead client: times out, leaves connect.<token>.json behind
+    with pytest.raises(TimeoutError):
+        spawn.comm_connect(port, comm=mpi_tpu.comm_self(), timeout=0.3)
+    assert any(n.startswith("connect.") for n in os.listdir(port))
+    results = {}
+
+    def server():
+        inter = spawn.comm_accept(port, comm=mpi_tpu.comm_self(), timeout=30)
+        results["size"] = inter.remote_size
+        inter.send("hi", dest=0)
+        inter.free()
+
+    def client():
+        inter = spawn.comm_connect(port, comm=mpi_tpu.comm_self(), timeout=30)
+        results["msg"] = inter.recv(source=0)
+        inter.free()
+
+    ts = threading.Thread(target=server)
+    tc = threading.Thread(target=client)
+    ts.start(); tc.start()
+    ts.join(60); tc.join(60)
+    assert results == {"size": 1, "msg": "hi"}
+    spawn.close_port(port)

@@ -5,6 +5,8 @@ for the deterministic refinements).  Parity: the same portable program must
 produce identical windows on the process backends and the SPMD backend.
 """
 
+import time
+
 import numpy as np
 import pytest
 
@@ -435,3 +437,118 @@ def test_passive_reply_waits_honor_recv_timeout():
 
     res = run_local(prog, 2)
     assert res[0] is True
+
+
+# -- PSCW generalized active target (round 3) -------------------------------
+
+
+def test_pscw_put_visible_after_wait():
+    """Origin start/put/complete; target post/wait — the put is applied
+    before wait returns (the completion rides the op channel FIFO)."""
+    def prog(comm):
+        win = comm.win_create(np.zeros(2, np.float64))
+        if comm.rank == 0:
+            win.post([1])          # expose to origin 1
+            win.wait()             # returns only after 1's complete
+            out = win.local.copy()
+        else:
+            win.start([0])
+            win.put_at(0, np.array([3.5, 4.5]))
+            win.accumulate_at(0, np.array([0.5, 0.5]))
+            win.complete()
+            out = None
+        comm.barrier()
+        win.free()
+        return out
+
+    res = run_local(prog, 2)
+    assert np.array_equal(res[0], [4.0, 5.0])
+
+
+def test_pscw_multiple_origins_and_test():
+    def prog(comm):
+        win = comm.win_create(np.zeros(1, np.float64))
+        if comm.rank == 0:
+            win.post([1, 2])
+            while not win.test():
+                time.sleep(0.001)
+            win.wait()  # already closed: returns immediately
+            out = float(win.local[0])
+        else:
+            win.start([0])
+            win.accumulate_at(0, np.array([float(comm.rank)]))
+            win.complete()
+            out = None
+        comm.barrier()
+        win.free()
+        return out
+
+    res = run_local(prog, 3)
+    assert res[0] == 3.0  # 1 + 2
+
+
+def test_pscw_epoch_discipline_errors():
+    def prog(comm):
+        win = comm.win_create(np.zeros(1))
+        with pytest.raises(RuntimeError, match="without MPI_Win_start"):
+            win.complete()
+        assert win.test()  # no epoch: trivially closed
+        win.wait()         # no epoch: returns immediately
+        win.post([])       # empty exposure epoch
+        win.wait()
+        win.start([])      # empty access epoch
+        with pytest.raises(RuntimeError, match="previous access"):
+            win.start([])
+        win.complete()
+        comm.barrier()
+        win.free()
+        return True
+
+    assert run_local(prog, 1)[0] is True
+
+
+def test_pscw_wait_times_out_on_dead_origin():
+    """An origin that never completes surfaces as RecvTimeout at the
+    target's wait (the failure-detection contract), not a hang."""
+    from mpi_tpu.transport.base import RecvTimeout
+
+    def prog(comm):
+        win = comm.win_create(np.zeros(1))
+        if comm.rank == 0:
+            comm.recv_timeout = 0.5  # rank 0 only: rank 1's barrier must
+            # not race the deliberate 0.5s wait-timeout window
+            win.post([1])
+            with pytest.raises(RecvTimeout, match="never completed"):
+                win.wait()
+        comm.barrier()  # rank 1 never starts/completes — by design
+        win.free()
+        return True
+
+    run_local(prog, 2)
+
+
+def test_pscw_complete_raises_target_op_errors():
+    """A bad op inside a start/complete epoch raises AT complete() —
+    and must not leak into a later, clean lock/unlock epoch."""
+    def prog(comm):
+        win = comm.win_create(np.zeros(2, np.float64))
+        if comm.rank == 0:
+            win.post([1])
+            win.wait()
+            # later clean passive epoch from rank 1 must not re-raise
+            comm.barrier()
+            comm.barrier()
+        else:
+            win.start([0])
+            win.put_at(0, np.zeros(3))  # wrong shape: fails at target
+            with pytest.raises(RuntimeError, match="PSCW op"):
+                win.complete()
+            comm.barrier()
+            win.lock(0)
+            win.put_at(0, np.ones(2))
+            win.unlock(0)  # clean epoch: no stale error resurfaces
+            comm.barrier()
+        win.free()
+        return True
+
+    run_local(prog, 2)
