@@ -48,7 +48,8 @@ __all__ = [
     "MPI_Win_create", "MPI_Win_fence", "MPI_Win_free",
     "MPI_Win_lock", "MPI_Win_unlock",
     "MPI_Win_post", "MPI_Win_start", "MPI_Win_complete", "MPI_Win_wait",
-    "MPI_Win_test",
+    "MPI_Win_test", "MPI_Fetch_and_op", "MPI_Compare_and_swap",
+    "MPI_Win_flush", "MPI_Comm_split_type", "MPI_COMM_TYPE_SHARED",
     "MPI_Put", "MPI_Get", "MPI_Accumulate",
     "MPI_Group_incl", "MPI_Group_excl", "MPI_Group_union",
     "MPI_Group_intersection", "MPI_Group_difference", "MPI_Group_size",
@@ -1060,3 +1061,32 @@ def MPI_Win_wait(win) -> None:
 
 def MPI_Win_test(win) -> bool:
     return win.test()
+
+
+def MPI_Fetch_and_op(win, data: Any, target: int, op=ops.SUM, loc: Any = None):
+    """MPI-3 atomic: combine ``data`` into the target window, return the
+    previous value (one round-trip; the distributed-counter primitive)."""
+    return win.fetch_and_op(target, data, op, loc)
+
+
+def MPI_Compare_and_swap(win, compare: Any, new: Any, target: int,
+                         loc: Any = None):
+    return win.compare_and_swap(target, compare, new, loc)
+
+
+def MPI_Win_flush(win, target: int) -> None:
+    win.flush(target)
+
+
+MPI_COMM_TYPE_SHARED = "shared"
+
+
+def MPI_Comm_split_type(split_type=MPI_COMM_TYPE_SHARED, key: int = 0,
+                        comm: Optional[Communicator] = None):
+    """MPI_Comm_split_type(COMM_TYPE_SHARED): ranks that share memory.
+    Every process world this library launches is single-host (the
+    launcher forks locally; multi-host is the SPMD/DCN backend), so the
+    shared-memory split is the whole communicator, reordered by key."""
+    if split_type != MPI_COMM_TYPE_SHARED:
+        raise ValueError(f"unknown split_type {split_type!r}")
+    return _call(comm, "split", 0, key)

@@ -285,7 +285,9 @@ class File:
     # -- shared file pointer -----------------------------------------------
 
     def _shared_fetch_add(self, n: int) -> int:
-        """Atomic fetch-and-add on the rank-0-hosted shared pointer."""
+        """Atomic fetch-and-add on the rank-0-hosted shared pointer —
+        ONE server round-trip (MPI-3 MPI_Fetch_and_op), down from the
+        4-message lock/get/put/unlock sequence."""
         if self._shared_win is None:
             # collective lazy init would hang (only callers reach here);
             # create eagerly instead the first time ANY shared op is used
@@ -293,12 +295,9 @@ class File:
                 "shared file pointer not initialized — open the file with "
                 "file_open(..., shared=True) (collective) to use "
                 "read_shared/write_shared")
-        w = self._shared_win
-        w.lock(0, exclusive=True)
-        old = int(np.asarray(w.get_at(0)).reshape(-1)[0])
-        w.put_at(0, np.asarray([old + n], dtype=np.int64))
-        w.unlock(0)
-        return old
+        old = self._shared_win.fetch_and_op(
+            0, np.asarray([n], dtype=np.int64))
+        return int(np.asarray(old).reshape(-1)[0])
 
     def init_shared(self) -> None:
         """Collective: create the shared-pointer window (done automatically

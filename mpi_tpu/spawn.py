@@ -350,6 +350,15 @@ def _name_dir() -> str:
         d = os.path.join(tempfile.gettempdir(),
                          f"mpi_tpu_names_{os.getuid()}")
     os.makedirs(d, mode=0o700, exist_ok=True)
+    # the ssh-agent pattern: a pre-existing dir another user planted
+    # (mkdir /tmp/mpi_tpu_names_<uid> first) could spoof published
+    # ports — require our ownership and no group/other write
+    st = os.stat(d)
+    if st.st_uid != os.getuid() or (st.st_mode & 0o022):
+        raise PermissionError(
+            f"name-service registry {d!r} is not owned by uid "
+            f"{os.getuid()} with mode 0700 — refusing (set "
+            f"{ENV_NAMESERVICE} to a trusted directory)")
     return d
 
 

@@ -70,6 +70,19 @@ class TpuWindow:
     def accumulate_at(self, rank: int, data=None, op=None, loc=None):
         self._no_passive()
 
+    def fetch_and_op(self, rank: int, data=None, op=None, loc=None):
+        self._no_passive()
+
+    def compare_and_swap(self, rank: int, compare=None, new=None, loc=None):
+        self._no_passive()
+
+    def flush(self, rank: int):
+        self._no_passive()
+
+    # PSCW is rank-asymmetric control flow — same no-SPMD-spelling
+    # diagnosis as passive target (fence is the active-target mode here)
+    post = start = complete = wait = test = _no_passive
+
     def __init__(self, comm, init: Any):
         self._comm = comm
         self._arr = jnp.asarray(init)

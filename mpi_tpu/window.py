@@ -251,6 +251,32 @@ class P2PWindow:
     # Self-targeted epochs bypass messaging and apply under the server's
     # mutex (deadlock-free on every transport).
 
+    def _atomic_runnable(self, src: int) -> bool:
+        """Caller holds _srv_mutex.  An atomic may run unless some OTHER
+        rank holds the exclusive lock (its epoch must stay isolated);
+        concurrent shared holders are fine — application is a single
+        mutex-guarded step."""
+        s = self._lock_state
+        return s["excl"] is None or s["excl"] == src
+
+    def _atomic_exec(self, msg) -> tuple:
+        """Caller holds _srv_mutex; returns the ('ok', old)/('err', txt)
+        reply — ONE implementation for the server path, the deferred
+        drain, and the self-rank path."""
+        try:
+            if msg[0] == "fetch_op":
+                _, data, op, loc = msg
+                old = self._read(loc)
+                self._apply("acc", data, loc, op)
+            else:  # "cas"
+                _, compare, new_val, loc = msg
+                old = self._read(loc)
+                if np.array_equal(old, compare):
+                    self._apply("put", new_val, loc, None)
+            return ("ok", old)
+        except Exception as e:  # noqa: BLE001 - surfaces at the origin
+            return ("err", f"{type(e).__name__}: {e}")
+
     def _ensure_server(self):
         import threading
 
@@ -331,6 +357,28 @@ class P2PWindow:
                         self._pscw_cv.notify_all()
                     self._org_comm._send_internal(("pscw_done", err), src,
                                                   _TAG_PASSIVE_REPLY)
+                elif kind in ("fetch_op", "cas"):
+                    # MPI-3 atomic: apply + reply the OLD value in one
+                    # message.  An exclusive lock held by ANOTHER rank
+                    # defers it (queued; drained at lock release) so
+                    # atomics cannot pierce an exclusive epoch.
+                    with self._srv_mutex:
+                        if self._atomic_runnable(src):
+                            reply = self._atomic_exec(msg)
+                        else:
+                            self._lock_state.setdefault(
+                                "atomics", []).append((src, msg))
+                            reply = None
+                    if reply is not None:
+                        self._org_comm._send_internal(
+                            reply, src, _TAG_PASSIVE_REPLY)
+                elif kind == "flush":
+                    # FIFO position => all prior ops from src are applied;
+                    # ack carries (and clears) any recorded error
+                    with self._srv_mutex:
+                        err = self._srv_errors.pop(src, None)
+                    self._org_comm._send_internal(("flushed", err), src,
+                                                  _TAG_PASSIVE_REPLY)
                 elif kind == "get":
                     try:
                         with self._srv_mutex:
@@ -390,6 +438,20 @@ class P2PWindow:
             granted.append(notify)
             if excl:
                 break
+        # drain atomics that the released lock was blocking (they run
+        # before notify-sends, still under the caller's mutex)
+        pend = s.get("atomics", [])
+        if pend:
+            still = []
+            for a_src, a_msg in pend:
+                if self._atomic_runnable(a_src):
+                    self._org_comm._send_internal(
+                        self._atomic_exec(a_msg), a_src, _TAG_PASSIVE_REPLY)
+                else:
+                    still.append((a_src, a_msg))
+            s["atomics"] = still
+        if getattr(self, "_pscw_cv", None) is not None:
+            self._pscw_cv.notify_all()  # wake self-rank atomic waiters
         for notify in granted:
             notify()
 
@@ -481,6 +543,60 @@ class P2PWindow:
                                f"{rank}: {val}")
         return val
 
+    # -- MPI-3 atomics + flush (passive/PSCW epochs) ------------------------
+
+    def fetch_and_op(self, rank: int, data: Any,
+                     op: _ops.ReduceOp = _ops.SUM, loc: Any = None):
+        """MPI_Fetch_and_op [S: MPI-3]: atomically combine ``data`` into
+        ``rank``'s window and return the PREVIOUS value — one server
+        round-trip (the fetch-add every distributed counter wants)."""
+        return self._atomic_origin(
+            rank, ("fetch_op", np.asarray(data), op, loc), "fetch_and_op")
+
+    def compare_and_swap(self, rank: int, compare: Any, new: Any,
+                         loc: Any = None):
+        """MPI_Compare_and_swap [S: MPI-3]: if the target location equals
+        ``compare``, replace it with ``new``; returns the previous value
+        either way."""
+        return self._atomic_origin(
+            rank, ("cas", np.asarray(compare), np.asarray(new), loc),
+            "compare_and_swap")
+
+    def _atomic_origin(self, rank: int, msg, what: str):
+        self._check_open()
+        self._ensure_server()
+        if rank == self._comm.rank:
+            with self._pscw_cv:  # the general server-state condition
+                while not self._atomic_runnable(rank):
+                    self._pscw_cv.wait()  # released lock notifies
+                tag, val = self._atomic_exec(msg)
+        else:
+            self._srv_comm._send_internal(msg, rank, _TAG_PASSIVE)
+            tag, val = self._org_comm._recv_internal(rank,
+                                                     _TAG_PASSIVE_REPLY)
+        if tag == "err":  # same contract on the self path as remote
+            raise RuntimeError(f"{what} failed at target {rank}: {val}")
+        return val
+
+    def flush(self, rank: int) -> None:
+        """MPI_Win_flush [S: MPI-3]: complete all outstanding ops at
+        ``rank`` WITHOUT closing the epoch; a recorded op error raises
+        here (and is cleared) instead of waiting for unlock."""
+        self._check_open()
+        self._ensure_server()
+        me = self._comm.rank
+        if rank == me:
+            with self._srv_mutex:
+                err = self._srv_errors.pop(me, None)
+            if err:
+                raise RuntimeError(f"RMA op failed at target {rank}: {err}")
+            return
+        self._srv_comm._send_internal(("flush",), rank, _TAG_PASSIVE)
+        tag, err = self._org_comm._recv_internal(rank, _TAG_PASSIVE_REPLY)
+        assert tag == "flushed"
+        if err:
+            raise RuntimeError(f"RMA op failed at target {rank}: {err}")
+
     # -- generalized active target (PSCW [S: MPI_Win_post/start/
     # complete/wait]) — the third RMA synchronization mode, alongside
     # fence (active) and lock/unlock (passive).  Target side: post(group)
@@ -519,10 +635,16 @@ class P2PWindow:
                                "epoch is still open (call win.complete())")
         ranks = [int(r) for r in getattr(group, "ranks", group)]
         me = self._comm.rank
+        oc = self._org_comm
         for t in ranks:
             if t != me:
-                msg = self._org_comm._recv_internal(t, _TAG_PSCW_POST)
-                assert msg == ("posted",)
+                # UNTIMED by design, like lock(): waiting for the target
+                # to reach its post() is waiting on application code, not
+                # on a bounded service (recv_timeout would false-positive
+                # on a slow-but-healthy peer)
+                obj, _, _ = oc._t.recv(oc._world(t), oc._ctx,
+                                       _TAG_PSCW_POST, timeout=None)
+                assert obj == ("posted",)
         self._pscw_targets = ranks
 
     def complete(self) -> None:

@@ -237,3 +237,17 @@ def test_get_count_and_elements():
     assert n64 == 12 and npair == 6 and nelem == 12
     assert n32 == 24  # 96 bytes / 4
     assert opaque is None  # pickled dict: MPI_UNDEFINED
+
+
+def test_comm_split_type_shared():
+    from mpi_tpu import api
+
+    def prog(comm):
+        node = api.MPI_Comm_split_type(comm=comm)
+        assert node.size == comm.size  # single-host worlds: whole comm
+        assert node.allreduce(1) == comm.size
+        with pytest.raises(ValueError, match="split_type"):
+            api.MPI_Comm_split_type("numa", comm=comm)
+        return True
+
+    assert all(run_local(prog, 3))

@@ -298,3 +298,12 @@ def test_stale_connect_request_skipped(tmp_path):
     ts.join(60); tc.join(60)
     assert results == {"size": 1, "msg": "hi"}
     spawn.close_port(port)
+
+
+def test_name_dir_rejects_foreign_or_loose_dir(tmp_path, monkeypatch):
+    loose = tmp_path / "registry"
+    loose.mkdir(mode=0o777)
+    os.chmod(loose, 0o777)  # umask-proof
+    monkeypatch.setenv(spawn.ENV_NAMESERVICE, str(loose))
+    with pytest.raises(PermissionError, match="refusing"):
+        spawn.publish_name("svc", "/tmp/x")

@@ -552,3 +552,134 @@ def test_pscw_complete_raises_target_op_errors():
         return True
 
     run_local(prog, 2)
+
+
+# -- MPI-3 atomics + flush (round 3) ----------------------------------------
+
+
+def test_fetch_and_op_is_atomic_counter():
+    """Concurrent fetch-adds from all ranks: every rank gets a distinct
+    previous value — the atomicity a lock/get/put/unlock has to work
+    around."""
+    def prog(comm):
+        win = comm.win_create(np.zeros(1, np.int64))
+        comm.barrier()
+        old = [int(win.fetch_and_op(0, np.ones(1, np.int64))[0])
+               for _ in range(5)]
+        comm.barrier()
+        total = int(win.local[0]) if comm.rank == 0 else None
+        comm.barrier()
+        win.free()
+        return old, total
+
+    res = run_local(prog, 4)
+    assert res[0][1] == 20  # 4 ranks x 5 increments
+    seen = [v for olds, _ in res for v in olds]
+    assert sorted(seen) == list(range(20))  # all distinct: atomic
+
+
+def test_compare_and_swap():
+    def prog(comm):
+        win = comm.win_create(np.zeros(1, np.int64))
+        comm.barrier()
+        if comm.rank == 1:
+            # succeed: 0 -> 7, then fail: compare 0 != 7
+            a = win.compare_and_swap(0, np.zeros(1, np.int64),
+                                     np.full(1, 7, np.int64))
+            b = win.compare_and_swap(0, np.zeros(1, np.int64),
+                                     np.full(1, 9, np.int64))
+            out = (int(a[0]), int(b[0]))
+        else:
+            out = None
+        comm.barrier()
+        final = int(win.local[0]) if comm.rank == 0 else None
+        comm.barrier()
+        win.free()
+        return out, final
+
+    res = run_local(prog, 2)
+    assert res[1][0] == (0, 7)
+    assert res[0][1] == 7  # the failed CAS did not write
+
+
+def test_win_flush_surfaces_error_inside_epoch():
+    def prog(comm):
+        win = comm.win_create(np.zeros(2))
+        comm.barrier()
+        if comm.rank == 1:
+            win.lock(0)
+            win.put_at(0, np.zeros(5))  # wrong shape
+            with pytest.raises(RuntimeError, match="failed at target"):
+                win.flush(0)
+            win.put_at(0, np.ones(2))  # epoch continues after flush
+            win.flush(0)               # clean: no stale error
+            win.unlock(0)              # clean too
+        comm.barrier()
+        out = win.local.copy() if comm.rank == 0 else None
+        comm.barrier()
+        win.free()
+        return out
+
+    res = run_local(prog, 2)
+    assert np.array_equal(res[0], [1.0, 1.0])
+
+
+def test_atomics_respect_exclusive_lock():
+    """A fetch_and_op issued while another rank holds the exclusive lock
+    is DEFERRED to lock release — it cannot pierce the epoch (review
+    round 3: the read-modify-write under exclusive lock must not lose
+    updates)."""
+    def prog(comm):
+        win = comm.win_create(np.zeros(1, np.int64))
+        comm.barrier()
+        if comm.rank == 1:
+            win.lock(0, exclusive=True)
+            comm.send("locked", dest=2, tag=1)
+            old = int(np.asarray(win.get_at(0))[0])
+            time.sleep(0.15)  # window for rank 2's atomic to sneak in
+            win.put_at(0, np.asarray([old + 100], np.int64))
+            win.unlock(0)
+            out = None
+        elif comm.rank == 2:
+            comm.recv(source=1, tag=1)
+            # issued mid-epoch: must apply only after rank 1's unlock
+            prev = int(win.fetch_and_op(0, np.ones(1, np.int64))[0])
+            out = prev
+        else:
+            out = None
+        comm.barrier()
+        final = int(win.local[0]) if comm.rank == 0 else None
+        comm.barrier()
+        win.free()
+        return out, final
+
+    res = run_local(prog, 3)
+    assert res[2][0] == 100   # atomic saw the epoch's result, not 0
+    assert res[0][1] == 101   # and its increment was not lost
+
+
+def test_atomic_self_path_error_parity():
+    def prog(comm):
+        win = comm.win_create(np.zeros(2))
+        with pytest.raises(RuntimeError, match="failed at target 0"):
+            win.fetch_and_op(0, np.zeros(5))  # self target, wrong shape
+        comm.barrier()
+        win.free()
+        return True
+
+    run_local(prog, 1)
+
+
+def test_tpu_window_atomics_diagnosed():
+    import mpi_tpu
+
+    def prog(comm):
+        win = comm.win_create(np.zeros(2, np.float32))
+        for fn in (lambda: win.fetch_and_op(0, 1.0),
+                   lambda: win.flush(0),
+                   lambda: win.post([0])):
+            with pytest.raises(NotImplementedError, match="SPMD"):
+                fn()
+        return 0
+
+    mpi_tpu.run(prog, backend="tpu", nranks=None)
