@@ -50,6 +50,10 @@ __all__ = [
     "MPI_Win_post", "MPI_Win_start", "MPI_Win_complete", "MPI_Win_wait",
     "MPI_Win_test", "MPI_Fetch_and_op", "MPI_Compare_and_swap",
     "MPI_Win_flush", "MPI_Comm_split_type", "MPI_COMM_TYPE_SHARED",
+    "MPI_Win_lock_all", "MPI_Win_unlock_all", "MPI_Win_flush_all",
+    "MPI_Win_flush_local", "MPI_Get_accumulate",
+    "MPI_Rput", "MPI_Rget", "MPI_Raccumulate", "MPI_Comm_idup",
+    "MPI_Type_create_hvector", "MPI_Type_create_hindexed",
     "MPI_Put", "MPI_Get", "MPI_Accumulate",
     "MPI_Group_incl", "MPI_Group_excl", "MPI_Group_union",
     "MPI_Group_intersection", "MPI_Group_difference", "MPI_Group_size",
@@ -633,21 +637,24 @@ def MPI_Get_processor_name() -> str:
 def MPI_Get_version():
     """(major, minor) of the MPI standard this library *conforms to*.
 
-    MPI-2.0 as of round 3: MPI-1 is complete (p2p, collectives, groups,
-    topology, derived datatypes + Pack/Unpack, error handlers, attribute
-    caching/keyvals, COMM_SELF, Get_count) and every MPI-2 chapter has
-    its core: one-sided RMA (active fence + passive lock/unlock),
-    dynamic process management (Comm_spawn/spawn_multiple/get_parent),
-    MPI-IO (open/views/explicit offsets/individual + shared pointers/
-    collective two-phase writes), intercommunicators.  Selected MPI-3
-    features exist beyond that (nonblocking collectives, neighborhood
-    collectives on cartesian AND distributed-graph topologies,
-    Waitany/Waitsome/Testall/Testany, matched probe Mprobe/Improbe/
-    Mrecv).  Known MPI-2 gaps, so (2, 0) and not higher:
-    no C/Fortran interop chapter (meaningless here), no
-    MPI_Register_datarep (external32 itself IS supported via
-    MPI_Pack_external/MPI_Unpack_external)."""
-    return (2, 0)
+    MPI-3.0 as of round 3.  MPI-1 and MPI-2 are complete (p2p,
+    collectives, groups, topology, derived datatypes incl. h-variants +
+    Pack/Unpack + external32, error handlers, attribute caching,
+    COMM_SELF, Get_count; RMA with all three sync modes, dynamic
+    processes incl. spawn + ports + name service, MPI-IO with views/
+    shared pointers/ordered + two-phase collective I/O,
+    intercommunicators).  The MPI-3 additions present: nonblocking
+    collectives, neighborhood collectives on cartesian AND
+    distributed-graph topologies, matched probe (Mprobe/Mrecv),
+    request-set ops, RMA atomics (Fetch_and_op/Compare_and_swap/
+    Get_accumulate) with lock_all/flush/flush_all and request-based
+    Rput/Rget/Raccumulate, Comm_split_type, Comm_idup,
+    Comm_create_group.  Known MPI-3 gaps, so not higher: no
+    MPI_Win_allocate_shared (shared-memory windows — the shm transport
+    serves that niche), no dynamic windows (Win_attach), no MPI_T tool
+    interface, no large-count bindings (Python ints are unbounded), no
+    MPI_Register_datarep."""
+    return (3, 0)
 
 
 def MPI_Get_library_version() -> str:
@@ -1090,3 +1097,49 @@ def MPI_Comm_split_type(split_type=MPI_COMM_TYPE_SHARED, key: int = 0,
     if split_type != MPI_COMM_TYPE_SHARED:
         raise ValueError(f"unknown split_type {split_type!r}")
     return _call(comm, "split", 0, key)
+
+
+MPI_Type_create_hvector = datatypes.type_create_hvector
+MPI_Type_create_hindexed = datatypes.type_create_hindexed
+
+
+def MPI_Win_lock_all(win) -> None:
+    win.lock_all()
+
+
+def MPI_Win_unlock_all(win) -> None:
+    win.unlock_all()
+
+
+def MPI_Win_flush_all(win) -> None:
+    win.flush_all()
+
+
+def MPI_Win_flush_local(win, target: int) -> None:
+    win.flush_local(target)
+
+
+def MPI_Get_accumulate(win, data: Any, target: int, op=ops.SUM,
+                       loc: Any = None):
+    return win.get_accumulate(target, data, op, loc)
+
+
+def MPI_Rput(win, data: Any, target: int, loc: Any = None):
+    return win.rput(target, data, loc)
+
+
+def MPI_Rget(win, target: int, loc: Any = None):
+    return win.rget(target, loc)
+
+
+def MPI_Raccumulate(win, data: Any, target: int, op=ops.SUM,
+                    loc: Any = None):
+    return win.raccumulate(target, data, op, loc)
+
+
+def MPI_Comm_idup(comm: Optional[Communicator] = None):
+    """MPI_Comm_idup: dup is synchronous here, so the request completes
+    at creation carrying the new communicator."""
+    from .communicator import _CompletedRequest
+
+    return _CompletedRequest(_world(comm).dup())

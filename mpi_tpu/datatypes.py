@@ -30,6 +30,7 @@ import numpy as np
 __all__ = [
     "Datatype", "type_contiguous", "type_vector", "type_indexed",
     "type_create_subarray", "type_create_struct", "type_create_resized",
+    "type_create_hvector", "type_create_hindexed",
     "from_structured", "pack", "unpack", "pack_size",
     "pack_external", "unpack_external",
 ]
@@ -363,6 +364,42 @@ def type_create_struct(blocklengths: Sequence[int],
     es = (np.concatenate(sizes) if sizes and all(s is not None for s in sizes)
           else None)
     return Datatype(np.dtype(np.uint8), idx, span, elem_sizes=es)
+
+
+def type_create_hvector(count: int, blocklength: int, stride_bytes: int,
+                        base: BaseLike) -> Datatype:
+    """MPI_Type_create_hvector: like type_vector but the stride is in
+    BYTES.  The index-map model addresses typed elements, so the byte
+    stride must be a whole multiple of the base extent (arbitrary byte
+    strides would mis-align every element); misuse is diagnosed, not
+    approximated."""
+    b = _as_base(base)
+    unit = b.extent_bytes  # type_vector strides are in units of the base
+    # EXTENT (a derived base spans extent elements, not one itemsize)
+    if unit == 0 or stride_bytes % unit:
+        raise ValueError(
+            f"hvector byte stride {stride_bytes} is not a multiple of the "
+            f"base extent {unit} bytes — such a layout cannot address "
+            f"whole base instances (use a uint8-based struct map for raw "
+            f"bytes)")
+    return type_vector(count, blocklength, stride_bytes // unit, base)
+
+
+def type_create_hindexed(blocklengths: Sequence[int],
+                         byte_displacements: Sequence[int],
+                         base: BaseLike) -> Datatype:
+    """MPI_Type_create_hindexed: indexed with BYTE displacements (same
+    whole-element restriction as hvector)."""
+    b = _as_base(base)
+    unit = b.extent_bytes  # displacements are in base-EXTENT units too
+    disps = []
+    for d in byte_displacements:
+        if unit == 0 or int(d) % unit:
+            raise ValueError(
+                f"hindexed byte displacement {d} is not a multiple of the "
+                f"base extent {unit} bytes")
+        disps.append(int(d) // unit)
+    return type_indexed(blocklengths, disps, base)
 
 
 def type_create_resized(base: BaseLike, lb: int, extent: int) -> Datatype:

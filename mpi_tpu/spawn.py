@@ -349,15 +349,19 @@ def _name_dir() -> str:
     if d is None:
         d = os.path.join(tempfile.gettempdir(),
                          f"mpi_tpu_names_{os.getuid()}")
+    import stat as _stat
+
     os.makedirs(d, mode=0o700, exist_ok=True)
-    # the ssh-agent pattern: a pre-existing dir another user planted
-    # (mkdir /tmp/mpi_tpu_names_<uid> first) could spoof published
-    # ports — require our ownership and no group/other write
-    st = os.stat(d)
-    if st.st_uid != os.getuid() or (st.st_mode & 0o022):
+    # the ssh-agent pattern: a pre-existing dir (or SYMLINK — lstat, not
+    # stat, or a planted link re-targets the registry into a victim-owned
+    # directory) another user created could spoof published ports —
+    # require a real directory we own with no group/other write
+    st = os.lstat(d)
+    if not _stat.S_ISDIR(st.st_mode) or st.st_uid != os.getuid() \
+            or (st.st_mode & 0o022):
         raise PermissionError(
-            f"name-service registry {d!r} is not owned by uid "
-            f"{os.getuid()} with mode 0700 — refusing (set "
+            f"name-service registry {d!r} is not a directory owned by "
+            f"uid {os.getuid()} with mode 0700 — refusing (set "
             f"{ENV_NAMESERVICE} to a trusted directory)")
     return d
 

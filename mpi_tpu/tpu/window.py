@@ -82,6 +82,10 @@ class TpuWindow:
     # PSCW is rank-asymmetric control flow — same no-SPMD-spelling
     # diagnosis as passive target (fence is the active-target mode here)
     post = start = complete = wait = test = _no_passive
+    # MPI-3 epoch/atomic helpers: all passive-target shaped
+    lock_all = unlock_all = flush_all = _no_passive
+    flush_local = flush_local_all = _no_passive
+    get_accumulate = rput = rget = raccumulate = _no_passive
 
     def __init__(self, comm, init: Any):
         self._comm = comm

@@ -95,6 +95,26 @@ def _normalize_pairs(pairs, my_rank: int, size: int,
     return pairs
 
 
+class _RmaRequest:
+    """Request-based RMA handle (MPI_Rput/Raccumulate): wait() completes
+    the op at the target via flush (surfacing its error there)."""
+
+    def __init__(self, win: "P2PWindow", rank: int):
+        self._win, self._rank = win, rank
+        self._done = False
+
+    def wait(self):
+        if not self._done:
+            self._win.flush(self._rank)
+            self._done = True
+
+    def test(self):
+        # make progress like every other Request type: completing here
+        # is a bounded flush ack, so request-set pollers terminate
+        self.wait()
+        return True, None
+
+
 class P2PWindow:
     """RMA window over a :class:`~mpi_tpu.communicator.P2PCommunicator`.
 
@@ -572,8 +592,13 @@ class P2PWindow:
                 tag, val = self._atomic_exec(msg)
         else:
             self._srv_comm._send_internal(msg, rank, _TAG_PASSIVE)
-            tag, val = self._org_comm._recv_internal(rank,
-                                                     _TAG_PASSIVE_REPLY)
+            # UNTIMED: the server defers atomics for the whole duration
+            # of another rank's exclusive lock — an application-
+            # controlled wait, like lock() (recv_timeout would false-
+            # positive on a healthy but busy target)
+            oc = self._org_comm
+            (tag, val), _, _ = oc._t.recv(oc._world(rank), oc._ctx,
+                                          _TAG_PASSIVE_REPLY, timeout=None)
         if tag == "err":  # same contract on the self path as remote
             raise RuntimeError(f"{what} failed at target {rank}: {val}")
         return val
@@ -596,6 +621,54 @@ class P2PWindow:
         assert tag == "flushed"
         if err:
             raise RuntimeError(f"RMA op failed at target {rank}: {err}")
+
+    def lock_all(self) -> None:
+        """MPI_Win_lock_all [S: MPI-3]: a SHARED lock at every rank's
+        window — deadlock-free because shared grants don't exclude each
+        other (rank order only matters against queued exclusives)."""
+        for r in range(self._comm.size):
+            self.lock(r, exclusive=False)
+
+    def unlock_all(self) -> None:
+        for r in range(self._comm.size):
+            self.unlock(r)
+
+    def flush_all(self) -> None:
+        """MPI_Win_flush_all: complete outstanding ops at every target."""
+        for r in range(self._comm.size):
+            self.flush(r)
+
+    # flush_local(_all): our origin side buffers nothing (ops ship
+    # immediately), so local completion is trivially true — but the
+    # TARGET-completion spelling is what callers usually mean; alias it.
+    flush_local = flush
+    flush_local_all = flush_all
+
+    def get_accumulate(self, rank: int, data: Any,
+                       op: _ops.ReduceOp = _ops.SUM, loc: Any = None):
+        """MPI_Get_accumulate [S: MPI-3]: fetch the target location and
+        accumulate into it, atomically — fetch_and_op generalized to
+        array payloads (this implementation never restricted the payload
+        to one element, so they coincide)."""
+        return self.fetch_and_op(rank, data, op, loc)
+
+    def rput(self, rank: int, data: Any, loc: Any = None):
+        """MPI_Rput [S: MPI-3 request-based RMA]: returns a Request whose
+        wait() flushes the target (op completion there)."""
+        self.put_at(rank, data, loc)
+        return _RmaRequest(self, rank)
+
+    def raccumulate(self, rank: int, data: Any,
+                    op: _ops.ReduceOp = _ops.SUM, loc: Any = None):
+        self.accumulate_at(rank, data, op, loc)
+        return _RmaRequest(self, rank)
+
+    def rget(self, rank: int, loc: Any = None):
+        """MPI_Rget: get_at is synchronous here, so the request is
+        complete at creation and carries the value."""
+        from .communicator import _CompletedRequest
+
+        return _CompletedRequest(self.get_at(rank, loc))
 
     # -- generalized active target (PSCW [S: MPI_Win_post/start/
     # complete/wait]) — the third RMA synchronization mode, alongside

@@ -487,3 +487,26 @@ def test_mrecv_honors_errhandler():
         comm.set_errhandler(errors.ERRORS_ARE_FATAL)
 
     run_local(prog, 1)
+
+
+def test_hvector_and_hindexed_byte_units():
+    t = dt.type_create_hvector(3, 1, 8, np.int32).commit()  # stride 2 elems
+    buf = np.arange(6, dtype=np.int32)
+    assert np.array_equal(t.pack(buf), [0, 2, 4])
+    hi = dt.type_create_hindexed([1, 2], [4, 12], np.int32).commit()
+    assert np.array_equal(hi.pack(np.arange(5, dtype=np.int32)), [1, 3, 4])
+    with pytest.raises(ValueError, match="multiple of"):
+        dt.type_create_hvector(2, 1, 5, np.int32)
+    with pytest.raises(ValueError, match="multiple of"):
+        dt.type_create_hindexed([1], [2], np.float64)
+
+
+def test_hvector_derived_base_uses_extent_units():
+    """Byte strides convert via the base EXTENT (a derived base spans
+    extent elements) — itemsize division landed wrong offsets (review
+    round 3)."""
+    pair = dt.type_contiguous(2, np.int32)  # extent 8 bytes
+    t = dt.type_create_hvector(2, 1, 8, pair).commit()
+    assert np.array_equal(t.pack(np.arange(8, dtype=np.int32)), [0, 1, 2, 3])
+    hi = dt.type_create_hindexed([1], [8], pair).commit()
+    assert np.array_equal(hi.pack(np.arange(6, dtype=np.int32)), [2, 3])

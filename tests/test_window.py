@@ -683,3 +683,85 @@ def test_tpu_window_atomics_diagnosed():
         return 0
 
     mpi_tpu.run(prog, backend="tpu", nranks=None)
+
+
+def test_lock_all_flush_all_and_request_rma():
+    def prog(comm):
+        win = comm.win_create(np.zeros(1, np.float64))
+        comm.barrier()
+        if comm.rank == 1:
+            win.lock_all()
+            reqs = [win.raccumulate(t, np.ones(1)) for t in range(comm.size)]
+            win.flush_all()
+            for r in reqs:
+                r.wait()  # already flushed: no-op
+            got = win.rget(0).wait()
+            win.unlock_all()
+            out = float(np.asarray(got)[0])
+        else:
+            out = None
+        comm.barrier()
+        local = float(win.local[0])
+        comm.barrier()
+        win.free()
+        return out, local
+
+    res = run_local(prog, 3)
+    assert res[1][0] == 1.0          # rget after the accumulate epoch
+    assert all(r[1] == 1.0 for r in res)  # every window accumulated once
+
+
+def test_get_accumulate_array_payload():
+    def prog(comm):
+        win = comm.win_create(np.arange(3, dtype=np.float64))
+        comm.barrier()
+        if comm.rank == 1:
+            old = win.get_accumulate(0, np.full(3, 10.0))
+            out = np.asarray(old)
+        else:
+            out = None
+        comm.barrier()
+        final = win.local.copy() if comm.rank == 0 else None
+        comm.barrier()
+        win.free()
+        return out, final
+
+    res = run_local(prog, 2)
+    assert np.array_equal(res[1][0], [0, 1, 2])     # fetched pre-image
+    assert np.array_equal(res[0][1], [10, 11, 12])  # accumulated
+
+
+def test_rma_request_test_makes_progress():
+    """A request-set poll over an Rput request terminates (review:
+    test() returned pending forever)."""
+    from mpi_tpu import api
+
+    def prog(comm):
+        win = comm.win_create(np.zeros(1))
+        comm.barrier()
+        if comm.rank == 1:
+            req = win.rput(0, np.ones(1))
+            idx, _ = api.MPI_Waitany([req])
+            assert idx == 0
+            done, _ = api.MPI_Test(win.rput(0, np.ones(1)))
+            assert done
+        comm.barrier()
+        win.free()
+        return True
+
+    run_local(prog, 2)
+
+
+def test_tpu_window_mpi3_helpers_diagnosed():
+    import mpi_tpu
+
+    def prog(comm):
+        win = comm.win_create(np.zeros(1, np.float32))
+        for fn in (win.lock_all, win.flush_all,
+                   lambda: win.get_accumulate(0, 1.0),
+                   lambda: win.rput(0, 1.0)):
+            with pytest.raises(NotImplementedError, match="SPMD"):
+                fn()
+        return 0
+
+    mpi_tpu.run(prog, backend="tpu", nranks=None)
