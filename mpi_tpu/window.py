@@ -97,20 +97,26 @@ def _normalize_pairs(pairs, my_rank: int, size: int,
 
 class _RmaRequest:
     """Request-based RMA handle (MPI_Rput/Raccumulate): wait() completes
-    the op at the target via flush (surfacing its error there)."""
+    the op at the target via flush (surfacing its error there).  Stamped
+    with the window's per-target flush epoch at creation: a flush/
+    flush_all issued AFTER the op makes later waits genuinely local
+    (no redundant round-trip per drained request)."""
 
     def __init__(self, win: "P2PWindow", rank: int):
         self._win, self._rank = win, rank
+        self._epoch = win._flush_epoch(rank)
         self._done = False
 
     def wait(self):
         if not self._done:
-            self._win.flush(self._rank)
+            if self._win._flush_epoch(self._rank) == self._epoch:
+                self._win.flush(self._rank)
             self._done = True
 
     def test(self):
         # make progress like every other Request type: completing here
-        # is a bounded flush ack, so request-set pollers terminate
+        # is at most one bounded flush ack, so request-set pollers
+        # terminate
         self.wait()
         return True, None
 
@@ -388,10 +394,14 @@ class P2PWindow:
                         else:
                             self._lock_state.setdefault(
                                 "atomics", []).append((src, msg))
-                            reply = None
-                    if reply is not None:
-                        self._org_comm._send_internal(
-                            reply, src, _TAG_PASSIVE_REPLY)
+                            # tell the origin the wait is now application-
+                            # bound (a foreign exclusive lock) so it can
+                            # drop its crash-detection timeout for the
+                            # final reply without losing it for dead
+                            # targets
+                            reply = ("deferred", None)
+                    self._org_comm._send_internal(
+                        reply, src, _TAG_PASSIVE_REPLY)
                 elif kind == "flush":
                     # FIFO position => all prior ops from src are applied;
                     # ack carries (and clears) any recorded error
@@ -592,13 +602,19 @@ class P2PWindow:
                 tag, val = self._atomic_exec(msg)
         else:
             self._srv_comm._send_internal(msg, rank, _TAG_PASSIVE)
-            # UNTIMED: the server defers atomics for the whole duration
-            # of another rank's exclusive lock — an application-
-            # controlled wait, like lock() (recv_timeout would false-
-            # positive on a healthy but busy target)
-            oc = self._org_comm
-            (tag, val), _, _ = oc._t.recv(oc._world(rank), oc._ctx,
-                                          _TAG_PASSIVE_REPLY, timeout=None)
+            # first reply is BOUNDED by recv_timeout (a dead target must
+            # surface, same contract as get/flush); a live target that
+            # queued the atomic behind a foreign exclusive lock answers
+            # ("deferred", ...) immediately, and only then do we wait
+            # untimed — the remaining wait is application-controlled,
+            # like lock()
+            tag, val = self._org_comm._recv_internal(rank,
+                                                     _TAG_PASSIVE_REPLY)
+            if tag == "deferred":
+                oc = self._org_comm
+                (tag, val), _, _ = oc._t.recv(oc._world(rank), oc._ctx,
+                                              _TAG_PASSIVE_REPLY,
+                                              timeout=None)
         if tag == "err":  # same contract on the self path as remote
             raise RuntimeError(f"{what} failed at target {rank}: {val}")
         return val
@@ -615,12 +631,21 @@ class P2PWindow:
                 err = self._srv_errors.pop(me, None)
             if err:
                 raise RuntimeError(f"RMA op failed at target {rank}: {err}")
+            self._bump_flush_epoch(rank)
             return
         self._srv_comm._send_internal(("flush",), rank, _TAG_PASSIVE)
         tag, err = self._org_comm._recv_internal(rank, _TAG_PASSIVE_REPLY)
         assert tag == "flushed"
         if err:
             raise RuntimeError(f"RMA op failed at target {rank}: {err}")
+        self._bump_flush_epoch(rank)
+
+    def _flush_epoch(self, rank: int) -> int:
+        return self.__dict__.setdefault("_flush_epochs", {}).get(rank, 0)
+
+    def _bump_flush_epoch(self, rank: int) -> None:
+        e = self.__dict__.setdefault("_flush_epochs", {})
+        e[rank] = e.get(rank, 0) + 1
 
     def lock_all(self) -> None:
         """MPI_Win_lock_all [S: MPI-3]: a SHARED lock at every rank's

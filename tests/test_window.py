@@ -642,6 +642,11 @@ def test_atomics_respect_exclusive_lock():
             out = None
         elif comm.rank == 2:
             comm.recv(source=1, tag=1)
+            # recv_timeout SHORTER than the lock hold: the immediate
+            # 'deferred' notice must keep this from false-positive
+            # timing out while the final reply stays application-bound
+            win._ensure_server()
+            win._org_comm.recv_timeout = 0.05
             # issued mid-epoch: must apply only after rank 1's unlock
             prev = int(win.fetch_and_op(0, np.ones(1, np.int64))[0])
             out = prev
@@ -765,3 +770,30 @@ def test_tpu_window_mpi3_helpers_diagnosed():
         return 0
 
     mpi_tpu.run(prog, backend="tpu", nranks=None)
+
+
+def test_rma_request_wait_local_after_flush_all():
+    """Requests stamped before a flush complete LOCALLY afterwards —
+    the drain does not re-flush per request (review round 3)."""
+    def prog(comm):
+        win = comm.win_create(np.zeros(1))
+        comm.barrier()
+        if comm.rank == 1:
+            reqs = [win.raccumulate(0, np.ones(1)) for _ in range(4)]
+            win.flush_all()
+            before = win._flush_epoch(0)
+            for r in reqs:
+                r.wait()
+            assert win._flush_epoch(0) == before  # no extra round-trips
+            # a NEW request after the flush still flushes once
+            r2 = win.rput(0, np.full(1, 7.0))
+            r2.wait()
+            assert win._flush_epoch(0) == before + 1
+        comm.barrier()
+        final = win.local.copy() if comm.rank == 0 else None
+        comm.barrier()
+        win.free()
+        return final
+
+    res = run_local(prog, 2)
+    assert np.array_equal(res[0], [7.0])
