@@ -42,6 +42,7 @@ from .group import Group
 from .spawn import (comm_accept, comm_connect, comm_get_parent, comm_spawn,
                     comm_spawn_multiple, close_port, lookup_name, open_port,
                     publish_name, unpublish_name)
+from .shmwin import SharedWindow, win_allocate_shared
 from .window import GetFuture, P2PWindow
 
 __all__ = [
@@ -53,7 +54,7 @@ __all__ = [
     "CartComm", "GraphComm", "InterComm", "create_intercomm",
     "cart_create", "graph_create",
     "dist_graph_create_adjacent", "dims_create", "Group",
-    "GetFuture", "P2PWindow",
+    "GetFuture", "P2PWindow", "SharedWindow", "win_allocate_shared",
     "comm_spawn", "comm_spawn_multiple", "comm_get_parent",
     "open_port", "close_port", "comm_accept", "comm_connect",
     "publish_name", "unpublish_name", "lookup_name",

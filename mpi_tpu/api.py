@@ -54,6 +54,7 @@ __all__ = [
     "MPI_Win_flush_local", "MPI_Get_accumulate",
     "MPI_Rput", "MPI_Rget", "MPI_Raccumulate", "MPI_Comm_idup",
     "MPI_Type_create_hvector", "MPI_Type_create_hindexed",
+    "MPI_Win_allocate_shared", "MPI_Win_shared_query", "MPI_Win_sync",
     "MPI_Put", "MPI_Get", "MPI_Accumulate",
     "MPI_Group_incl", "MPI_Group_excl", "MPI_Group_union",
     "MPI_Group_intersection", "MPI_Group_difference", "MPI_Group_size",
@@ -649,11 +650,11 @@ def MPI_Get_version():
     request-set ops, RMA atomics (Fetch_and_op/Compare_and_swap/
     Get_accumulate) with lock_all/flush/flush_all and request-based
     Rput/Rget/Raccumulate, Comm_split_type, Comm_idup,
-    Comm_create_group.  Known MPI-3 gaps, so not higher: no
-    MPI_Win_allocate_shared (shared-memory windows — the shm transport
-    serves that niche), no dynamic windows (Win_attach), no MPI_T tool
-    interface, no large-count bindings (Python ints are unbounded), no
-    MPI_Register_datarep."""
+    Comm_create_group, Win_allocate_shared/shared_query/Win_sync
+    (true load/store shared-memory windows over /dev/shm mmap on the
+    process backends).  Known MPI-3 gaps, so not higher: no dynamic
+    windows (Win_attach), no MPI_T tool interface, no large-count
+    bindings (Python ints are unbounded), no MPI_Register_datarep."""
     return (3, 0)
 
 
@@ -1047,6 +1048,28 @@ def MPI_Mrecv(message, status: Optional[Status] = None):
         if c is None:
             raise
         return errors.invoke_handler(c, exc)
+
+
+def MPI_Win_allocate_shared(nelems: int, dtype=None,
+                            comm: Optional[Communicator] = None):
+    """Collectively allocate a host-shared load/store window; query
+    any rank's region with win.remote(rank) (MPI_Win_shared_query)."""
+    import numpy as _np
+
+    from .shmwin import win_allocate_shared
+
+    return win_allocate_shared(comm, nelems,
+                               dtype if dtype is not None else _np.float64)
+
+
+def MPI_Win_shared_query(win, rank: int):
+    """(size_in_elements, the live shared view) of ``rank``'s region."""
+    view = win.remote(rank)
+    return view.size, view
+
+
+def MPI_Win_sync(win) -> None:
+    win.sync()
 
 
 def MPI_Win_post(win, group) -> None:

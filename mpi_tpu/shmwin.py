@@ -1,0 +1,147 @@
+"""Shared-memory windows — MPI_Win_allocate_shared [S: MPI-3 ch.11.2.3].
+
+The one RMA window kind where LOAD/STORE replaces message passing: all
+ranks of a shared-memory communicator (every process world this
+library's launcher starts is single-host — exactly MPI's
+COMM_TYPE_SHARED domain) map ONE /dev/shm segment, and each rank's
+window region is directly addressable by every other rank as a numpy
+view.  ``remote(rank)`` is MPI_Win_shared_query; plain array reads and
+writes are the RMA.
+
+Synchronization is the window-sync model [S]: mmap(MAP_SHARED) on one
+host is cache-coherent, so ``sync()`` only needs a compiler/CPU
+ordering point (a lock round-trip) — ordering between ranks is the
+caller's job via ``comm.barrier()`` or p2p, as in MPI.  The thread
+backend maps the same file per-thread, which degenerates to plain
+shared memory (views differ, coherence is trivial).
+"""
+
+from __future__ import annotations
+
+import mmap
+import os
+import tempfile
+import threading
+from typing import Any, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from .communicator import Communicator, P2PCommunicator
+
+__all__ = ["SharedWindow", "win_allocate_shared"]
+
+
+class SharedWindow:
+    """One shared segment; rank r owns the region ``local``; any region
+    is load/store-addressable via ``remote(r)``."""
+
+    def __init__(self, comm: P2PCommunicator, nelems: int, dtype: Any):
+        self._comm = comm
+        self._dtype = np.dtype(dtype)
+        sizes = comm.allgather(int(nelems))
+        if not isinstance(sizes, list):
+            sizes = [int(s) for s in np.asarray(sizes).reshape(-1)]
+        self._sizes = sizes
+        self._offsets = np.concatenate(([0], np.cumsum(sizes)))[:-1]
+        total_bytes = int(sum(sizes)) * self._dtype.itemsize
+        # rank 0 creates the segment; everyone maps the same file
+        if comm.rank == 0:
+            base = "/dev/shm" if os.path.isdir("/dev/shm") else None
+            fd, path = tempfile.mkstemp(prefix="mpi_tpu_shmwin_", dir=base)
+            os.ftruncate(fd, max(total_bytes, 1))
+            os.close(fd)
+        else:
+            path = None
+        self._path = comm.bcast(path, 0)
+        self._fd = os.open(self._path, os.O_RDWR)
+        self._map = mmap.mmap(self._fd, max(total_bytes, 1),
+                              mmap.MAP_SHARED)
+        self._buf = np.frombuffer(self._map, dtype=self._dtype,
+                                  count=int(sum(sizes)))
+        self._open = True
+        self._sync_lock = threading.Lock()
+        comm.barrier()  # all mapped before anyone stores
+
+    # -- addressing (MPI_Win_shared_query) ---------------------------------
+
+    def remote(self, rank: int) -> np.ndarray:
+        """Rank ``rank``'s region as a live shared view (loads AND stores
+        hit the shared segment directly)."""
+        self._check_open()
+        if not (0 <= rank < self._comm.size):
+            raise ValueError(f"rank {rank} out of range "
+                             f"(size {self._comm.size})")
+        off = int(self._offsets[rank])
+        return self._buf[off:off + self._sizes[rank]]
+
+    @property
+    def local(self) -> np.ndarray:
+        return self.remote(self._comm.rank)
+
+    @property
+    def whole(self) -> np.ndarray:
+        """The entire segment (all ranks' regions, in rank order)."""
+        self._check_open()
+        return self._buf
+
+    # -- synchronization ---------------------------------------------------
+
+    def sync(self) -> None:
+        """MPI_Win_sync: an ordering point for this rank's loads/stores
+        (mmap MAP_SHARED is coherent on one host; a lock round-trip is
+        the required memory barrier).  Cross-rank ORDERING still needs
+        comm.barrier()/p2p, per MPI."""
+        self._check_open()
+        with self._sync_lock:
+            pass
+
+    def fence(self) -> None:
+        """Convenience: sync + barrier — the bulk-synchronous epoch."""
+        self.sync()
+        self._comm.barrier()
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def free(self) -> None:
+        """Collective: detach; rank 0 unlinks after everyone detached.
+        If the caller still holds live views (remote()/local arrays),
+        the mapping cannot close eagerly — it is left to the GC; the
+        segment file is unlinked regardless (the mapping keeps working
+        until the views die, the name is gone immediately)."""
+        if not self._open:
+            return
+        self._open = False
+        self._buf = None
+        try:
+            self._map.close()
+        except BufferError:
+            pass  # user-held views pin the mapping; GC reclaims it
+        os.close(self._fd)
+        self._comm.barrier()
+        if self._comm.rank == 0:
+            try:
+                os.unlink(self._path)
+            except OSError:
+                pass
+        self._comm.barrier()
+
+    def _check_open(self) -> None:
+        if not self._open:
+            raise RuntimeError("shared window is freed")
+
+
+def win_allocate_shared(comm: Optional[Communicator], nelems: int,
+                        dtype: Any = np.float64) -> SharedWindow:
+    """MPI_Win_allocate_shared: collectively allocate one host-shared
+    segment; rank r contributes ``nelems`` elements (may differ per
+    rank, 0 allowed)."""
+    if comm is None:
+        from . import init
+
+        comm = init()
+    if not isinstance(comm, P2PCommunicator):
+        raise NotImplementedError(
+            "shared-memory windows are load/store on host RAM — a "
+            "process-backend feature (COMM_TYPE_SHARED domain); device "
+            "arrays already share HBM addressing inside one SPMD program")
+    return SharedWindow(comm, int(nelems), dtype)

@@ -87,6 +87,11 @@ class TpuWindow:
     flush_local = flush_local_all = _no_passive
     get_accumulate = rput = rget = raccumulate = _no_passive
 
+    def sync(self) -> None:
+        """MPI_Win_sync is valid on any window; in one traced SPMD
+        program the trace order IS the memory order — a correct no-op."""
+
+
     def __init__(self, comm, init: Any):
         self._comm = comm
         self._arr = jnp.asarray(init)

@@ -151,6 +151,8 @@ class P2PWindow:
         self._gets: List[Tuple] = []
         self._issue = 0
         self._freed = False
+        # per-target completed-flush counter (request-based RMA stamps)
+        self._flush_epochs: dict = {}
         # passive-target server (win_create is collective [S], so the
         # context allocation below is deterministic on every rank, and
         # every rank has a live server before any origin can lock it)
@@ -394,14 +396,21 @@ class P2PWindow:
                         else:
                             self._lock_state.setdefault(
                                 "atomics", []).append((src, msg))
-                            # tell the origin the wait is now application-
-                            # bound (a foreign exclusive lock) so it can
-                            # drop its crash-detection timeout for the
-                            # final reply without losing it for dead
-                            # targets
-                            reply = ("deferred", None)
-                    self._org_comm._send_internal(
-                        reply, src, _TAG_PASSIVE_REPLY)
+                            # the origin learns the wait is application-
+                            # bound (foreign exclusive lock): crash
+                            # detection stays on the first reply, only
+                            # the post-deferral wait is untimed.  Sent
+                            # UNDER the mutex — the release-drain also
+                            # sends under it, so the notice can never
+                            # be overtaken by the real reply (review:
+                            # a stale notice would poison the channel)
+                            self._org_comm._send_internal(
+                                ("deferred", None), src,
+                                _TAG_PASSIVE_REPLY)
+                            reply = None
+                    if reply is not None:
+                        self._org_comm._send_internal(
+                            reply, src, _TAG_PASSIVE_REPLY)
                 elif kind == "flush":
                     # FIFO position => all prior ops from src are applied;
                     # ack carries (and clears) any recorded error
@@ -573,6 +582,13 @@ class P2PWindow:
                                f"{rank}: {val}")
         return val
 
+    def sync(self) -> None:
+        """MPI_Win_sync: the memory-model ordering point.  This window's
+        ops are applied by the server under a mutex (no private/public
+        copy split), so the call is a correct no-op — valid on ANY
+        window, kept for portable MPI code."""
+        self._check_open()
+
     # -- MPI-3 atomics + flush (passive/PSCW epochs) ------------------------
 
     def fetch_and_op(self, rank: int, data: Any,
@@ -641,11 +657,10 @@ class P2PWindow:
         self._bump_flush_epoch(rank)
 
     def _flush_epoch(self, rank: int) -> int:
-        return self.__dict__.setdefault("_flush_epochs", {}).get(rank, 0)
+        return self._flush_epochs.get(rank, 0)
 
     def _bump_flush_epoch(self, rank: int) -> None:
-        e = self.__dict__.setdefault("_flush_epochs", {})
-        e[rank] = e.get(rank, 0) + 1
+        self._flush_epochs[rank] = self._flush_epochs.get(rank, 0) + 1
 
     def lock_all(self) -> None:
         """MPI_Win_lock_all [S: MPI-3]: a SHARED lock at every rank's

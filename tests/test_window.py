@@ -797,3 +797,84 @@ def test_rma_request_wait_local_after_flush_all():
 
     res = run_local(prog, 2)
     assert np.array_equal(res[0], [7.0])
+
+
+# -- shared-memory windows (MPI_Win_allocate_shared, round 3) ---------------
+
+
+def test_shared_window_load_store_across_ranks():
+    """Every rank stores into its region; neighbors LOAD it directly —
+    no messages, the MPI-3 shared-memory window model."""
+    import mpi_tpu
+
+    def prog(comm):
+        win = mpi_tpu.win_allocate_shared(comm, 4, np.float64)
+        win.local[:] = comm.rank * 10 + np.arange(4)
+        win.fence()                       # publish + sync
+        left = (comm.rank - 1) % comm.size
+        got = win.remote(left).copy()     # plain load of the neighbor
+        win.fence()
+        # direct remote STORE: rank 0 pokes everyone's first element
+        if comm.rank == 0:
+            for r in range(comm.size):
+                win.remote(r)[0] = -1.0
+        win.fence()
+        poked = float(win.local[0])
+        win.free()
+        return got, poked
+
+    res = run_local(prog, 3)
+    for r, (got, poked) in enumerate(res):
+        left = (r - 1) % 3
+        assert np.array_equal(got, left * 10 + np.arange(4))
+        assert poked == -1.0
+
+
+def test_shared_window_ragged_sizes_and_whole():
+    import mpi_tpu
+
+    def prog(comm):
+        n = comm.rank + 1  # ragged: 1, 2, 3
+        win = mpi_tpu.win_allocate_shared(comm, n, np.int32)
+        win.local[:] = comm.rank
+        win.fence()
+        whole = win.whole.copy() if comm.rank == 0 else None
+        sz, view = (len(win.remote(2)), None) if comm.rank == 0 else (None, None)
+        win.fence()
+        win.free()
+        return whole, sz
+
+    res = run_local(prog, 3)
+    assert np.array_equal(res[0][0], [0, 1, 1, 2, 2, 2])
+    assert res[0][1] == 3
+
+
+def test_shared_window_rejected_on_spmd():
+    import mpi_tpu
+
+    def prog(comm):
+        with pytest.raises(NotImplementedError, match="process-backend"):
+            mpi_tpu.win_allocate_shared(comm, 4)
+        return 0
+
+    mpi_tpu.run(prog, backend="tpu", nranks=None)
+
+
+def test_win_sync_valid_on_any_window():
+    import mpi_tpu
+
+    def prog(comm):
+        win = comm.win_create(np.zeros(1))
+        win.sync()  # MPI-3: valid on ANY window (no-op here, not a crash)
+        comm.barrier()
+        win.free()
+        return True
+
+    run_local(prog, 2)
+
+    def tpu_prog(comm):
+        win = comm.win_create(np.zeros(1, np.float32))
+        win.sync()
+        return 0
+
+    mpi_tpu.run(tpu_prog, backend="tpu", nranks=None)
