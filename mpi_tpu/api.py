@@ -55,6 +55,10 @@ __all__ = [
     "MPI_Rput", "MPI_Rget", "MPI_Raccumulate", "MPI_Comm_idup",
     "MPI_Type_create_hvector", "MPI_Type_create_hindexed",
     "MPI_Win_allocate_shared", "MPI_Win_shared_query", "MPI_Win_sync",
+    "MPI_Bcast_init", "MPI_Allreduce_init", "MPI_Reduce_init",
+    "MPI_Allgather_init", "MPI_Alltoall_init", "MPI_Barrier_init",
+    "MPI_Psend_init", "MPI_Precv_init", "MPI_Pready", "MPI_Pready_range",
+    "MPI_Parrived",
     "MPI_Put", "MPI_Get", "MPI_Accumulate",
     "MPI_Group_incl", "MPI_Group_excl", "MPI_Group_union",
     "MPI_Group_intersection", "MPI_Group_difference", "MPI_Group_size",
@@ -1166,3 +1170,71 @@ def MPI_Comm_idup(comm: Optional[Communicator] = None):
     from .communicator import _CompletedRequest
 
     return _CompletedRequest(_world(comm).dup())
+
+
+# -- MPI-4 previews (mpi_tpu/mpi4.py) ---------------------------------------
+
+
+def MPI_Bcast_init(obj: Any, root: int = 0,
+                   comm: Optional[Communicator] = None):
+    from .mpi4 import persistent_collective
+
+    return persistent_collective(_world(comm), "bcast", obj, root)
+
+
+def MPI_Allreduce_init(obj: Any, op=ops.SUM,
+                       comm: Optional[Communicator] = None):
+    from .mpi4 import persistent_collective
+
+    return persistent_collective(_world(comm), "allreduce", obj, op)
+
+
+def MPI_Reduce_init(obj: Any, op=ops.SUM, root: int = 0,
+                    comm: Optional[Communicator] = None):
+    from .mpi4 import persistent_collective
+
+    return persistent_collective(_world(comm), "reduce", obj, op, root)
+
+
+def MPI_Allgather_init(obj: Any, comm: Optional[Communicator] = None):
+    from .mpi4 import persistent_collective
+
+    return persistent_collective(_world(comm), "allgather", obj)
+
+
+def MPI_Alltoall_init(objs: Any, comm: Optional[Communicator] = None):
+    from .mpi4 import persistent_collective
+
+    return persistent_collective(_world(comm), "alltoall", objs)
+
+
+def MPI_Barrier_init(comm: Optional[Communicator] = None):
+    from .mpi4 import persistent_collective
+
+    return persistent_collective(_world(comm), "barrier")
+
+
+def MPI_Psend_init(buf: Any, partitions: int, dest: int, tag: int = 0,
+                   comm: Optional[Communicator] = None):
+    from .mpi4 import psend_init
+
+    return psend_init(_world(comm), buf, partitions, dest, tag)
+
+
+def MPI_Precv_init(partitions: int, source: int, tag: int = 0,
+                   comm: Optional[Communicator] = None):
+    from .mpi4 import precv_init
+
+    return precv_init(_world(comm), partitions, source, tag)
+
+
+def MPI_Pready(request, partition: int) -> None:
+    request.pready(partition)
+
+
+def MPI_Pready_range(request, lo: int, hi: int) -> None:
+    request.pready_range(lo, hi)
+
+
+def MPI_Parrived(request, partition: int) -> bool:
+    return request.parrived(partition)

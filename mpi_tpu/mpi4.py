@@ -1,0 +1,293 @@
+"""MPI-4 previews: persistent collectives and partitioned communication.
+
+Beyond the MPI-3.0 conformance line (api.MPI_Get_version), two MPI-4
+features whose shapes fit this framework naturally:
+
+* **Persistent collectives** (MPI_Bcast_init & co. [S: MPI-4 ch.6.11]):
+  plan a collective once, ``start()`` it many times.  Each handle owns
+  ONE isolated child context (the same deterministic counter scheme as
+  nonblocking collectives), so repeated starts can never cross-match —
+  and, per MPI, every rank must create and start its persistent
+  collectives in the same order.  Buffer CONTENT is read at start time
+  (the handle holds references, like send_init).
+
+* **Partitioned point-to-point** (MPI_Psend_init / Precv_init / Pready /
+  Parrived [S: MPI-4 ch.4]): one logical message whose partitions are
+  contributed (e.g. by different producer threads) and consumed
+  independently.  Each matched psend/precv pair gets its own context
+  derived from a per-(peer, tag) counter maintained symmetrically on
+  both sides — MPI's in-order matching of partitioned inits, spelled as
+  context isolation, so concurrent pairs on one (peer, tag) can never
+  interleave.  Partitions travel as individual internal messages
+  ``(index, payload)``; ``pready(i)`` reads partition ``i`` at call
+  time and ships it; ``parrived(i)`` polls without blocking.
+"""
+
+from __future__ import annotations
+
+import pickle
+import threading
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from .communicator import (Communicator, P2PCommunicator, Request,
+                           _ThreadRequest, _is_jax_array)
+
+__all__ = [
+    "PersistentCollective", "persistent_collective",
+    "PsendRequest", "PrecvRequest", "psend_init", "precv_init",
+]
+
+_TAG_PART = -41  # partitioned traffic (negative: invisible to wildcards)
+
+
+def _require_p2p(comm, what: str) -> P2PCommunicator:
+    if not isinstance(comm, P2PCommunicator):
+        raise NotImplementedError(
+            f"{what} is a process-backend feature; on the SPMD backend a "
+            "collective inside jit is already a plan (XLA compiles it "
+            "once) — just call it")
+    return comm
+
+
+class PersistentCollective(Request):
+    """A planned collective: ``start()`` runs one round on the handle's
+    private context; ``wait()``/``test()`` complete the current round."""
+
+    def __init__(self, comm: P2PCommunicator, method: str,
+                 args: tuple, kwargs: dict):
+        self._comm = comm._nbc_comm()  # one private context for all rounds
+        self._method = method
+        self._args, self._kwargs = args, kwargs
+        self._req: Optional[Request] = None
+
+    def start(self) -> "PersistentCollective":
+        if self._req is not None and not self._req.test()[0]:
+            raise RuntimeError(
+                "start() while the previous round of this persistent "
+                "collective is still in flight (wait() it first)")
+        fn = getattr(self._comm, self._method)
+        self._req = _ThreadRequest(lambda: fn(*self._args, **self._kwargs))
+        return self
+
+    def wait(self) -> Any:
+        if self._req is None:
+            raise RuntimeError("wait() before start() on a persistent "
+                               "collective")
+        return self._req.wait()
+
+    def test(self) -> Tuple[bool, Any]:
+        if self._req is None:
+            return False, None
+        return self._req.test()
+
+
+def persistent_collective(comm: Communicator, method: str, *args: Any,
+                          **kwargs: Any) -> PersistentCollective:
+    """Generic MPI_*_init for collectives: ``method`` is the Communicator
+    method name ('bcast', 'allreduce', 'reduce', 'allgather', 'alltoall',
+    'barrier', ...)."""
+    c = _require_p2p(comm, "persistent collectives")
+    if not callable(getattr(c, method, None)):
+        raise ValueError(f"unknown collective method {method!r}")
+    return PersistentCollective(c, method, args, kwargs)
+
+
+# -- partitioned point-to-point ---------------------------------------------
+
+
+def _pair_ctx_comm(comm: P2PCommunicator, peer: int, tag: int,
+                   side_counter: str) -> P2PCommunicator:
+    """A private context for ONE matched psend/precv pair.  Both sides
+    advance a per-(peer, tag) counter at init time, so the k-th
+    psend_init(dest, tag) matches the k-th precv_init(source, tag) —
+    MPI's in-order matching, enforced structurally."""
+    with comm._lock:
+        table = comm.__dict__.setdefault(side_counter, {})
+        k = table.get((peer, tag), 0)
+        table[(peer, tag)] = k + 1
+    return P2PCommunicator(comm._t, comm._group,
+                           (comm._ctx, "part", tag, k),
+                           recv_timeout=comm.recv_timeout)
+
+
+class PsendRequest:
+    """Sender side of a partitioned send (MPI_Psend_init)."""
+
+    def __init__(self, comm: P2PCommunicator, buf: Any, partitions: int,
+                 dest: int, tag: int):
+        if partitions < 1:
+            raise ValueError(f"partitions must be >= 1, got {partitions}")
+        self._c = _pair_ctx_comm(comm, dest, tag, "_psend_counters")
+        self._buf = buf
+        self._n = int(partitions)
+        self._dest = dest
+        self._active = False
+        self._ready: set = set()
+        self._lock = threading.Lock()
+
+    def start(self) -> "PsendRequest":
+        with self._lock:
+            if self._active:
+                raise RuntimeError("start() on an active partitioned send "
+                                   "(wait() the previous round first)")
+            self._active = True
+            self._ready = set()
+        return self
+
+    def pready(self, i: int) -> None:
+        """Mark partition ``i`` ready: its CURRENT content ships now.
+        Thread-safe — different producer threads may ready different
+        partitions (the MPI-4 use case)."""
+        with self._lock:
+            if not self._active:
+                raise RuntimeError("pready() outside an active round "
+                                   "(call start() first)")
+            if not (0 <= i < self._n):
+                raise ValueError(f"partition {i} out of range "
+                                 f"(0..{self._n - 1})")
+            if i in self._ready:
+                raise RuntimeError(f"partition {i} already marked ready "
+                                   "this round")
+            self._ready.add(i)
+            part = self._buf[i]
+        if self._c._t.aliases_payloads:
+            # by-reference transports: snapshot NOW so the producer can
+            # refill the partition immediately (the MPI buffer-reuse
+            # idiom; same pattern as PersistentRequest.start)
+            if isinstance(part, np.ndarray):
+                part = part.copy()
+            elif not (isinstance(part, (int, float, complex, bool,
+                                        str, bytes, type(None)))
+                      or _is_jax_array(part)):
+                part = pickle.loads(pickle.dumps(
+                    part, protocol=pickle.HIGHEST_PROTOCOL))
+        self._c._send_internal((int(i), part), self._dest, _TAG_PART)
+
+    def pready_range(self, lo: int, hi: int) -> None:
+        for i in range(lo, hi):
+            self.pready(i)
+
+    def wait(self) -> None:
+        """Complete the round; every partition must have been readied
+        (a silent partial send would deadlock the receiver)."""
+        with self._lock:
+            if not self._active:
+                raise RuntimeError("wait() outside an active round")
+            missing = [i for i in range(self._n) if i not in self._ready]
+            if missing:
+                raise RuntimeError(
+                    f"wait() with partitions never marked ready: "
+                    f"{missing[:8]}{'...' if len(missing) > 8 else ''} — "
+                    "the receiver would block forever")
+            self._active = False  # sends are buffered: complete on enqueue
+
+    def test(self) -> Tuple[bool, Any]:
+        """MPI semantics: an inactive request tests True; a completed
+        test DEACTIVATES the round (like wait), so start() may follow."""
+        with self._lock:
+            if not self._active:
+                return True, None
+            if len(self._ready) == self._n:
+                self._active = False
+                return True, None
+            return False, None
+
+
+class PrecvRequest:
+    """Receiver side (MPI_Precv_init): partitions complete independently;
+    ``parrived(i)`` polls, ``wait()`` assembles the full message."""
+
+    def __init__(self, comm: P2PCommunicator, partitions: int,
+                 source: int, tag: int):
+        if partitions < 1:
+            raise ValueError(f"partitions must be >= 1, got {partitions}")
+        self._c = _pair_ctx_comm(comm, source, tag, "_precv_counters")
+        self._n = int(partitions)
+        self._source = source
+        self._got: Dict[int, Any] = {}
+        self._active = False
+        self._result: Optional[List[Any]] = None
+
+    def start(self) -> "PrecvRequest":
+        if self._active:
+            raise RuntimeError("start() on an active partitioned recv")
+        self._active = True
+        self._got = {}
+        self._result = None
+        return self
+
+    def _drain_nowait(self) -> None:
+        # bounded to THIS round's partition count: an unbounded drain
+        # would steal (and overwrite with) the sender's next-round
+        # messages, corrupting this round and deadlocking the next
+        # (review round 3 — reproduced)
+        while len(self._got) < self._n:
+            hit = self._c._t.poll(self._c._world(self._source),
+                                  self._c._ctx, _TAG_PART)
+            if hit is None:
+                return
+            (i, part), _, _ = hit
+            self._got[i] = part
+
+    def parrived(self, i: int) -> bool:
+        """MPI_Parrived: has partition ``i`` landed? (non-blocking)"""
+        if not self._active:
+            raise RuntimeError("parrived() outside an active round")
+        if not (0 <= i < self._n):
+            raise ValueError(f"partition {i} out of range (0..{self._n - 1})")
+        self._drain_nowait()
+        return i in self._got
+
+    def partition(self, i: int) -> Any:
+        """Partition ``i``'s payload (must have arrived)."""
+        if not self.parrived(i):
+            raise RuntimeError(f"partition {i} has not arrived yet")
+        return self._got[i]
+
+    def wait(self) -> List[Any]:
+        """Block until every partition landed; returns them in partition
+        order (stacked by the caller if desired).  After a successful
+        test() completed the round, wait() returns the same result."""
+        if not self._active:
+            if self._result is not None:
+                return self._result
+            raise RuntimeError("wait() outside an active round")
+        while len(self._got) < self._n:
+            (i, part), _, _ = self._recv_blocking()
+            self._got[i] = part
+        return self._finish()
+
+    def _finish(self) -> List[Any]:
+        self._active = False
+        self._result = [self._got[i] for i in range(self._n)]
+        return self._result
+
+    def _recv_blocking(self):
+        return self._c._t.recv(self._c._world(self._source), self._c._ctx,
+                               _TAG_PART, timeout=self._c.recv_timeout)
+
+    def test(self) -> Tuple[bool, Any]:
+        """Inactive tests True; completion DEACTIVATES the round and
+        caches the assembled result for a subsequent wait()."""
+        if not self._active:
+            return True, self._result
+        self._drain_nowait()
+        if len(self._got) == self._n:
+            return True, self._finish()
+        return False, None
+
+
+def psend_init(comm: Communicator, buf: Any, partitions: int, dest: int,
+               tag: int = 0) -> PsendRequest:
+    """MPI_Psend_init: ``buf[i]`` is partition ``i`` (any indexable —
+    a [partitions, ...] array or a list)."""
+    return PsendRequest(_require_p2p(comm, "partitioned communication"),
+                        buf, partitions, dest, tag)
+
+
+def precv_init(comm: Communicator, partitions: int, source: int,
+               tag: int = 0) -> PrecvRequest:
+    return PrecvRequest(_require_p2p(comm, "partitioned communication"),
+                        partitions, source, tag)

@@ -22,7 +22,7 @@ import mmap
 import os
 import tempfile
 import threading
-from typing import Any, List, Optional, Sequence, Tuple
+from typing import Any, Optional
 
 import numpy as np
 

@@ -1,0 +1,270 @@
+"""MPI-4 previews (mpi_tpu/mpi4.py): persistent collectives and
+partitioned point-to-point."""
+
+import threading
+
+import numpy as np
+import pytest
+
+import mpi_tpu
+from mpi_tpu import api, mpi4
+from mpi_tpu.transport.local import run_local
+
+
+# -- persistent collectives --------------------------------------------------
+
+
+def test_persistent_allreduce_many_rounds():
+    """One plan, many starts; buffer CONTENT is read at start time."""
+    def prog(comm):
+        x = np.ones(4)
+        plan = mpi4.persistent_collective(comm, "allreduce", x)
+        outs = []
+        for round_ in range(3):
+            x[:] = round_ + 1  # mutate between starts: start sees it
+            outs.append(plan.start().wait())
+        return outs
+
+    res = run_local(prog, 3)
+    for outs in res:
+        for round_, out in enumerate(outs):
+            assert np.array_equal(out, np.full(4, 3.0 * (round_ + 1)))
+
+
+def test_persistent_bcast_and_barrier_api():
+    def prog(comm):
+        plan = api.MPI_Bcast_init({"v": comm.rank}, root=1, comm=comm)
+        got = plan.start().wait()
+        bar = api.MPI_Barrier_init(comm=comm)
+        bar.start().wait()
+        return got
+
+    res = run_local(prog, 3)
+    assert all(r == {"v": 1} for r in res)
+
+
+def test_persistent_collective_discipline():
+    def prog(comm):
+        plan = mpi4.persistent_collective(comm, "barrier")
+        with pytest.raises(RuntimeError, match="before start"):
+            plan.wait()
+        with pytest.raises(ValueError, match="unknown collective"):
+            mpi4.persistent_collective(comm, "frobnicate")
+        plan.start()
+        plan.wait()
+        plan.start()  # restart after completion is the whole point
+        plan.wait()
+        return True
+
+    run_local(prog, 2)
+
+
+def test_persistent_rejected_on_spmd():
+    def prog(comm):
+        with pytest.raises(NotImplementedError, match="already a plan"):
+            mpi4.persistent_collective(comm, "allreduce", 1)
+        return 0
+
+    mpi_tpu.run(prog, backend="tpu", nranks=None)
+
+
+# -- partitioned point-to-point ----------------------------------------------
+
+
+def test_partitioned_out_of_order_pready():
+    """Partitions readied out of order arrive and assemble in partition
+    order; parrived polls without blocking."""
+    def prog(comm):
+        n = 4
+        if comm.rank == 0:
+            buf = np.arange(n * 3.0).reshape(n, 3)
+            ps = mpi4.psend_init(comm, buf, n, dest=1, tag=5)
+            ps.start()
+            for i in (2, 0, 3, 1):
+                ps.pready(i)
+            ps.wait()
+            return None
+        pr = mpi4.precv_init(comm, n, source=0, tag=5)
+        pr.start()
+        parts = pr.wait()
+        return np.stack(parts)
+
+    res = run_local(prog, 2)
+    assert np.array_equal(res[1], np.arange(12.0).reshape(4, 3))
+
+
+def test_partitioned_producer_threads():
+    """The MPI-4 use case: different producer threads contribute
+    different partitions of ONE message."""
+    def prog(comm):
+        n = 6
+        if comm.rank == 0:
+            buf = [None] * n
+            ps = mpi4.psend_init(comm, buf, n, dest=1)
+            ps.start()
+
+            def producer(lo, hi):
+                for i in range(lo, hi):
+                    buf[i] = ("part", i)
+                    ps.pready(i)
+
+            t1 = threading.Thread(target=producer, args=(0, 3))
+            t2 = threading.Thread(target=producer, args=(3, 6))
+            t1.start(); t2.start(); t1.join(); t2.join()
+            ps.wait()
+            return None
+        pr = mpi4.precv_init(comm, n, source=0)
+        pr.start()
+        return pr.wait()
+
+    res = run_local(prog, 2)
+    assert res[1] == [("part", i) for i in range(6)]
+
+
+def test_partitioned_parrived_and_partition():
+    def prog(comm):
+        if comm.rank == 0:
+            comm.recv(source=1, tag=9)  # wait for "ready 1 shipped"
+            ps = mpi4.psend_init(comm, [10, 20], 2, dest=1)
+            ps.start()
+            ps.pready(1)
+            comm.send("shipped-1", dest=1, tag=9)
+            comm.recv(source=1, tag=9)
+            ps.pready(0)
+            ps.wait()
+            return None
+        pr = mpi4.precv_init(comm, 2, source=0)
+        pr.start()
+        comm.send("go", dest=0, tag=9)
+        comm.recv(source=0, tag=9)
+        # partition 1 shipped; partition 0 not yet
+        for _ in range(2000):
+            if pr.parrived(1):
+                break
+        assert pr.parrived(1) and pr.partition(1) == 20
+        assert not pr.parrived(0)
+        comm.send("more", dest=0, tag=9)
+        out = pr.wait()
+        assert out == [10, 20]
+        return True
+
+    run_local(prog, 2)
+
+
+def test_partitioned_multiple_pairs_same_tag_isolated():
+    """Two psend/precv pairs on the SAME (peer, tag) match in init order
+    (private contexts): payloads can never interleave."""
+    def prog(comm):
+        if comm.rank == 0:
+            a = mpi4.psend_init(comm, ["a0", "a1"], 2, dest=1, tag=1)
+            b = mpi4.psend_init(comm, ["b0", "b1"], 2, dest=1, tag=1)
+            a.start(); b.start()
+            b.pready(0); a.pready(1); b.pready(1); a.pready(0)
+            a.wait(); b.wait()
+            return None
+        a = mpi4.precv_init(comm, 2, source=0, tag=1)
+        b = mpi4.precv_init(comm, 2, source=0, tag=1)
+        a.start(); b.start()
+        return a.wait(), b.wait()
+
+    res = run_local(prog, 2)
+    assert res[1] == (["a0", "a1"], ["b0", "b1"])
+
+
+def test_partitioned_wait_names_missing_partitions():
+    def prog(comm):
+        ps = mpi4.psend_init(comm, [1, 2, 3], 3, dest=0)
+        ps.start()
+        ps.pready(1)
+        with pytest.raises(RuntimeError, match="never marked ready"):
+            ps.wait()
+        # drain so finalize's sanitizer stays quiet: complete the round
+        ps.pready(0); ps.pready(2); ps.wait()
+        pr = mpi4.precv_init(comm, 3, source=0)
+        pr.start()
+        pr.wait()
+        return True
+
+    run_local(prog, 1)
+
+
+def test_partitioned_rounds_do_not_cross():
+    """Round 2's partitions must not be drained into round 1 (review
+    round 3 — reproduced corruption before the bounded drain)."""
+    def prog(comm):
+        if comm.rank == 0:
+            ps = mpi4.psend_init(comm, [["r1p0", "r1p1"]][0], 2, dest=1)
+            ps.start(); ps.pready(0); ps.pready(1); ps.wait()
+            # race straight into round 2 before the receiver drains
+            ps.start()
+            ps2buf = ["r2p0", "r2p1"]
+            ps._buf = ps2buf
+            ps.pready(0); ps.pready(1); ps.wait()
+            return None
+        pr = mpi4.precv_init(comm, 2, source=0)
+        pr.start()
+        comm.barrier if False else None
+        import time
+        time.sleep(0.1)  # let BOTH rounds land in the mailbox
+        for _ in range(1000):
+            done, res = pr.test()
+            if done:
+                break
+        assert res == ["r1p0", "r1p1"], res
+        pr.start()
+        assert pr.wait() == ["r2p0", "r2p1"]
+        return True
+
+    run_local(prog, 2)
+
+
+def test_partitioned_test_completes_round():
+    """test() returning True deactivates (MPI semantics): start() may
+    follow without wait(); wait() after test returns the cached result."""
+    def prog(comm):
+        if comm.rank == 0:
+            ps = mpi4.psend_init(comm, [1, 2], 2, dest=1)
+            ps.start(); ps.pready(0); ps.pready(1)
+            done, _ = ps.test()
+            assert done
+            ps.start()  # no wait() needed after a successful test
+            ps.pready(0); ps.pready(1); ps.wait()
+            return None
+        pr = mpi4.precv_init(comm, 2, source=0)
+        assert pr.test() == (True, None)  # inactive tests True
+        pr.start()
+        while True:
+            done, res = pr.test()
+            if done:
+                break
+        assert res == [1, 2]
+        assert pr.wait() == [1, 2]  # cached result after test-completion
+        pr.start()
+        assert pr.wait() == [1, 2]
+        return True
+
+    run_local(prog, 2)
+
+
+def test_partitioned_snapshot_on_aliasing_transport():
+    """pready snapshots on by-reference transports: refilling the buffer
+    after pready must not mutate what the receiver sees."""
+    def prog(comm):
+        if comm.rank == 0:
+            buf = np.zeros((2, 3))
+            ps = mpi4.psend_init(comm, buf, 2, dest=1)
+            ps.start()
+            buf[0] = 1.0
+            ps.pready(0)
+            buf[0] = 99.0  # refill immediately — receiver must see 1.0
+            buf[1] = 2.0
+            ps.pready(1)
+            ps.wait()
+            return None
+        pr = mpi4.precv_init(comm, 2, source=0)
+        pr.start()
+        parts = pr.wait()
+        return np.stack(parts)
+
+    res = run_local(prog, 2, copy_payloads=False)
+    assert np.array_equal(res[1], [[1.0] * 3, [2.0] * 3])
