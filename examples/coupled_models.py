@@ -1,0 +1,69 @@
+"""Two independently started programs couple through a port.
+
+The classic MPI-2 use case for connect/accept: an "ocean" model and an
+"atmosphere" model are SEPARATE jobs (their own launchers, their own
+COMM_WORLDs; launch both with the same -n) that find each other via
+the name service and exchange boundary data every step over the
+intercommunicator.
+
+Run (two shells, or backgrounded):
+
+    python -m mpi_tpu.launcher -n 2 examples/coupled_models.py ocean &
+    python -m mpi_tpu.launcher -n 2 examples/coupled_models.py atmosphere
+"""
+
+import os
+import sys
+
+if __name__ == "__main__":
+    sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+import mpi_tpu
+from mpi_tpu import spawn
+
+ROLE = sys.argv[1] if len(sys.argv) > 1 else "ocean"
+SERVICE = "coupled-demo"
+STEPS = 5
+N = 8  # boundary points per rank
+
+comm = mpi_tpu.COMM_WORLD
+
+# pairing below is rank<->rank: both jobs must be launched with the SAME
+# -n (a many-to-one boundary-routing scheme is a modeling choice, not a
+# transport one)
+
+if ROLE == "ocean":
+    # server side: open a port, publish it, accept the atmosphere
+    port = spawn.open_port() if comm.rank == 0 else None
+    port = comm.bcast(port, 0)
+    if comm.rank == 0:
+        spawn.publish_name(SERVICE, port)
+    inter = spawn.comm_accept(port, comm=comm)
+    assert inter.remote_size == comm.size, "launch both jobs with the same -n"
+    sst = np.full(N, 290.0) + comm.rank  # sea-surface temperature
+    for step in range(STEPS):
+        # each ocean rank exchanges boundaries with its peer atmosphere rank
+        peer = comm.rank % inter.remote_size
+        flux = inter.sendrecv(sst, peer, source=peer)
+        sst = sst + 0.1 * (flux - sst)  # relax toward the forcing
+    if comm.rank == 0:
+        spawn.unpublish_name(SERVICE)
+        spawn.close_port(port)
+        print(f"ocean: coupled {STEPS} steps, final sst[0] = {sst[0]:.3f}")
+    inter.free()
+else:
+    # client side: look the service up (waiting for the server), connect
+    port = spawn.lookup_name(SERVICE, timeout=60) if comm.rank == 0 else None
+    port = comm.bcast(port, 0)
+    inter = spawn.comm_connect(port, comm=comm)
+    assert inter.remote_size == comm.size, "launch both jobs with the same -n"
+    air = np.full(N, 285.0) + comm.rank
+    for step in range(STEPS):
+        peer = comm.rank % inter.remote_size
+        sst = inter.sendrecv(air, peer, source=peer)
+        air = air + 0.05 * (sst - air)
+    if comm.rank == 0:
+        print(f"atmosphere: coupled {STEPS} steps, final air[0] = {air[0]:.3f}")
+    inter.free()

@@ -55,6 +55,7 @@ __all__ = [
     "MPI_Rput", "MPI_Rget", "MPI_Raccumulate", "MPI_Comm_idup",
     "MPI_Type_create_hvector", "MPI_Type_create_hindexed",
     "MPI_Win_allocate_shared", "MPI_Win_shared_query", "MPI_Win_sync",
+    "MPI_Win_create_dynamic", "MPI_Win_attach", "MPI_Win_detach",
     "MPI_Bcast_init", "MPI_Allreduce_init", "MPI_Reduce_init",
     "MPI_Allgather_init", "MPI_Alltoall_init", "MPI_Barrier_init",
     "MPI_Psend_init", "MPI_Precv_init", "MPI_Pready", "MPI_Pready_range",
@@ -656,9 +657,11 @@ def MPI_Get_version():
     Rput/Rget/Raccumulate, Comm_split_type, Comm_idup,
     Comm_create_group, Win_allocate_shared/shared_query/Win_sync
     (true load/store shared-memory windows over /dev/shm mmap on the
-    process backends).  Known MPI-3 gaps, so not higher: no dynamic
-    windows (Win_attach), no MPI_T tool interface, no large-count
-    bindings (Python ints are unbounded), no MPI_Register_datarep."""
+    process backends), Win_create_dynamic/attach/detach (key-addressed
+    runtime regions).  Known MPI-3 gaps, so not higher: no MPI_T tool
+    interface, no large-count bindings (Python ints are unbounded), no
+    MPI_Register_datarep.  MPI-4 previews beyond that: persistent
+    collectives and partitioned communication (mpi_tpu/mpi4.py)."""
     return (3, 0)
 
 
@@ -1238,3 +1241,15 @@ def MPI_Pready_range(request, lo: int, hi: int) -> None:
 
 def MPI_Parrived(request, partition: int) -> bool:
     return request.parrived(partition)
+
+
+def MPI_Win_create_dynamic(comm: Optional[Communicator] = None):
+    return _world(comm).win_create_dynamic()
+
+
+def MPI_Win_attach(win, key: str, array: Any):
+    return win.attach(key, array)
+
+
+def MPI_Win_detach(win, key: str):
+    return win.detach(key)

@@ -150,6 +150,25 @@ class Message:
         return payload
 
 
+def snapshot_payload(transport: Transport, payload: Any) -> Any:
+    """Deep-copy ``payload`` iff the transport aliases payloads (local with
+    copy_payloads=False) — the ONE site encoding the buffer-reuse snapshot
+    rules for persistent sends AND partitioned pready.  Serializing
+    transports copy in send() anyway, so snapshotting there would double
+    the work.  ndarrays get a cheap .copy(); other mutable payloads a
+    pickle round-trip; immutables (and immutable-by-design jax arrays)
+    pass through."""
+    if not transport.aliases_payloads:
+        return payload
+    if isinstance(payload, np.ndarray):
+        return payload.copy()
+    if isinstance(payload, (int, float, complex, bool, str, bytes,
+                            type(None))) or _is_jax_array(payload):
+        return payload
+    return pickle.loads(pickle.dumps(payload,
+                                     protocol=pickle.HIGHEST_PROTOCOL))
+
+
 class Request:
     """Handle for a nonblocking operation (MPI_Request).
 
@@ -245,21 +264,9 @@ class PersistentRequest(Request):
                 "until the previous operation completes)")
         if self._kind == "send":
             # Snapshot at start() time: the MPI buffer-reuse idiom lets the
-            # caller refill the bound buffer as soon as start() returns.
-            # Only a by-reference transport (local with copy_payloads=False)
-            # can alias that refill — serializing transports copy in send()
-            # anyway, so snapshotting there would double the work.  ndarrays
-            # get a cheap .copy(); other mutable payloads (lists, dicts,
-            # pytrees) a pickle round-trip; immutables pass through.
-            payload = self._buf
-            if self._comm._t.aliases_payloads:
-                if isinstance(payload, np.ndarray):
-                    payload = payload.copy()
-                elif not (isinstance(payload, (int, float, complex, bool,
-                                               str, bytes, type(None)))
-                          or _is_jax_array(payload)):
-                    payload = pickle.loads(pickle.dumps(
-                        payload, protocol=pickle.HIGHEST_PROTOCOL))
+            # caller refill the bound buffer as soon as start() returns
+            # (see snapshot_payload).
+            payload = snapshot_payload(self._comm._t, self._buf)
             self._inner = self._comm.isend(payload, self._peer, self._tag)
         else:
             self._inner = self._comm.irecv(self._peer, self._tag)
@@ -900,6 +907,14 @@ class P2PCommunicator(Communicator):
         from .window import P2PWindow
 
         return P2PWindow(self, init)
+
+    def win_create_dynamic(self):
+        """MPI_Win_create_dynamic: a window with no initial memory;
+        attach/detach regions at runtime (mpi_tpu/window.py
+        DynamicWindow — regions addressed by key in every op's loc)."""
+        from .window import DynamicWindow
+
+        return DynamicWindow(self)
 
     # -- collectives -------------------------------------------------------
 

@@ -25,14 +25,13 @@ features whose shapes fit this framework naturally:
 
 from __future__ import annotations
 
-import pickle
 import threading
 from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 
 from .communicator import (Communicator, P2PCommunicator, Request,
-                           _ThreadRequest, _is_jax_array)
+                           _ThreadRequest, snapshot_payload)
 
 __all__ = [
     "PersistentCollective", "persistent_collective",
@@ -150,23 +149,19 @@ class PsendRequest:
             if i in self._ready:
                 raise RuntimeError(f"partition {i} already marked ready "
                                    "this round")
+            # send INSIDE the lock: marking ready and enqueueing must be
+            # atomic, or a racing test()/start() could begin the next
+            # round and enqueue ITS sends first — FIFO would then hand
+            # the receiver a next-round payload inside this round
+            # (review round 3).  Snapshot rules shared with
+            # PersistentRequest.start (communicator.snapshot_payload).
+            part = snapshot_payload(self._c._t, self._buf[i])
+            self._c._send_internal((int(i), part), self._dest, _TAG_PART)
             self._ready.add(i)
-            part = self._buf[i]
-        if self._c._t.aliases_payloads:
-            # by-reference transports: snapshot NOW so the producer can
-            # refill the partition immediately (the MPI buffer-reuse
-            # idiom; same pattern as PersistentRequest.start)
-            if isinstance(part, np.ndarray):
-                part = part.copy()
-            elif not (isinstance(part, (int, float, complex, bool,
-                                        str, bytes, type(None)))
-                      or _is_jax_array(part)):
-                part = pickle.loads(pickle.dumps(
-                    part, protocol=pickle.HIGHEST_PROTOCOL))
-        self._c._send_internal((int(i), part), self._dest, _TAG_PART)
 
     def pready_range(self, lo: int, hi: int) -> None:
-        for i in range(lo, hi):
+        """MPI_Pready_range marks ``lo``..``hi`` INCLUSIVE [S: MPI-4]."""
+        for i in range(lo, hi + 1):
             self.pready(i)
 
     def wait(self) -> None:
@@ -209,18 +204,21 @@ class PrecvRequest:
         self._got: Dict[int, Any] = {}
         self._active = False
         self._result: Optional[List[Any]] = None
+        self._lock = threading.Lock()  # consumer threads poll concurrently
 
     def start(self) -> "PrecvRequest":
-        if self._active:
-            raise RuntimeError("start() on an active partitioned recv")
-        self._active = True
-        self._got = {}
-        self._result = None
+        with self._lock:
+            if self._active:
+                raise RuntimeError("start() on an active partitioned recv")
+            self._active = True
+            self._got = {}
+            self._result = None
         return self
 
-    def _drain_nowait(self) -> None:
-        # bounded to THIS round's partition count: an unbounded drain
-        # would steal (and overwrite with) the sender's next-round
+    def _drain_nowait_locked(self) -> None:
+        # caller holds self._lock.  Bounded to THIS round's partition
+        # count: an unbounded (or un-serialized, with concurrent
+        # consumer threads) drain would steal the sender's next-round
         # messages, corrupting this round and deadlocking the next
         # (review round 3 — reproduced)
         while len(self._got) < self._n:
@@ -232,51 +230,70 @@ class PrecvRequest:
             self._got[i] = part
 
     def parrived(self, i: int) -> bool:
-        """MPI_Parrived: has partition ``i`` landed? (non-blocking)"""
-        if not self._active:
-            raise RuntimeError("parrived() outside an active round")
+        """MPI_Parrived: has partition ``i`` landed? (non-blocking;
+        thread-safe — consumer threads may poll concurrently)"""
         if not (0 <= i < self._n):
             raise ValueError(f"partition {i} out of range (0..{self._n - 1})")
-        self._drain_nowait()
-        return i in self._got
+        with self._lock:
+            if not self._active:
+                raise RuntimeError("parrived() outside an active round")
+            self._drain_nowait_locked()
+            return i in self._got
 
     def partition(self, i: int) -> Any:
         """Partition ``i``'s payload (must have arrived)."""
         if not self.parrived(i):
             raise RuntimeError(f"partition {i} has not arrived yet")
-        return self._got[i]
+        with self._lock:
+            return self._got[i]
 
     def wait(self) -> List[Any]:
         """Block until every partition landed; returns them in partition
         order (stacked by the caller if desired).  After a successful
         test() completed the round, wait() returns the same result."""
-        if not self._active:
-            if self._result is not None:
-                return self._result
-            raise RuntimeError("wait() outside an active round")
-        while len(self._got) < self._n:
-            (i, part), _, _ = self._recv_blocking()
-            self._got[i] = part
-        return self._finish()
+        import time
 
-    def _finish(self) -> List[Any]:
+        with self._lock:
+            if not self._active:
+                if self._result is not None:
+                    return self._result
+                raise RuntimeError("wait() outside an active round")
+        # poll under the lock rather than blocking in transport recv: a
+        # concurrent parrived() could consume the last missing message
+        # and leave a blocking recv stuck waiting for (and then
+        # stealing) a NEXT-round message
+        timeout = self._c.recv_timeout
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while True:
+            with self._lock:
+                self._drain_nowait_locked()
+                if len(self._got) >= self._n:
+                    return self._finish_locked()
+                missing = [i for i in range(self._n) if i not in self._got]
+            if deadline is not None and time.monotonic() > deadline:
+                from .transport.base import RecvTimeout
+
+                raise RecvTimeout(
+                    f"partitioned recv: partitions {missing[:8]} from rank "
+                    f"{self._source} never arrived within {timeout}s")
+            time.sleep(0.0005)
+
+    def _finish_locked(self) -> List[Any]:
+        # caller holds self._lock
         self._active = False
         self._result = [self._got[i] for i in range(self._n)]
         return self._result
 
-    def _recv_blocking(self):
-        return self._c._t.recv(self._c._world(self._source), self._c._ctx,
-                               _TAG_PART, timeout=self._c.recv_timeout)
-
     def test(self) -> Tuple[bool, Any]:
         """Inactive tests True; completion DEACTIVATES the round and
         caches the assembled result for a subsequent wait()."""
-        if not self._active:
-            return True, self._result
-        self._drain_nowait()
-        if len(self._got) == self._n:
-            return True, self._finish()
-        return False, None
+        with self._lock:
+            if not self._active:
+                return True, self._result
+            self._drain_nowait_locked()
+            if len(self._got) == self._n:
+                return True, self._finish_locked()
+            return False, None
 
 
 def psend_init(comm: Communicator, buf: Any, partitions: int, dest: int,

@@ -863,3 +863,74 @@ class P2PWindow:
             self._buf[...] = op.combine(self._buf, data)
         else:
             self._buf[loc] = op.combine(self._buf[loc], data)
+
+
+class DynamicWindow(P2PWindow):
+    """MPI_Win_create_dynamic [S: MPI-3 ch.11.2.4]: a window with NO
+    initial memory; regions are attached and detached at runtime.  MPI
+    addresses attached regions by base pointer; the value-semantics
+    spelling here addresses them by KEY — ``loc`` in every RMA op is the
+    region key, or ``(key, subindex)`` for a part of a region.
+
+    attach/detach are LOCAL calls, per MPI; an op targeting a region the
+    target has not attached fails at the target and surfaces through the
+    usual completion points (unlock/flush/complete)."""
+
+    def __init__(self, comm):
+        super().__init__(comm, np.zeros(0))
+        self._regions: dict = {}
+
+    # -- local region management -------------------------------------------
+
+    def attach(self, key: str, array: Any) -> np.ndarray:
+        """Expose ``array`` (copied in, MPI_Win_create memory semantics)
+        under ``key``; returns the live region (reads show remote
+        writes after the usual synchronization).  Local call [S]."""
+        with self._srv_mutex:  # serialized against the window server
+            if key in self._regions:
+                raise ValueError(f"region {key!r} already attached")
+            self._regions[key] = np.array(array)
+        return self._regions[key]
+
+    def detach(self, key: str) -> np.ndarray:
+        """Withdraw the region; returns its final contents.  Local [S]."""
+        with self._srv_mutex:
+            if key not in self._regions:
+                raise ValueError(f"region {key!r} is not attached")
+            return self._regions.pop(key)
+
+    def region(self, key: str) -> np.ndarray:
+        return self._regions[key]
+
+    # -- storage override: loc = key | (key, subindex) ----------------------
+
+    def _resolve(self, loc: Any):
+        if loc is None:
+            raise ValueError(
+                "dynamic-window ops need loc=<region key> or "
+                "(key, subindex) — there is no base buffer")
+        if isinstance(loc, tuple) and len(loc) == 2 and loc[0] in self._regions:
+            return self._regions[loc[0]], loc[1]
+        if isinstance(loc, (str, bytes)) or loc in self._regions:
+            if loc not in self._regions:
+                raise KeyError(f"region {loc!r} is not attached at this "
+                               "target")
+            return self._regions[loc], None
+        raise KeyError(f"region {loc!r} is not attached at this target")
+
+    def _read(self, loc: Any) -> np.ndarray:
+        buf, sub = self._resolve(loc)
+        return np.copy(buf if sub is None else buf[sub])
+
+    def _apply(self, kind: str, data: np.ndarray, loc: Any,
+               op: Optional[_ops.ReduceOp]) -> None:
+        buf, sub = self._resolve(loc)
+        if kind == "put":
+            if sub is None:
+                buf[...] = data
+            else:
+                buf[sub] = data
+        elif sub is None:
+            buf[...] = op.combine(buf, data)
+        else:
+            buf[sub] = op.combine(buf[sub], data)

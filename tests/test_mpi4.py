@@ -193,7 +193,7 @@ def test_partitioned_rounds_do_not_cross():
     round 3 — reproduced corruption before the bounded drain)."""
     def prog(comm):
         if comm.rank == 0:
-            ps = mpi4.psend_init(comm, [["r1p0", "r1p1"]][0], 2, dest=1)
+            ps = mpi4.psend_init(comm, ["r1p0", "r1p1"], 2, dest=1)
             ps.start(); ps.pready(0); ps.pready(1); ps.wait()
             # race straight into round 2 before the receiver drains
             ps.start()
@@ -203,7 +203,6 @@ def test_partitioned_rounds_do_not_cross():
             return None
         pr = mpi4.precv_init(comm, 2, source=0)
         pr.start()
-        comm.barrier if False else None
         import time
         time.sleep(0.1)  # let BOTH rounds land in the mailbox
         for _ in range(1000):

@@ -878,3 +878,60 @@ def test_win_sync_valid_on_any_window():
         return 0
 
     mpi_tpu.run(tpu_prog, backend="tpu", nranks=None)
+
+
+# -- dynamic windows (MPI_Win_create_dynamic, round 3) ----------------------
+
+
+def test_dynamic_window_attach_rma_detach():
+    def prog(comm):
+        win = comm.win_create_dynamic()
+        comm.barrier()
+        if comm.rank == 0:
+            win.attach("grid", np.zeros(4))
+            win.attach("halo", np.zeros(2))
+        comm.barrier()
+        if comm.rank == 1:
+            win.lock(0)
+            win.put_at(0, np.arange(4.0), loc="grid")
+            win.accumulate_at(0, np.ones(2), loc="halo")
+            win.put_at(0, np.asarray([-1.0]), loc=("grid", slice(0, 1)))
+            got = win.get_at(0, loc="halo")
+            win.unlock(0)
+            out = np.asarray(got)
+        else:
+            out = None
+        comm.barrier()
+        if comm.rank == 0:
+            grid = win.detach("grid")
+            halo = win.detach("halo")
+            final = (grid, halo)
+        else:
+            final = None
+        comm.barrier()
+        win.free()
+        return out, final
+
+    res = run_local(prog, 2)
+    assert np.array_equal(res[1][0], [1.0, 1.0])
+    grid, halo = res[0][1]
+    assert np.array_equal(grid, [-1.0, 1.0, 2.0, 3.0])
+    assert np.array_equal(halo, [1.0, 1.0])
+
+
+def test_dynamic_window_unattached_region_diagnosed():
+    def prog(comm):
+        win = comm.win_create_dynamic()
+        comm.barrier()
+        if comm.rank == 1:
+            win.lock(0)
+            win.put_at(0, np.ones(2), loc="nope")
+            with pytest.raises(RuntimeError, match="not attached"):
+                win.unlock(0)  # op errors surface at completion
+            with pytest.raises(RuntimeError, match="need loc"):
+                win.fetch_and_op(1, np.ones(1))  # self, no region
+        comm.barrier()
+        win.free()
+        return True
+
+    run_local(prog, 2)
