@@ -56,6 +56,8 @@ __all__ = [
     "MPI_Type_create_hvector", "MPI_Type_create_hindexed",
     "MPI_Win_allocate_shared", "MPI_Win_shared_query", "MPI_Win_sync",
     "MPI_Win_create_dynamic", "MPI_Win_attach", "MPI_Win_detach",
+    "MPI_T_cvar_list", "MPI_T_cvar_read", "MPI_T_cvar_write",
+    "MPI_T_pvar_list", "MPI_T_pvar_read", "MPI_T_pvar_session_create",
     "MPI_Bcast_init", "MPI_Allreduce_init", "MPI_Reduce_init",
     "MPI_Allgather_init", "MPI_Alltoall_init", "MPI_Barrier_init",
     "MPI_Psend_init", "MPI_Precv_init", "MPI_Pready", "MPI_Pready_range",
@@ -658,10 +660,12 @@ def MPI_Get_version():
     Comm_create_group, Win_allocate_shared/shared_query/Win_sync
     (true load/store shared-memory windows over /dev/shm mmap on the
     process backends), Win_create_dynamic/attach/detach (key-addressed
-    runtime regions).  Known MPI-3 gaps, so not higher: no MPI_T tool
-    interface, no large-count bindings (Python ints are unbounded), no
-    MPI_Register_datarep.  MPI-4 previews beyond that: persistent
-    collectives and partitioned communication (mpi_tpu/mpi4.py)."""
+    runtime regions), and an MPI_T tool interface (mpit.py: real cvars
+    steering the library + exact transport-level pvar counters).
+    Remaining MPI-3 gaps: large-count bindings (meaningless — Python
+    ints are unbounded) and MPI_Register_datarep.  MPI-4 previews
+    beyond that: persistent collectives and partitioned communication
+    (mpi_tpu/mpi4.py)."""
     return (3, 0)
 
 
@@ -1253,3 +1257,15 @@ def MPI_Win_attach(win, key: str, array: Any):
 
 def MPI_Win_detach(win, key: str):
     return win.detach(key)
+
+
+# -- MPI_T tool interface (mpi_tpu/mpit.py) ---------------------------------
+
+from . import mpit as _mpit  # noqa: E402 - grouped with its API block
+
+MPI_T_cvar_list = _mpit.cvar_list
+MPI_T_cvar_read = _mpit.cvar_read
+MPI_T_cvar_write = _mpit.cvar_write
+MPI_T_pvar_list = _mpit.pvar_list
+MPI_T_pvar_read = _mpit.pvar_read
+MPI_T_pvar_session_create = _mpit.session_create

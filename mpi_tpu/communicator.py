@@ -30,11 +30,15 @@ from typing import Any, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from . import mpit as _mpit
 from . import ops as _ops
 from . import schedules
 from .transport.base import ANY_SOURCE, ANY_TAG, Transport
 
 # Internal tags (never matched by user-level ANY_TAG — see Mailbox._matches).
+# CPU-backend allreduce auto crossover (mpit cvar; measured, BASELINE.md)
+_RING_CROSSOVER_BYTES = 64 << 10
+
 _TAG_COLL = -2
 _TAG_SHIFT = -3
 _TAG_BARRIER = -4
@@ -755,6 +759,10 @@ class P2PCommunicator(Communicator):
         self._send_internal(obj, dest, tag)
 
     def _send_internal(self, obj: Any, dest: int, tag: int) -> None:
+        nbytes = getattr(obj, "nbytes", None)
+        if nbytes is None and isinstance(obj, (bytes, bytearray)):
+            nbytes = len(obj)
+        _mpit.count(sends=1, send_bytes=int(nbytes or 0))
         self._t.send(self._world(dest), self._ctx, tag, obj)
 
     def recv(self, source: int = ANY_SOURCE, tag: int = ANY_TAG,
@@ -767,6 +775,7 @@ class P2PCommunicator(Communicator):
         src_world = ANY_SOURCE if source == ANY_SOURCE else self._world(source)
         obj, src, t = self._t.recv(src_world, self._ctx, tag,
                                    timeout=self.recv_timeout)
+        _mpit.count(recvs=1)
         if status is not None:
             status._fill(self._from_world(src), t, obj)
         return obj
@@ -919,6 +928,7 @@ class P2PCommunicator(Communicator):
     # -- collectives -------------------------------------------------------
 
     def bcast(self, obj: Any, root: int = 0, algorithm: str = "auto") -> Any:
+        _mpit.count(collectives=1)
         # Binomial tree, log2(P) rounds (BASELINE.json:8).  'fused' (the TPU
         # backend's XLA-collective path) has no socket analogue and aliases
         # to the tree so portable programs run unchanged.
@@ -935,6 +945,7 @@ class P2PCommunicator(Communicator):
 
     def reduce(self, obj: Any, op: _ops.ReduceOp = _ops.SUM, root: int = 0,
                algorithm: str = "auto") -> Any:
+        _mpit.count(collectives=1)
         if algorithm not in ("auto", "tree", "fused"):  # 'fused' aliases tree here
             raise ValueError(f"unknown reduce algorithm {algorithm!r}")
         self._world(root)  # validate
@@ -950,6 +961,7 @@ class P2PCommunicator(Communicator):
 
     def allreduce(self, obj: Any, op: _ops.ReduceOp = _ops.SUM,
                   algorithm: str = "auto") -> Any:
+        _mpit.count(collectives=1)
         arr, scalar = _as_array(obj)
         if algorithm == "fused":  # no fused path on sockets; best schedule
             algorithm = "auto"
@@ -958,7 +970,8 @@ class P2PCommunicator(Communicator):
             # power-of-two groups; bandwidth-optimal ring otherwise
             # (the crossover the reference benchmarks head-to-head,
             # BASELINE.json:10).
-            if schedules.is_pow2(self.size) and arr.nbytes < (64 << 10):
+            if schedules.is_pow2(self.size) and \
+                    arr.nbytes < _RING_CROSSOVER_BYTES:
                 algorithm = "recursive_halving"
             else:
                 algorithm = "ring"
@@ -1026,6 +1039,7 @@ class P2PCommunicator(Communicator):
         return np.concatenate(chunks).reshape(shape).astype(dtype, copy=False)
 
     def allgather(self, obj: Any, algorithm: str = "auto") -> List[Any]:
+        _mpit.count(collectives=1)
         p, r = self.size, self._rank
         if algorithm in ("auto", "fused"):  # no fused path on sockets; best schedule
             algorithm = "doubling" if schedules.is_pow2(p) else "ring"
@@ -1052,6 +1066,7 @@ class P2PCommunicator(Communicator):
         return _maybe_stack(obj, items)
 
     def alltoall(self, objs: Sequence[Any], algorithm: str = "auto") -> List[Any]:
+        _mpit.count(collectives=1)
         p, r = self.size, self._rank
         if algorithm not in ("auto", "fused", "pairwise"):
             raise ValueError(f"unknown alltoall algorithm {algorithm!r}")
@@ -1066,6 +1081,7 @@ class P2PCommunicator(Communicator):
         return _maybe_stack(objs, result)
 
     def barrier(self) -> None:
+        _mpit.count(collectives=1)
         # Dissemination barrier, ceil(log2 P) rounds [S].
         p, r = self.size, self._rank
         for off in schedules.dissemination_offsets(p):
@@ -1073,6 +1089,7 @@ class P2PCommunicator(Communicator):
             self._recv_internal((r - off) % p, _TAG_BARRIER)
 
     def scan(self, obj: Any, op: _ops.ReduceOp = _ops.SUM) -> Any:
+        _mpit.count(collectives=1)
         # Hillis-Steele inclusive scan: log2(P) rounds of distance-doubling
         # partial prefixes [S].
         arr, scalar = _as_array(obj)
@@ -1090,6 +1107,7 @@ class P2PCommunicator(Communicator):
 
     def reduce_scatter(self, blocks: Any, op: _ops.ReduceOp = _ops.SUM,
                        algorithm: str = "auto") -> Any:
+        _mpit.count(collectives=1)
         p, r = self.size, self._rank
         if algorithm in ("auto", "fused"):
             algorithm = "ring"

@@ -1,0 +1,193 @@
+"""MPI_T tool interface [S: MPI-3 ch.14] — the introspection chapter.
+
+A deliberately small, honest implementation of the two variable kinds:
+
+* **Control variables (cvar)**: named knobs a tool can read and set.
+  Registered here are the real, load-bearing ones this library already
+  has (collective algorithm crossover, the collective-IO buffering
+  limit, receive timeout default).
+* **Performance variables (pvar)**: counters a tool can read/reset.
+  Counted (thread-safely) at the one choke point every process backend
+  shares — P2PCommunicator._send_internal / _recv_internal, plus every
+  collective entry point — so message/collective counts are exact
+  regardless of transport.  ``bytes_sent`` counts SIZED payloads
+  (arrays / bytes); opaque pickled objects count 0 there (their wire
+  size is a transport detail).
+
+Sessions are the MPI_T scoping object; handles are (session, variable)
+pairs, pythonically collapsed — a session simply records which pvars it
+reset, so reads are session-relative like the standard requires.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+__all__ = [
+    "cvar_register", "cvar_list", "cvar_read", "cvar_write",
+    "pvar_list", "pvar_read", "pvar_reset",
+    "Session", "session_create",
+]
+
+_lock = threading.Lock()
+
+
+# -- performance variables (exact transport-level counters) ------------------
+
+class _Counters:
+    __slots__ = ("sends", "send_bytes", "recvs", "collectives")
+
+    def __init__(self) -> None:
+        self.sends = 0
+        self.send_bytes = 0
+        self.recvs = 0
+        self.collectives = 0
+
+
+counters = _Counters()  # incremented by communicator.py (see count())
+
+
+def count(sends: int = 0, send_bytes: int = 0, recvs: int = 0,
+          collectives: int = 0) -> None:
+    """Thread-safe increment (rank-threads of the local backend share
+    this process's counters; unsynchronized += would lose updates)."""
+    with _lock:
+        counters.sends += sends
+        counters.send_bytes += send_bytes
+        counters.recvs += recvs
+        counters.collectives += collectives
+
+_PVARS: Dict[str, Callable[[], int]] = {
+    "msgs_sent": lambda: counters.sends,
+    "bytes_sent": lambda: counters.send_bytes,
+    "msgs_received": lambda: counters.recvs,
+    "collectives_started": lambda: counters.collectives,
+}
+
+
+def pvar_list() -> List[str]:
+    """MPI_T_pvar_get_info over all indices: the variable names."""
+    return sorted(_PVARS)
+
+
+def pvar_read(name: str) -> int:
+    """Absolute (process-lifetime) value of a performance variable."""
+    try:
+        return int(_PVARS[name]())
+    except KeyError:
+        raise KeyError(f"unknown pvar {name!r}; have {pvar_list()}") from None
+
+
+def pvar_reset(name: str) -> int:
+    """MPI_T semantics put reset in the session; module-level reset just
+    returns the current value to subtract (see Session)."""
+    return pvar_read(name)
+
+
+# -- control variables -------------------------------------------------------
+
+_CVARS: Dict[str, Tuple[Callable[[], Any], Optional[Callable[[Any], None]],
+                        str]] = {}
+
+
+def cvar_register(name: str, reader: Callable[[], Any],
+                  writer: Optional[Callable[[Any], None]],
+                  desc: str) -> None:
+    with _lock:
+        _CVARS[name] = (reader, writer, desc)
+
+
+def cvar_list() -> Dict[str, str]:
+    """name -> description (MPI_T_cvar_get_info)."""
+    _ensure_builtin_cvars()
+    return {k: v[2] for k, v in sorted(_CVARS.items())}
+
+
+def cvar_read(name: str) -> Any:
+    _ensure_builtin_cvars()
+    try:
+        return _CVARS[name][0]()
+    except KeyError:
+        raise KeyError(f"unknown cvar {name!r}; have "
+                       f"{sorted(_CVARS)}") from None
+
+
+def cvar_write(name: str, value: Any) -> None:
+    _ensure_builtin_cvars()
+    try:
+        reader, writer, _ = _CVARS[name]
+    except KeyError:
+        raise KeyError(f"unknown cvar {name!r}; have "
+                       f"{sorted(_CVARS)}") from None
+    if writer is None:
+        raise PermissionError(f"cvar {name!r} is read-only")
+    writer(value)
+
+
+_builtin_done = False
+
+
+def _ensure_builtin_cvars() -> None:
+    """The knobs that actually steer this library — registered LAZILY so
+    importing mpit from the transports cannot cycle back through io/
+    communicator at module-import time."""
+    global _builtin_done
+    if _builtin_done:
+        return
+    # imports OUTSIDE the lock (they can run user-level module code);
+    # registration + flag UNDER it, flag LAST — a concurrent reader must
+    # never observe done=True with the registry still empty
+    from . import communicator as _c
+    from . import io as _io
+
+    def _get_limit():
+        return _io._COLLECTIVE_BUFFER_LIMIT
+
+    def _set_limit(v):
+        _io._COLLECTIVE_BUFFER_LIMIT = int(v)
+
+    def _get_cross():
+        return _c._RING_CROSSOVER_BYTES
+
+    def _set_cross(v):
+        _c._RING_CROSSOVER_BYTES = int(v)
+
+    with _lock:
+        if _builtin_done:
+            return
+        _CVARS["io_collective_buffer_limit_bytes"] = (
+            _get_limit, _set_limit,
+            "write_at_all aggregates at rank 0 below this total (two-"
+            "phase collective buffering); above it ranks write "
+            "independently")
+        _CVARS["allreduce_ring_crossover_bytes"] = (
+            _get_cross, _set_cross,
+            "CPU-backend allreduce auto algorithm picks latency-optimal "
+            "recursive halving below this payload size (pow2 groups), "
+            "bandwidth-optimal ring at or above it")
+        _builtin_done = True
+
+
+# -- sessions ----------------------------------------------------------------
+
+class Session:
+    """MPI_T session: pvar reads are relative to the session's resets."""
+
+    def __init__(self) -> None:
+        self._base: Dict[str, int] = {}
+
+    def read(self, name: str) -> int:
+        return pvar_read(name) - self._base.get(name, 0)
+
+    def reset(self, name: str) -> None:
+        self._base[name] = pvar_read(name)
+
+    def reset_all(self) -> None:
+        for name in pvar_list():
+            self.reset(name)
+
+
+def session_create() -> Session:
+    """MPI_T_pvar_session_create."""
+    return Session()

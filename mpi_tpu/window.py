@@ -886,11 +886,12 @@ class DynamicWindow(P2PWindow):
         """Expose ``array`` (copied in, MPI_Win_create memory semantics)
         under ``key``; returns the live region (reads show remote
         writes after the usual synchronization).  Local call [S]."""
+        region = np.array(array)
         with self._srv_mutex:  # serialized against the window server
             if key in self._regions:
                 raise ValueError(f"region {key!r} already attached")
-            self._regions[key] = np.array(array)
-        return self._regions[key]
+            self._regions[key] = region
+        return region
 
     def detach(self, key: str) -> np.ndarray:
         """Withdraw the region; returns its final contents.  Local [S]."""
@@ -909,14 +910,15 @@ class DynamicWindow(P2PWindow):
             raise ValueError(
                 "dynamic-window ops need loc=<region key> or "
                 "(key, subindex) — there is no base buffer")
-        if isinstance(loc, tuple) and len(loc) == 2 and loc[0] in self._regions:
-            return self._regions[loc[0]], loc[1]
-        if isinstance(loc, (str, bytes)) or loc in self._regions:
-            if loc not in self._regions:
-                raise KeyError(f"region {loc!r} is not attached at this "
-                               "target")
-            return self._regions[loc], None
-        raise KeyError(f"region {loc!r} is not attached at this target")
+        if isinstance(loc, tuple) and len(loc) == 2:
+            key, sub = loc
+        else:
+            key, sub = loc, None
+        try:
+            return self._regions[key], sub
+        except (KeyError, TypeError):  # unknown key, or unhashable loc
+            raise KeyError(f"region {key!r} is not attached at this "
+                           "target") from None
 
     def _read(self, loc: Any) -> np.ndarray:
         buf, sub = self._resolve(loc)

@@ -926,6 +926,9 @@ def test_dynamic_window_unattached_region_diagnosed():
         if comm.rank == 1:
             win.lock(0)
             win.put_at(0, np.ones(2), loc="nope")
+            # unhashable loc targeting an unattached region must give the
+            # same diagnostic, not TypeError (review round 3)
+            win.put_at(0, np.ones(1), loc=(["un", "hashable"], 0))
             with pytest.raises(RuntimeError, match="not attached"):
                 win.unlock(0)  # op errors surface at completion
             with pytest.raises(RuntimeError, match="need loc"):
