@@ -64,6 +64,23 @@ def launch(
     return last
 
 
+def cpu_pinned_env(env: dict, want: Optional[str] = None) -> dict:
+    """Pin a child rank's jax to CPU (in place) unless ``want`` names
+    another platform: N rank processes must not each claim the (single,
+    possibly tunneled) TPU — concurrent claims serialize or wedge the
+    pool, hanging every rank at ``import jax``.  The ONE shared helper
+    for the launcher, comm_spawn, and bench fallbacks; the platform-
+    trigger scrub only applies when pinning to cpu, so an explicit
+    ``want='tpu'`` keeps the accelerator registration vars intact."""
+    want = want or env.pop("MPI_TPU_RANK_JAX_PLATFORMS", None) or "cpu"
+    if want == "cpu":
+        for k in list(env):
+            if k.startswith(("PALLAS_AXON", "AXON_")):
+                del env[k]
+    env["JAX_PLATFORMS"] = want
+    return env
+
+
 def _launch_once(
     nranks: int,
     argv: Sequence[str],
@@ -85,6 +102,10 @@ def _launch_once(
     try:
         for r in range(nranks):
             env = dict(os.environ)
+            # the escape hatch may arrive via env_extra OR the caller's
+            # environment — honor both before pinning
+            want = (env_extra or {}).get("MPI_TPU_RANK_JAX_PLATFORMS")
+            cpu_pinned_env(env, want)
             env.update(
                 {
                     ENV_RANK: str(r),

@@ -123,7 +123,12 @@ def _spawn_segments(segments: List[Tuple[List[str], int]],
         child_rank = 0
         for argv, n in segments:
             for _ in range(n):
+                from .launcher import cpu_pinned_env
+
                 env = dict(os.environ)
+                # same CPU pinning as the launcher (shared helper)
+                cpu_pinned_env(
+                    env, (env_extra or {}).get("MPI_TPU_RANK_JAX_PLATFORMS"))
                 env.update({
                     ENV_RANK: str(child_rank),
                     ENV_SIZE: str(nchildren),
