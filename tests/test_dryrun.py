@@ -92,3 +92,47 @@ def test_grouped_psum_scalar_and_odd_shapes():
             g0 = (r // 2) * 2
             np.testing.assert_allclose(out[r], x[g0] + x[g0 + 1],
                                        rtol=1e-5, atol=1e-6)
+
+
+def test_unwedge_guard_flips_to_cpu_on_probe_timeout(monkeypatch):
+    """A wedged device pool (probe subprocess timeout) must pin the live
+    jax config to CPU instead of letting entry() hang the driver."""
+    import subprocess
+
+    import jax
+
+    import __graft_entry__ as ge
+
+    calls = {}
+
+    def fake_run(*a, **k):
+        calls["probed"] = True
+        raise subprocess.TimeoutExpired(cmd=a[0], timeout=k.get("timeout"))
+
+    monkeypatch.setenv("JAX_PLATFORMS", "axon")
+    monkeypatch.setenv("PALLAS_AXON_POOL_IPS", "10.0.0.1")
+    monkeypatch.setattr(subprocess, "run", fake_run)
+    old = jax.config.jax_platforms
+    try:
+        ge._unwedge_guard()
+        assert calls.get("probed")
+        import os
+
+        assert os.environ["JAX_PLATFORMS"] == "cpu"
+        assert "PALLAS_AXON_POOL_IPS" not in os.environ
+        assert jax.config.jax_platforms == "cpu"
+    finally:
+        jax.config.update("jax_platforms", old)
+
+
+def test_unwedge_guard_noop_on_cpu_env(monkeypatch):
+    import subprocess
+
+    import __graft_entry__ as ge
+
+    def boom(*a, **k):
+        raise AssertionError("probe must not run when cpu is requested")
+
+    monkeypatch.setenv("JAX_PLATFORMS", "cpu")
+    monkeypatch.setattr(subprocess, "run", boom)
+    ge._unwedge_guard()  # returns without probing
