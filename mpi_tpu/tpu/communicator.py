@@ -85,12 +85,19 @@ class TpuCommunicator(Communicator):
     """
 
     def __init__(self, axis_name: str, mesh: Mesh,
-                 groups: Optional[List[List[int]]] = None):
+                 groups: Optional[List[List[int]]] = None,
+                 pallas_interpret: Optional[bool] = None):
         if axis_name not in mesh.axis_names:
             raise ValueError(f"axis {axis_name!r} not in mesh axes {mesh.axis_names}")
         self.axis_name = axis_name
         self.mesh = mesh
         self._axis_size = mesh.shape[axis_name]
+        # pallas_interpret: None → auto (interpret on CPU platforms);
+        # False forces the compiled kernel — needed when CROSS-LOWERING
+        # for TPU from a CPU host (jax.export platforms=['tpu']), where
+        # the trace-time platform probe would otherwise bake in the
+        # interpreter fallback instead of the RDMA kernel
+        self._pallas_interpret = pallas_interpret
         if groups is not None:
             sizes = {len(g) for g in groups}
             if len(sizes) != 1:
@@ -150,6 +157,15 @@ class TpuCommunicator(Communicator):
 
             return jax.default_backend() == "cpu"
         return devices.flat[0].platform == "cpu"
+
+    @property
+    def _pallas_interp(self) -> bool:
+        """Whether pallas_ring calls run under the interpreter: the
+        constructor's explicit ``pallas_interpret`` if given, else the
+        platform probe (see ``__init__``)."""
+        if self._pallas_interpret is not None:
+            return self._pallas_interpret
+        return self._on_cpu
 
     def _world_pairs(self, group_pairs: Sequence[Pair]) -> List[Pair]:
         """Expand group-local (src, dst) pairs to world-level ppermute pairs
@@ -402,7 +418,7 @@ class TpuCommunicator(Communicator):
             from .pallas_ring import pallas_ring_allreduce
 
             return pallas_ring_allreduce(x, self.axis_name, self.size,
-                                         interpret=self._on_cpu,
+                                         interpret=self._pallas_interp,
                                          groups=self._groups,
                                          op=_pallas_op_name(op))
         if algorithm == "recursive_halving":
@@ -480,7 +496,7 @@ class TpuCommunicator(Communicator):
             from .pallas_ring import pallas_ring_allgather
 
             return pallas_ring_allgather(x, self.axis_name, self.size,
-                                         interpret=self._on_cpu,
+                                         interpret=self._pallas_interp,
                                          groups=self._groups)
         raise ValueError(f"unknown allgather algorithm {algorithm!r}")
 
@@ -568,7 +584,7 @@ class TpuCommunicator(Communicator):
             from .pallas_ring import pallas_ring_reduce_scatter
 
             return pallas_ring_reduce_scatter(x, self.axis_name, self.size,
-                                              interpret=self._on_cpu,
+                                              interpret=self._pallas_interp,
                                               groups=self._groups,
                                               op=_pallas_op_name(op))
         raise ValueError(f"unknown reduce_scatter algorithm {algorithm!r}")
@@ -718,7 +734,8 @@ class TpuCommunicator(Communicator):
             for c in sorted(buckets):
                 new_groups.append([w for _, _, w in sorted(buckets[c])])
         return self._inherit_errhandler(
-            TpuCommunicator(self.axis_name, self.mesh, new_groups))
+            TpuCommunicator(self.axis_name, self.mesh, new_groups,
+                            pallas_interpret=self._pallas_interpret))
 
     def split_by(self, color_fn, key_fn=None) -> "TpuCommunicator":
         """split_all with functions of the world axis index."""
@@ -768,7 +785,8 @@ class TpuCommunicator(Communicator):
         # SPMD collectives carry no message-matching state, so a dup is a
         # fresh handle over the same groups.
         return self._copy_attrs_to(
-            TpuCommunicator(self.axis_name, self.mesh, self._groups))
+            TpuCommunicator(self.axis_name, self.mesh, self._groups,
+                            pallas_interpret=self._pallas_interpret))
 
     def free(self) -> None:
         pass

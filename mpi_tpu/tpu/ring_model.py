@@ -222,6 +222,17 @@ class RingSim:
                       for sl in range(2) for s in range(F)}
                      for d in range(P)]
         self.trace: List[str] = []
+        # -- link-occupancy tracking (VERDICT r3 missing #4) --------------
+        # Physical link i joins devices i and i+1; a right-going RDMA from
+        # src rides link src, a left-going one from src rides link src-1.
+        # Counters sample occupancy once per executed event ("tick"):
+        # how often each direction had an RDMA in flight, how often BOTH
+        # did simultaneously (the full-duplex overlap the bidirectional
+        # design claims), and the same per physical link.
+        self.ticks = 0
+        self.dir_busy_ticks = {+1: 0, -1: 0}
+        self.both_dir_ticks = 0
+        self.link_overlap_ticks = [0] * P
 
     # -- event enumeration --------------------------------------------------
 
@@ -318,6 +329,42 @@ class RingSim:
             del self.dmas[i]
             self.trace.append(f"arrive:{dma.src}->{dst} u={dma.u} "
                               f"seg={dma.seg}")
+        self._record_occupancy()
+
+    def _record_occupancy(self) -> None:
+        """Sample per-direction / per-link wire occupancy after an event.
+        An RDMA occupies its link from start until arrive (the model's
+        conservative in-flight window)."""
+        self.ticks += 1
+        busy: Dict[int, set] = {+1: set(), -1: set()}
+        for dma in self.dmas:
+            dirn = self.dirs[dma.seg]
+            link = dma.src if dirn > 0 else (dma.src - 1) % self.P
+            busy[dirn].add(link)
+        for dirn in (+1, -1):
+            if busy[dirn]:
+                self.dir_busy_ticks[dirn] += 1
+        if busy[+1] and busy[-1]:
+            self.both_dir_ticks += 1
+        for link in busy[+1] & busy[-1]:
+            self.link_overlap_ticks[link] += 1
+
+    def occupancy_summary(self) -> Dict[str, object]:
+        """Link-occupancy evidence for the bidirectional-overlap claim
+        (pallas_ring.py header: 'twice the usable line-rate'):
+        ``both_dir_ticks`` counts event-ticks during which right-going
+        AND left-going RDMAs were simultaneously in flight, and
+        ``links_with_duplex_overlap`` how many physical links carried
+        both directions at once at some point."""
+        return {
+            "ticks": self.ticks,
+            "right_busy_ticks": self.dir_busy_ticks[+1],
+            "left_busy_ticks": self.dir_busy_ticks[-1],
+            "both_dir_ticks": self.both_dir_ticks,
+            "links_with_duplex_overlap": sum(
+                1 for t in self.link_overlap_ticks if t > 0),
+            "n_links": self.P,
+        }
 
     def _accum(self, d: int, u: int, seg: int) -> None:
         slot = (u % 2, seg)
