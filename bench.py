@@ -268,22 +268,31 @@ def main() -> None:
 
     # best-of-3 per leg: each sample is already a p50 of 200 calls, but
     # on this 1-core box cross-RUN scheduler contention dominates the
-    #variance (observed r3: the ratio swung 1.4x-3.6x between runs);
-    # the min is the least-contended sample of each transport
-    socket_us = min(measure_process_p50("socket") for _ in range(3))
+    # variance (observed r3: the ratio swung 1.4x-3.6x between runs);
+    # the min is the least-contended sample of each transport.  ALL
+    # samples are persisted (VERDICT r3 next #6) so cross-round deltas
+    # are interpretable: a moved headline can be told apart from a
+    # lucky draw by comparing the full sample sets.
+    details["wedged_tunnel_fallback"] = wedged
+    socket_samples = [measure_process_p50("socket") for _ in range(3)]
+    socket_us = min(socket_samples)
     details["socket_2rank_1kf32_p50_us"] = socket_us
+    details["socket_samples_us"] = socket_samples
     try:
-        details["shm_2rank_1kf32_p50_us"] = min(
-            measure_process_p50("shm") for _ in range(3))
+        shm_samples = [measure_process_p50("shm") for _ in range(3)]
+        details["shm_2rank_1kf32_p50_us"] = min(shm_samples)
+        details["shm_samples_us"] = shm_samples
     except Exception as e:  # native toolchain may be absent
         details["shm_error"] = str(e)[:200]
 
     force_cpu = "yes" if n_real < 2 else "no"
-    spmd_us = min(float(_run_sub(
+    spmd_samples = [float(_run_sub(
         SPMD_PROG.format(repo=REPO, force_cpu=force_cpu), {},
         env_base=_cpu_env() if force_cpu == "yes" else None))
-        for _ in range(3))
+        for _ in range(3)]
+    spmd_us = min(spmd_samples)
     details["spmd_2rank_1kf32_p50_us"] = spmd_us
+    details["spmd_samples_us"] = spmd_samples
     details["spmd_leg_platform"] = "cpu-sim" if force_cpu == "yes" else "tpu-ici"
 
     # North-star leg (BASELINE.json:5): the REAL measurement needs >=2

@@ -74,6 +74,14 @@ class Status:
         self.tag = tag
         self._set_count(payload)
 
+    def _fill_envelope(self, source: int, tag: int) -> None:
+        """probe/iprobe: envelope only.  count_bytes is RESET to None
+        (MPI_UNDEFINED) — a Status reused after a prior recv must not
+        leak that recv's count through a probe (ADVICE r3 #1)."""
+        self.source = source
+        self.tag = tag
+        self.count_bytes = None
+
     def __repr__(self) -> str:  # pragma: no cover - cosmetic
         return f"Status(source={self.source}, tag={self.tag})"
 
@@ -671,6 +679,17 @@ class Communicator(ABC):
 
         return Group(range(self.size))
 
+    def split_type(self, split_type: str = "shared",
+                   key: int = 0) -> Optional["Communicator"]:
+        """MPI_Comm_split_type(COMM_TYPE_SHARED): ranks that share
+        memory.  Process worlds this library launches are single-host
+        (the launcher forks locally), so here the shared-memory split is
+        the whole communicator reordered by key.  The multi-host SPMD
+        backend overrides this with a real by-host split (ADVICE r3 #4)."""
+        if split_type != "shared":
+            raise ValueError(f"unknown split_type {split_type!r}")
+        return self.split(0, key)
+
     def win_create(self, init: Any):
         """MPI_Win_create [S]: expose a local buffer for one-sided RMA
         (put/get/accumulate inside fence epochs — see mpi_tpu/window.py).
@@ -833,8 +852,7 @@ class P2PCommunicator(Communicator):
         src_world = ANY_SOURCE if source == ANY_SOURCE else self._world(source)
         s, t = self._t.peek(src_world, self._ctx, tag, timeout=self.recv_timeout)
         if status is not None:
-            status.source = self._from_world(s)
-            status.tag = t
+            status._fill_envelope(self._from_world(s), t)
 
     def mprobe(self, source: int = ANY_SOURCE, tag: int = ANY_TAG,
                status: Optional[Status] = None) -> "Message":
@@ -874,8 +892,7 @@ class P2PCommunicator(Communicator):
         if hit is None:
             return False
         if status is not None:
-            status.source = self._from_world(hit[0])
-            status.tag = hit[1]
+            status._fill_envelope(self._from_world(hit[0]), hit[1])
         return True
 
     def shift(self, obj: Any, offset: int = 1, wrap: bool = True, fill: Any = None) -> Any:

@@ -42,7 +42,7 @@ from .communicator import Communicator, P2PCommunicator
 from .datatypes import Datatype
 
 __all__ = [
-    "File", "file_open",
+    "File", "file_open", "file_delete", "register_datarep", "Datarep",
     "MODE_RDONLY", "MODE_WRONLY", "MODE_RDWR", "MODE_CREATE", "MODE_EXCL",
     "MODE_APPEND", "MODE_DELETE_ON_CLOSE",
     "SEEK_SET", "SEEK_CUR", "SEEK_END",
@@ -63,6 +63,96 @@ _TAG_TWOPHASE = -30  # internal tag (negative: invisible to user wildcards)
 # write_at_all ships runs to the aggregator only below this total;
 # above it, shipping costs more than it saves and ranks write directly.
 _COLLECTIVE_BUFFER_LIMIT = 8 << 20
+
+
+# -- data representations (MPI_Register_datarep, MPI-2 §9.5 [S]) ------------
+
+
+class Datarep:
+    """How etype elements are represented IN THE FILE.  The MPI callback
+    triple, pythonically collapsed (the buffer/position plumbing of the
+    C signatures is what numpy slicing already does):
+
+    * ``read_fn(raw: bytes, etype: np.dtype, count: int, extra) ->
+      np.ndarray`` — file representation → memory representation;
+    * ``write_fn(arr: np.ndarray, etype: np.dtype, extra) -> bytes`` —
+      memory → file representation;
+    * ``extent_fn(etype: np.dtype, extra) -> int`` — bytes ONE element
+      occupies in the file (MPI's dtype_file_extent_fn); defaults to
+      ``etype.itemsize`` (size-preserving representations).
+
+    Conversions are elementwise (element i of the memory array ↔ bytes
+    [i*extent, (i+1)*extent) of the file stream), which is what lets
+    file views, shared pointers, and collective buffering keep operating
+    in etype units with only the byte math rescaled."""
+
+    def __init__(self, name: str, read_fn, write_fn, extent_fn=None,
+                 extra_state=None):
+        self.name = name
+        self._read, self._write = read_fn, write_fn
+        self._extent, self._extra = extent_fn, extra_state
+
+    def file_extent(self, etype: np.dtype) -> int:
+        e = (int(self._extent(etype, self._extra)) if self._extent
+             else etype.itemsize)
+        if e <= 0:
+            raise ValueError(
+                f"datarep {self.name!r}: file extent must be positive, "
+                f"got {e} for etype {etype}")
+        return e
+
+    def read(self, raw: bytes, etype: np.dtype, count: int) -> np.ndarray:
+        out = np.asarray(self._read(raw, etype, count, self._extra),
+                         dtype=etype)
+        if out.size != count:
+            raise ValueError(
+                f"datarep {self.name!r} read conversion returned "
+                f"{out.size} elements for {count} requested")
+        return out
+
+    def write(self, arr: np.ndarray, etype: np.dtype):
+        """→ the file-representation bytes (``bytes`` or a zero-copy
+        ``memoryview`` for identity representations)."""
+        raw = self._write(arr, etype, self._extra)
+        want = arr.size * self.file_extent(etype)
+        if len(raw) != want:
+            raise ValueError(
+                f"datarep {self.name!r} write conversion emitted "
+                f"{len(raw)} bytes for {arr.size} elements "
+                f"(extent says {want})")
+        return raw
+
+
+_DATAREPS = {
+    # memory representation IS the file representation — the write side
+    # hands back a zero-copy view of the array's own buffer (the default
+    # path must not regress to a full-payload memcpy per write)
+    "native": Datarep(
+        "native",
+        lambda raw, et, n, _: np.frombuffer(raw, dtype=et, count=n).copy(),
+        lambda arr, et, _: memoryview(arr).cast("B")),
+    # the portable big-endian interchange format (matches
+    # datatypes.pack_external for simple etypes)
+    "external32": Datarep(
+        "external32",
+        lambda raw, et, n, _: np.frombuffer(
+            raw, dtype=et.newbyteorder(">"), count=n).astype(et),
+        lambda arr, et, _: np.ascontiguousarray(arr).astype(
+            arr.dtype.newbyteorder(">"), copy=False).tobytes()),
+}
+
+
+def register_datarep(name: str, read_fn, write_fn, extent_fn=None,
+                     extra_state=None) -> None:
+    """MPI_Register_datarep: make ``name`` usable as ``set_view``'s
+    ``datarep`` argument process-wide.  Callback shapes are documented on
+    :class:`Datarep`.  Redefining a predefined or already-registered
+    representation is erroneous (MPI_ERR_DUP_DATAREP)."""
+    if name in _DATAREPS:
+        raise ValueError(f"datarep {name!r} already registered "
+                         f"(MPI_ERR_DUP_DATAREP)")
+    _DATAREPS[name] = Datarep(name, read_fn, write_fn, extent_fn,
+                              extra_state)
 
 
 def _pwrite_full(fd: int, view, offset: int) -> None:
@@ -127,9 +217,12 @@ class File:
                  not (amode & (MODE_WRONLY | MODE_RDWR)) else os.O_RDWR)
         self._fd = os.open(path, oflag)
         # the view: displacement (bytes) + etype + optional filetype map
+        # + data representation (how etype elements look in the file)
         self._disp = 0
         self._etype = np.dtype(np.uint8)
         self._filetype: Optional[Datatype] = None
+        self._datarep = _DATAREPS["native"]
+        self._file_es = 1  # bytes per etype element IN THE FILE
         self._pos = 0            # individual pointer, etype units in view
         self._shared_win = None  # lazy: passive-target counter at rank 0
         self._open = True
@@ -139,12 +232,26 @@ class File:
     # -- views -------------------------------------------------------------
 
     def set_view(self, disp: int = 0, etype: Any = np.uint8,
-                 filetype: Optional[Datatype] = None) -> None:
+                 filetype: Optional[Datatype] = None,
+                 datarep: str = "native") -> None:
         """MPI_File_set_view: offsets become etype-relative, the filetype's
         index map selects which file elements this rank sees.  Collective
         (each rank passes its OWN view — that is the point: disjoint
-        filetypes partition the file)."""
+        filetypes partition the file).
+
+        ``datarep`` names the file data representation: "native",
+        "external32", or any name registered via
+        :func:`register_datarep` — every typed read/write through this
+        view then runs the representation's conversion callbacks, with
+        file offsets scaled by its per-element file extent."""
         et = np.dtype(etype)
+        try:
+            rep = _DATAREPS[datarep]
+        except KeyError:
+            raise ValueError(
+                f"unknown datarep {datarep!r}; have {sorted(_DATAREPS)} "
+                f"(register custom representations with "
+                f"register_datarep)") from None
         if filetype is not None:
             if filetype.base_dtype != et and filetype.base_dtype != np.uint8:
                 raise ValueError(
@@ -173,18 +280,23 @@ class File:
         self._disp = int(disp)
         self._etype = et
         self._filetype = filetype
+        self._datarep = rep
+        self._file_es = rep.file_extent(et)
         self._pos = 0
         self._comm.barrier()
 
     def get_view(self):
-        return (self._disp, self._etype, self._filetype)
+        return (self._disp, self._etype, self._filetype,
+                self._datarep.name)
 
     # -- offset translation ------------------------------------------------
 
     def _byte_runs(self, offset: int, nelems: int) -> List[Tuple[int, int]]:
         """Visible [offset, offset+nelems) etype elements → coalesced
-        (file_byte_offset, nbytes) runs."""
-        es = self._etype.itemsize
+        (file_byte_offset, nbytes) runs.  All byte math is in FILE-side
+        element sizes (the datarep's extent; == etype.itemsize for
+        size-preserving representations like native/external32)."""
+        es = self._file_es
         if nelems <= 0:
             return []
         if self._filetype is None:
@@ -195,7 +307,7 @@ class File:
             raise ValueError("filetype selects zero elements")
         i = np.arange(offset, offset + nelems, dtype=np.int64)
         file_elems = ft.indices[i % k] + (i // k) * ft.extent
-        if ft.base_dtype == np.uint8 and es != 1:
+        if ft.base_dtype == np.uint8 and self._etype.itemsize != 1:
             raise ValueError("byte-based filetype with non-byte etype is "
                              "ambiguous; build the filetype over the etype")
         starts = self._disp + file_elems * es
@@ -208,22 +320,34 @@ class File:
 
     # -- explicit offsets (independent) ------------------------------------
 
-    def write_at(self, offset: int, data: Any) -> int:
-        """pwrite ``data`` (coerced to etype) at view-relative ``offset``
-        (etype units); returns elements written."""
-        self._check_open()
+    def _to_file_rep(self, data: Any) -> Tuple[np.ndarray, memoryview]:
+        """Coerce to etype and run the view's datarep write conversion;
+        returns (memory array, file-representation bytes)."""
         arr = np.ascontiguousarray(np.asarray(data, dtype=self._etype))
-        view = memoryview(arr).cast("B")
+        return arr, memoryview(self._datarep.write(arr, self._etype))
+
+    def _write_runs(self, offset: int, nelems: int, view) -> None:
+        """pwrite already-converted file-representation bytes across the
+        view's byte runs (shared by write_at and write_at_all's
+        independent branch, which must not convert twice)."""
         pos = 0
-        for start, nbytes in self._byte_runs(int(offset), arr.size):
+        for start, nbytes in self._byte_runs(int(offset), nelems):
             _pwrite_full(self._fd, view[pos:pos + nbytes], start)
             pos += nbytes
+
+    def write_at(self, offset: int, data: Any) -> int:
+        """pwrite ``data`` (coerced to etype, converted to the view's
+        datarep) at view-relative ``offset`` (etype units); returns
+        elements written."""
+        self._check_open()
+        arr, view = self._to_file_rep(data)
+        self._write_runs(offset, arr.size, view)
         return arr.size
 
     def read_at(self, offset: int, count: int) -> np.ndarray:
-        """pread ``count`` etype elements at view-relative ``offset``;
-        short reads at EOF return a shorter array (MPI: count via
-        Get_count)."""
+        """pread ``count`` etype elements at view-relative ``offset``,
+        converted from the view's datarep; short reads at EOF return a
+        shorter array (MPI: count via Get_count)."""
         self._check_open()
         chunks = []
         for start, nbytes in self._byte_runs(int(offset), int(count)):
@@ -232,9 +356,9 @@ class File:
             if len(b) < nbytes:  # true EOF inside a run
                 break
         raw = b"".join(chunks)
-        es = self._etype.itemsize
-        return np.frombuffer(raw[: len(raw) - len(raw) % es],
-                             dtype=self._etype).copy()
+        nel = len(raw) // self._file_es
+        return self._datarep.read(raw[: nel * self._file_es],
+                                  self._etype, nel)
 
     # -- individual file pointer -------------------------------------------
 
@@ -242,7 +366,7 @@ class File:
         """Number of VISIBLE etype elements the file currently holds under
         this view (SEEK_END must count through the filetype, not raw
         bytes — other ranks' elements are not ours)."""
-        es = self._etype.itemsize
+        es = self._file_es
         nbytes = self.get_size() - self._disp
         if nbytes <= 0:
             return 0
@@ -375,15 +499,23 @@ class File:
         offset-sorted sweep; large payloads write independently inside
         the same barrier bracket."""
         self._check_open()
-        arr = np.ascontiguousarray(np.asarray(data, dtype=self._etype))
-        total = self._comm.allreduce(arr.nbytes)
-        if total > _COLLECTIVE_BUFFER_LIMIT:
-            n = self.write_at(offset, arr)
+        arr, view = self._to_file_rep(data)
+        total = self._comm.allreduce(len(view))
+        # the aggregate-vs-independent branch must be COLLECTIVE: ranks
+        # compare the (already-allreduced) total against RANK 0's limit,
+        # so an MPI_T cvar_write on a subset of ranks cannot diverge the
+        # control flow (ADVICE r3 #2 — divergence surfaced as rank 0
+        # blocking in _recv_internal for payloads that never come)
+        limit = self._comm.bcast(_COLLECTIVE_BUFFER_LIMIT, 0)
+        if total > limit:
+            # reuse the bytes already converted above — a second
+            # write_at would run the datarep conversion (and hold a
+            # second full copy) exactly on the large-payload branch
+            self._write_runs(int(offset), arr.size, view)
             self._comm.barrier()
-            return n
+            return arr.size
         # phase 1: ship (run, bytes) lists to the aggregator
         runs = self._byte_runs(int(offset), arr.size)
-        view = memoryview(arr).cast("B")
         payload, pos = [], 0
         for start, nbytes in runs:
             payload.append((start, bytes(view[pos:pos + nbytes])))

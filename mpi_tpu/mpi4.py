@@ -21,6 +21,19 @@ features whose shapes fit this framework naturally:
   interleave.  Partitions travel as individual internal messages
   ``(index, payload)``; ``pready(i)`` reads partition ``i`` at call
   time and ships it; ``parrived(i)`` polls without blocking.
+
+* **Sessions** (MPI_Session_init / pset discovery /
+  MPI_Group_from_session_pset / MPI_Comm_create_from_group [S: MPI-4
+  ch.11]): the modern init story — a library acquires its OWN runtime
+  handle, discovers process sets by name, builds a group from a pset,
+  and derives a communicator from the group without ever touching
+  MPI_Init or MPI_COMM_WORLD.  Here the runtime instance is the
+  launcher-provided transport (the same discovery MPI_Init uses);
+  sessions share it but derive every communicator on a
+  session-namespaced context keyed by the MPI-mandated
+  ``(group members, stringtag)`` pair, so session traffic can never
+  cross-match world traffic — context isolation IS the session
+  boundary, the same scheme nonblocking/persistent collectives use.
 """
 
 from __future__ import annotations
@@ -36,6 +49,7 @@ from .communicator import (Communicator, P2PCommunicator, Request,
 __all__ = [
     "PersistentCollective", "persistent_collective",
     "PsendRequest", "PrecvRequest", "psend_init", "precv_init",
+    "Session", "session_init",
 ]
 
 _TAG_PART = -41  # partitioned traffic (negative: invisible to wildcards)
@@ -294,6 +308,134 @@ class PrecvRequest:
             if len(self._got) == self._n:
                 return True, self._finish_locked()
             return False, None
+
+
+# -- sessions (MPI-4 ch.11) ---------------------------------------------------
+
+
+class Session:
+    """An MPI-4 session: a private handle to the runtime.
+
+    Construct via :func:`session_init`.  The world process model and the
+    sessions model coexist (MPI-4 §11.4): both tap the same underlying
+    transport, but a session never touches the COMM_WORLD singleton and
+    all communicators it derives live on session-namespaced contexts.
+
+    ``base_comm`` injects the runtime access explicitly (how the local
+    thread backend's per-rank sessions are built — and how tests drive
+    multi-rank sessions); by default the launcher-provided environment
+    is discovered exactly as ``MPI_Init`` would.
+    """
+
+    #: the two predefined process sets every session exposes (MPI-4
+    #: §11.9.2; additional runtime-defined psets would list after these)
+    _PSETS = ("mpi://WORLD", "mpi://SELF")
+
+    def __init__(self, info: Optional[dict] = None, errhandler=None,
+                 base_comm: Optional[P2PCommunicator] = None):
+        if base_comm is None:
+            import mpi_tpu as _m
+
+            base_comm = _m.init()
+        self._base = _require_p2p(base_comm, "sessions")
+        self._info = dict(info or {})
+        self._errhandler = errhandler
+        self._finalized = False
+
+    # -- pset discovery ----------------------------------------------------
+
+    def get_num_psets(self, info: Optional[dict] = None) -> int:
+        """MPI_Session_get_num_psets."""
+        self._check_live()
+        return len(self._PSETS)
+
+    def get_nth_pset(self, n: int, info: Optional[dict] = None) -> str:
+        """MPI_Session_get_nth_pset."""
+        self._check_live()
+        if not (0 <= n < len(self._PSETS)):
+            raise ValueError(
+                f"pset index {n} out of range (0..{len(self._PSETS) - 1})")
+        return self._PSETS[n]
+
+    def get_info(self) -> dict:
+        """MPI_Session_get_info (hints echoed back; advisory)."""
+        self._check_live()
+        return dict(self._info)
+
+    # -- group / communicator derivation -----------------------------------
+
+    def group_from_pset(self, pset_name: str):
+        """MPI_Group_from_session_pset: the ordered member set of the
+        named pset, as a Group of runtime (world) ranks."""
+        self._check_live()
+        from .group import Group
+
+        if pset_name == "mpi://WORLD":
+            return Group(range(self._base.size))
+        if pset_name == "mpi://SELF":
+            return Group([self._base.rank])
+        raise ValueError(
+            f"unknown process set {pset_name!r}; this session has "
+            f"{list(self._PSETS)}")
+
+    def comm_create_from_group(self, group, stringtag: str = "",
+                               info: Optional[dict] = None,
+                               errhandler=None) -> P2PCommunicator:
+        """MPI_Comm_create_from_group: a communicator over ``group``
+        (runtime ranks, in group order) — collective over the GROUP
+        MEMBERS only, no parent communicator involved.  Matching follows
+        MPI-4: concurrent calls are disambiguated by the
+        ``(group members, stringtag)`` pair, which becomes the new
+        context — every member must pass the same group and stringtag,
+        and concurrent calls with an identical pair are erroneous."""
+        self._check_live()
+        ranks = tuple(int(r) for r in group.ranks)
+        if self._base.rank not in ranks:
+            raise ValueError(
+                f"calling rank {self._base.rank} is not in the group "
+                f"{list(ranks)} (comm_create_from_group is collective "
+                f"over the group members themselves)")
+        # group ranks are BASE-comm-local (what group_from_pset hands
+        # out); the transport speaks world ranks — translate, so a base
+        # comm that is itself a split/reordered view of the world still
+        # derives a correctly-wired communicator (review round 4).  The
+        # context must also be spelled in world ranks: it has to be
+        # byte-identical across member processes whose local numbering
+        # may differ.
+        world_ranks = tuple(self._base._world(r) for r in ranks)
+        return P2PCommunicator(self._base._t, world_ranks,
+                               context=("sess", world_ranks, str(stringtag)),
+                               recv_timeout=self._base.recv_timeout)
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def finalize(self) -> None:
+        """MPI_Session_finalize: the session handle becomes unusable.
+        Communicators derived from it must already be out of use (MPI
+        erroneous otherwise); the shared runtime transport is NOT closed
+        — it belongs to the process (world model finalize / launcher
+        teardown owns it)."""
+        self._finalized = True
+
+    @property
+    def finalized(self) -> bool:
+        return self._finalized
+
+    def _check_live(self) -> None:
+        if self._finalized:
+            raise RuntimeError("operation on a finalized MPI session")
+
+    def __enter__(self) -> "Session":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.finalize()
+
+
+def session_init(info: Optional[dict] = None, errhandler=None,
+                 base_comm: Optional[P2PCommunicator] = None) -> Session:
+    """MPI_Session_init (see :class:`Session`)."""
+    return Session(info, errhandler, base_comm)
 
 
 def psend_init(comm: Communicator, buf: Any, partitions: int, dest: int,

@@ -135,6 +135,12 @@ def cvar_write(name: str, value: Any) -> None:
 
 _builtin_done = False
 
+# replicated-gather footprint warning threshold (bytes of the full
+# [size, ...] stack PER DEVICE).  Held here (not in tpu/communicator)
+# so reading/writing the cvar never needs a jax import; list-wrapped so
+# the closures below share one mutable cell.
+_GATHER_WARN_BYTES = [64 * 2 ** 20]
+
 
 def _ensure_builtin_cvars() -> None:
     """The knobs that actually steer this library — registered LAZILY so
@@ -174,6 +180,13 @@ def _ensure_builtin_cvars() -> None:
             "CPU-backend allreduce auto algorithm picks latency-optimal "
             "recursive halving below this payload size (pow2 groups), "
             "bandwidth-optimal ring at or above it")
+        _CVARS["gather_replicated_warn_bytes"] = (
+            lambda: _GATHER_WARN_BYTES[0],
+            lambda v: _GATHER_WARN_BYTES.__setitem__(0, int(v)),
+            "SPMD gather/gatherv warn when the replicated [size, ...] "
+            "stack exceeds this many bytes PER DEVICE (O(size x payload) "
+            "HBM); use gather(..., sharded=True) to keep per-device HBM "
+            "O(payload)")
         _builtin_done = True
 
 

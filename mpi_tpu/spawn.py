@@ -42,15 +42,28 @@ ENV_PARENT_TOTAL = "MPI_TPU_PARENT_TOTAL"
 # independent jobs (MPI semantics: spawn does not wait), but keeping the
 # handles lets atexit reap finished ones instead of leaving zombies.
 _spawned: List[subprocess.Popen] = []
-_tmpdirs: List[str] = []
+_bridge_dirs: List[str] = []
+_child_dirs: List[str] = []
 _parent_intercomm: Optional[InterComm] = None
 
 
 def _cleanup() -> None:  # pragma: no cover - exit path
+    alive = False
     for p in _spawned:
-        p.poll()
-    for d in _tmpdirs:
+        if p.poll() is None:
+            alive = True
+    # the bridge dies with this (parent) process either way — its rdv
+    # dir is safe to remove.  The CHILD WORLD's rdv dir is NOT ours to
+    # delete while children still run: spawn does not wait, and late
+    # child ranks discover each other lazily through those port files
+    # (ADVICE r3 #3 — a prompt parent exit would break their wiring).
+    # Reap it only once every spawned child has exited; otherwise leave
+    # it to the OS tempdir lifecycle.
+    for d in _bridge_dirs:
         shutil.rmtree(d, ignore_errors=True)
+    if not alive:
+        for d in _child_dirs:
+            shutil.rmtree(d, ignore_errors=True)
 
 
 atexit.register(_cleanup)
@@ -109,7 +122,8 @@ def _spawn_segments(segments: List[Tuple[List[str], int]],
     if comm.rank == root:
         bridge_rdv = tempfile.mkdtemp(prefix="mpi_tpu_spawn_bridge_")
         child_rdv = tempfile.mkdtemp(prefix="mpi_tpu_spawn_world_")
-        _tmpdirs.extend([bridge_rdv, child_rdv])
+        _bridge_dirs.append(bridge_rdv)
+        _child_dirs.append(child_rdv)
         dirs = (bridge_rdv, child_rdv)
     else:
         dirs = None
@@ -278,7 +292,10 @@ def comm_accept(port_name: str, comm: Optional[Communicator] = None,
                     except OSError:
                         shutil.rmtree(rdv, ignore_errors=True)
                         continue  # dead requester; keep scanning
-                    _tmpdirs.append(rdv)  # dies with the server process
+                    # an accept/connect bridge: both sides are live jobs,
+                    # and this (server) process exiting kills the bridge
+                    # anyway — safe to reap unconditionally at exit
+                    _bridge_dirs.append(rdv)
                     return int(meta["size"]), rdv
             return None
 

@@ -251,3 +251,88 @@ def test_comm_split_type_shared():
         return True
 
     assert all(run_local(prog, 3))
+
+
+def test_comm_split_type_shared_spmd_by_host(monkeypatch):
+    """On the SPMD backend COMM_TYPE_SHARED splits by jax process
+    (ADVICE r3 #4): a mesh whose axis spans two hosts yields per-host
+    sub-communicators, not the whole comm."""
+    from types import SimpleNamespace
+
+    import numpy as np_
+
+    from mpi_tpu.tpu import TpuCommunicator, default_mesh
+
+    mesh = default_mesh(8)
+    comm = TpuCommunicator("world", mesh)
+    # all-CPU devices are one process: degenerates to the whole comm
+    assert comm.split_type().size == 8
+    # simulate a 2-host mesh (4 devices per process)
+    fake = np_.array([SimpleNamespace(process_index=i // 4, id=i)
+                      for i in range(8)])
+    monkeypatch.setattr(comm, "mesh",
+                        SimpleNamespace(axis_names=("world",), devices=fake,
+                                        shape={"world": 8}))
+    node = comm.split_type()
+    assert node.size == 4
+    assert node.axis_index_groups == [[0, 1, 2, 3], [4, 5, 6, 7]]
+    with pytest.raises(ValueError, match="split_type"):
+        comm.split_type("numa")
+
+
+def test_probe_resets_stale_count(tmp_path):
+    """A Status reused after a recv must not leak that recv's
+    count_bytes through a probe (ADVICE r3 #1): probe sees only the
+    envelope — MPI_Get_count after it is MPI_UNDEFINED (None)."""
+    import numpy as np_
+
+    import mpi_tpu
+
+    def prog(comm):
+        if comm.rank == 0:
+            comm.send(np_.zeros(16, np_.float64), 1, tag=5)
+            comm.send(np_.zeros(4, np_.float64), 1, tag=6)
+            return True
+        st = mpi_tpu.Status()
+        comm.recv(0, tag=5, status=st)
+        assert st.count_bytes == 128
+        comm.probe(0, tag=6, status=st)
+        assert st.count_bytes is None  # envelope only, stale count cleared
+        assert st.tag == 6
+        # iprobe path too
+        st2 = mpi_tpu.Status()
+        st2.count_bytes = 999
+        assert comm.iprobe(0, tag=6, status=st2)
+        assert st2.count_bytes is None
+        comm.recv(0, tag=6, status=st)
+        assert st.count_bytes == 32
+        return True
+
+    assert all(run_local(prog, 2))
+
+
+def test_spawn_cleanup_preserves_live_child_world_dirs(tmp_path):
+    """The parent's atexit cleanup must not delete a child WORLD's
+    rendezvous dir while children still run (ADVICE r3 #3); the bridge
+    dir (dead with the parent) always goes."""
+    from types import SimpleNamespace
+
+    from mpi_tpu import spawn as sp
+
+    bridge = tmp_path / "bridge"; bridge.mkdir()
+    child = tmp_path / "child"; child.mkdir()
+    monkeypatch_state = (list(sp._spawned), list(sp._bridge_dirs),
+                         list(sp._child_dirs))
+    try:
+        sp._spawned[:] = [SimpleNamespace(poll=lambda: None)]  # alive
+        sp._bridge_dirs[:] = [str(bridge)]
+        sp._child_dirs[:] = [str(child)]
+        sp._cleanup()
+        assert not bridge.exists()   # bridge reaped
+        assert child.exists()        # child world preserved
+        sp._spawned[:] = [SimpleNamespace(poll=lambda: 0)]  # all exited
+        sp._cleanup()
+        assert not child.exists()    # now safe to reap
+    finally:
+        sp._spawned[:], sp._bridge_dirs[:], sp._child_dirs[:] = \
+            monkeypatch_state

@@ -71,8 +71,8 @@ def test_many_random_seeds_largest_config():
 
 
 @pytest.mark.parametrize("P,coll", [
-    (2, ALLREDUCE), (2, REDUCE_SCATTER),
-], ids=["ar2", "rs2"])
+    (2, ALLREDUCE), (2, REDUCE_SCATTER), (2, ALLGATHER),
+], ids=["ar2", "rs2", "ag2"])
 def test_exhaustive_bidirectional(P, coll):
     """Full interleaving space with one flow per direction.  (P=3
     exhaustive takes minutes — the adversarial sweeps below cover it.)"""

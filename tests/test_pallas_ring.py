@@ -321,6 +321,37 @@ def test_pallas_ring_multiaxis_export_tpu(ring_axis):
     assert "tpu_custom_call" in exp.mlir_module()
 
 
+def test_pallas_ring_multiaxis_export_tpu_rs_and_ag():
+    """reduce_scatter and allgather kernels also lower for TPU on the
+    2-D mesh (same dict-MESH addressing, different kernel modes: rot=-1
+    half-ring and the land-direct ag-only mode)."""
+    from jax.sharding import AbstractMesh
+
+    from mpi_tpu.tpu.pallas_ring import (pallas_ring_allgather,
+                                         pallas_ring_reduce_scatter)
+
+    mesh = AbstractMesh((2, 4), ("dp", "mp"))
+
+    def rs(x):
+        # x: [1(dp shard), 4 blocks, 256] — drop the dp dim, ring over mp
+        return pallas_ring_reduce_scatter(x[0], "mp", 4, tile_rows=8,
+                                          interpret=False)[None]
+
+    def ag(x):
+        return pallas_ring_allgather(x[0], "mp", 4, tile_rows=8,
+                                     interpret=False)[0][None]
+
+    for f, shape, ispec in (
+            (rs, (2, 4, 256), P("dp", None, None)),
+            (ag, (2, 256), P("dp", None))):
+        jf = jax.jit(jax.shard_map(
+            f, mesh=mesh, in_specs=ispec, out_specs=P("dp", None),
+            check_vma=False))
+        exp = jax.export.export(jf, platforms=["tpu"])(
+            jax.ShapeDtypeStruct(shape, jnp.float32))
+        assert "tpu_custom_call" in exp.mlir_module()
+
+
 def test_pallas_ring_1d_export_tpu():
     """The validated 1-D (LOGICAL device id) path also lowers for TPU
     from this CPU host — the same Mosaic pipeline the real-TPU tier
