@@ -57,13 +57,30 @@ def ring_attention(comm, q, k, v):
     return acc / l[:, None]
 
 
-def ring_attention_program(comm, seq_per_rank: int = 64, d: int = 32):
+def ring_attention_program(comm, seq_per_rank: int = 64, d: int = 32,
+                           kernel: bool = False):
+    """``kernel=True`` (TPU backend, d a multiple of 128, block rows a
+    multiple of 8) swaps the shift-based loop for the fused Pallas
+    kernel ``mpi_tpu.tpu.pallas_ring_attention`` — K/V circulate as
+    in-kernel RDMAs behind the online-softmax compute (the hot path;
+    same algebra, protocol model-checked in ring_model.AttentionSim)."""
     key = jax.random.fold_in(jax.random.PRNGKey(7), comm.rank)
     kq, kk, kv = jax.random.split(key, 3)
     q = jax.random.normal(kq, (seq_per_rank, d), jnp.float32)
     k = jax.random.normal(kk, (seq_per_rank, d), jnp.float32)
     v = jax.random.normal(kv, (seq_per_rank, d), jnp.float32)
-    out = ring_attention(comm, q, k, v)
+    if kernel:
+        if not hasattr(comm, "axis_name"):
+            raise NotImplementedError(
+                "--kernel is the fused Pallas TPU path: it needs the SPMD "
+                "backend (run with --backend tpu); the process backends "
+                "use the portable shift-based loop (drop --kernel)")
+        from mpi_tpu.tpu import pallas_ring_attention
+
+        out = pallas_ring_attention(q, k, v, comm.axis_name, comm.size,
+                                    interpret=comm._pallas_interp)
+    else:
+        out = ring_attention(comm, q, k, v)
     return out, q, k, v
 
 
@@ -73,11 +90,14 @@ def main():
     ap.add_argument("-n", "--nranks", type=int, default=None)
     ap.add_argument("--seq-per-rank", type=int, default=64)
     ap.add_argument("--dim", type=int, default=32)
+    ap.add_argument("--kernel", action="store_true",
+                    help="use the fused Pallas RDMA kernel "
+                         "(TPU backend; --dim multiple of 128)")
     args = ap.parse_args()
 
     out = mpi_tpu.run(ring_attention_program, backend=args.backend,
                       nranks=args.nranks, seq_per_rank=args.seq_per_rank,
-                      d=args.dim)
+                      d=args.dim, kernel=args.kernel)
     first = out[0] if isinstance(out, list) else out
     o = np.asarray(jax.device_get(first[0] if isinstance(first, tuple) else first))
     print(f"ring attention OK: local block {o.shape[-2:]}, |out| = {np.abs(o).mean():.4f}")

@@ -25,6 +25,7 @@ from .transport.base import ANY_SOURCE, ANY_TAG
 __all__ = [
     "MPI_Init", "MPI_Finalize", "MPI_Initialized", "MPI_COMM_WORLD",
     "MPI_Comm_rank", "MPI_Comm_size", "MPI_Send", "MPI_Recv", "MPI_Sendrecv",
+    "MPI_Isendrecv", "MPI_Isendrecv_replace",
     "MPI_Bcast", "MPI_Reduce", "MPI_Allreduce", "MPI_Allgather", "MPI_Alltoall",
     "MPI_Barrier", "MPI_Comm_split", "MPI_Comm_dup", "MPI_Scatter", "MPI_Gather",
     "MPI_Scan", "MPI_Reduce_scatter", "MPI_Isend", "MPI_Irecv", "MPI_Wait",
@@ -202,6 +203,23 @@ def MPI_Sendrecv(sendobj: Any, dest: int, source: int = ANY_SOURCE,
                  sendtag: int = 0, recvtag: int = ANY_TAG,
                  comm: Optional[Communicator] = None) -> Any:
     return _call(comm, "sendrecv", sendobj, dest, source, sendtag, recvtag)
+
+
+def MPI_Isendrecv(sendobj: Any, dest: int, source: int = ANY_SOURCE,
+                  sendtag: int = 0, recvtag: int = ANY_TAG,
+                  comm: Optional[Communicator] = None):
+    """MPI-4 nonblocking combined send+receive; the request completes
+    with the received payload."""
+    return _call(comm, "isendrecv", sendobj, dest, source, sendtag, recvtag)
+
+
+def MPI_Isendrecv_replace(buf: Any, dest: int, source: int = ANY_SOURCE,
+                          sendtag: int = 0, recvtag: int = ANY_TAG,
+                          comm: Optional[Communicator] = None):
+    """MPI-4 nonblocking sendrecv_replace: ndarray ``buf`` is refilled
+    in place when the request completes."""
+    return _call(comm, "isendrecv_replace", buf, dest, source, sendtag,
+                 recvtag)
 
 
 def MPI_Bcast(obj: Any, root: int = 0, comm: Optional[Communicator] = None) -> Any:

@@ -819,6 +819,39 @@ class P2PCommunicator(Communicator):
         self.send(obj, dest, tag)
         return _CompletedRequest()
 
+    def isendrecv(self, sendobj: Any, dest: int, source: int = ANY_SOURCE,
+                  sendtag: int = 0, recvtag: int = ANY_TAG) -> Request:
+        """MPI_Isendrecv [S: an MPI-4 addition]: nonblocking combined
+        send+receive.  The send completes on enqueue (buffered, as
+        isend); the returned request completes with the received
+        payload — it IS an irecv posted after the send, which preserves
+        sendrecv's deadlock-freedom without blocking the caller."""
+        self.send(sendobj, dest, sendtag)
+        return self.irecv(source, recvtag)
+
+    def isendrecv_replace(self, buf, dest: int, source: int = ANY_SOURCE,
+                          sendtag: int = 0, recvtag: int = ANY_TAG) -> Request:
+        """MPI_Isendrecv_replace [S: MPI-4]: like isendrecv but the
+        received payload overwrites ``buf`` in place at completion
+        (ndarray buffers; the payload is also returned for non-buffer
+        use).  The outgoing content is snapshotted NOW, so the in-place
+        replace can never corrupt the send."""
+        self.send(snapshot_payload(self._t, buf), dest, sendtag)
+        inner = self.irecv(source, recvtag)
+
+        def _finish():
+            got = inner.wait()
+            import numpy as _np
+
+            if isinstance(buf, _np.ndarray):
+                # genuine refill failures (shape mismatch, read-only
+                # buffer) must RAISE — a swallowed error would leave buf
+                # silently stale despite the replace contract
+                buf[...] = got
+            return got  # non-buffer payloads: return-value semantics
+
+        return _ThreadRequest(_finish)
+
     def irecv(self, source: int = ANY_SOURCE, tag: int = ANY_TAG) -> Request:
         """Nonblocking receive (MPI_Irecv): returns a Request; ``test()``
         polls without blocking, ``wait()`` blocks.  Requests on the same

@@ -6,11 +6,22 @@ Public surface:
 * :class:`TpuCommunicator` — the Communicator bound to a mesh axis; fused XLA
   collectives plus hand-scheduled ppermute algorithms (ring /
   recursive-halving / tree / doubling / pairwise).
+* :func:`pallas_ring_attention` — fused long-context ring attention (K/V
+  circulate as in-kernel RDMAs; pallas_attention.py).
 """
 
 from .communicator import SpmdSemanticsError, TpuCommunicator
 from .runner import default_mesh, run_spmd
 from . import collectives
+
+
+def pallas_ring_attention(*args, **kwargs):
+    """Lazy re-export of pallas_attention.pallas_ring_attention (keeps
+    ``import mpi_tpu.tpu`` light — pallas only loads when used)."""
+    from .pallas_attention import pallas_ring_attention as f
+
+    return f(*args, **kwargs)
+
 
 __all__ = [
     "TpuCommunicator",
@@ -18,4 +29,5 @@ __all__ = [
     "run_spmd",
     "default_mesh",
     "collectives",
+    "pallas_ring_attention",
 ]

@@ -211,6 +211,21 @@ class TpuCommunicator(Communicator):
             "use comm.shift / comm.exchange / collectives (XLA already "
             "overlaps the DMAs).")
 
+    def isendrecv(self, sendobj: Any, dest: int, source: int = -1,
+                  sendtag: int = 0, recvtag: int = -1):
+        raise _unsupported(
+            "MPI_Isendrecv with per-rank dest/source",
+            "If the pattern is a uniform ring offset use comm.shift(x, "
+            "offset); if it is a fixed pattern use comm.exchange(x, pairs) "
+            "(XLA already overlaps the DMAs).")
+
+    def isendrecv_replace(self, buf, dest: int, source: int = -1,
+                          sendtag: int = 0, recvtag: int = -1):
+        raise _unsupported(
+            "MPI_Isendrecv_replace with per-rank dest/source",
+            "Use comm.shift(x, offset) / comm.exchange(x, pairs) and "
+            "rebind the result (SPMD arrays are immutable).")
+
     def send_init(self, buf: Any, dest: int, tag: int = 0):
         raise _unsupported(
             "MPI_Send_init", "the persistent-request idiom IS the compiled "

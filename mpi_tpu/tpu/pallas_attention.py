@@ -1,0 +1,293 @@
+"""Fused ring attention as a Pallas TPU kernel (RDMA over ICI).
+
+Long-context exact attention over a sequence-sharded axis (SURVEY.md §2
+strategy table — the long-context strategy is first-class).  The
+ppermute spelling lives in ``examples/ring_attention.py``; this module
+is its TPU-first hot path: ONE kernel in which the K/V blocks circulate
+the ring as RDMAs while the MXU computes attention against the block
+that just landed — transfer hidden behind compute, the same
+communication/compute overlap argument as ``pallas_ring``.
+
+Protocol (a sibling of pallas_ring's — verified by the discrete-event
+model ``ring_model.AttentionSim``, tests/test_pallas_protocol.py):
+
+* Each device holds Q, K, V blocks of the sequence ([Sb, d] each).  At
+  step 0 it computes attention of its Q against its OWN K/V and starts
+  forwarding that K/V (one stacked [2*Sb, d] RDMA) to its right
+  neighbor's landing slot.
+* Arrival ``a`` (1..P-1) lands K/V block ``(rank - a) mod P`` in the
+  double-buffered comm slot ``a % 2``; the device copies it to VMEM,
+  folds it into the online-softmax state (running rowmax ``m``,денom
+  ``l``, weighted accumulator ``o`` — all f32), and, while the fold
+  runs, forwards the same block from the slot to the next neighbor.
+* **Credit flow control** recycles the slots: arrival ``a+2`` re-uses
+  slot ``a % 2``, so after consuming arrival ``a`` (VMEM copy done AND
+  the forwarding RDMA has left the slot — ``wait_send`` precedes the
+  credit) the device signals one credit to its LEFT neighbor, which
+  gates that neighbor's send ``a+1``.  Sends 0 and 1 are credit-free
+  (their target slots are virgin).
+* Entry/exit neighbor barriers bracket the kernel, as in pallas_ring.
+
+Numerics: the online-softmax recurrence
+``m' = max(m, rowmax(S)); l' = l·e^{m-m'} + rowsum(e^{S-m'});
+o' = o·e^{m-m'} + e^{S-m'}·V`` is an exact (not approximate) attention
+— the standard flash/ring-attention algebra.  Accumulation is float32
+for bf16 inputs.  Non-causal (full) attention; scale = 1/sqrt(d) by
+default.
+
+Under the interpreter (CPU tier) RDMAs run serially (start+wait, no
+credits/barriers) — same data path, no overlap; under vma typing or a
+multi-axis mesh the interpreter executes a ppermute ring fallback
+(same online-softmax algebra as jax ops) with the shared loud-fallback
+warning.  The compiled multi-axis path addresses neighbors by mesh
+coordinate exactly like pallas_ring.
+
+Restrictions (diagnosed): f32/bf16; head dim ``d`` a multiple of 128
+(lane width); block rows ``Sb`` a multiple of 8; the per-device K/V
+block must fit VMEM twice over (double buffer) — tens of thousands of
+rows at d=128.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from .pallas_ring import _check_args, _fallback, _world_pairs_of
+
+_LANES = 128
+
+
+def _online_fold(q, k, v, m, l, o, scale):
+    """One block's online-softmax fold (shared by kernel and fallback).
+    q:[Sq,d] k,v:[Sb,d] m,l:[Sq,1] o:[Sq,d] (f32 state) → new (m,l,o)."""
+    s = jnp.dot(q.astype(jnp.float32), k.astype(jnp.float32).T,
+                preferred_element_type=jnp.float32) * scale
+    m_new = jnp.maximum(m, jnp.max(s, axis=1, keepdims=True))
+    alpha = jnp.exp(m - m_new)
+    p = jnp.exp(s - m_new)
+    l_new = l * alpha + jnp.sum(p, axis=1, keepdims=True)
+    o_new = o * alpha + jnp.dot(p, v.astype(jnp.float32),
+                                preferred_element_type=jnp.float32)
+    return m_new, l_new, o_new
+
+
+def _kernel(params_smem, q_hbm, kv_hbm, out_hbm, comm_hbm, q_vmem, kv_vmem,
+            m_vmem, l_vmem, o_vmem, copy_sem, send_sem, recv_sem,
+            credit_sem, *, axis_name: str, size: int, sb: int, d: int,
+            scale: float, pipelined: bool, mesh_ids: bool):
+    """See module docstring for the step/slot/credit schedule."""
+    left = params_smem[0]
+    right = params_smem[1]
+    P = size
+
+    def dev_kw(target):
+        if mesh_ids:
+            return dict(device_id={axis_name: target},
+                        device_id_type=pltpu.DeviceIdType.MESH)
+        return dict(device_id=target,
+                    device_id_type=pltpu.DeviceIdType.LOGICAL)
+
+    def fwd_rdma(u):
+        """Send ``u`` (0..P-2): the block computed at step ``u`` moves
+        to the right neighbor's slot ``(u+1) % 2``."""
+        dst_slot = (u + 1) % 2
+        src = kv_hbm if u == 0 else comm_hbm.at[u % 2]
+        return pltpu.make_async_remote_copy(
+            src_ref=src, dst_ref=comm_hbm.at[dst_slot],
+            send_sem=send_sem.at[dst_slot], recv_sem=recv_sem.at[dst_slot],
+            **dev_kw(right))
+
+    def neighbor_barrier():
+        if not pipelined:
+            return
+        bar = pltpu.get_barrier_semaphore()
+        pltpu.semaphore_signal(bar, inc=1, **dev_kw(left))
+        pltpu.semaphore_signal(bar, inc=1, **dev_kw(right))
+        pltpu.semaphore_wait(bar, 2)
+
+    def load_kv(src_ref):
+        cp = pltpu.make_async_copy(src_ref, kv_vmem, copy_sem)
+        cp.start()
+        cp.wait()
+
+    def fold():
+        k = kv_vmem[pl.ds(0, sb), :]
+        v = kv_vmem[pl.ds(sb, sb), :]
+        m, l, o = _online_fold(q_vmem[:], k, v, m_vmem[:], l_vmem[:],
+                               o_vmem[:], scale)
+        m_vmem[:] = m
+        l_vmem[:] = l
+        o_vmem[:] = o
+
+    # init: Q to VMEM; online-softmax state
+    cp_q = pltpu.make_async_copy(q_hbm, q_vmem, copy_sem)
+    cp_q.start()
+    cp_q.wait()
+    m_vmem[:] = jnp.full((sb, 1), -jnp.inf, jnp.float32)
+    l_vmem[:] = jnp.zeros((sb, 1), jnp.float32)
+    o_vmem[:] = jnp.zeros((sb, d), jnp.float32)
+
+    neighbor_barrier()
+
+    # step 0: my own block computes and starts circulating
+    load_kv(kv_hbm)
+    fold()
+    if P >= 2:
+        fwd_rdma(0).start()
+        if pipelined:
+            fwd_rdma(0).wait_send()  # sem hygiene, as in attention_program
+        else:
+            fwd_rdma(0).wait()
+
+    for a in range(1, P):
+        slot = a % 2
+        if pipelined:
+            fwd_rdma(a - 1).wait_recv()  # arrival a lands in comm[slot]
+        load_kv(comm_hbm.at[slot])
+        if a <= P - 2:
+            # forward while the fold below runs; send a >= 2 first
+            # waits for the credit arming its destination slot
+            if pipelined:
+                if a >= 2:
+                    pltpu.semaphore_wait(credit_sem.at[(a + 1) % 2], 1)
+                fwd_rdma(a).start()
+            else:
+                fwd_rdma(a).start()
+                fwd_rdma(a).wait()
+        fold()
+        if pipelined and a <= P - 2:
+            # slot free only after the forward READ it out (wait_send),
+            # then credit the writer for arrival a+2's reuse
+            fwd_rdma(a).wait_send()
+        if pipelined and a + 2 <= P - 1:
+            pltpu.semaphore_signal(credit_sem.at[slot], inc=1,
+                                   **dev_kw(left))
+
+    out = o_vmem[:] / l_vmem[:]
+    out_vmem_cp = pltpu.make_async_copy(o_vmem, out_hbm, copy_sem)
+    o_vmem[:] = out.astype(jnp.float32)
+    out_vmem_cp.start()
+    out_vmem_cp.wait()
+
+    neighbor_barrier()
+
+
+def _ring_neighbors(axis_name: str, size: int) -> jnp.ndarray:
+    idx = lax.axis_index(axis_name)
+    return jnp.stack([lax.rem(idx - 1 + size, size),
+                      lax.rem(idx + 1, size)]).astype(jnp.int32)
+
+
+def _fallback_attention(q, k, v, axis_name: str, size: int, scale: float):
+    """The same online-softmax ring as jax ops over ppermute — the
+    vma/multi-axis interpreter path (and a reference implementation)."""
+    world_pairs = _world_pairs_of(size, None)
+    perm = world_pairs([(r, (r + 1) % size) for r in range(size)])
+    m = jnp.full(q.shape[:1] + (1,), -jnp.inf, jnp.float32)
+    l = jnp.zeros(q.shape[:1] + (1,), jnp.float32)
+    o = jnp.zeros((q.shape[0], v.shape[1]), jnp.float32)
+    kb, vb = k, v
+    for step in range(size):
+        m, l, o = _online_fold(q, kb, vb, m, l, o, scale)
+        if step < size - 1:  # the last fold's blocks need no rotation
+            kb = lax.ppermute(kb, axis_name, perm)
+            vb = lax.ppermute(vb, axis_name, perm)
+    return (o / l).astype(q.dtype)
+
+
+def pallas_ring_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
+                          axis_name: str, size: int, *,
+                          scale: float = None,
+                          interpret: bool = False) -> jnp.ndarray:
+    """Exact full (non-causal) attention over a sequence-sharded axis:
+    ``q``/``k``/``v`` are this device's [Sb, d] blocks; returns this
+    device's [Sb, d] output block.  Call inside shard_map over a mesh
+    with ``axis_name``; the global sequence is the concatenation of the
+    blocks in rank order.
+
+    The compiled path is the in-kernel RDMA circulation described in
+    the module docstring; ``interpret=True`` (the CPU tier) runs the
+    serial same-kernel path, or — under vma typing / a multi-axis mesh
+    — the ppermute fallback with the shared loud warning."""
+    if q.ndim != 2 or k.shape != q.shape or v.shape != q.shape:
+        raise ValueError(
+            f"ring attention wants equal [rows, d] blocks for q/k/v, got "
+            f"{q.shape}/{k.shape}/{v.shape}")
+    if k.dtype != q.dtype or v.dtype != q.dtype:
+        raise ValueError(
+            f"ring attention wants one dtype for q/k/v (the circulating "
+            f"K/V buffer is allocated as q's), got "
+            f"{q.dtype}/{k.dtype}/{v.dtype}")
+    sb, d = q.shape
+    if d % _LANES:
+        raise NotImplementedError(
+            f"head dim must be a multiple of {_LANES} (lane width), got {d}")
+    from .pallas_ring import _SUBLANES
+
+    sub = _SUBLANES.get(jnp.dtype(q.dtype), 8)
+    if sb % sub:
+        raise NotImplementedError(
+            f"block rows must be a multiple of {sub} ({jnp.dtype(q.dtype)} "
+            f"sublane tile), got {sb}")
+    if scale is None:
+        scale = 1.0 / float(np.sqrt(d))
+    # shared dtype/vma/mesh probing with the ring collectives (f32/bf16)
+    vma_on, multi_axis = _check_args(q, axis_name, size, sub, "sum")
+    if size == 1:
+        m0 = jnp.full((sb, 1), -jnp.inf, jnp.float32)
+        l0 = jnp.zeros((sb, 1), jnp.float32)
+        o0 = jnp.zeros((sb, d), jnp.float32)
+        _, l1, o1 = _online_fold(q, k, v, m0, l0, o0, scale)
+        return (o1 / l1).astype(q.dtype)
+    if (vma_on or multi_axis) and interpret:
+        _fallback("ring_attention", axis_name, vma_on, multi_axis)
+        return _fallback_attention(q, k, v, axis_name, size, scale)
+
+    kv = jnp.concatenate([k, v], axis=0)  # one [2*Sb, d] circulating block
+    params = _ring_neighbors(axis_name, size)
+    kern = functools.partial(
+        _kernel, axis_name=axis_name, size=size, sb=sb, d=d, scale=scale,
+        pipelined=not interpret, mesh_ids=multi_axis)
+    compiler_params = None if interpret else pltpu.CompilerParams(
+        collective_id=16, has_side_effects=True)
+    if vma_on:
+        try:
+            in_vma = frozenset(jax.typeof(q).vma)
+        except (AttributeError, NameError):
+            in_vma = frozenset()
+        out_shape = jax.ShapeDtypeStruct((sb, d), jnp.float32,
+                                         vma=in_vma | {axis_name})
+    else:
+        out_shape = jax.ShapeDtypeStruct((sb, d), jnp.float32)
+    out = pl.pallas_call(
+        kern,
+        out_shape=out_shape,
+        in_specs=[pl.BlockSpec(memory_space=pltpu.SMEM),
+                  pl.BlockSpec(memory_space=pl.ANY),
+                  pl.BlockSpec(memory_space=pl.ANY)],
+        out_specs=pl.BlockSpec(memory_space=pl.ANY),
+        scratch_shapes=[
+            pl.ANY((2, 2 * sb, d), q.dtype),            # landing slots
+            pltpu.VMEM((sb, d), q.dtype),               # Q
+            pltpu.VMEM((2 * sb, d), q.dtype),           # K/V staging
+            pltpu.VMEM((sb, 1), jnp.float32),           # m
+            pltpu.VMEM((sb, 1), jnp.float32),           # l
+            pltpu.VMEM((sb, d), jnp.float32),           # o
+            pltpu.SemaphoreType.DMA(()),
+            pltpu.SemaphoreType.DMA((2,)),              # send (slot parity)
+            pltpu.SemaphoreType.DMA((2,)),              # recv (slot parity)
+            pltpu.SemaphoreType.REGULAR((2,)),          # slot credits
+        ],
+        compiler_params=compiler_params,
+        interpret=interpret,
+    )(params, q, kv)
+    return out.astype(q.dtype)

@@ -499,18 +499,169 @@ class RingSim:
         return (tuple(self.pc), sems, dmas, slots)
 
 
-def explore_all(P: int, K: int, *, rot: int, allgather: bool,
-                rs: bool = True,
-                dirs: Optional[Tuple[int, ...]] = None,
-                max_states: int = 2_000_000) -> int:
-    """Exhaustive DFS over every interleaving (protocol state, no payload
-    tracking): every reachable state must have an enabled event unless the
-    run is complete, and every terminal state must have drained semaphores.
-    Returns the number of distinct states visited."""
-    def fresh():
-        return RingSim(P, K, rot=rot, allgather=allgather, rs=rs,
-                       track_data=False, dirs=dirs)
+# ---------------------------------------------------------------------------
+# Ring-attention circulation protocol (pallas_attention._kernel)
+# ---------------------------------------------------------------------------
 
+
+def attention_program(my: int, P: int) -> List[object]:
+    """The pipelined ``pallas_attention._kernel`` body for device ``my``
+    as a static op list (same one-to-one construction discipline as
+    ``device_program``).  Single flow; ``Accum(a, 0)`` models the
+    VMEM-copy+online-softmax fold of arrival ``a`` (a=0 → the device's
+    own block, no slot involved).  Send ``u`` targets slot (u+1)%2;
+    sends 0/1 are credit-free (virgin slots); the credit for slot a%2
+    is signalled only after wait_send(a) — the forward must have READ
+    the slot out before the writer may land arrival a+2 in it."""
+    left, right = (my - 1) % P, (my + 1) % P
+    ops: List[object] = [Signal(left, ("bar",)), Signal(right, ("bar",)),
+                         Wait(("bar",), 2)]
+    ops.append(Accum(0, 0))                       # fold own block
+    if P >= 2:
+        ops.append(DmaStart(0, 0))                # circulate own block
+        ops.append(Wait(("send", 1, 0), 1))       # sem hygiene for send 0
+    for a in range(1, P):
+        slot = a % 2
+        ops.append(Wait(("recv", slot, 0), 1))    # arrival a landed
+        if a <= P - 2:
+            if a >= 2:                            # dst slot needs a credit
+                ops.append(Wait(("credit", (a + 1) % 2, 0), 1))
+            ops.append(DmaStart(a, 0))            # forward the block
+        ops.append(Accum(a, 0))                   # fold it
+        if a <= P - 2:
+            ops.append(Wait(("send", (a + 1) % 2, 0), 1))  # forward left
+        if a + 2 <= P - 1:                        # slot reused at a+2
+            ops.append(Signal(left, ("credit", slot, 0)))
+    ops += [Signal(left, ("bar",)), Signal(right, ("bar",)),
+            Wait(("bar",), 2)]
+    return ops
+
+
+class AttentionSim(RingSim):
+    """RingSim specialization for the K/V circulation protocol: payloads
+    are block ids moving through the 2-slot landing buffer; ``out`` is
+    reused as the per-device fold log (which blocks were folded, in what
+    order).  Invariants: the shared 1-4 (no deadlock, no slot overwrite,
+    no read-while-landing, sems drain) plus (5') every device folds
+    every block EXACTLY once, in ring order my, my-1, ..., my-P+1."""
+
+    def __init__(self, P: int):
+        # reuse RingSim's machinery with a 1-flow ALLGATHER-ish config;
+        # programs/payloads are overridden below
+        super().__init__(P, 1, rot=0, allgather=True, rs=False,
+                         track_data=True,
+                         program_override=lambda d, p, k, **kw:
+                         attention_program(d, p))
+        # fold log replaces the out grid; comm keeps (state, payload)
+        self.folded: List[List[int]] = [[] for _ in range(P)]
+        # what each device's NEXT send actually carries is read from the
+        # slot at DmaStart time (catching schedule bugs for real)
+        self.own_block = list(range(P))
+
+    def _mk_dma(self, d: int, u: int, fi: int) -> Dma:
+        P = self.P
+        if u == 0:
+            payload = frozenset([(d, d, 0)])      # my own block id d
+        else:
+            state, payload = self.comm[d][(u % 2, 0)]
+            if state != "full":
+                raise ProtocolViolation(
+                    f"dev{d} forwarded from EMPTY slot {(u % 2, 0)} at "
+                    f"send {u} (forward started before arrival consumed)")
+        return Dma(d, u, fi, "started", payload, (u % 2, fi), (d + 1) % P,
+                   dst_slot=((u + 1) % 2, fi), dst_region=None)
+
+    def step(self, event: Tuple) -> None:
+        kind = event[0]
+        if kind == "dev":
+            d = event[1]
+            op = self.progs[d][self.pc[d]]
+            if isinstance(op, Signal) and op.sem[0] == "credit":
+                # crediting left = promising MY slot is reusable; free it
+                # (its content was folded AND forwarded out — the program
+                # places the signal after Accum and wait_send)
+                self.comm[d][(op.sem[1], op.sem[2])] = ("empty", frozenset())
+            super().step(event)
+            return
+        if kind == "leave":
+            # a forward (u>0) reads a comm SLOT; verify it never changed
+            # under the in-flight read (RingSim's leave checks the out
+            # grid instead, which attention does not use)
+            dma = self.dmas[event[1]]
+            if dma.u > 0:
+                state, cur = self.comm[dma.src][dma.src_region]
+                if state != "full" or cur != dma.payload:
+                    raise ProtocolViolation(
+                        f"slot {dma.src_region} of dev{dma.src} changed "
+                        f"while forward u={dma.u} was reading it "
+                        f"(invariant 3)")
+            dma.phase = "left"
+            sk = ("send", (dma.u + 1) % 2, dma.seg)
+            self.sems[dma.src][sk] = self.sems[dma.src].get(sk, 0) + 1
+            self._record_occupancy()
+            return
+        # arrive: attention's recv semaphores are indexed by the LANDING
+        # slot parity (u+1)%2, not RingSim's u%2 — handle fully here
+        i = event[1]
+        dma = self.dmas[i]
+        dst = dma.dst
+        state, _ = self.comm[dst][dma.dst_slot]
+        if state == "full":
+            raise ProtocolViolation(
+                f"arrival u={dma.u} from dev{dma.src} overwrote unconsumed "
+                f"slot {dma.dst_slot} on dev{dst} (invariant 2)")
+        for other in self.dmas:
+            if (other is not dma and other.phase == "started"
+                    and other.src == dst and other.u > 0
+                    and other.src_region == dma.dst_slot):
+                raise ProtocolViolation(
+                    f"arrival u={dma.u} landed in slot {dma.dst_slot} of "
+                    f"dev{dst} while dev{dst}'s forward u={other.u} was "
+                    f"reading it (invariant 3)")
+        self.comm[dst][dma.dst_slot] = ("full", dma.payload)
+        rk = ("recv", dma.dst_slot[0], dma.seg)
+        self.sems[dst][rk] = self.sems[dst].get(rk, 0) + 1
+        del self.dmas[i]
+        self._record_occupancy()
+
+    def _accum(self, d: int, u: int, seg: int) -> None:
+        if u == 0:
+            self.folded[d].append(d)              # own block, no slot
+            return
+        slot = (u % 2, seg)
+        state, payload = self.comm[d][slot]
+        if state != "full":
+            raise ProtocolViolation(
+                f"dev{d} folded EMPTY slot {slot} at arrival {u}")
+        ids = [b for (_, b, _) in payload]
+        if len(ids) != 1:
+            raise ProtocolViolation(
+                f"dev{d} arrival {u}: slot holds {sorted(payload)}, not "
+                f"one block")
+        self.folded[d].append(ids[0])
+        # the slot stays FULL until the credit signal frees it (it is
+        # still the forward's RDMA source); never-credited tail slots
+        # simply stay full to exit — no invariant needs them empty
+
+    def check_final(self) -> None:
+        for d in range(self.P):
+            for k, vv in self.sems[d].items():
+                if vv != 0:
+                    raise ProtocolViolation(
+                        f"semaphore {k} on dev{d} = {vv} at exit "
+                        f"(invariant 4)")
+            want = [(d - a) % self.P for a in range(self.P)]
+            if self.folded[d] != want:
+                raise ProtocolViolation(
+                    f"dev{d} folded {self.folded[d]}, want ring order "
+                    f"{want} (invariant 5')")
+
+
+def _explore(fresh, max_states: int) -> int:
+    """Shared exhaustive DFS over every interleaving (protocol state, no
+    payload tracking): every reachable state must have an enabled event
+    unless the run is complete, and every terminal state must pass
+    ``check_final``.  Returns the number of distinct states visited."""
     seen = set()
     root = fresh()
     stack = [[]]  # paths (event lists); replay is cheap at these sizes
@@ -543,3 +694,20 @@ def explore_all(P: int, K: int, *, rot: int, allgather: bool,
                 raise ProtocolViolation("state space larger than budget")
             stack.append(path + [e])
     return visited
+
+
+def explore_attention(P: int, max_states: int = 2_000_000) -> int:
+    """Exhaustive DFS over the attention circulation protocol (the
+    ``explore_all`` twin for AttentionSim)."""
+    return _explore(lambda: AttentionSim(P), max_states)
+
+
+def explore_all(P: int, K: int, *, rot: int, allgather: bool,
+                rs: bool = True,
+                dirs: Optional[Tuple[int, ...]] = None,
+                max_states: int = 2_000_000) -> int:
+    """Exhaustive DFS over the collective-ring protocol (see
+    ``_explore`` for the search contract)."""
+    return _explore(
+        lambda: RingSim(P, K, rot=rot, allgather=allgather, rs=rs,
+                        track_data=False, dirs=dirs), max_states)

@@ -438,3 +438,76 @@ def test_session_on_reordered_base_comm():
         assert total == 3          # full world reduced: 0+1+2
         assert ssz == 1            # SELF pset is really just me
         assert crank == 2 - r      # comm ordered by the reversed base
+
+
+# -- MPI-4 nonblocking sendrecv ----------------------------------------------
+
+
+def test_isendrecv_ring():
+    """MPI_Isendrecv (MPI-4): nonblocking ring halo exchange — post,
+    overlap 'compute', then wait for the neighbor's payload."""
+    def prog(comm):
+        right = (comm.rank + 1) % comm.size
+        left = (comm.rank - 1) % comm.size
+        req = comm.isendrecv(np.full(3, comm.rank), right, left)
+        local = float(comm.rank) ** 2  # overlapped work
+        got = req.wait()
+        return float(got[0]), local
+
+    res = run_local(prog, 4)
+    for r, (got, _) in enumerate(res):
+        assert got == (r - 1) % 4
+
+
+def test_isendrecv_replace_in_place():
+    def prog(comm):
+        right = (comm.rank + 1) % comm.size
+        left = (comm.rank - 1) % comm.size
+        buf = np.full(2, comm.rank, np.float64)
+        req = comm.isendrecv_replace(buf, right, left)
+        got = req.wait()
+        # buf now holds the neighbor's (pre-snapshot) payload
+        assert np.array_equal(buf, got)
+        return float(buf[0])
+
+    res = run_local(prog, 3)
+    assert res == [2.0, 0.0, 1.0]
+
+
+def test_isendrecv_flat_api_and_spmd_diagnostic():
+    from mpi_tpu.tpu import SpmdSemanticsError, run_spmd
+
+    def prog(comm):
+        req = api.MPI_Isendrecv(comm.rank, (comm.rank + 1) % comm.size,
+                                (comm.rank - 1) % comm.size, comm=comm)
+        return req.wait()
+
+    assert run_local(prog, 3) == [2, 0, 1]
+
+    def sprog(comm):
+        with pytest.raises(SpmdSemanticsError, match="Isendrecv"):
+            comm.isendrecv(1.0, 0)
+        with pytest.raises(SpmdSemanticsError, match="Isendrecv_replace"):
+            comm.isendrecv_replace(np.zeros(2), 0)
+        return comm.allreduce(1.0)
+
+    run_spmd(sprog, nranks=8)
+
+
+def test_isendrecv_replace_shape_mismatch_raises():
+    """A refill that cannot be applied must RAISE (review round 4), not
+    leave the buffer silently stale."""
+    def prog(comm):
+        right = (comm.rank + 1) % comm.size
+        left = (comm.rank - 1) % comm.size
+        comm.send(np.zeros(3), right, tag=9)  # wrong-shaped payload
+        buf = np.zeros(2)
+        req = comm.isendrecv_replace(buf, right, left, sendtag=8,
+                                     recvtag=9)
+        with pytest.raises(ValueError):
+            req.wait()
+        # drain the sendtag-8 message so finalize stays clean
+        comm.recv(left, tag=8)
+        return True
+
+    assert all(run_local(prog, 2))

@@ -144,6 +144,15 @@ def run_cross_platform_export() -> dict:
         "jax.ShapeDtypeStruct((1024,), jnp.float32))\n"
         "res['pallas_ring_1d'] = {'platforms': list(exp.platforms),"
         " 'mosaic_kernel': 'tpu_custom_call' in exp.mlir_module()}\n"
+        "from mpi_tpu.tpu.pallas_attention import pallas_ring_attention\n"
+        "fa = jax.jit(jax.shard_map(lambda q, k, v: pallas_ring_attention("
+        "q, k, v, 'world', 8, interpret=False), mesh=mesh,"
+        " in_specs=(P('world'),) * 3, out_specs=P('world'),"
+        " check_vma=False))\n"
+        "aa = jax.ShapeDtypeStruct((8 * 64, 128), jnp.float32)\n"
+        "expa = jax.export.export(fa, platforms=['tpu'])(aa, aa, aa)\n"
+        "res['pallas_ring_attention'] = {'platforms': list(expa.platforms),"
+        " 'mosaic_kernel': 'tpu_custom_call' in expa.mlir_module()}\n"
         "with warnings.catch_warnings():\n"
         "    warnings.simplefilter('ignore')\n"
         "    exp2 = ge.export_multichip_tpu(8)\n"
