@@ -31,19 +31,29 @@ import jax.numpy as jnp
 import numpy as np
 
 
-def ring_attention(comm, q, k, v):
-    """Exact (non-causal) attention over the sequence sharded on the ring.
+def ring_attention(comm, q, k, v, causal: bool = False):
+    """Exact attention (full, or causal) over the sequence sharded on
+    the ring.
 
     q, k, v: [block, d] local blocks.  Returns the local [block, d] output.
     2(P-1) ppermutes total (K and V), overlapping compute with the rotation.
+    ``causal`` masks by GLOBAL position (rank r's block covers rows
+    [r*block, (r+1)*block)); the step-0 block is the diagonal one, so
+    every query row is unmasked at least once from the start.
     """
     scale = 1.0 / math.sqrt(q.shape[-1])
     m = jnp.full(q.shape[:1], -jnp.inf, q.dtype)       # running row max
     l = jnp.zeros(q.shape[:1], q.dtype)                # running denominator
     acc = jnp.zeros_like(q)                            # running numerator
     k_cur, v_cur = k, v
+    b = q.shape[0]
     for step in range(comm.size):
         scores = (q @ k_cur.T) * scale                 # [b, b] one block pair
+        if causal:
+            kv_idx = (comm.rank - step) % comm.size    # block now held
+            qi = comm.rank * b + jnp.arange(b)[:, None]
+            kj = kv_idx * b + jnp.arange(b)[None, :]
+            scores = jnp.where(kj <= qi, scores, -1e30)
         blk_max = scores.max(axis=-1)
         new_m = jnp.maximum(m, blk_max)
         corr = jnp.exp(m - new_m)
@@ -58,7 +68,7 @@ def ring_attention(comm, q, k, v):
 
 
 def ring_attention_program(comm, seq_per_rank: int = 64, d: int = 32,
-                           kernel: bool = False):
+                           kernel: bool = False, causal: bool = False):
     """``kernel=True`` (TPU backend, d a multiple of 128, block rows a
     multiple of 8) swaps the shift-based loop for the fused Pallas
     kernel ``mpi_tpu.tpu.pallas_ring_attention`` — K/V circulate as
@@ -78,9 +88,10 @@ def ring_attention_program(comm, seq_per_rank: int = 64, d: int = 32,
         from mpi_tpu.tpu import pallas_ring_attention
 
         out = pallas_ring_attention(q, k, v, comm.axis_name, comm.size,
+                                    causal=causal,
                                     interpret=comm._pallas_interp)
     else:
-        out = ring_attention(comm, q, k, v)
+        out = ring_attention(comm, q, k, v, causal=causal)
     return out, q, k, v
 
 
@@ -93,11 +104,13 @@ def main():
     ap.add_argument("--kernel", action="store_true",
                     help="use the fused Pallas RDMA kernel "
                          "(TPU backend; --dim multiple of 128)")
+    ap.add_argument("--causal", action="store_true",
+                    help="causal (autoregressive) masking by global position")
     args = ap.parse_args()
 
     out = mpi_tpu.run(ring_attention_program, backend=args.backend,
                       nranks=args.nranks, seq_per_rank=args.seq_per_rank,
-                      d=args.dim, kernel=args.kernel)
+                      d=args.dim, kernel=args.kernel, causal=args.causal)
     first = out[0] if isinstance(out, list) else out
     o = np.asarray(jax.device_get(first[0] if isinstance(first, tuple) else first))
     print(f"ring attention OK: local block {o.shape[-2:]}, |out| = {np.abs(o).mean():.4f}")

@@ -205,6 +205,40 @@ class _CompletedRequest(Request):
         return True, self._value
 
 
+class _ReplaceRequest(Request):
+    """isendrecv_replace's handle: delegates to the inner irecv on the
+    caller's thread and applies the in-place refill exactly once at
+    completion.  Refill failures (shape mismatch, read-only buffer)
+    RAISE — a swallowed error would leave ``buf`` silently stale."""
+
+    def __init__(self, inner: Request, buf: Any):
+        self._inner = inner
+        self._buf = buf
+        self._done = False
+        self._value: Any = None
+
+    def _finish(self, got: Any) -> Any:
+        import numpy as _np
+
+        if isinstance(self._buf, _np.ndarray):
+            self._buf[...] = got
+        self._done, self._value = True, got
+        return got
+
+    def wait(self) -> Any:
+        if self._done:
+            return self._value
+        return self._finish(self._inner.wait())
+
+    def test(self) -> Tuple[bool, Any]:
+        if self._done:
+            return True, self._value
+        done, got = self._inner.test()
+        if not done:
+            return False, None
+        return True, self._finish(got)
+
+
 class _RecvRequest(Request):
     """Outstanding receive.  Requests posted on the same (source, tag) key
     complete in POSTED order regardless of wait()/test() call order (MPI
@@ -835,22 +869,12 @@ class P2PCommunicator(Communicator):
         received payload overwrites ``buf`` in place at completion
         (ndarray buffers; the payload is also returned for non-buffer
         use).  The outgoing content is snapshotted NOW, so the in-place
-        replace can never corrupt the send."""
+        replace can never corrupt the send.  Completion runs on the
+        CALLER's wait()/test() — no background thread may touch the
+        shared posted-receive queue (it would race concurrent receives
+        on the same (source, tag); review round 4)."""
         self.send(snapshot_payload(self._t, buf), dest, sendtag)
-        inner = self.irecv(source, recvtag)
-
-        def _finish():
-            got = inner.wait()
-            import numpy as _np
-
-            if isinstance(buf, _np.ndarray):
-                # genuine refill failures (shape mismatch, read-only
-                # buffer) must RAISE — a swallowed error would leave buf
-                # silently stale despite the replace contract
-                buf[...] = got
-            return got  # non-buffer payloads: return-value semantics
-
-        return _ThreadRequest(_finish)
+        return _ReplaceRequest(self.irecv(source, recvtag), buf)
 
     def irecv(self, source: int = ANY_SOURCE, tag: int = ANY_TAG) -> Request:
         """Nonblocking receive (MPI_Irecv): returns a Request; ``test()``

@@ -15,12 +15,15 @@ from .runner import default_mesh, run_spmd
 from . import collectives
 
 
-def pallas_ring_attention(*args, **kwargs):
-    """Lazy re-export of pallas_attention.pallas_ring_attention (keeps
-    ``import mpi_tpu.tpu`` light — pallas only loads when used)."""
-    from .pallas_attention import pallas_ring_attention as f
+def __getattr__(name: str):
+    # PEP 562 lazy re-export: ``import mpi_tpu.tpu`` stays light (pallas
+    # loads only when used) and callers get the GENUINE function object
+    # — real signature, real docstring (review round 4)
+    if name == "pallas_ring_attention":
+        from .pallas_attention import pallas_ring_attention
 
-    return f(*args, **kwargs)
+        return pallas_ring_attention
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
 
 
 __all__ = [

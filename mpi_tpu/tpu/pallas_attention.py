@@ -32,8 +32,9 @@ Numerics: the online-softmax recurrence
 ``m' = max(m, rowmax(S)); l' = l·e^{m-m'} + rowsum(e^{S-m'});
 o' = o·e^{m-m'} + e^{S-m'}·V`` is an exact (not approximate) attention
 — the standard flash/ring-attention algebra.  Accumulation is float32
-for bf16 inputs.  Non-causal (full) attention; scale = 1/sqrt(d) by
-default.
+for bf16 inputs.  Full OR causal attention (``causal=True`` masks by
+global position — block indices come from the SMEM params, so the same
+compiled kernel serves every rank); scale = 1/sqrt(d) by default.
 
 Under the interpreter (CPU tier) RDMAs run serially (start+wait, no
 credits/barriers) — same data path, no overlap; under vma typing or a
@@ -66,11 +67,18 @@ from .pallas_ring import _check_args, _fallback, _world_pairs_of
 _LANES = 128
 
 
-def _online_fold(q, k, v, m, l, o, scale):
+_MASKED = -1e30  # large-negative finite (an -inf mask would NaN through exp)
+
+
+def _online_fold(q, k, v, m, l, o, scale, mask=None):
     """One block's online-softmax fold (shared by kernel and fallback).
-    q:[Sq,d] k,v:[Sb,d] m,l:[Sq,1] o:[Sq,d] (f32 state) → new (m,l,o)."""
+    q:[Sq,d] k,v:[Sb,d] m,l:[Sq,1] o:[Sq,d] (f32 state) → new (m,l,o).
+    ``mask``: optional [Sq,Sb] bool, True = attend (False → _MASKED;
+    a fully-masked block folds as exactly zero contribution)."""
     s = jnp.dot(q.astype(jnp.float32), k.astype(jnp.float32).T,
                 preferred_element_type=jnp.float32) * scale
+    if mask is not None:
+        s = jnp.where(mask, s, _MASKED)
     m_new = jnp.maximum(m, jnp.max(s, axis=1, keepdims=True))
     alpha = jnp.exp(m - m_new)
     p = jnp.exp(s - m_new)
@@ -80,13 +88,24 @@ def _online_fold(q, k, v, m, l, o, scale):
     return m_new, l_new, o_new
 
 
+def _causal_mask(my, kv_idx, sb: int):
+    """[Sb,Sb] causal mask for query block ``my`` vs key block
+    ``kv_idx`` (both traced block indices): global key position must
+    not exceed global query position."""
+    qi = my * sb + lax.broadcasted_iota(jnp.int32, (sb, sb), 0)
+    kj = kv_idx * sb + lax.broadcasted_iota(jnp.int32, (sb, sb), 1)
+    return kj <= qi
+
+
 def _kernel(params_smem, q_hbm, kv_hbm, out_hbm, comm_hbm, q_vmem, kv_vmem,
             m_vmem, l_vmem, o_vmem, copy_sem, send_sem, recv_sem,
             credit_sem, *, axis_name: str, size: int, sb: int, d: int,
-            scale: float, pipelined: bool, mesh_ids: bool):
+            scale: float, pipelined: bool, mesh_ids: bool,
+            causal: bool = False):
     """See module docstring for the step/slot/credit schedule."""
     left = params_smem[0]
     right = params_smem[1]
+    my = params_smem[2]
     P = size
 
     def dev_kw(target):
@@ -119,14 +138,30 @@ def _kernel(params_smem, q_hbm, kv_hbm, out_hbm, comm_hbm, q_vmem, kv_vmem,
         cp.start()
         cp.wait()
 
-    def fold():
-        k = kv_vmem[pl.ds(0, sb), :]
-        v = kv_vmem[pl.ds(sb, sb), :]
-        m, l, o = _online_fold(q_vmem[:], k, v, m_vmem[:], l_vmem[:],
-                               o_vmem[:], scale)
-        m_vmem[:] = m
-        l_vmem[:] = l
-        o_vmem[:] = o
+    def fold(a):
+        def body(mask):
+            k = kv_vmem[pl.ds(0, sb), :]
+            v = kv_vmem[pl.ds(sb, sb), :]
+            m, l, o = _online_fold(q_vmem[:], k, v, m_vmem[:], l_vmem[:],
+                                   o_vmem[:], scale, mask)
+            m_vmem[:] = m
+            l_vmem[:] = l
+            o_vmem[:] = o
+
+        if not causal:
+            body(None)
+            return
+        # arrival a carries K/V block (my - a) mod P; the first fold
+        # (a=0, own block) always has its diagonal unmasked, so the
+        # running max is finite from step 0 on.  Blocks entirely in the
+        # future (kv_idx > my) contribute exactly zero — skip their MXU
+        # passes outright (the circulation/credit schedule above is
+        # untouched, so the model-checked protocol is unchanged).
+        kv_idx = lax.rem(my - a + P, P)
+
+        @pl.when(kv_idx <= my)
+        def _():
+            body(_causal_mask(my, kv_idx, sb))
 
     # init: Q to VMEM; online-softmax state
     cp_q = pltpu.make_async_copy(q_hbm, q_vmem, copy_sem)
@@ -140,7 +175,7 @@ def _kernel(params_smem, q_hbm, kv_hbm, out_hbm, comm_hbm, q_vmem, kv_vmem,
 
     # step 0: my own block computes and starts circulating
     load_kv(kv_hbm)
-    fold()
+    fold(0)
     if P >= 2:
         fwd_rdma(0).start()
         if pipelined:
@@ -163,7 +198,7 @@ def _kernel(params_smem, q_hbm, kv_hbm, out_hbm, comm_hbm, q_vmem, kv_vmem,
             else:
                 fwd_rdma(a).start()
                 fwd_rdma(a).wait()
-        fold()
+        fold(a)
         if pipelined and a <= P - 2:
             # slot free only after the forward READ it out (wait_send),
             # then credit the writer for arrival a+2's reuse
@@ -182,22 +217,30 @@ def _kernel(params_smem, q_hbm, kv_hbm, out_hbm, comm_hbm, q_vmem, kv_vmem,
 
 
 def _ring_neighbors(axis_name: str, size: int) -> jnp.ndarray:
+    """[left, right, my] int32 SMEM params (my = causal block index)."""
     idx = lax.axis_index(axis_name)
     return jnp.stack([lax.rem(idx - 1 + size, size),
-                      lax.rem(idx + 1, size)]).astype(jnp.int32)
+                      lax.rem(idx + 1, size), idx]).astype(jnp.int32)
 
 
-def _fallback_attention(q, k, v, axis_name: str, size: int, scale: float):
+def _fallback_attention(q, k, v, axis_name: str, size: int, scale: float,
+                        causal: bool = False):
     """The same online-softmax ring as jax ops over ppermute — the
     vma/multi-axis interpreter path (and a reference implementation)."""
     world_pairs = _world_pairs_of(size, None)
     perm = world_pairs([(r, (r + 1) % size) for r in range(size)])
+    my = lax.axis_index(axis_name)
+    sb = q.shape[0]
     m = jnp.full(q.shape[:1] + (1,), -jnp.inf, jnp.float32)
     l = jnp.zeros(q.shape[:1] + (1,), jnp.float32)
     o = jnp.zeros((q.shape[0], v.shape[1]), jnp.float32)
     kb, vb = k, v
     for step in range(size):
-        m, l, o = _online_fold(q, kb, vb, m, l, o, scale)
+        mask = None
+        if causal:
+            kv_idx = lax.rem(my - step + size, size)
+            mask = _causal_mask(my, kv_idx, sb)
+        m, l, o = _online_fold(q, kb, vb, m, l, o, scale, mask)
         if step < size - 1:  # the last fold's blocks need no rotation
             kb = lax.ppermute(kb, axis_name, perm)
             vb = lax.ppermute(vb, axis_name, perm)
@@ -206,13 +249,13 @@ def _fallback_attention(q, k, v, axis_name: str, size: int, scale: float):
 
 def pallas_ring_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
                           axis_name: str, size: int, *,
-                          scale: float = None,
+                          scale: float = None, causal: bool = False,
                           interpret: bool = False) -> jnp.ndarray:
-    """Exact full (non-causal) attention over a sequence-sharded axis:
-    ``q``/``k``/``v`` are this device's [Sb, d] blocks; returns this
-    device's [Sb, d] output block.  Call inside shard_map over a mesh
-    with ``axis_name``; the global sequence is the concatenation of the
-    blocks in rank order.
+    """Exact attention (full, or causal with ``causal=True``) over a
+    sequence-sharded axis: ``q``/``k``/``v`` are this device's [Sb, d]
+    blocks; returns this device's [Sb, d] output block.  Call inside
+    shard_map over a mesh with ``axis_name``; the global sequence is
+    the concatenation of the blocks in rank order.
 
     The compiled path is the in-kernel RDMA circulation described in
     the module docstring; ``interpret=True`` (the CPU tier) runs the
@@ -246,17 +289,18 @@ def pallas_ring_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
         m0 = jnp.full((sb, 1), -jnp.inf, jnp.float32)
         l0 = jnp.zeros((sb, 1), jnp.float32)
         o0 = jnp.zeros((sb, d), jnp.float32)
-        _, l1, o1 = _online_fold(q, k, v, m0, l0, o0, scale)
+        mask = _causal_mask(jnp.int32(0), jnp.int32(0), sb) if causal else None
+        _, l1, o1 = _online_fold(q, k, v, m0, l0, o0, scale, mask)
         return (o1 / l1).astype(q.dtype)
     if (vma_on or multi_axis) and interpret:
         _fallback("ring_attention", axis_name, vma_on, multi_axis)
-        return _fallback_attention(q, k, v, axis_name, size, scale)
+        return _fallback_attention(q, k, v, axis_name, size, scale, causal)
 
     kv = jnp.concatenate([k, v], axis=0)  # one [2*Sb, d] circulating block
     params = _ring_neighbors(axis_name, size)
     kern = functools.partial(
         _kernel, axis_name=axis_name, size=size, sb=sb, d=d, scale=scale,
-        pipelined=not interpret, mesh_ids=multi_axis)
+        pipelined=not interpret, mesh_ids=multi_axis, causal=causal)
     compiler_params = None if interpret else pltpu.CompilerParams(
         collective_id=16, has_side_effects=True)
     if vma_on:

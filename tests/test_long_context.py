@@ -116,3 +116,31 @@ def test_dp_training_loss_decreases_and_backends_agree():
     # and training actually trains
     long = run_spmd(dp_train_program, nranks=4, steps=40)
     assert float(np.ravel(np.asarray(long[0]))[0]) < tpu_loss
+
+
+def test_ring_attention_causal_both_spellings_match_oracle():
+    """--causal on both the shift loop and the kernel variant equals a
+    dense causal oracle (global-position masking across blocks)."""
+    import warnings
+
+    P, s, d = 4, 8, 128
+
+    def causal_full(q, k, v):
+        sc = (q @ k.T) / np.sqrt(q.shape[-1])
+        n = sc.shape[0]
+        sc = np.where(np.tril(np.ones((n, n), bool)), sc, -np.inf)
+        p = np.exp(sc - sc.max(axis=-1, keepdims=True))
+        p /= p.sum(axis=-1, keepdims=True)
+        return p @ v
+
+    for kernel in (False, True):
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore", RuntimeWarning)
+            out = run_spmd(ring_attention_program, nranks=P, seq_per_rank=s,
+                           d=d, kernel=kernel, causal=True)
+        o = np.asarray(out[0]).reshape(P * s, d)
+        q = np.asarray(out[1]).reshape(P * s, d)
+        k = np.asarray(out[2]).reshape(P * s, d)
+        v = np.asarray(out[3]).reshape(P * s, d)
+        np.testing.assert_allclose(o, causal_full(q, k, v), rtol=2e-4,
+                                   atol=2e-5)

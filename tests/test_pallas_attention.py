@@ -194,3 +194,73 @@ def test_mixed_dtype_diagnosed():
         jax.jit(jax.shard_map(lambda x: f(x)[:0], mesh=mesh,
                               in_specs=P("world"), out_specs=P("world"),
                               check_vma=False))(jnp.zeros(2, jnp.float32))
+
+
+def _causal_oracle(q, k, v):
+    s = (q.astype(np.float32) @ k.astype(np.float32).T) / np.sqrt(q.shape[1])
+    n = s.shape[0]
+    s = np.where(np.tril(np.ones((n, n), bool)), s, -np.inf)
+    p = np.exp(s - s.max(axis=1, keepdims=True))
+    p /= p.sum(axis=1, keepdims=True)
+    return p @ v.astype(np.float32)
+
+
+@pytest.mark.parametrize("Pn,Sb,d", [(2, 8, 128), (4, 16, 128),
+                                     (3, 8, 128)])
+def test_causal_interpreter_parity(Pn, Sb, d):
+    """causal=True masks by GLOBAL position across the sharded sequence
+    (block indices from the SMEM params): kernel == dense causal
+    oracle.  The first fold is the own (diagonal) block, so the running
+    max is finite from step 0 — no NaN path through the -1e30 mask."""
+    rng = np.random.RandomState(Pn)
+    q = rng.randn(Pn * Sb, d).astype(np.float32)
+    k = rng.randn(Pn * Sb, d).astype(np.float32)
+    v = rng.randn(Pn * Sb, d).astype(np.float32)
+    mesh = default_mesh(Pn)
+    jf = jax.jit(jax.shard_map(
+        lambda qb, kb, vb: pallas_ring_attention(
+            qb, kb, vb, "world", Pn, causal=True, interpret=True),
+        mesh=mesh, in_specs=(P("world"),) * 3, out_specs=P("world"),
+        check_vma=False))
+    got = np.asarray(jf(jnp.asarray(q), jnp.asarray(k), jnp.asarray(v)))
+    np.testing.assert_allclose(got, _causal_oracle(q, k, v), rtol=2e-4,
+                               atol=2e-5)
+
+
+def test_causal_fallback_and_size1():
+    """The ppermute fallback (vma on) and the P=1 degenerate path apply
+    the same causal mask."""
+    Pn, Sb, d = 4, 8, 128
+    rng = np.random.RandomState(11)
+    q = rng.randn(Pn * Sb, d).astype(np.float32)
+    mesh = default_mesh(Pn)
+    jf = jax.jit(jax.shard_map(
+        lambda qb: pallas_ring_attention(qb, qb, qb, "world", Pn,
+                                         causal=True, interpret=True),
+        mesh=mesh, in_specs=P("world"), out_specs=P("world")))
+    with pytest.warns(RuntimeWarning, match="ppermute ring fallback"):
+        got = np.asarray(jf(jnp.asarray(q)))
+    np.testing.assert_allclose(got, _causal_oracle(q, q, q), rtol=2e-4,
+                               atol=2e-5)
+
+    q1 = q[:Sb]
+    mesh1 = default_mesh(1)
+    got1 = np.asarray(jax.jit(jax.shard_map(
+        lambda qb: pallas_ring_attention(qb, qb, qb, "world", 1,
+                                         causal=True, interpret=True),
+        mesh=mesh1, in_specs=P("world"), out_specs=P("world"),
+        check_vma=False))(jnp.asarray(q1)))
+    np.testing.assert_allclose(got1, _causal_oracle(q1, q1, q1), rtol=2e-4,
+                               atol=2e-5)
+
+
+def test_causal_export_tpu():
+    mesh = AbstractMesh((8,), ("s",))
+    jf = jax.jit(jax.shard_map(
+        lambda q, k, v: pallas_ring_attention(q, k, v, "s", 8, causal=True,
+                                              interpret=False),
+        mesh=mesh, in_specs=(P("s"),) * 3, out_specs=P("s"),
+        check_vma=False))
+    aval = jax.ShapeDtypeStruct((8 * 64, 128), jnp.float32)
+    exp = jax.export.export(jf, platforms=["tpu"])(aval, aval, aval)
+    assert "tpu_custom_call" in exp.mlir_module()
