@@ -101,8 +101,17 @@ def _kernel(params_smem, q_hbm, kv_hbm, out_hbm, comm_hbm, q_vmem, kv_vmem,
             m_vmem, l_vmem, o_vmem, copy_sem, send_sem, recv_sem,
             credit_sem, *, axis_name: str, size: int, sb: int, d: int,
             scale: float, pipelined: bool, mesh_ids: bool,
-            causal: bool = False):
-    """See module docstring for the step/slot/credit schedule."""
+            causal: bool = False, hq: int = 1, hkv: int = 1):
+    """See module docstring for the step/slot/credit schedule.
+
+    Multi-head layout (``hq`` query heads, ``hkv`` K/V heads — GQA when
+    hkv < hq): the per-head [Sb, dh] planes are stacked along rows —
+    q/out/m/l/o rows [h*Sb, (h+1)*Sb) belong to query head h; the
+    circulating buffer stacks all K planes then all V planes
+    ([hkv*Sb] + [hkv*Sb] rows), so ONE RDMA moves every head's K/V and
+    the circulation/credit protocol is byte-identical to the
+    single-head case (pure payload relabeling — AttentionSim's
+    verification carries over unchanged)."""
     left = params_smem[0]
     right = params_smem[1]
     my = params_smem[2]
@@ -140,13 +149,18 @@ def _kernel(params_smem, q_hbm, kv_hbm, out_hbm, comm_hbm, q_vmem, kv_vmem,
 
     def fold(a):
         def body(mask):
-            k = kv_vmem[pl.ds(0, sb), :]
-            v = kv_vmem[pl.ds(sb, sb), :]
-            m, l, o = _online_fold(q_vmem[:], k, v, m_vmem[:], l_vmem[:],
-                                   o_vmem[:], scale, mask)
-            m_vmem[:] = m
-            l_vmem[:] = l
-            o_vmem[:] = o
+            g = hq // hkv  # query heads per K/V head (GQA group size)
+            for h in range(hq):
+                kvh = h // g
+                rows = pl.ds(h * sb, sb)
+                k = kv_vmem[pl.ds(kvh * sb, sb), :]
+                v = kv_vmem[pl.ds((hkv + kvh) * sb, sb), :]
+                m, l, o = _online_fold(q_vmem[rows, :], k, v,
+                                       m_vmem[rows, :], l_vmem[rows, :],
+                                       o_vmem[rows, :], scale, mask)
+                m_vmem[rows, :] = m
+                l_vmem[rows, :] = l
+                o_vmem[rows, :] = o
 
         if not causal:
             body(None)
@@ -167,9 +181,9 @@ def _kernel(params_smem, q_hbm, kv_hbm, out_hbm, comm_hbm, q_vmem, kv_vmem,
     cp_q = pltpu.make_async_copy(q_hbm, q_vmem, copy_sem)
     cp_q.start()
     cp_q.wait()
-    m_vmem[:] = jnp.full((sb, 1), -jnp.inf, jnp.float32)
-    l_vmem[:] = jnp.zeros((sb, 1), jnp.float32)
-    o_vmem[:] = jnp.zeros((sb, d), jnp.float32)
+    m_vmem[:] = jnp.full((hq * sb, 1), -jnp.inf, jnp.float32)
+    l_vmem[:] = jnp.zeros((hq * sb, 1), jnp.float32)
+    o_vmem[:] = jnp.zeros((hq * sb, d), jnp.float32)
 
     neighbor_barrier()
 
@@ -226,25 +240,39 @@ def _ring_neighbors(axis_name: str, size: int) -> jnp.ndarray:
 def _fallback_attention(q, k, v, axis_name: str, size: int, scale: float,
                         causal: bool = False):
     """The same online-softmax ring as jax ops over ppermute — the
-    vma/multi-axis interpreter path (and a reference implementation)."""
+    vma/multi-axis interpreter path, and the recompute body of the
+    custom-vjp backward.  Accepts both layouts ([Sb, d] and
+    [H, Sb, d]); the multi-head ring rotates the WHOLE [Hkv, Sb, d]
+    K/V stacks once per step (one ppermute pair per step, exactly like
+    the kernel's single circulating RDMA) with per-head folds inside —
+    NOT one ring per head (review round 4)."""
+    multihead = q.ndim == 3
+    q3 = q if multihead else q[None]
+    k3 = k if multihead else k[None]
+    v3 = v if multihead else v[None]
+    hq, sb, d = q3.shape
+    hkv = k3.shape[0]
+    g = hq // hkv
     world_pairs = _world_pairs_of(size, None)
     perm = world_pairs([(r, (r + 1) % size) for r in range(size)])
     my = lax.axis_index(axis_name)
-    sb = q.shape[0]
-    m = jnp.full(q.shape[:1] + (1,), -jnp.inf, jnp.float32)
-    l = jnp.zeros(q.shape[:1] + (1,), jnp.float32)
-    o = jnp.zeros((q.shape[0], v.shape[1]), jnp.float32)
-    kb, vb = k, v
+    m = [jnp.full((sb, 1), -jnp.inf, jnp.float32) for _ in range(hq)]
+    l = [jnp.zeros((sb, 1), jnp.float32) for _ in range(hq)]
+    o = [jnp.zeros((sb, d), jnp.float32) for _ in range(hq)]
+    kb, vb = k3, v3
     for step in range(size):
         mask = None
         if causal:
             kv_idx = lax.rem(my - step + size, size)
-            mask = _causal_mask(my, kv_idx, sb)
-        m, l, o = _online_fold(q, kb, vb, m, l, o, scale, mask)
+            mask = _causal_mask(my, kv_idx, sb)  # shared by every head
+        for h in range(hq):
+            m[h], l[h], o[h] = _online_fold(q3[h], kb[h // g], vb[h // g],
+                                            m[h], l[h], o[h], scale, mask)
         if step < size - 1:  # the last fold's blocks need no rotation
             kb = lax.ppermute(kb, axis_name, perm)
             vb = lax.ppermute(vb, axis_name, perm)
-    return (o / l).astype(q.dtype)
+    out = jnp.stack([(o[h] / l[h]) for h in range(hq)]).astype(q.dtype)
+    return out if multihead else out[0]
 
 
 def pallas_ring_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
@@ -252,8 +280,15 @@ def pallas_ring_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
                           scale: float = None, causal: bool = False,
                           interpret: bool = False) -> jnp.ndarray:
     """Exact attention (full, or causal with ``causal=True``) over a
-    sequence-sharded axis: ``q``/``k``/``v`` are this device's [Sb, d]
-    blocks; returns this device's [Sb, d] output block.  Call inside
+    sequence-sharded axis.  Two shapes:
+
+    * single-head: ``q``/``k``/``v`` = this device's [Sb, dh] blocks;
+    * multi-head / GQA: ``q`` = [Hq, Sb, dh], ``k``/``v`` =
+      [Hkv, Sb, dh] with ``Hq % Hkv == 0`` — query head h attends K/V
+      head ``h // (Hq//Hkv)`` (Hkv == Hq is classic multi-head,
+      Hkv == 1 is MQA).  ALL heads ride ONE circulating RDMA per step.
+
+    Returns this device's output block, shaped like ``q``.  Call inside
     shard_map over a mesh with ``axis_name``; the global sequence is
     the concatenation of the blocks in rank order.
 
@@ -261,16 +296,29 @@ def pallas_ring_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     the module docstring; ``interpret=True`` (the CPU tier) runs the
     serial same-kernel path, or — under vma typing / a multi-axis mesh
     — the ppermute fallback with the shared loud warning."""
-    if q.ndim != 2 or k.shape != q.shape or v.shape != q.shape:
+    if q.ndim not in (2, 3):
         raise ValueError(
-            f"ring attention wants equal [rows, d] blocks for q/k/v, got "
+            f"ring attention wants [Sb, dh] or [H, Sb, dh] blocks, got "
+            f"q {q.shape}")
+    if k.shape != v.shape or q.shape[-2:] != k.shape[-2:] or \
+            q.ndim != k.ndim:
+        raise ValueError(
+            f"ring attention wants equal [.., rows, d] blocks for q/k/v "
+            f"(k/v may differ from q only in the HEAD count), got "
             f"{q.shape}/{k.shape}/{v.shape}")
     if k.dtype != q.dtype or v.dtype != q.dtype:
         raise ValueError(
             f"ring attention wants one dtype for q/k/v (the circulating "
             f"K/V buffer is allocated as q's), got "
             f"{q.dtype}/{k.dtype}/{v.dtype}")
-    sb, d = q.shape
+    multihead = q.ndim == 3
+    hq = q.shape[0] if multihead else 1
+    hkv = k.shape[0] if multihead else 1
+    if hkv < 1 or hq % hkv or hkv > hq:
+        raise ValueError(
+            f"GQA wants Hq a positive multiple of Hkv, got Hq={hq} "
+            f"Hkv={hkv}")
+    sb, d = q.shape[-2:]
     if d % _LANES:
         raise NotImplementedError(
             f"head dim must be a multiple of {_LANES} (lane width), got {d}")
@@ -285,53 +333,102 @@ def pallas_ring_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
         scale = 1.0 / float(np.sqrt(d))
     # shared dtype/vma/mesh probing with the ring collectives (f32/bf16)
     vma_on, multi_axis = _check_args(q, axis_name, size, sub, "sum")
-    if size == 1:
+
+    def _per_head(fn, q_, k_, v_):
+        """Apply a [Sb,dh]-block function per query head (GQA maps
+        query head h to K/V head h // (Hq//Hkv))."""
+        if not multihead:
+            return fn(q_, k_, v_)
+        g = hq // hkv
+        return jnp.stack([fn(q_[h], k_[h // g], v_[h // g])
+                          for h in range(hq)])
+
+    def _local_one(qh, kh, vh):
         m0 = jnp.full((sb, 1), -jnp.inf, jnp.float32)
         l0 = jnp.zeros((sb, 1), jnp.float32)
         o0 = jnp.zeros((sb, d), jnp.float32)
-        mask = _causal_mask(jnp.int32(0), jnp.int32(0), sb) if causal else None
-        _, l1, o1 = _online_fold(q, k, v, m0, l0, o0, scale, mask)
+        mask = (_causal_mask(jnp.int32(0), jnp.int32(0), sb)
+                if causal else None)
+        _, l1, o1 = _online_fold(qh, kh, vh, m0, l0, o0, scale, mask)
         return (o1 / l1).astype(q.dtype)
+
+    def _reference(q_, k_, v_):
+        """Pure-jax ring (differentiable) — primal-identical to the
+        kernel; the custom-vjp backward recomputes through it.  Only
+        reached with size >= 2 (size == 1 returns below, before any
+        _reference call site)."""
+        return _fallback_attention(q_, k_, v_, axis_name, size, scale,
+                                   causal)
+
+    if size == 1:
+        return _per_head(_local_one, q, k, v)
     if (vma_on or multi_axis) and interpret:
         _fallback("ring_attention", axis_name, vma_on, multi_axis)
-        return _fallback_attention(q, k, v, axis_name, size, scale, causal)
+        return _reference(q, k, v)
 
-    kv = jnp.concatenate([k, v], axis=0)  # one [2*Sb, d] circulating block
-    params = _ring_neighbors(axis_name, size)
-    kern = functools.partial(
-        _kernel, axis_name=axis_name, size=size, sb=sb, d=d, scale=scale,
-        pipelined=not interpret, mesh_ids=multi_axis, causal=causal)
-    compiler_params = None if interpret else pltpu.CompilerParams(
-        collective_id=16, has_side_effects=True)
-    if vma_on:
-        try:
-            in_vma = frozenset(jax.typeof(q).vma)
-        except (AttributeError, NameError):
-            in_vma = frozenset()
-        out_shape = jax.ShapeDtypeStruct((sb, d), jnp.float32,
-                                         vma=in_vma | {axis_name})
-    else:
-        out_shape = jax.ShapeDtypeStruct((sb, d), jnp.float32)
-    out = pl.pallas_call(
-        kern,
-        out_shape=out_shape,
-        in_specs=[pl.BlockSpec(memory_space=pltpu.SMEM),
-                  pl.BlockSpec(memory_space=pl.ANY),
-                  pl.BlockSpec(memory_space=pl.ANY)],
-        out_specs=pl.BlockSpec(memory_space=pl.ANY),
-        scratch_shapes=[
-            pl.ANY((2, 2 * sb, d), q.dtype),            # landing slots
-            pltpu.VMEM((sb, d), q.dtype),               # Q
-            pltpu.VMEM((2 * sb, d), q.dtype),           # K/V staging
-            pltpu.VMEM((sb, 1), jnp.float32),           # m
-            pltpu.VMEM((sb, 1), jnp.float32),           # l
-            pltpu.VMEM((sb, d), jnp.float32),           # o
-            pltpu.SemaphoreType.DMA(()),
-            pltpu.SemaphoreType.DMA((2,)),              # send (slot parity)
-            pltpu.SemaphoreType.DMA((2,)),              # recv (slot parity)
-            pltpu.SemaphoreType.REGULAR((2,)),          # slot credits
-        ],
-        compiler_params=compiler_params,
-        interpret=interpret,
-    )(params, q, kv)
-    return out.astype(q.dtype)
+    def _kernel_call(q_, k_, v_):
+        # flat multi-head layout (see _kernel docstring): q/out stack
+        # query heads along rows; the circulating buffer stacks all K
+        # planes then all V planes so one RDMA carries every head
+        qf = q_.reshape(hq * sb, d) if multihead else q_
+        kf = k_.reshape(hkv * sb, d) if multihead else k_
+        vf = v_.reshape(hkv * sb, d) if multihead else v_
+        kv = jnp.concatenate([kf, vf], axis=0)
+        params = _ring_neighbors(axis_name, size)
+        kern = functools.partial(
+            _kernel, axis_name=axis_name, size=size, sb=sb, d=d,
+            scale=scale, pipelined=not interpret, mesh_ids=multi_axis,
+            causal=causal, hq=hq, hkv=hkv)
+        compiler_params = None if interpret else pltpu.CompilerParams(
+            collective_id=16, has_side_effects=True)
+        if vma_on:
+            try:
+                in_vma = frozenset(jax.typeof(q_).vma)
+            except (AttributeError, NameError):
+                in_vma = frozenset()
+            out_shape = jax.ShapeDtypeStruct((hq * sb, d), jnp.float32,
+                                             vma=in_vma | {axis_name})
+        else:
+            out_shape = jax.ShapeDtypeStruct((hq * sb, d), jnp.float32)
+        out = pl.pallas_call(
+            kern,
+            out_shape=out_shape,
+            in_specs=[pl.BlockSpec(memory_space=pltpu.SMEM),
+                      pl.BlockSpec(memory_space=pl.ANY),
+                      pl.BlockSpec(memory_space=pl.ANY)],
+            out_specs=pl.BlockSpec(memory_space=pl.ANY),
+            scratch_shapes=[
+                pl.ANY((2, 2 * hkv * sb, d), q.dtype),   # landing slots
+                pltpu.VMEM((hq * sb, d), q.dtype),       # Q (all heads)
+                pltpu.VMEM((2 * hkv * sb, d), q.dtype),  # K/V staging
+                pltpu.VMEM((hq * sb, 1), jnp.float32),   # m
+                pltpu.VMEM((hq * sb, 1), jnp.float32),   # l
+                pltpu.VMEM((hq * sb, d), jnp.float32),   # o
+                pltpu.SemaphoreType.DMA(()),
+                pltpu.SemaphoreType.DMA((2,)),           # send (parity)
+                pltpu.SemaphoreType.DMA((2,)),           # recv (parity)
+                pltpu.SemaphoreType.REGULAR((2,)),       # slot credits
+            ],
+            compiler_params=compiler_params,
+            interpret=interpret,
+        )(params, qf, kv)
+        out = out.astype(q_.dtype)
+        return out.reshape(hq, sb, d) if multihead else out
+
+    # Differentiable wrapper: jax cannot autodiff through the kernel's
+    # remote DMAs, so the backward RECOMPUTES through the pure-jax ring
+    # (the flash-attention recompute strategy; ppermutes transpose to
+    # the inverse rotation) — the fused kernel stays the forward hot
+    # path and training can jax.grad straight through it.
+    attn = jax.custom_vjp(_kernel_call)
+
+    def _fwd(q_, k_, v_):
+        return _kernel_call(q_, k_, v_), (q_, k_, v_)
+
+    def _bwd(res, ct):
+        q_, k_, v_ = res
+        _, vjp = jax.vjp(_reference, q_, k_, v_)
+        return vjp(ct)
+
+    attn.defvjp(_fwd, _bwd)
+    return attn(q, k, v)
