@@ -191,6 +191,23 @@ def _mk_copy_sync(copy_sem):
     return copy_sync
 
 
+def _mk_snd(first_src, comm_hbm, send_sem, recv_sem, dev_kw, right):
+    """Send-descriptor factory shared by both ring kernels: send ``u``
+    forwards from ``first_src`` (u == 0: the block that never landed in
+    a slot) or comm slot u%2, into the right neighbor's slot (u+1)%2,
+    on the (parity)-indexed send/recv semaphores.  One definition —
+    the slot/sem indexing IS the protocol the models check."""
+    def snd(u):
+        dst_slot = (u + 1) % 2
+        src = first_src if u == 0 else comm_hbm.at[u % 2]
+        return pltpu.make_async_remote_copy(
+            src_ref=src, dst_ref=comm_hbm.at[dst_slot],
+            send_sem=send_sem.at[dst_slot], recv_sem=recv_sem.at[dst_slot],
+            **dev_kw(right))
+
+    return snd
+
+
 def attention_vmem_plan(sb: int, d: int, hq: int, hkv: int, dtype,
                         vmem_limit_bytes: Optional[int] = None,
                         for_backward: bool = False):
@@ -300,16 +317,8 @@ def _kernel(params_smem, q_hbm, kv_hbm, *refs,
     dev_kw = _mk_dev_kw(mesh_ids, axis_name)
     neighbor_barrier = _mk_barrier(pipelined, dev_kw, left, right)
     copy_sync = _mk_copy_sync(copy_sem)
-
-    def fwd_rdma(u):
-        """Send ``u`` (0..P-2): the block computed at step ``u`` moves
-        to the right neighbor's slot ``(u+1) % 2``."""
-        dst_slot = (u + 1) % 2
-        src = kv_hbm if u == 0 else comm_hbm.at[u % 2]
-        return pltpu.make_async_remote_copy(
-            src_ref=src, dst_ref=comm_hbm.at[dst_slot],
-            send_sem=send_sem.at[dst_slot], recv_sem=recv_sem.at[dst_slot],
-            **dev_kw(right))
+    # send u (0..P-2): the block computed at step u moves on
+    fwd_rdma = _mk_snd(kv_hbm, comm_hbm, send_sem, recv_sem, dev_kw, right)
 
     # -- resident fold: whole block staged in VMEM --------------------------
 
@@ -536,20 +545,18 @@ def _bwd_kernel(params_smem, q_hbm, kv32_hbm, do_hbm, lse_hbm, delta_hbm,
     neighbor_barrier = _mk_barrier(pipelined, dev_kw, left, right)
     copy_sync = _mk_copy_sync(copy_sem)
 
-    def snd(u):
-        """Send ``u`` (0..P-1): the block folded at step ``u`` moves to
-        the right neighbor's slot ``(u+1) % 2``.  Send 0 reads the
-        assembled own-block scratch, not a comm slot."""
-        dst_slot = (u + 1) % 2
-        src = own_hbm if u == 0 else comm_hbm.at[u % 2]
-        return pltpu.make_async_remote_copy(
-            src_ref=src, dst_ref=comm_hbm.at[dst_slot],
-            send_sem=send_sem.at[dst_slot], recv_sem=recv_sem.at[dst_slot],
-            **dev_kw(right))
+    # send u (0..P-1): the block folded at step u moves on; send 0
+    # reads the assembled own-block scratch, not a comm slot
+    snd = _mk_snd(own_hbm, comm_hbm, send_sem, recv_sem, dev_kw, right)
 
-    def pair_grads(kv_idx):
+    def pair_grads(kv_idx, masked):
         """dQ/dK/dV contributions of my Q rows against the K/V block in
-        kv_vmem; dK/dV accumulate into dkv_vmem (all heads)."""
+        kv_vmem; dK/dV accumulate into dkv_vmem (all heads).  ``masked``
+        (static) applies the causal mask — only the DIAGONAL block
+        (kv_idx == my) needs it: strictly-past blocks are all-True and
+        future blocks are skipped by the caller's pl.when, so the mask
+        materialization stays off the P-2 hot arrivals (review round
+        5)."""
         for h in range(hq):
             kvh = h // g
             rows = pl.ds(h * sb, sb)
@@ -562,9 +569,7 @@ def _bwd_kernel(params_smem, q_hbm, kv32_hbm, do_hbm, lse_hbm, delta_hbm,
             s = jnp.dot(qh, kb.T,
                         preferred_element_type=jnp.float32) * scale
             p = jnp.exp(s - lseh)
-            if causal:
-                # kv_idx < my ⇒ all-True; == my ⇒ the diagonal tile;
-                # > my is skipped by the caller's pl.when
+            if masked:
                 p = jnp.where(_causal_mask(my, kv_idx, sb), p, 0.0)
             dp = jnp.dot(doh, vb.T, preferred_element_type=jnp.float32)
             ds_ = p * (dp - deltah) * scale
@@ -590,7 +595,7 @@ def _bwd_kernel(params_smem, q_hbm, kv32_hbm, do_hbm, lse_hbm, delta_hbm,
     copy_sync(kv32_hbm, own_hbm.at[pl.ds(0, kv_rows)])
     copy_sync(kv32_hbm, kv_vmem)
     dkv_vmem[:] = jnp.zeros((kv_rows, d), jnp.float32)
-    pair_grads(my)
+    pair_grads(my, masked=causal)  # a=0 is the diagonal block
     copy_sync(dkv_vmem, own_hbm.at[pl.ds(kv_rows, kv_rows)])
 
     neighbor_barrier()
@@ -607,22 +612,26 @@ def _bwd_kernel(params_smem, q_hbm, kv32_hbm, do_hbm, lse_hbm, delta_hbm,
         if a < P:
             # fold BEFORE forward: the dK/dV planes must carry my
             # contribution when the block moves on
-            def consume(kv_idx):
+            def consume(kv_idx, masked):
                 copy_sync(comm_hbm.at[slot, pl.ds(0, kv_rows)], kv_vmem)
                 copy_sync(comm_hbm.at[slot, pl.ds(kv_rows, kv_rows)],
                           dkv_vmem)
-                pair_grads(kv_idx)
+                pair_grads(kv_idx, masked)
                 copy_sync(dkv_vmem,
                           comm_hbm.at[slot, pl.ds(kv_rows, kv_rows)])
 
             if causal:
+                # the diagonal block is always arrival 0 (kv_idx == my
+                # iff a ≡ 0 mod P), so arrivals 1..P-1 are either
+                # strictly past (mask provably all-True — skip its
+                # materialization) or future (skip everything)
                 kv_idx = lax.rem(my - a + P, P)
 
-                @pl.when(kv_idx <= my)
+                @pl.when(kv_idx < my)
                 def _():
-                    consume(kv_idx)
+                    consume(kv_idx, masked=False)
             else:
-                consume(lax.rem(my - a + P, P))
+                consume(lax.rem(my - a + P, P), masked=False)
             if pipelined:
                 # FIRST retire the previous hop and credit its slot —
                 # this signal transitively feeds the right neighbor's
