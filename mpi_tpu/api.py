@@ -267,7 +267,11 @@ def MPI_Gather(obj: Any, root: int = 0, comm: Optional[Communicator] = None) -> 
     HBM per device and warns above the ``gather_replicated_warn_bytes``
     mpit cvar; large payloads should use the backend-specific
     ``comm.gather(obj, sharded=True)`` spelling (zero wire traffic,
-    O(payload) per device — see TpuCommunicator.gather)."""
+    O(payload) per device — see TpuCommunicator.gather).  The sharded
+    slice is branded vma-VARYING over the axis, so composing it with a
+    non-sharded out_spec fails the vma typecheck loudly instead of
+    silently yielding a [1, ...] slice (under ``check_vma=False`` the
+    composition remains the caller's burden)."""
     return _call(comm, "gather", obj, root)
 
 

@@ -33,7 +33,8 @@ import numpy as np
 from . import mpit as _mpit
 from . import ops as _ops
 from . import schedules
-from .transport.base import ANY_SOURCE, ANY_TAG, Transport
+from .transport.base import (ANY_SOURCE, ANY_TAG, Transport,
+                             payload_nbytes)
 
 # Internal tags (never matched by user-level ANY_TAG — see Mailbox._matches).
 # CPU-backend allreduce auto crossover (mpit cvar; measured, BASELINE.md)
@@ -48,9 +49,10 @@ _TAG_SPLIT = -5
 class Status:
     """Result metadata for a receive (MPI_Status analogue).
 
-    ``count_bytes`` is the received payload's size when it is a sized
-    buffer (ndarray / bytes), None for opaque pickled objects and for
-    probe (which sees only the envelope) — the MPI_UNDEFINED analogue.
+    ``count_bytes`` is the payload's size when it is a sized buffer
+    (ndarray / bytes) — set by receives AND by probe/iprobe, which
+    peek the queued message's size without consuming it (ADVICE r4
+    #2); None for opaque pickled objects — the MPI_UNDEFINED analogue.
     MPI_Get_count/MPI_Get_elements (api.py) divide it by a datatype."""
 
     __slots__ = ("source", "tag", "count_bytes")
@@ -61,12 +63,9 @@ class Status:
         self.count_bytes: Optional[int] = None
 
     def _set_count(self, obj: Any) -> None:
-        if hasattr(obj, "nbytes"):
-            self.count_bytes = int(obj.nbytes)
-        elif isinstance(obj, (bytes, bytearray, memoryview)):
-            self.count_bytes = len(obj)
-        else:
-            self.count_bytes = None
+        # ONE sizing rule, shared with the transports' probe peek —
+        # probe and the matching recv must never disagree on a count
+        self.count_bytes = payload_nbytes(obj)
 
     def _fill(self, source: int, tag: int, payload: Any) -> None:
         """The one envelope-fill site (recv, mprobe/improbe, Mrecv)."""
@@ -74,13 +73,17 @@ class Status:
         self.tag = tag
         self._set_count(payload)
 
-    def _fill_envelope(self, source: int, tag: int) -> None:
-        """probe/iprobe: envelope only.  count_bytes is RESET to None
-        (MPI_UNDEFINED) — a Status reused after a prior recv must not
-        leak that recv's count through a probe (ADVICE r3 #1)."""
+    def _fill_envelope(self, source: int, tag: int,
+                       count_bytes: Optional[int] = None) -> None:
+        """probe/iprobe: the envelope plus the QUEUED payload's size
+        (the transports peek it without consuming — ADVICE r4 #2: the
+        canonical probe+get_count+recv buffer-sizing idiom works).
+        None (MPI_UNDEFINED) for opaque pickled payloads; a Status
+        reused after a prior recv never leaks that recv's count
+        (ADVICE r3 #1 — the field is overwritten either way)."""
         self.source = source
         self.tag = tag
-        self.count_bytes = None
+        self.count_bytes = count_bytes
 
     def __repr__(self) -> str:  # pragma: no cover - cosmetic
         return f"Status(source={self.source}, tag={self.tag})"
@@ -907,9 +910,10 @@ class P2PCommunicator(Communicator):
         (without consuming it); fills ``status`` with its envelope."""
         _check_user_tag(tag)
         src_world = ANY_SOURCE if source == ANY_SOURCE else self._world(source)
-        s, t = self._t.peek(src_world, self._ctx, tag, timeout=self.recv_timeout)
+        s, t, n = self._t.peek(src_world, self._ctx, tag,
+                               timeout=self.recv_timeout)
         if status is not None:
-            status._fill_envelope(self._from_world(s), t)
+            status._fill_envelope(self._from_world(s), t, n)
 
     def mprobe(self, source: int = ANY_SOURCE, tag: int = ANY_TAG,
                status: Optional[Status] = None) -> "Message":
@@ -949,7 +953,7 @@ class P2PCommunicator(Communicator):
         if hit is None:
             return False
         if status is not None:
-            status._fill_envelope(self._from_world(hit[0]), hit[1])
+            status._fill_envelope(self._from_world(hit[0]), hit[1], hit[2])
         return True
 
     def shift(self, obj: Any, offset: int = 1, wrap: bool = True, fill: Any = None) -> Any:

@@ -68,15 +68,41 @@ _COLLECTIVE_BUFFER_LIMIT = 8 << 20
 # -- data representations (MPI_Register_datarep, MPI-2 §9.5 [S]) ------------
 
 
+def _wants_position(fn, base_params: int) -> str:
+    """How a datarep callback takes the optional ``position`` argument:
+    ``"pos"`` (a positional parameter beyond the ``base_params``
+    required ones, or *args), ``"kw"`` (a keyword-only parameter named
+    ``position`` — review round 5: the natural ``*, position=0``
+    spelling must not be silently treated as position-free), or ``""``
+    (position-free; also for C callables hiding their signature)."""
+    import inspect
+
+    try:
+        sig = inspect.signature(fn)
+    except (TypeError, ValueError):
+        return ""
+    params = list(sig.parameters.values())
+    kinds = [p.kind for p in params]
+    if any(p.kind == inspect.Parameter.KEYWORD_ONLY
+           and p.name == "position" for p in params):
+        return "kw"
+    if inspect.Parameter.VAR_POSITIONAL in kinds:
+        return "pos"
+    positional = [k for k in kinds
+                  if k in (inspect.Parameter.POSITIONAL_ONLY,
+                           inspect.Parameter.POSITIONAL_OR_KEYWORD)]
+    return "pos" if len(positional) > base_params else ""
+
+
 class Datarep:
     """How etype elements are represented IN THE FILE.  The MPI callback
-    triple, pythonically collapsed (the buffer/position plumbing of the
-    C signatures is what numpy slicing already does):
+    triple, pythonically collapsed (the buffer plumbing of the C
+    signatures is what numpy slicing already does):
 
-    * ``read_fn(raw: bytes, etype: np.dtype, count: int, extra) ->
-      np.ndarray`` — file representation → memory representation;
-    * ``write_fn(arr: np.ndarray, etype: np.dtype, extra) -> bytes`` —
-      memory → file representation;
+    * ``read_fn(raw: bytes, etype: np.dtype, count: int, extra
+      [, position]) -> np.ndarray`` — file → memory representation;
+    * ``write_fn(arr: np.ndarray, etype: np.dtype, extra
+      [, position]) -> bytes`` — memory → file representation;
     * ``extent_fn(etype: np.dtype, extra) -> int`` — bytes ONE element
       occupies in the file (MPI's dtype_file_extent_fn); defaults to
       ``etype.itemsize`` (size-preserving representations).
@@ -84,13 +110,26 @@ class Datarep:
     Conversions are elementwise (element i of the memory array ↔ bytes
     [i*extent, (i+1)*extent) of the file stream), which is what lets
     file views, shared pointers, and collective buffering keep operating
-    in etype units with only the byte math rescaled."""
+    in etype units with only the byte math rescaled.
+
+    **Positional representations** (ADVICE r4 #3): a callback declaring
+    the optional trailing ``position`` parameter receives the
+    VIEW-relative etype index of its first element (MPI's ``position``
+    argument), so element-indexed schemes (e.g. per-element keystreams)
+    convert correctly even when a filetype scatters the batch across
+    non-contiguous file runs — the batch is always contiguous IN THE
+    VIEW.  Representations keyed to absolute FILE byte offsets (e.g.
+    record headers between runs) are NOT expressible — a filetype's
+    runs are invisible to the callback by design; model those as part
+    of the filetype instead."""
 
     def __init__(self, name: str, read_fn, write_fn, extent_fn=None,
                  extra_state=None):
         self.name = name
         self._read, self._write = read_fn, write_fn
         self._extent, self._extra = extent_fn, extra_state
+        self._read_pos = _wants_position(read_fn, 4)
+        self._write_pos = _wants_position(write_fn, 3)
 
     def file_extent(self, etype: np.dtype) -> int:
         e = (int(self._extent(etype, self._extra)) if self._extent
@@ -101,19 +140,34 @@ class Datarep:
                 f"got {e} for etype {etype}")
         return e
 
-    def read(self, raw: bytes, etype: np.dtype, count: int) -> np.ndarray:
-        out = np.asarray(self._read(raw, etype, count, self._extra),
-                         dtype=etype)
+    def read(self, raw: bytes, etype: np.dtype, count: int,
+             position: int = 0) -> np.ndarray:
+        if self._read_pos == "pos":
+            out = self._read(raw, etype, count, self._extra,
+                             int(position))
+        elif self._read_pos == "kw":
+            out = self._read(raw, etype, count, self._extra,
+                             position=int(position))
+        else:
+            out = self._read(raw, etype, count, self._extra)
+        out = np.asarray(out, dtype=etype)
         if out.size != count:
             raise ValueError(
                 f"datarep {self.name!r} read conversion returned "
                 f"{out.size} elements for {count} requested")
         return out
 
-    def write(self, arr: np.ndarray, etype: np.dtype):
+    def write(self, arr: np.ndarray, etype: np.dtype,
+              position: int = 0):
         """→ the file-representation bytes (``bytes`` or a zero-copy
         ``memoryview`` for identity representations)."""
-        raw = self._write(arr, etype, self._extra)
+        if self._write_pos == "pos":
+            raw = self._write(arr, etype, self._extra, int(position))
+        elif self._write_pos == "kw":
+            raw = self._write(arr, etype, self._extra,
+                              position=int(position))
+        else:
+            raw = self._write(arr, etype, self._extra)
         want = arr.size * self.file_extent(etype)
         if len(raw) != want:
             raise ValueError(
@@ -320,11 +374,15 @@ class File:
 
     # -- explicit offsets (independent) ------------------------------------
 
-    def _to_file_rep(self, data: Any) -> Tuple[np.ndarray, memoryview]:
-        """Coerce to etype and run the view's datarep write conversion;
-        returns (memory array, file-representation bytes)."""
+    def _to_file_rep(self, data: Any,
+                     position: int = 0) -> Tuple[np.ndarray, memoryview]:
+        """Coerce to etype and run the view's datarep write conversion
+        (``position`` = view-relative etype offset of element 0, for
+        positional representations); returns (memory array,
+        file-representation bytes)."""
         arr = np.ascontiguousarray(np.asarray(data, dtype=self._etype))
-        return arr, memoryview(self._datarep.write(arr, self._etype))
+        return arr, memoryview(
+            self._datarep.write(arr, self._etype, position))
 
     def _write_runs(self, offset: int, nelems: int, view) -> None:
         """pwrite already-converted file-representation bytes across the
@@ -340,7 +398,7 @@ class File:
         datarep) at view-relative ``offset`` (etype units); returns
         elements written."""
         self._check_open()
-        arr, view = self._to_file_rep(data)
+        arr, view = self._to_file_rep(data, int(offset))
         self._write_runs(offset, arr.size, view)
         return arr.size
 
@@ -358,7 +416,7 @@ class File:
         raw = b"".join(chunks)
         nel = len(raw) // self._file_es
         return self._datarep.read(raw[: nel * self._file_es],
-                                  self._etype, nel)
+                                  self._etype, nel, int(offset))
 
     # -- individual file pointer -------------------------------------------
 
@@ -499,7 +557,7 @@ class File:
         offset-sorted sweep; large payloads write independently inside
         the same barrier bracket."""
         self._check_open()
-        arr, view = self._to_file_rep(data)
+        arr, view = self._to_file_rep(data, int(offset))
         total = self._comm.allreduce(len(view))
         # the aggregate-vs-independent branch must be COLLECTIVE: ranks
         # compare the (already-allreduced) total against RANK 0's limit,

@@ -312,6 +312,13 @@ class PrecvRequest:
 
 # -- sessions (MPI-4 ch.11) ---------------------------------------------------
 
+# generation counters for comm_create_from_group contexts, keyed by
+# (calling world rank, world_ranks, stringtag) — module-global (NOT
+# per-Session: context isolation must hold across sessions) but
+# rank-scoped via the key, so thread-backed ranks sharing one process
+# count independently (see Session.comm_create_from_group)
+_CFG_GENERATIONS: Dict[Tuple, int] = {}
+
 
 class Session:
     """An MPI-4 session: a private handle to the runtime.
@@ -385,9 +392,26 @@ class Session:
         (runtime ranks, in group order) — collective over the GROUP
         MEMBERS only, no parent communicator involved.  Matching follows
         MPI-4: concurrent calls are disambiguated by the
-        ``(group members, stringtag)`` pair, which becomes the new
-        context — every member must pass the same group and stringtag,
-        and concurrent calls with an identical pair are erroneous."""
+        ``(group members, stringtag)`` pair; every member must pass the
+        same group and stringtag, and CONCURRENT calls with an
+        identical pair are erroneous.
+
+        SEQUENTIAL calls with the same pair are legal and must yield
+        ISOLATED communicators (ADVICE r4 #1: a static context would
+        cross-match their traffic, e.g. a stale unmatched isend on the
+        first comm received by the second).  A per-RANK generation
+        counter keyed by (calling world rank, world_ranks, stringtag)
+        is mixed into the context: every member participates in every
+        creation with this key, creations with one key are ordered
+        (they are collectives over the same members, and concurrent
+        identical pairs are erroneous per MPI-4), so each member's Nth
+        call counts N on its own key — the contexts agree across
+        members with no extra traffic, and repeated creations get
+        distinct contexts.  The calling rank must be part of the KEY
+        but not the context: on the threaded local backend all ranks
+        share one process, so a process-global counter would advance
+        once per MEMBER and disagree across ranks (found by this
+        change's own isolation test deadlocking)."""
         self._check_live()
         ranks = tuple(int(r) for r in group.ranks)
         if self._base.rank not in ranks:
@@ -403,9 +427,13 @@ class Session:
         # byte-identical across member processes whose local numbering
         # may differ.
         world_ranks = tuple(self._base._world(r) for r in ranks)
-        return P2PCommunicator(self._base._t, world_ranks,
-                               context=("sess", world_ranks, str(stringtag)),
-                               recv_timeout=self._base.recv_timeout)
+        key = (self._base._t.world_rank, world_ranks, str(stringtag))
+        gen = _CFG_GENERATIONS.get(key, 0)
+        _CFG_GENERATIONS[key] = gen + 1
+        return P2PCommunicator(
+            self._base._t, world_ranks,
+            context=("sess", world_ranks, str(stringtag), gen),
+            recv_timeout=self._base.recv_timeout)
 
     # -- lifecycle ---------------------------------------------------------
 

@@ -33,6 +33,17 @@ class RecvTimeout(TransportError):
     pass
 
 
+def payload_nbytes(obj: Any) -> Optional[int]:
+    """Size of a sized payload (ndarray / bytes-like), None for opaque
+    objects — the count a probe can report without consuming (Status
+    applies the same rule after a receive)."""
+    if hasattr(obj, "nbytes"):
+        return int(obj.nbytes)
+    if isinstance(obj, (bytes, bytearray, memoryview)):
+        return len(obj)
+    return None
+
+
 class Mailbox:
     """Thread-safe matching queue of (src, ctx, tag, payload) messages."""
 
@@ -121,9 +132,15 @@ class Mailbox:
                 )
             return hit
 
-    def peek_nowait(self, source: int, ctx, tag: int) -> Optional[Tuple[int, int]]:
-        """Non-blocking, non-consuming scan: (src, tag) of the oldest match,
-        or None (MPI_Iprobe substrate — keeps FIFO intact)."""
+    def peek_nowait(
+        self, source: int, ctx, tag: int
+    ) -> Optional[Tuple[int, int, Optional[int]]]:
+        """Non-blocking, non-consuming scan: (src, tag, nbytes) of the
+        oldest match, or None (MPI_Iprobe substrate — keeps FIFO
+        intact).  ``nbytes`` is the queued payload's size when it is a
+        sized buffer (the message IS local at peek time, so the probe
+        can honor the probe+get_count+recv sizing idiom — ADVICE r4
+        #2), None for opaque objects."""
         with self._lock:
             hit = self._scan_locked(source, ctx, tag, False)
             if hit is None and self._closed:
@@ -131,14 +148,18 @@ class Mailbox:
                     f"transport closed while probing (source={source}, "
                     f"ctx={ctx}, tag={tag})"
                 )
-            return None if hit is None else (hit[1], hit[2])
+            return (None if hit is None
+                    else (hit[1], hit[2], payload_nbytes(hit[0])))
 
     def peek(self, source: int, ctx, tag: int,
-             timeout: Optional[float] = None) -> Tuple[int, int]:
+             timeout: Optional[float] = None
+             ) -> Tuple[int, int, Optional[int]]:
         """Like match() but WITHOUT consuming: block until a matching message
-        is queued and return its (src, tag) — MPI_Probe semantics."""
-        _, s, t = self._blocking_scan(source, ctx, tag, False, timeout, "probe")
-        return s, t
+        is queued and return its (src, tag, nbytes) — MPI_Probe
+        semantics (see peek_nowait for the count)."""
+        p, s, t = self._blocking_scan(source, ctx, tag, False, timeout,
+                                      "probe")
+        return s, t, payload_nbytes(p)
 
     def pending_summary(self) -> List[Tuple[int, int, int]]:
         with self._lock:
@@ -187,10 +208,13 @@ class Transport(ABC):
         return self.mailbox.poll(source, ctx, tag)
 
     def peek(self, source: int, ctx, tag: int,
-             timeout: Optional[float] = None) -> Tuple[int, int]:
+             timeout: Optional[float] = None
+             ) -> Tuple[int, int, Optional[int]]:
         return self.mailbox.peek(source, ctx, tag, timeout=timeout)
 
-    def peek_nowait(self, source: int, ctx, tag: int) -> Optional[Tuple[int, int]]:
+    def peek_nowait(
+        self, source: int, ctx, tag: int
+    ) -> Optional[Tuple[int, int, Optional[int]]]:
         return self.mailbox.peek_nowait(source, ctx, tag)
 
     def close(self) -> None:

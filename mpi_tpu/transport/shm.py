@@ -322,7 +322,8 @@ class ShmTransport(Transport):
                 hit = self.mailbox.poll(source, ctx, tag)
             else:
                 pk = self.mailbox.peek_nowait(source, ctx, tag)
-                hit = None if pk is None else (None, pk[0], pk[1])
+                # probe hits reuse the payload slot for the byte count
+                hit = None if pk is None else (pk[2], pk[0], pk[1])
             if hit is not None:
                 return hit
             if self._closing:
@@ -364,7 +365,7 @@ class ShmTransport(Transport):
                 else:
                     pk = self.mailbox.peek_nowait(source, ctx, tag)
                     if pk is not None:
-                        return None, pk[0], pk[1]
+                        return pk[2], pk[0], pk[1]
                 self._lib.shmdb_wait(self._db, seen, slice_s)
                 continue
 
@@ -384,10 +385,10 @@ class ShmTransport(Transport):
         return self.mailbox.poll(source, ctx, tag)
 
     def peek(self, source: int, ctx, tag: int,
-             timeout: Optional[float] = None) -> Tuple[int, int]:
-        _, s, t = self._blocking_match("probe", source, ctx, tag, timeout,
+             timeout: Optional[float] = None):
+        n, s, t = self._blocking_match("probe", source, ctx, tag, timeout,
                                        False)
-        return s, t
+        return s, t, n
 
     def peek_nowait(self, source: int, ctx, tag: int):
         if self._progress_lock.acquire(blocking=False):

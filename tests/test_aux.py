@@ -280,10 +280,12 @@ def test_comm_split_type_shared_spmd_by_host(monkeypatch):
         comm.split_type("numa")
 
 
-def test_probe_resets_stale_count(tmp_path):
-    """A Status reused after a recv must not leak that recv's
-    count_bytes through a probe (ADVICE r3 #1): probe sees only the
-    envelope — MPI_Get_count after it is MPI_UNDEFINED (None)."""
+def test_probe_reports_queued_count_not_stale(tmp_path):
+    """probe/iprobe set count_bytes to the QUEUED message's real size
+    (ADVICE r4 #2 — the canonical probe+get_count+recv buffer-sizing
+    idiom), overwriting any stale count from a prior recv on a reused
+    Status (the ADVICE r3 #1 leak stays fixed: the probed count is the
+    probed MESSAGE's, never the previous receive's)."""
     import numpy as np_
 
     import mpi_tpu
@@ -292,20 +294,27 @@ def test_probe_resets_stale_count(tmp_path):
         if comm.rank == 0:
             comm.send(np_.zeros(16, np_.float64), 1, tag=5)
             comm.send(np_.zeros(4, np_.float64), 1, tag=6)
+            comm.send({"opaque": True}, 1, tag=7)
             return True
         st = mpi_tpu.Status()
         comm.recv(0, tag=5, status=st)
         assert st.count_bytes == 128
         comm.probe(0, tag=6, status=st)
-        assert st.count_bytes is None  # envelope only, stale count cleared
+        # the queued tag-6 message's size — NOT the stale 128
+        assert st.count_bytes == 32
         assert st.tag == 6
         # iprobe path too
         st2 = mpi_tpu.Status()
         st2.count_bytes = 999
         assert comm.iprobe(0, tag=6, status=st2)
-        assert st2.count_bytes is None
+        assert st2.count_bytes == 32
+        # probe does not consume; recv agrees with the probed count
         comm.recv(0, tag=6, status=st)
         assert st.count_bytes == 32
+        # opaque payloads still probe as MPI_UNDEFINED (None)
+        comm.probe(0, tag=7, status=st)
+        assert st.count_bytes is None
+        comm.recv(0, tag=7)
         return True
 
     assert all(run_local(prog, 2))

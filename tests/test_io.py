@@ -400,6 +400,45 @@ def test_register_custom_datarep_roundtrip(tmp_path):
         del mio._DATAREPS["fix16"]
 
 
+def test_positional_datarep_gets_view_offsets(tmp_path):
+    """ADVICE r4 #3: a conversion callback declaring the optional
+    trailing ``position`` parameter receives the VIEW-relative etype
+    index of its batch's first element — correct through strided
+    filetype views (where file bytes are scattered) and through
+    seek-based and _all spellings (which compute offsets)."""
+    key = 7
+
+    def rd(raw, et, n, extra, position):
+        vals = np.frombuffer(raw, dtype=np.int32, count=n).copy()
+        return (vals - (np.arange(n) + position) * extra).astype(et)
+
+    def wr(arr, et, extra, position):
+        idx = np.arange(arr.size) + position
+        return (arr.astype(np.int32) + idx * extra).astype(
+            np.int32).tobytes()
+
+    mio.register_datarep("poskey", rd, wr, extra_state=key)
+    try:
+        path = str(tmp_path / "poskey.bin")
+        data = np.asarray([10, 20, 30, 40, 50, 60], np.int32)
+        with mio.file_open(_self(), path,
+                           mio.MODE_CREATE | mio.MODE_RDWR) as f:
+            ft = dt.type_vector(6, 1, 2, np.int32)  # every other element
+            f.set_view(etype=np.int32, filetype=ft, datarep="poskey")
+            assert f.write_at(0, data) == 6
+            # whole-view read and an OFFSET read both decode correctly
+            assert np.array_equal(f.read_at(0, 6), data)
+            assert np.array_equal(f.read_at(2, 3), data[2:5])
+            # seek-based path feeds the file pointer as the position
+            f.seek(4)
+            assert np.array_equal(f.read(2), data[4:6])
+        # on disk each element i is stored value + i*key at strided slots
+        raw = np.fromfile(path, dtype=np.int32)
+        assert np.array_equal(raw[::2] - np.arange(6) * key, data)
+    finally:
+        del mio._DATAREPS["poskey"]
+
+
 def test_datarep_errors(tmp_path):
     path = str(tmp_path / "err.bin")
     with mio.file_open(_self(), path, mio.MODE_CREATE | mio.MODE_RDWR) as f:
@@ -444,3 +483,30 @@ def test_datarep_through_flat_api_and_shared_pointer(tmp_path):
                               -np.arange(4, dtype=np.int32))
     finally:
         del mio._DATAREPS["negate"]
+
+
+def test_positional_datarep_keyword_only_spelling(tmp_path):
+    """The natural ``*, position=0`` keyword-only spelling is honored
+    too (review round 5: it must not silently convert with position 0
+    everywhere)."""
+    def rd(raw, et, n, extra, *, position=0):
+        vals = np.frombuffer(raw, dtype=np.int32, count=n).copy()
+        return (vals - (np.arange(n) + position)).astype(et)
+
+    def wr(arr, et, extra, *, position=0):
+        idx = np.arange(arr.size) + position
+        return (arr.astype(np.int32) + idx).astype(np.int32).tobytes()
+
+    mio.register_datarep("poskw", rd, wr)
+    try:
+        path = str(tmp_path / "poskw.bin")
+        data = np.asarray([100, 200, 300, 400], np.int32)
+        with mio.file_open(_self(), path,
+                           mio.MODE_CREATE | mio.MODE_RDWR) as f:
+            f.set_view(etype=np.int32, datarep="poskw")
+            f.write_at(0, data)
+            # an offset read only decodes right if position reached rd
+            assert np.array_equal(f.read_at(1, 3), data[1:4])
+            assert np.array_equal(f.read_at(0, 4), data)
+    finally:
+        del mio._DATAREPS["poskw"]

@@ -511,3 +511,43 @@ def test_isendrecv_replace_shape_mismatch_raises():
         return True
 
     assert all(run_local(prog, 2))
+
+
+def test_sequential_comm_create_from_group_isolated():
+    """ADVICE r4 #1: two SEQUENTIAL comm_create_from_group calls with
+    the same (group, stringtag) — legal in MPI-4; only concurrent
+    identical pairs are erroneous — must produce ISOLATED
+    communicators: a stale unmatched isend on the first comm must NOT
+    be received by the second.  The per-process generation counter
+    keyed by (world_ranks, stringtag) gives them distinct contexts
+    without any extra agreement traffic (creations with one key are
+    ordered collectives over the same members)."""
+    def prog(comm):
+        with mpi4.session_init(base_comm=comm) as sess:
+            grp = sess.group_from_pset("mpi://WORLD")
+            c1 = sess.comm_create_from_group(grp, "lib")
+            c2 = sess.comm_create_from_group(grp, "lib")
+            assert c1._ctx != c2._ctx  # distinct contexts...
+            # ...agreed across ranks (same generation on every member)
+            gens = c1._ctx[-1], c2._ctx[-1]
+            assert comm.allreduce(gens[0], op=mpi_tpu.ops.MAX) == gens[0]
+            assert comm.allreduce(gens[1], op=mpi_tpu.ops.MAX) == gens[1]
+            # stale traffic on c1 must not cross into c2
+            if comm.rank == 0:
+                c1.isend("stale-on-c1", 1, tag=3)
+                c2.send("fresh-on-c2", 1, tag=3)
+                comm.barrier()
+            else:
+                got = c2.recv(0, tag=3) if comm.rank == 1 else None
+                comm.barrier()
+                if comm.rank == 1:
+                    assert got == "fresh-on-c2"
+                    # the stale message is still on c1, where it belongs
+                    assert c1.iprobe(0, tag=3)
+                    assert c1.recv(0, tag=3) == "stale-on-c1"
+            # a DIFFERENT stringtag with the same group also isolates
+            c3 = sess.comm_create_from_group(grp, "other")
+            assert c3._ctx != c1._ctx and c3._ctx != c2._ctx
+            return True
+
+    assert all(run_local(prog, 2))

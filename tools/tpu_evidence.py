@@ -89,8 +89,14 @@ def run_real_tpu_tier() -> dict:
         # line — a bare findall over full stdout would also match test
         # output that happens to contain "N passed"
         if re.match(r"=+ .*(passed|failed|skipped|error).* =+$", line):
-            summary = {k: int(n) for n, k in re.findall(
-                r"(\d+) (passed|failed|skipped|errors?|warnings?)", line)}
+            # canonical singular keys: pytest pluralizes ("1 error" vs
+            # "2 errors"), which would make the artifact's schema vary
+            # run to run for downstream checkers (ADVICE r4 #4)
+            summary = {
+                {"errors": "error", "warnings": "warning"}.get(k, k):
+                int(n) for n, k in re.findall(
+                    r"(\d+) (passed|failed|skipped|errors?|warnings?)",
+                    line)}
     return {"ran": True, "returncode": r.returncode,
             "summary": summary, "tests": tests,
             "tail": r.stdout.strip().splitlines()[-3:]}
