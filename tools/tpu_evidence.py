@@ -17,12 +17,17 @@ What it records, in order of strength:
 3. **entry_on_chip**: if the tunnel is up, ``__graft_entry__.entry()``
    executed on the chip (platform recorded from the result's device).
 4. **cross_platform_export**: ALWAYS — ``jax.export`` of (a) the 1-D
-   pallas_ring kernel and (b) the FULL 2-D-mesh multichip step with the
-   dp ring on ``pallas_ring``, for the TPU target, from whatever host
-   this runs on.  jax.export executes the entire TPU lowering pipeline
-   (Mosaic included) with no chip attached — the strongest evidence a
-   wedged tunnel allows, and it runs even when the chip is healthy so
-   the artifact's shape is stable across states.
+   pallas_ring kernel, (b) the ring-attention kernel in both resident
+   and TILED fold modes (Sb=8192/device — a block no resident score
+   matrix could hold), (c) value_and_grad of the attention kernel
+   (BOTH ring kernels — the fused backward — in one lowered module,
+   no ppermute recompute), and (d) the FULL 2-D-mesh multichip step
+   with the dp ring on ``pallas_ring``, for the TPU target, from
+   whatever host this runs on.  jax.export executes the entire TPU
+   lowering pipeline (Mosaic included) with no chip attached — the
+   strongest evidence a wedged tunnel allows, and it runs even when
+   the chip is healthy so the artifact's shape is stable across
+   states.
 
 The artifact is honest about failure: a wedged tunnel yields
 ``tunnel.ok = false`` with the probe's timeout, and the chip-gated
@@ -159,6 +164,31 @@ def run_cross_platform_export() -> dict:
         "expa = jax.export.export(fa, platforms=['tpu'])(aa, aa, aa)\n"
         "res['pallas_ring_attention'] = {'platforms': list(expa.platforms),"
         " 'mosaic_kernel': 'tpu_custom_call' in expa.mlir_module()}\n"
+        "at = jax.ShapeDtypeStruct((8 * 8192, 128), jnp.float32)\n"
+        "expt = jax.export.export(fa, platforms=['tpu'])(at, at, at)\n"
+        "from mpi_tpu.tpu.pallas_attention import attention_vmem_plan\n"
+        "res['pallas_ring_attention_tiled'] = {\n"
+        "    'platforms': list(expt.platforms),\n"
+        "    'mosaic_kernel': 'tpu_custom_call' in expt.mlir_module(),\n"
+        "    'plan': attention_vmem_plan(8192, 128, 1, 1, jnp.float32),\n"
+        "    'note': 'Sb=8192/device: resident score would be 256MB; '\n"
+        "            'the tiled fold (HBM state, fori tiles) lowers'}\n"
+        "def loss(q, k, v):\n"
+        "    out = pallas_ring_attention(q, k, v, 'world', 8, causal=True,"
+        " interpret=False)\n"
+        "    return jax.lax.psum(jnp.sum(out ** 2), 'world')\n"
+        "fg = jax.jit(jax.shard_map(lambda q, k, v: jax.value_and_grad("
+        "loss, argnums=(0, 1, 2))(q, k, v), mesh=mesh,"
+        " in_specs=(P('world'),) * 3, out_specs=(P(), (P('world'),) * 3),"
+        " check_vma=False))\n"
+        "ab = jax.ShapeDtypeStruct((8 * 32, 128), jnp.float32)\n"
+        "expb = jax.export.export(fg, platforms=['tpu'])(ab, ab, ab)\n"
+        "res['pallas_attention_fused_backward'] = {\n"
+        "    'platforms': list(expb.platforms),\n"
+        "    'mosaic_kernels': expb.mlir_module().count('tpu_custom_call'),\n"
+        "    'ppermute_recompute_absent':"
+        " 'collective_permute' not in expb.mlir_module(),\n"
+        "    'note': 'value_and_grad lowers BOTH ring kernels (fwd+bwd)'}\n"
         "with warnings.catch_warnings():\n"
         "    warnings.simplefilter('ignore')\n"
         "    exp2 = ge.export_multichip_tpu(8)\n"
