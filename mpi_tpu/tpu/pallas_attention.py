@@ -65,10 +65,12 @@ dK/dV contribution into the circulating payload, and forwards; after P
 hops the accumulators land back home.  Fold-before-forward ordering
 (the payload is mutated before it moves on) with the same
 double-buffer + credit discipline — model-checked separately by
-``ring_model.AttentionBwdSim``.  When the backward's resident VMEM
-need exceeds the budget it falls back to recomputing through the
-pure-jax ppermute ring (the flash recompute strategy, correct at any
-size).
+``ring_model.AttentionBwdSim``.  The backward fold is VMEM-planned
+like the forward: resident, or flash-tiled (dQ accumulating in its
+HBM output; a K/V-tile outer loop carries dK/dV accumulators over a
+Q-tile inner loop), so long-context training stays on the fused
+kernels; only an impossible budget falls back to recomputing through
+the pure-jax ppermute ring (correct at any size).
 
 Under the interpreter (CPU tier) RDMAs run serially (start+wait, no
 credits/barriers) — same data path, no overlap; under vma typing or a
@@ -213,11 +215,13 @@ def attention_vmem_plan(sb: int, d: int, hq: int, hkv: int, dtype,
                         for_backward: bool = False):
     """Choose the fold execution mode from a VMEM budget (trace time).
 
-    Returns ``("resident", None)`` when the whole-block fold fits,
+    Returns ``("resident", None)`` when the whole-block fold fits, or
     ``("tiled", (tq, tk))`` with the largest sublane-aligned divisor
-    tile that fits, or — backward only, which has no tiled mode —
-    ``("fallback", None)`` (→ ppermute recompute).  Raises
-    NotImplementedError with the arithmetic when nothing fits.
+    tile that fits — for the forward AND (round 5) the backward.  A
+    backward no tile can satisfy returns ``("fallback", None)`` (→
+    ppermute recompute, correct at any size); the forward instead
+    raises NotImplementedError with the arithmetic, since it has no
+    correct fallback to offer.
 
     The estimates are deliberately generous (temporaries counted at
     f32, a spare plane for Mosaic's fusions) so a "resident" or
@@ -236,8 +240,21 @@ def attention_vmem_plan(sb: int, d: int, hq: int, hkv: int, dtype,
                     + hq * sb * d * 4          # dQ accumulator
                     + 4 * sb * sb * 4          # s/p/dp/ds temporaries
                     + 2 * sb * d * 4)          # fold temporaries
-        return ("resident", None) if resident <= limit \
-            else ("fallback", None)
+        if resident <= limit:
+            return ("resident", None)
+        for mdiv in _divisors_desc(sb // sub):
+            t = sub * mdiv
+            tiled = (2 * t * d * esz           # q/do tiles
+                     + 2 * t * _LANES * 4      # lse/delta tiles
+                     + 2 * t * d * 4           # k/v tiles (f32)
+                     + t * d * 4               # dk/dv store buffer
+                     + t * d * 4               # dq tile
+                     + 2 * t * d * 4           # dk/dv loop carries
+                     + 4 * t * t * 4           # s/p/dp/ds temporaries
+                     + 2 * t * d * 4)          # fold temporaries
+            if tiled <= limit:
+                return ("tiled", (t, t))
+        return ("fallback", None)  # recompute always works
     resident = (hq * sb * d * esz              # Q
                 + 2 * hkv * sb * d * esz       # K/V staging
                 + 2 * hq * sb * _LANES * 4     # m, l ([.., 1] buffers are
@@ -511,12 +528,11 @@ def _kernel(params_smem, q_hbm, kv_hbm, *refs,
 
 
 def _bwd_kernel(params_smem, q_hbm, kv32_hbm, do_hbm, lse_hbm, delta_hbm,
-                dq_hbm, dkv_hbm, own_hbm, comm_hbm, q_vmem, do_vmem,
-                lse_vmem, delta_vmem, kv_vmem, dkv_vmem, dq_vmem,
-                copy_sem, send_sem, recv_sem, credit_sem, *,
+                dq_hbm, dkv_hbm, own_hbm, comm_hbm, *refs,
                 axis_name: str, size: int, sb: int, d: int, scale: float,
                 pipelined: bool, mesh_ids: bool, causal: bool,
-                hq: int, hkv: int):
+                hq: int, hkv: int,
+                tiles: Optional[Tuple[int, int]] = None):
     """Fused ring-attention backward: [K, V, dK, dV] circulate (f32,
     one RDMA per hop) for a FULL cycle of P sends; dQ accumulates
     locally; dK/dV accumulate in the payload and land home at arrival
@@ -534,7 +550,23 @@ def _bwd_kernel(params_smem, q_hbm, kv32_hbm, do_hbm, lse_hbm, delta_hbm,
     saved logsumexp — no rescaling pass), dP = dO·Vᵀ,
     dS = P_∘(dP - D)·scale with D = rowsum(dO∘Out) precomputed,
     dQ += dS·K, dK += dSᵀ·Q, dV = P_ᵀ·dO.  bf16 inputs circulate f32
-    (2× wire bytes; the MXU folds are f32 regardless)."""
+    (2× wire bytes; the MXU folds are f32 regardless).
+
+    ``tiles=None`` → resident fold (everything staged whole in VMEM);
+    ``tiles=(tq, tk)`` → flash-style tiling (round 5: the fused
+    backward must not fall off to the ppermute recompute exactly where
+    long contexts need it): dQ accumulates in its HBM output, each
+    arrival loops K/V-tiles (outer, dK/dV tile carried as values) over
+    Q-tiles (inner, residuals + dQ staged per tile) — the circulation
+    protocol is byte-identical in both modes."""
+    if tiles is None:
+        (q_vmem, do_vmem, lse_vmem, delta_vmem, kv_vmem, dkv_vmem,
+         dq_vmem, copy_sem, send_sem, recv_sem, credit_sem) = refs
+    else:
+        (qt_vmem, dot_vmem, lset_vmem, deltat_vmem, kt_vmem, vt_vmem,
+         accb_vmem, dqt_vmem, copy_sem, send_sem, recv_sem,
+         credit_sem) = refs
+        tq, tk = tiles
     left = params_smem[0]
     right = params_smem[1]
     my = params_smem[2]
@@ -582,21 +614,111 @@ def _bwd_kernel(params_smem, q_hbm, kv32_hbm, do_hbm, lse_hbm, delta_hbm,
             dkv_vmem[vrows, :] = dkv_vmem[vrows, :] + jnp.dot(
                 p.T, doh, preferred_element_type=jnp.float32)
 
-    # stage the rank-local residuals once
-    copy_sync(q_hbm, q_vmem)
-    copy_sync(do_hbm, do_vmem)
-    copy_sync(lse_hbm, lse_vmem)
-    copy_sync(delta_hbm, delta_vmem)
-    dq_vmem[:] = jnp.zeros((hq * sb, d), jnp.float32)
+    def pair_grads_tiled(kv_idx, kv_at, dkv_at, init_zero, masked):
+        """Flash-tiled pair gradients: dK/dV tiles ride the inner-loop
+        carry (loaded from — or, ``init_zero``, started at zero in —
+        the dK/dV planes addressed by ``dkv_at(row0, n)``), residuals
+        and the dQ accumulator stage per Q-tile straight from/to their
+        HBM refs (dQ lives in its OUTPUT ref between arrivals).
+        ``kv_at(row0, n)`` addresses the arrived K/V planes.  The
+        protocol sees the exact same consume window as the resident
+        fold."""
+        nq, nk = sb // tq, sb // tk
+        for h in range(hq):
+            kvh = h // g
+
+            # zero the dK/dV tiles only for the FIRST query head of
+            # each K/V group: later heads of the group must accumulate
+            # into (not overwrite) what earlier heads stored — review
+            # round 5 caught the per-head re-zeroing dropping all but
+            # the last head's own-block contribution under GQA
+            zero_here = init_zero and (h % g == 0)
+
+            def j_body(j, _, h=h, kvh=kvh, zero_here=zero_here):
+                kr = kvh * sb + j * tk
+                copy_sync(kv_at(kr, tk), kt_vmem)
+                copy_sync(kv_at(hkv * sb + kr, tk), vt_vmem)
+                if zero_here:
+                    dk0 = jnp.zeros((tk, d), jnp.float32)
+                    dv0 = jnp.zeros((tk, d), jnp.float32)
+                else:
+                    copy_sync(dkv_at(kr, tk), accb_vmem)
+                    dk0 = accb_vmem[:]
+                    copy_sync(dkv_at(hkv * sb + kr, tk), accb_vmem)
+                    dv0 = accb_vmem[:]
+
+                def i_body(i, carry, h=h):
+                    dk, dv = carry
+                    r0 = h * sb + i * tq
+                    copy_sync(q_hbm.at[pl.ds(r0, tq)], qt_vmem)
+                    copy_sync(do_hbm.at[pl.ds(r0, tq)], dot_vmem)
+                    copy_sync(lse_hbm.at[pl.ds(r0, tq)], lset_vmem)
+                    copy_sync(delta_hbm.at[pl.ds(r0, tq)], deltat_vmem)
+                    copy_sync(dq_hbm.at[pl.ds(r0, tq)], dqt_vmem)
+                    qh = qt_vmem[:].astype(jnp.float32)
+                    doh = dot_vmem[:].astype(jnp.float32)
+                    s = jnp.dot(qh, kt_vmem[:].T,
+                                preferred_element_type=jnp.float32) * scale
+                    p = jnp.exp(s - lset_vmem[:, :1])
+                    if masked:
+                        p = jnp.where(
+                            _causal_mask(my, kv_idx, sb, i * tq, j * tk,
+                                         tq, tk), p, 0.0)
+                    dp = jnp.dot(doh, vt_vmem[:].T,
+                                 preferred_element_type=jnp.float32)
+                    ds_ = p * (dp - deltat_vmem[:, :1]) * scale
+                    dqt_vmem[:] = dqt_vmem[:] + jnp.dot(
+                        ds_, kt_vmem[:],
+                        preferred_element_type=jnp.float32)
+                    copy_sync(dqt_vmem, dq_hbm.at[pl.ds(r0, tq)])
+                    return (dk + jnp.dot(ds_.T, qh,
+                                         preferred_element_type=jnp.float32),
+                            dv + jnp.dot(p.T, doh,
+                                         preferred_element_type=jnp.float32))
+
+                # on the DIAGONAL block, q-tiles strictly above this
+                # k-tile are fully masked — skip them (mirrors the
+                # forward's diagonal tile-skip)
+                i_lo = (j * tk) // tq if masked else 0
+                dk, dv = lax.fori_loop(i_lo, nq, i_body, (dk0, dv0))
+                accb_vmem[:] = dk
+                copy_sync(accb_vmem, dkv_at(kr, tk))
+                accb_vmem[:] = dv
+                copy_sync(accb_vmem, dkv_at(hkv * sb + kr, tk))
+                return 0
+
+            lax.fori_loop(0, nk, j_body, 0)
+
+    if tiles is None:
+        # stage the rank-local residuals once
+        copy_sync(q_hbm, q_vmem)
+        copy_sync(do_hbm, do_vmem)
+        copy_sync(lse_hbm, lse_vmem)
+        copy_sync(delta_hbm, delta_vmem)
+        dq_vmem[:] = jnp.zeros((hq * sb, d), jnp.float32)
+    else:
+        # dQ accumulates in its output ref: zero it tile by tile
+        def zq_body(i, _):
+            dqt_vmem[:] = jnp.zeros((tq, d), jnp.float32)
+            copy_sync(dqt_vmem, dq_hbm.at[pl.ds(i * tq, tq)])
+            return 0
+
+        lax.fori_loop(0, (hq * sb) // tq, zq_body, 0)
 
     # fold 0 (own block) and assemble the circulating payload: K/V
     # planes straight from the input (already f32), dK/dV planes = my
     # own contribution (every other rank's accumulates en route)
     copy_sync(kv32_hbm, own_hbm.at[pl.ds(0, kv_rows)])
-    copy_sync(kv32_hbm, kv_vmem)
-    dkv_vmem[:] = jnp.zeros((kv_rows, d), jnp.float32)
-    pair_grads(my, masked=causal)  # a=0 is the diagonal block
-    copy_sync(dkv_vmem, own_hbm.at[pl.ds(kv_rows, kv_rows)])
+    if tiles is None:
+        copy_sync(kv32_hbm, kv_vmem)
+        dkv_vmem[:] = jnp.zeros((kv_rows, d), jnp.float32)
+        pair_grads(my, masked=causal)  # a=0 is the diagonal block
+        copy_sync(dkv_vmem, own_hbm.at[pl.ds(kv_rows, kv_rows)])
+    else:
+        pair_grads_tiled(
+            my, kv_at=lambda r0, n: kv32_hbm.at[pl.ds(r0, n)],
+            dkv_at=lambda r0, n: own_hbm.at[pl.ds(kv_rows + r0, n)],
+            init_zero=True, masked=causal)
 
     neighbor_barrier()
 
@@ -612,13 +734,23 @@ def _bwd_kernel(params_smem, q_hbm, kv32_hbm, do_hbm, lse_hbm, delta_hbm,
         if a < P:
             # fold BEFORE forward: the dK/dV planes must carry my
             # contribution when the block moves on
-            def consume(kv_idx, masked):
-                copy_sync(comm_hbm.at[slot, pl.ds(0, kv_rows)], kv_vmem)
-                copy_sync(comm_hbm.at[slot, pl.ds(kv_rows, kv_rows)],
-                          dkv_vmem)
-                pair_grads(kv_idx, masked)
-                copy_sync(dkv_vmem,
-                          comm_hbm.at[slot, pl.ds(kv_rows, kv_rows)])
+            def consume(kv_idx, masked, slot=slot):
+                if tiles is None:
+                    copy_sync(comm_hbm.at[slot, pl.ds(0, kv_rows)],
+                              kv_vmem)
+                    copy_sync(comm_hbm.at[slot, pl.ds(kv_rows, kv_rows)],
+                              dkv_vmem)
+                    pair_grads(kv_idx, masked)
+                    copy_sync(dkv_vmem,
+                              comm_hbm.at[slot, pl.ds(kv_rows, kv_rows)])
+                else:
+                    pair_grads_tiled(
+                        kv_idx,
+                        kv_at=lambda r0, n: comm_hbm.at[
+                            slot, pl.ds(r0, n)],
+                        dkv_at=lambda r0, n: comm_hbm.at[
+                            slot, pl.ds(kv_rows + r0, n)],
+                        init_zero=False, masked=masked)
 
             if causal:
                 # the diagonal block is always arrival 0 (kv_idx == my
@@ -653,7 +785,8 @@ def _bwd_kernel(params_smem, q_hbm, kv32_hbm, do_hbm, lse_hbm, delta_hbm,
                 snd(a - 1).wait_send()
             copy_sync(comm_hbm.at[slot, pl.ds(kv_rows, kv_rows)], dkv_hbm)
 
-    copy_sync(dq_vmem, dq_hbm)
+    if tiles is None:
+        copy_sync(dq_vmem, dq_hbm)  # tiled mode accumulated in place
     neighbor_barrier()
 
 
@@ -730,8 +863,8 @@ def pallas_ring_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
 
     Differentiable: the forward emits the logsumexp residual and the
     backward runs its own fused ring kernel ([K,V,dK,dV] circulation)
-    when its resident VMEM plan fits, else recomputes through the
-    pure-jax ring (flash recompute)."""
+    in resident or flash-tiled mode per its VMEM plan; only an
+    impossible budget recomputes through the pure-jax ring."""
     if q.ndim not in (2, 3):
         raise ValueError(
             f"ring attention wants [Sb, dh] or [H, Sb, dh] blocks, got "
@@ -772,9 +905,9 @@ def pallas_ring_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     # fold mode from the VMEM budget (raises when nothing fits)
     _, tiles = attention_vmem_plan(sb, d, hq, hkv, q.dtype,
                                    vmem_limit_bytes)
-    bwd_resident = attention_vmem_plan(
-        sb, d, hq, hkv, q.dtype, vmem_limit_bytes,
-        for_backward=True)[0] == "resident"
+    bwd_mode, bwd_tiles = attention_vmem_plan(
+        sb, d, hq, hkv, q.dtype, vmem_limit_bytes, for_backward=True)
+    bwd_fused = bwd_mode in ("resident", "tiled")
 
     def _per_head(fn, q_, k_, v_):
         """Apply a [Sb,dh]-block function per query head (GQA maps
@@ -889,7 +1022,8 @@ def pallas_ring_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
         return (out, res[1]) if with_lse else out
 
     def _bwd_kernel_call(q_, k_, v_, out, lse, ct):
-        """Fused backward (resident mode): → (dq, dk, dv) like q/k/v."""
+        """Fused backward (resident or tiled mode): → (dq, dk, dv)
+        like q/k/v."""
         qf = q_.reshape(hq * sb, d) if multihead else q_
         kf = k_.reshape(hkv * sb, d) if multihead else k_
         vf = v_.reshape(hkv * sb, d) if multihead else v_
@@ -903,20 +1037,37 @@ def pallas_ring_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
         kern = functools.partial(
             _bwd_kernel, axis_name=axis_name, size=size, sb=sb, d=d,
             scale=scale, pipelined=not interpret, mesh_ids=multi_axis,
-            causal=causal, hq=hq, hkv=hkv)
+            causal=causal, hq=hq, hkv=hkv, tiles=bwd_tiles)
         compiler_params = None if interpret else pltpu.CompilerParams(
             collective_id=17, has_side_effects=True)
         kv_rows = 2 * hkv * sb
         scratch = [
             pl.ANY((kv_rows * 2, d), jnp.float32),       # own [K,V,dK,dV]
             pl.ANY((2, kv_rows * 2, d), jnp.float32),    # landing slots
-            pltpu.VMEM((hq * sb, d), q.dtype),           # Q
-            pltpu.VMEM((hq * sb, d), q.dtype),           # dOut
-            pltpu.VMEM((hq * sb, _LANES), jnp.float32),  # lse
-            pltpu.VMEM((hq * sb, _LANES), jnp.float32),  # delta
-            pltpu.VMEM((kv_rows, d), jnp.float32),       # K/V staging
-            pltpu.VMEM((kv_rows, d), jnp.float32),       # dK/dV staging
-            pltpu.VMEM((hq * sb, d), jnp.float32),       # dQ accumulator
+        ]
+        if bwd_tiles is None:
+            scratch += [
+                pltpu.VMEM((hq * sb, d), q.dtype),           # Q
+                pltpu.VMEM((hq * sb, d), q.dtype),           # dOut
+                pltpu.VMEM((hq * sb, _LANES), jnp.float32),  # lse
+                pltpu.VMEM((hq * sb, _LANES), jnp.float32),  # delta
+                pltpu.VMEM((kv_rows, d), jnp.float32),       # K/V staging
+                pltpu.VMEM((kv_rows, d), jnp.float32),       # dK/dV staging
+                pltpu.VMEM((hq * sb, d), jnp.float32),       # dQ accum
+            ]
+        else:
+            tqb, tkb = bwd_tiles
+            scratch += [
+                pltpu.VMEM((tqb, d), q.dtype),               # q tile
+                pltpu.VMEM((tqb, d), q.dtype),               # dOut tile
+                pltpu.VMEM((tqb, _LANES), jnp.float32),      # lse tile
+                pltpu.VMEM((tqb, _LANES), jnp.float32),      # delta tile
+                pltpu.VMEM((tkb, d), jnp.float32),           # k tile
+                pltpu.VMEM((tkb, d), jnp.float32),           # v tile
+                pltpu.VMEM((tkb, d), jnp.float32),           # dk/dv buffer
+                pltpu.VMEM((tqb, d), jnp.float32),           # dq tile
+            ]
+        scratch += [
             pltpu.SemaphoreType.DMA(()),
             pltpu.SemaphoreType.DMA((2,)),               # send (parity)
             pltpu.SemaphoreType.DMA((2,)),               # recv (parity)
@@ -946,14 +1097,15 @@ def pallas_ring_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
 
     # Differentiable wrapper: jax cannot autodiff through the kernel's
     # remote DMAs, so the backward is either the fused [K,V,dK,dV]
-    # ring kernel above (resident plan) or a recompute through the
-    # pure-jax ring (out-of-budget fallback; ppermutes transpose to
-    # the inverse rotation) — either way the fused kernel stays the
-    # forward hot path and training can jax.grad straight through it.
+    # ring kernel above (resident or tiled plan) or a recompute
+    # through the pure-jax ring (out-of-budget fallback; ppermutes
+    # transpose to the inverse rotation) — either way the fused kernel
+    # stays the forward hot path and training can jax.grad straight
+    # through it.
     attn = jax.custom_vjp(_primal)
 
     def _fwd(q_, k_, v_):
-        if not bwd_resident:
+        if not bwd_fused:
             # the recompute backward needs only the inputs — skip the
             # lse output and do not pin out/lse across fwd..bwd
             return _kernel_call(q_, k_, v_, with_lse=False), (q_, k_, v_)
@@ -961,7 +1113,7 @@ def pallas_ring_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
         return out, (q_, k_, v_, out, lse)
 
     def _bwd(res, ct):
-        if not bwd_resident:
+        if not bwd_fused:
             q_, k_, v_ = res
             _, vjp = jax.vjp(_reference, q_, k_, v_)
             return vjp(ct)
