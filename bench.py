@@ -244,6 +244,31 @@ for name, (fn, cv) in legs.items():
     except Exception as e:
         result[name + "_error"] = str(e)[:300]
 
+# training leg: value_and_grad through the fused forward AND the fused
+# ring backward (resident/tiled per the VMEM plan).  FLOPs: forward 2
+# matmuls (4*S^2*d) + backward 5 matmuls (s recompute, dP, dS*K,
+# dS^T*Q, P^T*dO = 10*S^2*d) = 14*S^2*d per call.
+try:
+    def train(qb):
+        def loss(qq, kk, vv):
+            out = pallas_ring_attention(qq, kk, vv, "world", P_,
+                                        interpret=interp)
+            return jax.lax.psum(jnp.sum(out ** 2), "world")
+        _, grads = jax.value_and_grad(loss, argnums=(0, 1, 2))(qb, qb, qb)
+        return grads[0] + grads[1] + grads[2]
+
+    # same vma discipline as the forward leg: typing ON wherever the
+    # compiled kernel runs, OFF only on the CPU sim (where vma+interp
+    # would swap in the ppermute fallback and measure the wrong code)
+    f = jax.jit(jax.shard_map(train, mesh=mesh, in_specs=P("world"),
+                              out_specs=P("world"), check_vma=not interp))
+    t = bench(f, q)
+    result["pallas_kernel_train"] = {{
+        "t_s": t, "gflops_per_s": 3.5 * flops / t / 1e9,
+        "flops_per_call": 3.5 * flops}}
+except Exception as e:
+    result["pallas_kernel_train_error"] = str(e)[:300]
+
 # plain dense attention on ONE device over the same global sequence —
 # the no-parallelism baseline the ring is beating.  The dense [S, S]
 # score matrix is the whole point of the comparison, so cap it at a
@@ -274,8 +299,8 @@ if platform == "tpu":
     for k, peak_tf in PEAKS_F32_TFLOPS.items():
         if kind.lower().startswith(k.lower()):
             result["mxu_peak_f32_tflops_per_chip"] = peak_tf
-            for leg in ("pallas_kernel", "ppermute_ring",
-                        "local_dense_1dev"):
+            for leg in ("pallas_kernel", "pallas_kernel_train",
+                        "ppermute_ring", "local_dense_1dev"):
                 if isinstance(result.get(leg), dict):
                     chips = 1 if leg == "local_dense_1dev" else P_
                     result[leg]["mfu_pct_f32"] = round(

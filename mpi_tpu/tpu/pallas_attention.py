@@ -193,6 +193,23 @@ def _mk_copy_sync(copy_sem):
     return copy_sync
 
 
+def _pair_grad_tile(qh, doh, lse1, delta1, kb, vb, scale, mask=None):
+    """ONE copy of the flash-backward algebra (review round 5: the
+    resident and tiled folds must not carry separate copies of it):
+    given f32 Q/dO rows, their lse/delta columns, and a K/V tile,
+    return (dq, dk, dv) contributions.  ``mask``: optional [rows, cols]
+    bool, True = attend (probabilities zeroed elsewhere)."""
+    s = jnp.dot(qh, kb.T, preferred_element_type=jnp.float32) * scale
+    p = jnp.exp(s - lse1)
+    if mask is not None:
+        p = jnp.where(mask, p, 0.0)
+    dp = jnp.dot(doh, vb.T, preferred_element_type=jnp.float32)
+    ds_ = p * (dp - delta1) * scale
+    return (jnp.dot(ds_, kb, preferred_element_type=jnp.float32),
+            jnp.dot(ds_.T, qh, preferred_element_type=jnp.float32),
+            jnp.dot(p.T, doh, preferred_element_type=jnp.float32))
+
+
 def _mk_snd(first_src, comm_hbm, send_sem, recv_sem, dev_kw, right):
     """Send-descriptor factory shared by both ring kernels: send ``u``
     forwards from ``first_src`` (u == 0: the block that never landed in
@@ -592,27 +609,18 @@ def _bwd_kernel(params_smem, q_hbm, kv32_hbm, do_hbm, lse_hbm, delta_hbm,
         for h in range(hq):
             kvh = h // g
             rows = pl.ds(h * sb, sb)
-            qh = q_vmem[rows, :].astype(jnp.float32)
-            doh = do_vmem[rows, :].astype(jnp.float32)
-            lseh = lse_vmem[rows, :][:, :1]
-            deltah = delta_vmem[rows, :][:, :1]
-            kb = kv_vmem[pl.ds(kvh * sb, sb), :]
-            vb = kv_vmem[pl.ds((hkv + kvh) * sb, sb), :]
-            s = jnp.dot(qh, kb.T,
-                        preferred_element_type=jnp.float32) * scale
-            p = jnp.exp(s - lseh)
-            if masked:
-                p = jnp.where(_causal_mask(my, kv_idx, sb), p, 0.0)
-            dp = jnp.dot(doh, vb.T, preferred_element_type=jnp.float32)
-            ds_ = p * (dp - deltah) * scale
-            dq_vmem[rows, :] = dq_vmem[rows, :] + jnp.dot(
-                ds_, kb, preferred_element_type=jnp.float32)
+            mask = _causal_mask(my, kv_idx, sb) if masked else None
+            dq_c, dk_c, dv_c = _pair_grad_tile(
+                q_vmem[rows, :].astype(jnp.float32),
+                do_vmem[rows, :].astype(jnp.float32),
+                lse_vmem[rows, :][:, :1], delta_vmem[rows, :][:, :1],
+                kv_vmem[pl.ds(kvh * sb, sb), :],
+                kv_vmem[pl.ds((hkv + kvh) * sb, sb), :], scale, mask)
+            dq_vmem[rows, :] = dq_vmem[rows, :] + dq_c
             krows = pl.ds(kvh * sb, sb)
             vrows = pl.ds((hkv + kvh) * sb, sb)
-            dkv_vmem[krows, :] = dkv_vmem[krows, :] + jnp.dot(
-                ds_.T, qh, preferred_element_type=jnp.float32)
-            dkv_vmem[vrows, :] = dkv_vmem[vrows, :] + jnp.dot(
-                p.T, doh, preferred_element_type=jnp.float32)
+            dkv_vmem[krows, :] = dkv_vmem[krows, :] + dk_c
+            dkv_vmem[vrows, :] = dkv_vmem[vrows, :] + dv_c
 
     def pair_grads_tiled(kv_idx, kv_at, dkv_at, init_zero, masked):
         """Flash-tiled pair gradients: dK/dV tiles ride the inner-loop
@@ -655,26 +663,16 @@ def _bwd_kernel(params_smem, q_hbm, kv32_hbm, do_hbm, lse_hbm, delta_hbm,
                     copy_sync(lse_hbm.at[pl.ds(r0, tq)], lset_vmem)
                     copy_sync(delta_hbm.at[pl.ds(r0, tq)], deltat_vmem)
                     copy_sync(dq_hbm.at[pl.ds(r0, tq)], dqt_vmem)
-                    qh = qt_vmem[:].astype(jnp.float32)
-                    doh = dot_vmem[:].astype(jnp.float32)
-                    s = jnp.dot(qh, kt_vmem[:].T,
-                                preferred_element_type=jnp.float32) * scale
-                    p = jnp.exp(s - lset_vmem[:, :1])
-                    if masked:
-                        p = jnp.where(
-                            _causal_mask(my, kv_idx, sb, i * tq, j * tk,
-                                         tq, tk), p, 0.0)
-                    dp = jnp.dot(doh, vt_vmem[:].T,
-                                 preferred_element_type=jnp.float32)
-                    ds_ = p * (dp - deltat_vmem[:, :1]) * scale
-                    dqt_vmem[:] = dqt_vmem[:] + jnp.dot(
-                        ds_, kt_vmem[:],
-                        preferred_element_type=jnp.float32)
+                    mask = (_causal_mask(my, kv_idx, sb, i * tq, j * tk,
+                                         tq, tk) if masked else None)
+                    dq_c, dk_c, dv_c = _pair_grad_tile(
+                        qt_vmem[:].astype(jnp.float32),
+                        dot_vmem[:].astype(jnp.float32),
+                        lset_vmem[:, :1], deltat_vmem[:, :1],
+                        kt_vmem[:], vt_vmem[:], scale, mask)
+                    dqt_vmem[:] = dqt_vmem[:] + dq_c
                     copy_sync(dqt_vmem, dq_hbm.at[pl.ds(r0, tq)])
-                    return (dk + jnp.dot(ds_.T, qh,
-                                         preferred_element_type=jnp.float32),
-                            dv + jnp.dot(p.T, doh,
-                                         preferred_element_type=jnp.float32))
+                    return dk + dk_c, dv + dv_c
 
                 # on the DIAGONAL block, q-tiles strictly above this
                 # k-tile are fully masked — skip them (mirrors the

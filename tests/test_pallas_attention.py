@@ -765,12 +765,14 @@ def test_bwd_tiled_export_tpu():
     assert "collective_permute" not in exp.mlir_module()
 
 
-@pytest.mark.parametrize("Hq,Hkv", [(2, 1), (4, 2)])
-def test_bwd_tiled_parity_gqa(Hq, Hkv):
+@pytest.mark.parametrize("Hq,Hkv,causal", [(2, 1, False), (4, 2, False),
+                                           (2, 1, True), (4, 2, True)])
+def test_bwd_tiled_parity_gqa(Hq, Hkv, causal):
     """GQA through the TILED fused backward: dK/dV tiles must
     ACCUMULATE across the query heads of one K/V group (review round
     5: per-head re-zeroing dropped all but the last head's own-block
-    contribution)."""
+    contribution) — including under causal masking, where the diagonal
+    i_lo tile-skip interacts with the per-group zeroing."""
     from mpi_tpu.tpu.pallas_attention import (_fallback_attention,
                                               attention_vmem_plan)
 
@@ -789,13 +791,13 @@ def test_bwd_tiled_parity_gqa(Hq, Hkv):
 
     def loss_kernel(qb, kb, vb, ctb):
         out = pallas_ring_attention(qb, kb, vb, "world", Pn,
-                                    interpret=True,
+                                    causal=causal, interpret=True,
                                     vmem_limit_bytes=limit)
         return jnp.sum(out * ctb)
 
     def loss_ref(qb, kb, vb, ctb):
         out = _fallback_attention(qb, kb, vb, "world", Pn,
-                                  1.0 / np.sqrt(d))
+                                  1.0 / np.sqrt(d), causal)
         return jnp.sum(out * ctb)
 
     grads = {}

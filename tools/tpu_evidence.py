@@ -189,6 +189,17 @@ def run_cross_platform_export() -> dict:
         "    'ppermute_recompute_absent':"
         " 'collective_permute' not in expb.mlir_module(),\n"
         "    'note': 'value_and_grad lowers BOTH ring kernels (fwd+bwd)'}\n"
+        "abt = jax.ShapeDtypeStruct((8 * 2048, 128), jnp.float32)\n"
+        "expbt = jax.export.export(fg, platforms=['tpu'])(abt, abt, abt)\n"
+        "res['pallas_attention_fused_backward_tiled'] = {\n"
+        "    'platforms': list(expbt.platforms),\n"
+        "    'mosaic_kernels': expbt.mlir_module().count('tpu_custom_call'),\n"
+        "    'ppermute_recompute_absent':"
+        " 'collective_permute' not in expbt.mlir_module(),\n"
+        "    'bwd_plan': attention_vmem_plan(2048, 128, 1, 1,"
+        " jnp.float32, for_backward=True),\n"
+        "    'note': 'Sb=2048/device: the TILED fused backward lowers "
+        "(resident temporaries would be 64MB)'}\n"
         "with warnings.catch_warnings():\n"
         "    warnings.simplefilter('ignore')\n"
         "    exp2 = ge.export_multichip_tpu(8)\n"
