@@ -224,50 +224,40 @@ def bench(f, x):
         ts.append(time.perf_counter() - t0)
     return statistics.median(ts)
 
-# the kernel leg runs check_vma=False on the CPU sim so the INTERPRETED
+# pallas legs run check_vma=False on the CPU sim so the INTERPRETED
 # KERNEL (serial data path) is measured, not the ppermute fallback —
-# same reasoning as the northstar pallas legs; compiled kernel on chips
+# same reasoning as the northstar pallas legs; compiled kernel (vma
+# typing ON) on chips.  The train leg is value_and_grad through the
+# fused forward AND the fused ring backward (resident/tiled per the
+# VMEM plan); its FLOPs factor: forward 2 matmuls (4*S^2*d) + backward
+# 5 matmuls (s recompute, dP, dS*K, dS^T*Q, P^T*dO = 10*S^2*d) -> 3.5x.
+def train(qb):
+    def loss(qq, kk, vv):
+        out = pallas_ring_attention(qq, kk, vv, "world", P_,
+                                    interpret=interp)
+        return jax.lax.psum(jnp.sum(out ** 2), "world")
+    _, grads = jax.value_and_grad(loss, argnums=(0, 1, 2))(qb, qb, qb)
+    return grads[0] + grads[1] + grads[2]
+
 legs = {{
     "pallas_kernel": (
         lambda qb: pallas_ring_attention(qb, qb, qb, "world", P_,
-                                         interpret=interp), not interp),
+                                         interpret=interp),
+        not interp, 1.0),
+    "pallas_kernel_train": (train, not interp, 3.5),
     "ppermute_ring": (
         lambda qb: _fallback_attention(qb, qb, qb, "world", P_,
-                                       1.0 / d ** 0.5), True),
+                                       1.0 / d ** 0.5), True, 1.0),
 }}
-for name, (fn, cv) in legs.items():
+for name, (fn, cv, ff) in legs.items():
     try:
         f = jax.jit(jax.shard_map(fn, mesh=mesh, in_specs=P("world"),
                                   out_specs=P("world"), check_vma=cv))
         t = bench(f, q)
-        result[name] = {{"t_s": t, "gflops_per_s": flops / t / 1e9}}
+        result[name] = {{"t_s": t, "gflops_per_s": ff * flops / t / 1e9,
+                         "flops_per_call": ff * flops}}
     except Exception as e:
         result[name + "_error"] = str(e)[:300]
-
-# training leg: value_and_grad through the fused forward AND the fused
-# ring backward (resident/tiled per the VMEM plan).  FLOPs: forward 2
-# matmuls (4*S^2*d) + backward 5 matmuls (s recompute, dP, dS*K,
-# dS^T*Q, P^T*dO = 10*S^2*d) = 14*S^2*d per call.
-try:
-    def train(qb):
-        def loss(qq, kk, vv):
-            out = pallas_ring_attention(qq, kk, vv, "world", P_,
-                                        interpret=interp)
-            return jax.lax.psum(jnp.sum(out ** 2), "world")
-        _, grads = jax.value_and_grad(loss, argnums=(0, 1, 2))(qb, qb, qb)
-        return grads[0] + grads[1] + grads[2]
-
-    # same vma discipline as the forward leg: typing ON wherever the
-    # compiled kernel runs, OFF only on the CPU sim (where vma+interp
-    # would swap in the ppermute fallback and measure the wrong code)
-    f = jax.jit(jax.shard_map(train, mesh=mesh, in_specs=P("world"),
-                              out_specs=P("world"), check_vma=not interp))
-    t = bench(f, q)
-    result["pallas_kernel_train"] = {{
-        "t_s": t, "gflops_per_s": 3.5 * flops / t / 1e9,
-        "flops_per_call": 3.5 * flops}}
-except Exception as e:
-    result["pallas_kernel_train_error"] = str(e)[:300]
 
 # plain dense attention on ONE device over the same global sequence —
 # the no-parallelism baseline the ring is beating.  The dense [S, S]
