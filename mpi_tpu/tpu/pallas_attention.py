@@ -193,6 +193,23 @@ def _mk_copy_sync(copy_sem):
     return copy_sync
 
 
+def _mk_copy_par(par_sems):
+    """Start INDEPENDENT local DMAs together, then wait them all — the
+    tiled folds stage several disjoint tiles per step (k+v; the m/l/o
+    state; the backward's five residuals) and serializing them exposes
+    every transfer's full HBM latency on chip (round 5).  Each copy
+    gets its own semaphore by POSITION (all indices Python-static)."""
+    def copy_par(*pairs):
+        cps = [pltpu.make_async_copy(src, dst, par_sems.at[i])
+               for i, (src, dst) in enumerate(pairs)]
+        for cp in cps:
+            cp.start()
+        for cp in cps:
+            cp.wait()
+
+    return copy_par
+
+
 def _pair_grad_tile(qh, doh, lse1, delta1, kb, vb, scale, mask=None):
     """ONE copy of the flash-backward algebra (review round 5: the
     resident and tiled folds must not carry separate copies of it):
@@ -264,7 +281,7 @@ def attention_vmem_plan(sb: int, d: int, hq: int, hkv: int, dtype,
             tiled = (2 * t * d * esz           # q/do tiles
                      + 2 * t * _LANES * 4      # lse/delta tiles
                      + 2 * t * d * 4           # k/v tiles (f32)
-                     + t * d * 4               # dk/dv store buffer
+                     + 2 * t * d * 4           # dk/dv staging buffers
                      + t * d * 4               # dq tile
                      + 2 * t * d * 4           # dk/dv loop carries
                      + 4 * t * t * 4           # s/p/dp/ds temporaries
@@ -334,14 +351,16 @@ def _kernel(params_smem, q_hbm, kv_hbm, *refs,
     if tiles is None:
         if with_lse:
             (comm_hbm, q_vmem, kv_vmem, m_vmem, l_vmem, o_vmem, lse_vmem,
-             copy_sem, send_sem, recv_sem, credit_sem) = refs
+             copy_sem, send_sem, recv_sem, credit_sem,
+             par_sems) = refs
         else:
             (comm_hbm, q_vmem, kv_vmem, m_vmem, l_vmem, o_vmem,
-             copy_sem, send_sem, recv_sem, credit_sem) = refs
+             copy_sem, send_sem, recv_sem, credit_sem,
+             par_sems) = refs
     else:
         (comm_hbm, m_hbm, l_hbm, o_hbm, qt_vmem, kt_vmem, vt_vmem,
          mt_vmem, lt_vmem, ot_vmem,
-         copy_sem, send_sem, recv_sem, credit_sem) = refs
+         copy_sem, send_sem, recv_sem, credit_sem, par_sems) = refs
         tq, tk = tiles
     left = params_smem[0]
     right = params_smem[1]
@@ -351,6 +370,7 @@ def _kernel(params_smem, q_hbm, kv_hbm, *refs,
     dev_kw = _mk_dev_kw(mesh_ids, axis_name)
     neighbor_barrier = _mk_barrier(pipelined, dev_kw, left, right)
     copy_sync = _mk_copy_sync(copy_sem)
+    copy_par = _mk_copy_par(par_sems)
     # send u (0..P-2): the block computed at step u moves on
     fwd_rdma = _mk_snd(kv_hbm, comm_hbm, send_sem, recv_sem, dev_kw, right)
 
@@ -405,25 +425,28 @@ def _kernel(params_smem, q_hbm, kv_hbm, *refs,
 
                 def q_body(i, _, h=h, kvh=kvh, base=base):
                     r0 = base + i * tq
-                    copy_sync(q_hbm.at[pl.ds(r0, tq)], qt_vmem)
                     if a == 0:
+                        copy_sync(q_hbm.at[pl.ds(r0, tq)], qt_vmem)
                         m0 = jnp.full((tq, 1), -jnp.inf, jnp.float32)
                         l0 = jnp.zeros((tq, 1), jnp.float32)
                         o0 = jnp.zeros((tq, d), jnp.float32)
                     else:
-                        copy_sync(m_hbm.at[pl.ds(r0, tq)], mt_vmem)
-                        copy_sync(l_hbm.at[pl.ds(r0, tq)], lt_vmem)
-                        copy_sync(o_hbm.at[pl.ds(r0, tq)], ot_vmem)
+                        # the q tile rides the same parallel batch as
+                        # the state tiles (review round 5)
+                        copy_par((q_hbm.at[pl.ds(r0, tq)], qt_vmem),
+                                 (m_hbm.at[pl.ds(r0, tq)], mt_vmem),
+                                 (l_hbm.at[pl.ds(r0, tq)], lt_vmem),
+                                 (o_hbm.at[pl.ds(r0, tq)], ot_vmem))
                         m0 = mt_vmem[:, :1]
                         l0 = lt_vmem[:, :1]
                         o0 = ot_vmem[:]
 
                     def k_body(j, carry):
                         m, l, o = carry
-                        copy_sync(src.at[pl.ds(kvh * sb + j * tk, tk)],
-                                  kt_vmem)
-                        copy_sync(src.at[pl.ds((hkv + kvh) * sb + j * tk,
-                                               tk)], vt_vmem)
+                        copy_par((src.at[pl.ds(kvh * sb + j * tk, tk)],
+                                  kt_vmem),
+                                 (src.at[pl.ds((hkv + kvh) * sb + j * tk,
+                                               tk)], vt_vmem))
                         mask = None
                         if causal:
                             mask = _causal_mask(my, kv_idx, sb,
@@ -447,9 +470,9 @@ def _kernel(params_smem, q_hbm, kv_hbm, *refs,
                     mt_vmem[:] = jnp.broadcast_to(m, (tq, _LANES))
                     lt_vmem[:] = jnp.broadcast_to(l, (tq, _LANES))
                     ot_vmem[:] = o
-                    copy_sync(mt_vmem, m_hbm.at[pl.ds(r0, tq)])
-                    copy_sync(lt_vmem, l_hbm.at[pl.ds(r0, tq)])
-                    copy_sync(ot_vmem, o_hbm.at[pl.ds(r0, tq)])
+                    copy_par((mt_vmem, m_hbm.at[pl.ds(r0, tq)]),
+                             (lt_vmem, l_hbm.at[pl.ds(r0, tq)]),
+                             (ot_vmem, o_hbm.at[pl.ds(r0, tq)]))
                     return 0
 
                 lax.fori_loop(0, nq, q_body, 0)
@@ -523,15 +546,16 @@ def _kernel(params_smem, q_hbm, kv_hbm, *refs,
             lse_vmem[:] = jnp.broadcast_to(
                 m_vmem[:] + jnp.log(l_vmem[:]), (hq * sb, _LANES))
         o_vmem[:] = out
-        copy_sync(o_vmem, out_hbm)
         if with_lse:
-            copy_sync(lse_vmem, lse_hbm)
+            copy_par((o_vmem, out_hbm), (lse_vmem, lse_hbm))
+        else:
+            copy_sync(o_vmem, out_hbm)
     else:
         def out_body(i, _):
             r0 = i * tq
-            copy_sync(m_hbm.at[pl.ds(r0, tq)], mt_vmem)
-            copy_sync(l_hbm.at[pl.ds(r0, tq)], lt_vmem)
-            copy_sync(o_hbm.at[pl.ds(r0, tq)], ot_vmem)
+            copy_par((m_hbm.at[pl.ds(r0, tq)], mt_vmem),
+                     (l_hbm.at[pl.ds(r0, tq)], lt_vmem),
+                     (o_hbm.at[pl.ds(r0, tq)], ot_vmem))
             ot_vmem[:] = ot_vmem[:] / lt_vmem[:, :1]
             copy_sync(ot_vmem, out_hbm.at[pl.ds(r0, tq)])
             if with_lse:
@@ -578,11 +602,12 @@ def _bwd_kernel(params_smem, q_hbm, kv32_hbm, do_hbm, lse_hbm, delta_hbm,
     protocol is byte-identical in both modes."""
     if tiles is None:
         (q_vmem, do_vmem, lse_vmem, delta_vmem, kv_vmem, dkv_vmem,
-         dq_vmem, copy_sem, send_sem, recv_sem, credit_sem) = refs
+         dq_vmem, copy_sem, send_sem, recv_sem, credit_sem,
+         par_sems) = refs
     else:
         (qt_vmem, dot_vmem, lset_vmem, deltat_vmem, kt_vmem, vt_vmem,
-         accb_vmem, dqt_vmem, copy_sem, send_sem, recv_sem,
-         credit_sem) = refs
+         accb_vmem, accb2_vmem, dqt_vmem, copy_sem, send_sem, recv_sem,
+         credit_sem, par_sems) = refs
         tq, tk = tiles
     left = params_smem[0]
     right = params_smem[1]
@@ -593,6 +618,7 @@ def _bwd_kernel(params_smem, q_hbm, kv32_hbm, do_hbm, lse_hbm, delta_hbm,
     dev_kw = _mk_dev_kw(mesh_ids, axis_name)
     neighbor_barrier = _mk_barrier(pipelined, dev_kw, left, right)
     copy_sync = _mk_copy_sync(copy_sem)
+    copy_par = _mk_copy_par(par_sems)
 
     # send u (0..P-1): the block folded at step u moves on; send 0
     # reads the assembled own-block scratch, not a comm slot
@@ -644,25 +670,25 @@ def _bwd_kernel(params_smem, q_hbm, kv32_hbm, do_hbm, lse_hbm, delta_hbm,
 
             def j_body(j, _, h=h, kvh=kvh, zero_here=zero_here):
                 kr = kvh * sb + j * tk
-                copy_sync(kv_at(kr, tk), kt_vmem)
-                copy_sync(kv_at(hkv * sb + kr, tk), vt_vmem)
+                copy_par((kv_at(kr, tk), kt_vmem),
+                         (kv_at(hkv * sb + kr, tk), vt_vmem))
                 if zero_here:
                     dk0 = jnp.zeros((tk, d), jnp.float32)
                     dv0 = jnp.zeros((tk, d), jnp.float32)
                 else:
-                    copy_sync(dkv_at(kr, tk), accb_vmem)
+                    copy_par((dkv_at(kr, tk), accb_vmem),
+                             (dkv_at(hkv * sb + kr, tk), accb2_vmem))
                     dk0 = accb_vmem[:]
-                    copy_sync(dkv_at(hkv * sb + kr, tk), accb_vmem)
-                    dv0 = accb_vmem[:]
+                    dv0 = accb2_vmem[:]
 
                 def i_body(i, carry, h=h):
                     dk, dv = carry
                     r0 = h * sb + i * tq
-                    copy_sync(q_hbm.at[pl.ds(r0, tq)], qt_vmem)
-                    copy_sync(do_hbm.at[pl.ds(r0, tq)], dot_vmem)
-                    copy_sync(lse_hbm.at[pl.ds(r0, tq)], lset_vmem)
-                    copy_sync(delta_hbm.at[pl.ds(r0, tq)], deltat_vmem)
-                    copy_sync(dq_hbm.at[pl.ds(r0, tq)], dqt_vmem)
+                    copy_par((q_hbm.at[pl.ds(r0, tq)], qt_vmem),
+                             (do_hbm.at[pl.ds(r0, tq)], dot_vmem),
+                             (lse_hbm.at[pl.ds(r0, tq)], lset_vmem),
+                             (delta_hbm.at[pl.ds(r0, tq)], deltat_vmem),
+                             (dq_hbm.at[pl.ds(r0, tq)], dqt_vmem))
                     mask = (_causal_mask(my, kv_idx, sb, i * tq, j * tk,
                                          tq, tk) if masked else None)
                     dq_c, dk_c, dv_c = _pair_grad_tile(
@@ -680,19 +706,17 @@ def _bwd_kernel(params_smem, q_hbm, kv32_hbm, do_hbm, lse_hbm, delta_hbm,
                 i_lo = (j * tk) // tq if masked else 0
                 dk, dv = lax.fori_loop(i_lo, nq, i_body, (dk0, dv0))
                 accb_vmem[:] = dk
-                copy_sync(accb_vmem, dkv_at(kr, tk))
-                accb_vmem[:] = dv
-                copy_sync(accb_vmem, dkv_at(hkv * sb + kr, tk))
+                accb2_vmem[:] = dv
+                copy_par((accb_vmem, dkv_at(kr, tk)),
+                         (accb2_vmem, dkv_at(hkv * sb + kr, tk)))
                 return 0
 
             lax.fori_loop(0, nk, j_body, 0)
 
     if tiles is None:
-        # stage the rank-local residuals once
-        copy_sync(q_hbm, q_vmem)
-        copy_sync(do_hbm, do_vmem)
-        copy_sync(lse_hbm, lse_vmem)
-        copy_sync(delta_hbm, delta_vmem)
+        # stage the rank-local residuals once (independent → parallel)
+        copy_par((q_hbm, q_vmem), (do_hbm, do_vmem),
+                 (lse_hbm, lse_vmem), (delta_hbm, delta_vmem))
         dq_vmem[:] = jnp.zeros((hq * sb, d), jnp.float32)
     else:
         # dQ accumulates in its output ref: zero it tile by tile
@@ -999,6 +1023,7 @@ def pallas_ring_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
             pltpu.SemaphoreType.DMA((2,)),               # send (parity)
             pltpu.SemaphoreType.DMA((2,)),               # recv (parity)
             pltpu.SemaphoreType.REGULAR((2,)),           # slot credits
+            pltpu.SemaphoreType.DMA((8,)),               # parallel tiles
         ]
         shapes = [(hq * sb, d)]
         if with_lse:
@@ -1062,7 +1087,8 @@ def pallas_ring_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
                 pltpu.VMEM((tqb, _LANES), jnp.float32),      # delta tile
                 pltpu.VMEM((tkb, d), jnp.float32),           # k tile
                 pltpu.VMEM((tkb, d), jnp.float32),           # v tile
-                pltpu.VMEM((tkb, d), jnp.float32),           # dk/dv buffer
+                pltpu.VMEM((tkb, d), jnp.float32),           # dk buffer
+                pltpu.VMEM((tkb, d), jnp.float32),           # dv buffer
                 pltpu.VMEM((tqb, d), jnp.float32),           # dq tile
             ]
         scratch += [
@@ -1070,6 +1096,7 @@ def pallas_ring_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
             pltpu.SemaphoreType.DMA((2,)),               # send (parity)
             pltpu.SemaphoreType.DMA((2,)),               # recv (parity)
             pltpu.SemaphoreType.REGULAR((2,)),           # slot credits
+            pltpu.SemaphoreType.DMA((8,)),               # parallel tiles
         ]
         dq, dkv = pl.pallas_call(
             kern,
