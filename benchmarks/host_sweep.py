@@ -1,0 +1,155 @@
+#!/usr/bin/env python
+"""OSU-style host data-plane size sweep (1KB -> 64MB) over real rank
+processes — the artifact trail for the segmented collective engine.
+
+Runs the 2-rank allreduce sweep on BOTH host transports (socket, shm)
+with both hand-scheduled algorithms (ring, recursive_halving), plus the
+1KB latency legs that ground the shm-vs-socket small-message inversion
+diagnosis (VERDICT r5 weak #1 / next-round #7).  From the allreduce rows
+it re-derives the ring/halving crossover that backs the
+``allreduce_ring_crossover_bytes`` mpit cvar.
+
+Each (transport, band) combination is ONE launcher invocation of
+benchmarks/osu.py, so the measured program is exactly the shipping
+benchmark, not a private reimplementation.
+
+Usage::
+
+    python benchmarks/host_sweep.py --label pre  --out benchmarks/results/host_sweep_pre.json
+    python benchmarks/host_sweep.py --label post --out benchmarks/results/host_sweep_post.json
+    python bench.py --sweep        # the post-change spelling used by CI
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+import time
+from typing import Dict, List, Optional
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+# iters shrink as sizes grow: at 64MB one allreduce moves ~64MB per rank
+# per call, so a handful of samples already averages thousands of ring
+# segments; at 1KB the per-call noise needs the larger population.
+BANDS = [
+    ("1KB,4KB,16KB,64KB", 40, 5),
+    ("256KB,1MB,4MB", 12, 2),
+    ("16MB,64MB", 5, 1),
+]
+TRANSPORTS = ("socket", "shm")
+ALGOS = ("ring", "recursive_halving")
+
+
+def _osu_rows(backend: str, bench: str, sizes: str, algos: Optional[str],
+              iters: int, warmup: int,
+              env_extra: Optional[Dict[str, str]] = None) -> List[Dict]:
+    from mpi_tpu.launcher import launch
+
+    with tempfile.TemporaryDirectory() as td:
+        out = os.path.join(td, "rows.jsonl")
+        argv = [os.path.join(REPO, "benchmarks", "osu.py"),
+                "--bench", bench, "--backend", backend, "-n", "2",
+                "--sizes", sizes, "--iters", str(iters),
+                "--warmup", str(warmup), "--out", out]
+        if algos:
+            argv += ["--algorithms", algos]
+        rc = launch(2, argv, env_extra=dict(env_extra or {}),
+                    timeout=1800.0, backend=backend)
+        if rc != 0:
+            raise RuntimeError(f"{backend} {bench} sweep leg exited {rc}")
+        with open(out) as f:
+            return [json.loads(line) for line in f if line.strip()]
+
+
+def allreduce_sweep() -> List[Dict]:
+    rows: List[Dict] = []
+    for backend in TRANSPORTS:
+        for sizes, iters, warmup in BANDS:
+            rows += _osu_rows(backend, "allreduce", sizes, ",".join(ALGOS),
+                              iters, warmup)
+    return rows
+
+
+def latency_diagnosis_legs() -> List[Dict]:
+    """1KB ping-pong p50 on socket, shm(default spin), shm(spin off) and
+    shm(long spin): separates the futex-wakeup cost (the spin knob removes
+    it when a spare core can run the sender) from everything else."""
+    legs = []
+    for backend, env, label in (
+        ("socket", None, "socket"),
+        ("shm", None, "shm_default"),
+        ("shm", {"MPI_TPU_SHM_SPIN_US": "0"}, "shm_spin_off"),
+        ("shm", {"MPI_TPU_SHM_SPIN_US": "300"}, "shm_spin_300us"),
+    ):
+        try:
+            rows = _osu_rows(backend, "latency", "1KB", None, 200, 20,
+                             env_extra=env)
+            for r in rows:
+                r["leg"] = label
+            legs += rows
+        except Exception as e:  # noqa: BLE001 - a diag leg must not kill the sweep
+            legs.append({"leg": label, "error": str(e)[:200]})
+    return legs
+
+
+def derive_crossover(rows: List[Dict]) -> Dict:
+    """Per transport: the smallest size from which ring's p50 stays at or
+    below recursive halving's for every larger measured size (the point
+    the ``auto`` policy should switch); None if halving never loses."""
+    out: Dict = {}
+    for backend in TRANSPORTS:
+        by_size: Dict[int, Dict[str, float]] = {}
+        for r in rows:
+            if r.get("backend") == backend and "p50_us" in r:
+                by_size.setdefault(r["bytes"], {})[r["algorithm"]] = r["p50_us"]
+        sizes = sorted(by_size)
+        crossover = None
+        for i, s in enumerate(sizes):
+            if all("ring" in by_size[t] and "recursive_halving" in by_size[t]
+                   and by_size[t]["ring"] <= by_size[t]["recursive_halving"]
+                   for t in sizes[i:]):
+                crossover = s
+                break
+        out[backend] = {"crossover_bytes": crossover,
+                        "table": {str(s): by_size[s] for s in sizes}}
+    return out
+
+
+def run_sweep(label: str) -> Dict:
+    t0 = time.time()
+    rows = allreduce_sweep()
+    lat = latency_diagnosis_legs()
+    result = {
+        "label": label,
+        "nranks": 2,
+        "cpus": os.cpu_count(),
+        "allreduce_rows": rows,
+        "latency_1kb_legs": lat,
+        "crossover": derive_crossover(rows),
+        "wall_s": round(time.time() - t0, 1),
+    }
+    return result
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--label", default="post")
+    ap.add_argument("--out", default=None)
+    args = ap.parse_args(argv)
+    result = run_sweep(args.label)
+    text = json.dumps(result, indent=2)
+    if args.out:
+        os.makedirs(os.path.dirname(os.path.abspath(args.out)), exist_ok=True)
+        with open(args.out, "w") as f:
+            f.write(text + "\n")
+    print(text)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

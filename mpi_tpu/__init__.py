@@ -123,6 +123,9 @@ def finalize() -> None:
         _world.barrier()
         pending = _world.close_transport()
         _world = None
+    from . import mpi4 as _mpi4
+
+    _mpi4._cfg_prune_all()  # session generation counters die with the world
     if pending:
         import warnings
 

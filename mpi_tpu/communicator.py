@@ -33,17 +33,68 @@ import numpy as np
 from . import mpit as _mpit
 from . import ops as _ops
 from . import schedules
+from .transport import codec as _codec
 from .transport.base import (ANY_SOURCE, ANY_TAG, Transport,
                              payload_nbytes)
 
 # Internal tags (never matched by user-level ANY_TAG — see Mailbox._matches).
-# CPU-backend allreduce auto crossover (mpit cvar; measured, BASELINE.md)
-_RING_CROSSOVER_BYTES = 64 << 10
+# CPU-backend allreduce auto crossover (mpit cvar; re-derived from the
+# segmented-engine host sweep, benchmarks/results/host_sweep_post.json).
+# The seed's 64KB dated from the era when recursive halving PICKLED its
+# chunk lists; on raw frames its latency edge reaches further: measured
+# halving still wins both host transports at 256KB inclusive (2- and
+# 4-rank legs; at 2 ranks the artifact's crossover derivation is null —
+# halving moves the SAME volume as ring there, so it never durably
+# loses).  Ring's 2(P-1)/P·N volume advantage at P>2 is what the MB+
+# sizes keep it for; 512KB is the smallest pow2 above every size the
+# sweep showed halving winning.
+_RING_CROSSOVER_BYTES = 512 << 10
+
+# Segmented collective engine (ISSUE 1 tentpole): element ranges larger
+# than the segment size ship as multiple raw frames so the receiver's
+# fold/copy of segment k overlaps the transport streaming segment k+1.
+# The right granularity is a TRANSPORT property (shm: stay inside the
+# ring; socket: amortize per-frame host work — see each transport's
+# coll_segment_hint), so _SEGMENT_BYTES = 0 means "ask the transport";
+# the mpit cvar collective_segment_bytes sets a nonzero engine-wide
+# override.  _SEG_WINDOW bounds how many segments a rank sends AHEAD of
+# its receive pointer: the credit that keeps window * segment
+# comfortably inside the 4MB shm ring, so symmetric exchanges never
+# stall on a full ring waiting for the 20Hz helper drainer (the seed
+# engine's hidden bandwidth cliff).
+_SEGMENT_BYTES = 0
+_SEG_WINDOW = 4
+# Arrays below this stay on the seed single-message bcast path: the
+# segmented tree costs one header message per edge + an assemble copy,
+# noise at bandwidth sizes but real at latency sizes.
+_BCAST_SEGMENT_MIN_BYTES = 1 << 20
 
 _TAG_COLL = -2
 _TAG_SHIFT = -3
 _TAG_BARRIER = -4
 _TAG_SPLIT = -5
+
+
+class _SegHeader:
+    """Wire announcement of a segmented tree broadcast (root's choice).
+
+    Pickled by class identity, so no user payload can collide with it;
+    carries the result geometry plus the segment count — each segment
+    frame is self-describing (raw frames ship dtype+shape), so receivers
+    never re-derive the root's segmentation, they just count it."""
+
+    __slots__ = ("dtype_str", "shape", "nseg")
+
+    def __init__(self, dtype_str: str, shape: Tuple[int, ...], nseg: int):
+        self.dtype_str = dtype_str
+        self.shape = shape
+        self.nseg = nseg
+
+    def __getstate__(self):
+        return (self.dtype_str, self.shape, self.nseg)
+
+    def __setstate__(self, state):
+        self.dtype_str, self.shape, self.nseg = state
 
 
 class Status:
@@ -271,7 +322,11 @@ class _RecvRequest(Request):
     def wait(self) -> Any:
         while not self._done:
             head = self._queue[0]  # earliest posted request gets the message
-            head._complete(self._comm.recv(head._source, head._tag))
+            # _recv_internal, not recv: the posting entry point already
+            # validated user tags, and internal (negative-tag) requests —
+            # the segmented collective engine's pipelined irecvs — must
+            # not trip the user-tag check at completion time
+            head._complete(self._comm._recv_internal(head._source, head._tag))
         return self._value
 
     def test(self) -> Tuple[bool, Any]:
@@ -884,6 +939,11 @@ class P2PCommunicator(Communicator):
         polls without blocking, ``wait()`` blocks.  Requests on the same
         (source, tag) complete in posted order."""
         _check_user_tag(tag)
+        return self._irecv_internal(source, tag)
+
+    def _irecv_internal(self, source: int, tag: int) -> "_RecvRequest":
+        """irecv without the user-tag gate — the collective engine posts
+        pipelined receives on the internal _TAG_COLL tag through here."""
         with self._lock:
             queue = self._irecv_queues.setdefault((source, tag), [])
         return _RecvRequest(self, source, tag, queue)
@@ -1009,17 +1069,65 @@ class P2PCommunicator(Communicator):
         _mpit.count(collectives=1)
         # Binomial tree, log2(P) rounds (BASELINE.json:8).  'fused' (the TPU
         # backend's XLA-collective path) has no socket analogue and aliases
-        # to the tree so portable programs run unchanged.
+        # to the tree so portable programs run unchanged.  Large contiguous
+        # arrays take the SEGMENTED pipelined tree: the root announces the
+        # geometry with a _SegHeader, then every rank forwards each segment
+        # to its children the moment it lands — cut-through through tree
+        # levels instead of the seed's store-and-forward whole frames.
         if algorithm not in ("auto", "tree", "fused"):
             raise ValueError(f"unknown bcast algorithm {algorithm!r}")
         self._world(root)  # validate
-        for pairs in schedules.binomial_bcast_rounds(self.size, root):
-            for s, d in pairs:
-                if self._rank == s:
-                    self._send_internal(obj, d, _TAG_COLL)
-                elif self._rank == d:
-                    obj = self._recv_internal(s, _TAG_COLL)
-        return obj
+        if self.size == 1:
+            return obj
+        parent, children = schedules.binomial_tree_links(
+            self.size, self._rank, root)
+        if self._rank == root:
+            # Gate on eligibility+size BEFORE compacting: as_raw_array's
+            # ascontiguousarray on a strided view is a full-buffer copy
+            # (and a payload_copies count) that the single-message path
+            # below would throw away — only pay it when the segmented
+            # tree actually runs.  size >= 3: with a single leaf there is
+            # no interior rank to overlap forwarding, so segmentation
+            # would only add a header message and an assemble copy.
+            if (_codec.raw_eligible(obj) and self.size >= 3
+                    and obj.nbytes >= _BCAST_SEGMENT_MIN_BYTES):
+                arr = _codec.as_raw_array(obj)
+                flat = arr.reshape(-1)
+                seg = self._seg_elems(arr.itemsize)
+                spans = schedules.segment_spans(0, flat.size, seg)
+                header = _SegHeader(arr.dtype.str, arr.shape, len(spans))
+                for c in children:
+                    self._send_internal(header, c, _TAG_COLL)
+                for lo, hi in spans:
+                    view = self._coll_payload(flat[lo:hi])
+                    for c in children:
+                        self._send_internal(view, c, _TAG_COLL)
+                return obj
+            for c in children:
+                self._send_internal(obj, c, _TAG_COLL)
+            return obj
+        got = self._recv_internal(parent, _TAG_COLL)
+        if isinstance(got, _SegHeader):
+            # forward the header FIRST so the whole subtree allocates and
+            # starts receiving before any payload bytes arrive
+            for c in children:
+                self._send_internal(got, c, _TAG_COLL)
+            out = _codec.RECV_POOL.empty(got.shape, np.dtype(got.dtype_str))
+            flat = out.reshape(-1)
+            off = 0
+            for _ in range(got.nseg):
+                seg = np.asarray(self._recv_internal(parent, _TAG_COLL))
+                n = seg.size
+                flat[off:off + n] = seg.reshape(-1)
+                if children:
+                    view = self._coll_payload(flat[off:off + n])
+                    for c in children:
+                        self._send_internal(view, c, _TAG_COLL)
+                off += n
+            return out
+        for c in children:
+            self._send_internal(got, c, _TAG_COLL)
+        return got
 
     def reduce(self, obj: Any, op: _ops.ReduceOp = _ops.SUM, root: int = 0,
                algorithm: str = "auto") -> Any:
@@ -1032,9 +1140,11 @@ class P2PCommunicator(Communicator):
         for pairs in schedules.binomial_reduce_rounds(self.size, root):
             for s, d in pairs:
                 if self._rank == s:
-                    self._send_internal(acc, d, _TAG_COLL)
+                    self._send_internal(self._coll_payload(acc), d, _TAG_COLL)
                 elif self._rank == d:
-                    acc = op.combine(acc, self._recv_internal(s, _TAG_COLL))
+                    # in place: no fresh array per fold (and a send of acc
+                    # can only happen in a LATER round, after this fold)
+                    op.combine_into(acc, self._recv_internal(s, _TAG_COLL))
         return _unwrap(acc, scalar) if self._rank == root else None
 
     def allreduce(self, obj: Any, op: _ops.ReduceOp = _ops.SUM,
@@ -1065,30 +1175,121 @@ class P2PCommunicator(Communicator):
             raise ValueError(f"unknown allreduce algorithm {algorithm!r}")
         return _unwrap(np.asarray(out), scalar)
 
+    # -- segmented collective engine (ISSUE 1 tentpole) --------------------
+    #
+    # Every bandwidth-bound collective below operates on ONE contiguous
+    # working buffer: chunk boundaries come from the shared pure tables in
+    # schedules.py (chunk_offsets / segment_spans), payloads are VIEWS of
+    # the buffer (contiguous, so they ride the codec raw frames with zero
+    # host-side staging), accumulation is in-place (op.combine_into), and
+    # each exchange step is pipelined — segments stream while earlier
+    # segments fold.  The seed engine's per-step costs this removes:
+    # a list of chunk copies, a fresh array per combine, a full-buffer
+    # np.concatenate at the end, and (for recursive halving) a PICKLE of
+    # the chunk list every round.
+
+    def _coll_payload(self, view: np.ndarray) -> np.ndarray:
+        """Aliasing transports (local copy_payloads=False) deliver by
+        reference, and the engine mutates its working buffer in place —
+        hand them a snapshot instead of a live view."""
+        return view.copy() if self._t.aliases_payloads else view
+
+    def _seg_elems(self, itemsize: int) -> int:
+        """Pipeline segment size in ELEMENTS for this communicator's
+        transport: the collective_segment_bytes cvar when set (nonzero),
+        else the transport's own coll_segment_hint."""
+        nbytes = _SEGMENT_BYTES or getattr(
+            self._t, "coll_segment_hint", Transport.coll_segment_hint)
+        return max(1, nbytes // max(1, itemsize))
+
+    def _seg_exchange(self, work: np.ndarray, sbounds: Tuple[int, int],
+                      rbounds: Tuple[int, int], dest: int, src: int,
+                      op: Optional[_ops.ReduceOp] = None) -> None:
+        """One pipelined exchange step: send ``work[sbounds]`` to ``dest``
+        while receiving the same global element range ``rbounds`` from
+        ``src``, folding (``op``) or copying (``op=None``) each segment
+        into the working buffer as soon as it lands.
+
+        Receives are posted as irecvs up front (they complete in posted
+        order, matching the sender's FIFO channel), and sends are
+        credit-limited to _SEG_WINDOW segments ahead of the receive
+        pointer: enough in flight to keep the wire busy, little enough
+        that a symmetric exchange can never fill the shm ring with
+        nobody draining.  Both sides compute spans from the same global
+        tables, so message boundaries agree with zero metadata traffic."""
+        seg = self._seg_elems(work.itemsize)
+        sspans = schedules.segment_spans(sbounds[0], sbounds[1], seg)
+        rspans = schedules.segment_spans(rbounds[0], rbounds[1], seg)
+        reqs = [self._irecv_internal(src, _TAG_COLL) for _ in rspans]
+        try:
+            si = 0
+            while si < min(len(sspans), _SEG_WINDOW):
+                lo, hi = sspans[si]
+                self._send_internal(self._coll_payload(work[lo:hi]), dest,
+                                    _TAG_COLL)
+                si += 1
+            for (lo, hi), req in zip(rspans, reqs):
+                got = req.wait()
+                view = work[lo:hi]
+                if op is None:
+                    view[...] = got
+                else:
+                    op.combine_into(view, got)
+                if si < len(sspans):
+                    slo, shi = sspans[si]
+                    self._send_internal(self._coll_payload(work[slo:shi]),
+                                        dest, _TAG_COLL)
+                    si += 1
+            while si < len(sspans):  # recv range empty/shorter: drain tail
+                slo, shi = sspans[si]
+                self._send_internal(self._coll_payload(work[slo:shi]), dest,
+                                    _TAG_COLL)
+                si += 1
+        except BaseException:
+            # Un-post OUR pending irecvs: a failed exchange (recv timeout,
+            # transport error) must not leave stale queue heads on the
+            # internal (src, _TAG_COLL) channel — they would silently
+            # absorb the first segments of any later collective with the
+            # same peer (the blocking seed path left no such residue).
+            # In-flight peer bytes may still arrive; un-posting at least
+            # fails the NEXT operation loudly instead of misfolding.
+            for req in reqs:
+                if not req._done and req in req._queue:
+                    req._queue.remove(req)
+            raise
+
     def _allreduce_ring(self, arr: np.ndarray, op: _ops.ReduceOp) -> np.ndarray:
-        # Reduce-scatter ring + allgather ring, 2(P-1) steps (SURVEY.md §3.3).
+        # Reduce-scatter ring + allgather ring, 2(P-1) steps (SURVEY.md
+        # §3.3), segmented and in place: one flat working copy of the
+        # input, every wire payload a contiguous view of it.
         p, r = self.size, self._rank
-        shape, dtype = arr.shape, arr.dtype
-        chunks = np.array_split(arr.reshape(-1), p)
-        chunks = [c.copy() for c in chunks]
+        shape = arr.shape
+        work = arr.flatten()  # flatten always copies — our mutable buffer
+        offs = schedules.chunk_offsets(work.size, p)
         right, left = (r + 1) % p, (r - 1) % p
         for step in range(p - 1):
             si = schedules.ring_rs_send_chunk(r, step, p)
             ri = schedules.ring_rs_recv_chunk(r, step, p)
-            recvd = self._sendrecv_internal(chunks[si], right, left, _TAG_COLL)
-            chunks[ri] = op.combine(chunks[ri], recvd)
+            self._seg_exchange(work, (offs[si], offs[si + 1]),
+                               (offs[ri], offs[ri + 1]), right, left, op)
         for step in range(p - 1):
             si = schedules.ring_ag_send_chunk(r, step, p)
             ri = schedules.ring_ag_recv_chunk(r, step, p)
-            chunks[ri] = self._sendrecv_internal(chunks[si], right, left, _TAG_COLL)
-        return np.concatenate(chunks).reshape(shape).astype(dtype, copy=False)
+            self._seg_exchange(work, (offs[si], offs[si + 1]),
+                               (offs[ri], offs[ri + 1]), right, left)
+        return work.reshape(shape)
 
     def _allreduce_halving(self, arr: np.ndarray, op: _ops.ReduceOp) -> np.ndarray:
         # Recursive-halving reduce-scatter + recursive-doubling allgather
         # (power-of-two only; latency-optimal [S]; BASELINE.json:10).
+        # Chunks [a, b) of the flat buffer are the contiguous range
+        # [offs[a], offs[b]), so each round's half ships as raw frames —
+        # the seed path pickled a Python list of chunk arrays here,
+        # copying every byte through the pickler on both ends.
         p, r = self.size, self._rank
-        shape, dtype = arr.shape, arr.dtype
-        chunks = [c.copy() for c in np.array_split(arr.reshape(-1), p)]
+        shape = arr.shape
+        work = arr.flatten()
+        offs = schedules.chunk_offsets(work.size, p)
         masks = schedules.halving_masks(p)
         lo, hi = 0, p
         for mask in masks:
@@ -1098,28 +1299,31 @@ class P2PCommunicator(Communicator):
                 mine, theirs = (mid, hi), (lo, mid)
             else:
                 mine, theirs = (lo, mid), (mid, hi)
-            recvd = self._sendrecv_internal(chunks[theirs[0]:theirs[1]], partner,
-                                            partner, _TAG_COLL)
+            self._seg_exchange(work, (offs[theirs[0]], offs[theirs[1]]),
+                               (offs[mine[0]], offs[mine[1]]),
+                               partner, partner, op)
             lo, hi = mine
-            for i, c in zip(range(lo, hi), recvd):
-                chunks[i] = op.combine(chunks[i], c)
         # now [lo, hi) == [r, r+1): rank r holds reduced chunk r
         for mask in reversed(masks):
             partner = r ^ mask
-            recvd = self._sendrecv_internal(chunks[lo:hi], partner, partner, _TAG_COLL)
             w = hi - lo
-            if r & mask:
-                chunks[lo - w:lo] = recvd
-                lo -= w
-            else:
-                chunks[hi:hi + w] = recvd
-                hi += w
-        return np.concatenate(chunks).reshape(shape).astype(dtype, copy=False)
+            rb = (lo - w, lo) if r & mask else (hi, hi + w)
+            self._seg_exchange(work, (offs[lo], offs[hi]),
+                               (offs[rb[0]], offs[rb[1]]), partner, partner)
+            lo, hi = (rb[0], hi) if r & mask else (lo, rb[1])
+        return work.reshape(shape)
 
     def allgather(self, obj: Any, algorithm: str = "auto") -> List[Any]:
         _mpit.count(collectives=1)
         p, r = self.size, self._rank
-        if algorithm in ("auto", "fused"):  # no fused path on sockets; best schedule
+        if algorithm in ("auto", "fused"):  # no fused path on sockets
+            # The pick may depend ONLY on the group shape, never on the
+            # rank-local payload: ragged allgather is supported, so a
+            # size- or type-conditioned pick could choose wire-incompatible
+            # algorithms on different ranks.  Doubling is latency-optimal
+            # (log P rounds) on pow2 groups; bandwidth-bound array
+            # workloads should request "ring" explicitly for the
+            # raw-frame row buffer.
             algorithm = "doubling" if schedules.is_pow2(p) else "ring"
         items: List[Any] = [None] * p
         items[r] = obj
@@ -1127,16 +1331,79 @@ class P2PCommunicator(Communicator):
             return items
         if algorithm == "ring":
             right, left = (r + 1) % p, (r - 1) % p
+            # only the ring branch uses the compacted form — probing here
+            # keeps doubling payloads from paying an ascontiguousarray
+            # copy (and a payload_copies count) that is never sent
+            arr = _codec.as_raw_array(obj)
+            if arr is not None:
+                # Contiguous row-buffer fast path: rows are views of ONE
+                # [p, ...] working buffer — rotated payloads ship raw with
+                # no per-step staging and the final stack costs zero
+                # copies.  The wire protocol is IDENTICAL to the generic
+                # path (one self-describing frame per step), so ranks
+                # with mismatched payloads (ragged allgather) interoperate:
+                # a row that doesn't fit the local geometry just falls
+                # back to object storage for that slot.
+                work = np.empty((p,) + arr.shape, arr.dtype)
+                work[r] = arr
+                ragged: dict = {}
+
+                def slot(i: int) -> Any:
+                    # membership, not .get: None is a legal ragged payload
+                    if i in ragged:
+                        return ragged[i]
+                    return self._coll_payload(work[i])
+
+                for step in range(p - 1):
+                    si = schedules.ring_ag_send_chunk(r, step + 1, p)
+                    ri = schedules.ring_ag_recv_chunk(r, step + 1, p)
+                    self._send_internal(slot(si), right, _TAG_COLL)
+                    got = self._recv_internal(left, _TAG_COLL)
+                    # exact type, mirroring codec.raw_eligible: an ndarray
+                    # SUBCLASS row (MaskedArray, ...) must stay a ragged
+                    # object, not be flattened into the plain buffer with
+                    # its subclass state stripped
+                    if (type(got) is np.ndarray
+                            and got.shape == arr.shape
+                            and got.dtype == arr.dtype):
+                        work[ri] = got
+                    else:
+                        ragged[ri] = got
+                if not ragged:
+                    return work
+                items = [ragged[i] if i in ragged else work[i]
+                         for i in range(p)]
+                items[r] = obj
+                return _maybe_stack(obj, items)
             for step in range(p - 1):
                 si = schedules.ring_ag_send_chunk(r, step + 1, p)
                 ri = schedules.ring_ag_recv_chunk(r, step + 1, p)
                 items[ri] = self._sendrecv_internal(items[si], right, left, _TAG_COLL)
         elif algorithm == "doubling":
+            # Each round exchanges the whole owned batch.  When every
+            # owned value is raw-eligible the batch ships as a keyed LIST
+            # — [int64 rank-index array, *values] — which the codec sends
+            # as ONE multi-segment raw frame (zero pickled array bytes);
+            # otherwise the seed's dict rides pickle.  The two forms are
+            # distinguished per message by type, so each sender decides
+            # from its own batch alone and mixed groups interoperate.
             owned = {r: obj}
             for mask in schedules.doubling_masks(p):
                 partner = r ^ mask
-                recvd = self._sendrecv_internal(owned, partner, partner, _TAG_COLL)
-                owned.update(recvd)
+                ks = sorted(owned)
+                vals = [owned[k] for k in ks]
+                if all(_codec.raw_eligible(v) for v in vals):
+                    # values are never mutated after the send, so no
+                    # aliasing snapshot is needed (matches the seed dict)
+                    batch: Any = [np.asarray(ks, np.int64)] + vals
+                else:
+                    batch = owned
+                recvd = self._sendrecv_internal(batch, partner, partner,
+                                                _TAG_COLL)
+                if isinstance(recvd, list):
+                    owned.update(zip((int(k) for k in recvd[0]), recvd[1:]))
+                else:
+                    owned.update(recvd)
             for i, v in owned.items():
                 items[i] = v
         else:

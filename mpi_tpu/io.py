@@ -70,11 +70,17 @@ _COLLECTIVE_BUFFER_LIMIT = 8 << 20
 
 def _wants_position(fn, base_params: int) -> str:
     """How a datarep callback takes the optional ``position`` argument:
-    ``"pos"`` (a positional parameter beyond the ``base_params``
-    required ones, or *args), ``"kw"`` (a keyword-only parameter named
-    ``position`` — review round 5: the natural ``*, position=0``
-    spelling must not be silently treated as position-free), or ``""``
-    (position-free; also for C callables hiding their signature)."""
+    ``"pos"`` (a trailing positional parameter NAMED ``position``, or
+    *args), ``"kw"`` (a keyword-only parameter named ``position`` —
+    review round 5: the natural ``*, position=0`` spelling must not be
+    silently treated as position-free), or ``""`` (position-free; also
+    for C callables hiding their signature).
+
+    The positional detection requires the name (ADVICE r5 #1): a
+    callback with an unrelated defaulted trailing arg — e.g.
+    ``read_fn(raw, et, n, extra, strict=True)`` — must keep that
+    parameter's default, not silently receive the element position in
+    it.  Such a signature gets a warning so the ambiguity is loud."""
     import inspect
 
     try:
@@ -88,10 +94,33 @@ def _wants_position(fn, base_params: int) -> str:
         return "kw"
     if inspect.Parameter.VAR_POSITIONAL in kinds:
         return "pos"
-    positional = [k for k in kinds
-                  if k in (inspect.Parameter.POSITIONAL_ONLY,
-                           inspect.Parameter.POSITIONAL_OR_KEYWORD)]
-    return "pos" if len(positional) > base_params else ""
+    positional = [p for p in params
+                  if p.kind in (inspect.Parameter.POSITIONAL_ONLY,
+                                inspect.Parameter.POSITIONAL_OR_KEYWORD)]
+    if len(positional) <= base_params:
+        return ""
+    extra = positional[base_params]
+    if extra.name == "position":
+        return "pos"
+    import warnings
+
+    if extra.default is inspect.Parameter.empty:
+        # A REQUIRED extra has no default to preserve — not passing the
+        # position would TypeError on every call, so it still receives
+        # it (the pre-r5 behavior); the warning only flags the name.
+        warnings.warn(
+            f"datarep callback {getattr(fn, '__name__', fn)!r} takes the "
+            f"element position in a parameter named {extra.name!r}; name "
+            f"it 'position' to make the contract explicit",
+            UserWarning, stacklevel=3)
+        return "pos"
+    warnings.warn(
+        f"datarep callback {getattr(fn, '__name__', fn)!r} has a trailing "
+        f"defaulted parameter {extra.name!r}; only a parameter named "
+        f"'position' receives the element position — {extra.name!r} keeps "
+        f"its default (rename it to 'position' to opt in)",
+        UserWarning, stacklevel=3)
+    return ""
 
 
 class Datarep:

@@ -39,6 +39,7 @@ features whose shapes fit this framework naturally:
 from __future__ import annotations
 
 import threading
+import weakref
 from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
@@ -316,8 +317,62 @@ class PrecvRequest:
 # (calling world rank, world_ranks, stringtag) — module-global (NOT
 # per-Session: context isolation must hold across sessions) but
 # rank-scoped via the key, so thread-backed ranks sharing one process
-# count independently (see Session.comm_create_from_group)
+# count independently (see Session.comm_create_from_group).
+#
+# All three tables are guarded by _CFG_LOCK (ADVICE r5 #2: the bare
+# get-then-set raced under MPI_THREAD_MULTIPLE — two threads could claim
+# the same generation and silently cross-match traffic).  _CFG_IN_FLIGHT
+# holds keys whose creation is between generation claim and communicator
+# wiring: a second creation with an identical key inside that window is
+# the "concurrent calls with an identical (group, stringtag) pair" case
+# MPI-4 §11.6 declares erroneous, and it now raises instead of handing
+# out a generation whose cross-rank ordering is undefined.  _CFG_LIVE
+# refcounts, per key, the sessions that created under it; a key's
+# generation counter is pruned when the LAST such session finalizes
+# (its communicators must already be out of use per MPI-4, so restarting
+# at generation 0 cannot collide with live traffic — while any sharing
+# session is still live the counter survives).
 _CFG_GENERATIONS: Dict[Tuple, int] = {}
+_CFG_IN_FLIGHT: set = set()
+_CFG_LIVE: Dict[Tuple, int] = {}
+_CFG_LOCK = threading.Lock()
+
+
+def _cfg_prune(keys) -> None:
+    """Drop one session's refcount on each of ``keys``; forget generation
+    counters whose last holder is gone (session-finalize prune)."""
+    with _CFG_LOCK:
+        for key in keys:
+            n = _CFG_LIVE.get(key, 0) - 1
+            if n <= 0:
+                _CFG_LIVE.pop(key, None)
+                _CFG_GENERATIONS.pop(key, None)
+            else:
+                _CFG_LIVE[key] = n
+
+
+def _cfg_prune_all() -> None:
+    """World-finalize prune of counters no LIVE session still holds.
+
+    Finalizing the process world must not clear keys of unfinalized
+    sessions on OTHER worlds (run_local thread worlds take any
+    base_comm) — restarting their counters at generation 0 could
+    collide with a still-open communicator's context.  Sessions that
+    were garbage-collected without finalize() drop out of the weak
+    registry, so exactly the leaked keys get swept here."""
+    with _CFG_LOCK:
+        held = set()
+        for sess in list(_LIVE_SESSIONS):
+            if not sess._finalized:
+                held.update(sess._cfg_keys)
+        for key in [k for k in _CFG_GENERATIONS if k not in held]:
+            _CFG_GENERATIONS.pop(key, None)
+            _CFG_LIVE.pop(key, None)
+        _CFG_IN_FLIGHT.difference_update(
+            k for k in list(_CFG_IN_FLIGHT) if k not in held)
+
+
+_LIVE_SESSIONS: "weakref.WeakSet" = weakref.WeakSet()
 
 
 class Session:
@@ -348,6 +403,10 @@ class Session:
         self._info = dict(info or {})
         self._errhandler = errhandler
         self._finalized = False
+        # comm_create_from_group keys this session holds live (with
+        # multiplicity) — released at finalize, see _cfg_prune
+        self._cfg_keys: List[Tuple] = []
+        _LIVE_SESSIONS.add(self)
 
     # -- pset discovery ----------------------------------------------------
 
@@ -428,12 +487,29 @@ class Session:
         # may differ.
         world_ranks = tuple(self._base._world(r) for r in ranks)
         key = (self._base._t.world_rank, world_ranks, str(stringtag))
-        gen = _CFG_GENERATIONS.get(key, 0)
-        _CFG_GENERATIONS[key] = gen + 1
-        return P2PCommunicator(
-            self._base._t, world_ranks,
-            context=("sess", world_ranks, str(stringtag), gen),
-            recv_timeout=self._base.recv_timeout)
+        with _CFG_LOCK:
+            if key in _CFG_IN_FLIGHT:
+                raise RuntimeError(
+                    f"concurrent MPI_Comm_create_from_group calls with an "
+                    f"identical (group={list(ranks)}, "
+                    f"stringtag={str(stringtag)!r}) pair on rank "
+                    f"{self._base.rank} — erroneous per MPI-4 §11.6: "
+                    f"identical concurrent creations cannot be matched "
+                    f"across members (disambiguate with distinct "
+                    f"stringtags, or order the calls)")
+            _CFG_IN_FLIGHT.add(key)
+            gen = _CFG_GENERATIONS.get(key, 0)
+            _CFG_GENERATIONS[key] = gen + 1
+            _CFG_LIVE[key] = _CFG_LIVE.get(key, 0) + 1
+            self._cfg_keys.append(key)
+        try:
+            return P2PCommunicator(
+                self._base._t, world_ranks,
+                context=("sess", world_ranks, str(stringtag), gen),
+                recv_timeout=self._base.recv_timeout)
+        finally:
+            with _CFG_LOCK:
+                _CFG_IN_FLIGHT.discard(key)
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -442,8 +518,14 @@ class Session:
         Communicators derived from it must already be out of use (MPI
         erroneous otherwise); the shared runtime transport is NOT closed
         — it belongs to the process (world model finalize / launcher
-        teardown owns it)."""
-        self._finalized = True
+        teardown owns it).  Generation counters this session held are
+        released (and forgotten once no live session shares them), so
+        long-running processes that churn sessions don't grow the
+        module-global table without bound."""
+        if not self._finalized:
+            self._finalized = True
+            keys, self._cfg_keys = self._cfg_keys, []
+            _cfg_prune(keys)
 
     @property
     def finalized(self) -> bool:

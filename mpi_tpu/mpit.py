@@ -37,7 +37,7 @@ _lock = threading.Lock()
 
 class _Counters:
     __slots__ = ("sends", "send_bytes", "recvs", "collectives",
-                 "pallas_fallbacks")
+                 "pallas_fallbacks", "bytes_raw", "bytes_pickled", "copies")
 
     def __init__(self) -> None:
         self.sends = 0
@@ -45,13 +45,17 @@ class _Counters:
         self.recvs = 0
         self.collectives = 0
         self.pallas_fallbacks = 0
+        self.bytes_raw = 0
+        self.bytes_pickled = 0
+        self.copies = 0
 
 
-counters = _Counters()  # incremented by communicator.py (see count())
+counters = _Counters()  # incremented by communicator.py / codec.py (count())
 
 
 def count(sends: int = 0, send_bytes: int = 0, recvs: int = 0,
-          collectives: int = 0, pallas_fallbacks: int = 0) -> None:
+          collectives: int = 0, pallas_fallbacks: int = 0,
+          bytes_raw: int = 0, bytes_pickled: int = 0, copies: int = 0) -> None:
     """Thread-safe increment (rank-threads of the local backend share
     this process's counters; unsynchronized += would lose updates)."""
     with _lock:
@@ -60,6 +64,9 @@ def count(sends: int = 0, send_bytes: int = 0, recvs: int = 0,
         counters.recvs += recvs
         counters.collectives += collectives
         counters.pallas_fallbacks += pallas_fallbacks
+        counters.bytes_raw += bytes_raw
+        counters.bytes_pickled += bytes_pickled
+        counters.copies += copies
 
 _PVARS: Dict[str, Callable[[], int]] = {
     "msgs_sent": lambda: counters.sends,
@@ -71,6 +78,15 @@ _PVARS: Dict[str, Callable[[], int]] = {
     # r3 weak #4 — sim benchmarks must not silently measure the wrong
     # implementation)
     "pallas_ring_fallbacks": lambda: counters.pallas_fallbacks,
+    # wire-plane byte accounting (codec.py): array payload bytes that
+    # shipped as raw frames vs bytes that went through the pickler, plus
+    # host-side payload copies (self-send value copies, non-contiguous
+    # compactions).  These are the counters that PROVE a hot path stayed
+    # zero-copy — e.g. the segmented allreduce asserts 0 pickled array
+    # bytes at bandwidth sizes (ISSUE 1 acceptance).
+    "bytes_raw_sent": lambda: counters.bytes_raw,
+    "bytes_pickled_sent": lambda: counters.bytes_pickled,
+    "payload_copies": lambda: counters.copies,
 }
 
 
@@ -167,6 +183,15 @@ def _ensure_builtin_cvars() -> None:
     def _set_cross(v):
         _c._RING_CROSSOVER_BYTES = int(v)
 
+    def _get_seg():
+        return _c._SEGMENT_BYTES
+
+    def _set_seg(v):
+        if int(v) < 0:
+            raise ValueError(
+                "collective_segment_bytes must be >= 0 (0 = per-transport)")
+        _c._SEGMENT_BYTES = int(v)
+
     with _lock:
         if _builtin_done:
             return
@@ -180,6 +205,16 @@ def _ensure_builtin_cvars() -> None:
             "CPU-backend allreduce auto algorithm picks latency-optimal "
             "recursive halving below this payload size (pow2 groups), "
             "bandwidth-optimal ring at or above it")
+        _CVARS["collective_segment_bytes"] = (
+            _get_seg, _set_seg,
+            "pipeline segment size of the host collective engine: element "
+            "ranges above this many bytes ship as multiple raw frames so "
+            "the receiver's fold of segment k overlaps the transport "
+            "streaming segment k+1.  0 (default) defers to each "
+            "transport's coll_segment_hint (shm: stay inside the ring; "
+            "socket: amortize per-frame host work); nonzero overrides "
+            "every transport (keep window*segment below the shm ring "
+            "capacity; see communicator._SEG_WINDOW)")
         _CVARS["gather_replicated_warn_bytes"] = (
             lambda: _GATHER_WARN_BYTES[0],
             lambda v: _GATHER_WARN_BYTES.__setitem__(0, int(v)),

@@ -25,21 +25,29 @@ class NativeBuildError(RuntimeError):
     pass
 
 
-def ensure_built() -> str:
-    """Compile shmring.cpp if needed; return the path to the .so."""
-    if os.path.exists(_SO) and os.path.getmtime(_SO) >= os.path.getmtime(_SRC):
+def ensure_built(force: bool = False) -> str:
+    """Compile shmring.cpp if needed; return the path to the .so.
+
+    ``force`` rebuilds even when the cached .so looks fresh — the recovery
+    path for a .so carried over from a host with a different glibc layout
+    (dlopen fails with an unresolved symbol; see load_shmring)."""
+    if (not force and os.path.exists(_SO)
+            and os.path.getmtime(_SO) >= os.path.getmtime(_SRC)):
         return _SO
     lock_path = os.path.join(_DIR, ".build.lock")
     with open(lock_path, "w") as lock:
         fcntl.flock(lock, fcntl.LOCK_EX)
         try:
-            if (os.path.exists(_SO)
+            if (not force and os.path.exists(_SO)
                     and os.path.getmtime(_SO) >= os.path.getmtime(_SRC)):
                 return _SO  # another process built it while we waited
             fd, tmp = tempfile.mkstemp(suffix=".so", dir=_DIR)
             os.close(fd)
+            # -lrt: shm_open/shm_unlink live in librt on pre-2.34 glibc
+            # (a stub librt still exists on newer ones, so the flag is
+            # portable both ways)
             cmd = ["g++", "-O3", "-std=c++17", "-shared", "-fPIC",
-                   "-o", tmp, _SRC, "-pthread"]
+                   "-o", tmp, _SRC, "-pthread", "-lrt"]
             try:
                 proc = subprocess.run(cmd, capture_output=True, text=True)
             except FileNotFoundError as e:
@@ -62,7 +70,13 @@ def load_shmring() -> ctypes.CDLL:
     global _lib
     if _lib is not None:
         return _lib
-    lib = ctypes.CDLL(ensure_built())
+    try:
+        lib = ctypes.CDLL(ensure_built())
+    except OSError:
+        # a cached .so from a host with a different glibc (e.g. shm_open
+        # moved between librt and libc) fails at dlopen, not at build —
+        # recompile against THIS toolchain and retry once
+        lib = ctypes.CDLL(ensure_built(force=True))
     lib.shmring_create.restype = ctypes.c_void_p
     lib.shmring_create.argtypes = [ctypes.c_char_p, ctypes.c_uint64]
     lib.shmring_open.restype = ctypes.c_void_p

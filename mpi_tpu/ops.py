@@ -44,12 +44,32 @@ def _minimum(a, b):
 
 @dataclass(frozen=True)
 class ReduceOp:
-    """An MPI reduction operator: elementwise combiner + dtype-aware identity."""
+    """An MPI reduction operator: elementwise combiner + dtype-aware identity.
+
+    ``ufunc`` (builtin ops only) is the numpy ufunc equivalent of
+    ``combine``, used by the host collective engine's in-place
+    accumulation; ``combine`` remains the portable spelling that also
+    works on jax tracers."""
 
     name: str
     combine: Callable[[Any, Any], Any]
     identity: Callable[[Any], Any]  # np.dtype -> neutral scalar
     commutative: bool = True
+    ufunc: Any = None  # numpy ufunc for in-place host accumulation
+
+    def combine_into(self, acc: np.ndarray, value: Any) -> np.ndarray:
+        """Accumulate ``value`` into ndarray ``acc`` IN PLACE (host data
+        plane only — numpy, never tracers): zero result allocations for
+        builtin ops, one temporary for user ops.  Always preserves acc's
+        dtype — MPI reduces in the datatype, so a user combine that
+        upcasts is cast back at every fold, not once at the end."""
+        if self.ufunc is not None:
+            self.ufunc(acc, value, out=acc)
+            return acc
+        out = self.combine(acc, value)
+        if out is not acc:
+            acc[...] = out
+        return acc
 
     def __repr__(self) -> str:  # pragma: no cover - cosmetic
         return f"ReduceOp({self.name})"
@@ -128,18 +148,21 @@ def make_op(combine: Callable[[Any, Any], Any], identity: Any,
     return ReduceOp(name, combine, ident_fn, commutative)
 
 
-SUM = ReduceOp("sum", operator.add, _id_sum)
-PROD = ReduceOp("prod", operator.mul, _id_prod)
-MAX = ReduceOp("max", _maximum, _id_max)
-MIN = ReduceOp("min", _minimum, _id_min)
+SUM = ReduceOp("sum", operator.add, _id_sum, ufunc=np.add)
+PROD = ReduceOp("prod", operator.mul, _id_prod, ufunc=np.multiply)
+MAX = ReduceOp("max", _maximum, _id_max, ufunc=np.maximum)
+MIN = ReduceOp("min", _minimum, _id_min, ufunc=np.minimum)
 # Logical ops are defined on bool payloads (MPI's int-as-logical is not
-# replicated; pass bool arrays).  Bitwise ops are defined on bool/int payloads.
-LAND = ReduceOp("land", operator.and_, _id_true)
-LOR = ReduceOp("lor", operator.or_, _id_false)
-LXOR = ReduceOp("lxor", operator.xor, _id_false)
-BAND = ReduceOp("band", operator.and_, _id_band)
-BOR = ReduceOp("bor", operator.or_, _id_false)
-BXOR = ReduceOp("bxor", operator.xor, _id_false)
+# replicated; pass bool arrays).  Bitwise ops are defined on bool/int
+# payloads.  The ufuncs mirror the operator spellings exactly (operator
+# `&`/`|`/`^` on arrays ARE the bitwise ufuncs), so the in-place and
+# allocating paths can never disagree.
+LAND = ReduceOp("land", operator.and_, _id_true, ufunc=np.bitwise_and)
+LOR = ReduceOp("lor", operator.or_, _id_false, ufunc=np.bitwise_or)
+LXOR = ReduceOp("lxor", operator.xor, _id_false, ufunc=np.bitwise_xor)
+BAND = ReduceOp("band", operator.and_, _id_band, ufunc=np.bitwise_and)
+BOR = ReduceOp("bor", operator.or_, _id_false, ufunc=np.bitwise_or)
+BXOR = ReduceOp("bxor", operator.xor, _id_false, ufunc=np.bitwise_xor)
 
 ALL_OPS = (SUM, PROD, MAX, MIN, LAND, LOR, LXOR, BAND, BOR, BXOR)
 BY_NAME = {op.name: op for op in ALL_OPS}

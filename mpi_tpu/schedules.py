@@ -22,13 +22,74 @@ Conventions
 
 from __future__ import annotations
 
-from typing import List, Tuple
+from typing import List, Optional, Sequence, Tuple
 
 Pair = Tuple[int, int]
+Span = Tuple[int, int]
 
 
 def is_pow2(n: int) -> bool:
     return n >= 1 and (n & (n - 1)) == 0
+
+
+# ---------------------------------------------------------------------------
+# Segment schedules (the host segmented collective engine — ISSUE 1 tentpole)
+# ---------------------------------------------------------------------------
+#
+# The engine slices ONE contiguous working buffer with these pure tables, so
+# both sides of every exchange agree on message boundaries without any
+# metadata traffic: chunk_offsets is a function of (n, parts) only and
+# segment_spans of (range, max_elems) only — identical on every rank for the
+# congruent payloads MPI reductions require.
+
+
+def chunk_offsets(n: int, parts: int) -> List[int]:
+    """``parts + 1`` monotone element offsets splitting ``n`` elements into
+    ``parts`` chunks, np.array_split-compatible (the first ``n % parts``
+    chunks get one extra element; trailing chunks may be empty when
+    ``n < parts``).  Chunk ``i`` is the half-open range
+    ``[offs[i], offs[i+1])`` and chunks ``[a, b)`` together are the single
+    contiguous range ``[offs[a], offs[b])`` — which is what lets the
+    recursive-halving path ship each round's half as ONE raw frame instead
+    of a pickled list of chunk arrays."""
+    if parts < 1:
+        raise ValueError(f"need at least one chunk, got {parts}")
+    base, extra = divmod(n, parts)
+    offs = [0]
+    for i in range(parts):
+        offs.append(offs[-1] + base + (1 if i < extra else 0))
+    return offs
+
+
+def segment_spans(lo: int, hi: int, max_elems: int) -> List[Span]:
+    """Split element range ``[lo, hi)`` into pipeline segments of at most
+    ``max_elems`` elements.  Empty ranges produce NO spans (and therefore
+    no messages) — symmetric, because both sides of an exchange derive
+    their spans from the same global chunk table."""
+    if max_elems < 1:
+        raise ValueError(f"segments need >= 1 element, got {max_elems}")
+    if hi <= lo:
+        return []
+    return [(s, min(s + max_elems, hi)) for s in range(lo, hi, max_elems)]
+
+
+def binomial_tree_links(size: int, rank: int,
+                        root: int = 0) -> Tuple[Optional[int], List[int]]:
+    """``(parent, children-in-send-order)`` of ``rank`` in the binomial
+    broadcast tree — the per-rank view of :func:`binomial_bcast_rounds`.
+    The segmented pipelined bcast walks links instead of rounds: a rank
+    forwards segment k to its children as soon as it lands, so segments
+    stream through tree levels concurrently (cut-through instead of
+    store-and-forward).  ``parent`` is None exactly at ``root``."""
+    parent: Optional[int] = None
+    children: List[int] = []
+    for pairs in binomial_bcast_rounds(size, root):
+        for s, d in pairs:
+            if d == rank:
+                parent = s
+            elif s == rank:
+                children.append(d)
+    return parent, children
 
 
 # ---------------------------------------------------------------------------

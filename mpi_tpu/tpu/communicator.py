@@ -675,8 +675,12 @@ class TpuCommunicator(Communicator):
             return lax.pcast(x, self.axis_name, to="varying")
         try:  # pre-pcast jax: pvary raises on an unbound axis name
             return lax.pvary(x, self.axis_name)
-        except NameError:
-            return x  # outside shard_map: nothing to brand against
+        except (NameError, ValueError):
+            # outside shard_map: nothing to brand against.  Which exception
+            # an unbound axis raises has moved between jax releases
+            # (NameError historically, ValueError in newer trace-context
+            # plumbing — ADVICE r5 #3), so both mean the same benign thing
+            return x
 
     def gather(self, obj, root: int = 0, sharded: bool = False):
         """Stacked [size, ...] — contract guarantees it only at root (other

@@ -22,6 +22,31 @@ from jax.sharding import Mesh, PartitionSpec as P
 from .communicator import TpuCommunicator
 
 
+def _install_shard_map_compat() -> None:
+    """``jax.shard_map`` across the jax version drift this repo tolerates
+    (see _brand_sharded_slice for the same policy on pvary/pcast):
+    pre-0.5 jax ships shard_map only as ``jax.experimental.shard_map``,
+    whose equivalent of ``check_vma`` is still called ``check_rep``.
+    Install a translating alias at the top-level spelling so every call
+    site — library, benchmarks, tools, tests — runs unchanged on either
+    vintage.  No-op when jax already has the real thing."""
+    if getattr(jax, "shard_map", None) is not None:
+        return
+    from jax.experimental.shard_map import shard_map as _legacy
+
+    def shard_map(f, *, mesh=None, in_specs=None, out_specs=None,
+                  check_vma=None, **kw):
+        if check_vma is not None:
+            kw.setdefault("check_rep", check_vma)
+        return _legacy(f, mesh=mesh, in_specs=in_specs,
+                       out_specs=out_specs, **kw)
+
+    jax.shard_map = shard_map
+
+
+_install_shard_map_compat()
+
+
 def default_mesh(nranks: Optional[int] = None, axis_name: str = "world") -> Mesh:
     """1-D mesh over the first ``nranks`` local devices (all, if None).
 

@@ -34,6 +34,9 @@ class TracingTransport(Transport):
         self.world_size = inner.world_size
         self.mailbox = inner.mailbox
         self.aliases_payloads = inner.aliases_payloads
+        # decorate, don't re-tune: a traced run must execute the same
+        # collective wire schedule as the wrapped data plane
+        self.coll_segment_hint = inner.coll_segment_hint
         self.log: List[Tuple] = []
         self._lock = threading.Lock()
 

@@ -183,6 +183,15 @@ class Transport(ABC):
     # mutable payloads themselves.  Serializing transports copy anyway.
     aliases_payloads = False
 
+    # Preferred pipeline-segment size of the segmented collective engine
+    # for THIS transport's data plane (communicator._seg_exchange), used
+    # when the ``collective_segment_bytes`` mpit cvar is 0 (= auto).  The
+    # right value is a transport property: shm must keep window*segment
+    # inside its fixed ring capacity, while loopback TCP already overlaps
+    # via kernel socket buffers and instead wants few, large frames (the
+    # per-frame host costs dominate it at bandwidth sizes).
+    coll_segment_hint = 256 << 10
+
     def __init__(self, world_rank: int, world_size: int) -> None:
         self.world_rank = world_rank
         self.world_size = world_size

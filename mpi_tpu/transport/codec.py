@@ -13,11 +13,27 @@ the top bit of the u64 length word (RAW_FLAG):
   (ONE copy, out) — this is what makes the native data plane actually
   faster than pickle-over-TCP at bandwidth sizes (VERDICT round 1,
   "what's weak" #2).
+* multi-segment raw frames — a LIST of contiguous numpy arrays ships as
+  one length-prefixed raw body: meta ``(ctx, tag, [(dtype.str, shape),
+  ...])`` followed by every segment's raw bytes back to back.  List
+  payloads of arrays (chunked collectives, user batches) previously fell
+  off the raw path into a pickle of the whole list — silently copying
+  every array byte through the pickler twice (ISSUE 1 tentpole #2).  The
+  receiver reads each segment into its own pooled destination
+  (``RECV_POOL``) and delivers the reassembled list.
 
 Eligibility for the raw path: any ``np.ndarray`` without Python-object
 fields (object dtypes and structured/void dtypes fall back to pickle,
 which handles them correctly).  Non-contiguous arrays are compacted with
-``ascontiguousarray`` first — still cheaper than pickling.
+``ascontiguousarray`` first — still cheaper than pickling.  For the
+multi-segment frame, a plain ``list`` whose EVERY element passes the
+same test; tuples and mixed lists keep pickle (type fidelity).
+
+Byte-level observability: every frame build counts into the mpit pvars
+``bytes_raw_sent`` / ``bytes_pickled_sent``; host-side payload copies
+(self-send value copies, non-contiguous compactions) count into
+``payload_copies`` — the counters that prove a hot path stayed on the
+one-copy plane (asserted in tests/test_segmented_collectives.py).
 """
 
 from __future__ import annotations
@@ -27,9 +43,11 @@ import struct
 import sys
 import threading
 import weakref
-from typing import Any, Optional, Tuple
+from typing import Any, List, Optional, Tuple, Union
 
 import numpy as np
+
+from .. import mpit as _mpit
 
 # u64 length word: top bit = raw-array frame, low 63 bits = body length
 RAW_FLAG = 1 << 63
@@ -39,19 +57,83 @@ META = struct.Struct("<I")  # meta-pickle length prefix inside a raw body
 _PROTO = pickle.HIGHEST_PROTOCOL
 
 
-def as_raw_array(payload: Any) -> Optional[np.ndarray]:
-    """The contiguous ndarray to ship raw, or None → use pickle.
+def raw_eligible(payload: Any) -> bool:
+    """Whether a payload can ship as raw bytes.  Exact-type check:
+    ndarray SUBCLASSES (MaskedArray, np.matrix, ...) carry state the raw
+    frame cannot represent — they keep the pickle path, which
+    round-trips them faithfully."""
+    return (type(payload) is np.ndarray and not payload.dtype.hasobject
+            and payload.dtype.kind != "V")
 
-    Exact-type check: ndarray SUBCLASSES (MaskedArray, np.matrix, ...)
-    carry state the raw frame cannot represent — they keep the pickle
-    path, which round-trips them faithfully."""
-    if (type(payload) is np.ndarray and not payload.dtype.hasobject
-            and payload.dtype.kind != "V"):
-        if payload.flags["C_CONTIGUOUS"]:
-            return payload
-        # compact a strided view (ascontiguousarray would also promote
-        # 0-dim to 1-dim, but 0-dim arrays are always contiguous)
-        return np.ascontiguousarray(payload)
+
+def _contiguous(arr: np.ndarray) -> np.ndarray:
+    if arr.flags["C_CONTIGUOUS"]:
+        return arr
+    # compact a strided view (ascontiguousarray would also promote
+    # 0-dim to 1-dim, but 0-dim arrays are always contiguous)
+    _mpit.count(copies=1)
+    return np.ascontiguousarray(arr)
+
+
+def as_raw_array(payload: Any) -> Optional[np.ndarray]:
+    """The contiguous ndarray to ship raw, or None → use pickle."""
+    if raw_eligible(payload):
+        return _contiguous(payload)
+    return None
+
+
+def as_raw_segments(payload: Any) -> Optional[List[np.ndarray]]:
+    """The contiguous ndarrays of a list payload to ship as ONE
+    multi-segment raw frame, or None → use pickle.
+
+    Only plain (non-empty) ``list`` payloads whose every element passes
+    the raw-array test qualify; tuples, empty lists, and mixed lists
+    keep the pickle path so arbitrary payload types round-trip with
+    full fidelity.  So does a list holding the SAME array object twice:
+    pickle's memo preserves that identity on the receiver (``got[0] is
+    got[1]``), which independent raw segments cannot — and a program
+    relying on it would silently read stale data after mutating one."""
+    if not _is_plain_raw_list(payload):
+        return None
+    return [_contiguous(item) for item in payload]
+
+
+def _is_plain_raw_list(payload: Any) -> bool:
+    """Whether a list payload gets element-wise array treatment — the ONE
+    predicate behind both the wire path (as_raw_segments) and the
+    self-send path (value_copy), so a self-send always mirrors what a
+    peer-send would do: plain non-empty list, every element raw-eligible,
+    no duplicate objects (pickle's memo must keep receiver-side
+    aliasing)."""
+    return (type(payload) is list and bool(payload)
+            and all(raw_eligible(item) for item in payload)
+            and len({id(item) for item in payload}) == len(payload))
+
+
+def _meta_nbytes(arr) -> int:
+    """Payload bytes from dtype+shape alone — the same duck-typed contract
+    the meta pickle itself uses (test_codec drives >2^31-element frame
+    arithmetic through stand-ins that carry only those two fields)."""
+    n = 1
+    for s in arr.shape:
+        n *= int(s)
+    return n * np.dtype(arr.dtype).itemsize
+
+
+def pack_raw_frame(ctx, tag: int,
+                   payload: Any) -> Optional[Tuple[bytes, Tuple[np.ndarray, ...]]]:
+    """The raw-frame plan for ``payload``: ``(head, bufs)`` where ``head``
+    is the length-prefixed meta and ``bufs`` the contiguous arrays whose
+    bytes follow it on the wire (single-array or multi-segment frame) —
+    or None → the payload must ride pickle.  The ONE place both
+    byte-stream transports decide a payload's frame kind, so their wire
+    behavior cannot diverge."""
+    arr = as_raw_array(payload)
+    if arr is not None:
+        return pack_raw_meta(ctx, tag, arr), (arr,)
+    segs = as_raw_segments(payload)
+    if segs is not None:
+        return pack_raw_segs_meta(ctx, tag, segs), tuple(segs)
     return None
 
 
@@ -59,6 +141,18 @@ def pack_raw_meta(ctx, tag: int, arr: np.ndarray) -> bytes:
     """``<u32 meta_len><meta pickle>`` — everything in the raw body except
     the array bytes themselves."""
     meta = pickle.dumps((ctx, tag, arr.dtype.str, arr.shape), protocol=_PROTO)
+    _mpit.count(bytes_raw=_meta_nbytes(arr))
+    return META.pack(len(meta)) + meta
+
+
+def pack_raw_segs_meta(ctx, tag: int, segs: List[np.ndarray]) -> bytes:
+    """Multi-segment meta: ``(ctx, tag, [(dtype.str, shape), ...])`` — a
+    3-tuple, distinguished from the single-array meta (a 4-tuple) by
+    arity, so both frame kinds share RAW_FLAG and the wire stays
+    backward compatible."""
+    meta = pickle.dumps((ctx, tag, [(a.dtype.str, a.shape) for a in segs]),
+                        protocol=_PROTO)
+    _mpit.count(bytes_raw=sum(int(a.nbytes) for a in segs))
     return META.pack(len(meta)) + meta
 
 
@@ -139,32 +233,75 @@ class _BufferPool:
 RECV_POOL = _BufferPool()
 
 
-def unpack_raw_meta(meta: bytes) -> Tuple[Any, int, np.ndarray]:
+RawPayload = Union[np.ndarray, List[np.ndarray]]
+
+
+def unpack_raw_meta(meta: bytes) -> Tuple[Any, int, RawPayload]:
     """Decode a raw frame's meta pickle; returns (ctx, tag, empty array to
-    read the raw bytes into — pooled at bandwidth sizes, see _BufferPool)."""
-    ctx, tag, dtype_str, shape = pickle.loads(meta)
-    return ctx, tag, RECV_POOL.empty(shape, np.dtype(dtype_str))
+    read the raw bytes into — pooled at bandwidth sizes, see _BufferPool).
+    A multi-segment meta (3-tuple, see pack_raw_segs_meta) yields a LIST
+    of destination arrays, each pooled independently, to be filled in
+    order from the frame body."""
+    tup = pickle.loads(meta)
+    if len(tup) == 4:
+        ctx, tag, dtype_str, shape = tup
+        return ctx, tag, RECV_POOL.empty(shape, np.dtype(dtype_str))
+    ctx, tag, descs = tup
+    return ctx, tag, [RECV_POOL.empty(shape, np.dtype(dtype_str))
+                      for dtype_str, shape in descs]
 
 
-def parse_raw_body(body: bytes) -> Tuple[Any, int, np.ndarray]:
+def raw_destinations(payload: RawPayload) -> List[np.ndarray]:
+    """The fill/drain order of a raw payload's buffers (single array or
+    multi-segment list) — the one place both transports iterate it."""
+    return payload if isinstance(payload, list) else [payload]
+
+
+def parse_raw_body(body: bytes) -> Tuple[Any, int, RawPayload]:
     """Decode an entire small raw body pulled in one read: meta prefix +
-    array bytes → (ctx, tag, array).  The .copy() both compacts and makes
-    the result writable/owned."""
+    array bytes → (ctx, tag, array-or-list).  The .copy() both compacts
+    and makes the result writable/owned."""
     (mlen,) = META.unpack_from(body)
-    ctx, tag, dtype_str, shape = pickle.loads(body[META.size:META.size + mlen])
-    dtype = np.dtype(dtype_str)
-    arr = np.frombuffer(body, dtype=dtype, offset=META.size + mlen).reshape(
-        shape).copy() if dtype.itemsize else np.empty(shape, dtype)
-    return ctx, tag, arr
+    tup = pickle.loads(body[META.size:META.size + mlen])
+    off = META.size + mlen
+
+    def take(dtype_str, shape):
+        nonlocal off
+        dtype = np.dtype(dtype_str)
+        n = int(np.prod(shape, dtype=np.int64)) if shape else 1
+        if not (n and dtype.itemsize):
+            return np.empty(shape, dtype)
+        arr = np.frombuffer(body, dtype=dtype, count=n,
+                            offset=off).reshape(shape).copy()
+        off += n * dtype.itemsize
+        return arr
+
+    if len(tup) == 4:
+        ctx, tag, dtype_str, shape = tup
+        return ctx, tag, take(dtype_str, shape)
+    ctx, tag, descs = tup
+    return ctx, tag, [take(ds, shape) for ds, shape in descs]
 
 
 def pack_pickle_body(ctx, tag: int, obj: Any) -> bytes:
-    return pickle.dumps((ctx, tag, obj), protocol=_PROTO)
+    blob = pickle.dumps((ctx, tag, obj), protocol=_PROTO)
+    _mpit.count(bytes_pickled=len(blob))
+    return blob
 
 
 def value_copy(payload: Any) -> Any:
-    """Self-send copy with message (value) semantics: cheap ndarray copy,
+    """Self-send copy with message (value) semantics: cheap ndarray copy
+    (also elementwise for all-ndarray lists, the multi-segment shape),
     pickle round-trip for everything else."""
     if isinstance(payload, np.ndarray):
+        _mpit.count(copies=1)
         return payload.copy()
+    if _is_plain_raw_list(payload):
+        # the shared predicate, not a bare type check: an object-dtype
+        # element's .copy() would be shallow, and a duplicate-object
+        # list must keep pickle's receiver-side aliasing — both cases
+        # ride the pickle deep copy below, exactly as a peer-send would
+        _mpit.count(copies=len(payload))
+        return [item.copy() for item in payload]
+    _mpit.count(copies=1)
     return pickle.loads(pickle.dumps(payload, protocol=_PROTO))

@@ -33,6 +33,9 @@ class FaultyTransport(Transport):
         self.world_size = inner.world_size
         self.mailbox = inner.mailbox
         self.aliases_payloads = inner.aliases_payloads
+        # decorate, don't re-tune: collectives through the fault injector
+        # must segment exactly like the wrapped data plane
+        self.coll_segment_hint = inner.coll_segment_hint
         self.drop_every = drop_every
         self.delay_s = delay_s
         self.duplicate_every = duplicate_every

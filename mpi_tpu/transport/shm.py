@@ -98,6 +98,13 @@ def _db_name(session: str, rank: int) -> bytes:
 
 
 class ShmTransport(Transport):
+    # The ring is a fixed _RING_BYTES (4MB) allocation per directed pair:
+    # the collective engine's in-flight credit (window * segment, see
+    # communicator._SEG_WINDOW) must stay well inside it or a symmetric
+    # exchange stalls on the periodic drainer.  256KB * window 4 = 1MB —
+    # a quarter ring — keeps the futex fast path hot at every sweep size.
+    coll_segment_hint = 256 << 10
+
     def __init__(self, rank: int, size: int, rdv_dir: str,
                  ring_bytes: int = _RING_BYTES,
                  connect_timeout: float = _OPEN_TIMEOUT) -> None:
@@ -234,14 +241,18 @@ class ShmTransport(Transport):
                 (mlen,) = codec.META.unpack(mbuf.raw)
                 meta = ctypes.create_string_buffer(mlen)
                 self._read_exact(ring, ctypes.addressof(meta), mlen, src)
-                ctx, tag, arr = codec.unpack_raw_meta(meta.raw)
-                if codec.META.size + mlen + arr.nbytes != body:
+                ctx, tag, out = codec.unpack_raw_meta(meta.raw)
+                dests = codec.raw_destinations(out)
+                total = sum(a.nbytes for a in dests)
+                if codec.META.size + mlen + total != body:
                     raise ValueError(
                         f"raw frame length mismatch: header says {body}, "
-                        f"meta implies {codec.META.size + mlen + arr.nbytes}")
-                # the single receive-side copy: ring -> final array
-                self._read_exact(ring, arr.ctypes.data, arr.nbytes, src)
-                return ctx, tag, arr
+                        f"meta implies {codec.META.size + mlen + total}")
+                # the single receive-side copy: ring -> final array(s)
+                for a in dests:
+                    if a.nbytes:
+                        self._read_exact(ring, a.ctypes.data, a.nbytes, src)
+                return ctx, tag, out
             payload = ctypes.create_string_buffer(body) if body else b""
             if body:
                 self._read_exact(ring, ctypes.addressof(payload), body, src)
@@ -455,10 +466,10 @@ class ShmTransport(Transport):
             # its full nap slice before noticing the local delivery
             self._lib.shmdb_ring(self._db)
             return
-        arr = codec.as_raw_array(payload)
-        if arr is not None:
-            head = codec.pack_raw_meta(ctx, tag, arr)
-            body = len(head) + arr.nbytes
+        frame = codec.pack_raw_frame(ctx, tag, payload)
+        if frame is not None:
+            head, bufs = frame
+            body = len(head) + sum(b.nbytes for b in bufs)
             header = _LEN.pack(codec.RAW_FLAG | body)
             with self._send_lock(dest):
                 if self._closing:  # close() may have held this lock first
@@ -466,18 +477,21 @@ class ShmTransport(Transport):
                         f"rank {self.world_rank}: send on a closed transport")
                 ring = self._out_ring_locked(dest)
                 if body <= _SMALL:
-                    frame = header + head + arr.tobytes()
+                    frame = header + head + b"".join(
+                        b.tobytes() for b in bufs)
                     self._write_all(ring, frame, len(frame), dest)
                     self._lib.shmdb_ring(self._out_dbs[dest])
                     return
                 # big frame: header+meta, bell, then the raw bytes straight
-                # from the array's own buffer — the single send-side copy
+                # from each array's own buffer — the single send-side copy
                 # is the in-C memcpy into the ring (see send() pickle path
                 # below for why the bell precedes the body)
                 prefix = header + head
                 self._write_all(ring, prefix, len(prefix), dest)
                 self._lib.shmdb_ring(self._out_dbs[dest])
-                self._write_all(ring, arr.ctypes.data, arr.nbytes, dest)
+                for b in bufs:
+                    if b.nbytes:
+                        self._write_all(ring, b.ctypes.data, b.nbytes, dest)
             return
         blob = codec.pack_pickle_body(ctx, tag, payload)
         with self._send_lock(dest):

@@ -67,6 +67,14 @@ def _recv_into_exact(sock: socket.socket, view: memoryview) -> bool:
 
 
 class SocketTransport(Transport):
+    # Loopback/intra-host TCP gets its exchange overlap from the kernel
+    # socket buffers; what the engine's segmentation costs it is per-frame
+    # host work (header + meta pickle + reader-thread delivery, all under
+    # the GIL).  Measured on the host sweep (benchmarks/results/
+    # host_sweep_post.json): 4MB segments beat 256KB by >3x at the 16MB
+    # allreduce point, so prefer few, large frames here.
+    coll_segment_hint = 4 << 20
+
     def __init__(
         self,
         rank: int,
@@ -131,8 +139,9 @@ class SocketTransport(Transport):
             word, _seq = _HEADER.unpack(head)
             plen = word & codec.LEN_MASK
             if word & codec.RAW_FLAG:
-                # raw-array frame: tiny meta pickle, then the bytes stream
-                # straight into the freshly-allocated result array
+                # raw frame: tiny meta pickle, then the bytes stream
+                # straight into the freshly-allocated result array(s) —
+                # one destination per segment for multi-segment frames
                 mhead = _recv_exact(conn, codec.META.size)
                 if mhead is None:
                     conn.close()
@@ -142,12 +151,30 @@ class SocketTransport(Transport):
                 if meta is None:
                     conn.close()
                     return
-                ctx, tag, arr = codec.unpack_raw_meta(meta)
-                if arr.nbytes and not _recv_into_exact(
-                        conn, memoryview(arr).cast("B")):
+                ctx, tag, out = codec.unpack_raw_meta(meta)
+                dests = codec.raw_destinations(out)
+                total = sum(a.nbytes for a in dests)
+                if codec.META.size + mlen + total != plen:
+                    # a frame whose meta disagrees with the length word
+                    # would desync the byte stream (the remainder of the
+                    # body parses as the next header) — kill the channel
+                    # and fail loudly instead (threading excepthook),
+                    # mirroring the shm receive path's mismatch check
+                    conn.close()
+                    raise ValueError(
+                        f"raw frame length mismatch from rank {src}: "
+                        f"header says {plen}, meta implies "
+                        f"{codec.META.size + mlen + total}")
+                ok = True
+                for arr in dests:
+                    if arr.nbytes and not _recv_into_exact(
+                            conn, memoryview(arr).cast("B")):
+                        ok = False
+                        break
+                if not ok:
                     conn.close()
                     return
-                self.mailbox.deliver(src, ctx, tag, arr)
+                self.mailbox.deliver(src, ctx, tag, out)
                 continue
             payload = _recv_exact(conn, plen)
             if payload is None:
@@ -219,20 +246,22 @@ class SocketTransport(Transport):
             # value-semantics copy (cheap .copy() for arrays)
             self.mailbox.deliver(dest, ctx, tag, codec.value_copy(payload))
             return
-        arr = codec.as_raw_array(payload)
-        if arr is not None:
-            head = codec.pack_raw_meta(ctx, tag, arr)
-            body = len(head) + arr.nbytes
+        frame = codec.pack_raw_frame(ctx, tag, payload)
+        if frame is not None:
+            head, bufs = frame
+            body = len(head) + sum(b.nbytes for b in bufs)
             with self._send_lock(dest):
                 conn = self._get_conn_locked(dest)
                 self._seq += 1
                 prefix = _HEADER.pack(codec.RAW_FLAG | body, self._seq) + head
                 try:
                     conn.sendall(prefix)
-                    if arr.nbytes:
-                        # sendall reads the array's buffer directly — the
-                        # payload is never pickled or re-copied host-side
-                        conn.sendall(memoryview(arr).cast("B"))
+                    for b in bufs:
+                        if b.nbytes:
+                            # sendall reads the array's buffer directly —
+                            # the payload is never pickled or re-copied
+                            # host-side
+                            conn.sendall(memoryview(b).cast("B"))
                 except OSError as e:
                     raise TransportError(
                         f"rank {self.world_rank}: send to rank {dest} "

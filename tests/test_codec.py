@@ -109,6 +109,128 @@ def test_pickle_fallbacks_still_work(name, world):
     assert all(world(prog, 2))
 
 
+# -- multi-segment raw frames (ISSUE 1: list-of-arrays zero-copy) ----------
+
+
+def test_multi_segment_eligibility():
+    """Only plain non-empty lists whose EVERY element is a plain
+    raw-eligible ndarray ride the multi-segment frame; everything else
+    keeps pickle's full type fidelity."""
+    ok = [np.arange(4.0), np.zeros((2, 3), np.int16)]
+    segs = codec.as_raw_segments(ok)
+    assert segs is not None and len(segs) == 2
+    assert all(s.flags["C_CONTIGUOUS"] for s in segs)
+    assert codec.as_raw_segments([]) is None                    # empty
+    assert codec.as_raw_segments(tuple(ok)) is None             # tuple
+    assert codec.as_raw_segments([np.arange(3), "x"]) is None   # mixed
+    assert codec.as_raw_segments(
+        [np.array([{}], object)]) is None                       # object dtype
+    rec = np.zeros(2, dtype=[("a", "i4")])
+    assert codec.as_raw_segments([rec]) is None                 # structured
+
+
+def test_aliased_list_keeps_pickle_identity():
+    """A list holding the SAME array twice stays on pickle, whose memo
+    preserves the aliasing on the receiver (got[0] is got[1]) —
+    independent raw segments (and per-element value_copy) cannot, and a
+    program mutating got[0] expecting got[1] to follow would silently
+    diverge."""
+    a = np.arange(4.0)
+    assert codec.as_raw_segments([a, a]) is None
+    copied = codec.value_copy([a, a])
+    assert copied[0] is copied[1]
+    assert copied[0] is not a and np.array_equal(copied[0], a)
+    # equal-but-distinct arrays still ride the raw frame
+    assert codec.as_raw_segments([a, a.copy()]) is not None
+
+
+def test_multi_segment_meta_roundtrip():
+    segs = [np.arange(5, dtype=np.float32),
+            np.arange(6, dtype=np.int64).reshape(2, 3)]
+    packed = codec.pack_raw_segs_meta(("c",), 9, segs)
+    (mlen,) = codec.META.unpack(packed[:codec.META.size])
+    ctx, tag, out = codec.unpack_raw_meta(packed[codec.META.size:
+                                                 codec.META.size + mlen])
+    assert ctx == ("c",) and tag == 9
+    assert isinstance(out, list) and len(out) == 2
+    for dst, src in zip(out, segs):
+        assert dst.shape == src.shape and dst.dtype == src.dtype
+
+
+SEG_LISTS = [
+    [np.arange(7.0)],                                    # single segment
+    [np.arange(5, dtype=np.float32),                     # mixed dtypes/shapes
+     np.arange(12, dtype=np.int64).reshape(3, 4),
+     np.array(2.5, np.float64)],                         # incl. 0-dim
+    [np.empty(0, np.float32), np.arange(3, dtype=np.int8)],  # empty segment
+    [np.random.RandomState(3).randn(1 << 16),            # 512KB each: the
+     np.random.RandomState(4).randn(1 << 16)],           # big streaming path
+    [(np.arange(40.0).reshape(5, 8))[::2, 1::3],         # non-contiguous
+     np.arange(4.0)],
+]
+
+
+@pytest.mark.parametrize("name,world", WORLDS, ids=[w[0] for w in WORLDS])
+def test_multi_segment_roundtrip(name, world):
+    """A list of arrays crosses both byte-stream transports as ONE raw
+    frame — values exact, no pickled array bytes."""
+    from mpi_tpu import mpit
+
+    def prog(comm):
+        if comm.rank == 0:
+            for i, lst in enumerate(SEG_LISTS):
+                comm.send(lst, dest=1, tag=i)
+            return True
+        for i, lst in enumerate(SEG_LISTS):
+            got = comm.recv(source=0, tag=i)
+            assert isinstance(got, list) and len(got) == len(lst)
+            for g, want in zip(got, lst):
+                assert g.dtype == want.dtype and g.shape == want.shape
+                np.testing.assert_array_equal(g, want)
+        return True
+
+    pickled_before = mpit.counters.bytes_pickled
+    assert all(world(prog, 2))
+    assert mpit.counters.bytes_pickled == pickled_before
+
+
+@pytest.mark.parametrize("name,world", WORLDS, ids=[w[0] for w in WORLDS])
+def test_multi_segment_pickle_fallback_object_dtype(name, world):
+    """A list containing an object-dtype array falls back to pickle —
+    and round-trips the objects faithfully (the fidelity the fallback
+    exists to preserve)."""
+    from mpi_tpu import mpit
+
+    lst = [np.arange(3.0), np.array([{"k": 1}, None], dtype=object)]
+    assert codec.as_raw_segments(lst) is None
+
+    def prog(comm):
+        if comm.rank == 0:
+            comm.send(lst, dest=1, tag=0)
+            return True
+        got = comm.recv(source=0, tag=0)
+        np.testing.assert_array_equal(got[0], lst[0])
+        assert got[1].dtype == object and got[1][0] == {"k": 1}
+        assert got[1][1] is None
+        return True
+
+    pickled_before = mpit.counters.bytes_pickled
+    assert all(world(prog, 2))
+    assert mpit.counters.bytes_pickled > pickled_before
+
+
+def test_multi_segment_self_send_value_semantics():
+    """Self-sent lists of arrays keep message (value) semantics per
+    element."""
+    from mpi_tpu.transport import codec as c
+
+    lst = [np.arange(4.0), np.ones(2)]
+    cp = c.value_copy(lst)
+    lst[0][:] = -1
+    np.testing.assert_array_equal(cp[0], np.arange(4.0))
+    np.testing.assert_array_equal(cp[1], np.ones(2))
+
+
 @pytest.mark.parametrize("name,world", WORLDS, ids=[w[0] for w in WORLDS])
 def test_raw_self_send_copies(name, world):
     """Self-sends keep value semantics: mutating after send must not

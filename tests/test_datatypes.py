@@ -4,6 +4,9 @@ path, and typed send/recv over the local backend."""
 
 import numpy as np
 import pytest
+pytest.importorskip(
+    "hypothesis",
+    reason="property tests need hypothesis, absent from this environment")
 from hypothesis import given, settings
 from hypothesis import strategies as st
 

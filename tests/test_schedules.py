@@ -5,6 +5,9 @@ invariant."""
 
 import numpy as np
 import pytest
+pytest.importorskip(
+    "hypothesis",
+    reason="property tests need hypothesis, absent from this environment")
 from hypothesis import given, settings
 from hypothesis import strategies as st
 

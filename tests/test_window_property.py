@@ -4,6 +4,11 @@ order; writes before gets; see mpi_tpu/window.py module docstring) — on
 BOTH the thread backend and the SPMD backend."""
 
 import numpy as np
+import pytest
+
+pytest.importorskip(
+    "hypothesis",
+    reason="property tests need hypothesis, absent from this environment")
 from hypothesis import given, settings
 from hypothesis import strategies as st
 
