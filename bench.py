@@ -550,12 +550,18 @@ def main() -> None:
 
 if __name__ == "__main__":
     if "--sweep" in sys.argv[1:]:
-        # the OSU-style host data-plane size sweep (ISSUE 1 tentpole #4);
-        # writes the post-change artifact next to the committed pre run
+        # the OSU-style host data-plane size sweep (ISSUE 1 tentpole #4,
+        # extended to alltoall/reduce_scatter/rabenseifner in ISSUE 2);
+        # writes the post-change artifact next to the committed pre run.
+        # --quick is the tier-1 smoke spelling (tiny sizes, 1 sample) that
+        # keeps the sweep harness from bit-rotting between perf PRs.
         from benchmarks import host_sweep
 
+        if "--quick" in sys.argv[1:]:
+            # smoke run: stdout only, no artifact to leak or overwrite
+            sys.exit(host_sweep.main(["--label", "post", "--quick"]))
         sys.exit(host_sweep.main(
             ["--label", "post",
              "--out", os.path.join(REPO, "benchmarks", "results",
-                                   "host_sweep_post.json")]))
+                                   "host_sweep2_post.json")]))
     main()

@@ -2,14 +2,14 @@
 BASELINE.json:2,7-10).
 
 Benchmarks: ``latency`` (ping-pong), ``bcast``, ``reduce``, ``allreduce``,
-``allgather``, ``alltoall`` — swept over message sizes and algorithm
-variants on any backend.  Output is JSON lines so BASELINE.md tables
-regenerate mechanically (SURVEY.md §5 observability row).
+``allgather``, ``alltoall``, ``reduce_scatter`` — swept over message sizes
+and algorithm variants on any backend.  Output is JSON lines so BASELINE.md
+tables regenerate mechanically (SURVEY.md §5 observability row).
 
 Bus-bandwidth follows the NCCL-tests convention (SURVEY.md §6):
-allreduce ``bytes × 2(P−1)/P ÷ t``; allgather/alltoall ``bytes × (P−1)/P ÷
-t`` where bytes is the full gathered/exchanged payload; bcast/reduce
-``bytes ÷ t``.
+allreduce ``bytes × 2(P−1)/P ÷ t``; allgather/alltoall/reduce_scatter
+``bytes × (P−1)/P ÷ t`` where bytes is the full gathered/exchanged/
+reduced payload; bcast/reduce ``bytes ÷ t``.
 
 Usage::
 
@@ -71,7 +71,7 @@ def busbw_gbps(bench: str, nbytes: int, p: int, seconds: float) -> float:
         return float("inf")
     if bench == "allreduce":
         moved = nbytes * 2 * (p - 1) / p
-    elif bench in ("allgather", "alltoall"):
+    elif bench in ("allgather", "alltoall", "reduce_scatter"):
         moved = nbytes * (p - 1) / p
     else:  # bcast, reduce
         moved = nbytes
@@ -95,6 +95,11 @@ def _cpu_collective_call(comm, bench: str, x: np.ndarray, algo: str):
     if bench == "alltoall":
         blocks = np.array_split(x, comm.size)
         return comm.alltoall(blocks, algorithm=algo)
+    if bench == "reduce_scatter":
+        # nbytes is the TOTAL per-rank input (one block per destination
+        # rank), matching the alltoall convention
+        blocks = np.array_split(x, comm.size)
+        return comm.reduce_scatter(blocks, algorithm=algo)
     raise ValueError(f"unknown benchmark {bench!r}")
 
 
@@ -278,6 +283,10 @@ def tpu_bench(bench: str, sizes: List[int], algos: List[str], iters: int,
                     def body(x, a=algo):
                         return comm.alltoall(x[0], algorithm=a)[None]
                     xg = jnp.zeros((p, p, max(1, n // p)), jnp.float32)
+                elif bench == "reduce_scatter":
+                    def body(x, a=algo):
+                        return comm.reduce_scatter(x[0], algorithm=a)[None]
+                    xg = jnp.zeros((p, p, max(1, n // p)), jnp.float32)
                 else:
                     raise ValueError(f"unknown benchmark {bench!r}")
 
@@ -309,13 +318,14 @@ def tpu_bench(bench: str, sizes: List[int], algos: List[str], iters: int,
 # ---------------------------------------------------------------------------
 
 ALL_BENCHES = ["latency", "bw", "bcast", "reduce", "allreduce", "allgather",
-               "alltoall"]
+               "alltoall", "reduce_scatter"]
 DEFAULT_ALGOS = {
     "allreduce": ["ring", "recursive_halving", "fused"],  # + pallas_ring (tpu, opt-in)
     "bcast": ["tree", "fused"],
     "reduce": ["tree", "fused"],
     "allgather": ["ring", "doubling", "fused"],
     "alltoall": ["pairwise", "fused"],
+    "reduce_scatter": ["ring", "fused"],
     "latency": ["-"],
     "bw": ["-"],
 }

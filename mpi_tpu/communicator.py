@@ -69,6 +69,38 @@ _SEG_WINDOW = 4
 # noise at bandwidth sizes but real at latency sizes.
 _BCAST_SEGMENT_MIN_BYTES = 1 << 20
 
+# Below this TOTAL payload size reduce_scatter keeps the seed's simple
+# per-chunk ring: the segmented engine's working-buffer flatten, irecv
+# posting and result copy-out are noise at bandwidth sizes but real at
+# latency sizes.  Measured (host_sweep2_{pre,post}.json): the segmented
+# ring wins from 1MB up on socket (1MB p50 646us -> 255us) and from 4MB
+# up on shm (3700us -> 687us; the shm 1MB cell is a wash — 1148us ->
+# 1279us, inside that box's 2-core noise band), and loses below 256KB
+# on both.  The gate follows the socket signal; the shm 1MB tie is the
+# accepted cost of one engine-wide constant.  A nonzero
+# collective_segment_bytes cvar LOWERS the gate to payloads spanning
+# more than one configured segment — steering the engine to, say, 64B
+# segments says segmentation is wanted wherever it produces a pipeline
+# (how the parity tests force multi-segment exchanges on tiny
+# payloads), while a bandwidth-tuned 8MB segment leaves small
+# reduce_scatters on the cheap per-chunk path.
+_RS_SEGMENT_MIN_BYTES = 1 << 20
+
+# Above this size, allreduce 'auto' hands the payload to the Rabenseifner
+# composition (block-ring reduce_scatter + ring allgather [S: Thakur et
+# al.]) instead of the classic ring.  Both move 2(P-1)/P·N per rank;
+# unlike recursive halving the composition works for ANY group size,
+# which is why it gets its own crossover rather than reusing
+# _RING_CROSSOVER_BYTES.  Derived from the measured sweep: the smallest
+# bandwidth-regime size from which the composition's p50 stays within
+# 10% of ring's at every larger size AND strictly beats it in the tail,
+# on BOTH host transports (benchmarks/results/host_sweep2_post.json
+# "rabenseifner_crossover.combined_bytes" = 1MB; equal-volume schedules
+# tie by construction, so the tolerant rule is what survives this box's
+# 2-core noise — see benchmarks/host_sweep.py _RABEN_TIE).
+# mpit cvar: allreduce_rabenseifner_crossover_bytes.
+_RABENSEIFNER_CROSSOVER_BYTES = 1 << 20
+
 _TAG_COLL = -2
 _TAG_SHIFT = -3
 _TAG_BARRIER = -4
@@ -143,6 +175,37 @@ class Status:
 def _check_user_tag(tag: int) -> None:
     if tag != ANY_TAG and tag < 0:
         raise ValueError(f"user tags must be >= 0 (got {tag}); negative tags are reserved")
+
+
+def _resolve_algorithm(coll: str, algorithm: str, real: Tuple[str, ...],
+                       aliases: dict) -> str:
+    """The ONE ``algorithm=`` gate for the host collectives: aliases are
+    EXPLICIT (e.g. ``'fused'`` — the TPU backend's XLA-collective tier —
+    maps to the best process-backend schedule so portable programs run
+    unchanged), real names pass through, and anything else raises the
+    same-shaped error everywhere, listing every accepted value.  Before
+    this helper each collective validated ad hoc: alltoall accepted
+    'fused' but silently ran pairwise with no documentation, and the
+    error messages never said what WAS accepted."""
+    if algorithm in aliases:
+        return aliases[algorithm]
+    if algorithm in real:
+        return algorithm
+    accepted = sorted(set(real) | set(aliases))
+    raise ValueError(
+        f"unknown {coll} algorithm {algorithm!r}; accepted: {accepted}")
+
+
+def _unpost(reqs: Sequence["_RecvRequest"]) -> None:
+    """Failure path of a collective that posted internal irecvs: remove
+    the not-yet-completed ones from their posted queues.  A stale queue
+    head would silently absorb the first frames of any LATER collective
+    on the same (source, _TAG_COLL) channel and misfold; un-posting at
+    least fails the next operation loudly (in-flight peer bytes may
+    still arrive — see _seg_exchange)."""
+    for req in reqs:
+        if not req._done and req in req._queue:
+            req._queue.remove(req)
 
 
 def _as_array(obj: Any) -> Tuple[np.ndarray, bool]:
@@ -1066,16 +1129,17 @@ class P2PCommunicator(Communicator):
     # -- collectives -------------------------------------------------------
 
     def bcast(self, obj: Any, root: int = 0, algorithm: str = "auto") -> Any:
+        """MPI_Bcast.  ``algorithm``: ``"tree"`` (binomial tree, log2(P)
+        rounds — BASELINE.json:8); ``"auto"`` and ``"fused"`` (the TPU
+        backend's XLA-collective tier, no socket analogue) are aliases
+        of it.  Large contiguous arrays take the SEGMENTED pipelined
+        tree: the root announces the geometry with a _SegHeader, then
+        every rank forwards each segment to its children the moment it
+        lands — cut-through through tree levels instead of the seed's
+        store-and-forward whole frames."""
         _mpit.count(collectives=1)
-        # Binomial tree, log2(P) rounds (BASELINE.json:8).  'fused' (the TPU
-        # backend's XLA-collective path) has no socket analogue and aliases
-        # to the tree so portable programs run unchanged.  Large contiguous
-        # arrays take the SEGMENTED pipelined tree: the root announces the
-        # geometry with a _SegHeader, then every rank forwards each segment
-        # to its children the moment it lands — cut-through through tree
-        # levels instead of the seed's store-and-forward whole frames.
-        if algorithm not in ("auto", "tree", "fused"):
-            raise ValueError(f"unknown bcast algorithm {algorithm!r}")
+        _resolve_algorithm("bcast", algorithm, ("tree",),
+                           {"auto": "tree", "fused": "tree"})
         self._world(root)  # validate
         if self.size == 1:
             return obj
@@ -1131,9 +1195,12 @@ class P2PCommunicator(Communicator):
 
     def reduce(self, obj: Any, op: _ops.ReduceOp = _ops.SUM, root: int = 0,
                algorithm: str = "auto") -> Any:
+        """MPI_Reduce.  ``algorithm``: ``"tree"`` (binomial tree with
+        in-place folds); ``"auto"`` and ``"fused"`` are aliases of it on
+        process backends."""
         _mpit.count(collectives=1)
-        if algorithm not in ("auto", "tree", "fused"):  # 'fused' aliases tree here
-            raise ValueError(f"unknown reduce algorithm {algorithm!r}")
+        _resolve_algorithm("reduce", algorithm, ("tree",),
+                           {"auto": "tree", "fused": "tree"})
         self._world(root)  # validate
         arr, scalar = _as_array(obj)
         acc = arr.copy()
@@ -1149,16 +1216,33 @@ class P2PCommunicator(Communicator):
 
     def allreduce(self, obj: Any, op: _ops.ReduceOp = _ops.SUM,
                   algorithm: str = "auto") -> Any:
+        """MPI_Allreduce.  ``algorithm``: ``"ring"`` (bandwidth-optimal
+        reduce-scatter ring + allgather ring), ``"recursive_halving"``
+        (latency-optimal, power-of-two groups only), ``"rabenseifner"``
+        (block-ring reduce_scatter + ring allgather composition [S:
+        Thakur et al.], any group size), ``"reduce_bcast"`` (naive
+        reference), or ``"auto"`` — halving below the measured
+        _RING_CROSSOVER_BYTES on pow2 groups, rabenseifner at or above
+        _RABENSEIFNER_CROSSOVER_BYTES, ring in between.  ``"fused"``
+        (the TPU tier) aliases to ``"auto"`` on process backends."""
         _mpit.count(collectives=1)
         arr, scalar = _as_array(obj)
-        if algorithm == "fused":  # no fused path on sockets; best schedule
-            algorithm = "auto"
+        algorithm = _resolve_algorithm(
+            "allreduce", algorithm,
+            ("auto", "ring", "recursive_halving", "rabenseifner",
+             "reduce_bcast"),
+            {"fused": "auto"})  # no fused path on sockets; best schedule
         if algorithm == "auto":
-            # Latency-optimal recursive halving for small payloads on
-            # power-of-two groups; bandwidth-optimal ring otherwise
+            # The Rabenseifner composition once the measured sweep shows
+            # it stably at-or-below ring (checked FIRST so lowering its
+            # cvar below the ring crossover takes effect on pow2 groups
+            # too); latency-optimal recursive halving for small payloads
+            # on power-of-two groups; bandwidth-optimal ring otherwise
             # (the crossover the reference benchmarks head-to-head,
             # BASELINE.json:10).
-            if schedules.is_pow2(self.size) and \
+            if arr.nbytes >= _RABENSEIFNER_CROSSOVER_BYTES:
+                algorithm = "rabenseifner"
+            elif schedules.is_pow2(self.size) and \
                     arr.nbytes < _RING_CROSSOVER_BYTES:
                 algorithm = "recursive_halving"
             else:
@@ -1169,10 +1253,10 @@ class P2PCommunicator(Communicator):
             out = self._allreduce_ring(arr, op)
         elif algorithm == "recursive_halving":
             out = self._allreduce_halving(arr, op)
-        elif algorithm == "reduce_bcast":
+        elif algorithm == "rabenseifner":
+            out = self._allreduce_rabenseifner(arr, op)
+        else:  # reduce_bcast
             out = self.bcast(self.reduce(arr, op, root=0), root=0)
-        else:
-            raise ValueError(f"unknown allreduce algorithm {algorithm!r}")
         return _unwrap(np.asarray(out), scalar)
 
     # -- segmented collective engine (ISSUE 1 tentpole) --------------------
@@ -1253,9 +1337,7 @@ class P2PCommunicator(Communicator):
             # same peer (the blocking seed path left no such residue).
             # In-flight peer bytes may still arrive; un-posting at least
             # fails the NEXT operation loudly instead of misfolding.
-            for req in reqs:
-                if not req._done and req in req._queue:
-                    req._queue.remove(req)
+            _unpost(reqs)
             raise
 
     def _allreduce_ring(self, arr: np.ndarray, op: _ops.ReduceOp) -> np.ndarray:
@@ -1313,10 +1395,45 @@ class P2PCommunicator(Communicator):
             lo, hi = (rb[0], hi) if r & mask else (lo, rb[1])
         return work.reshape(shape)
 
+    def _allreduce_rabenseifner(self, arr: np.ndarray,
+                                op: _ops.ReduceOp) -> np.ndarray:
+        # The Rabenseifner composition [S: Thakur et al.]: block-ring
+        # reduce_scatter (rank r ends owning fully reduced chunk r, the
+        # MPI_Reduce_scatter_block schedule) + ring allgather of the
+        # reduced chunks — the same 2(P-1) segmented exchange steps and
+        # 2(P-1)/P·N volume as _allreduce_ring, but phase one IS the
+        # reduce_scatter collective's schedule, so allreduce and
+        # reduce_scatter share one measured data plane.  Works for any
+        # group size (recursive halving needs pow2).
+        p, r = self.size, self._rank
+        shape = arr.shape
+        work = arr.flatten()  # flatten always copies — our mutable buffer
+        offs = schedules.chunk_offsets(work.size, p)
+        right, left = (r + 1) % p, (r - 1) % p
+        for step in range(p - 1):
+            si = schedules.ring_rs_block_send_chunk(r, step, p)
+            ri = schedules.ring_rs_block_recv_chunk(r, step, p)
+            self._seg_exchange(work, (offs[si], offs[si + 1]),
+                               (offs[ri], offs[ri + 1]), right, left, op)
+        for step in range(p - 1):
+            si = schedules.ring_ag_block_send_chunk(r, step, p)
+            ri = schedules.ring_ag_block_recv_chunk(r, step, p)
+            self._seg_exchange(work, (offs[si], offs[si + 1]),
+                               (offs[ri], offs[ri + 1]), right, left)
+        return work.reshape(shape)
+
     def allgather(self, obj: Any, algorithm: str = "auto") -> List[Any]:
+        """MPI_Allgather.  ``algorithm``: ``"ring"`` (rotating row views
+        of one [P, ...] buffer, raw frames), ``"doubling"`` (recursive
+        doubling, log P rounds, pow2 groups only), or ``"auto"`` —
+        doubling on pow2 groups, ring otherwise.  ``"fused"`` (the TPU
+        tier) aliases to ``"auto"`` on process backends."""
         _mpit.count(collectives=1)
         p, r = self.size, self._rank
-        if algorithm in ("auto", "fused"):  # no fused path on sockets
+        algorithm = _resolve_algorithm(
+            "allgather", algorithm, ("auto", "ring", "doubling"),
+            {"fused": "auto"})  # no fused path on sockets
+        if algorithm == "auto":
             # The pick may depend ONLY on the group shape, never on the
             # rank-local payload: ragged allgather is supported, so a
             # size- or type-conditioned pick could choose wire-incompatible
@@ -1411,18 +1528,44 @@ class P2PCommunicator(Communicator):
         return _maybe_stack(obj, items)
 
     def alltoall(self, objs: Sequence[Any], algorithm: str = "auto") -> List[Any]:
+        """MPI_Alltoall.  ``algorithm``: ``"pairwise"`` (windowed
+        nonblocking pairwise exchange, P-1 rounds — BASELINE.json:9);
+        ``"auto"`` and ``"fused"`` (the TPU tier) are aliases of it on
+        process backends.
+
+        All P-1 receives are posted up front (each source is a distinct
+        FIFO channel, so posted order is arrival order per peer) and the
+        P-1 sends run at most _SEG_WINDOW rounds ahead of the completed
+        receives: every payload is already in flight — as a raw (or
+        multi-segment raw) frame for array payloads — while earlier
+        rounds complete, instead of the seed's P-1 serialized blocking
+        sendrecv rounds, and the window keeps a symmetric exchange from
+        parking more than window payloads in the shm ring with nobody
+        draining."""
         _mpit.count(collectives=1)
         p, r = self.size, self._rank
-        if algorithm not in ("auto", "fused", "pairwise"):
-            raise ValueError(f"unknown alltoall algorithm {algorithm!r}")
+        _resolve_algorithm("alltoall", algorithm, ("pairwise",),
+                           {"auto": "pairwise", "fused": "pairwise"})
         if len(objs) != p:
             raise ValueError(f"alltoall needs one payload per rank ({p}), got {len(objs)}")
         result: List[Any] = [None] * p
         result[r] = objs[r]
-        # Pairwise exchange, P-1 rounds (BASELINE.json:9; SURVEY.md §2 #9).
-        for k in schedules.alltoall_rounds(p):
-            dst, src = (r + k) % p, (r - k) % p
-            result[src] = self._sendrecv_internal(objs[dst], dst, src, _TAG_COLL)
+        rounds = schedules.alltoall_rounds(p)
+        reqs = [self._irecv_internal((r - k) % p, _TAG_COLL) for k in rounds]
+        done = 0
+        try:
+            for i, k in enumerate(rounds):
+                dst = (r + k) % p
+                self._send_internal(objs[dst], dst, _TAG_COLL)
+                if i - done >= _SEG_WINDOW:
+                    result[(r - rounds[done]) % p] = reqs[done].wait()
+                    done += 1
+            while done < len(reqs):
+                result[(r - rounds[done]) % p] = reqs[done].wait()
+                done += 1
+        except BaseException:
+            _unpost(reqs)
+            raise
         return _maybe_stack(objs, result)
 
     def barrier(self) -> None:
@@ -1436,7 +1579,9 @@ class P2PCommunicator(Communicator):
     def scan(self, obj: Any, op: _ops.ReduceOp = _ops.SUM) -> Any:
         _mpit.count(collectives=1)
         # Hillis-Steele inclusive scan: log2(P) rounds of distance-doubling
-        # partial prefixes [S].
+        # partial prefixes [S].  The partial-prefix payload is always a
+        # contiguous ndarray, so every round ships it as a raw frame —
+        # never pickled (asserted in tests/test_segmented_collectives2.py).
         arr, scalar = _as_array(obj)
         acc = arr.copy()
         p, r = self.size, self._rank
@@ -1446,22 +1591,108 @@ class P2PCommunicator(Communicator):
                 self._send_internal(acc, r + d, _TAG_COLL)
             if r - d >= 0:
                 recvd = self._recv_internal(r - d, _TAG_COLL)
-                acc = op.combine(recvd, acc)  # received prefix goes LEFT
+                # received prefix goes LEFT.  On serializing transports
+                # the received buffer is freshly allocated and private,
+                # so the fold can run in place into it — one allocation
+                # per round saved; aliasing transports (local
+                # copy_payloads=False) hand us a reference to the
+                # SENDER's accumulator, which must never be mutated.
+                if (not self._t.aliases_payloads
+                        and type(recvd) is np.ndarray
+                        and recvd.shape == acc.shape
+                        and recvd.dtype == acc.dtype):
+                    acc = op.combine_into(recvd, acc)
+                else:
+                    acc = op.combine(recvd, acc)
             d *= 2
         return _unwrap(acc, scalar)
 
+    @staticmethod
+    def _blocks_nbytes(blocks: Any) -> int:
+        """Total payload size of a reduce_scatter input, copy-free (for
+        the segmentation gate): homogeneous blocks are assumed — the
+        heterogeneous case never reaches the segmented path anyway."""
+        if isinstance(blocks, np.ndarray):
+            return int(blocks.nbytes)
+        return int(np.asarray(blocks[0]).nbytes) * len(blocks)
+
+    def _blocks_as_array(self, blocks: Any) -> Optional[np.ndarray]:
+        """The [P, ...] array view of a reduce_scatter payload when every
+        block agrees in dtype+shape and the dtype is raw-frame friendly —
+        the eligibility test of the segmented ring.  None → the generic
+        per-chunk path (heterogeneous block shapes, object dtypes)."""
+        if isinstance(blocks, np.ndarray):
+            arr = np.asarray(blocks)  # strips ndarray subclasses' state,
+        else:                         # exactly like the per-chunk asarray
+            first = np.asarray(blocks[0])
+            for b in blocks[1:]:
+                a = np.asarray(b)
+                if a.dtype != first.dtype or a.shape != first.shape:
+                    return None
+            arr = np.asarray(blocks)
+        if arr.dtype.hasobject or arr.dtype.kind == "V":
+            return None
+        return arr
+
     def reduce_scatter(self, blocks: Any, op: _ops.ReduceOp = _ops.SUM,
                        algorithm: str = "auto") -> Any:
+        """MPI_Reduce_scatter_block [S]: ``blocks`` holds one block per
+        rank (leading dimension == size); rank r gets the reduction of
+        everyone's block r.  ``algorithm``: ``"ring"`` (P-1 steps —
+        segmented on one contiguous working buffer when the blocks are
+        homogeneous arrays, generic per-chunk exchange otherwise);
+        ``"auto"`` and ``"fused"`` (the TPU tier) are aliases of it on
+        process backends.
+
+        The segmented path is the same engine as the ring allreduce:
+        every wire payload is a contiguous view of one flat [P·n]
+        buffer, folds are in-place (op.combine_into), and each of the
+        P-1 exchange steps pipelines via schedules.segment_spans — the
+        seed path's per-step block copy, combine allocation, and
+        blocking sendrecv serialization are all gone."""
         _mpit.count(collectives=1)
         p, r = self.size, self._rank
-        if algorithm in ("auto", "fused"):
-            algorithm = "ring"
-        if algorithm != "ring":
-            raise ValueError(f"unknown reduce_scatter algorithm {algorithm!r}")
+        _resolve_algorithm("reduce_scatter", algorithm, ("ring",),
+                           {"auto": "ring", "fused": "ring"})
         if len(blocks) != p:
             raise ValueError(
                 f"reduce_scatter needs one block per rank ({p}), got {len(blocks)}")
-        chunks = [np.asarray(b).copy() for b in blocks]
+        # Size-gate BEFORE _blocks_as_array: for list payloads eligibility
+        # stacks the blocks into the working buffer, a copy the per-chunk
+        # path below would throw away (same discipline as the segmented
+        # bcast's eligibility gate).
+        nbytes = self._blocks_nbytes(blocks)
+        use_seg = (nbytes >= _RS_SEGMENT_MIN_BYTES
+                   or 0 < _SEGMENT_BYTES < nbytes)
+        arr = self._blocks_as_array(blocks) if use_seg and p > 1 else None
+        if arr is not None:
+            was_scalar = arr.ndim == 1
+            shape = arr.shape[1:]
+            # list payloads: np.asarray already STACKED the blocks into a
+            # fresh contiguous buffer nobody else holds — reshape is the
+            # working buffer with zero extra copies; ndarray payloads
+            # alias the caller's memory, so flatten's copy is mandatory
+            work = (arr.reshape(-1) if not isinstance(blocks, np.ndarray)
+                    else arr.flatten())
+            bn = work.size // p
+            right, left = (r + 1) % p, (r - 1) % p
+            for step in range(p - 1):
+                si = schedules.ring_rs_block_send_chunk(r, step, p)
+                ri = schedules.ring_rs_block_recv_chunk(r, step, p)
+                self._seg_exchange(work, (si * bn, (si + 1) * bn),
+                                   (ri * bn, (ri + 1) * bn), right, left, op)
+            # own block copied out so the P·n working buffer is released
+            return _unwrap(work[r * bn:(r + 1) * bn].reshape(shape).copy(),
+                           was_scalar)
+        # Generic path (per-destination block shapes/dtypes differ):
+        # only the chunks this rank folds INTO need a private copy — the
+        # ring's fold targets are every chunk except (r-1)%p, which is
+        # sent in step 0 and never touched again, so it stays a view of
+        # the caller's data (_coll_payload snapshots it iff the
+        # transport delivers by reference).
+        view_only = (r - 1) % p
+        chunks = [np.asarray(b) if i == view_only and p > 1
+                  else np.asarray(b).copy() for i, b in enumerate(blocks)]
         was_scalar = chunks[0].ndim == 0
         if p == 1:
             return _unwrap(chunks[0], was_scalar)
@@ -1469,11 +1700,30 @@ class P2PCommunicator(Communicator):
         for step in range(p - 1):
             si = schedules.ring_rs_block_send_chunk(r, step, p)
             ri = schedules.ring_rs_block_recv_chunk(r, step, p)
-            recvd = self._sendrecv_internal(chunks[si], right, left, _TAG_COLL)
-            chunks[ri] = op.combine(chunks[ri], recvd)
-        return _unwrap(np.asarray(chunks[r]), was_scalar)
+            payload = self._coll_payload(chunks[si]) if step == 0 \
+                else chunks[si]
+            recvd = self._sendrecv_internal(payload, right, left, _TAG_COLL)
+            mine = chunks[ri]
+            # in-place fold only when the received chunk matches ours
+            # exactly — cross-rank dtype/shape drift (tolerated by the
+            # seed via numpy promotion) keeps the allocating combine,
+            # the same guard scan applies (MPI requires congruent
+            # payloads, but a silent semantics change is worse)
+            if (type(recvd) is np.ndarray and recvd.shape == mine.shape
+                    and recvd.dtype == mine.dtype):
+                op.combine_into(mine, recvd)
+            else:
+                chunks[ri] = np.asarray(op.combine(mine, recvd))
+        return _unwrap(chunks[r], was_scalar)
 
     def scatter(self, objs: Optional[Sequence[Any]], root: int = 0) -> Any:
+        """MPI_Scatter: rank d receives ``objs[d]`` from ``root``.  The
+        root's fan-out is nonblocking — every payload is enqueued on the
+        transport (a raw frame for array payloads, never pickled array
+        bytes) before any peer's receive completes, so one slow child
+        cannot serialize the others."""
+        _mpit.count(collectives=1)
+        self._world(root)  # validate
         if self._rank == root:
             if objs is None or len(objs) != self.size:
                 raise ValueError(f"scatter root needs one payload per rank ({self.size})")
@@ -1484,12 +1734,24 @@ class P2PCommunicator(Communicator):
         return self._recv_internal(root, _TAG_COLL)
 
     def gather(self, obj: Any, root: int = 0) -> Optional[List[Any]]:
+        """MPI_Gather: root returns ``[payload_0, ..., payload_{P-1}]``.
+        The root posts every receive up front (nonblocking fan-in): each
+        source is its own FIFO channel, so arrivals buffer concurrently
+        instead of the seed's serialized rank-order recv loop, and array
+        payloads ride raw frames end to end."""
+        _mpit.count(collectives=1)
+        self._world(root)  # validate
         if self._rank == root:
             items: List[Any] = [None] * self.size
             items[root] = obj
-            for s in range(self.size):
-                if s != root:
-                    items[s] = self._recv_internal(s, _TAG_COLL)
+            srcs = [s for s in range(self.size) if s != root]
+            reqs = [self._irecv_internal(s, _TAG_COLL) for s in srcs]
+            try:
+                for s, req in zip(srcs, reqs):
+                    items[s] = req.wait()
+            except BaseException:
+                _unpost(reqs)
+                raise
             return items
         self._send_internal(obj, root, _TAG_COLL)
         return None

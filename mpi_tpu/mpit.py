@@ -183,6 +183,12 @@ def _ensure_builtin_cvars() -> None:
     def _set_cross(v):
         _c._RING_CROSSOVER_BYTES = int(v)
 
+    def _get_raben():
+        return _c._RABENSEIFNER_CROSSOVER_BYTES
+
+    def _set_raben(v):
+        _c._RABENSEIFNER_CROSSOVER_BYTES = int(v)
+
     def _get_seg():
         return _c._SEGMENT_BYTES
 
@@ -205,6 +211,13 @@ def _ensure_builtin_cvars() -> None:
             "CPU-backend allreduce auto algorithm picks latency-optimal "
             "recursive halving below this payload size (pow2 groups), "
             "bandwidth-optimal ring at or above it")
+        _CVARS["allreduce_rabenseifner_crossover_bytes"] = (
+            _get_raben, _set_raben,
+            "CPU-backend allreduce auto algorithm hands payloads at or "
+            "above this size to the Rabenseifner composition (block-ring "
+            "reduce_scatter + ring allgather, any group size) instead of "
+            "the classic ring; derived from the measured host sweep "
+            "(benchmarks/results/host_sweep2_post.json)")
         _CVARS["collective_segment_bytes"] = (
             _get_seg, _set_seg,
             "pipeline segment size of the host collective engine: element "
@@ -214,7 +227,10 @@ def _ensure_builtin_cvars() -> None:
             "transport's coll_segment_hint (shm: stay inside the ring; "
             "socket: amortize per-frame host work); nonzero overrides "
             "every transport (keep window*segment below the shm ring "
-            "capacity; see communicator._SEG_WINDOW)")
+            "capacity; see communicator._SEG_WINDOW) and also lowers "
+            "reduce_scatter's segmented-path gate to any payload "
+            "spanning more than one segment (default gate: "
+            "communicator._RS_SEGMENT_MIN_BYTES)")
         _CVARS["gather_replicated_warn_bytes"] = (
             lambda: _GATHER_WARN_BYTES[0],
             lambda v: _GATHER_WARN_BYTES.__setitem__(0, int(v)),

@@ -178,6 +178,21 @@ def ring_rs_block_recv_chunk(rank, step: int, size: int):
     return (rank - step - 2) % size
 
 
+# Allgather ring for block-distributed chunks: rank r starts holding
+# chunk r (the state the block reduce-scatter above ends in) and rotates
+# — at step s it sends chunk (r-s) mod P right and receives (r-s-1) mod P
+# from the left.  Composing the two IS the Rabenseifner allreduce
+# [S: Thakur et al.]: reduce_scatter + allgather over one buffer.
+
+
+def ring_ag_block_send_chunk(rank, step: int, size: int):
+    return (rank - step) % size
+
+
+def ring_ag_block_recv_chunk(rank, step: int, size: int):
+    return (rank - step - 1) % size
+
+
 # ---------------------------------------------------------------------------
 # Recursive halving / doubling (allreduce, allgather — BASELINE.json:10)
 # ---------------------------------------------------------------------------

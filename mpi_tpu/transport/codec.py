@@ -33,7 +33,11 @@ Byte-level observability: every frame build counts into the mpit pvars
 ``bytes_raw_sent`` / ``bytes_pickled_sent``; host-side payload copies
 (self-send value copies, non-contiguous compactions) count into
 ``payload_copies`` — the counters that prove a hot path stayed on the
-one-copy plane (asserted in tests/test_segmented_collectives.py).
+one-copy plane.  Asserted for allreduce/bcast/allgather in
+tests/test_segmented_collectives.py and for the rest of the family
+(alltoall, reduce_scatter, the Rabenseifner composition, scatter/
+gather, scan) in tests/test_segmented_collectives2.py — on BOTH
+byte-stream transports.
 """
 
 from __future__ import annotations

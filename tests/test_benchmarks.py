@@ -31,16 +31,43 @@ def test_busbw_convention():
     assert busbw_gbps("bcast", 10**9, 4, 1.0) == pytest.approx(1.0)
 
 
-@pytest.mark.parametrize("bench", ["latency", "allreduce", "allgather", "alltoall"])
+@pytest.mark.parametrize("bench", ["latency", "allreduce", "allgather", "alltoall",
+                                   "reduce_scatter"])
 def test_local_smoke(bench):
-    rows = run_bench(bench, "local", 4, [1024], None if bench == "latency" else ["ring"]
-                     if bench in ("allreduce", "allgather") else ["pairwise"],
-                     iters=3, warmup=1)
+    algos = {"latency": None, "allreduce": ["ring", "rabenseifner"],
+             "allgather": ["ring"], "alltoall": ["pairwise"],
+             "reduce_scatter": ["ring"]}[bench]
+    rows = run_bench(bench, "local", 4, [1024], algos, iters=3, warmup=1)
     rows = [r for r in rows if "skipped" not in r]
     assert rows, "no benchmark rows produced"
+    if algos:
+        assert {r["algorithm"] for r in rows} == set(algos)
     for r in rows:
         assert r["p50_us"] > 0
         assert np.isfinite(r["p50_us"])
+
+
+def test_host_sweep_quick_smoke():
+    """The OSU host sweep harness end to end in --quick mode (the
+    ``bench.py --sweep --quick`` CI spelling): real launcher-spawned rank
+    processes on BOTH transports, every swept bench present, and the
+    crossover derivations run over the measured rows — so the sweep
+    can't bit-rot between perf PRs."""
+    from benchmarks import host_sweep
+
+    result = host_sweep.run_sweep("smoke", quick=True)
+    assert result["quick"] and result["nranks"] == 2
+    for key in ("allreduce_rows", "alltoall_rows", "reduce_scatter_rows"):
+        rows = [r for r in result[key] if "p50_us" in r]
+        assert {r["backend"] for r in rows} == {"socket", "shm"}, (key, rows)
+        for r in rows:
+            assert r["p50_us"] > 0 and np.isfinite(r["p50_us"])
+    # all three allreduce algorithms measured (rabenseifner exists now)
+    assert {r["algorithm"] for r in result["allreduce_rows"]
+            if "p50_us" in r} == {"ring", "recursive_halving", "rabenseifner"}
+    assert set(result["crossover"]) == {"socket", "shm"}
+    assert set(result["rabenseifner_crossover"]) == {"socket", "shm",
+                                                    "combined_bytes"}
 
 
 @pytest.mark.parametrize("bench", ["allreduce", "bcast", "alltoall"])

@@ -1,0 +1,415 @@
+"""The segmented data plane, part 2 (ISSUE 2 tentpole): nonblocking
+windowed-pairwise alltoall, the segmented-ring reduce_scatter, the
+Rabenseifner (reduce_scatter + ring allgather) allreduce composition, the
+nonblocking scatter/gather fan-out/fan-in, and unpickled scan prefixes.
+
+Parity: every new path must match a single-process numpy oracle across
+group sizes (pow2 and not), ops, scalar/0-dim payloads, list-vs-stacked
+block inputs, and segment boundaries forced down to a few elements via
+the ``collective_segment_bytes`` cvar.
+
+Zero-copy proof: on BOTH byte-stream transports (socket, shm) the
+``bytes_raw_sent`` / ``bytes_pickled_sent`` / ``payload_copies`` mpit
+pvars must show the new paths ship array payloads exclusively as raw
+frames — 0 pickled array bytes AND 0 host-side payload copies."""
+
+import numpy as np
+import pytest
+
+from mpi_tpu import mpit, ops, schedules
+from mpi_tpu.transport.local import run_local
+from tests.test_shm_backend import run_shm_world
+from tests.test_socket_backend import run_socket_world
+
+NRANKS = [1, 2, 3, 4, 5, 8]
+WORLDS = {"socket": run_socket_world, "shm": run_shm_world}
+
+
+@pytest.fixture
+def small_segments():
+    """Force multi-segment pipelines at test-sized payloads."""
+    old = mpit.cvar_read("collective_segment_bytes")
+    mpit.cvar_write("collective_segment_bytes", 64)
+    yield
+    mpit.cvar_write("collective_segment_bytes", old)
+
+
+def _byte_deltas_during(world, prog, nranks):
+    """(pickled, raw, copies) pvar deltas across a threaded rank world
+    (thread-backed ranks share the process-global counters, so this sums
+    all ranks)."""
+    p0 = mpit.counters.bytes_pickled
+    r0 = mpit.counters.bytes_raw
+    c0 = mpit.counters.copies
+    assert all(world(prog, nranks))
+    return (mpit.counters.bytes_pickled - p0,
+            mpit.counters.bytes_raw - r0,
+            mpit.counters.copies - c0)
+
+
+# -- alltoall: windowed nonblocking pairwise --------------------------------
+
+
+def test_alltoall_parity_all_sizes():
+    """result[src] on rank r == src's block r, for every group size
+    (window > P-1, window < P-1, and the degenerate P=1)."""
+    for n in NRANKS:
+        data = np.random.RandomState(n).randn(n, n, 5)
+
+        def prog(comm):
+            return comm.alltoall(list(data[comm.rank]))
+
+        for r, res in enumerate(run_local(prog, n)):
+            np.testing.assert_array_equal(np.asarray(res), data[:, r])
+
+
+def test_alltoall_mixed_payloads_and_aliases():
+    """Arbitrary (non-array) payloads still work per slot, and the
+    documented aliases run the same pairwise path."""
+    def prog(comm):
+        objs = [{"s": comm.rank, "d": d} if d == 0
+                else np.arange(4.0) + comm.rank * 10 + d
+                for d in range(comm.size)]
+        return [comm.alltoall(objs, algorithm=a)
+                for a in ("auto", "pairwise", "fused")]
+
+    for r, per_algo in enumerate(run_local(prog, 5)):
+        for got in per_algo:
+            for s in range(5):
+                if r == 0:
+                    assert got[s] == {"s": s, "d": 0}
+                else:
+                    np.testing.assert_array_equal(
+                        np.asarray(got[s]), np.arange(4.0) + s * 10 + r)
+
+
+@pytest.mark.parametrize("transport", sorted(WORLDS))
+def test_alltoall_zero_pickled_bytes(transport):
+    """Every alltoall payload is an array → all wire bytes raw, zero
+    host-side copies (the blocks are contiguous views)."""
+    n = 4
+    nelem = 1 << 14  # 128KB per block
+
+    def prog(comm):
+        rng = np.random.RandomState(comm.rank)
+        blocks = list(rng.randn(n, nelem))
+        got = comm.alltoall(blocks)
+        for s in range(n):
+            np.testing.assert_array_equal(
+                np.asarray(got)[s],
+                np.random.RandomState(s).randn(n, nelem)[comm.rank])
+        return True
+
+    pickled, raw, copies = _byte_deltas_during(WORLDS[transport], prog, n)
+    assert pickled == 0, f"alltoall pickled {pickled} bytes"
+    assert copies == 0, f"alltoall made {copies} host payload copies"
+    assert raw >= n * (n - 1) * nelem * 8  # every off-rank block, raw
+
+
+# -- reduce_scatter: segmented ring on one working buffer -------------------
+
+
+@pytest.mark.parametrize("op,oracle", [
+    (ops.SUM, lambda d: d.astype(np.float64).sum(0)),
+    (ops.MAX, lambda d: d.max(0)),
+])
+def test_reduce_scatter_parity_ops_sizes(op, oracle, small_segments):
+    for n in NRANKS:
+        data = np.random.RandomState(n).randn(n, n, 11)
+
+        def prog(comm):
+            return comm.reduce_scatter(data[comm.rank], op=op)
+
+        for r, res in enumerate(run_local(prog, n)):
+            np.testing.assert_allclose(np.asarray(res), oracle(data[:, r]),
+                                       err_msg=f"n={n} r={r}")
+
+
+def test_reduce_scatter_list_blocks_match_stacked(small_segments):
+    """A list of per-destination blocks and the stacked [P, ...] array
+    take the same segmented path and produce identical results."""
+    n = 4
+    data = np.random.RandomState(7).randn(n, n, 9).astype(np.float32)
+
+    def stacked(comm):
+        return comm.reduce_scatter(data[comm.rank], op=ops.SUM)
+
+    def listed(comm):
+        return comm.reduce_scatter(list(data[comm.rank]), op=ops.SUM)
+
+    for a, b in zip(run_local(stacked, n), run_local(listed, n)):
+        assert np.asarray(a).dtype == np.float32
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_reduce_scatter_scalar_blocks():
+    for n in NRANKS:
+        def prog(comm):
+            return comm.reduce_scatter(
+                [float(comm.rank + d) for d in range(comm.size)])
+
+        for r, res in enumerate(run_local(prog, n)):
+            assert np.asarray(res).ndim == 0
+            assert float(res) == sum(s + r for s in range(n))
+
+
+def test_reduce_scatter_heterogeneous_block_shapes():
+    """Per-destination block shapes may differ (block r has shape
+    (2+r,)); the generic per-chunk path handles it — and only copies the
+    fold-target chunks (the send-only chunk stays a caller view)."""
+    n = 4
+
+    def prog(comm):
+        blocks = [np.full(2 + d, float(comm.rank + 1)) for d in range(n)]
+        out = comm.reduce_scatter(blocks, op=ops.SUM)
+        # the caller's blocks must be untouched (views are read-only use)
+        for d in range(n):
+            np.testing.assert_array_equal(blocks[d],
+                                          np.full(2 + d, comm.rank + 1.0))
+        return out
+
+    want_total = float(sum(range(1, n + 1)))
+    for r, res in enumerate(run_local(prog, n)):
+        np.testing.assert_array_equal(np.asarray(res),
+                                      np.full(2 + r, want_total))
+
+
+def test_reduce_scatter_mixed_dtypes_promote_like_seed():
+    """Cross-rank dtype drift (rank 0 float64, rank 1 int64) reduced via
+    numpy promotion on the seed path — the in-place fold must not turn
+    that into a UFuncOutputCastingError (regression: review of ISSUE 2)."""
+    def prog(comm):
+        dtype = np.float64 if comm.rank == 0 else np.int64
+        blocks = [np.arange(1, 5, dtype=dtype) * (comm.rank + 1)
+                  for _ in range(comm.size)]
+        return comm.reduce_scatter(blocks, op=ops.SUM)
+
+    for res in run_local(prog, 2):
+        np.testing.assert_allclose(np.asarray(res, dtype=np.float64),
+                                   np.arange(1, 5) * 3.0)
+
+
+def test_reduce_scatter_input_not_mutated(small_segments):
+    """The segmented path folds into a PRIVATE working buffer — the
+    caller's stacked blocks array must come back bit-identical."""
+    n = 3
+    data = np.random.RandomState(3).randn(n, n, 8)
+
+    def prog(comm):
+        mine = data[comm.rank].copy()
+        keep = mine.copy()
+        comm.reduce_scatter(mine, op=ops.SUM)
+        np.testing.assert_array_equal(mine, keep)
+        return True
+
+    assert all(run_local(prog, n))
+
+
+@pytest.mark.parametrize("transport", sorted(WORLDS))
+def test_reduce_scatter_zero_pickled_bytes(transport, small_segments):
+    """The segmented ring ships only contiguous views of the working
+    buffer: zero pickled array bytes, zero host payload copies, and the
+    raw volume ≥ the (P-1)/P·N ring lower bound per rank."""
+    n = 4
+    nelem = n * (1 << 14)  # 512KB total per rank
+
+    def prog(comm):
+        rng = np.random.RandomState(comm.rank)
+        blocks = rng.randn(n, nelem // n)
+        want = np.zeros(nelem // n)
+        for s in range(n):
+            want += np.random.RandomState(s).randn(n, nelem // n)[comm.rank]
+        out = comm.reduce_scatter(blocks, op=ops.SUM)
+        np.testing.assert_allclose(out, want)
+        return True
+
+    pickled, raw, copies = _byte_deltas_during(WORLDS[transport], prog, n)
+    assert pickled == 0, f"reduce_scatter pickled {pickled} bytes"
+    assert copies == 0, f"reduce_scatter made {copies} host payload copies"
+    assert raw >= n * (n - 1) * (nelem // n) * 8
+
+
+# -- Rabenseifner allreduce (reduce_scatter + ring allgather) ---------------
+
+
+@pytest.mark.parametrize("op,oracle", [
+    (ops.SUM, lambda xs: sum(x.astype(np.float64) for x in xs)),
+    (ops.MAX, lambda xs: np.maximum.reduce(xs)),
+])
+def test_rabenseifner_parity_ops_sizes(op, oracle, small_segments):
+    """Any group size (unlike recursive halving), every shape regime:
+    scalars, fewer elements than ranks, multi-chunk, 2-D."""
+    for n in NRANKS:
+        for shape in [(), (1,), (7,), (250,), (13, 11)]:
+            data = [np.random.RandomState(100 * n + i).randint(
+                1, 100, size=shape or (1,)).astype(np.float64).reshape(shape)
+                for i in range(n)]
+            want = np.asarray(oracle(data))
+
+            def prog(comm):
+                return comm.allreduce(data[comm.rank], op,
+                                      algorithm="rabenseifner")
+
+            for res in run_local(prog, n):
+                np.testing.assert_allclose(
+                    np.asarray(res, dtype=np.float64).reshape(shape), want,
+                    err_msg=f"n={n} shape={shape}")
+
+
+def test_rabenseifner_matches_ring_dtype_and_auto_cvar(small_segments):
+    """Same dtype preservation as ring, and the auto policy hands
+    payloads at/above allreduce_rabenseifner_crossover_bytes to the
+    composition (steered by the live cvar, restored afterwards)."""
+    n = 3  # non-pow2: auto can only be ring or rabenseifner
+    data = [np.arange(101, dtype=np.int32) * (i + 1) for i in range(n)]
+    want = np.arange(101, dtype=np.int32) * sum(range(1, n + 1))
+
+    def explicit(comm):
+        return comm.allreduce(data[comm.rank], algorithm="rabenseifner")
+
+    for res in run_local(explicit, n):
+        assert np.asarray(res).dtype == np.int32
+        np.testing.assert_array_equal(np.asarray(res), want)
+
+    old = mpit.cvar_read("allreduce_rabenseifner_crossover_bytes")
+    mpit.cvar_write("allreduce_rabenseifner_crossover_bytes", 16)
+    try:
+        for res in run_local(lambda c: c.allreduce(data[c.rank]), n):
+            np.testing.assert_array_equal(np.asarray(res), want)
+        # pow2 group: the lowered cvar must win over the halving branch
+        # (auto checks the rabenseifner crossover FIRST) — payload far
+        # below _RING_CROSSOVER_BYTES, yet the composition runs: its
+        # 2(P-1) exchange steps send 6 messages per rank at this size,
+        # vs recursive halving's log2(P) = 2 — the send count pins
+        # which branch executed
+        data4 = [np.arange(101, dtype=np.int32) * (i + 1) for i in range(4)]
+        want4 = np.arange(101, dtype=np.int32) * 10
+        sends0 = mpit.counters.sends
+        for res in run_local(lambda c: c.allreduce(data4[c.rank]), 4):
+            np.testing.assert_array_equal(np.asarray(res), want4)
+        assert mpit.counters.sends - sends0 >= 6 * 4, \
+            "auto did not take the rabenseifner branch on the pow2 group"
+    finally:
+        mpit.cvar_write("allreduce_rabenseifner_crossover_bytes", old)
+
+
+@pytest.mark.parametrize("transport", sorted(WORLDS))
+def test_rabenseifner_zero_pickled_bytes(transport):
+    """The composition inherits the engine's zero-pickle plane on both
+    byte-stream transports; volume ≥ 2(P-1)/P·N per rank, all raw."""
+    n = 4
+    data = [np.random.RandomState(i).randn(1 << 16) for i in range(n)]  # 512KB
+    want = sum(data)
+
+    def prog(comm):
+        out = comm.allreduce(data[comm.rank], ops.SUM,
+                             algorithm="rabenseifner")
+        np.testing.assert_allclose(out, want)
+        return True
+
+    pickled, raw, copies = _byte_deltas_during(WORLDS[transport], prog, n)
+    assert pickled == 0, f"rabenseifner pickled {pickled} bytes"
+    assert copies == 0
+    assert raw >= 2 * (n - 1) * data[0].nbytes  # n ranks x 2(P-1)/P each
+
+
+# -- scatter / gather fan-out/fan-in + scan ---------------------------------
+
+
+def test_scatter_gather_roundtrip_all_sizes():
+    for n in NRANKS:
+        data = np.random.RandomState(n).randn(n, 6)
+
+        def prog(comm):
+            mine = comm.scatter(list(data) if comm.rank == n - 1 else None,
+                                root=n - 1)
+            np.testing.assert_array_equal(mine, data[comm.rank])
+            return comm.gather(mine * 2, root=0)
+
+        res = run_local(prog, n)
+        np.testing.assert_array_equal(np.asarray(res[0]), data * 2)
+        assert all(r is None for r in res[1:])
+
+
+@pytest.mark.parametrize("transport", sorted(WORLDS))
+def test_scatter_gather_scan_zero_pickled_array_bytes(transport):
+    """Array payloads of scatter's fan-out, gather's fan-in and scan's
+    partial prefixes all ride raw frames."""
+    n = 4
+    nelem = 1 << 15  # 256KB
+
+    def prog(comm):
+        rng = np.random.RandomState(0)
+        parts = rng.randn(n, nelem)
+        mine = comm.scatter(list(parts) if comm.rank == 0 else None, root=0)
+        np.testing.assert_array_equal(mine, parts[comm.rank])
+        sc = comm.scan(mine)
+        np.testing.assert_allclose(sc, parts[:comm.rank + 1].sum(0))
+        back = comm.gather(mine, root=0)
+        if comm.rank == 0:
+            np.testing.assert_array_equal(np.asarray(back), parts)
+        return True
+
+    pickled, raw, copies = _byte_deltas_during(WORLDS[transport], prog, n)
+    assert pickled == 0, f"scatter/gather/scan pickled {pickled} bytes"
+    assert copies == 0
+    # scatter + gather each move (n-1) blocks; scan moves at least one
+    # prefix per doubling round
+    assert raw >= (2 * (n - 1) + 1) * nelem * 8
+
+
+# -- unified algorithm validation -------------------------------------------
+
+
+def test_algorithm_validation_names_accepted_values():
+    """Every host collective rejects unknown algorithms with the same
+    message shape — 'unknown <coll> algorithm <a>; accepted: [...]' —
+    and accepts its documented aliases."""
+    def prog(comm):
+        calls = {
+            "allreduce": lambda a: comm.allreduce(np.arange(4.0), algorithm=a),
+            "allgather": lambda a: comm.allgather(np.arange(4.0), algorithm=a),
+            "alltoall": lambda a: comm.alltoall(
+                [np.arange(2.0)] * comm.size, algorithm=a),
+            "reduce_scatter": lambda a: comm.reduce_scatter(
+                np.zeros((comm.size, 2)), algorithm=a),
+            "bcast": lambda a: comm.bcast(
+                1 if comm.rank == 0 else None, algorithm=a),
+            "reduce": lambda a: comm.reduce(np.arange(2.0), algorithm=a),
+        }
+        msgs = {}
+        for coll, call in calls.items():
+            call("auto")
+            call("fused")  # the TPU tier's name is an explicit alias
+            try:
+                call("nope")
+            except ValueError as e:
+                msgs[coll] = str(e)
+        return msgs
+
+    for msgs in run_local(prog, 2):
+        assert set(msgs) == {"allreduce", "allgather", "alltoall",
+                             "reduce_scatter", "bcast", "reduce"}
+        for coll, m in msgs.items():
+            assert m.startswith(f"unknown {coll} algorithm 'nope'"), m
+            assert "accepted: [" in m and "'fused'" in m, m
+
+
+def test_block_ag_schedule_composes_with_block_rs():
+    """The new ring_ag_block_* tables: starting from 'rank r owns chunk
+    r' (the block reduce-scatter postcondition), P-1 rotation steps
+    deliver every chunk to every rank, each exactly once."""
+    for p in [1, 2, 3, 4, 5, 8]:
+        held = [{r} for r in range(p)]
+        for step in range(p - 1):
+            sends = {}
+            for r in range(p):
+                si = schedules.ring_ag_block_send_chunk(r, step, p)
+                assert si in held[r], (p, r, step)
+                sends[(r + 1) % p] = si
+            for r in range(p):
+                ri = schedules.ring_ag_block_recv_chunk(r, step, p)
+                assert sends[r] == ri
+                assert ri not in held[r], "chunk received twice"
+                held[r].add(ri)
+        assert all(h == set(range(p)) for h in held)
