@@ -549,6 +549,14 @@ def main() -> None:
 
 
 if __name__ == "__main__":
+    if "--chaos" in sys.argv[1:]:
+        # FaultyTransport drop/delay/duplicate sweep over the collective
+        # family asserting diagnose-don't-hang (ISSUE 3 satellite);
+        # --quick is the tier-1 smoke spelling, mirroring --sweep's.
+        from benchmarks import chaos
+
+        sys.exit(chaos.main(
+            ["--quick"] if "--quick" in sys.argv[1:] else []))
     if "--sweep" in sys.argv[1:]:
         # the OSU-style host data-plane size sweep (ISSUE 1 tentpole #4,
         # extended to alltoall/reduce_scatter/rabenseifner in ISSUE 2);

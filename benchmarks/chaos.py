@@ -1,0 +1,164 @@
+#!/usr/bin/env python
+"""Chaos smoke: FaultyTransport drop/delay/duplicate sweep over the
+collective family, asserting DIAGNOSE-DON'T-HANG.
+
+The failure story's CI tripwire (ISSUE 3 satellite): every cell runs one
+in-process local world through a fault-injecting transport and records
+the outcome.  A cell may *succeed* (the fault was absorbed — e.g. a
+delay, or a duplicate the matching engine never mismatched) or *fail
+diagnosably* (RecvTimeout / ProcFailedError / TransportError naming the
+stuck channel) — what it may never do is HANG: a run_local deadlock
+timeout fails the sweep.  That is exactly the library's failure-semantics
+contract (README "Failure semantics"), checked across every collective
+algorithm gate rather than argued about.
+
+Duplicate-injection cells additionally record result corruption
+(``wrong_result``) honestly instead of asserting it away: a duplicated
+internal frame can legally mis-fold a later collective on the same
+channel — the sweep documents which schedules are sensitive, it does not
+promise they aren't.
+
+Usage::
+
+    python benchmarks/chaos.py            # full sweep, JSON to stdout
+    python benchmarks/chaos.py --quick    # tier-1 smoke (fewer cells)
+    python bench.py --chaos [--quick]     # the CI spelling
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+from typing import Dict, List
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from mpi_tpu import mpit  # noqa: E402
+from mpi_tpu.errors import ProcFailedError, RevokedError  # noqa: E402
+from mpi_tpu.transport.base import RecvTimeout, TransportError  # noqa: E402
+from mpi_tpu.transport.faulty import FaultyTransport  # noqa: E402
+from mpi_tpu.transport.local import run_local  # noqa: E402
+
+NRANKS = 4  # pow2: exercises halving/doubling gates too
+RECV_TIMEOUT_S = 2.0  # the diagnosis bound a dropped message hits
+WORLD_TIMEOUT_S = 30.0  # run_local deadlock ceiling = the HANG verdict
+
+# (name, per-rank collective call).  Payloads are small (latency-path
+# schedules) — chaos probes control-flow robustness, not bandwidth.
+COLLECTIVES = [
+    ("bcast", lambda c: c.bcast(np.arange(8.0), root=0)),
+    ("reduce", lambda c: c.reduce(np.ones(8), root=0)),
+    ("allreduce-ring", lambda c: c.allreduce(np.ones(8), algorithm="ring")),
+    ("allreduce-halving", lambda c: c.allreduce(
+        np.ones(8), algorithm="recursive_halving")),
+    ("allreduce-rabenseifner", lambda c: c.allreduce(
+        np.ones(8), algorithm="rabenseifner")),
+    ("allgather-ring", lambda c: c.allgather(
+        np.full(4, c.rank), algorithm="ring")),
+    ("allgather-doubling", lambda c: c.allgather(
+        np.full(4, c.rank), algorithm="doubling")),
+    ("alltoall", lambda c: c.alltoall([np.full(2, c.rank)] * c.size)),
+    ("reduce_scatter", lambda c: c.reduce_scatter(np.ones((c.size, 4)))),
+    ("scatter", lambda c: c.scatter(
+        [np.full(2, d) for d in range(c.size)] if c.rank == 0 else None,
+        root=0)),
+    ("gather", lambda c: c.gather(np.full(2, c.rank), root=0)),
+    ("scan", lambda c: c.scan(np.ones(4))),
+    ("barrier", lambda c: c.barrier()),
+]
+
+FAULTS = [
+    ("drop", dict(drop_every=5)),
+    ("delay", dict(delay_s=0.01)),
+    ("duplicate", dict(duplicate_every=5)),
+]
+
+QUICK_COLLECTIVES = ("allreduce-ring", "alltoall", "reduce_scatter",
+                     "barrier")
+
+
+def _oracle(name: str, comm_size: int):
+    """Expected fault-free result per rank (None = don't check)."""
+    if name.startswith("allreduce"):
+        return lambda r, got: np.array_equal(np.asarray(got),
+                                             np.full(8, float(comm_size)))
+    if name == "scan":
+        return lambda r, got: np.array_equal(np.asarray(got),
+                                             np.full(4, float(r + 1)))
+    return None
+
+
+def run_cell(coll_name: str, call, fault_kw: Dict) -> Dict:
+    wrapper = FaultyTransport.wrapper(**fault_kw)
+    check = _oracle(coll_name, NRANKS)
+
+    def fn(comm):
+        got = call(comm)
+        if check is not None and not check(comm.rank, got):
+            return "wrong_result"
+        return "ok"
+
+    t0 = time.monotonic()
+    try:
+        res = run_local(fn, NRANKS, transport_wrapper=wrapper,
+                        recv_timeout=RECV_TIMEOUT_S, timeout=WORLD_TIMEOUT_S)
+        outcome = ("wrong_result" if "wrong_result" in res else "ok")
+    except TimeoutError as e:
+        outcome = f"HANG: {e}"  # the one unacceptable verdict
+    except RuntimeError as e:
+        # run_local wraps the first rank error; classify its cause
+        cause = e.__cause__
+        if isinstance(cause, (RecvTimeout, ProcFailedError, RevokedError,
+                              TransportError)):
+            outcome = f"diagnosed:{type(cause).__name__}"
+        else:
+            outcome = f"error:{type(cause).__name__}: {str(cause)[:120]}"
+    return {"collective": coll_name, "fault": dict(fault_kw),
+            "outcome": outcome,
+            "wall_ms": round((time.monotonic() - t0) * 1e3, 1)}
+
+
+def run_chaos(quick: bool = False) -> Dict:
+    t0 = time.time()
+    ses = mpit.session_create()
+    ses.reset_all()
+    colls = [(n, c) for n, c in COLLECTIVES
+             if not quick or n in QUICK_COLLECTIVES]
+    cells: List[Dict] = []
+    for fault_name, fault_kw in FAULTS:
+        for coll_name, call in colls:
+            cell = run_cell(coll_name, call, fault_kw)
+            cell["fault_name"] = fault_name
+            cells.append(cell)
+    hangs = [c for c in cells if c["outcome"].startswith("HANG")]
+    return {
+        "quick": quick,
+        "nranks": NRANKS,
+        "recv_timeout_s": RECV_TIMEOUT_S,
+        "cells": cells,
+        "hangs": hangs,
+        "injected": {"dropped": ses.read("faulty_dropped"),
+                     "duplicated": ses.read("faulty_duplicated")},
+        "ok": not hangs,
+        "wall_s": round(time.time() - t0, 1),
+    }
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--quick", action="store_true",
+                    help="smoke mode: a subset of collectives per fault")
+    args = ap.parse_args(argv)
+    result = run_chaos(quick=args.quick)
+    print(json.dumps(result, indent=2))
+    return 0 if result["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

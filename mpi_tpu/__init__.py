@@ -33,7 +33,7 @@ from .ops import SUM, PROD, MAX, MIN, LAND, LOR, LXOR, BAND, BOR, BXOR, ReduceOp
 from .communicator import Communicator, Message, P2PCommunicator, Request, Status
 from .transport.base import ANY_SOURCE, ANY_TAG
 from .transport.local import run_local
-from . import datatypes, errors, io, mpi4, schedules, checker, checkpoint, profiling, trace
+from . import datatypes, errors, ft, io, mpi4, schedules, checker, checkpoint, profiling, trace
 from .intercomm import InterComm, create_intercomm
 from .topology import (CartComm, GraphComm, cart_create,
                        dims_create, dist_graph_create_adjacent,
@@ -50,7 +50,7 @@ __all__ = [
     "SUM", "PROD", "MAX", "MIN", "LAND", "LOR", "LXOR", "BAND", "BOR", "BXOR",
     "Communicator", "Message", "P2PCommunicator", "Request", "Status", "ANY_SOURCE", "ANY_TAG",
     "init", "finalize", "is_initialized", "run", "run_local",
-    "schedules", "checker", "checkpoint", "profiling", "trace", "COMM_WORLD", "io", "mpi4",
+    "schedules", "checker", "checkpoint", "ft", "profiling", "trace", "COMM_WORLD", "io", "mpi4",
     "CartComm", "GraphComm", "InterComm", "create_intercomm",
     "cart_create", "graph_create",
     "dist_graph_create_adjacent", "dims_create", "Group",
@@ -99,6 +99,14 @@ def init(backend: Optional[str] = None) -> Communicator:
 
             t = _T(rank, size, rdv)
             _world = P2PCommunicator(t, range(size))
+            if os.environ.get("MPI_TPU_FT", "") not in ("", "0"):
+                # ULFM fault tolerance (mpi_tpu/ft.py): heartbeat files
+                # under the rendezvous dir + a detector thread, so a
+                # dead rank surfaces as ProcFailedError within the
+                # fault_detect_timeout_s cvar instead of a stall
+                from . import ft as _ft
+
+                _ft.enable(_world, rdv_dir=rdv)
         elif backend in ("self", "local"):
             from .transport.local import LocalTransport, LocalWorld
 

@@ -80,6 +80,10 @@ __all__ = [
     "MPI_SUCCESS", "MPI_ERRORS_ARE_FATAL", "MPI_ERRORS_RETURN",
     "MPI_Error_class", "MPI_Error_string", "ErrorCode",
     "MPI_Comm_set_errhandler", "MPI_Comm_get_errhandler",
+    "MPI_ERR_PROC_FAILED", "MPI_ERR_REVOKED",
+    "MPIX_Comm_revoke", "MPIX_Comm_shrink", "MPIX_Comm_agree",
+    "MPIX_Comm_failure_ack", "MPIX_Comm_failure_get_acked",
+    "MPIX_Comm_get_failed",
     "MPI_Errhandler_create",
     "MPI_Comm_create_keyval", "MPI_Comm_free_keyval", "MPI_COMM_DUP_FN",
     "MPI_COMM_NULL_COPY_FN", "MPI_NO_COPY", "Keyval",
@@ -827,6 +831,49 @@ def MPI_Comm_get_errhandler(comm: Optional[Communicator] = None):
 def MPI_Errhandler_create(fn):
     """MPI_Errhandler_create: any ``fn(comm, exc)`` callable IS a handler."""
     return fn
+
+
+# -- fault tolerance (ULFM proposal; mpi_tpu/ft.py) --------------------------
+
+MPI_ERR_PROC_FAILED = errors.MPI_ERR_PROC_FAILED
+MPI_ERR_REVOKED = errors.MPI_ERR_REVOKED
+
+
+def MPIX_Comm_revoke(comm: Optional[Communicator] = None):
+    """Revoke the communicator everywhere (not collective): every rank's
+    pending and future operations on it raise RevokedError /
+    MPI_ERR_REVOKED — the survivor-unblocking half of the failure story."""
+    return _call(comm, "revoke")
+
+
+def MPIX_Comm_shrink(comm: Optional[Communicator] = None):
+    """Survivors agree on the failed set and return a dense
+    sub-communicator of them (collective among survivors; valid on a
+    revoked communicator)."""
+    return _call(comm, "shrink")
+
+
+def MPIX_Comm_agree(value: bool = True,
+                    comm: Optional[Communicator] = None):
+    """Fault-tolerant agreement on the AND of every live rank's value;
+    ERR_PROC_FAILED (after agreeing) while dead members are
+    unacknowledged."""
+    return _call(comm, "agree", value)
+
+
+def MPIX_Comm_failure_ack(comm: Optional[Communicator] = None):
+    """Acknowledge all currently known failures (re-arms ANY_SOURCE
+    receives and agreement); returns the acknowledged ranks."""
+    return _call(comm, "failure_ack")
+
+
+def MPIX_Comm_failure_get_acked(comm: Optional[Communicator] = None):
+    return _call(comm, "failure_get_acked")
+
+
+def MPIX_Comm_get_failed(comm: Optional[Communicator] = None):
+    """Comm ranks this process currently believes dead (sorted)."""
+    return _call(comm, "get_failed")
 
 
 # -- attribute caching (MPI-1 ch.5.7 keyvals) -------------------------------

@@ -48,7 +48,7 @@ def _gen_dir(path: str, manifest: dict) -> str:
     return path if gen is None else os.path.join(path, f"gen{gen}")
 
 
-def save(path: str, state: Any, comm=None) -> None:
+def save(path: str, state: Any, comm=None, agree: bool = False) -> None:
     """Collective checkpoint on a process-backend communicator.
 
     Crash-safe re-save (generation scheme): every rank writes its state
@@ -58,10 +58,46 @@ def save(path: str, state: Any, comm=None) -> None:
     restorable through every instant of the save.  A crash before the
     manifest swap leaves the old generation committed; a crash after it
     leaves the new one (the orphaned directory is swept on the next save).
+
+    ``agree=True`` (needs ULFM fault tolerance, mpi_tpu/ft.py) replaces
+    the pre-commit barrier with fault-tolerant agreement: if any rank
+    died before its state reached disk, ``comm.agree`` raises
+    ProcFailedError on every survivor and the manifest is NOT swung —
+    the old checkpoint stays committed, and the caller can ``shrink()``
+    / relaunch and retry.  A plain barrier would instead either hang on
+    the corpse or (FT enabled) raise on *some* ranks while rank 0 may
+    already have committed — agreement makes the commit/no-commit
+    decision consistent across survivors.  An exception from the
+    post-commit agreement means the checkpoint IS committed
+    (``exists(path)`` disambiguates).
     """
     from . import init
 
     comm = comm or init()
+
+    def _sync(committed: bool):
+        if not agree:
+            comm.barrier()
+            return
+        # The agreed value is "no survivor knows of any dead member"
+        # — NOT just this rank's view, and independent of
+        # failure_ack: an acknowledged death re-arms ANY_SOURCE
+        # receives, but a full-world checkpoint with a member's
+        # state file missing must never commit (the manifest sweep
+        # would destroy the last good generation).  agree() itself
+        # still raises for unacknowledged deaths.  The exception text
+        # states which side of the commit point the death landed on —
+        # the recovery decision differs (retry vs accept).
+        if not comm.agree(not comm.get_failed()):
+            from .errors import ProcFailedError
+
+            raise ProcFailedError(
+                "checkpoint IS committed, but a member died before "
+                "every survivor returned from save (exists(path) "
+                "confirms the new generation)" if committed else
+                "checkpoint commit withheld: a member died before "
+                "every rank's state reached disk",
+                failed=tuple(comm.get_failed()), collective="agree")
     prev = _read_manifest(path) if comm.rank == 0 else None
     if comm.rank == 0:
         prev_gen = -1 if prev is None else int(prev.get("gen", -1))
@@ -74,7 +110,7 @@ def save(path: str, state: Any, comm=None) -> None:
     os.makedirs(rank_dir, exist_ok=True)
     with open(os.path.join(rank_dir, _STATE), "wb") as f:
         pickle.dump(state, f, protocol=pickle.HIGHEST_PROTOCOL)
-    comm.barrier()  # every rank's state is on disk
+    _sync(committed=False)  # every rank's state on disk, or NO commit
     if comm.rank == 0:
         tmp = os.path.join(path, "." + _MANIFEST)
         with open(tmp, "w") as f:
@@ -91,7 +127,7 @@ def save(path: str, state: Any, comm=None) -> None:
             victim = os.path.join(path, entry)
             if os.path.isdir(victim):
                 shutil.rmtree(victim, ignore_errors=True)
-    comm.barrier()  # nobody returns before the checkpoint is committed
+    _sync(committed=True)  # nobody returns before the commit is visible
 
 
 def exists(path: str) -> bool:

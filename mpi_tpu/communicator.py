@@ -25,6 +25,7 @@ from __future__ import annotations
 
 import pickle
 import threading
+import time
 from abc import ABC, abstractmethod
 from typing import Any, List, Optional, Sequence, Tuple
 
@@ -33,9 +34,10 @@ import numpy as np
 from . import mpit as _mpit
 from . import ops as _ops
 from . import schedules
+from .errors import ProcFailedError, RevokedError
 from .transport import codec as _codec
-from .transport.base import (ANY_SOURCE, ANY_TAG, Transport,
-                             payload_nbytes)
+from .transport.base import (ANY_SOURCE, ANY_TAG, RecvTimeout, Transport,
+                             TransportError, payload_nbytes)
 
 # Internal tags (never matched by user-level ANY_TAG — see Mailbox._matches).
 # CPU-backend allreduce auto crossover (mpit cvar; re-derived from the
@@ -105,6 +107,19 @@ _TAG_COLL = -2
 _TAG_SHIFT = -3
 _TAG_BARRIER = -4
 _TAG_SPLIT = -5
+# -6/-7/-8 are the fault-tolerance control tags (revoke / shrink /
+# agree) — see mpi_tpu/ft.py TAG_REVOKE & co.
+
+# Default ``recv_timeout`` of newly created communicators (mpit cvar
+# ``recv_timeout_s``; 0/None = wait forever).  The per-communicator
+# attribute still overrides — this is the process-wide knob the failure
+# story turns so a lost message surfaces as RecvTimeout everywhere.
+_RECV_TIMEOUT_DEFAULT: Optional[float] = None
+
+# Slice length of fault-tolerant blocking waits (detector/revocation
+# re-check cadence while blocked) — mirrored from ft._POLL_S lazily so
+# importing this module never pulls the ft machinery in.
+_FT_POLL_S = 0.05
 
 
 class _SegHeader:
@@ -397,6 +412,13 @@ class _RecvRequest(Request):
             head = self._queue[0]
             hit = head._poll_once()
             if hit is None:
+                # FT parity with wait(): a polling loop over a dead
+                # peer (or a revoked communicator) must fail within the
+                # detection bound, not spin forever returning (False,
+                # None).  Checked only on the empty path — a message
+                # already delivered stays receivable (MPI: completable
+                # operations complete even after a peer death).
+                self._comm._ft_poll_check(self._source, self._tag)
                 return False, None
             head._complete(hit[0])
         return True, self._value
@@ -901,8 +923,18 @@ class P2PCommunicator(Communicator):
         # Failure-detection knob: with a timeout, a lost message surfaces as
         # RecvTimeout (with the pending-message summary) instead of a hang —
         # see transport/faulty.py for the fault-injection counterpart.
-        self.recv_timeout = recv_timeout
+        self.recv_timeout = (recv_timeout if recv_timeout is not None
+                             else _RECV_TIMEOUT_DEFAULT)
         self._irecv_queues: dict = {}
+        # ULFM fault-tolerance state (mpi_tpu/ft.py CommFT), attached by
+        # ft.enable(); None = all FT machinery compiled out of the hot
+        # path (a single attribute test per op).
+        self._ft = None
+        # Which collective's machinery is currently waiting on internal
+        # tags — included in ProcFailedError diagnoses.  Set-and-forget
+        # at each collective entry: it is only consulted for failures on
+        # internal (negative) tags, which only occur inside collectives.
+        self._coll_name: Optional[str] = None
 
     # -- identity ----------------------------------------------------------
 
@@ -937,7 +969,25 @@ class P2PCommunicator(Communicator):
         if nbytes is None and isinstance(obj, (bytes, bytearray)):
             nbytes = len(obj)
         _mpit.count(sends=1, send_bytes=int(nbytes or 0))
-        self._t.send(self._world(dest), self._ctx, tag, obj)
+        dest_world = self._world(dest)
+        if self._ft is not None:
+            self._ft.check(self)  # raises RevokedError on a revoked comm
+            if dest_world in self._ft.world.failed:
+                raise ProcFailedError(
+                    f"rank {self._rank}: send to dead rank {dest}",
+                    failed=(dest,),
+                    collective=self._coll_name if tag < 0 else None)
+            try:
+                self._t.send(dest_world, self._ctx, tag, obj)
+            except TransportError as e:
+                # transport evidence beats the detector to the diagnosis
+                self._ft.world.observe(dest_world, f"send failed: {e}")
+                raise ProcFailedError(
+                    f"rank {self._rank}: send to rank {dest} failed "
+                    f"({e})", failed=(dest,),
+                    collective=self._coll_name if tag < 0 else None) from e
+            return
+        self._t.send(dest_world, self._ctx, tag, obj)
 
     def recv(self, source: int = ANY_SOURCE, tag: int = ANY_TAG,
              status: Optional[Status] = None) -> Any:
@@ -947,12 +997,96 @@ class P2PCommunicator(Communicator):
     def _recv_internal(self, source: int, tag: int,
                        status: Optional[Status] = None) -> Any:
         src_world = ANY_SOURCE if source == ANY_SOURCE else self._world(source)
-        obj, src, t = self._t.recv(src_world, self._ctx, tag,
-                                   timeout=self.recv_timeout)
+        if self._ft is not None:
+            obj, src, t = self._ft_wait(src_world, tag)
+        else:
+            obj, src, t = self._t.recv(src_world, self._ctx, tag,
+                                       timeout=self.recv_timeout)
         _mpit.count(recvs=1)
         if status is not None:
             status._fill(self._from_world(src), t, obj)
         return obj
+
+    # -- fault-tolerant blocking waits (mpi_tpu/ft.py) ---------------------
+
+    def _ft_wait(self, src_world: int, tag: int, consume: bool = True):
+        """Every FT-enabled blocking wait (recv, probe, and through
+        _RecvRequest.wait the segmented engine's irecv drains): the
+        transport wait runs in _FT_POLL_S slices, and between slices a
+        queued revocation raises RevokedError while a detector hit on a
+        relevant peer raises ProcFailedError — a peer death is noticed
+        within the detection bound no matter how long the communicator-
+        level ``recv_timeout`` is (or whether one is set at all)."""
+        ft = self._ft
+        timeout = self.recv_timeout
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while True:
+            ft.check(self)
+            remaining = (None if deadline is None
+                         else deadline - time.monotonic())
+            slice_s = (_FT_POLL_S if remaining is None
+                       else max(0.0, min(_FT_POLL_S, remaining)))
+            try:
+                if consume:
+                    return self._t.recv(src_world, self._ctx, tag,
+                                        timeout=slice_s)
+                return self._t.peek(src_world, self._ctx, tag,
+                                    timeout=slice_s)
+            except RecvTimeout:
+                suspects = self._ft_suspects(src_world, tag)
+                if suspects:
+                    what = (f"collective {self._coll_name!r}" if tag < 0
+                            else f"recv(tag={tag})")
+                    raise ProcFailedError(
+                        f"rank {self._rank}: peer death detected while "
+                        f"blocked in {what}", failed=suspects,
+                        collective=self._coll_name if tag < 0 else None)
+                if deadline is not None and time.monotonic() >= deadline:
+                    # fresh exception: re-raising the SLICE's timeout
+                    # would log a nonsensical "timed out after 0.05s"
+                    # for a wait that honored the configured timeout
+                    raise RecvTimeout(
+                        f"recv(source={src_world}, ctx={self._ctx}, "
+                        f"tag={tag}) timed out after {timeout}s; "
+                        f"pending={self._t.mailbox.pending_summary()}")
+
+    def _ft_poll_check(self, source: int, tag: int) -> None:
+        """FT gate of the NONBLOCKING completion paths (Request.test,
+        iprobe, improbe): apply queued revocations and convert a
+        detector hit on a relevant peer into ProcFailedError — same
+        rules as the sliced blocking wait, minus the blocking."""
+        if self._ft is None:
+            return
+        self._ft.check(self)
+        src_world = (ANY_SOURCE if source == ANY_SOURCE
+                     else self._world(source))
+        suspects = self._ft_suspects(src_world, tag)
+        if suspects:
+            what = (f"collective {self._coll_name!r}" if tag < 0
+                    else f"poll(tag={tag})")
+            raise ProcFailedError(
+                f"rank {self._rank}: peer death detected while polling "
+                f"{what}", failed=suspects,
+                collective=self._coll_name if tag < 0 else None)
+
+    def _ft_suspects(self, src_world: int, tag: int) -> Tuple[int, ...]:
+        """Which known-dead comm ranks make THIS wait hopeless.  Internal
+        (negative) tags are collective machinery: any member death dooms
+        the collective, so every failed member is a suspect.  A user
+        recv from a specific source fails only if THAT source is dead; a
+        wildcard recv fails on any not-yet-acknowledged death (ULFM
+        ANY_SOURCE semantics — ``failure_ack`` re-arms it)."""
+        ft = self._ft
+        failed_world = ft.world.failed_snapshot() & set(self._group)
+        if not failed_world:
+            return ()
+        failed = sorted(self._group.index(w) for w in failed_world)
+        if tag < 0:
+            return tuple(failed)
+        if src_world == ANY_SOURCE:
+            return tuple(r for r in failed if r not in ft.acked)
+        src = self._from_world(src_world)
+        return (src,) if src in failed else ()
 
     def sendrecv(self, sendobj: Any, dest: int, source: int = ANY_SOURCE,
                  sendtag: int = 0, recvtag: int = ANY_TAG,
@@ -1033,8 +1167,11 @@ class P2PCommunicator(Communicator):
         (without consuming it); fills ``status`` with its envelope."""
         _check_user_tag(tag)
         src_world = ANY_SOURCE if source == ANY_SOURCE else self._world(source)
-        s, t, n = self._t.peek(src_world, self._ctx, tag,
-                               timeout=self.recv_timeout)
+        if self._ft is not None:
+            s, t, n = self._ft_wait(src_world, tag, consume=False)
+        else:
+            s, t, n = self._t.peek(src_world, self._ctx, tag,
+                                   timeout=self.recv_timeout)
         if status is not None:
             status._fill_envelope(self._from_world(s), t, n)
 
@@ -1046,8 +1183,11 @@ class P2PCommunicator(Communicator):
         The thread-safe probe+recv idiom MPI_Probe cannot provide."""
         _check_user_tag(tag)
         src_world = ANY_SOURCE if source == ANY_SOURCE else self._world(source)
-        obj, src, t = self._t.recv(src_world, self._ctx, tag,
-                                   timeout=self.recv_timeout)
+        if self._ft is not None:
+            obj, src, t = self._ft_wait(src_world, tag)
+        else:
+            obj, src, t = self._t.recv(src_world, self._ctx, tag,
+                                       timeout=self.recv_timeout)
         msg = Message(obj, self._from_world(src), t, comm=self)
         if status is not None:
             status._fill(msg.source, msg.tag, obj)
@@ -1060,6 +1200,8 @@ class P2PCommunicator(Communicator):
         src_world = ANY_SOURCE if source == ANY_SOURCE else self._world(source)
         hit = self._t.poll(src_world, self._ctx, tag)
         if hit is None:
+            # empty-path FT gate: see _RecvRequest.test
+            self._ft_poll_check(source, tag)
             return None
         obj, src, t = hit
         msg = Message(obj, self._from_world(src), t, comm=self)
@@ -1074,12 +1216,15 @@ class P2PCommunicator(Communicator):
         src_world = ANY_SOURCE if source == ANY_SOURCE else self._world(source)
         hit = self._t.peek_nowait(src_world, self._ctx, tag)
         if hit is None:
+            # empty-path FT gate: see _RecvRequest.test
+            self._ft_poll_check(source, tag)
             return False
         if status is not None:
             status._fill_envelope(self._from_world(hit[0]), hit[1], hit[2])
         return True
 
     def shift(self, obj: Any, offset: int = 1, wrap: bool = True, fill: Any = None) -> Any:
+        self._coll_name = "shift"
         p, r = self.size, self._rank
         d, s = r + offset, r - offset
         if wrap:
@@ -1100,6 +1245,7 @@ class P2PCommunicator(Communicator):
                  fill: Any = None) -> Any:
         from .checker import validate_perm
 
+        self._coll_name = "exchange"
         validate_perm(pairs, self.size)
         dsts = [d for s, d in pairs if s == self._rank]
         srcs = [s for s, d in pairs if d == self._rank]
@@ -1138,6 +1284,7 @@ class P2PCommunicator(Communicator):
         lands — cut-through through tree levels instead of the seed's
         store-and-forward whole frames."""
         _mpit.count(collectives=1)
+        self._coll_name = "bcast"
         _resolve_algorithm("bcast", algorithm, ("tree",),
                            {"auto": "tree", "fused": "tree"})
         self._world(root)  # validate
@@ -1199,6 +1346,7 @@ class P2PCommunicator(Communicator):
         in-place folds); ``"auto"`` and ``"fused"`` are aliases of it on
         process backends."""
         _mpit.count(collectives=1)
+        self._coll_name = "reduce"
         _resolve_algorithm("reduce", algorithm, ("tree",),
                            {"auto": "tree", "fused": "tree"})
         self._world(root)  # validate
@@ -1226,6 +1374,7 @@ class P2PCommunicator(Communicator):
         _RABENSEIFNER_CROSSOVER_BYTES, ring in between.  ``"fused"``
         (the TPU tier) aliases to ``"auto"`` on process backends."""
         _mpit.count(collectives=1)
+        self._coll_name = "allreduce"
         arr, scalar = _as_array(obj)
         algorithm = _resolve_algorithm(
             "allreduce", algorithm,
@@ -1312,8 +1461,13 @@ class P2PCommunicator(Communicator):
                 self._send_internal(self._coll_payload(work[lo:hi]), dest,
                                     _TAG_COLL)
                 si += 1
-            for (lo, hi), req in zip(rspans, reqs):
-                got = req.wait()
+            for seg_i, ((lo, hi), req) in enumerate(zip(rspans, reqs)):
+                try:
+                    got = req.wait()
+                except ProcFailedError as e:
+                    if e.segment is None:  # name the stalled segment
+                        e.segment = seg_i
+                    raise
                 view = work[lo:hi]
                 if op is None:
                     view[...] = got
@@ -1429,6 +1583,7 @@ class P2PCommunicator(Communicator):
         doubling on pow2 groups, ring otherwise.  ``"fused"`` (the TPU
         tier) aliases to ``"auto"`` on process backends."""
         _mpit.count(collectives=1)
+        self._coll_name = "allgather"
         p, r = self.size, self._rank
         algorithm = _resolve_algorithm(
             "allgather", algorithm, ("auto", "ring", "doubling"),
@@ -1543,6 +1698,7 @@ class P2PCommunicator(Communicator):
         parking more than window payloads in the shm ring with nobody
         draining."""
         _mpit.count(collectives=1)
+        self._coll_name = "alltoall"
         p, r = self.size, self._rank
         _resolve_algorithm("alltoall", algorithm, ("pairwise",),
                            {"auto": "pairwise", "fused": "pairwise"})
@@ -1570,6 +1726,7 @@ class P2PCommunicator(Communicator):
 
     def barrier(self) -> None:
         _mpit.count(collectives=1)
+        self._coll_name = "barrier"
         # Dissemination barrier, ceil(log2 P) rounds [S].
         p, r = self.size, self._rank
         for off in schedules.dissemination_offsets(p):
@@ -1578,6 +1735,7 @@ class P2PCommunicator(Communicator):
 
     def scan(self, obj: Any, op: _ops.ReduceOp = _ops.SUM) -> Any:
         _mpit.count(collectives=1)
+        self._coll_name = "scan"
         # Hillis-Steele inclusive scan: log2(P) rounds of distance-doubling
         # partial prefixes [S].  The partial-prefix payload is always a
         # contiguous ndarray, so every round ships it as a raw frame —
@@ -1651,6 +1809,7 @@ class P2PCommunicator(Communicator):
         seed path's per-step block copy, combine allocation, and
         blocking sendrecv serialization are all gone."""
         _mpit.count(collectives=1)
+        self._coll_name = "reduce_scatter"
         p, r = self.size, self._rank
         _resolve_algorithm("reduce_scatter", algorithm, ("ring",),
                            {"auto": "ring", "fused": "ring"})
@@ -1723,6 +1882,7 @@ class P2PCommunicator(Communicator):
         bytes) before any peer's receive completes, so one slow child
         cannot serialize the others."""
         _mpit.count(collectives=1)
+        self._coll_name = "scatter"
         self._world(root)  # validate
         if self._rank == root:
             if objs is None or len(objs) != self.size:
@@ -1740,6 +1900,7 @@ class P2PCommunicator(Communicator):
         instead of the seed's serialized rank-order recv loop, and array
         payloads ride raw frames end to end."""
         _mpit.count(collectives=1)
+        self._coll_name = "gather"
         self._world(root)  # validate
         if self._rank == root:
             items: List[Any] = [None] * self.size
@@ -1755,6 +1916,120 @@ class P2PCommunicator(Communicator):
             return items
         self._send_internal(obj, root, _TAG_COLL)
         return None
+
+    # -- fault tolerance (ULFM; mpi_tpu/ft.py) -----------------------------
+
+    def _require_ft(self, what: str):
+        if self._ft is None:
+            raise RuntimeError(
+                f"{what}() needs fault tolerance enabled on this "
+                f"communicator: mpi_tpu.ft.enable(comm), MPI_TPU_FT=1 "
+                f"under the launcher, or run_local(..., "
+                f"fault_tolerance=True)")
+        return self._ft
+
+    @property
+    def revoked(self) -> bool:
+        """True once this communicator has been revoked (locally or by a
+        delivered remote revocation)."""
+        return self._ft is not None and self._ft.revoked
+
+    def revoke(self) -> None:
+        """MPIX_Comm_revoke [S: ULFM]: mark this communicator dead
+        everywhere.  Best-effort notification to every other rank on the
+        reserved control tag; every rank entering or blocked inside a
+        p2p/collective call on this communicator raises RevokedError —
+        including survivors who were not talking to the failed rank.
+        Not collective; callable from exactly one rank."""
+        from . import ft as _ftm
+
+        ft = self._require_ft("revoke")
+        if not ft.revoked:
+            ft.revoked = True
+            _mpit.count(revokes=1)
+        for peer in range(self.size):
+            if peer == self._rank:
+                continue
+            try:
+                self._t.send(self._group[peer], ft.home_ctx,
+                             _ftm.TAG_REVOKE, None)
+            except (TransportError, ValueError):
+                pass  # dead/unreachable peers need no revocation
+
+    def get_failed(self) -> List[int]:
+        """MPIX_Comm_failure_get_acked's sibling: the comm ranks this
+        process currently believes dead (sorted; empty without FT)."""
+        from . import ft as _ftm
+
+        return _ftm.failed_comm_ranks(self)
+
+    def failure_ack(self) -> List[int]:
+        """MPIX_Comm_failure_ack [S: ULFM]: acknowledge every currently
+        known failure — wildcard (ANY_SOURCE) receives stop raising for
+        these ranks, and ``agree`` stops treating them as fatal.
+        Returns the acknowledged comm ranks."""
+        ft = self._require_ft("failure_ack")
+        ft.acked |= set(self.get_failed())
+        return sorted(ft.acked)
+
+    def failure_get_acked(self) -> List[int]:
+        """MPIX_Comm_failure_get_acked [S: ULFM]."""
+        return sorted(self._require_ft("failure_get_acked").acked)
+
+    def shrink(self) -> "P2PCommunicator":
+        """MPIX_Comm_shrink [S: ULFM]: survivors agree on the failed set
+        (fault-tolerant all-reduce over liveness bitmaps — ft._agreement)
+        and return a dense sub-communicator of the survivors, ordered by
+        old rank, able to run the full collective family.  Valid on a
+        revoked communicator (the agreement runs on the raw transport,
+        below the revocation check)."""
+        from . import ft as _ftm
+
+        ft = self._require_ft("shrink")
+        view, _ = _ftm._agreement(self, _ftm.TAG_SHRINK, True)
+        if (view >> self._rank) & 1:
+            raise ProcFailedError(
+                f"rank {self._rank}: suspected dead by the survivors "
+                f"during shrink (false suspicion — this rank stalled "
+                f"past the detection bound)", failed=(self._rank,),
+                collective="shrink")
+        survivors = [q for q in range(self.size) if not (view >> q) & 1]
+        # Deterministic from AGREED state with no further communication:
+        # every survivor derives the same context.  The agreement epoch
+        # is part of it — shrink is collective and epochs advance in
+        # lockstep, so two successive shrinks with the SAME failed set
+        # still get distinct, non-cross-matching contexts (the Mailbox
+        # matches by (src, ctx, tag) alone).
+        ctx = (self._ctx, "shrink", ft.current_epoch(_ftm.TAG_SHRINK),
+               tuple(survivors))
+        new = P2PCommunicator(self._t, [self._group[q] for q in survivors],
+                              ctx, recv_timeout=self.recv_timeout)
+        new._ft = _ftm.CommFT(ft.world, ctx)
+        _mpit.count(shrinks=1)
+        return self._inherit_errhandler(new)
+
+    def agree(self, value: bool = True) -> bool:
+        """MPIX_Comm_agree [S: ULFM]: fault-tolerant agreement on the
+        logical AND of every live rank's ``value`` — the primitive for
+        app-level commit decisions (checkpoint.save(..., agree=True)).
+        Completes despite failures; raises ProcFailedError *after* the
+        agreement when a member is dead and not yet acknowledged via
+        ``failure_ack`` (the exception carries the agreed value as
+        ``.value``), so survivors decide consistently whether to treat
+        the result as trustworthy."""
+        from . import ft as _ftm
+
+        ft = self._require_ft("agree")
+        view, anded = _ftm._agreement(self, _ftm.TAG_AGREE, value)
+        failed = [q for q in range(self.size) if (view >> q) & 1]
+        if set(failed) - ft.acked:
+            exc = ProcFailedError(
+                f"rank {self._rank}: agreement completed but members "
+                f"are dead and unacknowledged", failed=failed,
+                collective="agree")
+            exc.value = anded
+            raise exc
+        return anded
 
     # -- communicator management ------------------------------------------
 
@@ -1777,16 +2052,26 @@ class P2PCommunicator(Communicator):
             (k, cr) for cr, (c, k) in enumerate(infos) if c == color
         )
         group = [self._group[cr] for _, cr in members]
-        return self._inherit_errhandler(
+        return self._inherit_errhandler(self._inherit_ft(
             P2PCommunicator(self._t, group, ctx,
-                            recv_timeout=self.recv_timeout))
+                            recv_timeout=self.recv_timeout)))
 
     def dup(self) -> "P2PCommunicator":
         self.barrier()  # collectiveness check + sync, like MPI_Comm_dup
         ctx = self._alloc_context()
-        return self._copy_attrs_to(
+        return self._copy_attrs_to(self._inherit_ft(
             P2PCommunicator(self._t, self._group, ctx,
-                            recv_timeout=self.recv_timeout))
+                            recv_timeout=self.recv_timeout)))
+
+    def _inherit_ft(self, new: "P2PCommunicator") -> "P2PCommunicator":
+        """A split/dup child of an FT-enabled communicator is FT-enabled
+        too (same detector world, FRESH revocation state — MPI:
+        revocation does not propagate across communicator creation)."""
+        if self._ft is not None:
+            from . import ft as _ftm
+
+            new._ft = _ftm.CommFT(self._ft.world, new._ctx)
+        return new
 
     # -- nonblocking collectives [S: MPI-3 MPI_Ibcast & co.] ---------------
 
@@ -1799,8 +2084,13 @@ class P2PCommunicator(Communicator):
         with self._lock:
             self._nbc_count = getattr(self, "_nbc_count", 0) + 1
             k = self._nbc_count
-        return P2PCommunicator(self._t, self._group, (self._ctx, "nbc", k),
-                               recv_timeout=self.recv_timeout)
+        c = P2PCommunicator(self._t, self._group, (self._ctx, "nbc", k),
+                            recv_timeout=self.recv_timeout)
+        # SHARE the parent's FT state (not a fresh one): revoking the
+        # parent must unblock its nonblocking collectives in flight, and
+        # the clone polls the parent's home_ctx for remote revocations.
+        c._ft = self._ft
+        return c
 
     def ibcast(self, obj: Any, root: int = 0) -> Request:
         c = self._nbc_comm()
@@ -1848,6 +2138,8 @@ class P2PCommunicator(Communicator):
     def close_transport(self) -> List[Tuple[int, int, int]]:
         """Finalize-time shutdown: returns any unexpected pending messages
         (the 'unreceived message' sanitizer check, SURVEY.md §5)."""
+        if self._ft is not None:
+            self._ft.world.stop()
         pending = self._t.mailbox.drain()
         self._t.close()
         return pending

@@ -30,7 +30,9 @@ __all__ = [
     "MPI_ERR_ROOT", "MPI_ERR_GROUP", "MPI_ERR_OP", "MPI_ERR_TOPOLOGY",
     "MPI_ERR_DIMS", "MPI_ERR_ARG", "MPI_ERR_UNKNOWN", "MPI_ERR_TRUNCATE",
     "MPI_ERR_OTHER", "MPI_ERR_INTERN", "MPI_ERR_PENDING", "MPI_ERR_IO",
+    "MPI_ERR_PROC_FAILED", "MPI_ERR_REVOKED",
     "ERRORS_ARE_FATAL", "ERRORS_RETURN", "ErrorCode",
+    "ProcFailedError", "RevokedError",
     "error_class", "error_string",
 ]
 
@@ -54,6 +56,10 @@ MPI_ERR_OTHER = 16
 MPI_ERR_INTERN = 17
 MPI_ERR_PENDING = 18
 MPI_ERR_IO = 19
+# ULFM (MPI Forum User-Level Failure Mitigation proposal) error classes:
+# a peer process is known dead / the communicator was revoked.
+MPI_ERR_PROC_FAILED = 20
+MPI_ERR_REVOKED = 21
 
 _STRINGS = {
     MPI_SUCCESS: "no error",
@@ -76,7 +82,43 @@ _STRINGS = {
     MPI_ERR_INTERN: "internal error",
     MPI_ERR_PENDING: "pending operation (timeout)",
     MPI_ERR_IO: "I/O error",
+    MPI_ERR_PROC_FAILED: "peer process has failed",
+    MPI_ERR_REVOKED: "communicator has been revoked",
 }
+
+
+class ProcFailedError(RuntimeError):
+    """MPI_ERR_PROC_FAILED [S: ULFM]: an operation could not complete
+    because a member of the communicator is dead — detected either by the
+    liveness layer (mpi_tpu/ft.py heartbeat detector) or by transport
+    evidence (failed send / recv timeout on a suspected peer).  Carries
+    the suspected comm ranks and, for collective waits, which collective
+    and pipeline segment was in flight when the death surfaced."""
+
+    def __init__(self, msg: str, failed=(), collective: Optional[str] = None,
+                 segment: Optional[int] = None):
+        super().__init__(msg)
+        self.failed = tuple(failed)
+        self.collective = collective
+        self.segment = segment
+
+    def __str__(self) -> str:
+        base = super().__str__()
+        bits = []
+        if self.failed:
+            bits.append(f"failed ranks {list(self.failed)}")
+        if self.collective:
+            bits.append(f"in {self.collective}")
+        if self.segment is not None:
+            bits.append(f"segment {self.segment}")
+        return f"{base} [{', '.join(bits)}]" if bits else base
+
+
+class RevokedError(RuntimeError):
+    """MPI_ERR_REVOKED [S: ULFM]: the communicator was revoked
+    (``comm.revoke()`` on any rank); every pending and future p2p or
+    collective operation on it raises this — the mechanism that unblocks
+    survivors who were not themselves talking to a dead rank."""
 
 
 class _FatalHandler:
@@ -146,6 +188,10 @@ def error_class(exc: Any) -> int:
         return int(exc)
     if isinstance(exc, int):
         return exc
+    if isinstance(exc, ProcFailedError):
+        return MPI_ERR_PROC_FAILED
+    if isinstance(exc, RevokedError):
+        return MPI_ERR_REVOKED
     from .transport.base import RecvTimeout  # local import: no cycle at load
 
     if isinstance(exc, RecvTimeout):

@@ -1,0 +1,395 @@
+"""User-level fault tolerance (ULFM) — detection, revocation, recovery.
+
+The MPI Forum's User-Level Failure Mitigation proposal [S] is the
+standard shape for surviving rank death without tearing the world down:
+
+* **Detection** — a liveness layer notices a dead peer within a bounded
+  time (``fault_detect_timeout_s`` mpit cvar), *independent* of whether
+  any survivor is blocked on that peer.  Every rank runs one detector
+  thread that (a) publishes its own heartbeat and (b) watches every
+  peer's; a peer whose heartbeat goes stale is marked failed.  Two
+  liveness substrates behind one interface: heartbeat FILES under the
+  rendezvous dir for process worlds (socket/shm — a dead process stops
+  touching its file), and a shared in-memory beat table for the local
+  thread world (where FaultyTransport's ``kill_after_n`` injection
+  simulates death — see transport/faulty.py).
+* **Conversion** — with fault tolerance enabled, every blocking wait in
+  the communicator (p2p recv/probe AND the segmented collective
+  engine's irecv drains) runs in short slices, re-checking the detector
+  between slices; a detector hit (or transport send failure) surfaces
+  as :class:`~mpi_tpu.errors.ProcFailedError` (``MPI_ERR_PROC_FAILED``)
+  naming the suspected ranks, the collective, and the pipeline segment
+  — instead of the shm transport's 120s stall constant or an unbounded
+  socket hang.
+* **Propagation** — ``comm.revoke()`` broadcasts a revocation on the
+  reserved control tag; any rank entering or blocked inside an
+  operation on a revoked communicator raises
+  :class:`~mpi_tpu.errors.RevokedError` (``MPI_ERR_REVOKED``).  This is
+  what unblocks survivors who were *not* talking to the corpse.
+* **Recovery** — ``comm.shrink()`` (survivors agree on the failed set
+  and build a dense sub-communicator) and ``comm.agree()``
+  (fault-tolerant boolean agreement, the checkpoint-commit primitive —
+  see mpi_tpu/checkpoint.py ``save(..., agree=True)``).
+
+The agreement protocol (:func:`_agreement`) is a lockstep iterated
+all-to-all exchange of monotone (failed-view, AND-value) pairs that
+terminates after two consecutive *clean* rounds (view stable and every
+received pair equal to the one sent).  Views and AND-values only grow /
+only fall, so with crash-stop failures that are stable by the time the
+protocol starts (the checkpoint/restart use case) all survivors
+converge to identical results; a failure racing the protocol itself is
+absorbed in extra rounds, and the one dishonest corner — a FALSE
+suspicion (live peer stalled past the detection bound) — can split the
+group, exactly the accuracy/completeness tradeoff every timeout-based
+failure detector has.  Documented, not hidden.
+
+Enable per world: ``mpi_tpu.ft.enable(comm)`` (process worlds pick the
+liveness substrate from the transport), ``MPI_TPU_FT=1`` in the
+launcher environment, or ``run_local(..., fault_tolerance=True)``.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+from . import mpit as _mpit
+from .errors import ProcFailedError, RevokedError
+from .transport.base import ANY_SOURCE, RecvTimeout, TransportError
+
+# Reserved control tags (negative: user wildcards can never match them —
+# transport/base.py Mailbox._matches; distinct from communicator.py's
+# collective/barrier/shift tags).
+TAG_REVOKE = -6
+TAG_SHRINK = -7
+TAG_AGREE = -8
+
+# Detection bound: a peer whose heartbeat is stale this long is declared
+# failed.  Deliberately far below transport/shm.py's 120s no-progress
+# stall constant — the detector, not the data plane, is the failure
+# authority.  mpit cvar: fault_detect_timeout_s.
+_DETECT_TIMEOUT_S = 5.0
+# How often each rank publishes its own heartbeat (and scans peers').
+# mpit cvar: fault_heartbeat_interval_s.
+_HEARTBEAT_S = 0.25
+# Slice length of fault-tolerant blocking waits: the latency between a
+# detector hit (or an arriving revocation) and the blocked wait noticing.
+_POLL_S = 0.05
+
+
+class MemoryLiveness:
+    """Shared beat table for one in-process world (local thread ranks)."""
+
+    def __init__(self, size: int) -> None:
+        self._beats = [0] * size
+        self._lock = threading.Lock()
+
+    def beat(self, rank: int) -> None:
+        with self._lock:
+            self._beats[rank] += 1
+
+    def stamp(self, rank: int) -> Optional[int]:
+        with self._lock:
+            return self._beats[rank]
+
+
+class FileLiveness:
+    """Heartbeat files ``hb.<rank>`` under the rendezvous dir: a rank
+    touches its own file every interval; a dead process stops touching.
+    The stamp is the file's mtime — no content parsing, no partial-read
+    hazard."""
+
+    def __init__(self, rdv_dir: str, rank: int) -> None:
+        self._rdv = rdv_dir
+        self._path = os.path.join(rdv_dir, f"hb.{rank}")
+        with open(self._path, "w") as f:
+            f.write("alive")
+
+    def beat(self, rank: int) -> None:
+        try:
+            os.utime(self._path, None)
+        except OSError:
+            pass  # rendezvous dir tearing down — world is exiting
+
+    def stamp(self, rank: int) -> Optional[int]:
+        try:
+            return os.stat(os.path.join(self._rdv, f"hb.{rank}")).st_mtime_ns
+        except OSError:
+            return None  # not yet published (or swept): treated as stale
+
+
+class WorldFT:
+    """Per-process failure-detection state: the detector thread, the
+    failed set (WORLD ranks), and the liveness substrate.  Shared by
+    every communicator derived from one transport."""
+
+    def __init__(self, transport, liveness, detect_timeout_s: float,
+                 heartbeat_s: float) -> None:
+        self._t = transport
+        self._liveness = liveness
+        self.detect_timeout_s = float(detect_timeout_s)
+        self.heartbeat_s = float(heartbeat_s)
+        self.failed: set = set()  # world ranks; reads are snapshot-cheap
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        # peer -> (last stamp seen, local monotonic time it changed)
+        now = time.monotonic()
+        self._last: Dict[int, Tuple[Optional[int], float]] = {
+            p: (None, now) for p in range(transport.world_size)
+            if p != transport.world_rank
+        }
+        liveness.beat(transport.world_rank)
+        self._thread = threading.Thread(
+            target=self._loop, daemon=True,
+            name=f"mpi-tpu-ft-detector-{transport.world_rank}")
+        self._thread.start()
+
+    # -- detection ---------------------------------------------------------
+
+    def _loop(self) -> None:
+        last_tick = time.monotonic()
+        while not self._stop.is_set():
+            # A kill-injected rank (FaultyTransport.killed) is dead to the
+            # world: it stops heartbeating AND stops accusing others.
+            if getattr(self._t, "killed", False):
+                return
+            self._liveness.beat(self._t.world_rank)
+            now = time.monotonic()
+            # Stall threshold: well past the nominal loop period (so a
+            # tight detect_timeout <= 2*heartbeat cannot make EVERY
+            # iteration look like a stall and silently suppress
+            # detection forever) AND a real fraction of the bound.
+            if now - last_tick > max(self.detect_timeout_s / 2,
+                                     3.0 * self.heartbeat_s):
+                # WE were descheduled (loaded box, GC pause): peer
+                # staleness measured across our own stall is not
+                # evidence — while stalled we also stopped beating, so
+                # symmetric false accusations would split live worlds.
+                # Restart every unchanged peer's window; a genuinely
+                # dead peer is still caught one window later (bounded).
+                self._last = {p: (s, now) for p, (s, _) in
+                              self._last.items()}
+            last_tick = now
+            for peer, (stamp, changed) in list(self._last.items()):
+                if peer in self.failed:
+                    continue
+                cur = self._liveness.stamp(peer)
+                if cur is not None and cur != stamp:
+                    self._last[peer] = (cur, now)
+                elif now - changed > self.detect_timeout_s:
+                    self.observe(peer, "heartbeat stale for "
+                                       f"{now - changed:.1f}s")
+            self._stop.wait(self.heartbeat_s)
+
+    def observe(self, world_rank: int, why: str) -> None:
+        """Mark a world rank failed (detector hit OR transport evidence,
+        e.g. a failed send); counts the detection pvar exactly once."""
+        with self._lock:
+            if world_rank in self.failed:
+                return
+            self.failed.add(world_rank)
+        _mpit.count(proc_failed=1)
+
+    def failed_snapshot(self) -> set:
+        """Consistent copy of the failed set: callers iterate/intersect
+        it, and an unlocked copy racing the detector's add() can raise
+        'set changed size during iteration' — an undiagnostic crash in
+        place of the ProcFailedError the caller is building."""
+        with self._lock:
+            return set(self.failed)
+
+    def stop(self) -> None:
+        self._stop.set()
+
+
+class CommFT:
+    """Per-communicator fault-tolerance state: revocation flag, the
+    acknowledged-failure set (comm ranks), and agreement epochs.  nbc
+    clones share their parent's instance (a revoke must unblock a
+    nonblocking collective in flight); split/dup/shrink children get a
+    fresh one (MPI: revocation does not propagate across communicator
+    creation)."""
+
+    def __init__(self, world: WorldFT, home_ctx) -> None:
+        self.world = world
+        self.home_ctx = home_ctx
+        self.revoked = False
+        self.acked: set = set()  # comm ranks acknowledged via failure_ack
+        self._epochs: Dict[int, int] = {}
+        self._lock = threading.Lock()
+        self._last_revoke_poll = 0.0
+
+    def next_epoch(self, tag: int) -> int:
+        with self._lock:
+            self._epochs[tag] = self._epochs.get(tag, 0) + 1
+            return self._epochs[tag]
+
+    def current_epoch(self, tag: int) -> int:
+        with self._lock:
+            return self._epochs.get(tag, 0)
+
+    def check(self, comm) -> None:
+        """Entry/slice check of every fault-tolerant operation: raise if
+        this communicator is revoked, applying any queued revocation
+        first (the one delivery point of TAG_REVOKE — counts the
+        ``revokes_delivered`` pvar).  The mailbox scan for a queued
+        revocation is rate-limited to the _POLL_S cadence: it is an
+        O(pending-messages) walk under the mailbox lock, and this check
+        runs on EVERY FT-enabled send — unthrottled it would tax the
+        zero-copy pipeline exactly where the segmented engine earns its
+        keep (the sliced blocking waits already re-check every slice)."""
+        if not self.revoked:
+            now = time.monotonic()
+            if now - self._last_revoke_poll >= _POLL_S:
+                self._last_revoke_poll = now  # benign race: extra poll
+                try:
+                    hit = comm._t.poll(ANY_SOURCE, self.home_ctx,
+                                       TAG_REVOKE)
+                except TransportError:
+                    hit = None  # closed mailbox: normal wait path reports
+                if hit is not None:
+                    self.revoked = True
+                    _mpit.count(revokes=1)
+        if self.revoked:
+            raise RevokedError(
+                f"communicator (ctx={comm._ctx}) has been revoked")
+
+
+def enable(comm, liveness=None, rdv_dir: Optional[str] = None,
+           detect_timeout_s: Optional[float] = None,
+           heartbeat_s: Optional[float] = None):
+    """Enable ULFM fault tolerance on a P2P communicator (idempotent per
+    transport; the detector thread is shared).  Process worlds default
+    to heartbeat files under the rendezvous dir (``rdv_dir``, or the
+    launcher's MPI_TPU_RDV); the local thread world passes the shared
+    :class:`MemoryLiveness` (run_local does this for you)."""
+    if getattr(comm, "_ft", None) is not None:
+        return comm
+    world = getattr(comm._t, "_ft_world", None)
+    if world is None:
+        if liveness is None:
+            rdv = rdv_dir or os.environ.get("MPI_TPU_RDV")
+            if rdv is None:
+                raise ValueError(
+                    "fault tolerance needs a liveness substrate: pass "
+                    "liveness= (in-process worlds) or rdv_dir= / set "
+                    "MPI_TPU_RDV (process worlds)")
+            liveness = FileLiveness(rdv, comm._t.world_rank)
+        world = WorldFT(
+            comm._t, liveness,
+            _DETECT_TIMEOUT_S if detect_timeout_s is None
+            else detect_timeout_s,
+            _HEARTBEAT_S if heartbeat_s is None else heartbeat_s)
+        comm._t._ft_world = world
+    comm._ft = CommFT(world, comm._ctx)
+    return comm
+
+
+# -- fault-tolerant agreement (the shrink/agree engine) ----------------------
+
+
+def _agreement(comm, tag: int, value: bool) -> Tuple[int, bool]:
+    """Lockstep iterated exchange among the ranks of ``comm`` not yet
+    believed dead: each round every participant sends its (view, value)
+    to every other and collects one message from each, folding received
+    views (bitwise OR over comm-rank bitmasks — the "all-reduce over
+    liveness bitmaps") and values (AND).  A peer that times out past the
+    detection bound, is detector-flagged, or fails a send joins the
+    view.  Terminates after two consecutive clean rounds; returns
+    (final view bitmask, AND of surviving contributions).
+
+    Runs on the RAW transport (not the communicator's send/recv): shrink
+    and agree must work on a *revoked* communicator [S: ULFM], so the
+    revocation check is deliberately bypassed here."""
+    ft = comm._ft
+    p, r = comm.size, comm.rank
+    epoch = ft.next_epoch(tag)
+    view = 0
+    for cr in comm.get_failed():
+        view |= 1 << cr
+    value = bool(value)
+    clean = 0
+    rnd = 0
+    while clean < 2:
+        rnd += 1
+        sent_view, sent_value = view, value
+        live = [q for q in range(p) if q != r and not (view >> q) & 1]
+        for q in live:
+            try:
+                comm._t.send(comm._group[q], comm._ctx, tag,
+                             (epoch, rnd, view, value))
+            except (TransportError, ValueError) as e:
+                view |= 1 << q
+                ft.world.observe(comm._group[q],
+                                 f"send failed during agreement: {e}")
+        all_equal = True
+        for q in live:
+            got = _agreement_recv(comm, q, tag, epoch, rnd)
+            if got is None:
+                view |= 1 << q
+                all_equal = False
+                continue
+            pview, pval = got
+            view |= pview
+            value = value and pval
+            if pview != sent_view or pval != sent_value:
+                all_equal = False
+        clean = clean + 1 if (view == sent_view and all_equal) else 0
+    return view, value
+
+
+def _agreement_recv(comm, peer: int, tag: int, epoch: int,
+                    rnd: int) -> Optional[Tuple[int, bool]]:
+    """One agreement message from comm-rank ``peer``: sliced wait that
+    gives up (returns None → peer joins the view) when the detector
+    flags the peer or the bounded deadline passes.  Stale epochs (a dead
+    rank's leftovers from an earlier agreement) are discarded; a FUTURE
+    epoch would mean agreement calls were not issued in the same order
+    on every rank — a programming error worth raising over."""
+    ft = comm._ft
+    peer_world = comm._group[peer]
+    deadline = time.monotonic() + max(3.0 * ft.world.detect_timeout_s, 2.0)
+    while True:
+        # Message FIRST, suspicion second: a false suspicion (live peer
+        # stalled past the detection bound on a loaded box) must never
+        # discard an agreement message that has already arrived —
+        # dropping a live participant here is the one way the protocol
+        # can split the group.
+        try:
+            payload, _, _ = comm._t.recv(peer_world, comm._ctx, tag,
+                                         timeout=_POLL_S)
+        except RecvTimeout:
+            if peer_world in ft.world.failed:
+                return None
+            if time.monotonic() > deadline:
+                # overdue joins THIS agreement's view only — a protocol
+                # timeout is weak evidence (the peer may just not have
+                # entered the collective yet), so it must not poison
+                # the world-level failed set the way detector/transport
+                # evidence (WorldFT.observe) does
+                return None
+            continue
+        except TransportError:
+            return None  # transport torn down under us: peer unreachable
+        got_epoch, got_rnd, pview, pval = payload
+        if got_epoch < epoch:
+            continue  # stale leftover: discard
+        if got_epoch > epoch:
+            raise RuntimeError(
+                f"agreement epoch skew from rank {peer}: got {got_epoch}, "
+                f"expected {epoch} (agreements must be issued in the same "
+                f"order on every rank)")
+        if got_rnd != rnd:
+            continue  # defensive: lockstep + FIFO should prevent this
+        return int(pview), bool(pval)
+
+
+def failed_comm_ranks(comm) -> List[int]:
+    """Comm ranks of ``comm`` currently believed dead (sorted)."""
+    ft = getattr(comm, "_ft", None)
+    if ft is None:
+        return []
+    failed_world = ft.world.failed_snapshot() & set(comm._group)
+    return sorted(comm._group.index(w) for w in failed_world)

@@ -123,11 +123,13 @@ def _launch_once(
             bad = [c for c in codes if c not in (None, 0)]
             if bad:
                 _kill_all(procs)
+                sys.stderr.write(_exit_summary(procs))
                 return bad[0]
             if all(c == 0 for c in codes):
                 return 0
             if deadline is not None and time.monotonic() > deadline:
                 _kill_all(procs)
+                sys.stderr.write(_exit_summary(procs))
                 raise TimeoutError(f"ranks still running after {timeout}s")
             time.sleep(0.02)
     finally:
@@ -152,6 +154,11 @@ def _cleanup_shm(rdv: str) -> None:
 
 
 def _kill_all(procs: List[subprocess.Popen]) -> None:
+    """TERM → bounded wait → KILL → reap.  The escalation matters: a rank
+    wedged in native code (shm ring memcpy, a jammed jax runtime) ignores
+    SIGTERM, and a launcher that only TERMs leaves it holding /dev/shm
+    segments and the TPU lock.  The final wait reaps the KILLed zombies
+    so the exit summary below reports real wait statuses, not None."""
     for p in procs:
         if p.poll() is None:
             p.terminate()
@@ -162,6 +169,34 @@ def _kill_all(procs: List[subprocess.Popen]) -> None:
                 p.wait(max(0.0, deadline - time.monotonic()))
             except subprocess.TimeoutExpired:
                 p.send_signal(signal.SIGKILL)
+    for p in procs:
+        if p.poll() is None:
+            try:
+                p.wait(2.0)
+            except subprocess.TimeoutExpired:  # pragma: no cover - kernel
+                pass  # unkillable (D-state); the summary reports it
+
+
+def _exit_summary(procs: List[subprocess.Popen]) -> str:
+    """Per-rank outcome table, printed on any non-zero outcome so a
+    failure-story log is diagnosable without spelunking: WHICH rank died
+    first-order (its own exit code / signal) vs which were merely killed
+    by the launcher's TERM→KILL escalation."""
+    lines = ["mpi_tpu.launcher: per-rank exit summary:"]
+    for r, p in enumerate(procs):
+        code = p.poll()
+        if code is None:
+            what = "still running (unkillable?)"
+        elif code < 0:
+            try:
+                name = signal.Signals(-code).name
+            except ValueError:
+                name = f"signal {-code}"
+            what = f"killed by {name}"
+        else:
+            what = f"exit code {code}"
+        lines.append(f"  rank {r}: {what}")
+    return "\n".join(lines) + "\n"
 
 
 def main(argv: Optional[Sequence[str]] = None) -> int:

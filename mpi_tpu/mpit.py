@@ -37,7 +37,9 @@ _lock = threading.Lock()
 
 class _Counters:
     __slots__ = ("sends", "send_bytes", "recvs", "collectives",
-                 "pallas_fallbacks", "bytes_raw", "bytes_pickled", "copies")
+                 "pallas_fallbacks", "bytes_raw", "bytes_pickled", "copies",
+                 "proc_failed", "revokes", "shrinks",
+                 "faulty_dropped", "faulty_duplicated", "attention_oob")
 
     def __init__(self) -> None:
         self.sends = 0
@@ -48,6 +50,12 @@ class _Counters:
         self.bytes_raw = 0
         self.bytes_pickled = 0
         self.copies = 0
+        self.proc_failed = 0
+        self.revokes = 0
+        self.shrinks = 0
+        self.faulty_dropped = 0
+        self.faulty_duplicated = 0
+        self.attention_oob = 0
 
 
 counters = _Counters()  # incremented by communicator.py / codec.py (count())
@@ -55,7 +63,10 @@ counters = _Counters()  # incremented by communicator.py / codec.py (count())
 
 def count(sends: int = 0, send_bytes: int = 0, recvs: int = 0,
           collectives: int = 0, pallas_fallbacks: int = 0,
-          bytes_raw: int = 0, bytes_pickled: int = 0, copies: int = 0) -> None:
+          bytes_raw: int = 0, bytes_pickled: int = 0, copies: int = 0,
+          proc_failed: int = 0, revokes: int = 0, shrinks: int = 0,
+          faulty_dropped: int = 0, faulty_duplicated: int = 0,
+          attention_oob: int = 0) -> None:
     """Thread-safe increment (rank-threads of the local backend share
     this process's counters; unsynchronized += would lose updates)."""
     with _lock:
@@ -67,6 +78,12 @@ def count(sends: int = 0, send_bytes: int = 0, recvs: int = 0,
         counters.bytes_raw += bytes_raw
         counters.bytes_pickled += bytes_pickled
         counters.copies += copies
+        counters.proc_failed += proc_failed
+        counters.revokes += revokes
+        counters.shrinks += shrinks
+        counters.faulty_dropped += faulty_dropped
+        counters.faulty_duplicated += faulty_duplicated
+        counters.attention_oob += attention_oob
 
 _PVARS: Dict[str, Callable[[], int]] = {
     "msgs_sent": lambda: counters.sends,
@@ -87,6 +104,23 @@ _PVARS: Dict[str, Callable[[], int]] = {
     "bytes_raw_sent": lambda: counters.bytes_raw,
     "bytes_pickled_sent": lambda: counters.bytes_pickled,
     "payload_copies": lambda: counters.copies,
+    # ULFM fault-tolerance events (mpi_tpu/ft.py): distinct world ranks
+    # this process declared dead (detector hit or transport evidence);
+    # revocations applied to a communicator (local revoke() + delivered
+    # remote notifications); shrinks that completed agreement and built
+    # a survivor communicator.
+    "proc_failures_detected": lambda: counters.proc_failed,
+    "revokes_delivered": lambda: counters.revokes,
+    "shrinks_completed": lambda: counters.shrinks,
+    # fault-injection tallies (transport/faulty.py): messages the chaos
+    # wrapper dropped / delivered twice — lets a chaos sweep assert the
+    # injection actually fired without a handle on every wrapper.
+    "faulty_dropped": lambda: counters.faulty_dropped,
+    "faulty_duplicated": lambda: counters.faulty_duplicated,
+    # ring-attention forwards that ran the ppermute fallback because no
+    # tile fit the VMEM budget (tpu/pallas_attention.py — graceful
+    # degradation instead of NotImplementedError; ROADMAP r5 #4)
+    "attention_fallbacks": lambda: counters.attention_oob,
 }
 
 
@@ -169,7 +203,9 @@ def _ensure_builtin_cvars() -> None:
     # registration + flag UNDER it, flag LAST — a concurrent reader must
     # never observe done=True with the registry still empty
     from . import communicator as _c
+    from . import ft as _ft
     from . import io as _io
+    from .transport import shm as _shm
 
     def _get_limit():
         return _io._COLLECTIVE_BUFFER_LIMIT
@@ -197,6 +233,32 @@ def _ensure_builtin_cvars() -> None:
             raise ValueError(
                 "collective_segment_bytes must be >= 0 (0 = per-transport)")
         _c._SEGMENT_BYTES = int(v)
+
+    def _get_recv_timeout():
+        return _c._RECV_TIMEOUT_DEFAULT or 0.0
+
+    def _set_recv_timeout(v):
+        if float(v) < 0:
+            raise ValueError("recv_timeout_s must be >= 0 (0 = no timeout)")
+        _c._RECV_TIMEOUT_DEFAULT = float(v) or None
+
+    def _get_shm_wt():
+        return _shm._WRITE_TIMEOUT
+
+    def _set_shm_wt(v):
+        if float(v) <= 0:
+            raise ValueError("shm_write_timeout_s must be > 0")
+        _shm._WRITE_TIMEOUT = float(v)
+
+    def _set_detect(v):
+        if float(v) <= 0:
+            raise ValueError("fault_detect_timeout_s must be > 0")
+        _ft._DETECT_TIMEOUT_S = float(v)
+
+    def _set_heartbeat(v):
+        if float(v) <= 0:
+            raise ValueError("fault_heartbeat_interval_s must be > 0")
+        _ft._HEARTBEAT_S = float(v)
 
     with _lock:
         if _builtin_done:
@@ -231,6 +293,30 @@ def _ensure_builtin_cvars() -> None:
             "reduce_scatter's segmented-path gate to any payload "
             "spanning more than one segment (default gate: "
             "communicator._RS_SEGMENT_MIN_BYTES)")
+        _CVARS["recv_timeout_s"] = (
+            _get_recv_timeout, _set_recv_timeout,
+            "default recv_timeout of newly created communicators: a "
+            "blocking receive with no matching message raises RecvTimeout "
+            "after this many seconds instead of hanging (0 = wait "
+            "forever).  Per-communicator .recv_timeout still overrides")
+        _CVARS["shm_write_timeout_s"] = (
+            _get_shm_wt, _set_shm_wt,
+            "shm transport no-progress stall bound: a ring write (full "
+            "ring, nobody draining) or mid-frame read with no progress "
+            "for this long declares the peer dead (TransportError).  The "
+            "data plane's last-resort constant — the ft.py detector "
+            "(fault_detect_timeout_s) should fire far earlier")
+        _CVARS["fault_detect_timeout_s"] = (
+            lambda: _ft._DETECT_TIMEOUT_S, _set_detect,
+            "ULFM failure-detection bound (mpi_tpu/ft.py): a peer whose "
+            "heartbeat is stale this long is declared dead and every "
+            "fault-tolerant blocking wait on it raises ProcFailedError "
+            "(MPI_ERR_PROC_FAILED).  Read at ft.enable() time")
+        _CVARS["fault_heartbeat_interval_s"] = (
+            lambda: _ft._HEARTBEAT_S, _set_heartbeat,
+            "how often each fault-tolerant rank publishes its heartbeat "
+            "and scans its peers' (mpi_tpu/ft.py); keep well below "
+            "fault_detect_timeout_s.  Read at ft.enable() time")
         _CVARS["gather_replicated_warn_bytes"] = (
             lambda: _GATHER_WARN_BYTES[0],
             lambda v: _GATHER_WARN_BYTES.__setitem__(0, int(v)),

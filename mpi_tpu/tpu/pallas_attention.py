@@ -254,8 +254,10 @@ def attention_vmem_plan(sb: int, d: int, hq: int, hkv: int, dtype,
     tile that fits — for the forward AND (round 5) the backward.  A
     backward no tile can satisfy returns ``("fallback", None)`` (→
     ppermute recompute, correct at any size); the forward instead
-    raises NotImplementedError with the arithmetic, since it has no
-    correct fallback to offer.
+    raises NotImplementedError with the arithmetic — which the caller
+    (pallas_ring_attention) converts into the loud ppermute-ring
+    fallback (warning + ``attention_fallbacks`` pvar), so an over-tight
+    budget degrades instead of failing (ROADMAP r5 #4).
 
     The estimates are deliberately generous (temporaries counted at
     f32, a spare plane for Mosaic's fusions) so a "resident" or
@@ -924,9 +926,27 @@ def pallas_ring_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
         scale = 1.0 / float(np.sqrt(d))
     # shared dtype/vma/mesh probing with the ring collectives (f32/bf16)
     vma_on, multi_axis = _check_args(q, axis_name, size, sub, "sum")
-    # fold mode from the VMEM budget (raises when nothing fits)
-    _, tiles = attention_vmem_plan(sb, d, hq, hkv, q.dtype,
-                                   vmem_limit_bytes)
+    # Fold mode from the VMEM budget.  A forward NO tile can satisfy
+    # degrades to the ppermute ring (graceful degradation, ROADMAP r5
+    # #4): primal-identical and correct at any size, just without the
+    # kernel's RDMA overlap — so the substitution is LOUD (warning +
+    # ``attention_fallbacks`` mpit pvar), exactly like the vma/multi-
+    # axis interpreter fallback, instead of the former
+    # NotImplementedError that made an over-tight budget fatal.
+    try:
+        _, tiles = attention_vmem_plan(sb, d, hq, hkv, q.dtype,
+                                       vmem_limit_bytes)
+    except NotImplementedError as e:
+        import warnings
+
+        from .. import mpit
+
+        warnings.warn(
+            f"ring attention forward out of VMEM budget — executing the "
+            f"ppermute ring fallback; timings will not reflect the RDMA "
+            f"kernel. ({e})", RuntimeWarning, stacklevel=2)
+        mpit.count(attention_oob=1)
+        return _fallback_attention(q, k, v, axis_name, size, scale, causal)
     bwd_mode, bwd_tiles = attention_vmem_plan(
         sb, d, hq, hkv, q.dtype, vmem_limit_bytes, for_backward=True)
     bwd_fused = bwd_mode in ("resident", "tiled")

@@ -9,11 +9,25 @@ Wraps any Transport and injects configurable faults on the send path:
 * ``delay_s`` — sleep before delivering (models congestion; exposes
   ordering assumptions that only hold under low latency);
 * ``duplicate_every`` — deliver every k-th message twice (models retry
-  storms; exposes non-idempotent receive logic).
+  storms; exposes non-idempotent receive logic);
+* ``kill_after_n`` — the n-th send KILLS this rank (crash-stop: the send
+  and everything after it vanish, :class:`KilledRankError` is raised so
+  the rank's program stops, and the liveness detector sees ``killed``
+  and stops heartbeating — the in-process analogue of ``os._exit`` that
+  makes the whole ULFM story testable in tier-1, see mpi_tpu/ft.py);
+* ``crash_on_send_to`` — like ``kill_after_n`` but triggered by the
+  first send addressed to a specific world rank (dies *before*
+  delivering), for failure placement at an exact schedule edge.
+
+The ``dropped``/``duplicated`` tallies are mpit pvars
+(``faulty_dropped`` / ``faulty_duplicated``) as well as instance
+attributes, so chaos sweeps can assert injection actually happened
+without holding a reference to every wrapper.
 
 FIFO order per channel is preserved for non-faulted messages.  Use with
 ``run_local(..., transport_wrapper=FaultyTransport.wrapper(...))`` and a
-recv ``timeout`` to turn silent deadlocks into diagnosable failures.
+recv ``timeout`` (or fault_tolerance=True) to turn silent deadlocks into
+diagnosable failures.
 """
 
 from __future__ import annotations
@@ -22,12 +36,24 @@ import threading
 import time
 from typing import Any, Optional
 
+from .. import mpit as _mpit
 from .base import Transport
+
+
+class KilledRankError(RuntimeError):
+    """Raised on the injected-death rank itself (and on any later use of
+    its transport): the in-process spelling of 'this process is gone'.
+    run_local treats it as a simulated crash — the rank's result slot
+    records the death and the SURVIVORS' mailboxes stay open, so the
+    failure is theirs to detect (unlike a real error, which closes every
+    mailbox to unblock the world)."""
 
 
 class FaultyTransport(Transport):
     def __init__(self, inner: Transport, drop_every: int = 0,
-                 delay_s: float = 0.0, duplicate_every: int = 0) -> None:
+                 delay_s: float = 0.0, duplicate_every: int = 0,
+                 kill_after_n: int = 0,
+                 crash_on_send_to: Optional[int] = None) -> None:
         self.inner = inner
         self.world_rank = inner.world_rank
         self.world_size = inner.world_size
@@ -39,31 +65,49 @@ class FaultyTransport(Transport):
         self.drop_every = drop_every
         self.delay_s = delay_s
         self.duplicate_every = duplicate_every
+        self.kill_after_n = kill_after_n
+        self.crash_on_send_to = crash_on_send_to
         self._n = 0
         self._lock = threading.Lock()
         self.dropped = 0
         self.duplicated = 0
+        self.killed = False  # read by the ft.py detector (stops beating)
 
     @classmethod
     def wrapper(cls, **kwargs):
         """For run_local's transport_wrapper hook."""
         return lambda inner: cls(inner, **kwargs)
 
+    def _die(self, why: str) -> None:
+        self.killed = True
+        raise KilledRankError(
+            f"rank {self.world_rank}: injected death ({why})")
+
     def send(self, dest: int, ctx, tag: int, payload: Any) -> None:
+        if self.killed:
+            self._die("already dead")
+        if self.crash_on_send_to is not None and dest == self.crash_on_send_to:
+            self._die(f"crash_on_send_to={dest}")
         with self._lock:
             self._n += 1
             n = self._n
+        if self.kill_after_n and n >= self.kill_after_n:
+            self._die(f"kill_after_n={self.kill_after_n}")
         if self.drop_every and n % self.drop_every == 0:
             self.dropped += 1
+            _mpit.count(faulty_dropped=1)
             return
         if self.delay_s:
             time.sleep(self.delay_s)
         self.inner.send(dest, ctx, tag, payload)
         if self.duplicate_every and n % self.duplicate_every == 0:
             self.duplicated += 1
+            _mpit.count(faulty_duplicated=1)
             self.inner.send(dest, ctx, tag, payload)
 
     def recv(self, source: int, ctx, tag: int, timeout: Optional[float] = None):
+        if self.killed:
+            self._die("already dead")
         return self.inner.recv(source, ctx, tag, timeout)
 
     def close(self) -> None:

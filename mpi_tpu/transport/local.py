@@ -47,6 +47,9 @@ class LocalTransport(Transport):
         self.mailbox.close()
 
 
+KILLED = object()  # result-slot sentinel: this rank died by injection
+
+
 def run_local(
     fn: Callable,
     nranks: int,
@@ -56,12 +59,22 @@ def run_local(
     copy_payloads: bool = True,
     transport_wrapper: Optional[Callable[[Transport], Transport]] = None,
     recv_timeout: Optional[float] = None,
+    fault_tolerance: bool = False,
 ) -> List[Any]:
     """Run ``fn(comm, *args, **kwargs)`` on ``nranks`` in-process ranks;
     return the per-rank results as a list indexed by rank.
 
     ``transport_wrapper`` lets tests interpose (fault injection, tracing) at
     the plugin boundary without touching the Communicator.
+
+    ``fault_tolerance=True`` enables the ULFM layer (mpi_tpu/ft.py) on
+    every rank over one shared in-memory liveness table: a rank killed by
+    FaultyTransport injection (KilledRankError) records :data:`KILLED` in
+    its result slot and — unlike a real error — does NOT close the other
+    mailboxes, so survivors exercise detection/revoke/shrink exactly as
+    they would against a dead process.  A rank whose ``fn`` returns stops
+    heartbeating, so long-running survivors eventually see it as failed —
+    keep the detection timeout above the straggler spread.
     """
     from ..communicator import P2PCommunicator
 
@@ -70,20 +83,40 @@ def run_local(
     results: List[Any] = [None] * nranks
     errors: List[tuple] = []
     lock = threading.Lock()
+    liveness = None
+    if fault_tolerance:
+        from .. import ft as _ft
+
+        liveness = _ft.MemoryLiveness(nranks)
 
     def runner(r: int) -> None:
+        ft_state = None
         try:
             t: Transport = LocalTransport(world, r)
             if transport_wrapper is not None:
                 t = transport_wrapper(t)
             comm = P2PCommunicator(t, range(nranks), recv_timeout=recv_timeout)
+            if liveness is not None:
+                from .. import ft as _ft
+
+                ft_state = _ft.enable(comm, liveness=liveness)._ft
             results[r] = fn(comm, *args, **kwargs)
         except BaseException as e:  # noqa: BLE001 - propagated to caller below
+            from .faulty import KilledRankError
+
+            if isinstance(e, KilledRankError):
+                # simulated crash-stop: the rank is gone but the WORLD
+                # lives on — survivors must detect/recover on their own
+                results[r] = KILLED
+                return
             with lock:
                 errors.append((r, e, traceback.format_exc()))
             # unblock peers waiting on this rank
             for mb in world.mailboxes:
                 mb.close()
+        finally:
+            if ft_state is not None:
+                ft_state.world.stop()
 
     threads = [
         threading.Thread(target=runner, args=(r,), name=f"mpi-tpu-rank-{r}", daemon=True)

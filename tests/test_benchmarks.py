@@ -70,6 +70,25 @@ def test_host_sweep_quick_smoke():
                                                     "combined_bytes"}
 
 
+def test_chaos_quick_smoke():
+    """The chaos harness end to end in --quick mode (the ``bench.py
+    --chaos --quick`` CI spelling): FaultyTransport drop/delay/duplicate
+    over the collective family — every cell completes or fails
+    DIAGNOSABLY (no hangs), and the injection pvars prove faults
+    actually fired."""
+    from benchmarks import chaos
+
+    result = chaos.run_chaos(quick=True)
+    assert result["ok"], result["hangs"]
+    assert result["hangs"] == []
+    assert result["cells"], "no chaos cells ran"
+    for cell in result["cells"]:
+        assert (cell["outcome"] in ("ok", "wrong_result")
+                or cell["outcome"].startswith("diagnosed:")), cell
+    assert result["injected"]["dropped"] >= 1
+    assert result["injected"]["duplicated"] >= 1
+
+
 @pytest.mark.parametrize("bench", ["allreduce", "bcast", "alltoall"])
 def test_tpu_smoke(bench):
     algos = {"allreduce": ["ring", "fused"], "bcast": ["tree"],

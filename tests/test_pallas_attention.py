@@ -810,3 +810,23 @@ def test_bwd_tiled_parity_gqa(Hq, Hkv, causal):
         grads[name] = [np.asarray(x) for x in g]
     for gk, gr in zip(grads["kernel"], grads["ref"]):
         np.testing.assert_allclose(gk, gr, rtol=2e-4, atol=1e-4)
+
+
+def test_forward_oob_falls_back_loudly():
+    """An un-tileable FORWARD budget degrades to the ppermute ring
+    (ROADMAP r5 #4 graceful degradation) — numerically exact, with the
+    shared loud-substitution contract: a RuntimeWarning AND the
+    ``attention_fallbacks`` mpit pvar, never NotImplementedError."""
+    from mpi_tpu import mpit
+    from mpi_tpu.tpu.pallas_attention import attention_vmem_plan
+
+    Pn, Sb, d = 2, 8, 128
+    # a budget even the minimal tile can't satisfy (the plan still
+    # raises — the CALLER owns the substitution)
+    with pytest.raises(NotImplementedError):
+        attention_vmem_plan(Sb, d, 1, 1, np.float32, vmem_limit_bytes=1)
+    before = mpit.pvar_read("attention_fallbacks")
+    with pytest.warns(RuntimeWarning, match="out of VMEM budget"):
+        got, want = _run(Pn, Sb, d, vmem_limit_bytes=1)
+    np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-5)
+    assert mpit.pvar_read("attention_fallbacks") == before + 1
