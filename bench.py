@@ -529,6 +529,10 @@ def main() -> None:
     speedup = socket_us / spmd_us
     med_speedup = (statistics.median(socket_samples)
                    / statistics.median(spmd_samples))
+    # ISSUE 4 satellite: every bench result JSON is oversubscription-
+    # stamped (2 rank procs + the driver on this box's cores) so the
+    # known ±2-3x noise cells are machine-identifiable
+    details["oversubscribed"] = 3 > (os.cpu_count() or 1)
     with open(os.path.join(REPO, "BENCH_DETAILS.json"), "w") as f:
         json.dump(details, f, indent=2)
 
@@ -538,6 +542,7 @@ def main() -> None:
         "unit": "x",
         "vs_baseline": round(speedup, 3),
         "median_speedup": round(med_speedup, 3),
+        "oversubscribed": 3 > (os.cpu_count() or 1),
         "socket_us_min_med_max": [round(min(socket_samples), 1),
                                   round(statistics.median(socket_samples),
                                         1),

@@ -62,6 +62,15 @@ SWEEP_BENCHES = (
     ("reduce_scatter", ("ring",)),
 )
 
+# Small-message band (ISSUE 4 satellite): osu_latency / osu_barrier plus
+# small allreduce swept 8B-64KB.  Small-message p50s are far less noisy
+# on an oversubscribed box than the 64MB bandwidth cells — this is the
+# band where the shared-memory collective arena's win is assertable.
+# 'auto' records the shipping policy on each side of a perf PR; 'ring'
+# pins the segmented-ring engine as the contemporary baseline.
+SMALL_SIZES = "8,64,1KB,4KB,16KB,64KB"
+SMALL_ALLREDUCE_ALGOS = "auto,ring"
+
 
 def _osu_rows(backend: str, bench: str, sizes: str, algos: Optional[str],
               iters: int, warmup: int,
@@ -96,6 +105,25 @@ def collective_sweep(quick: bool = False) -> Dict[str, List[Dict]]:
                                   iters, warmup)
         out[bench] = rows
     return out
+
+
+def small_message_sweep(quick: bool = False) -> List[Dict]:
+    """osu_latency + osu_barrier + small allreduce (8B-64KB), both host
+    transports — the arena's before/after artifact band.  Rows carry
+    ``leg`` = ``osu_latency`` / ``osu_barrier`` / ``osu_allreduce``."""
+    sizes = "1KB" if quick else SMALL_SIZES
+    iters, warmup = (1, 0) if quick else (120, 20)
+    rows: List[Dict] = []
+    for backend in TRANSPORTS:
+        for leg, bench, szs, algos in (
+                ("osu_latency", "latency", sizes, None),
+                ("osu_barrier", "barrier", "1", None),
+                ("osu_allreduce", "allreduce", sizes,
+                 SMALL_ALLREDUCE_ALGOS)):
+            for r in _osu_rows(backend, bench, szs, algos, iters, warmup):
+                r["leg"] = leg
+                rows.append(r)
+    return rows
 
 
 def latency_diagnosis_legs() -> List[Dict]:
@@ -216,9 +244,12 @@ def run_sweep(label: str, quick: bool = False) -> Dict:
         "quick": quick,
         "nranks": 2,
         "cpus": os.cpu_count(),
+        # 2 rank processes + the sweep driver (see osu.run_bench)
+        "oversubscribed": 3 > (os.cpu_count() or 1),
         "allreduce_rows": rows,
         "alltoall_rows": benches["alltoall"],
         "reduce_scatter_rows": benches["reduce_scatter"],
+        "small_message_rows": small_message_sweep(quick=quick),
         "crossover": derive_crossover(rows),
         "rabenseifner_crossover": derive_rabenseifner_crossover(rows),
         "wall_s": round(time.time() - t0, 1),
@@ -228,14 +259,34 @@ def run_sweep(label: str, quick: bool = False) -> Dict:
     return result
 
 
+def run_small_sweep(label: str, quick: bool = False) -> Dict:
+    """Just the small-message band — the arena PR's pre/post artifact
+    (committed as benchmarks/results/osu_small_{pre,post}.json)."""
+    t0 = time.time()
+    return {
+        "label": label,
+        "quick": quick,
+        "nranks": 2,
+        "cpus": os.cpu_count(),
+        # 2 rank processes + the sweep driver (see osu.run_bench)
+        "oversubscribed": 3 > (os.cpu_count() or 1),
+        "small_message_rows": small_message_sweep(quick=quick),
+        "wall_s": round(time.time() - t0, 1),
+    }
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--label", default="post")
     ap.add_argument("--out", default=None)
     ap.add_argument("--quick", action="store_true",
                     help="smoke mode: 1KB only, 1 sample, no latency legs")
+    ap.add_argument("--small", action="store_true",
+                    help="small-message band only (osu_latency/osu_barrier/"
+                         "small allreduce) — the arena pre/post artifact")
     args = ap.parse_args(argv)
-    result = run_sweep(args.label, quick=args.quick)
+    result = (run_small_sweep(args.label, quick=args.quick) if args.small
+              else run_sweep(args.label, quick=args.quick))
     text = json.dumps(result, indent=2)
     if args.out:
         os.makedirs(os.path.dirname(os.path.abspath(args.out)), exist_ok=True)

@@ -1,10 +1,14 @@
 """OSU-style micro-benchmark suite (SURVEY.md §2 component #12;
 BASELINE.json:2,7-10).
 
-Benchmarks: ``latency`` (ping-pong), ``bcast``, ``reduce``, ``allreduce``,
-``allgather``, ``alltoall``, ``reduce_scatter`` — swept over message sizes
-and algorithm variants on any backend.  Output is JSON lines so BASELINE.md
-tables regenerate mechanically (SURVEY.md §5 observability row).
+Benchmarks: ``latency`` (ping-pong — the classic ``osu_latency``),
+``barrier`` (``osu_barrier``: p50 of a full barrier round), ``bcast``,
+``reduce``, ``allreduce``, ``allgather``, ``alltoall``,
+``reduce_scatter`` — swept over message sizes and algorithm variants on
+any backend.  Output is JSON lines so BASELINE.md tables regenerate
+mechanically (SURVEY.md §5 observability row).  Every row carries
+``oversubscribed`` (ranks > cpu cores) so the known ±2-3x noise cells of
+an oversubscribed box are machine-identifiable.
 
 Bus-bandwidth follows the NCCL-tests convention (SURVEY.md §6):
 allreduce ``bytes × 2(P−1)/P ÷ t``; allgather/alltoall/reduce_scatter
@@ -128,6 +132,26 @@ def cpu_bench_program(comm, bench: str, sizes: List[int], algos: List[str],
                 rows.append({"bench": "latency", "nranks": comm.size,
                              "bytes": nbytes,
                              "p50_us": statistics.median(samples) * 1e6})
+        return rows
+
+    if bench == "barrier":
+        # osu_barrier: p50 of one full barrier round (no payload, so the
+        # sizes sweep collapses to a single row).  The slowest rank's
+        # median is the barrier completion time, like the collectives.
+        comm.barrier()
+        samples = []
+        for i in range(warmup + iters):
+            t0 = time.perf_counter()
+            comm.barrier()
+            dt = time.perf_counter() - t0
+            if i >= warmup:
+                samples.append(dt)
+        p50 = float(np.asarray(comm.allreduce(
+            np.float64(statistics.median(samples)), op=mpi_tpu.MAX,
+            algorithm="reduce_bcast")))
+        if comm.rank == 0:
+            rows.append({"bench": "barrier", "nranks": comm.size,
+                         "bytes": 0, "p50_us": p50 * 1e6})
         return rows
 
     if bench == "bw":
@@ -317,8 +341,8 @@ def tpu_bench(bench: str, sizes: List[int], algos: List[str], iters: int,
 # CLI
 # ---------------------------------------------------------------------------
 
-ALL_BENCHES = ["latency", "bw", "bcast", "reduce", "allreduce", "allgather",
-               "alltoall", "reduce_scatter"]
+ALL_BENCHES = ["latency", "bw", "barrier", "bcast", "reduce", "allreduce",
+               "allgather", "alltoall", "reduce_scatter"]
 DEFAULT_ALGOS = {
     "allreduce": ["ring", "recursive_halving", "fused"],  # + pallas_ring (tpu, opt-in)
     "bcast": ["tree", "fused"],
@@ -328,6 +352,7 @@ DEFAULT_ALGOS = {
     "reduce_scatter": ["ring", "fused"],
     "latency": ["-"],
     "bw": ["-"],
+    "barrier": ["-"],
 }
 
 
@@ -335,11 +360,11 @@ def run_bench(bench: str, backend: str, nranks: int, sizes: List[int],
               algos: List[str], iters: int, warmup: int,
               algos_explicit: bool = False) -> List[Dict]:
     if backend == "tpu":
-        if bench == "bw":
-            # SPMD has no standalone p2p stream; bandwidth tiers are the
-            # collective sweeps + bench.py's ICI line-rate probe
-            return [{"bench": "bw", "backend": "tpu",
-                     "skipped": "windowed p2p bw is a process-backend bench"}]
+        if bench in ("bw", "barrier"):
+            # SPMD has no standalone p2p stream and its barrier is a
+            # device-fused psum; both are process-backend benches
+            return [{"bench": bench, "backend": "tpu",
+                     "skipped": f"{bench} is a process-backend bench"}]
         return tpu_bench(bench, sizes, algos, iters, warmup, nranks)
     if not algos_explicit:
         # 'fused'/'pallas_ring' are TPU-backend tiers; drop them from the
@@ -364,8 +389,19 @@ def run_bench(bench: str, backend: str, nranks: int, sizes: List[int],
                 "backend=socket must run under the launcher:\n"
                 f"  python -m mpi_tpu.launcher -n {nranks} benchmarks/osu.py ..."
             )
+    ncpu = os.cpu_count() or 1
+    # Process backends run N rank PROCESSES plus the driving process —
+    # that +1 is exactly what makes the 2-rank sweeps contend on the
+    # 2-core reference box (the documented ±2-3x noise band), so it must
+    # count or the stamp reads false on the very box it was built for.
+    # Thread/SPMD backends share the driver's process.
+    extra = 0 if backend in ("local", "tpu") else 1
     for r in rows:
         r.setdefault("backend", backend)
+        # the row's own rank count when present — under the launcher the
+        # CLI -n default is not the world size
+        r.setdefault("oversubscribed",
+                     int(r.get("nranks", nranks)) + extra > ncpu)
     return rows
 
 

@@ -35,9 +35,9 @@ from .transport.base import ANY_SOURCE, ANY_TAG
 from .transport.local import run_local
 from . import datatypes, errors, ft, io, mpi4, schedules, checker, checkpoint, profiling, trace
 from .intercomm import InterComm, create_intercomm
-from .topology import (CartComm, GraphComm, cart_create,
+from .topology import (CartComm, GraphComm, HierarchicalComm, cart_create,
                        dims_create, dist_graph_create_adjacent,
-                       graph_create)
+                       graph_create, split_hierarchical)
 from .group import Group
 from .spawn import (comm_accept, comm_connect, comm_get_parent, comm_spawn,
                     comm_spawn_multiple, close_port, lookup_name, open_port,
@@ -51,8 +51,8 @@ __all__ = [
     "Communicator", "Message", "P2PCommunicator", "Request", "Status", "ANY_SOURCE", "ANY_TAG",
     "init", "finalize", "is_initialized", "run", "run_local",
     "schedules", "checker", "checkpoint", "ft", "profiling", "trace", "COMM_WORLD", "io", "mpi4",
-    "CartComm", "GraphComm", "InterComm", "create_intercomm",
-    "cart_create", "graph_create",
+    "CartComm", "GraphComm", "HierarchicalComm", "InterComm",
+    "create_intercomm", "cart_create", "graph_create", "split_hierarchical",
     "dist_graph_create_adjacent", "dims_create", "Group",
     "GetFuture", "P2PWindow", "SharedWindow", "win_allocate_shared",
     "comm_spawn", "comm_spawn_multiple", "comm_get_parent",

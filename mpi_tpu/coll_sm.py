@@ -1,0 +1,581 @@
+"""Shared-memory collective arena (coll/sm) — one-copy intra-node collectives.
+
+The segmented zero-copy engine (ISSUE 1-2) made every collective byte ride
+raw frames, but on the shm transport each byte still takes TWO memcpys
+through a per-pair SPSC ring plus a futex doorbell per frame.  Between
+co-located ranks the interconnect IS shared memory, so the proven fix from
+production MPI stacks (MPICH's ``coll/sm``, Open MPI's HAN hierarchy) is to
+map one POSIX shared-memory **arena** per communicator that ranks load and
+store directly:
+
+* layout — P per-rank 64-byte **flag lines** (a monotone sequence counter
+  per rank: the generalized sense-reversing barrier, posted with release
+  semantics and awaited with acquire semantics by the native ``shmflag_*``
+  ops in native/shmring.cpp) followed by P data **slots** (a tiny
+  length-prefixed meta pickle, then raw payload bytes at a 64-byte-aligned
+  offset);
+* small payloads (≤ the ``coll_sm_eager_bytes`` cvar) take the **flat**
+  single-copy path: write own slot → barrier → read peers' slots in place
+  (bcast/reduce/allreduce/allgather; barrier is the flag round alone) — no
+  frames, no pickling of payload bytes, no doorbells;
+* large allreduce/reduce_scatter take the **block in-place** path: write
+  own payload → barrier → each rank folds its assigned chunk (the shared
+  ``schedules.chunk_offsets`` table) reading peers' blocks *in place from
+  the arena* with ``op.combine_into`` — one copy in, one copy out, versus
+  the ring's per-hop memcpys;
+* every payload-bearing entry writes a meta word first and the whole group
+  **negotiates inside the arena**: if any rank's payload cannot ride it
+  (not a plain ndarray, larger than a slot, mismatched geometry for a
+  reduction), all ranks observe the same metas after the entry barrier and
+  fall back to the classic wire algorithms together (counted in the
+  ``coll_sm_fallbacks`` pvar) — which is what lets ``algorithm="auto"``
+  route to the arena even for bcast (payload known only at the root) and
+  ragged allgather without any rank-divergent choice;
+* arena waits run in the same ~50ms slices as the segmented engine's
+  ``_seg_exchange``: with fault tolerance enabled a dead rank surfaces as
+  ``ProcFailedError`` inside ``fault_detect_timeout_s`` (and a revocation
+  as ``RevokedError``) instead of deadlocking a barrier; without FT the
+  wait is bounded by ``recv_timeout`` / the shm stall constant.
+
+Observability: ``coll_sm_hits`` / ``coll_sm_bytes`` / ``coll_sm_fallbacks``
+mpit pvars; arena copy-in/copy-out passes count into ``payload_copies`` so
+the ≤2-copies-per-rank contract is assertable, and zero ring frames /
+zero pickled payload bytes are provable from the untouched ``msgs_sent`` /
+``bytes_pickled_sent`` counters (tests/test_coll_sm.py).
+
+Lifecycle: the communicator's rank 0 creates the segment (named from the
+transport session + communicator context, so the launcher's crash-path
+glob sweeps orphans), peers open-and-wait like the ring handshake; handles
+are refcounted in the module ``_LIVE`` registry (pruned like mpi4's
+``_CFG_GENERATIONS``) and closed — creator unlinking the name — when the
+transport closes at world finalize.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import functools
+import hashlib
+import os
+import pickle
+import struct
+import threading
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from . import mpit as _mpit
+from . import schedules
+from .transport import codec as _codec
+from .transport.base import ANY_SOURCE, RecvTimeout, TransportError
+
+# Sentinel: the arena declined this payload (after keeping the group in
+# lockstep); the caller runs the classic wire algorithm.
+FALLBACK = object()
+
+# Arena size per communicator (mpit cvar ``coll_sm_arena_bytes``; 0
+# disables the arena entirely — the kill switch).  Each rank's slot is
+# the P-th share after the flag lines, so the largest payload the
+# in-place block paths take is ~arena/P.
+_ARENA_BYTES = 8 << 20
+# Flat-path gate (mpit cvar ``coll_sm_eager_bytes``): reductions at or
+# below this read every peer's slot whole (latency-optimal, P·N loads);
+# above it allreduce folds per-chunk in place (bandwidth-optimal).
+_EAGER_BYTES = 32 << 10
+
+_LINE = 64          # flag line stride (cache-line separation)
+_META_MAX = 256     # per-slot meta region: u32 length + meta pickle
+_META_LEN = struct.Struct("<I")
+_SLICE_S = 0.05     # FT/teardown re-check cadence of arena waits
+_OPEN_TIMEOUT = 60.0
+
+_KIND_NONE = 0      # "my payload cannot ride the arena" (or no payload)
+_KIND_DATA = 1
+
+# name -> {"refs": int, "creator": bool} — the _CFG_GENERATIONS-style
+# registry: locked, refcounted, pruned as handles close; lets tests
+# assert unlink-at-finalize and makes accidental double-creation loud.
+_LIVE: Dict[str, Dict[str, Any]] = {}
+_LIVE_LOCK = threading.Lock()
+
+
+def gate(comm) -> Tuple[str, ...]:
+    """The extra ``algorithm=`` names this communicator's transport
+    earns: ``("sm",)`` on an arena-capable (shm) transport, ``()``
+    otherwise — so socket worlds reject ``"sm"`` with the standard
+    unknown-algorithm gate error."""
+    return ("sm",) if getattr(comm._t, "supports_coll_sm", False) else ()
+
+
+def arena_for(comm) -> Optional["Arena"]:
+    """This communicator's arena, created collectively on first use; None
+    when the arena cannot serve it (socket/local transport, size-1 group,
+    a nonblocking-collective clone, or the cvar kill switch)."""
+    if _ARENA_BYTES <= 0 or comm.size < 2:
+        return None
+    if not getattr(comm._t, "supports_coll_sm", False):
+        return None
+    if getattr(comm, "_no_coll_sm", False):
+        return None
+    arena = comm.__dict__.get("_coll_sm_arena")
+    if arena is None:
+        arena = Arena(comm)
+        comm._coll_sm_arena = arena
+    return arena
+
+
+def _arena_name(session: str, ctx, group) -> str:
+    """/dev/shm name of one communicator's arena.  Digest of the context
+    AND the member group (contexts are nested tuples — deterministic repr
+    across ranks): disjoint split() children deliberately share a context
+    (the mailbox disambiguates by source, so the wire never collides),
+    but each needs its OWN arena — the group is what tells node 0's intra
+    communicator from node 1's.  The session prefix keeps the name inside
+    the launcher's crash-cleanup glob (transport/shm.py shm_prefix)."""
+    from .transport.shm import shm_prefix
+
+    digest = hashlib.sha1(repr((ctx, tuple(group))).encode()).hexdigest()[:16]
+    return f"/{shm_prefix(session)}arena_{digest}"
+
+
+class Arena:
+    """One mapped collective arena: flag lines + data slots + the sliced
+    flag-wait that converts peer death into ProcFailedError."""
+
+    def __init__(self, comm):
+        from .native import load_shmring
+
+        t = comm._t
+        self._lib = load_shmring()
+        p = comm.size
+        self._p = p
+        self._rank = comm.rank
+        slot = ((_ARENA_BYTES - _LINE * p) // p) // _LINE * _LINE
+        if slot < _META_MAX + _LINE:
+            raise TransportError(
+                f"coll_sm_arena_bytes={_ARENA_BYTES} too small for {p} "
+                f"ranks (slot would be {slot} bytes)")
+        self.slot_bytes = slot
+        self.capacity = slot - _META_MAX  # payload bytes per slot
+        nbytes = _LINE * p + slot * p
+        self.name = _arena_name(t._session, comm._ctx, comm._group)
+        self._creator = comm.rank == 0
+        with _LIVE_LOCK:
+            ent = _LIVE.setdefault(self.name, {"refs": 0, "creator": False})
+            if self._creator:
+                if ent["creator"]:
+                    raise RuntimeError(
+                        f"concurrent creation of arena {self.name!r} "
+                        f"(two communicators resolved the same context?)")
+                ent["creator"] = True
+            ent["refs"] += 1
+        name_b = self.name.encode()
+        # Rendezvous handshake, exactly like the rings (shm.py
+        # _out_ring_locked): the creator publishes a readiness file in
+        # the rendezvous dir AFTER creating the segment, and openers
+        # wait for THAT file, not for the name to appear in /dev/shm.
+        # Without it an opener can map a STALE segment (a crashed
+        # earlier run with the same session basename — ranks that died
+        # without closing leave the name behind) in the window before
+        # the creator's unlink+recreate, leaving the group split across
+        # two segments that share one name: a silent barrier deadlock.
+        rdv = getattr(t, "_rdv", None)
+        flag = (None if rdv is None else
+                os.path.join(rdv, "arena." + self.name.rsplit("_", 1)[-1]))
+        timeout = getattr(t, "_connect_timeout", _OPEN_TIMEOUT)
+        self._flag_file = flag if comm.rank == 0 else None
+        if self._creator:
+            self._ptr = self._lib.shmarena_create(name_b, nbytes)
+            if self._ptr and flag is not None:
+                try:
+                    tmp = flag + f".tmp.{os.getpid()}"
+                    with open(tmp, "w") as f:
+                        f.write("ready")
+                    os.replace(tmp, flag)
+                except OSError:
+                    pass  # rdv dir tearing down — openers wait on magic
+        else:
+            if flag is not None:
+                deadline = time.monotonic() + timeout
+                while not os.path.exists(flag):
+                    if time.monotonic() > deadline:
+                        break  # fall through: open-by-magic still bounded
+                    time.sleep(0.002)
+            self._ptr = self._lib.shmarena_open(name_b, timeout)
+        if not self._ptr:
+            with _LIVE_LOCK:
+                ent = _LIVE.get(self.name)
+                if ent:
+                    ent["refs"] -= 1
+                    if self._creator:
+                        ent["creator"] = False
+                    if ent["refs"] <= 0:
+                        _LIVE.pop(self.name, None)
+            raise TransportError(
+                f"rank {comm.rank}: arena "
+                f"{'create' if self._creator else 'open'}({self.name!r}) "
+                f"failed")
+        self._base = int(self._lib.shmarena_addr(self._ptr))
+        cbuf = (ctypes.c_ubyte * nbytes).from_address(self._base)
+        self._cbuf = cbuf  # keeps the mapping's python view alive
+        self._mem: Optional[np.ndarray] = np.frombuffer(cbuf, np.uint8)
+        self._slots_off = _LINE * p
+        self.seq = 0
+        self._closed = False
+        self._active = 0  # collectives currently touching the mapping
+        # registered on the TRANSPORT (arenas of sub-communicators share
+        # it), closed by ShmTransport.close() at world finalize
+        t._coll_arenas = getattr(t, "_coll_arenas", {})
+        t._coll_arenas[(comm._ctx, comm._group)] = self
+
+    # -- slots -------------------------------------------------------------
+
+    def _slot(self, rank: int) -> np.ndarray:
+        off = self._slots_off + rank * self.slot_bytes
+        return self._mem[off:off + self.slot_bytes]
+
+    def write_meta(self, kind: int, arr: Optional[np.ndarray]) -> int:
+        """Write this rank's meta word (+payload bytes when ``kind`` is
+        data); returns the kind actually written (a meta pickle that
+        overflows its region degrades to _KIND_NONE)."""
+        desc = None if arr is None else (arr.dtype.str, arr.shape)
+        meta = pickle.dumps((kind, desc), protocol=pickle.HIGHEST_PROTOCOL)
+        if len(meta) > _META_MAX - _META_LEN.size:  # absurd ndim: decline
+            kind, meta = _KIND_NONE, pickle.dumps(
+                (_KIND_NONE, None), protocol=pickle.HIGHEST_PROTOCOL)
+        slot = self._slot(self._rank)
+        slot[:_META_LEN.size] = np.frombuffer(
+            _META_LEN.pack(len(meta)), np.uint8)
+        slot[_META_LEN.size:_META_LEN.size + len(meta)] = np.frombuffer(
+            meta, np.uint8)
+        if kind == _KIND_DATA and arr is not None and arr.nbytes:
+            dst = slot[_META_MAX:_META_MAX + arr.nbytes].view(arr.dtype)
+            dst[...] = arr.reshape(-1)
+        return kind
+
+    def read_meta(self, rank: int):
+        slot = self._slot(rank)
+        (mlen,) = _META_LEN.unpack(slot[:_META_LEN.size].tobytes())
+        return pickle.loads(slot[_META_LEN.size:_META_LEN.size + mlen]
+                            .tobytes())
+
+    def data(self, rank: int, dtype, nelems: int) -> np.ndarray:
+        """Rank ``rank``'s payload as a flat IN-PLACE view of the arena —
+        valid only between the entry barrier and the exit barrier; never
+        returned to the caller (results are private copies)."""
+        dtype = np.dtype(dtype)
+        slot = self._slot(rank)
+        return slot[_META_MAX:_META_MAX + nelems * dtype.itemsize].view(dtype)
+
+    # -- synchronization ---------------------------------------------------
+
+    def _flag_addr(self, rank: int) -> int:
+        return self._base + rank * _LINE
+
+    def barrier(self, comm) -> None:
+        """One flag round: post my next sequence value, wait until every
+        peer has posted it too.  All collectives on a communicator are
+        issued in the same order on every rank (the MPI requirement the
+        wire algorithms already lean on), so the local counters stay in
+        lockstep with zero arena traffic beyond the flags."""
+        self.seq += 1
+        target = self.seq & 0xFFFFFFFF
+        self._lib.shmflag_post(self._flag_addr(self._rank), target)
+        for q in range(self._p):
+            if q != self._rank:
+                self._wait_flag(comm, q, target)
+
+    def _wait_flag(self, comm, peer: int, target: int) -> None:
+        """Sliced flag wait — the arena's analogue of the segmented
+        engine's FT-gated irecv drain: between ~50ms native waits a
+        queued revocation raises RevokedError and a detector hit raises
+        ProcFailedError naming the collective, so a dead rank never
+        deadlocks a barrier; without FT the wait is bounded by the
+        communicator's recv_timeout (RecvTimeout) or the shm transport's
+        stall constant (TransportError)."""
+        from .transport import shm as _shm
+
+        addr = self._flag_addr(peer)
+        timeout = comm.recv_timeout
+        bound = _shm._WRITE_TIMEOUT if timeout is None else timeout
+        deadline = time.monotonic() + bound
+        while True:
+            cur = self._lib.shmflag_wait_ge(addr, target, _SLICE_S)
+            if ((cur - target) & 0xFFFFFFFF) < 0x80000000:  # wrap-safe >=
+                return
+            if self._closed:
+                raise TransportError(
+                    f"rank {self._rank}: arena closed while waiting for "
+                    f"rank {peer} in {comm._coll_name!r}")
+            # FT parity with _seg_exchange: detector hit / revocation
+            # surfaces here, inside the detection bound
+            comm._ft_poll_check(ANY_SOURCE, -2)
+            if time.monotonic() > deadline:
+                what = (f"arena wait on rank {peer} in collective "
+                        f"{comm._coll_name!r}")
+                if timeout is not None:
+                    raise RecvTimeout(
+                        f"{what} timed out after {timeout}s")
+                raise TransportError(
+                    f"rank {self._rank}: {what} made no progress for "
+                    f"{bound}s — is the peer alive?")
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def _begin(self) -> None:
+        if self._closed:
+            raise TransportError(
+                f"rank {self._rank}: collective arena {self.name!r} is "
+                f"closed")
+        self._active += 1
+
+    def _end(self) -> None:
+        self._active -= 1
+
+    def close(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        if self._active == 0:
+            # quiescent: release the mapping now.  A close racing an
+            # in-flight collective (crash-path teardown) instead LEAKS
+            # the mapping until process exit — the doorbell pattern from
+            # transport/shm.py: never hand freed pages to a thread still
+            # inside a fold or a native flag wait.
+            self._mem = None
+            self._cbuf = None
+            self._lib.shmarena_close(self._ptr)
+            self._ptr = None
+        with _LIVE_LOCK:
+            ent = _LIVE.get(self.name)
+            if ent:
+                ent["refs"] -= 1
+                if ent["refs"] <= 0:
+                    _LIVE.pop(self.name, None)
+        if self._creator:
+            self._lib.shmarena_unlink(self.name.encode())
+            if self._flag_file is not None:
+                try:
+                    os.unlink(self._flag_file)
+                except OSError:
+                    pass
+
+
+def live_arenas() -> Dict[str, int]:
+    """name -> live handle count (test/tool introspection)."""
+    with _LIVE_LOCK:
+        return {k: v["refs"] for k, v in _LIVE.items()}
+
+
+# -- the collectives ---------------------------------------------------------
+#
+# Every entry point returns FALLBACK (after keeping the group's flag
+# sequence in lockstep) when the arena cannot serve the call, and the
+# result otherwise.  Copy accounting: each payload pass counts ONE
+# ``payload_copies`` tick per rank — the copy-in at write_meta time and
+# the copy-out/fold pass — so an arena collective is provably ≤2 copies.
+
+
+def _sm_coll(fn):
+    """Entry-point wrapper: resolve the arena (FALLBACK + pvar when this
+    communicator has none) and hold the active-use guard across every
+    arena touch, so a crash-path transport close never unmaps pages a
+    collective is still reading."""
+    @functools.wraps(fn)
+    def run(comm, *args):
+        arena = arena_for(comm)
+        if arena is None:
+            # Count a fallback only when the transport HAS an arena tier
+            # (nbc clone, kill-switch cvar): on socket/local worlds the
+            # pvar must stay 0 — it diagnoses real shm-arena declines,
+            # and the non-shm hot path skips the counter lock entirely.
+            if getattr(comm._t, "supports_coll_sm", False):
+                _mpit.count(coll_sm_fallbacks=1)
+            return FALLBACK
+        arena._begin()
+        try:
+            return fn(arena, comm, *args)
+        finally:
+            arena._end()
+    return run
+
+
+def _eligible(arena: Arena, payload: Any) -> Optional[np.ndarray]:
+    """The contiguous array to place in this rank's slot, or None — the
+    local half of the in-arena negotiation."""
+    arr = _codec.as_raw_array(payload)
+    if arr is None or arr.nbytes > arena.capacity:
+        return None
+    return arr
+
+
+def _enter(arena: Arena, comm, payload: Any) -> Optional[np.ndarray]:
+    """Write this rank's meta (+data when eligible) and cross the entry
+    barrier; returns the placed array or None."""
+    mine = _eligible(arena, payload)
+    kind = arena.write_meta(
+        _KIND_DATA if mine is not None else _KIND_NONE, mine)
+    if kind != _KIND_DATA:
+        mine = None
+    if mine is not None:
+        _mpit.count(copies=1, coll_sm_bytes=int(mine.nbytes))
+    arena.barrier(comm)
+    return mine
+
+
+def _metas(arena: Arena) -> List[Tuple[int, Any]]:
+    return [arena.read_meta(q) for q in range(arena._p)]
+
+
+def _decline(arena: Arena, comm) -> Any:
+    """Uniform fallback exit: one more barrier keeps every rank's flag
+    sequence in lockstep, then the caller runs the wire algorithm."""
+    arena.barrier(comm)
+    _mpit.count(coll_sm_fallbacks=1)
+    return FALLBACK
+
+
+def _congruent(metas: List[Tuple[int, Any]]) -> bool:
+    """True iff every rank placed data of identical (dtype, shape) — the
+    precondition of an in-place reduction fold."""
+    kind0, desc0 = metas[0]
+    return kind0 == _KIND_DATA and all(
+        kind == _KIND_DATA and desc == desc0 for kind, desc in metas)
+
+
+@_sm_coll
+def barrier(arena: Arena, comm) -> Any:
+    arena.barrier(comm)
+    _mpit.count(coll_sm_hits=1)
+    return None
+
+
+@_sm_coll
+def bcast(arena: Arena, comm, obj: Any, root: int) -> Any:
+    me = comm.rank == root
+    _enter(arena, comm, obj if me else None)
+    kind, desc = arena.read_meta(root)
+    if kind != _KIND_DATA:
+        return _decline(arena, comm)
+    if me:
+        arena.barrier(comm)
+        _mpit.count(coll_sm_hits=1)
+        return obj
+    dtype_str, shape = desc
+    out = _codec.RECV_POOL.empty(shape, np.dtype(dtype_str))
+    if out.size:
+        out.reshape(-1)[...] = arena.data(root, out.dtype, out.size)
+    arena.barrier(comm)  # root's slot free for the next collective
+    _mpit.count(copies=1, coll_sm_hits=1, coll_sm_bytes=int(out.nbytes))
+    return out
+
+
+@_sm_coll
+def allreduce(arena: Arena, comm, arr: np.ndarray, op) -> Any:
+    mine = _enter(arena, comm, arr)
+    if not _congruent(_metas(arena)):
+        return _decline(arena, comm)
+    p, r = arena._p, comm.rank
+    out = np.empty(mine.shape, mine.dtype)
+    flat = out.reshape(-1)
+    n = flat.size
+    if mine.nbytes <= _EAGER_BYTES:
+        # flat: every rank folds every slot, in rank order — the result
+        # is deterministic and bit-identical on every rank
+        if n:
+            flat[...] = arena.data(0, mine.dtype, n)
+            for q in range(1, p):
+                op.combine_into(flat, arena.data(q, mine.dtype, n))
+        arena.barrier(comm)
+        _mpit.count(copies=1, coll_sm_hits=1)
+        return out
+    # block in-place: fold my chunk reading peers' blocks straight from
+    # the arena, publish the reduced chunk in my own slot, then gather
+    # every reduced chunk — one copy in, one copy out per rank
+    offs = schedules.chunk_offsets(n, p)
+    lo, hi = offs[r], offs[r + 1]
+    if hi > lo:
+        flat[lo:hi] = arena.data(0, mine.dtype, n)[lo:hi]
+        for q in range(1, p):
+            op.combine_into(flat[lo:hi], arena.data(q, mine.dtype, n)[lo:hi])
+        arena.data(r, mine.dtype, n)[lo:hi] = flat[lo:hi]
+    arena.barrier(comm)  # every reduced chunk published
+    for q in range(p):
+        if q != r and offs[q + 1] > offs[q]:
+            flat[offs[q]:offs[q + 1]] = \
+                arena.data(q, mine.dtype, n)[offs[q]:offs[q + 1]]
+    arena.barrier(comm)  # slots free for the next collective
+    _mpit.count(copies=1, coll_sm_hits=1)
+    return out
+
+
+@_sm_coll
+def reduce(arena: Arena, comm, arr: np.ndarray, op, root: int) -> Any:
+    # Above eager the binomial tree's distributed folds beat a flat P·N
+    # fold at the root; reduction payloads are congruent, so every rank
+    # gates identically without consulting the metas.
+    if arr.nbytes > _EAGER_BYTES:
+        arena.write_meta(_KIND_NONE, None)
+        arena.barrier(comm)
+        mine = None
+    else:
+        mine = _enter(arena, comm, arr)
+    if not _congruent(_metas(arena)):
+        return _decline(arena, comm)
+    out = None
+    if comm.rank == root:
+        out = np.empty(mine.shape, mine.dtype)
+        flat = out.reshape(-1)
+        if flat.size:
+            flat[...] = arena.data(0, mine.dtype, flat.size)
+            for q in range(1, arena._p):
+                op.combine_into(flat, arena.data(q, mine.dtype, flat.size))
+        _mpit.count(copies=1)
+    arena.barrier(comm)
+    _mpit.count(coll_sm_hits=1)
+    return (out,)
+
+
+@_sm_coll
+def allgather(arena: Arena, comm, obj: Any) -> Any:
+    _enter(arena, comm, obj)
+    metas = _metas(arena)
+    if any(kind != _KIND_DATA for kind, _ in metas):
+        return _decline(arena, comm)
+    items: List[Any] = [None] * arena._p
+    for q, (_, (dtype_str, shape)) in enumerate(metas):
+        if q == comm.rank:
+            items[q] = obj
+            continue
+        dst = _codec.RECV_POOL.empty(shape, np.dtype(dtype_str))
+        if dst.size:
+            dst.reshape(-1)[...] = arena.data(q, dst.dtype, dst.size)
+        items[q] = dst
+    arena.barrier(comm)
+    _mpit.count(copies=1, coll_sm_hits=1)
+    return (items,)
+
+
+@_sm_coll
+def reduce_scatter(arena: Arena, comm, arr: np.ndarray, op) -> Any:
+    """``arr`` is the stacked [P, ...] block array (the communicator's
+    ``_blocks_as_array`` eligibility view): write the whole input, one
+    barrier, fold only block ``rank`` reading peers' blocks in place —
+    no writeback or gather phase, the result is private."""
+    mine = _enter(arena, comm, arr)
+    if not _congruent(_metas(arena)):
+        return _decline(arena, comm)
+    p, r = arena._p, comm.rank
+    n = mine.size
+    bn = n // p
+    out = np.empty(mine.shape[1:], mine.dtype)
+    flat = out.reshape(-1)
+    if bn:
+        lo = r * bn
+        flat[...] = arena.data(0, mine.dtype, n)[lo:lo + bn]
+        for q in range(1, p):
+            op.combine_into(flat, arena.data(q, mine.dtype, n)[lo:lo + bn])
+    arena.barrier(comm)
+    _mpit.count(copies=1, coll_sm_hits=1)
+    return (out,)

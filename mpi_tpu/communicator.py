@@ -31,6 +31,7 @@ from typing import Any, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from . import coll_sm as _coll_sm
 from . import mpit as _mpit
 from . import ops as _ops
 from . import schedules
@@ -1276,20 +1277,31 @@ class P2PCommunicator(Communicator):
 
     def bcast(self, obj: Any, root: int = 0, algorithm: str = "auto") -> Any:
         """MPI_Bcast.  ``algorithm``: ``"tree"`` (binomial tree, log2(P)
-        rounds — BASELINE.json:8); ``"auto"`` and ``"fused"`` (the TPU
-        backend's XLA-collective tier, no socket analogue) are aliases
-        of it.  Large contiguous arrays take the SEGMENTED pipelined
+        rounds — BASELINE.json:8); ``"sm"`` (shm transports only: the
+        shared-memory collective arena — every rank reads the root's
+        slot in place, mpi_tpu/coll_sm.py); ``"auto"`` tries the arena
+        when the transport has one, the tree otherwise; ``"fused"`` (the
+        TPU backend's XLA-collective tier, no socket analogue) aliases
+        the tree.  Large contiguous arrays take the SEGMENTED pipelined
         tree: the root announces the geometry with a _SegHeader, then
         every rank forwards each segment to its children the moment it
         lands — cut-through through tree levels instead of the seed's
         store-and-forward whole frames."""
         _mpit.count(collectives=1)
         self._coll_name = "bcast"
-        _resolve_algorithm("bcast", algorithm, ("tree",),
-                           {"auto": "tree", "fused": "tree"})
+        algorithm = _resolve_algorithm(
+            "bcast", algorithm, ("auto", "tree") + _coll_sm.gate(self),
+            {"fused": "tree"})
         self._world(root)  # validate
         if self.size == 1:
             return obj
+        if algorithm in ("auto", "sm"):
+            # the arena decides eligibility INTERNALLY (only the root
+            # knows the payload) and keeps the group in lockstep on
+            # fallback — safe for auto even with rank-local knowledge
+            got = _coll_sm.bcast(self, obj, root)
+            if got is not _coll_sm.FALLBACK:
+                return got
         parent, children = schedules.binomial_tree_links(
             self.size, self._rank, root)
         if self._rank == root:
@@ -1343,14 +1355,23 @@ class P2PCommunicator(Communicator):
     def reduce(self, obj: Any, op: _ops.ReduceOp = _ops.SUM, root: int = 0,
                algorithm: str = "auto") -> Any:
         """MPI_Reduce.  ``algorithm``: ``"tree"`` (binomial tree with
-        in-place folds); ``"auto"`` and ``"fused"`` are aliases of it on
-        process backends."""
+        in-place folds); ``"sm"`` (shm transports: the collective arena
+        — ranks publish their payloads, the root folds them in place);
+        ``"auto"`` tries the arena at eager sizes, the tree otherwise;
+        ``"fused"`` aliases the tree on process backends."""
         _mpit.count(collectives=1)
         self._coll_name = "reduce"
-        _resolve_algorithm("reduce", algorithm, ("tree",),
-                           {"auto": "tree", "fused": "tree"})
+        algorithm = _resolve_algorithm(
+            "reduce", algorithm, ("auto", "tree") + _coll_sm.gate(self),
+            {"fused": "tree"})
         self._world(root)  # validate
         arr, scalar = _as_array(obj)
+        if algorithm in ("auto", "sm") and self.size > 1:
+            got = _coll_sm.reduce(self, arr, op, root)
+            if got is not _coll_sm.FALLBACK:
+                (out,) = got
+                return (_unwrap(np.asarray(out), scalar)
+                        if self._rank == root else None)
         acc = arr.copy()
         for pairs in schedules.binomial_reduce_rounds(self.size, root):
             for s, d in pairs:
@@ -1369,7 +1390,9 @@ class P2PCommunicator(Communicator):
         (latency-optimal, power-of-two groups only), ``"rabenseifner"``
         (block-ring reduce_scatter + ring allgather composition [S:
         Thakur et al.], any group size), ``"reduce_bcast"`` (naive
-        reference), or ``"auto"`` — halving below the measured
+        reference), ``"sm"`` (shm transports only: the shared-memory
+        collective arena, mpi_tpu/coll_sm.py), or ``"auto"`` — the
+        arena first on shm transports, else halving below the measured
         _RING_CROSSOVER_BYTES on pow2 groups, rabenseifner at or above
         _RABENSEIFNER_CROSSOVER_BYTES, ring in between.  ``"fused"``
         (the TPU tier) aliases to ``"auto"`` on process backends."""
@@ -1379,8 +1402,17 @@ class P2PCommunicator(Communicator):
         algorithm = _resolve_algorithm(
             "allreduce", algorithm,
             ("auto", "ring", "recursive_halving", "rabenseifner",
-             "reduce_bcast"),
+             "reduce_bcast") + _coll_sm.gate(self),
             {"fused": "auto"})  # no fused path on sockets; best schedule
+        if algorithm in ("auto", "sm") and self.size > 1:
+            # shm transports: the collective arena first — flat slot
+            # folds at eager sizes, in-place chunk folds above
+            # (mpi_tpu/coll_sm.py); on decline the wire auto policy
+            # below picks the best classic schedule
+            got = _coll_sm.allreduce(self, arr, op)
+            if got is not _coll_sm.FALLBACK:
+                return _unwrap(np.asarray(got), scalar)
+            algorithm = "auto"
         if algorithm == "auto":
             # The Rabenseifner composition once the measured sweep shows
             # it stably at-or-below ring (checked FIRST so lowering its
@@ -1579,15 +1611,29 @@ class P2PCommunicator(Communicator):
     def allgather(self, obj: Any, algorithm: str = "auto") -> List[Any]:
         """MPI_Allgather.  ``algorithm``: ``"ring"`` (rotating row views
         of one [P, ...] buffer, raw frames), ``"doubling"`` (recursive
-        doubling, log P rounds, pow2 groups only), or ``"auto"`` —
-        doubling on pow2 groups, ring otherwise.  ``"fused"`` (the TPU
-        tier) aliases to ``"auto"`` on process backends."""
+        doubling, log P rounds, pow2 groups only), ``"sm"`` (shm
+        transports: the collective arena — every rank reads every slot
+        in place), or ``"auto"`` — the arena first on shm transports,
+        else doubling on pow2 groups, ring otherwise.  ``"fused"`` (the
+        TPU tier) aliases to ``"auto"`` on process backends."""
         _mpit.count(collectives=1)
         self._coll_name = "allgather"
         p, r = self.size, self._rank
         algorithm = _resolve_algorithm(
-            "allgather", algorithm, ("auto", "ring", "doubling"),
+            "allgather", algorithm,
+            ("auto", "ring", "doubling") + _coll_sm.gate(self),
             {"fused": "auto"})  # no fused path on sockets
+        if algorithm in ("auto", "sm") and p > 1:
+            # Transport capability is group-uniform, so this keeps the
+            # "pick may depend only on the group shape" rule: payload
+            # raggedness (or non-array payloads) is resolved INSIDE the
+            # arena, where every rank sees the same metas and falls
+            # back together.
+            got = _coll_sm.allgather(self, obj)
+            if got is not _coll_sm.FALLBACK:
+                (got_items,) = got
+                return _maybe_stack(obj, got_items)
+            algorithm = "auto"
         if algorithm == "auto":
             # The pick may depend ONLY on the group shape, never on the
             # rank-local payload: ragged allgather is supported, so a
@@ -1724,11 +1770,21 @@ class P2PCommunicator(Communicator):
             raise
         return _maybe_stack(objs, result)
 
-    def barrier(self) -> None:
+    def barrier(self, algorithm: str = "auto") -> None:
+        """MPI_Barrier.  ``algorithm``: ``"dissemination"`` (ceil(log2 P)
+        message rounds [S]), ``"sm"`` (shm transports: one flag round in
+        the collective arena — no messages at all), or ``"auto"`` — the
+        arena on shm transports, dissemination otherwise."""
         _mpit.count(collectives=1)
         self._coll_name = "barrier"
-        # Dissemination barrier, ceil(log2 P) rounds [S].
+        algorithm = _resolve_algorithm(
+            "barrier", algorithm,
+            ("auto", "dissemination") + _coll_sm.gate(self),
+            {"fused": "dissemination"})
         p, r = self.size, self._rank
+        if algorithm in ("auto", "sm") and p > 1:
+            if _coll_sm.barrier(self) is not _coll_sm.FALLBACK:
+                return
         for off in schedules.dissemination_offsets(p):
             self._send_internal(None, (r + off) % p, _TAG_BARRIER)
             self._recv_internal((r - off) % p, _TAG_BARRIER)
@@ -1799,8 +1855,10 @@ class P2PCommunicator(Communicator):
         everyone's block r.  ``algorithm``: ``"ring"`` (P-1 steps —
         segmented on one contiguous working buffer when the blocks are
         homogeneous arrays, generic per-chunk exchange otherwise);
-        ``"auto"`` and ``"fused"`` (the TPU tier) are aliases of it on
-        process backends.
+        ``"sm"`` (shm transports: write-own-input → barrier → fold block
+        ``rank`` reading peers in place from the collective arena);
+        ``"auto"`` — the arena first on shm transports, the ring
+        otherwise; ``"fused"`` (the TPU tier) aliases the ring.
 
         The segmented path is the same engine as the ring allreduce:
         every wire payload is a contiguous view of one flat [P·n]
@@ -1811,11 +1869,29 @@ class P2PCommunicator(Communicator):
         _mpit.count(collectives=1)
         self._coll_name = "reduce_scatter"
         p, r = self.size, self._rank
-        _resolve_algorithm("reduce_scatter", algorithm, ("ring",),
-                           {"auto": "ring", "fused": "ring"})
+        algorithm = _resolve_algorithm(
+            "reduce_scatter", algorithm,
+            ("auto", "ring") + _coll_sm.gate(self),
+            {"fused": "ring"})
         if len(blocks) != p:
             raise ValueError(
                 f"reduce_scatter needs one block per rank ({p}), got {len(blocks)}")
+        if algorithm in ("auto", "sm") and p > 1:
+            # Arena path: write the whole [P·n] input once, fold only
+            # block ``rank`` reading peers in place.  The stacked-array
+            # eligibility view is built only when the payload fits a
+            # slot (the stacking copy must not be paid on the decline
+            # path); an ineligible rank enters with no payload and the
+            # in-arena negotiation lands everyone on the ring together.
+            arena = _coll_sm.arena_for(self)
+            arr_sm = (self._blocks_as_array(blocks)
+                      if arena is not None
+                      and self._blocks_nbytes(blocks) <= arena.capacity
+                      else None)
+            got = _coll_sm.reduce_scatter(self, arr_sm, op)
+            if got is not _coll_sm.FALLBACK:
+                (out,) = got
+                return _unwrap(out, out.ndim == 0)
         # Size-gate BEFORE _blocks_as_array: for list payloads eligibility
         # stacks the blocks into the working buffer, a copy the per-chunk
         # path below would throw away (same discipline as the segmented
@@ -2044,7 +2120,11 @@ class P2PCommunicator(Communicator):
             return (self._ctx, self._nchildren)
 
     def split(self, color: Optional[int], key: int = 0) -> Optional["P2PCommunicator"]:
-        infos = self.allgather((color, key))
+        # control-plane exchange pinned to the wire ring: the (color,
+        # key) tuple can never ride the coll/sm arena, and letting it
+        # try would lazily map the PARENT's multi-MB arena segment as a
+        # side effect of every split on an shm world
+        infos = self.allgather((color, key), algorithm="ring")
         ctx = self._alloc_context()
         if color is None:
             return None
@@ -2090,6 +2170,10 @@ class P2PCommunicator(Communicator):
         # parent must unblock its nonblocking collectives in flight, and
         # the clone polls the parent's home_ctx for remote revocations.
         c._ft = self._ft
+        # No collective arena on nbc clones: each clone is single-use,
+        # so routing it to coll_sm would map a fresh multi-MB segment
+        # PER CALL; the wire algorithms serve the threaded collective.
+        c._no_coll_sm = True
         return c
 
     def ibcast(self, obj: Any, root: int = 0) -> Request:

@@ -39,7 +39,8 @@ class _Counters:
     __slots__ = ("sends", "send_bytes", "recvs", "collectives",
                  "pallas_fallbacks", "bytes_raw", "bytes_pickled", "copies",
                  "proc_failed", "revokes", "shrinks",
-                 "faulty_dropped", "faulty_duplicated", "attention_oob")
+                 "faulty_dropped", "faulty_duplicated", "attention_oob",
+                 "sm_hits", "sm_bytes", "sm_fallbacks")
 
     def __init__(self) -> None:
         self.sends = 0
@@ -56,6 +57,9 @@ class _Counters:
         self.faulty_dropped = 0
         self.faulty_duplicated = 0
         self.attention_oob = 0
+        self.sm_hits = 0
+        self.sm_bytes = 0
+        self.sm_fallbacks = 0
 
 
 counters = _Counters()  # incremented by communicator.py / codec.py (count())
@@ -66,7 +70,8 @@ def count(sends: int = 0, send_bytes: int = 0, recvs: int = 0,
           bytes_raw: int = 0, bytes_pickled: int = 0, copies: int = 0,
           proc_failed: int = 0, revokes: int = 0, shrinks: int = 0,
           faulty_dropped: int = 0, faulty_duplicated: int = 0,
-          attention_oob: int = 0) -> None:
+          attention_oob: int = 0, coll_sm_hits: int = 0,
+          coll_sm_bytes: int = 0, coll_sm_fallbacks: int = 0) -> None:
     """Thread-safe increment (rank-threads of the local backend share
     this process's counters; unsynchronized += would lose updates)."""
     with _lock:
@@ -84,6 +89,9 @@ def count(sends: int = 0, send_bytes: int = 0, recvs: int = 0,
         counters.faulty_dropped += faulty_dropped
         counters.faulty_duplicated += faulty_duplicated
         counters.attention_oob += attention_oob
+        counters.sm_hits += coll_sm_hits
+        counters.sm_bytes += coll_sm_bytes
+        counters.sm_fallbacks += coll_sm_fallbacks
 
 _PVARS: Dict[str, Callable[[], int]] = {
     "msgs_sent": lambda: counters.sends,
@@ -121,6 +129,14 @@ _PVARS: Dict[str, Callable[[], int]] = {
     # tile fit the VMEM budget (tpu/pallas_attention.py — graceful
     # degradation instead of NotImplementedError; ROADMAP r5 #4)
     "attention_fallbacks": lambda: counters.attention_oob,
+    # shared-memory collective arena (mpi_tpu/coll_sm.py): collectives
+    # served entirely by arena load/store (zero ring frames), per-rank
+    # payload bytes moved through it, and eligible requests that fell
+    # back to the wire algorithms (non-array payload, payload larger
+    # than a slot, nbc clone, mismatched reduction geometry)
+    "coll_sm_hits": lambda: counters.sm_hits,
+    "coll_sm_bytes": lambda: counters.sm_bytes,
+    "coll_sm_fallbacks": lambda: counters.sm_fallbacks,
 }
 
 
@@ -202,10 +218,21 @@ def _ensure_builtin_cvars() -> None:
     # imports OUTSIDE the lock (they can run user-level module code);
     # registration + flag UNDER it, flag LAST — a concurrent reader must
     # never observe done=True with the registry still empty
+    from . import coll_sm as _sm
     from . import communicator as _c
     from . import ft as _ft
     from . import io as _io
     from .transport import shm as _shm
+
+    def _set_sm_arena(v):
+        if int(v) < 0:
+            raise ValueError("coll_sm_arena_bytes must be >= 0 (0 = off)")
+        _sm._ARENA_BYTES = int(v)
+
+    def _set_sm_eager(v):
+        if int(v) < 0:
+            raise ValueError("coll_sm_eager_bytes must be >= 0")
+        _sm._EAGER_BYTES = int(v)
 
     def _get_limit():
         return _io._COLLECTIVE_BUFFER_LIMIT
@@ -317,6 +344,21 @@ def _ensure_builtin_cvars() -> None:
             "how often each fault-tolerant rank publishes its heartbeat "
             "and scans its peers' (mpi_tpu/ft.py); keep well below "
             "fault_detect_timeout_s.  Read at ft.enable() time")
+        _CVARS["coll_sm_arena_bytes"] = (
+            lambda: _sm._ARENA_BYTES, _set_sm_arena,
+            "size of the per-communicator shared-memory collective arena "
+            "(mpi_tpu/coll_sm.py): P flag lines + P data slots; a rank's "
+            "slot is the P-th share, the ceiling of the in-place block "
+            "paths.  0 disables the arena (every sm/auto request falls "
+            "back to the wire algorithms).  Read at arena creation — set "
+            "it before the communicator's first sm collective")
+        _CVARS["coll_sm_eager_bytes"] = (
+            lambda: _sm._EAGER_BYTES, _set_sm_eager,
+            "flat-path gate of the arena reductions: payloads at or "
+            "below this are folded whole from every peer's slot "
+            "(latency-optimal); above it allreduce switches to the "
+            "chunked in-place fold and reduce stays on the binomial "
+            "tree")
         _CVARS["gather_replicated_warn_bytes"] = (
             lambda: _GATHER_WARN_BYTES[0],
             lambda v: _GATHER_WARN_BYTES.__setitem__(0, int(v)),

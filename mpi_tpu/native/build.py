@@ -116,5 +116,26 @@ def load_shmring() -> ctypes.CDLL:
     lib.shmdb_close.argtypes = [ctypes.c_void_p]
     lib.shmdb_unlink.restype = ctypes.c_int
     lib.shmdb_unlink.argtypes = [ctypes.c_char_p]
+    # collective arena (coll/sm): one segment per shm communicator, with
+    # per-rank flag lines driven by the shmflag_* ops (mpi_tpu/coll_sm.py)
+    lib.shmarena_create.restype = ctypes.c_void_p
+    lib.shmarena_create.argtypes = [ctypes.c_char_p, ctypes.c_uint64]
+    lib.shmarena_open.restype = ctypes.c_void_p
+    lib.shmarena_open.argtypes = [ctypes.c_char_p, ctypes.c_double]
+    lib.shmarena_addr.restype = ctypes.c_uint64
+    lib.shmarena_addr.argtypes = [ctypes.c_void_p]
+    lib.shmarena_size.restype = ctypes.c_uint64
+    lib.shmarena_size.argtypes = [ctypes.c_void_p]
+    lib.shmarena_close.restype = None
+    lib.shmarena_close.argtypes = [ctypes.c_void_p]
+    lib.shmarena_unlink.restype = ctypes.c_int
+    lib.shmarena_unlink.argtypes = [ctypes.c_char_p]
+    lib.shmflag_read.restype = ctypes.c_uint32
+    lib.shmflag_read.argtypes = [ctypes.c_uint64]
+    lib.shmflag_post.restype = None
+    lib.shmflag_post.argtypes = [ctypes.c_uint64, ctypes.c_uint32]
+    lib.shmflag_wait_ge.restype = ctypes.c_uint32
+    lib.shmflag_wait_ge.argtypes = [ctypes.c_uint64, ctypes.c_uint32,
+                                    ctypes.c_double]
     _lib = lib
     return lib

@@ -429,4 +429,145 @@ void shmdb_close(void* db) { munmap(db, sizeof(Doorbell)); }
 
 int shmdb_unlink(const char* name) { return shm_unlink(name); }
 
+// ---- collective arena (coll/sm) -------------------------------------------
+// One POSIX segment per shm-backed communicator: a 64-byte native header
+// (magic handshake, like the ring), then the Python layer's layout — P
+// per-rank flag LINES (64 bytes each: [u32 seq][u32 waiters], cache-line
+// separated so two ranks' posts never share a line) followed by P data
+// slots ranks load/store directly.  The flag ops below are the whole
+// synchronization vocabulary: a monotone per-rank sequence counter is the
+// generalized sense-reversing barrier (sense = counter parity, and the
+// monotone spelling needs no reset phase), posted with release semantics
+// AFTER the data stores and awaited with acquire semantics BEFORE the
+// data loads.  Waits spin briefly (arena peers are co-located, so the
+// expected wait is sub-microsecond) and then sleep on a futex in the
+// flag line itself; Python loops the wait in short slices so the ULFM
+// detector can convert a dead peer into ProcFailedError.
+
+struct ArenaHeader {
+  std::atomic<uint32_t> magic;
+};
+
+struct ArenaMap {
+  void* mem;
+  size_t maplen;
+};
+
+void* shmarena_create(const char* name, uint64_t nbytes) {
+  shm_unlink(name);  // stale segment from a crashed run, if any
+  int fd = shm_open(name, O_CREAT | O_EXCL | O_RDWR, 0600);
+  if (fd < 0) return nullptr;
+  size_t maplen = kDataOffset + nbytes;
+  if (ftruncate(fd, (off_t)maplen) != 0) {
+    close(fd);
+    shm_unlink(name);
+    return nullptr;
+  }
+  void* mem = mmap(nullptr, maplen, PROT_READ | PROT_WRITE, MAP_SHARED, fd, 0);
+  close(fd);
+  if (mem == MAP_FAILED) {
+    shm_unlink(name);
+    return nullptr;
+  }
+  memset(mem, 0, kDataOffset);  // flags/slots start zeroed lazily (fresh file)
+  ArenaMap* a = new ArenaMap{mem, maplen};
+  ((ArenaHeader*)mem)->magic.store(kMagic, std::memory_order_release);
+  return a;
+}
+
+void* shmarena_open(const char* name, double timeout_s) {
+  double deadline = timeout_s < 0 ? -1.0 : now_s() + timeout_s;
+  int fd = -1;
+  int spins = 0;
+  for (;;) {
+    fd = shm_open(name, O_RDWR, 0600);
+    if (fd >= 0) break;
+    if (errno != ENOENT || !poll_step(spins, deadline)) return nullptr;
+  }
+  struct stat st;  // wait for the creator's ftruncate
+  spins = 0;
+  for (;;) {
+    if (fstat(fd, &st) != 0) {
+      close(fd);
+      return nullptr;
+    }
+    if ((size_t)st.st_size > kDataOffset) break;
+    if (!poll_step(spins, deadline)) {
+      close(fd);
+      return nullptr;
+    }
+  }
+  size_t maplen = (size_t)st.st_size;
+  void* mem = mmap(nullptr, maplen, PROT_READ | PROT_WRITE, MAP_SHARED, fd, 0);
+  close(fd);
+  if (mem == MAP_FAILED) return nullptr;
+  ArenaHeader* h = (ArenaHeader*)mem;
+  spins = 0;
+  while (h->magic.load(std::memory_order_acquire) != kMagic) {
+    if (!poll_step(spins, deadline)) {
+      munmap(mem, maplen);
+      return nullptr;
+    }
+  }
+  return new ArenaMap{mem, maplen};
+}
+
+// usable base address / byte count (past the native header)
+uint64_t shmarena_addr(void* a) {
+  return (uint64_t)((uint8_t*)((ArenaMap*)a)->mem + kDataOffset);
+}
+
+uint64_t shmarena_size(void* a) {
+  return (uint64_t)(((ArenaMap*)a)->maplen - kDataOffset);
+}
+
+void shmarena_close(void* a) {
+  ArenaMap* m = (ArenaMap*)a;
+  munmap(m->mem, m->maplen);
+  delete m;
+}
+
+int shmarena_unlink(const char* name) { return shm_unlink(name); }
+
+// flag line: [u32 seq][u32 waiters] at line_addr (64-byte separated by the
+// Python layout).  seq comparisons are wrap-safe (signed difference), so
+// 2^31 barriers fit between any two ranks' progress — unreachable skew.
+
+uint32_t shmflag_read(uint64_t line_addr) {
+  return ((std::atomic<uint32_t>*)line_addr)->load(std::memory_order_seq_cst);
+}
+
+void shmflag_post(uint64_t line_addr, uint32_t value) {
+  std::atomic<uint32_t>* seq = (std::atomic<uint32_t>*)line_addr;
+  std::atomic<uint32_t>* waiters = seq + 1;
+  seq->store(value, std::memory_order_seq_cst);
+  if (waiters->load(std::memory_order_seq_cst) != 0) {
+    sys_futex(seq, FUTEX_WAKE, INT32_MAX, nullptr);
+  }
+}
+
+// Wait until seq >= target (wrap-safe) or timeout; returns the current
+// value either way.  Short yield-spin first: the common case is a peer a
+// few instructions behind, and on an oversubscribed box the yield lets it
+// run; the futex nap handles the long tail without burning the core.
+uint32_t shmflag_wait_ge(uint64_t line_addr, uint32_t target,
+                         double timeout_s) {
+  std::atomic<uint32_t>* seq = (std::atomic<uint32_t>*)line_addr;
+  std::atomic<uint32_t>* waiters = seq + 1;
+  double deadline = timeout_s < 0 ? -1.0 : now_s() + timeout_s;
+  int spins = 0;
+  for (;;) {
+    uint32_t cur = seq->load(std::memory_order_seq_cst);
+    if ((int32_t)(cur - target) >= 0) return cur;
+    if (spins < 64) {
+      ++spins;
+      sched_yield();
+      continue;
+    }
+    if (!futex_wait_step(seq, cur, waiters, deadline)) {
+      return seq->load(std::memory_order_seq_cst);
+    }
+  }
+}
+
 }  // extern "C"

@@ -420,6 +420,140 @@ def graph_create(comm: Communicator, edges: Sequence[Pair]) -> GraphComm:
     return GraphComm(comm, edges)
 
 
+def split_hierarchical(comm: Communicator, node_key=None
+                       ) -> Tuple[Communicator, Optional[Communicator],
+                                  List[int]]:
+    """The two-level split behind hierarchical collectives (Open MPI
+    HAN's shape): ``(intra, leaders, node_of)`` where ``intra`` groups
+    the ranks sharing ``node_key(rank)`` (ordered by old rank, so the
+    node's lowest rank is intra rank 0 — the node leader), ``leaders``
+    contains exactly the leaders (None on non-leader ranks), and
+    ``node_of[r]`` is rank r's dense node id (nodes numbered in
+    first-appearance order, which makes node n's rank in ``leaders``
+    exactly n).
+
+    ``node_key`` must be a pure function of the comm rank, identical on
+    every rank (the split_by_rank contract).  Default: the shared-memory
+    domain — worlds this library's launcher starts are single-host, so
+    every rank shares node 0; mixed worlds pass their real host key, and
+    tests pass synthetic keys to exercise the composition on one box."""
+    if node_key is None:
+        node_key = lambda r: 0  # noqa: E731 - the single-host domain
+    keys = [node_key(r) for r in range(comm.size)]
+    order: dict = {}
+    for k in keys:
+        order.setdefault(k, len(order))
+    node_of = [order[k] for k in keys]
+    my_node = node_of[comm.rank]
+    intra = comm.split(my_node, key=comm.rank)
+    is_leader = intra.rank == 0
+    leaders = comm.split(0 if is_leader else None, key=comm.rank)
+    return intra, leaders, node_of
+
+
+class HierarchicalComm:
+    """Hierarchical collective dispatch over a two-level split: the
+    intra-node tier runs on each node's own communicator — where the shm
+    transport's collective arena (mpi_tpu/coll_sm.py) serves collectives
+    by load/store — and the inter-node tier runs the measured wire
+    algorithms (ring / Rabenseifner via ``inter_algorithm``) between the
+    node leaders only.  An allreduce therefore moves each payload once
+    per node over the wire instead of once per rank: intra reduce →
+    leaders allreduce → intra bcast.
+
+    Wraps (never mutates) an existing communicator, like CartComm."""
+
+    def __init__(self, comm: Communicator, node_key=None,
+                 inter_algorithm: str = "auto"):
+        self.comm = comm
+        self.intra, self.leaders, self._node_of = split_hierarchical(
+            comm, node_key)
+        self._members: List[List[int]] = [
+            [] for _ in range(max(self._node_of) + 1)]
+        for r, n in enumerate(self._node_of):
+            self._members[n].append(r)
+        self._leader_of = [m[0] for m in self._members]
+        self._inter = inter_algorithm
+
+    # -- identity ----------------------------------------------------------
+
+    @property
+    def rank(self):
+        return self.comm.rank
+
+    @property
+    def size(self) -> int:
+        return self.comm.size
+
+    @property
+    def n_nodes(self) -> int:
+        return len(self._members)
+
+    def _to_leader(self, obj: Any, root: int) -> Any:
+        """Hop a payload from ``root`` to its node leader (identity when
+        root IS the leader).  Rides ``comm.exchange`` — the static-pattern
+        p2p primitive every backend provides — so bystander ranks no-op."""
+        leader = self._leader_of[self._node_of[root]]
+        if leader == root:
+            return obj
+        got = self.comm.exchange(obj, [(root, leader)])
+        return got if self.comm.rank == leader else obj
+
+    # -- collectives -------------------------------------------------------
+
+    def barrier(self) -> None:
+        """Gather phase in every node, one inter-node round among the
+        leaders, release phase in every node."""
+        self.intra.barrier()
+        if self.leaders is not None:
+            self.leaders.barrier()
+        self.intra.barrier()
+
+    def allreduce(self, obj: Any, op: Any = None) -> Any:
+        from . import ops as _ops
+
+        op = op or _ops.SUM
+        part = self.intra.reduce(obj, op, root=0)
+        if self.leaders is not None:
+            part = self.leaders.allreduce(part, op,
+                                          algorithm=self._inter)
+        return self.intra.bcast(part, root=0)
+
+    def reduce(self, obj: Any, op: Any = None, root: int = 0) -> Any:
+        from . import ops as _ops
+
+        op = op or _ops.SUM
+        part = self.intra.reduce(obj, op, root=0)
+        rn = self._node_of[root]
+        val = (self.leaders.reduce(part, op, root=rn)
+               if self.leaders is not None else part)
+        if self._node_of[self.comm.rank] != rn:
+            return None
+        # root's node: ship the total from the node leader to root
+        # (intra bcast keeps it collective-only; non-roots drop it)
+        val = self.intra.bcast(val, root=0)
+        return val if self.comm.rank == root else None
+
+    def bcast(self, obj: Any, root: int = 0) -> Any:
+        obj = self._to_leader(obj, root)
+        if self.leaders is not None:
+            obj = self.leaders.bcast(obj, root=self._node_of[root])
+        return self.intra.bcast(obj, root=0)
+
+    def allgather(self, obj: Any) -> Any:
+        from .communicator import _maybe_stack
+
+        node_items = self.intra.gather(obj, root=0)
+        full: List[Any] = [None] * self.comm.size
+        if self.leaders is not None:  # exactly the leaders (intra rank 0)
+            per_node = self.leaders.allgather([list(node_items)])
+            for n, (items,) in enumerate(per_node):
+                for i, r in enumerate(self._members[n]):
+                    full[r] = items[i]
+        full = self.intra.bcast(full, root=0)
+        return _maybe_stack(obj, full)
+
+
 def dist_graph_create_adjacent(comm: Communicator,
                                sources: Sequence[int],
                                destinations: Sequence[int]) -> GraphComm:

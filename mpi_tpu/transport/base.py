@@ -192,6 +192,13 @@ class Transport(ABC):
     # per-frame host costs dominate it at bandwidth sizes).
     coll_segment_hint = 256 << 10
 
+    # True only for transports whose ranks share a POSIX shared-memory
+    # domain (the shm transport): unlocks the coll/sm collective arena
+    # (mpi_tpu/coll_sm.py — ``algorithm="sm"`` and the ``auto`` routing).
+    # Deliberately NOT inherited by wrappers like FaultyTransport, whose
+    # point is to exercise the wire paths.
+    supports_coll_sm = False
+
     def __init__(self, world_rank: int, world_size: int) -> None:
         self.world_rank = world_rank
         self.world_size = world_size

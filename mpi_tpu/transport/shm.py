@@ -105,6 +105,11 @@ class ShmTransport(Transport):
     # a quarter ring — keeps the futex fast path hot at every sweep size.
     coll_segment_hint = 256 << 10
 
+    # Ranks of one shm world share /dev/shm: communicators over this
+    # transport may map a coll/sm collective arena (mpi_tpu/coll_sm.py);
+    # the handles register in _coll_arenas and close() tears them down.
+    supports_coll_sm = True
+
     def __init__(self, rank: int, size: int, rdv_dir: str,
                  ring_bytes: int = _RING_BYTES,
                  connect_timeout: float = _OPEN_TIMEOUT) -> None:
@@ -553,6 +558,13 @@ class ShmTransport(Transport):
 
     def close(self) -> None:
         self._closing = True
+        # coll/sm arenas of every communicator over this transport: close
+        # the mapping (the owning rank also unlinks the name).  Arena
+        # waits re-check _closed each slice, so a straggler blocked in a
+        # flag wait surfaces a TransportError instead of touching a
+        # freed mapping.
+        for arena in list(getattr(self, "_coll_arenas", {}).values()):
+            arena.close()
         if self._db:
             self._lib.shmdb_ring(self._db)  # pop any thread out of its nap
         if self._helper.is_alive():

@@ -68,6 +68,18 @@ def test_host_sweep_quick_smoke():
     assert set(result["crossover"]) == {"socket", "shm"}
     assert set(result["rabenseifner_crossover"]) == {"socket", "shm",
                                                     "combined_bytes"}
+    # ISSUE 4 satellites: the small-message band (osu_latency /
+    # osu_barrier / small allreduce — the arena's artifact legs) rode
+    # along, and every result row is oversubscription-stamped
+    small = [r for r in result["small_message_rows"] if "p50_us" in r]
+    assert {r["leg"] for r in small} == {"osu_latency", "osu_barrier",
+                                         "osu_allreduce"}
+    assert {r["backend"] for r in small} == {"socket", "shm"}
+    assert "oversubscribed" in result
+    for key in ("allreduce_rows", "small_message_rows"):
+        for r in result[key]:
+            if "p50_us" in r:
+                assert isinstance(r["oversubscribed"], bool), r
 
 
 def test_chaos_quick_smoke():

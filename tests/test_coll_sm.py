@@ -1,0 +1,443 @@
+"""Shared-memory collective arena (ISSUE 4 tentpole — mpi_tpu/coll_sm.py).
+
+Four contracts:
+
+* parity — ``algorithm="sm"`` (and ``auto``, which routes to the arena on
+  shm transports) matches the wire algorithms and the numpy oracle for
+  bcast/reduce/allreduce/allgather/barrier/reduce_scatter, across group
+  sizes, ops, the flat↔block boundary, and ragged/object payloads (which
+  must FALL BACK through the in-arena negotiation, not deadlock);
+* the copy contract — pvars prove an arena collective moves ZERO ring
+  frames (``msgs_sent``), ZERO pickled payload bytes
+  (``bytes_pickled_sent``), and ≤2 payload copies per rank
+  (``payload_copies``), with ``coll_sm_hits``/``coll_sm_bytes`` counting;
+* lifecycle — the ``algorithm="sm"`` gate error on non-shm transports,
+  per-communicator arenas for disjoint split children (the ctx-sharing
+  regression), refcount/unlink at world finalize, the cvar kill switch;
+* fault tolerance — a rank dying mid-barrier surfaces ProcFailedError on
+  the survivors within the detection bound (the FaultyTransport-style
+  ``killed`` injection), never a deadlock.
+"""
+
+import glob
+import time
+
+import numpy as np
+import pytest
+
+from mpi_tpu import coll_sm, ft, mpit, ops, topology
+from mpi_tpu.errors import ProcFailedError
+from mpi_tpu.transport.local import run_local
+from tests.test_shm_backend import run_shm_world
+from tests.test_socket_backend import run_socket_world
+
+NRANKS = [2, 3, 4, 5]
+
+
+def _deltas(world, prog, nranks, names):
+    base = {n: mpit.pvar_read(n) for n in names}
+    res = world(prog, nranks)
+    return res, {n: mpit.pvar_read(n) - base[n] for n in names}
+
+
+# -- parity ------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("algo", ["sm", "auto"])
+def test_allreduce_parity_flat_and_block(algo):
+    """Both arena paths (flat at <=eager, chunked in-place above) match
+    the oracle for every group size, op, and scalar payloads."""
+    for n in NRANKS:
+        for nelem in (1, 7, 1 << 10, (coll_sm._EAGER_BYTES // 8) + 13):
+            data = [np.random.RandomState(100 * n + i).randn(nelem)
+                    for i in range(n)]
+
+            def prog(comm):
+                return comm.allreduce(data[comm.rank], ops.SUM,
+                                      algorithm=algo)
+
+            for res in run_shm_world(prog, n):
+                np.testing.assert_allclose(res, sum(data),
+                                           err_msg=f"n={n} nelem={nelem}")
+
+
+def test_allreduce_ops_and_scalars():
+    def prog(comm):
+        mx = comm.allreduce(np.float64(comm.rank), ops.MAX, algorithm="sm")
+        s = comm.allreduce(float(comm.rank + 1), algorithm="sm")
+        return mx, s
+
+    for mx, s in run_shm_world(prog, 4):
+        assert float(mx) == 3.0
+        assert float(s) == 10.0
+        assert np.asarray(mx).ndim == 0
+
+
+def test_bcast_reduce_allgather_barrier_parity():
+    n = 4
+    data = np.random.RandomState(5).randn(n, 9)
+
+    def prog(comm):
+        out = {}
+        out["bcast"] = comm.bcast(
+            data[0] if comm.rank == 0 else None, root=0, algorithm="sm")
+        out["reduce"] = comm.reduce(data[comm.rank], ops.SUM, root=2,
+                                    algorithm="sm")
+        out["ag"] = comm.allgather(data[comm.rank], algorithm="sm")
+        comm.barrier(algorithm="sm")
+        out["rs"] = comm.reduce_scatter(
+            np.tile(data[comm.rank], (comm.size, 1)), ops.SUM,
+            algorithm="sm")
+        return out
+
+    for r, out in enumerate(run_shm_world(prog, n)):
+        np.testing.assert_array_equal(out["bcast"], data[0])
+        if r == 2:
+            np.testing.assert_allclose(out["reduce"], data.sum(0))
+        else:
+            assert out["reduce"] is None
+        np.testing.assert_array_equal(np.asarray(out["ag"]), data)
+        np.testing.assert_allclose(out["rs"], data.sum(0))
+
+
+def test_allgather_ragged_and_object_payloads_fall_back():
+    """Ragged arrays ride the arena (per-slot geometry); object payloads
+    make the WHOLE group fall back to the wire path via the in-arena
+    negotiation — same results, no deadlock, fallbacks counted."""
+    def prog(comm):
+        ragged = comm.allgather(np.arange(comm.rank + 1.0), algorithm="sm")
+        objs = comm.allgather({"r": comm.rank}, algorithm="sm")
+        return ragged, objs
+
+    f0 = mpit.pvar_read("coll_sm_fallbacks")
+    for r, (ragged, objs) in enumerate(run_shm_world(prog, 3)):
+        for q in range(3):
+            np.testing.assert_array_equal(ragged[q], np.arange(q + 1.0))
+        assert objs == [{"r": q} for q in range(3)]
+    assert mpit.pvar_read("coll_sm_fallbacks") - f0 >= 3  # object leg
+
+
+def test_mismatched_reduction_geometry_falls_back():
+    """Cross-rank dtype drift must not misfold in place: the metas
+    disagree, every rank declines together, and the generic wire path's
+    numpy-promotion semantics are preserved (reduce_scatter is the one
+    collective whose seed path tolerated drift — same contract as
+    test_reduce_scatter_mixed_dtypes_promote_like_seed, now via the
+    arena negotiation on shm)."""
+    def prog(comm):
+        dtype = np.float64 if comm.rank == 0 else np.int64
+        blocks = [np.arange(1, 5, dtype=dtype) * (comm.rank + 1)
+                  for _ in range(comm.size)]
+        return comm.reduce_scatter(blocks, op=ops.SUM, algorithm="sm")
+
+    f0 = mpit.pvar_read("coll_sm_fallbacks")
+    for res in run_shm_world(prog, 2):
+        np.testing.assert_allclose(np.asarray(res, dtype=np.float64),
+                                   np.arange(1, 5) * 3.0)
+    assert mpit.pvar_read("coll_sm_fallbacks") - f0 >= 2
+
+
+def test_oversized_payload_falls_back():
+    """A payload larger than a slot declines into the segmented wire
+    engine — still correct, counted as a fallback."""
+    def prog(comm):
+        arena = coll_sm.arena_for(comm)
+        big = np.ones(arena.capacity // 8 + 64)
+        return comm.allreduce(big, algorithm="sm")
+
+    f0 = mpit.pvar_read("coll_sm_fallbacks")
+    for res in run_shm_world(prog, 2):
+        assert float(np.asarray(res)[0]) == 2.0
+    assert mpit.pvar_read("coll_sm_fallbacks") - f0 >= 2
+
+
+# -- the copy contract (zero frames, zero pickle, <=2 copies) ----------------
+
+
+def test_arena_zero_frames_zero_pickle_two_copies():
+    n, nelem = 4, 1 << 9  # 4KB: flat path
+    data = [np.random.RandomState(i).randn(nelem) for i in range(n)]
+
+    def prog(comm):
+        out = comm.allreduce(data[comm.rank], ops.SUM, algorithm="sm")
+        np.testing.assert_allclose(out, sum(data))
+        return True
+
+    names = ("msgs_sent", "bytes_pickled_sent", "payload_copies",
+             "coll_sm_hits", "coll_sm_bytes", "bytes_raw_sent")
+    res, d = _deltas(run_shm_world, prog, n, names)
+    assert all(res)
+    assert d["msgs_sent"] == 0, f"arena allreduce sent {d['msgs_sent']} frames"
+    assert d["bytes_pickled_sent"] == 0
+    assert d["bytes_raw_sent"] == 0  # no wire traffic at all
+    assert d["coll_sm_hits"] == n
+    assert d["coll_sm_bytes"] >= n * nelem * 8
+    assert d["payload_copies"] <= 2 * n, \
+        f"more than 2 copies per rank: {d['payload_copies']}"
+
+
+def test_arena_block_path_copy_contract():
+    """The >eager in-place chunk fold keeps the same contract: zero
+    frames, zero pickled bytes, one copy in + one copy out per rank."""
+    n = 2
+    nelem = coll_sm._EAGER_BYTES // 8 * 4  # 4x eager: block path
+    data = [np.random.RandomState(i).randn(nelem) for i in range(n)]
+
+    def prog(comm):
+        out = comm.allreduce(data[comm.rank], ops.SUM, algorithm="sm")
+        np.testing.assert_allclose(out, sum(data))
+        return True
+
+    names = ("msgs_sent", "bytes_pickled_sent", "payload_copies",
+             "coll_sm_hits")
+    res, d = _deltas(run_shm_world, prog, n, names)
+    assert all(res)
+    assert d["msgs_sent"] == 0 and d["bytes_pickled_sent"] == 0
+    assert d["coll_sm_hits"] == n
+    assert d["payload_copies"] <= 2 * n
+
+
+def test_barrier_is_message_free():
+    def prog(comm):
+        for _ in range(10):
+            comm.barrier()
+        return True
+
+    res, d = _deltas(run_shm_world, lambda c: prog(c), 3, ("msgs_sent",))
+    assert all(res)
+    assert d["msgs_sent"] == 0, "shm auto barrier still sends messages"
+
+
+# -- dispatch gate and lifecycle --------------------------------------------
+
+
+def test_socket_and_local_reject_sm_with_gate_error():
+    def prog(comm):
+        msgs = {}
+        for coll, call in {
+            "allreduce": lambda: comm.allreduce(np.ones(4), algorithm="sm"),
+            "bcast": lambda: comm.bcast(np.ones(4), algorithm="sm"),
+            "reduce": lambda: comm.reduce(np.ones(4), algorithm="sm"),
+            "allgather": lambda: comm.allgather(np.ones(4), algorithm="sm"),
+            "barrier": lambda: comm.barrier(algorithm="sm"),
+            "reduce_scatter": lambda: comm.reduce_scatter(
+                np.ones((comm.size, 2)), algorithm="sm"),
+        }.items():
+            try:
+                call()
+            except ValueError as e:
+                msgs[coll] = str(e)
+        return msgs
+
+    for world in (run_socket_world, run_local):
+        for msgs in world(prog, 2):
+            assert len(msgs) == 6, f"some gates accepted 'sm': {msgs}"
+            for coll, m in msgs.items():
+                assert m.startswith(f"unknown {coll} algorithm 'sm'"), m
+                assert "accepted: [" in m and "'sm'" not in m.split(
+                    "accepted: [")[1], m
+
+
+def test_disjoint_split_children_get_distinct_arenas():
+    """split() children deliberately share a context (the mailbox keys
+    on source); their ARENAS must not — regression for the name
+    collision that deadlocked hierarchical intra-node groups."""
+    def prog(comm):
+        half = comm.split(comm.rank // 2, key=comm.rank)
+        out = half.allreduce(np.full(4, float(comm.rank)), algorithm="sm")
+        names = {half._coll_sm_arena.name, comm._coll_sm_arena.name
+                 if comm.__dict__.get("_coll_sm_arena") else None}
+        return np.asarray(out)[0], half._coll_sm_arena.name
+
+    res = run_shm_world(prog, 4)
+    sums = [r[0] for r in res]
+    assert sums == [1.0, 1.0, 5.0, 5.0]
+    assert res[0][1] == res[1][1]
+    assert res[2][1] == res[3][1]
+    assert res[0][1] != res[2][1], "disjoint children shared one arena"
+
+
+def test_arena_refcount_and_unlink_at_finalize():
+    seen = {}
+
+    def prog(comm):
+        comm.allreduce(np.ones(8), algorithm="sm")
+        if comm.rank == 0:
+            name = comm._coll_sm_arena.name
+            seen["live"] = dict(coll_sm.live_arenas())
+            seen["file"] = glob.glob("/dev/shm" + name)
+        comm.barrier()
+        return True
+
+    assert all(run_shm_world(prog, 3))
+    # mid-world: 3 handles on one segment, the name present in /dev/shm
+    assert list(seen["live"].values()) == [3]
+    assert len(seen["file"]) == 1
+    # world closed (run_shm_world closes every transport): registry
+    # pruned, name unlinked
+    assert coll_sm.live_arenas() == {}
+    assert glob.glob(seen["file"][0]) == []
+
+
+def test_cvar_kill_switch_and_eager_gate():
+    old = mpit.cvar_read("coll_sm_arena_bytes")
+    try:
+        mpit.cvar_write("coll_sm_arena_bytes", 0)
+
+        def prog(comm):
+            # auto must fall back to the wire engine; explicit "sm" is
+            # still an accepted NAME on shm (capability is per
+            # transport), it just cannot be served
+            a = comm.allreduce(np.ones(4))
+            b = comm.allreduce(np.ones(4), algorithm="sm")
+            return float(np.asarray(a)[0]), float(np.asarray(b)[0])
+
+        h0 = mpit.pvar_read("coll_sm_hits")
+        for a, b in run_shm_world(prog, 2):
+            assert a == b == 2.0
+        assert mpit.pvar_read("coll_sm_hits") == h0, \
+            "kill switch did not disable the arena"
+    finally:
+        mpit.cvar_write("coll_sm_arena_bytes", old)
+    assert mpit.cvar_read("coll_sm_eager_bytes") > 0  # registered
+
+
+def test_nonblocking_collectives_skip_the_arena():
+    """nbc clones are single-use: they must not map an arena per call
+    (and must still complete on the wire path)."""
+    def prog(comm):
+        req = comm.iallreduce(np.full(4, float(comm.rank + 1)))
+        comm.allreduce(np.ones(2), algorithm="sm")  # parent arena is fine
+        return float(np.asarray(req.wait())[0])
+
+    before = len(coll_sm.live_arenas())
+    for got in run_shm_world(prog, 2):
+        assert got == 3.0
+    assert len(coll_sm.live_arenas()) == before  # no leaked nbc arenas
+
+
+def test_stale_arena_from_crashed_run_is_not_opened():
+    """A crashed earlier run with the same session basename leaves its
+    arena segment behind (ranks that die never close); the NEXT run's
+    openers must not map it — the rendezvous readiness file (written by
+    the creator AFTER unlink+create, like the ring handshake) closes the
+    window that silently split the group across two same-named segments
+    (regression: the FT kill e2e deadlock)."""
+    import os
+    import tempfile
+    import threading
+
+    from mpi_tpu.communicator import P2PCommunicator
+    from mpi_tpu.native import load_shmring
+    from mpi_tpu.transport.shm import ShmTransport
+
+    rdv = tempfile.mkdtemp(prefix="mpi_tpu_stale_arena_")
+    session = os.path.basename(rdv)
+    # forge the stale segment a crashed run would leave: same name the
+    # world communicator (ctx=0, group=(0,1)) will derive, magic set,
+    # flags pre-poisoned so accidentally joining it would misbehave
+    name = coll_sm._arena_name(session, 0, (0, 1))
+    lib = load_shmring()
+    stale = lib.shmarena_create(name.encode(), 1 << 16)
+    assert stale
+    lib.shmflag_post(int(lib.shmarena_addr(stale)) + 64, 999)
+    lib.shmarena_close(stale)
+
+    results, errors, transports = [None, None], [], [None, None]
+
+    def runner(r):
+        try:
+            t = ShmTransport(r, 2, rdv, ring_bytes=256 * 1024)
+            transports[r] = t
+            comm = P2PCommunicator(t, range(2))
+            results[r] = comm.allreduce(np.full(4, float(r + 1)),
+                                        algorithm="sm")
+        except BaseException as e:  # noqa: BLE001
+            errors.append((r, e))
+
+    threads = [threading.Thread(target=runner, args=(r,), daemon=True)
+               for r in range(2)]
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join(30.0)
+    alive = any(th.is_alive() for th in threads)
+    for t in transports:
+        if t is not None:
+            t.close()
+    assert not errors, errors
+    assert not alive, "ranks deadlocked across a stale arena segment"
+    for res in results:
+        np.testing.assert_allclose(res, np.full(4, 3.0))
+
+
+# -- hierarchical composition (topology.split_hierarchical) ------------------
+
+
+def test_hierarchical_dispatch_arena_intra_wire_inter():
+    """Synthetic 2-nodes-of-2 on one box: each node's intra communicator
+    serves collectives from its own arena while the leaders run the wire
+    algorithms — allreduce/bcast/reduce/allgather/barrier parity."""
+    def prog(comm):
+        h = topology.HierarchicalComm(comm, node_key=lambda r: r // 2,
+                                      inter_algorithm="rabenseifner")
+        x = np.arange(6.0) + comm.rank
+        out = {"ar": h.allreduce(x),
+               "bc": h.bcast(np.full(3, 9.0) if comm.rank == 3 else None,
+                             root=3),
+               "rd": h.reduce(x, root=2),
+               "ag": h.allgather(np.full(2, float(comm.rank)))}
+        h.barrier()
+        assert h.n_nodes == 2
+        return out
+
+    want = np.arange(6.0) * 4 + 6
+    h0 = mpit.pvar_read("coll_sm_hits")
+    for r, o in enumerate(run_shm_world(prog, 4)):
+        np.testing.assert_allclose(o["ar"], want)
+        np.testing.assert_array_equal(o["bc"], np.full(3, 9.0))
+        if r == 2:
+            np.testing.assert_allclose(o["rd"], want)
+        else:
+            assert o["rd"] is None
+        np.testing.assert_array_equal(
+            np.asarray(o["ag"]),
+            np.stack([np.full(2, float(q)) for q in range(4)]))
+    assert mpit.pvar_read("coll_sm_hits") > h0, \
+        "hierarchical intra tier never hit the arena"
+
+
+# -- fault tolerance: death mid-barrier is bounded ---------------------------
+
+
+def test_kill_mid_barrier_raises_proc_failed_within_bound():
+    """The FaultyTransport-style injection: the victim flips its
+    transport's ``killed`` flag (detector stops beating) and never
+    enters the barrier; survivors blocked in the arena flag wait get
+    ProcFailedError naming the collective within the detection bound —
+    never the shm stall constant, never a deadlock."""
+    liveness = ft.MemoryLiveness(3)
+    outcomes = {}
+
+    def prog(comm):
+        ft.enable(comm, liveness=liveness, detect_timeout_s=1.0,
+                  heartbeat_s=0.1)
+        comm.allreduce(np.ones(4), algorithm="sm")  # arena up, all alive
+        if comm.rank == 2:
+            comm._t.killed = True  # crash-stop: stops heartbeating
+            return "died"
+        t0 = time.monotonic()
+        try:
+            comm.barrier(algorithm="sm")
+        except ProcFailedError as e:
+            took = time.monotonic() - t0
+            outcomes[comm.rank] = (took, e)
+            return "detected"
+        return "hung?"
+
+    res = run_shm_world(prog, 3, timeout=30.0)
+    assert res == ["detected", "detected", "died"]
+    for rank, (took, exc) in outcomes.items():
+        assert took < 10.0, f"rank {rank} took {took:.1f}s (bound is ~1s)"
+        assert 2 in exc.failed
+        assert exc.collective == "barrier"

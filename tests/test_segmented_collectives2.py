@@ -219,7 +219,10 @@ def test_reduce_scatter_zero_pickled_bytes(transport, small_segments):
         want = np.zeros(nelem // n)
         for s in range(n):
             want += np.random.RandomState(s).randn(n, nelem // n)[comm.rank]
-        out = comm.reduce_scatter(blocks, op=ops.SUM)
+        # explicit ring: this test proves the segmented WIRE engine; on
+        # shm worlds "auto" now routes to the collective arena, whose
+        # copy contract is asserted in tests/test_coll_sm.py
+        out = comm.reduce_scatter(blocks, op=ops.SUM, algorithm="ring")
         np.testing.assert_allclose(out, want)
         return True
 

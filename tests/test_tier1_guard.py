@@ -1,0 +1,75 @@
+"""tools/tier1_guard.py — the mechanical "no worse than seed" gate:
+parse DOTS_PASSED from a tier-1 log exactly like the ROADMAP verify
+line, compare against the committed floor in tests/baseline_count.json."""
+
+import json
+import os
+import subprocess
+import sys
+
+from tools.tier1_guard import count_dots, main
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_LOG = """\
+============================= test session starts ==============================
+....F..s                                                                 [ 40%]
+..x.E.                                                                   [ 70%]
+tests/test_a.py .... not a -q progress line (has a path prefix)
+not a progress line .... with dots
+.......                                                                  [100%]
+=========================== short test summary info ============================
+"""
+
+
+def test_count_dots_matches_verify_line(tmp_path):
+    log = tmp_path / "t1.log"
+    log.write_text(_LOG)
+    got = count_dots(str(log))
+    # 6+4+7 dots on the three BARE -q progress lines; path-prefixed and
+    # prose lines must NOT count (the verify grep anchors on ^[.FEsx]+)
+    assert got == {"dots_passed": 17, "dots_failed": 1, "dots_errors": 1,
+                   "dots_skipped": 2}
+
+
+def test_guard_enforces_floor(tmp_path):
+    log = tmp_path / "t1.log"
+    log.write_text(_LOG)
+    baseline = tmp_path / "baseline.json"
+    # --update records the baseline; a same-count run passes
+    assert main([str(log), "--baseline", str(baseline), "--update"]) == 0
+    assert json.loads(baseline.read_text())["dots_passed"] == 17
+    assert main([str(log), "--baseline", str(baseline)]) == 0
+    # a shrunken run fails
+    baseline.write_text(json.dumps({"dots_passed": 18}))
+    assert main([str(log), "--baseline", str(baseline)]) == 1
+    # a grown run still passes (the floor is a minimum, not an equality)
+    baseline.write_text(json.dumps({"dots_passed": 10}))
+    assert main([str(log), "--baseline", str(baseline)]) == 0
+
+
+def test_guard_rejects_empty_log(tmp_path):
+    log = tmp_path / "empty.log"
+    log.write_text("no progress lines here\n")
+    assert main([str(log), "--baseline", str(tmp_path / "b.json")]) == 2
+
+
+def test_committed_baseline_exists_and_is_sane():
+    """The committed floor the CI comparison runs against."""
+    path = os.path.join(REPO, "tests", "baseline_count.json")
+    with open(path) as f:
+        base = json.load(f)
+    assert base["dots_passed"] >= 634  # the PR-3 tier-1 count on this box
+
+
+def test_cli_entrypoint(tmp_path):
+    log = tmp_path / "t1.log"
+    log.write_text(_LOG)
+    baseline = tmp_path / "baseline.json"
+    baseline.write_text(json.dumps({"dots_passed": 1}))
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "tier1_guard.py"),
+         str(log), "--baseline", str(baseline)],
+        capture_output=True, text=True)
+    assert proc.returncode == 0
+    assert "DOTS_PASSED=17" in proc.stdout

@@ -1,0 +1,93 @@
+#!/usr/bin/env python
+"""Mechanical "no worse than seed" guard for the tier-1 suite.
+
+The ROADMAP's tier-1 verify line already computes ``DOTS_PASSED`` (the
+count of passed-test dots in the pytest progress output); this tool turns
+the eyeball comparison into an exit code: parse a tier-1 log, count the
+dots exactly the way the verify line does, and fail if the count dropped
+below the committed baseline in ``tests/baseline_count.json``.
+
+Usage::
+
+    # after running the tier-1 verify line with `tee /tmp/_t1.log`:
+    python tools/tier1_guard.py /tmp/_t1.log            # enforce
+    python tools/tier1_guard.py /tmp/_t1.log --update   # re-baseline
+
+``--update`` rewrites the baseline from the given log — run it only when
+a PR legitimately grows the suite (the new count becomes the next PR's
+floor).  The baseline file also records the failed count for context,
+but only the passed floor is enforced: a PR that adds tests may add
+known-drift failures to the environment-dependent tail, while losing
+previously-passing tests is always a regression.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import re
+import sys
+
+BASELINE = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "tests", "baseline_count.json")
+
+# the verify line's grep: progress lines are runs of . F E s x, optionally
+# suffixed by a [ NN%] marker
+_PROGRESS = re.compile(r"^[.FEsx]+( *\[ *[0-9]+%\])?$")
+
+
+def count_dots(log_path: str) -> dict:
+    passed = failed = errors = skipped = 0
+    with open(log_path, "rb") as f:
+        for raw in f:
+            line = raw.decode("utf-8", "replace").rstrip("\n")
+            if _PROGRESS.match(line):
+                passed += line.count(".")
+                failed += line.count("F")
+                errors += line.count("E")
+                skipped += line.count("s") + line.count("x")
+    return {"dots_passed": passed, "dots_failed": failed,
+            "dots_errors": errors, "dots_skipped": skipped}
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("log", help="tier-1 pytest log (the tee'd verify output)")
+    ap.add_argument("--baseline", default=BASELINE)
+    ap.add_argument("--update", action="store_true",
+                    help="rewrite the baseline from this log")
+    args = ap.parse_args(argv)
+
+    counts = count_dots(args.log)
+    if counts["dots_passed"] == 0:
+        print(f"tier1_guard: no pytest progress lines found in {args.log} "
+              f"(wrong file, or the run crashed before collecting?)")
+        return 2
+    if args.update:
+        with open(args.baseline, "w") as f:
+            json.dump(counts, f, indent=2)
+            f.write("\n")
+        print(f"tier1_guard: baseline updated: {counts}")
+        return 0
+    try:
+        with open(args.baseline) as f:
+            base = json.load(f)
+    except FileNotFoundError:
+        print(f"tier1_guard: no baseline at {args.baseline}; run with "
+              f"--update once to record one")
+        return 2
+    floor = int(base["dots_passed"])
+    got = counts["dots_passed"]
+    print(f"tier1_guard: DOTS_PASSED={got} (baseline floor {floor}, "
+          f"failed {counts['dots_failed']})")
+    if got < floor:
+        print(f"tier1_guard: FAIL — passed count dropped below the "
+              f"committed baseline ({got} < {floor})")
+        return 1
+    print("tier1_guard: OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
