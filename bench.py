@@ -562,6 +562,13 @@ if __name__ == "__main__":
 
         sys.exit(chaos.main(
             ["--quick"] if "--quick" in sys.argv[1:] else []))
+    if "--verify-overhead" in sys.argv[1:]:
+        # verifier cost leg (ISSUE 5): asserts the off-mode zero-cost
+        # contract (pvar-identical hot path) and prices the on-mode.
+        from benchmarks import verify_overhead
+
+        sys.exit(verify_overhead.main(
+            ["--quick"] if "--quick" in sys.argv[1:] else []))
     if "--sweep" in sys.argv[1:]:
         # the OSU-style host data-plane size sweep (ISSUE 1 tentpole #4,
         # extended to alltoall/reduce_scatter/rabenseifner in ISSUE 2);

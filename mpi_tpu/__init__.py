@@ -33,7 +33,7 @@ from .ops import SUM, PROD, MAX, MIN, LAND, LOR, LXOR, BAND, BOR, BXOR, ReduceOp
 from .communicator import Communicator, Message, P2PCommunicator, Request, Status
 from .transport.base import ANY_SOURCE, ANY_TAG
 from .transport.local import run_local
-from . import datatypes, errors, ft, io, mpi4, schedules, checker, checkpoint, profiling, trace
+from . import datatypes, errors, ft, io, mpi4, schedules, checker, checkpoint, profiling, trace, verify
 from .intercomm import InterComm, create_intercomm
 from .topology import (CartComm, GraphComm, HierarchicalComm, cart_create,
                        dims_create, dist_graph_create_adjacent,
@@ -50,7 +50,7 @@ __all__ = [
     "SUM", "PROD", "MAX", "MIN", "LAND", "LOR", "LXOR", "BAND", "BOR", "BXOR",
     "Communicator", "Message", "P2PCommunicator", "Request", "Status", "ANY_SOURCE", "ANY_TAG",
     "init", "finalize", "is_initialized", "run", "run_local",
-    "schedules", "checker", "checkpoint", "ft", "profiling", "trace", "COMM_WORLD", "io", "mpi4",
+    "schedules", "checker", "checkpoint", "ft", "profiling", "trace", "verify", "COMM_WORLD", "io", "mpi4",
     "CartComm", "GraphComm", "HierarchicalComm", "InterComm",
     "create_intercomm", "cart_create", "graph_create", "split_hierarchical",
     "dist_graph_create_adjacent", "dims_create", "Group",
@@ -107,6 +107,13 @@ def init(backend: Optional[str] = None) -> Communicator:
                 from . import ft as _ft
 
                 _ft.enable(_world, rdv_dir=rdv)
+            if os.environ.get("MPI_TPU_VERIFY", "") not in ("", "0"):
+                # runtime correctness verifier (mpi_tpu/verify):
+                # pending-op files under the rendezvous dir — deadlocks
+                # surface as DeadlockError within verify_stall_timeout_s
+                # + one analysis slice, divergent collectives as
+                # CollectiveMismatchError before their data moves
+                verify.enable(_world, rdv_dir=rdv)
         elif backend in ("self", "local"):
             from .transport.local import LocalTransport, LocalWorld
 
@@ -129,6 +136,9 @@ def finalize() -> None:
         if _world is None:
             return
         _world.barrier()
+        verified = _world._verify is not None
+        if verified:
+            _world._verify.world.mark_exited()
         pending = _world.close_transport()
         _world = None
     from . import mpi4 as _mpi4
@@ -138,6 +148,15 @@ def finalize() -> None:
         import warnings
 
         warnings.warn(f"MPI_Finalize: {len(pending)} unreceived message(s): {pending[:8]}")
+    if verified:
+        # finalize-time verifier report (SURVEY.md §5 sanitizer story):
+        # leaked requests, unfreed communicators, recorded lints
+        problems = verify.finalize_report()
+        if problems:
+            import warnings
+
+            warnings.warn("MPI_Finalize: verifier report:\n  "
+                          + "\n  ".join(problems))
 
 
 def run(

@@ -15,7 +15,7 @@ mpi_tpu/trace.py).
 from __future__ import annotations
 
 from collections import Counter, deque
-from typing import Iterable, List, Sequence, Tuple
+from typing import Dict, Iterable, List, Sequence, Tuple
 
 Pair = Tuple[int, int]
 
@@ -53,6 +53,47 @@ def validate_rounds(rounds: Sequence[Sequence[Pair]], size: int) -> None:
             validate_perm(pairs, size)
         except ScheduleError as e:
             raise ScheduleError(f"round {i}: {e}") from e
+
+
+def find_deadlock(waits: Dict[int, Tuple[str, Sequence[int]]],
+                  ranks: Iterable[int],
+                  exited: Iterable[int] = ()) -> List[int]:
+    """AND-OR wait-for-graph analysis: the pure core of the runtime
+    deadlock detector (mpi_tpu/verify/deadlock.py) — same model as the
+    MUST-class MPI verifiers.
+
+    ``waits[r] = (mode, targets)`` describes a *blocked* rank: with
+    ``mode='AND'`` (a specific-source recv, a waitall set) r needs EVERY
+    target to progress; with ``mode='OR'`` (an ANY_SOURCE recv, a
+    waitany set) ANY progressing target can release it.  ``ranks`` is
+    the whole world; ``exited`` ranks have terminated and can never send
+    again.  Returns the sorted list of ranks proven deadlocked: the
+    greatest set of blocked ranks none of whose release conditions can
+    be met by a rank outside it (a cycle for AND edges, a knot for OR
+    sets).  Ranks neither blocked nor exited are assumed able to
+    progress — the analysis never false-positives on a slow peer, only
+    on a closed blocking picture."""
+    ranks = set(ranks)
+    exited = set(exited) & ranks
+    progressing = ranks - set(waits) - exited
+    changed = True
+    while changed:
+        changed = False
+        for r, (mode, targets) in waits.items():
+            if r in progressing:
+                continue
+            targets = [t for t in targets if t in ranks and t != r]
+            if not targets:
+                # nothing known about the wait: assume it can progress
+                progressing.add(r)
+                changed = True
+                continue
+            ok = (any(t in progressing for t in targets) if mode == "OR"
+                  else all(t in progressing for t in targets))
+            if ok:
+                progressing.add(r)
+                changed = True
+    return sorted(r for r in waits if r not in progressing)
 
 
 def verify_matching(logs: Sequence[Sequence[tuple]],

@@ -109,7 +109,8 @@ _TAG_SHIFT = -3
 _TAG_BARRIER = -4
 _TAG_SPLIT = -5
 # -6/-7/-8 are the fault-tolerance control tags (revoke / shrink /
-# agree) — see mpi_tpu/ft.py TAG_REVOKE & co.
+# agree) — see mpi_tpu/ft.py TAG_REVOKE & co.; -9 is the runtime
+# verifier's collective-signature ring (mpi_tpu/verify/collcheck.py).
 
 # Default ``recv_timeout`` of newly created communicators (mpit cvar
 # ``recv_timeout_s``; 0/None = wait forever).  The per-communicator
@@ -117,9 +118,10 @@ _TAG_SPLIT = -5
 # story turns so a lost message surfaces as RecvTimeout everywhere.
 _RECV_TIMEOUT_DEFAULT: Optional[float] = None
 
-# Slice length of fault-tolerant blocking waits (detector/revocation
-# re-check cadence while blocked) — mirrored from ft._POLL_S lazily so
-# importing this module never pulls the ft machinery in.
+# Slice length of fault-tolerant AND verified blocking waits (detector/
+# revocation/stall re-check cadence while blocked) — mirrors ft.POLL_S
+# (kept as a literal so importing this module never pulls the ft
+# machinery in; the two are asserted equal in tests/test_verify.py).
 _FT_POLL_S = 0.05
 
 
@@ -320,6 +322,15 @@ class Request:
     ``wait()`` blocks until completion and returns the payload (None for
     sends); ``test()`` returns (done, payload-or-None) without blocking."""
 
+    # Verifier tracking record (mpi_tpu/verify) — None when the request
+    # was created with the verifier off, so _vnote is one attribute test.
+    _vinfo = None
+
+    def _vnote(self, completed: bool, blocking: bool = True) -> None:
+        vi = self._vinfo
+        if vi is not None:
+            vi.note(completed, blocking)
+
     def wait(self) -> Any:
         raise NotImplementedError
 
@@ -332,9 +343,11 @@ class _CompletedRequest(Request):
         self._value = value
 
     def wait(self) -> Any:
+        self._vnote(True)
         return self._value
 
     def test(self) -> Tuple[bool, Any]:
+        self._vnote(True, blocking=False)
         return True, self._value
 
 
@@ -406,6 +419,7 @@ class _RecvRequest(Request):
             # the segmented collective engine's pipelined irecvs — must
             # not trip the user-tag check at completion time
             head._complete(self._comm._recv_internal(head._source, head._tag))
+        self._vnote(True)
         return self._value
 
     def test(self) -> Tuple[bool, Any]:
@@ -419,9 +433,15 @@ class _RecvRequest(Request):
                 # None).  Checked only on the empty path — a message
                 # already delivered stays receivable (MPI: completable
                 # operations complete even after a peer death).
-                self._comm._ft_poll_check(self._source, self._tag)
+                self._comm._empty_poll_check(self._source, self._tag)
                 return False, None
             head._complete(hit[0])
+            if self._comm._verify is not None:
+                # a poll hit is real progress: stamp it (and retract any
+                # stale published entry) even though this completion
+                # path bypasses _recv_internal
+                self._comm._verify.world.note_progress()
+        self._vnote(True, blocking=False)
         return True, self._value
 
 
@@ -442,6 +462,7 @@ class PersistentRequest(Request):
         self._peer, self._tag = peer, tag
         self._inner: Optional[Request] = None  # active sub-request
         self._last: Any = None  # last completed payload (sticky, see wait)
+        self._buf_key: Optional[int] = None  # verifier live-buffer handle
 
     @property
     def active(self) -> bool:
@@ -460,6 +481,17 @@ class PersistentRequest(Request):
             self._inner = self._comm.isend(payload, self._peer, self._tag)
         else:
             self._inner = self._comm.irecv(self._peer, self._tag)
+            v = self._comm._verify
+            if v is not None and isinstance(self._buf, np.ndarray):
+                # live receive buffer: overlapping another pending
+                # nonblocking op's buffer is the message-race lint
+                from .verify.state import user_site
+
+                self._buf_key = v.world.buffer_live(
+                    self._buf,
+                    f"rank {self._comm.rank}: recv_init(source="
+                    f"{self._peer}, tag={self._tag}).start() at "
+                    f"{user_site()}", writes=True)
         return self
 
     def wait(self) -> Any:
@@ -484,6 +516,9 @@ class PersistentRequest(Request):
     def _complete(self, value: Any) -> None:
         self._inner = None
         self._last = value
+        if self._buf_key is not None:
+            self._comm._verify.world.buffer_release(self._buf_key)
+            self._buf_key = None
         if self._kind == "recv" and isinstance(self._buf, np.ndarray):
             self._buf[...] = value
 
@@ -514,6 +549,7 @@ class _ThreadRequest(Request):
 
     def wait(self) -> Any:
         self._thread.join()
+        self._vnote(True)
         if self._error is not None:
             raise self._error
         return self._value
@@ -521,7 +557,10 @@ class _ThreadRequest(Request):
     def test(self) -> Tuple[bool, Any]:
         if self._thread.is_alive():
             return False, None
-        return True, self.wait()
+        self._vnote(True, blocking=False)
+        if self._error is not None:
+            raise self._error
+        return True, self._value
 
 
 class Keyval:
@@ -668,6 +707,20 @@ class Communicator(ABC):
         hole to zeros)."""
         raise NotImplementedError(f"{type(self).__name__} does not implement exchange")
 
+    def _verify_counts(self, coll: str, counts) -> None:
+        """Vector-collective hook: with the runtime verifier on (P2P
+        backends only — the attribute is never set elsewhere), cross-
+        check the literal counts vector across ranks; divergence is the
+        truncating-recv case (rank j sends counts_j[j] rows, rank i
+        reads counts_i[j] of them)."""
+        v = getattr(self, "_verify", None)
+        if v is not None and self.size > 1:
+            from .verify import collcheck as _vcc
+
+            _vcc.check(self, coll, counts=tuple(
+                tuple(int(c) for c in row) if hasattr(row, "__len__")
+                else int(row) for row in counts))
+
     # -- collectives -------------------------------------------------------
 
     @abstractmethod
@@ -770,6 +823,7 @@ class Communicator(ABC):
         """MPI_Allgatherv [S]: concatenation of every rank's first
         ``counts[rank]`` rows, in rank order."""
         self._check_counts(counts)
+        self._verify_counts("allgatherv", counts)
         items = self.allgather(self._take_rows(obj, counts[self.rank]))
         return np.concatenate([np.asarray(it) for it in items], axis=0)
 
@@ -777,6 +831,7 @@ class Communicator(ABC):
                 root: int = 0) -> Optional[Any]:
         """MPI_Gatherv [S]: like allgatherv, result only guaranteed at root."""
         self._check_counts(counts)
+        self._verify_counts("gatherv", counts)
         items = self.gather(self._take_rows(obj, counts[self.rank]), root)
         if items is None:
             return None
@@ -787,6 +842,7 @@ class Communicator(ABC):
         rank r receives its ``counts[r]``-row slice.  (The SPMD backend
         returns it padded to ``max(counts)`` rows — static shapes.)"""
         self._check_counts(counts)
+        self._verify_counts("scatterv", counts)
         parts: Optional[List[Any]] = None
         if self.rank == root:
             offs = np.cumsum([0] + list(counts))
@@ -805,6 +861,7 @@ class Communicator(ABC):
         holding ``counts[j][rank]`` valid rows (exact on process backends;
         padded to the global max count on SPMD)."""
         self._check_counts_matrix(counts)
+        self._verify_counts("alltoallv", counts)
         sendlist = [self._take_rows(blocks[d], counts[self.rank][d])
                     for d in range(self.size)]
         return self.alltoall(sendlist)
@@ -931,6 +988,12 @@ class P2PCommunicator(Communicator):
         # ft.enable(); None = all FT machinery compiled out of the hot
         # path (a single attribute test per op).
         self._ft = None
+        # Runtime-verifier state (mpi_tpu/verify CommVerify), attached by
+        # verify.enable(); None = the whole verifier is a single
+        # attribute test per op (the off-mode zero-cost contract,
+        # asserted by tests/test_verify.py and bench.py
+        # --verify-overhead).
+        self._verify = None
         # Which collective's machinery is currently waiting on internal
         # tags — included in ProcFailedError diagnoses.  Set-and-forget
         # at each collective entry: it is only consulted for failures on
@@ -987,8 +1050,12 @@ class P2PCommunicator(Communicator):
                     f"rank {self._rank}: send to rank {dest} failed "
                     f"({e})", failed=(dest,),
                     collective=self._coll_name if tag < 0 else None) from e
+            if self._verify is not None:
+                self._verify.world.note_progress()
             return
         self._t.send(dest_world, self._ctx, tag, obj)
+        if self._verify is not None:
+            self._verify.world.note_progress()
 
     def recv(self, source: int = ANY_SOURCE, tag: int = ANY_TAG,
              status: Optional[Status] = None) -> Any:
@@ -998,8 +1065,8 @@ class P2PCommunicator(Communicator):
     def _recv_internal(self, source: int, tag: int,
                        status: Optional[Status] = None) -> Any:
         src_world = ANY_SOURCE if source == ANY_SOURCE else self._world(source)
-        if self._ft is not None:
-            obj, src, t = self._ft_wait(src_world, tag)
+        if self._ft is not None or self._verify is not None:
+            obj, src, t = self._sliced_wait(src_world, tag)
         else:
             obj, src, t = self._t.recv(src_world, self._ctx, tag,
                                        timeout=self.recv_timeout)
@@ -1008,67 +1075,130 @@ class P2PCommunicator(Communicator):
             status._fill(self._from_world(src), t, obj)
         return obj
 
-    # -- fault-tolerant blocking waits (mpi_tpu/ft.py) ---------------------
+    # -- sliced blocking waits (mpi_tpu/ft.py + mpi_tpu/verify) ------------
 
-    def _ft_wait(self, src_world: int, tag: int, consume: bool = True):
-        """Every FT-enabled blocking wait (recv, probe, and through
-        _RecvRequest.wait the segmented engine's irecv drains): the
-        transport wait runs in _FT_POLL_S slices, and between slices a
-        queued revocation raises RevokedError while a detector hit on a
-        relevant peer raises ProcFailedError — a peer death is noticed
-        within the detection bound no matter how long the communicator-
-        level ``recv_timeout`` is (or whether one is set at all)."""
+    def _sliced_wait(self, src_world: int, tag: int, consume: bool = True):
+        """Every FT- or verifier-enabled blocking wait (recv, probe, and
+        through _RecvRequest.wait the segmented engine's irecv drains):
+        the transport wait runs in _FT_POLL_S slices, and between slices
+
+        * (FT) a queued revocation raises RevokedError and a detector
+          hit on a relevant peer raises ProcFailedError — a peer death
+          is noticed within the detection bound no matter how long the
+          communicator-level ``recv_timeout`` is;
+        * (verify) past ``verify_stall_timeout_s`` the rank publishes
+          its pending op on the out-of-band board and runs the wait-for
+          deadlock analysis — a proven cycle/knot raises DeadlockError
+          instead of hanging (mpi_tpu/verify/deadlock.py).
+
+        One slice loop for both: the verifier deliberately reuses the FT
+        slice-poll plumbing rather than stacking a second poller."""
         ft = self._ft
+        vw = self._verify.world if self._verify is not None else None
         timeout = self.recv_timeout
-        deadline = None if timeout is None else time.monotonic() + timeout
-        while True:
-            ft.check(self)
-            remaining = (None if deadline is None
-                         else deadline - time.monotonic())
-            slice_s = (_FT_POLL_S if remaining is None
-                       else max(0.0, min(_FT_POLL_S, remaining)))
-            try:
-                if consume:
-                    return self._t.recv(src_world, self._ctx, tag,
-                                        timeout=slice_s)
-                return self._t.peek(src_world, self._ctx, tag,
-                                    timeout=slice_s)
-            except RecvTimeout:
-                suspects = self._ft_suspects(src_world, tag)
-                if suspects:
-                    what = (f"collective {self._coll_name!r}" if tag < 0
-                            else f"recv(tag={tag})")
-                    raise ProcFailedError(
-                        f"rank {self._rank}: peer death detected while "
-                        f"blocked in {what}", failed=suspects,
-                        collective=self._coll_name if tag < 0 else None)
-                if deadline is not None and time.monotonic() >= deadline:
-                    # fresh exception: re-raising the SLICE's timeout
-                    # would log a nonsensical "timed out after 0.05s"
-                    # for a wait that honored the configured timeout
-                    raise RecvTimeout(
-                        f"recv(source={src_world}, ctx={self._ctx}, "
-                        f"tag={tag}) timed out after {timeout}s; "
-                        f"pending={self._t.mailbox.pending_summary()}")
+        start = time.monotonic()
+        deadline = None if timeout is None else start + timeout
+        block_id = vw.begin_block() if vw is not None else 0
+        try:
+            while True:
+                if ft is not None:
+                    ft.check(self)
+                remaining = (None if deadline is None
+                             else deadline - time.monotonic())
+                slice_s = (_FT_POLL_S if remaining is None
+                           else max(0.0, min(_FT_POLL_S, remaining)))
+                try:
+                    if consume:
+                        hit = self._t.recv(src_world, self._ctx, tag,
+                                           timeout=slice_s)
+                    else:
+                        hit = self._t.peek(src_world, self._ctx, tag,
+                                           timeout=slice_s)
+                except RecvTimeout:
+                    if ft is not None:
+                        suspects = self._ft_suspects(src_world, tag)
+                        if suspects:
+                            what = (f"collective {self._coll_name!r}"
+                                    if tag < 0 else f"recv(tag={tag})")
+                            raise ProcFailedError(
+                                f"rank {self._rank}: peer death detected "
+                                f"while blocked in {what}", failed=suspects,
+                                collective=self._coll_name if tag < 0
+                                else None)
+                    if (vw is not None and
+                            time.monotonic() - start >= vw.stall_timeout_s):
+                        # may raise DeadlockError; the published entry is
+                        # deliberately NOT cleared on the raise — peers
+                        # confirming the same diagnosis need it stable
+                        self._verify_stalled(vw, src_world, tag, block_id,
+                                             consume)
+                    if deadline is not None \
+                            and time.monotonic() >= deadline:
+                        # fresh exception: re-raising the SLICE's timeout
+                        # would log a nonsensical "timed out after 0.05s"
+                        # for a wait that honored the configured timeout
+                        raise RecvTimeout(
+                            f"recv(source={src_world}, ctx={self._ctx}, "
+                            f"tag={tag}) timed out after {timeout}s; "
+                            f"pending={self._t.mailbox.pending_summary()}")
+                else:
+                    if vw is not None:
+                        vw.note_progress()  # clears the published entry
+                    return hit
+        except (RecvTimeout, ProcFailedError, RevokedError):
+            # the rank exits this wait alive (the caller may catch and
+            # continue): retract any published 'blocked' entry so a peer's
+            # analysis cannot keep implicating a wait that is over.
+            # DeadlockError is not in this list on purpose (see above).
+            if vw is not None:
+                vw.clear_published()
+            raise
 
-    def _ft_poll_check(self, source: int, tag: int) -> None:
+    def _verify_stalled(self, vw, src_world: int, tag: int, block_id: int,
+                        consume: bool) -> None:
+        from .verify import deadlock as _vdl
+        from .verify.state import user_site
+
+        if src_world == ANY_SOURCE:
+            targets = tuple(w for w in self._group
+                            if w != self._t.world_rank)
+            mode = "OR"
+        else:
+            targets, mode = (src_world,), "AND"
+        _vdl.check_stalled(
+            vw, self, targets, mode, tag,
+            "recv" if consume else "probe",
+            self._coll_name if tag < 0 else None, user_site(), block_id)
+
+    def _empty_poll_check(self, source: int, tag: int) -> None:
         """FT gate of the NONBLOCKING completion paths (Request.test,
-        iprobe, improbe): apply queued revocations and convert a
-        detector hit on a relevant peer into ProcFailedError — same
-        rules as the sliced blocking wait, minus the blocking."""
-        if self._ft is None:
-            return
-        self._ft.check(self)
-        src_world = (ANY_SOURCE if source == ANY_SOURCE
-                     else self._world(source))
-        suspects = self._ft_suspects(src_world, tag)
-        if suspects:
-            what = (f"collective {self._coll_name!r}" if tag < 0
-                    else f"poll(tag={tag})")
-            raise ProcFailedError(
-                f"rank {self._rank}: peer death detected while polling "
-                f"{what}", failed=suspects,
-                collective=self._coll_name if tag < 0 else None)
+        iprobe, improbe) on their EMPTY path: apply queued revocations
+        and convert a detector hit on a relevant peer into
+        ProcFailedError — same rules as the sliced blocking wait, minus
+        the blocking.  The runtime verifier deliberately does NOT treat
+        an empty poll as a blocked state: a nonblocking call proves
+        nothing about whether the rank is stuck (it may be polling
+        opportunistically while doing useful work), so publishing it as
+        'blocked' — let alone raising DeadlockError from it — would
+        false-positive on correct programs.  Deadlock participation is
+        restricted to the blocking waits (_sliced_wait), MUST-style;
+        pure-polling drain loops are the documented residual
+        (ROADMAP)."""
+        if self._ft is not None:
+            self._ft.check(self)
+            src_world = (ANY_SOURCE if source == ANY_SOURCE
+                         else self._world(source))
+            suspects = self._ft_suspects(src_world, tag)
+            if suspects:
+                what = (f"collective {self._coll_name!r}" if tag < 0
+                        else f"poll(tag={tag})")
+                raise ProcFailedError(
+                    f"rank {self._rank}: peer death detected while polling "
+                    f"{what}", failed=suspects,
+                    collective=self._coll_name if tag < 0 else None)
+
+    # kept under its historical name for the faulty/chaos harnesses
+    _ft_poll_check = _empty_poll_check
 
     def _ft_suspects(self, src_world: int, tag: int) -> Tuple[int, ...]:
         """Which known-dead comm ranks make THIS wait hopeless.  Internal
@@ -1107,7 +1237,10 @@ class P2PCommunicator(Communicator):
         immediately complete — standard-mode semantics with system buffering
         [S]."""
         self.send(obj, dest, tag)
-        return _CompletedRequest()
+        req: Request = _CompletedRequest()
+        if self._verify is not None:
+            self._track_request(req, "isend", dest, tag)
+        return req
 
     def isendrecv(self, sendobj: Any, dest: int, source: int = ANY_SOURCE,
                   sendtag: int = 0, recvtag: int = ANY_TAG) -> Request:
@@ -1130,14 +1263,24 @@ class P2PCommunicator(Communicator):
         shared posted-receive queue (it would race concurrent receives
         on the same (source, tag); review round 4)."""
         self.send(snapshot_payload(self._t, buf), dest, sendtag)
-        return _ReplaceRequest(self.irecv(source, recvtag), buf)
+        inner = self.irecv(source, recvtag)
+        if self._verify is not None and inner._vinfo is not None:
+            # the replace writes ``buf`` in place at completion: a live
+            # write buffer for the overlap (message-race) lint
+            inner._vinfo.kind = "isendrecv_replace"
+            self._verify.world.track_buffer(
+                inner._vinfo, buf, inner._vinfo.describe(), writes=True)
+        return _ReplaceRequest(inner, buf)
 
     def irecv(self, source: int = ANY_SOURCE, tag: int = ANY_TAG) -> Request:
         """Nonblocking receive (MPI_Irecv): returns a Request; ``test()``
         polls without blocking, ``wait()`` blocks.  Requests on the same
         (source, tag) complete in posted order."""
         _check_user_tag(tag)
-        return self._irecv_internal(source, tag)
+        req = self._irecv_internal(source, tag)
+        if self._verify is not None:
+            self._track_request(req, "irecv", source, tag)
+        return req
 
     def _irecv_internal(self, source: int, tag: int) -> "_RecvRequest":
         """irecv without the user-tag gate — the collective engine posts
@@ -1168,8 +1311,8 @@ class P2PCommunicator(Communicator):
         (without consuming it); fills ``status`` with its envelope."""
         _check_user_tag(tag)
         src_world = ANY_SOURCE if source == ANY_SOURCE else self._world(source)
-        if self._ft is not None:
-            s, t, n = self._ft_wait(src_world, tag, consume=False)
+        if self._ft is not None or self._verify is not None:
+            s, t, n = self._sliced_wait(src_world, tag, consume=False)
         else:
             s, t, n = self._t.peek(src_world, self._ctx, tag,
                                    timeout=self.recv_timeout)
@@ -1184,8 +1327,8 @@ class P2PCommunicator(Communicator):
         The thread-safe probe+recv idiom MPI_Probe cannot provide."""
         _check_user_tag(tag)
         src_world = ANY_SOURCE if source == ANY_SOURCE else self._world(source)
-        if self._ft is not None:
-            obj, src, t = self._ft_wait(src_world, tag)
+        if self._ft is not None or self._verify is not None:
+            obj, src, t = self._sliced_wait(src_world, tag)
         else:
             obj, src, t = self._t.recv(src_world, self._ctx, tag,
                                        timeout=self.recv_timeout)
@@ -1204,6 +1347,8 @@ class P2PCommunicator(Communicator):
             # empty-path FT gate: see _RecvRequest.test
             self._ft_poll_check(source, tag)
             return None
+        if self._verify is not None:
+            self._verify.world.note_progress()
         obj, src, t = hit
         msg = Message(obj, self._from_world(src), t, comm=self)
         if status is not None:
@@ -1220,6 +1365,8 @@ class P2PCommunicator(Communicator):
             # empty-path FT gate: see _RecvRequest.test
             self._ft_poll_check(source, tag)
             return False
+        if self._verify is not None:
+            self._verify.world.note_progress()
         if status is not None:
             status._fill_envelope(self._from_world(hit[0]), hit[1], hit[2])
         return True
@@ -1275,6 +1422,31 @@ class P2PCommunicator(Communicator):
 
     # -- collectives -------------------------------------------------------
 
+    def _verify_coll(self, coll: str, root: Optional[int] = None,
+                     op: Any = None, payload: Any = None,
+                     algorithm: Optional[str] = None,
+                     counts: Optional[Tuple] = None) -> None:
+        """Collective-matching hook (mpi_tpu/verify/collcheck.py): with
+        the verifier on, circulate this entry's signature on the
+        TAG_VERIFY ring and raise CollectiveMismatchError on divergence
+        BEFORE any collective data moves.  A single attribute test when
+        the verifier is off."""
+        if self._verify is not None and self.size > 1:
+            from .verify import collcheck as _vcc
+
+            _vcc.check(self, coll, root=root, op=op, payload=payload,
+                       algorithm=algorithm, counts=counts)
+
+    def _track_request(self, req: Request, kind: str, peer: int,
+                       tag: int) -> Request:
+        """Register a user-level nonblocking request with the verifier
+        (leak / double-wait lints).  Caller checked self._verify."""
+        from .verify.state import user_site
+
+        self._verify.world.track_request(req, kind, self._rank, peer, tag,
+                                         user_site())
+        return req
+
     def bcast(self, obj: Any, root: int = 0, algorithm: str = "auto") -> Any:
         """MPI_Bcast.  ``algorithm``: ``"tree"`` (binomial tree, log2(P)
         rounds — BASELINE.json:8); ``"sm"`` (shm transports only: the
@@ -1293,6 +1465,7 @@ class P2PCommunicator(Communicator):
             "bcast", algorithm, ("auto", "tree") + _coll_sm.gate(self),
             {"fused": "tree"})
         self._world(root)  # validate
+        self._verify_coll("bcast", root=root, algorithm=algorithm)
         if self.size == 1:
             return obj
         if algorithm in ("auto", "sm"):
@@ -1366,6 +1539,8 @@ class P2PCommunicator(Communicator):
             {"fused": "tree"})
         self._world(root)  # validate
         arr, scalar = _as_array(obj)
+        self._verify_coll("reduce", root=root, op=op, payload=arr,
+                          algorithm=algorithm)
         if algorithm in ("auto", "sm") and self.size > 1:
             got = _coll_sm.reduce(self, arr, op, root)
             if got is not _coll_sm.FALLBACK:
@@ -1404,6 +1579,8 @@ class P2PCommunicator(Communicator):
             ("auto", "ring", "recursive_halving", "rabenseifner",
              "reduce_bcast") + _coll_sm.gate(self),
             {"fused": "auto"})  # no fused path on sockets; best schedule
+        self._verify_coll("allreduce", op=op, payload=arr,
+                          algorithm=algorithm)
         if algorithm in ("auto", "sm") and self.size > 1:
             # shm transports: the collective arena first — flat slot
             # folds at eager sizes, in-place chunk folds above
@@ -1623,6 +1800,7 @@ class P2PCommunicator(Communicator):
             "allgather", algorithm,
             ("auto", "ring", "doubling") + _coll_sm.gate(self),
             {"fused": "auto"})  # no fused path on sockets
+        self._verify_coll("allgather", algorithm=algorithm)
         if algorithm in ("auto", "sm") and p > 1:
             # Transport capability is group-uniform, so this keeps the
             # "pick may depend only on the group shape" rule: payload
@@ -1750,6 +1928,7 @@ class P2PCommunicator(Communicator):
                            {"auto": "pairwise", "fused": "pairwise"})
         if len(objs) != p:
             raise ValueError(f"alltoall needs one payload per rank ({p}), got {len(objs)}")
+        self._verify_coll("alltoall", algorithm="pairwise")
         result: List[Any] = [None] * p
         result[r] = objs[r]
         rounds = schedules.alltoall_rounds(p)
@@ -1781,6 +1960,7 @@ class P2PCommunicator(Communicator):
             "barrier", algorithm,
             ("auto", "dissemination") + _coll_sm.gate(self),
             {"fused": "dissemination"})
+        self._verify_coll("barrier", algorithm=algorithm)
         p, r = self.size, self._rank
         if algorithm in ("auto", "sm") and p > 1:
             if _coll_sm.barrier(self) is not _coll_sm.FALLBACK:
@@ -1797,6 +1977,7 @@ class P2PCommunicator(Communicator):
         # contiguous ndarray, so every round ships it as a raw frame —
         # never pickled (asserted in tests/test_segmented_collectives2.py).
         arr, scalar = _as_array(obj)
+        self._verify_coll("scan", op=op, payload=arr)
         acc = arr.copy()
         p, r = self.size, self._rank
         d = 1
@@ -1876,6 +2057,12 @@ class P2PCommunicator(Communicator):
         if len(blocks) != p:
             raise ValueError(
                 f"reduce_scatter needs one block per rank ({p}), got {len(blocks)}")
+        # geometry class of block 0 (cheap: no stacking copy) + the block
+        # count — mismatched reduce geometry across ranks is flagged
+        # before the ring/arena can misfold or truncate
+        self._verify_coll("reduce_scatter", op=op,
+                          payload=np.asarray(blocks[0]),
+                          algorithm=algorithm, counts=(p,))
         if algorithm in ("auto", "sm") and p > 1:
             # Arena path: write the whole [P·n] input once, fold only
             # block ``rank`` reading peers in place.  The stacked-array
@@ -1960,6 +2147,7 @@ class P2PCommunicator(Communicator):
         _mpit.count(collectives=1)
         self._coll_name = "scatter"
         self._world(root)  # validate
+        self._verify_coll("scatter", root=root)
         if self._rank == root:
             if objs is None or len(objs) != self.size:
                 raise ValueError(f"scatter root needs one payload per rank ({self.size})")
@@ -1978,6 +2166,7 @@ class P2PCommunicator(Communicator):
         _mpit.count(collectives=1)
         self._coll_name = "gather"
         self._world(root)  # validate
+        self._verify_coll("gather", root=root)
         if self._rank == root:
             items: List[Any] = [None] * self.size
             items[root] = obj
@@ -2132,16 +2321,16 @@ class P2PCommunicator(Communicator):
             (k, cr) for cr, (c, k) in enumerate(infos) if c == color
         )
         group = [self._group[cr] for _, cr in members]
-        return self._inherit_errhandler(self._inherit_ft(
+        return self._inherit_errhandler(self._inherit_ft(self._inherit_verify(
             P2PCommunicator(self._t, group, ctx,
-                            recv_timeout=self.recv_timeout)))
+                            recv_timeout=self.recv_timeout), "split")))
 
     def dup(self) -> "P2PCommunicator":
         self.barrier()  # collectiveness check + sync, like MPI_Comm_dup
         ctx = self._alloc_context()
-        return self._copy_attrs_to(self._inherit_ft(
+        return self._copy_attrs_to(self._inherit_ft(self._inherit_verify(
             P2PCommunicator(self._t, self._group, ctx,
-                            recv_timeout=self.recv_timeout)))
+                            recv_timeout=self.recv_timeout), "dup")))
 
     def _inherit_ft(self, new: "P2PCommunicator") -> "P2PCommunicator":
         """A split/dup child of an FT-enabled communicator is FT-enabled
@@ -2151,6 +2340,21 @@ class P2PCommunicator(Communicator):
             from . import ft as _ftm
 
             new._ft = _ftm.CommFT(self._ft.world, new._ctx)
+        return new
+
+    def _inherit_verify(self, new: "P2PCommunicator",
+                        how: str) -> "P2PCommunicator":
+        """A split/dup child of a verified communicator is verified too
+        (same world board, fresh collective sequence) and joins the
+        unfreed-communicator registry — ``free()`` checks it out, the
+        finalize report lists the leftovers."""
+        if self._verify is not None:
+            from .verify.state import CommVerify, user_site
+
+            cv = CommVerify(self._verify.world)
+            cv.comm_key = self._verify.world.track_comm(new, how,
+                                                        user_site())
+            new._verify = cv
         return new
 
     # -- nonblocking collectives [S: MPI-3 MPI_Ibcast & co.] ---------------
@@ -2170,51 +2374,72 @@ class P2PCommunicator(Communicator):
         # parent must unblock its nonblocking collectives in flight, and
         # the clone polls the parent's home_ctx for remote revocations.
         c._ft = self._ft
+        if self._verify is not None:
+            # fresh per-comm sequence (the clone's ctx isolates its
+            # TAG_VERIFY traffic); NOT in the unfreed-comm registry —
+            # nbc clones are single-use internal machinery
+            from .verify.state import CommVerify
+
+            c._verify = CommVerify(self._verify.world)
         # No collective arena on nbc clones: each clone is single-use,
         # so routing it to coll_sm would map a fresh multi-MB segment
         # PER CALL; the wire algorithms serve the threaded collective.
         c._no_coll_sm = True
         return c
 
+    def _nbc_request(self, kind: str, fn, root: int = -1) -> Request:
+        req = _ThreadRequest(fn)
+        if self._verify is not None:
+            self._track_request(req, kind, root, _TAG_COLL)
+        return req
+
     def ibcast(self, obj: Any, root: int = 0) -> Request:
         c = self._nbc_comm()
-        return _ThreadRequest(lambda: c.bcast(obj, root))
+        return self._nbc_request("ibcast", lambda: c.bcast(obj, root), root)
 
     def ireduce(self, obj: Any, op: _ops.ReduceOp = _ops.SUM,
                 root: int = 0) -> Request:
         c = self._nbc_comm()
-        return _ThreadRequest(lambda: c.reduce(obj, op, root))
+        return self._nbc_request("ireduce", lambda: c.reduce(obj, op, root),
+                                 root)
 
     def iallreduce(self, obj: Any, op: _ops.ReduceOp = _ops.SUM,
                    algorithm: str = "auto") -> Request:
         c = self._nbc_comm()
-        return _ThreadRequest(lambda: c.allreduce(obj, op, algorithm))
+        return self._nbc_request("iallreduce",
+                                 lambda: c.allreduce(obj, op, algorithm))
 
     def iallgather(self, obj: Any) -> Request:
         c = self._nbc_comm()
-        return _ThreadRequest(lambda: c.allgather(obj))
+        return self._nbc_request("iallgather", lambda: c.allgather(obj))
 
     def ialltoall(self, objs: Sequence[Any]) -> Request:
         c = self._nbc_comm()
-        return _ThreadRequest(lambda: c.alltoall(objs))
+        return self._nbc_request("ialltoall", lambda: c.alltoall(objs))
 
     def ibarrier(self) -> Request:
         c = self._nbc_comm()
-        return _ThreadRequest(c.barrier)
+        return self._nbc_request("ibarrier", c.barrier)
 
     def iscatter(self, objs: Optional[Sequence[Any]], root: int = 0) -> Request:
         c = self._nbc_comm()
-        return _ThreadRequest(lambda: c.scatter(objs, root))
+        return self._nbc_request("iscatter", lambda: c.scatter(objs, root),
+                                 root)
 
     def igather(self, obj: Any, root: int = 0) -> Request:
         c = self._nbc_comm()
-        return _ThreadRequest(lambda: c.gather(obj, root))
+        return self._nbc_request("igather", lambda: c.gather(obj, root),
+                                 root)
 
     def free(self) -> None:
-        """Sub-communicators share the world transport: no-op.  A comm
-        flagged as OWNING its transport (the spawn bridge, which has a
-        dedicated socket world) closes it — otherwise every comm_spawn
-        would leak a listener fd + reader threads."""
+        """Sub-communicators share the world transport: no-op (plus the
+        verifier's unfreed-comm checkout).  A comm flagged as OWNING its
+        transport (the spawn bridge, which has a dedicated socket world)
+        closes it — otherwise every comm_spawn would leak a listener fd
+        + reader threads."""
+        if self._verify is not None and self._verify.comm_key is not None:
+            self._verify.world.free_comm(self._verify.comm_key)
+            self._verify.comm_key = None
         if getattr(self, "_owns_transport", False):
             self._owns_transport = False
             self.close_transport()

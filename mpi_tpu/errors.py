@@ -33,6 +33,7 @@ __all__ = [
     "MPI_ERR_PROC_FAILED", "MPI_ERR_REVOKED",
     "ERRORS_ARE_FATAL", "ERRORS_RETURN", "ErrorCode",
     "ProcFailedError", "RevokedError",
+    "DeadlockError", "CollectiveMismatchError",
     "error_class", "error_string",
 ]
 
@@ -121,6 +122,39 @@ class RevokedError(RuntimeError):
     survivors who were not themselves talking to a dead rank."""
 
 
+class DeadlockError(RuntimeError):
+    """The runtime verifier (mpi_tpu/verify) proved a wait-for
+    cycle/knot: every rank in ``ranks`` is blocked, and none of their
+    pending operations can ever be satisfied by a rank outside the
+    blocked set.  Raised INSTEAD of hanging, on every deadlocked rank,
+    with the full cross-rank blocking picture (``table`` maps each
+    world rank to its published pending-op entry; the message renders
+    every rank, its pending op, and its call site — the MUST-style
+    deadlock report)."""
+
+    def __init__(self, msg: str, ranks=(), table: Optional[dict] = None):
+        super().__init__(msg)
+        self.ranks = tuple(ranks)
+        self.table = dict(table or {})
+
+
+class CollectiveMismatchError(RuntimeError):
+    """The runtime verifier's collective-matching check failed: two
+    ranks of the same communicator entered collectives with divergent
+    signatures — different collective order, mismatched roots,
+    mismatched reduce ops, mismatched payload geometry, or divergent
+    vector counts (the truncating-recv case).  Carries both ranks,
+    both signatures, and both call sites; raised on EVERY rank of the
+    communicator (each sees the full signature ring), so no rank is
+    left blocked inside the mismatched collective."""
+
+    def __init__(self, msg: str, ranks=(), signatures=(), sites=()):
+        super().__init__(msg)
+        self.ranks = tuple(ranks)
+        self.signatures = tuple(signatures)
+        self.sites = tuple(sites)
+
+
 class _FatalHandler:
     def __repr__(self) -> str:  # pragma: no cover - cosmetic
         return "ERRORS_ARE_FATAL"
@@ -192,6 +226,10 @@ def error_class(exc: Any) -> int:
         return MPI_ERR_PROC_FAILED
     if isinstance(exc, RevokedError):
         return MPI_ERR_REVOKED
+    if isinstance(exc, DeadlockError):
+        return MPI_ERR_PENDING  # operations pending forever: the closest class
+    if isinstance(exc, CollectiveMismatchError):
+        return MPI_ERR_OTHER
     from .transport.base import RecvTimeout  # local import: no cycle at load
 
     if isinstance(exc, RecvTimeout):

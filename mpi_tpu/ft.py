@@ -74,9 +74,12 @@ _DETECT_TIMEOUT_S = 5.0
 # How often each rank publishes its own heartbeat (and scans peers').
 # mpit cvar: fault_heartbeat_interval_s.
 _HEARTBEAT_S = 0.25
-# Slice length of fault-tolerant blocking waits: the latency between a
-# detector hit (or an arriving revocation) and the blocked wait noticing.
-_POLL_S = 0.05
+# Slice length of fault-tolerant (and runtime-verified — the verifier
+# reuses this slice-poll plumbing, communicator._sliced_wait) blocking
+# waits: the latency between a detector hit, an arriving revocation, or
+# a publishable stall and the blocked wait noticing.
+POLL_S = 0.05
+_POLL_S = POLL_S  # historical name, kept for in-tree references
 
 
 class MemoryLiveness:

@@ -212,11 +212,17 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     parser.add_argument("--restarts", type=int, default=0,
                         help="relaunch the world up to N times after a "
                              "crash/hang (resume from checkpoints)")
+    parser.add_argument("--verify", action="store_true",
+                        help="enable the runtime correctness verifier "
+                             "(MPI_TPU_VERIFY=1 on every rank): deadlock "
+                             "detection, collective-matching signatures, "
+                             "request lints — see mpi_tpu/verify")
     parser.add_argument("script", help="python script to run on every rank")
     parser.add_argument("script_args", nargs=argparse.REMAINDER,
                         help="arguments passed to the script")
     args = parser.parse_args(argv)
     return launch(args.nranks, [args.script, *args.script_args],
+                  env_extra={"MPI_TPU_VERIFY": "1"} if args.verify else None,
                   timeout=args.timeout, backend=args.backend,
                   restarts=args.restarts)
 

@@ -40,7 +40,9 @@ class _Counters:
                  "pallas_fallbacks", "bytes_raw", "bytes_pickled", "copies",
                  "proc_failed", "revokes", "shrinks",
                  "faulty_dropped", "faulty_duplicated", "attention_oob",
-                 "sm_hits", "sm_bytes", "sm_fallbacks")
+                 "sm_hits", "sm_bytes", "sm_fallbacks",
+                 "v_deadlocks", "v_mismatches", "v_leaked", "v_double_waits",
+                 "v_buf_overlaps", "v_comms_unfreed")
 
     def __init__(self) -> None:
         self.sends = 0
@@ -60,6 +62,12 @@ class _Counters:
         self.sm_hits = 0
         self.sm_bytes = 0
         self.sm_fallbacks = 0
+        self.v_deadlocks = 0
+        self.v_mismatches = 0
+        self.v_leaked = 0
+        self.v_double_waits = 0
+        self.v_buf_overlaps = 0
+        self.v_comms_unfreed = 0
 
 
 counters = _Counters()  # incremented by communicator.py / codec.py (count())
@@ -71,7 +79,11 @@ def count(sends: int = 0, send_bytes: int = 0, recvs: int = 0,
           proc_failed: int = 0, revokes: int = 0, shrinks: int = 0,
           faulty_dropped: int = 0, faulty_duplicated: int = 0,
           attention_oob: int = 0, coll_sm_hits: int = 0,
-          coll_sm_bytes: int = 0, coll_sm_fallbacks: int = 0) -> None:
+          coll_sm_bytes: int = 0, coll_sm_fallbacks: int = 0,
+          verify_deadlocks: int = 0, verify_mismatches: int = 0,
+          verify_requests_leaked: int = 0, verify_double_waits: int = 0,
+          verify_buffer_overlaps: int = 0,
+          verify_comms_unfreed: int = 0) -> None:
     """Thread-safe increment (rank-threads of the local backend share
     this process's counters; unsynchronized += would lose updates)."""
     with _lock:
@@ -92,6 +104,12 @@ def count(sends: int = 0, send_bytes: int = 0, recvs: int = 0,
         counters.sm_hits += coll_sm_hits
         counters.sm_bytes += coll_sm_bytes
         counters.sm_fallbacks += coll_sm_fallbacks
+        counters.v_deadlocks += verify_deadlocks
+        counters.v_mismatches += verify_mismatches
+        counters.v_leaked += verify_requests_leaked
+        counters.v_double_waits += verify_double_waits
+        counters.v_buf_overlaps += verify_buffer_overlaps
+        counters.v_comms_unfreed += verify_comms_unfreed
 
 _PVARS: Dict[str, Callable[[], int]] = {
     "msgs_sent": lambda: counters.sends,
@@ -137,6 +155,18 @@ _PVARS: Dict[str, Callable[[], int]] = {
     "coll_sm_hits": lambda: counters.sm_hits,
     "coll_sm_bytes": lambda: counters.sm_bytes,
     "coll_sm_fallbacks": lambda: counters.sm_fallbacks,
+    # runtime correctness verifier (mpi_tpu/verify): deadlocks proven
+    # (DeadlockError raised instead of a hang), collective-signature
+    # mismatches (CollectiveMismatchError), and the finalize-report
+    # lints — requests leaked (GC'd/finalized unwaited), second wait()
+    # on a completed request, overlapping live nonblocking buffers (the
+    # message-race case), and split/dup comms never freed.
+    "verify_deadlocks_detected": lambda: counters.v_deadlocks,
+    "verify_collective_mismatches": lambda: counters.v_mismatches,
+    "verify_requests_leaked": lambda: counters.v_leaked,
+    "verify_double_waits": lambda: counters.v_double_waits,
+    "verify_buffer_overlaps": lambda: counters.v_buf_overlaps,
+    "verify_comms_unfreed": lambda: counters.v_comms_unfreed,
 }
 
 
@@ -223,6 +253,7 @@ def _ensure_builtin_cvars() -> None:
     from . import ft as _ft
     from . import io as _io
     from .transport import shm as _shm
+    from .verify import state as _vstate
 
     def _set_sm_arena(v):
         if int(v) < 0:
@@ -287,6 +318,11 @@ def _ensure_builtin_cvars() -> None:
             raise ValueError("fault_heartbeat_interval_s must be > 0")
         _ft._HEARTBEAT_S = float(v)
 
+    def _set_verify_stall(v):
+        if float(v) <= 0:
+            raise ValueError("verify_stall_timeout_s must be > 0")
+        _vstate._STALL_TIMEOUT_S = float(v)
+
     with _lock:
         if _builtin_done:
             return
@@ -344,6 +380,14 @@ def _ensure_builtin_cvars() -> None:
             "how often each fault-tolerant rank publishes its heartbeat "
             "and scans its peers' (mpi_tpu/ft.py); keep well below "
             "fault_detect_timeout_s.  Read at ft.enable() time")
+        _CVARS["verify_stall_timeout_s"] = (
+            lambda: _vstate._STALL_TIMEOUT_S, _set_verify_stall,
+            "runtime-verifier stall bound (mpi_tpu/verify): a verified "
+            "blocking wait (or nonblocking polling loop) stuck this long "
+            "publishes its pending op out-of-band and runs the wait-for "
+            "deadlock analysis — a proven cross-rank cycle/knot raises "
+            "DeadlockError naming every rank, its pending op, and its "
+            "call site.  Read at verify.enable() time")
         _CVARS["coll_sm_arena_bytes"] = (
             lambda: _sm._ARENA_BYTES, _set_sm_arena,
             "size of the per-communicator shared-memory collective arena "

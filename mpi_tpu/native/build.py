@@ -4,6 +4,14 @@ Many rank processes may import concurrently (the launcher spawns them in a
 burst), so the compile is serialized with an exclusive flock and lands via
 atomic rename; losers of the race find the finished .so.  The .so is cached
 next to the source and rebuilt whenever shmring.cpp is newer.
+
+Sanitizer builds: ``MPI_TPU_SANITIZE=address|undefined|thread`` adds the
+matching ``-fsanitize=`` flags and caches the result under a
+mode-specific name (``_shmring.asan.so`` etc.) so sanitized and plain
+builds never overwrite each other.  Loading an ASan build into an
+un-instrumented python usually needs ``LD_PRELOAD=$(gcc
+-print-file-name=libasan.so)`` — see tests/test_sanitize_native.py for
+the working recipe.
 """
 
 from __future__ import annotations
@@ -18,11 +26,37 @@ _DIR = os.path.dirname(os.path.abspath(__file__))
 _SRC = os.path.join(_DIR, "shmring.cpp")
 _SO = os.path.join(_DIR, "_shmring.so")
 
+# sanitizer mode -> (cache-name infix, -fsanitize= flag list); the env
+# knob is read per build so one process builds exactly one mode
+_SANITIZERS = {
+    "address": ("asan", ["-fsanitize=address", "-fno-omit-frame-pointer",
+                         "-g"]),
+    "undefined": ("ubsan", ["-fsanitize=undefined",
+                            "-fno-sanitize-recover=undefined", "-g"]),
+    "thread": ("tsan", ["-fsanitize=thread", "-g"]),
+}
+
 _lib = None
 
 
 class NativeBuildError(RuntimeError):
     pass
+
+
+def sanitize_mode() -> str:
+    """The MPI_TPU_SANITIZE env knob, validated ('' = plain build)."""
+    mode = os.environ.get("MPI_TPU_SANITIZE", "").strip()
+    if mode and mode not in _SANITIZERS:
+        raise NativeBuildError(
+            f"unknown MPI_TPU_SANITIZE={mode!r}; accepted: "
+            f"{sorted(_SANITIZERS)} (or unset for a plain build)")
+    return mode
+
+
+def _so_path(mode: str) -> str:
+    if not mode:
+        return _SO
+    return os.path.join(_DIR, f"_shmring.{_SANITIZERS[mode][0]}.so")
 
 
 def ensure_built(force: bool = False) -> str:
@@ -31,22 +65,25 @@ def ensure_built(force: bool = False) -> str:
     ``force`` rebuilds even when the cached .so looks fresh — the recovery
     path for a .so carried over from a host with a different glibc layout
     (dlopen fails with an unresolved symbol; see load_shmring)."""
-    if (not force and os.path.exists(_SO)
-            and os.path.getmtime(_SO) >= os.path.getmtime(_SRC)):
-        return _SO
+    mode = sanitize_mode()
+    so = _so_path(mode)
+    if (not force and os.path.exists(so)
+            and os.path.getmtime(so) >= os.path.getmtime(_SRC)):
+        return so
     lock_path = os.path.join(_DIR, ".build.lock")
     with open(lock_path, "w") as lock:
         fcntl.flock(lock, fcntl.LOCK_EX)
         try:
-            if (not force and os.path.exists(_SO)
-                    and os.path.getmtime(_SO) >= os.path.getmtime(_SRC)):
-                return _SO  # another process built it while we waited
+            if (not force and os.path.exists(so)
+                    and os.path.getmtime(so) >= os.path.getmtime(_SRC)):
+                return so  # another process built it while we waited
             fd, tmp = tempfile.mkstemp(suffix=".so", dir=_DIR)
             os.close(fd)
             # -lrt: shm_open/shm_unlink live in librt on pre-2.34 glibc
             # (a stub librt still exists on newer ones, so the flag is
             # portable both ways)
             cmd = ["g++", "-O3", "-std=c++17", "-shared", "-fPIC",
+                   *(_SANITIZERS[mode][1] if mode else []),
                    "-o", tmp, _SRC, "-pthread", "-lrt"]
             try:
                 proc = subprocess.run(cmd, capture_output=True, text=True)
@@ -58,9 +95,11 @@ def ensure_built(force: bool = False) -> str:
             if proc.returncode != 0:
                 os.unlink(tmp)
                 raise NativeBuildError(
-                    f"shmring.cpp failed to compile:\n{proc.stderr[-2000:]}")
-            os.replace(tmp, _SO)
-            return _SO
+                    f"shmring.cpp failed to compile"
+                    f"{f' (MPI_TPU_SANITIZE={mode})' if mode else ''}:\n"
+                    f"{proc.stderr[-2000:]}")
+            os.replace(tmp, so)
+            return so
         finally:
             fcntl.flock(lock, fcntl.LOCK_UN)
 

@@ -77,12 +77,20 @@ def verify_run(
     kwargs: Optional[Dict] = None,
     timeout: float = 120.0,
     strict_fifo: bool = True,
+    runtime_verify: bool = False,
 ) -> Tuple[List[Any], List[str]]:
     """Run ``fn(comm, *args)`` on the thread backend with full comm tracing;
     return (per-rank results, problems).  ``problems`` is empty iff every
     send was received, every recv was satisfied by a real send, and (with
     ``strict_fifo``, the default) no recv matched a send behind the head
-    of its channel — see checker.verify_matching."""
+    of its channel — see checker.verify_matching.
+
+    ``runtime_verify=True`` additionally runs the MUST-style runtime
+    verifier (mpi_tpu/verify) during the traced run — deadlocks raise
+    DeadlockError, divergent collectives CollectiveMismatchError — and
+    appends its lint report (leaked requests, buffer overlaps, ...) to
+    ``problems``: one call covering both the post-hoc matching check and
+    the online checks."""
     from .transport.local import run_local
 
     traces: List[Optional[TracingTransport]] = [None] * nranks
@@ -95,6 +103,11 @@ def verify_run(
         return tt
 
     results = run_local(fn, nranks, args=args, kwargs=kwargs, timeout=timeout,
-                        transport_wrapper=wrapper)
+                        transport_wrapper=wrapper, verify=runtime_verify)
     logs = [t.as_match_log() if t else [] for t in traces]
-    return results, checker.verify_matching(logs, strict_fifo=strict_fifo)
+    problems = checker.verify_matching(logs, strict_fifo=strict_fifo)
+    if runtime_verify:
+        from .verify import finalize_report
+
+        problems += finalize_report()
+    return results, problems

@@ -52,10 +52,16 @@ class Mailbox:
         self._cv = threading.Condition(self._lock)
         self._items: List[Tuple[int, int, int, Any]] = []
         self._closed = False
+        # lifetime delivery count: the runtime verifier's cheap progress
+        # stamp (mpi_tpu/verify/deadlock.py) — a "blocked" rank whose
+        # mailbox keeps receiving is matching-starved, not deadlocked,
+        # and the confirm pass uses the stamp to tell the two apart
+        self.deliveries = 0
 
     def deliver(self, src: int, ctx: int, tag: int, payload: Any) -> None:
         with self._cv:
             self._items.append((src, ctx, tag, payload))
+            self.deliveries += 1
             self._cv.notify_all()
 
     def close(self) -> None:

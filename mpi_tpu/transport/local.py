@@ -60,6 +60,7 @@ def run_local(
     transport_wrapper: Optional[Callable[[Transport], Transport]] = None,
     recv_timeout: Optional[float] = None,
     fault_tolerance: bool = False,
+    verify: bool = False,
 ) -> List[Any]:
     """Run ``fn(comm, *args, **kwargs)`` on ``nranks`` in-process ranks;
     return the per-rank results as a list indexed by rank.
@@ -75,6 +76,14 @@ def run_local(
     they would against a dead process.  A rank whose ``fn`` returns stops
     heartbeating, so long-running survivors eventually see it as failed —
     keep the detection timeout above the straggler spread.
+
+    ``verify=True`` enables the runtime correctness verifier
+    (mpi_tpu/verify) on every rank over one shared in-memory pending-op
+    board: deadlocks raise DeadlockError instead of hanging, divergent
+    collectives raise CollectiveMismatchError, and the request/buffer
+    lints land in ``mpi_tpu.verify.take_report()`` + ``verify_*`` pvars.
+    A rank whose ``fn`` returns publishes 'exited', so a peer blocked on
+    it is diagnosed rather than stuck until the run_local timeout.
     """
     from ..communicator import P2PCommunicator
 
@@ -88,9 +97,15 @@ def run_local(
         from .. import ft as _ft
 
         liveness = _ft.MemoryLiveness(nranks)
+    board = None
+    if verify:
+        from ..verify import MemoryBoard
+
+        board = MemoryBoard(nranks)
 
     def runner(r: int) -> None:
         ft_state = None
+        v_state = None
         try:
             t: Transport = LocalTransport(world, r)
             if transport_wrapper is not None:
@@ -100,7 +115,13 @@ def run_local(
                 from .. import ft as _ft
 
                 ft_state = _ft.enable(comm, liveness=liveness)._ft
+            if board is not None:
+                from .. import verify as _verify
+
+                v_state = _verify.enable(comm, board=board)._verify
             results[r] = fn(comm, *args, **kwargs)
+            if v_state is not None:
+                v_state.world.mark_exited()
         except BaseException as e:  # noqa: BLE001 - propagated to caller below
             from .faulty import KilledRankError
 

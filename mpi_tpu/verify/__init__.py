@@ -1,0 +1,98 @@
+"""MUST-style runtime correctness verifier + static MPI linter.
+
+The correctness-tooling layer of SURVEY.md §5: the framework's failure
+modes are hangs (mismatched blocking cycles), silently divergent
+collective schedules, and leaked/raced nonblocking requests — exactly
+the bug classes MUST-class MPI verifiers and message-race detectors
+catch.  This package grows the repo's seed (mpi_tpu/checker.py schedule
+validation + mpi_tpu/trace.py matching verification, both re-exported
+here) into a real subsystem:
+
+* **Deadlock detection** (:mod:`.deadlock`): every verified blocking
+  wait runs in slices (the FT slice-poll plumbing); past
+  ``verify_stall_timeout_s`` the rank publishes its pending op
+  out-of-band and the AND-OR wait-for analysis
+  (:func:`mpi_tpu.checker.find_deadlock`) turns a closed blocking
+  picture into :class:`~mpi_tpu.errors.DeadlockError` naming every
+  rank, its pending op, and its call site — instead of a hang.
+* **Collective matching** (:mod:`.collcheck`): per-entry signatures
+  (sequence, name, root, reduce op, geometry class, algorithm, vector
+  counts) cross-checked in-band on the reserved TAG_VERIFY ring before
+  any data moves; divergence raises
+  :class:`~mpi_tpu.errors.CollectiveMismatchError` on every rank.
+* **Request/resource lints** (:mod:`.state`): leaked requests
+  (GC'd/finalized unwaited), double-wait, overlapping live buffers
+  across pending nonblocking ops (the message-race case), and unfreed
+  communicators — reported through ``verify_*`` pvars and the
+  finalize-time report (:func:`take_report` / :func:`finalize_report`).
+* **Static lint** (:mod:`.lint` + ``tools/mpilint.py``): an AST pass
+  flagging rank-conditional collectives, send-send cycles between
+  literal rank pairs, literal count truncation, and operations on
+  possibly-revoked comms without an error handler.
+
+Enable with ``MPI_TPU_VERIFY=1`` under the launcher (or
+``python -m mpi_tpu.launcher --verify``), ``run_local(...,
+verify=True)``, or :func:`enable` on any P2P communicator.  Off (the
+default) the entire subsystem is a single ``is None`` attribute test
+per operation — the zero-copy hot path's pvar contracts and bench p50s
+are untouched (``bench.py --verify-overhead`` proves it).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+from ..checker import ScheduleError, find_deadlock, validate_perm, \
+    validate_rounds, verify_matching
+from ..errors import CollectiveMismatchError, DeadlockError
+from ..trace import TracingTransport, verify_run
+from . import state as _state
+from .collcheck import TAG_VERIFY
+from .lint import Finding, lint_file, lint_paths, lint_source
+from .state import (CommVerify, FileBoard, MemoryBoard, WorldVerify,
+                    finalize_report, peek_report, take_report, user_site)
+
+__all__ = [
+    "enable", "is_enabled", "take_report", "peek_report", "finalize_report",
+    "user_site",
+    "MemoryBoard", "FileBoard", "WorldVerify", "CommVerify",
+    "DeadlockError", "CollectiveMismatchError", "TAG_VERIFY",
+    "Finding", "lint_source", "lint_file", "lint_paths",
+    # the folded-in seed: schedule checking + trace-based matching
+    "ScheduleError", "validate_perm", "validate_rounds", "verify_matching",
+    "find_deadlock", "verify_run", "TracingTransport",
+]
+
+
+def is_enabled(comm) -> bool:
+    return getattr(comm, "_verify", None) is not None
+
+
+def enable(comm, board=None, rdv_dir: Optional[str] = None,
+           stall_timeout_s: Optional[float] = None):
+    """Enable the runtime verifier on a P2P communicator (idempotent per
+    transport; split/dup children inherit).  Process worlds default to
+    ``pending.<rank>`` files under the rendezvous dir (``rdv_dir`` or
+    the launcher's MPI_TPU_RDV); in-process worlds pass the shared
+    :class:`MemoryBoard` (``run_local(..., verify=True)`` does this for
+    you)."""
+    if getattr(comm, "_verify", None) is not None:
+        return comm
+    world = getattr(comm._t, "_verify_world", None)
+    if world is None:
+        if board is None:
+            rdv = rdv_dir or os.environ.get("MPI_TPU_RDV")
+            if rdv is None:
+                raise ValueError(
+                    "the verifier needs an out-of-band board: pass board= "
+                    "(in-process worlds) or rdv_dir= / set MPI_TPU_RDV "
+                    "(process worlds)")
+            board = FileBoard(rdv, comm._t.world_rank, comm._t.world_size)
+        world = WorldVerify(
+            comm._t, board,
+            _state._STALL_TIMEOUT_S if stall_timeout_s is None
+            else stall_timeout_s)
+        comm._t._verify_world = world
+    comm._verify = CommVerify(world)
+    return comm

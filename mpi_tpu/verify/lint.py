@@ -1,0 +1,369 @@
+"""Static MPI lint (MPI-Checker style): an AST pass over user programs.
+
+Four checks, deliberately literal-only (no dataflow guessing — every
+finding is a pattern a reviewer can confirm by reading the flagged
+lines; suppress a deliberate one with ``# mpilint: ok`` on the flagged
+line or the line above):
+
+* **MPL001 — rank-conditional collective**: a collective call on ``c``
+  inside an ``if`` whose condition tests ``c.rank``, with no matching
+  call of the same collective on ``c`` in the other branch.  Collective
+  schedules must be entered by every rank; a rank-conditional entry is
+  the divergent-order hang the runtime matcher catches dynamically.
+* **MPL002 — send-send cycle**: literal rank-pair branches (``if c.rank
+  == A: ... elif c.rank == B: ...``) where BOTH ranks blocking-send to
+  each other before either receives — legal under this library's
+  buffered sends, but a deadlock under MPI's synchronous/rendezvous
+  sends and any bounded-buffer transport; use ``sendrecv``.
+* **MPL003 — literal count truncation**: a typed ``MPI_Send(...,
+  count=N)`` to literal rank B paired with B's ``MPI_Recv(...,
+  count=M)`` from the sender with ``M < N`` — the receive silently
+  truncates.
+* **MPL004 — revoked comm without an error handler**: a p2p/collective
+  call on a comm after ``c.revoke()`` appears, with no
+  ``set_errhandler`` on it and outside any ``try``: every post-revoke
+  call raises RevokedError, so unhandled it just moves the crash.
+
+``lint_source``/``lint_paths`` return :class:`Finding` lists; the CLI is
+``tools/mpilint.py`` (wired into ``tools/check.sh`` over ``examples/``
+and ``mpi_tpu/``).
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import Dict, List, NamedTuple, Optional, Sequence, Tuple
+
+COLLECTIVES = frozenset({
+    "bcast", "reduce", "allreduce", "allgather", "allgatherv", "alltoall",
+    "alltoallv", "barrier", "scan", "exscan", "reduce_scatter", "scatter",
+    "scatterv", "gather", "gatherv", "maxloc", "minloc",
+})
+_P2P_OR_COLL = COLLECTIVES | frozenset({
+    "send", "recv", "sendrecv", "isend", "irecv", "probe", "iprobe",
+    "shift", "exchange", "split", "dup",
+})
+
+
+class Finding(NamedTuple):
+    file: str
+    line: int
+    code: str
+    msg: str
+
+    def render(self) -> str:
+        return f"{self.file}:{self.line}: {self.code} {self.msg}"
+
+
+def _method_call(node: ast.AST) -> Optional[Tuple[str, str, ast.Call]]:
+    """(receiver-name, method, call) for ``name.method(...)`` nodes."""
+    if (isinstance(node, ast.Call) and isinstance(node.func, ast.Attribute)
+            and isinstance(node.func.value, ast.Name)):
+        return node.func.value.id, node.func.attr, node
+    return None
+
+
+def _rank_cond_name(test: ast.AST) -> Optional[str]:
+    """Receiver name when the expression mentions ``<name>.rank``."""
+    for n in ast.walk(test):
+        if (isinstance(n, ast.Attribute) and n.attr == "rank"
+                and isinstance(n.value, ast.Name)):
+            return n.value.id
+    return None
+
+
+def _rank_eq_literal(test: ast.AST) -> Optional[Tuple[str, int]]:
+    """(name, K) for a test of the exact form ``name.rank == K``."""
+    if not (isinstance(test, ast.Compare) and len(test.ops) == 1
+            and isinstance(test.ops[0], ast.Eq)):
+        return None
+    sides = [test.left, test.comparators[0]]
+    name = lit = None
+    for s in sides:
+        if (isinstance(s, ast.Attribute) and s.attr == "rank"
+                and isinstance(s.value, ast.Name)):
+            name = s.value.id
+        elif isinstance(s, ast.Constant) and isinstance(s.value, int):
+            lit = s.value
+    return (name, lit) if name is not None and lit is not None else None
+
+
+def _int_arg(call: ast.Call, kw: str, pos: Optional[int]) -> Optional[int]:
+    for k in call.keywords:
+        if k.arg == kw and isinstance(k.value, ast.Constant) \
+                and isinstance(k.value.value, int):
+            return k.value.value
+    if pos is not None and len(call.args) > pos:
+        a = call.args[pos]
+        if isinstance(a, ast.Constant) and isinstance(a.value, int):
+            return a.value
+    return None
+
+
+def _calls_in(nodes: Sequence[ast.AST], *, into_defs: bool = False):
+    """Every Call in the given statement subtrees, skipping nested
+    function/class bodies unless asked (their execution time is
+    unrelated to the enclosing branch)."""
+    stack = list(nodes)
+    while stack:
+        n = stack.pop()
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                          ast.ClassDef)) and not into_defs:
+            continue
+        if isinstance(n, ast.Call):
+            yield n
+        stack.extend(ast.iter_child_nodes(n))
+
+
+def _suppressed(src: str) -> set:
+    out = set()
+    for i, line in enumerate(src.splitlines(), 1):
+        if "mpilint: ok" in line:
+            out.add(i)
+            out.add(i + 1)
+    return out
+
+
+def lint_source(src: str, filename: str = "<string>") -> List[Finding]:
+    try:
+        tree = ast.parse(src, filename)
+    except SyntaxError as e:
+        return [Finding(filename, e.lineno or 0, "MPL000",
+                        f"syntax error: {e.msg}")]
+    findings: List[Finding] = []
+    findings += _check_rank_conditional_collectives(tree, filename)
+    for scope in _scopes(tree):
+        branches = _rank_literal_branches(scope)
+        findings += _check_send_send_cycles(branches, filename)
+        findings += _check_count_truncation(branches, filename)
+    findings += _check_revoked_unhandled(tree, filename)
+    sup = _suppressed(src)
+    return sorted((f for f in findings if f.line not in sup),
+                  key=lambda f: (f.line, f.code))
+
+
+def _scopes(tree: ast.Module):
+    yield tree
+    for n in ast.walk(tree):
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            yield n
+
+
+# -- MPL001 ------------------------------------------------------------------
+
+def _branch_collectives(nodes: Sequence[ast.AST]) -> Dict[Tuple[str, str],
+                                                          int]:
+    out: Dict[Tuple[str, str], int] = {}
+    for call in _calls_in(nodes):
+        mc = _method_call(call)
+        if mc and mc[1] in COLLECTIVES:
+            out.setdefault((mc[0], mc[1]), call.lineno)
+    return out
+
+
+def _check_rank_conditional_collectives(tree, filename) -> List[Finding]:
+    findings = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.If):
+            continue
+        comm = _rank_cond_name(node.test)
+        if comm is None:
+            continue
+        body = _branch_collectives(node.body)
+        other = _branch_collectives(node.orelse)
+        for (recv_name, meth), line in sorted(body.items(),
+                                              key=lambda kv: kv[1]):
+            if recv_name == comm and (recv_name, meth) not in other:
+                findings.append(Finding(
+                    filename, line, "MPL001",
+                    f"collective {recv_name}.{meth}() is conditional on "
+                    f"{comm}.rank with no matching {meth}() in the other "
+                    f"branch — non-calling ranks diverge from the "
+                    f"collective schedule (hang/mismatch)"))
+        for (recv_name, meth), line in sorted(other.items(),
+                                              key=lambda kv: kv[1]):
+            if recv_name == comm and (recv_name, meth) not in body:
+                findings.append(Finding(
+                    filename, line, "MPL001",
+                    f"collective {recv_name}.{meth}() runs only when the "
+                    f"{comm}.rank test is false, with no matching "
+                    f"{meth}() in the taken branch — ranks diverge from "
+                    f"the collective schedule (hang/mismatch)"))
+    return findings
+
+
+# -- rank-literal branch collection (MPL002/003) -----------------------------
+
+class _Op(NamedTuple):
+    kind: str        # 'send' | 'recv'
+    peer: Optional[int]
+    count: Optional[int]
+    line: int
+
+
+def _branch_ops(comm: str, nodes: Sequence[ast.AST]) -> List[_Op]:
+    ops = []
+    for call in _calls_in(nodes):
+        mc = _method_call(call)
+        if mc and mc[0] == comm:
+            _, meth, c = mc
+            if meth == "send":
+                ops.append(_Op("send", _int_arg(c, "dest", 1), None,
+                               c.lineno))
+            elif meth == "recv":
+                ops.append(_Op("recv", _int_arg(c, "source", 0), None,
+                               c.lineno))
+        elif isinstance(call.func, ast.Name):
+            if call.func.id == "MPI_Send":
+                ops.append(_Op("send", _int_arg(call, "dest", 1),
+                               _int_arg(call, "count", None), call.lineno))
+            elif call.func.id == "MPI_Recv":
+                ops.append(_Op("recv", _int_arg(call, "source", 0),
+                               _int_arg(call, "count", None), call.lineno))
+    return sorted(ops, key=lambda o: o.line)
+
+
+def _rank_literal_branches(scope) -> Dict[Tuple[str, int], List[_Op]]:
+    """rank-literal branch bodies of one scope: (comm, K) -> ordered
+    send/recv ops, merged across every ``if comm.rank == K`` in it."""
+    branches: Dict[Tuple[str, int], List[_Op]] = {}
+    stack = list(ast.iter_child_nodes(scope))
+    while stack:
+        n = stack.pop()
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                          ast.ClassDef)) and n is not scope:
+            continue
+        if isinstance(n, ast.If):
+            hit = _rank_eq_literal(n.test)
+            if hit is not None:
+                comm, k = hit
+                branches.setdefault((comm, k), []).extend(
+                    _branch_ops(comm, n.body))
+        stack.extend(ast.iter_child_nodes(n))
+    for ops in branches.values():
+        ops.sort(key=lambda o: o.line)
+    return branches
+
+
+# -- MPL002 ------------------------------------------------------------------
+
+def _first_line(ops: List[_Op], kind: str, peer: int) -> Optional[int]:
+    for o in ops:
+        if o.kind == kind and o.peer == peer:
+            return o.line
+    return None
+
+
+def _check_send_send_cycles(branches, filename) -> List[Finding]:
+    findings = []
+    seen = set()
+    for (comm, a), ops_a in branches.items():
+        for (comm_b, b), ops_b in branches.items():
+            if comm_b != comm or b <= a or (comm, a, b) in seen:
+                continue
+            sa, ra = _first_line(ops_a, "send", b), _first_line(ops_a, "recv", b)
+            sb, rb = _first_line(ops_b, "send", a), _first_line(ops_b, "recv", a)
+            if None in (sa, ra, sb, rb):
+                continue
+            if sa < ra and sb < rb:
+                seen.add((comm, a, b))
+                findings.append(Finding(
+                    filename, sa, "MPL002",
+                    f"send-send cycle: rank {a} sends to {b} (line {sa}) "
+                    f"before receiving from it (line {ra}) while rank {b} "
+                    f"sends to {a} (line {sb}) before receiving (line "
+                    f"{rb}) — deadlocks under synchronous/rendezvous "
+                    f"sends; use {comm}.sendrecv()"))
+    return findings
+
+
+# -- MPL003 ------------------------------------------------------------------
+
+def _check_count_truncation(branches, filename) -> List[Finding]:
+    findings = []
+    for (comm, a), ops_a in branches.items():
+        for (comm_b, b), ops_b in branches.items():
+            if comm_b != comm:
+                continue
+            sends = [o for o in ops_a if o.kind == "send" and o.peer == b
+                     and o.count is not None]
+            recvs = [o for o in ops_b if o.kind == "recv"
+                     and o.peer in (a, None) and o.count is not None]
+            for s, r in zip(sends, recvs):
+                if r.count < s.count:
+                    findings.append(Finding(
+                        filename, r.line, "MPL003",
+                        f"recv count {r.count} < matching send count "
+                        f"{s.count} (rank {a} line {s.line} -> rank {b}): "
+                        f"the receive truncates the message"))
+    return findings
+
+
+# -- MPL004 ------------------------------------------------------------------
+
+def _check_revoked_unhandled(tree, filename) -> List[Finding]:
+    revoked: Dict[str, int] = {}
+    handled: set = set()
+    in_try: set = set()
+
+    def mark_try(node, inside):
+        inside = inside or isinstance(node, ast.Try)
+        if inside:
+            in_try.add(id(node))
+        for c in ast.iter_child_nodes(node):
+            mark_try(c, inside)
+
+    mark_try(tree, False)
+    for call in _calls_in([tree], into_defs=True):
+        mc = _method_call(call)
+        if mc is None:
+            continue
+        name, meth, _ = mc
+        if meth == "revoke":
+            revoked.setdefault(name, call.lineno)
+        elif meth == "set_errhandler":
+            handled.add(name)
+    findings = []
+    if not revoked:
+        return findings
+    flagged = set()
+    for call in _calls_in([tree], into_defs=True):
+        mc = _method_call(call)
+        if mc is None:
+            continue
+        name, meth, _ = mc
+        if (name in revoked and name not in handled and name not in flagged
+                and meth in _P2P_OR_COLL and call.lineno > revoked[name]
+                and id(call) not in in_try):
+            flagged.add(name)
+            findings.append(Finding(
+                filename, call.lineno, "MPL004",
+                f"{name}.{meth}() after {name}.revoke() (line "
+                f"{revoked[name]}) with no error handler and outside "
+                f"try: every operation on a revoked comm raises "
+                f"RevokedError — install set_errhandler or shrink() "
+                f"first"))
+    return findings
+
+
+# -- driver ------------------------------------------------------------------
+
+def lint_file(path: str) -> List[Finding]:
+    with open(path, "r", encoding="utf-8", errors="replace") as f:
+        return lint_source(f.read(), path)
+
+
+def lint_paths(paths: Sequence[str]) -> List[Finding]:
+    findings: List[Finding] = []
+    for p in paths:
+        if os.path.isdir(p):
+            for root, dirs, files in os.walk(p):
+                dirs[:] = [d for d in dirs
+                           if d not in ("__pycache__", ".git")]
+                for fn in sorted(files):
+                    if fn.endswith(".py"):
+                        findings += lint_file(os.path.join(root, fn))
+        elif p.endswith(".py"):
+            findings += lint_file(p)
+    return findings

@@ -1,0 +1,394 @@
+"""Verifier state: pending-op boards, per-rank world state, lint registry.
+
+The runtime verifier (MUST-style, SURVEY.md §5) needs one out-of-band
+channel: when a rank has been blocked past ``verify_stall_timeout_s`` it
+publishes WHAT it is blocked in (source set, AND/OR semantics, tag,
+collective, call site) and reads every peer's published entry, so the
+wait-for-graph analysis (mpi_tpu/checker.find_deadlock) can run on the
+full cross-rank picture without any rank being able to answer a message.
+Two substrates behind one Board interface, mirroring ft.py's liveness
+split:
+
+* :class:`MemoryBoard` — a shared in-process table for the local thread
+  world (``run_local(..., verify=True)`` creates one per world).
+* :class:`FileBoard` — ``pending.<rank>`` JSON files under the launcher
+  rendezvous dir for process worlds (socket/shm; ``MPI_TPU_VERIFY=1``).
+
+Everything else here is rank-local bookkeeping: the live-request set
+(leak / double-wait lints), live nonblocking buffer ranges (the
+message-race overlap lint), created-communicator registry (unfreed-comm
+lint), and the process-wide diagnostic report the finalize check and
+``take_report()`` drain.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import weakref
+from typing import Dict, List, Optional, Tuple
+
+from .. import mpit as _mpit
+
+# Default stall bound before a blocked wait publishes its pending op and
+# starts running deadlock analysis.  mpit cvar: verify_stall_timeout_s.
+_STALL_TIMEOUT_S = 5.0
+
+_PKG_DIR = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# -- the process-wide diagnostic report --------------------------------------
+
+_report_lock = threading.Lock()
+_REPORT: List[str] = []
+_WORLDS: "weakref.WeakSet" = weakref.WeakSet()
+
+
+def report_add(msg: str) -> None:
+    with _report_lock:
+        _REPORT.append(msg)
+
+
+def take_report() -> List[str]:
+    """Drain and return every diagnostic the verifier has recorded in
+    this process (lints are REPORTED, not raised — MUST-style; deadlock
+    and collective mismatch raise in addition to reporting)."""
+    with _report_lock:
+        out, _REPORT[:] = list(_REPORT), []
+    return out
+
+
+def peek_report() -> List[str]:
+    with _report_lock:
+        return list(_REPORT)
+
+
+def user_site(skip_dir: str = _PKG_DIR) -> str:
+    """file:lineno of the nearest caller OUTSIDE mpi_tpu — the call site
+    every diagnostic names.  Only ever invoked with the verifier on."""
+    import sys
+
+    try:
+        f = sys._getframe(1)
+    except ValueError:  # pragma: no cover - no frames
+        return "<unknown>"
+    while f is not None:
+        fn = f.f_code.co_filename
+        if not fn.startswith(skip_dir):
+            return f"{fn}:{f.f_lineno}"
+        f = f.f_back
+    return "<mpi_tpu internals>"
+
+
+# -- out-of-band pending-op boards -------------------------------------------
+
+
+class MemoryBoard:
+    """Shared pending-op table for one in-process world (thread ranks).
+
+    ``read_all`` attaches each entry's age since publish (``_age_s``):
+    a genuinely stalled rank refreshes its entry every analysis slice,
+    so the deadlock analysis EXPIRES un-refreshed 'blocked' entries —
+    the last-resort guard against a stale entry left behind by a rank
+    that died mid-stall (ended waits retract their entries promptly)."""
+
+    def __init__(self, size: int) -> None:
+        self._entries: List[Optional[Tuple[float, dict]]] = [None] * size
+        self._lock = threading.Lock()
+
+    def publish(self, rank: int, entry: Optional[dict]) -> None:
+        import time
+
+        with self._lock:
+            self._entries[rank] = (None if entry is None
+                                   else (time.monotonic(), entry))
+
+    def read_all(self) -> Dict[int, dict]:
+        import time
+
+        now = time.monotonic()
+        with self._lock:
+            out = {}
+            for r, slot in enumerate(self._entries):
+                if slot is None:
+                    continue
+                at, e = slot
+                d = dict(e)
+                d["_age_s"] = now - at
+                out[r] = d
+            return out
+
+
+class FileBoard:
+    """``pending.<rank>`` JSON files under the rendezvous dir.  Writes
+    are atomic (tmp + rename) so a reader never sees a torn entry; a
+    missing/corrupt file reads as 'no entry' (= running), which the
+    analysis treats as able-to-progress — crash-safe in the direction
+    that never false-positives."""
+
+    def __init__(self, rdv_dir: str, rank: int, size: int) -> None:
+        self._rdv = rdv_dir
+        self._rank = rank
+        self._size = size
+
+    def _path(self, rank: int) -> str:
+        return os.path.join(self._rdv, f"pending.{rank}")
+
+    def publish(self, rank: int, entry: Optional[dict]) -> None:
+        path = self._path(rank)
+        try:
+            if entry is None:
+                try:
+                    os.unlink(path)
+                except FileNotFoundError:
+                    pass
+                return
+            tmp = path + ".tmp"
+            with open(tmp, "w") as f:
+                json.dump(entry, f)
+            os.replace(tmp, path)
+        except OSError:
+            pass  # rendezvous dir tearing down — world is exiting
+
+    def read_all(self) -> Dict[int, dict]:
+        import time
+
+        now = time.time()
+        out: Dict[int, dict] = {}
+        for r in range(self._size):
+            path = self._path(r)
+            try:
+                with open(path) as f:
+                    entry = json.load(f)
+                # wall-clock mtime: the one cross-process-comparable
+                # stamp (monotonic clocks don't compare across ranks)
+                entry["_age_s"] = max(0.0, now - os.stat(path).st_mtime)
+                out[r] = entry
+            except (OSError, ValueError):
+                continue
+        return out
+
+
+# -- request / buffer lint bookkeeping ---------------------------------------
+
+
+class VInfo:
+    """Tracking record of one user-level nonblocking request."""
+
+    __slots__ = ("kind", "rank", "peer", "tag", "site", "wait_count",
+                 "world", "buf_key", "reported_leak", "__weakref__")
+
+    def __init__(self, world: "WorldVerify", kind: str, rank: int, peer: int,
+                 tag: int, site: str) -> None:
+        self.world = world
+        self.kind = kind
+        self.rank = rank
+        self.peer = peer
+        self.tag = tag
+        self.site = site
+        self.wait_count = 0
+        self.buf_key: Optional[int] = None
+        self.reported_leak = False
+
+    def describe(self) -> str:
+        return (f"rank {self.rank}: {self.kind}(peer={self.peer}, "
+                f"tag={self.tag}) at {self.site}")
+
+    # called from Request._vnote (communicator.py) on every wait()/test()
+    def note(self, completed: bool, blocking: bool) -> None:
+        w = self.world
+        if blocking:
+            self.wait_count += 1
+            if self.wait_count == 2 and self.kind != "persistent":
+                _mpit.count(verify_double_waits=1)
+                report_add(f"double-wait: second wait() on the same "
+                           f"request — {self.describe()}")
+        if completed:
+            w.retire_request(self)
+
+
+class WorldVerify:
+    """Per-rank verifier state (one per transport, like ft.WorldFT):
+    the shared board plus every rank-local registry the lints need."""
+
+    def __init__(self, transport, board, stall_timeout_s: float) -> None:
+        self.t = transport
+        self.board = board
+        self.stall_timeout_s = float(stall_timeout_s)
+        self.rank = transport.world_rank
+        self.size = transport.world_size
+        self._lock = threading.Lock()
+        self.ops = 0          # completed sends+recvs: the progress stamp
+        self.block_id = 0     # increments at every blocking-wait entry
+        self.published = False
+        self._last_check = 0.0
+        self._live: set = set()          # VInfos not yet completed/waited
+        # live nonblocking buffer ranges: key -> (start, end, writes, desc)
+        self._bufs: Dict[int, Tuple[int, int, bool, str]] = {}
+        self._buf_key = 0
+        # (ctx-repr, site, kind) of comms created while verifying
+        self.comms: Dict[int, Tuple[str, str, bool]] = {}
+        self._comm_key = 0
+        _WORLDS.add(self)
+
+    # -- progress / board --------------------------------------------------
+
+    def note_progress(self) -> None:
+        self.ops += 1
+        if self.published:
+            self.published = False
+            self.board.publish(self.rank, None)
+
+    def clear_published(self) -> None:
+        """Retract a published 'blocked' entry without claiming progress
+        — the exit path of a stalled wait that raised (RecvTimeout,
+        ProcFailedError, RevokedError): the rank is no longer in that
+        wait, and a lingering entry could falsely implicate it in a
+        peer's wait-for analysis until the TTL expires.  DeadlockError
+        deliberately does NOT retract: peers confirming the same
+        diagnosis need the entry stable."""
+        if self.published:
+            self.published = False
+            self.board.publish(self.rank, None)
+
+    def begin_block(self) -> int:
+        self.block_id += 1
+        return self.block_id
+
+    def mark_exited(self) -> None:
+        """Published when the rank's program returns/finalizes: a peer
+        blocked on this rank can then be diagnosed (wait-on-exited) the
+        way MUST reports 'waiting for a terminated process'."""
+        self.board.publish(self.rank, {"state": "exited", "rank": self.rank})
+
+    # -- request lints -----------------------------------------------------
+
+    def track_request(self, req, kind: str, rank: int, peer: int, tag: int,
+                      site: str) -> VInfo:
+        info = VInfo(self, kind, rank, peer, tag, site)
+        req._vinfo = info
+        with self._lock:
+            self._live.add(info)
+        # finalize objects keep themselves alive until the request dies
+        weakref.finalize(req, _request_gc, info)
+        return info
+
+    def retire_request(self, info: VInfo) -> None:
+        with self._lock:
+            self._live.discard(info)
+        self.release_buffer(info)
+
+    # -- buffer overlap lint (the message-race case) -----------------------
+
+    def buffer_live(self, arr, desc: str, writes: bool) -> Optional[int]:
+        """Register a buffer as live under a pending nonblocking op;
+        returns the release key.  Overlap with another live range where
+        either side WRITES is the message race MUST flags."""
+        try:
+            start = int(arr.__array_interface__["data"][0])
+            nbytes = int(arr.nbytes)
+        except (AttributeError, KeyError, TypeError):
+            return None  # not a buffer-backed payload: nothing to race on
+        end = start + nbytes
+        with self._lock:
+            for (s, e, w, d) in self._bufs.values():
+                if s < end and start < e and (w or writes):
+                    _mpit.count(verify_buffer_overlaps=1)
+                    report_add(
+                        f"overlapping live buffers across pending "
+                        f"nonblocking ops (message race): {desc} overlaps "
+                        f"{d} (bytes [{max(s, start)}, {min(e, end)}))")
+                    break
+            self._buf_key += 1
+            self._bufs[self._buf_key] = (start, end, writes, desc)
+            return self._buf_key
+
+    def buffer_release(self, key: Optional[int]) -> None:
+        if key is None:
+            return
+        with self._lock:
+            self._bufs.pop(key, None)
+
+    def track_buffer(self, info: VInfo, arr, desc: str, writes: bool) -> None:
+        info.buf_key = self.buffer_live(arr, desc, writes)
+
+    def release_buffer(self, info: VInfo) -> None:
+        self.buffer_release(info.buf_key)
+        info.buf_key = None
+
+    # -- unfreed-communicator lint ----------------------------------------
+
+    def track_comm(self, comm, how: str, site: str) -> int:
+        with self._lock:
+            self._comm_key += 1
+            key = self._comm_key
+            self.comms[key] = (repr(comm._ctx), site, how)
+        return key
+
+    def free_comm(self, key: int) -> None:
+        with self._lock:
+            self.comms.pop(key, None)
+
+    # -- finalize sweep ----------------------------------------------------
+
+    def finalize_sweep(self) -> None:
+        """Fold every still-pending lint into the report: live requests
+        never waited, communicators never freed.  Each finding is
+        reported ONCE (the registries drain), so repeated sweeps — one
+        per test, say — never re-report old findings."""
+        with self._lock:
+            live = list(self._live)
+            self._live.clear()
+            comms = list(self.comms.values())
+            self.comms.clear()
+        for info in live:
+            if info.wait_count == 0 and not info.reported_leak:
+                info.reported_leak = True
+                _mpit.count(verify_requests_leaked=1)
+                report_add(f"leaked request (never waited/tested): "
+                           f"{info.describe()}")
+        for ctx, site, how in comms:
+            _mpit.count(verify_comms_unfreed=1)
+            report_add(f"rank {self.rank}: communicator from {how}() at "
+                       f"{site} (ctx={ctx}) never freed before finalize")
+
+
+class CommVerify:
+    """Per-communicator verifier state: the shared WorldVerify plus this
+    communicator's collective sequence counter (the matching check's
+    ordering evidence) and, for split/dup children, the unfreed-comm
+    registry key."""
+
+    __slots__ = ("world", "_seq", "_seq_lock", "comm_key")
+
+    def __init__(self, world: WorldVerify) -> None:
+        self.world = world
+        self._seq = 0
+        self._seq_lock = threading.Lock()
+        self.comm_key: Optional[int] = None
+
+    def next_seq(self) -> int:
+        with self._seq_lock:
+            self._seq += 1
+            return self._seq
+
+
+def _request_gc(info: VInfo) -> None:
+    """weakref.finalize callback: the request object was garbage
+    collected.  An unwaited request at GC is the leak MUST flags —
+    isend/irecv whose completion nobody ever observed."""
+    if info.wait_count == 0 and not info.reported_leak:
+        info.reported_leak = True
+        _mpit.count(verify_requests_leaked=1)
+        report_add(f"leaked request (garbage-collected without wait/test): "
+                   f"{info.describe()}")
+    info.world.retire_request(info)
+
+
+def finalize_report() -> List[str]:
+    """Sweep every live verifier world's pending lints into the report,
+    then drain it — the finalize-time report (called by
+    ``mpi_tpu.finalize()``; tests call it directly)."""
+    for world in list(_WORLDS):
+        world.finalize_sweep()
+    return take_report()

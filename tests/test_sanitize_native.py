@@ -1,0 +1,117 @@
+"""Sanitizer builds of the native shm layer (ISSUE 5 satellite).
+
+``MPI_TPU_SANITIZE=address|undefined|thread`` makes native/build.py add
+the matching ``-fsanitize=`` flags under a separate build-cache name.
+These smoke tests build the sanitized .so and exercise the shmring +
+shmarena ops under it in a subprocess (an instrumented .so loaded into
+an un-instrumented python needs the sanitizer runtime LD_PRELOADed,
+which only a fresh process can do) — a leak/overflow/UB in the ring or
+arena paths fails the subprocess loudly.
+
+Not tier-1 (``slow``): spawns subprocesses and depends on the host
+toolchain shipping the sanitizer runtimes; self-skips where it doesn't.
+"""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+pytestmark = pytest.mark.slow
+
+_DRIVER = r"""
+import os, sys
+sys.path.insert(0, {repo!r})
+from mpi_tpu.native.build import load_shmring, ensure_built
+
+lib = load_shmring()
+assert ensure_built().endswith({so_tail!r}), ensure_built()
+name = f"mpi-tpu-sanitize-{{os.getpid()}}".encode()
+
+# ring: create -> write -> read back -> close -> unlink
+ring = lib.shmring_create(name, 1 << 16)
+assert ring, "shmring_create failed"
+payload = bytes(range(256)) * 8
+assert lib.shmring_write(ring, payload, len(payload), 5.0) == 0
+out = bytearray(len(payload))
+import ctypes
+buf = (ctypes.c_char * len(out)).from_buffer(out)
+assert lib.shmring_read(ring, buf, len(out), 5.0) == 0
+assert bytes(out) == payload
+lib.shmring_close(ring)
+lib.shmring_unlink(name)
+
+# arena: create -> flag post/read/wait -> close -> unlink
+aname = name + b".arena"
+arena = lib.shmarena_create(aname, 1 << 12)
+assert arena, "shmarena_create failed"
+addr = lib.shmarena_addr(arena)
+assert lib.shmarena_size(arena) >= (1 << 12)
+lib.shmflag_post(addr, 7)
+assert lib.shmflag_read(addr) == 7
+assert lib.shmflag_wait_ge(addr, 7, 1.0) == 7
+lib.shmarena_close(arena)
+lib.shmarena_unlink(aname)
+print("sanitized native ops OK")
+"""
+
+
+def _runtime_lib(name: str) -> str:
+    try:
+        out = subprocess.run(["gcc", f"-print-file-name={name}"],
+                             capture_output=True, text=True, timeout=30)
+    except (FileNotFoundError, subprocess.TimeoutExpired):
+        return ""
+    path = out.stdout.strip()
+    return path if os.path.sep in path and os.path.exists(path) else ""
+
+
+def _sanitized_smoke(tmp_path, mode: str, so_tail: str, runtime: str):
+    runtime_path = _runtime_lib(runtime)
+    if not runtime_path:
+        pytest.skip(f"toolchain has no {runtime}")
+    env = dict(os.environ)
+    env["MPI_TPU_SANITIZE"] = mode
+    # build first (no preload needed to compile)
+    build = subprocess.run(
+        [sys.executable, "-c",
+         f"import sys; sys.path.insert(0, {REPO!r}); "
+         f"from mpi_tpu.native.build import ensure_built; "
+         f"print(ensure_built())"],
+        capture_output=True, text=True, env=env, timeout=300)
+    if build.returncode != 0:
+        pytest.skip(f"sanitized build unavailable: {build.stderr[-500:]}")
+    assert so_tail in build.stdout, build.stdout
+    script = tmp_path / f"drv_{mode}.py"
+    script.write_text(_DRIVER.format(repo=REPO, so_tail=so_tail))
+    env["LD_PRELOAD"] = runtime_path
+    # leak check off: python itself leaks by ASan's standards
+    env["ASAN_OPTIONS"] = "detect_leaks=0"
+    proc = subprocess.run([sys.executable, str(script)],
+                          capture_output=True, text=True, env=env,
+                          timeout=300)
+    assert proc.returncode == 0, (proc.stdout[-500:], proc.stderr[-2000:])
+    assert "sanitized native ops OK" in proc.stdout
+
+
+def test_asan_smoke(tmp_path):
+    _sanitized_smoke(tmp_path, "address", "_shmring.asan.so", "libasan.so")
+
+
+def test_ubsan_smoke(tmp_path):
+    _sanitized_smoke(tmp_path, "undefined", "_shmring.ubsan.so",
+                     "libubsan.so")
+
+
+def test_unknown_mode_rejected():
+    from mpi_tpu.native.build import NativeBuildError, sanitize_mode
+
+    os.environ["MPI_TPU_SANITIZE"] = "bogus"
+    try:
+        with pytest.raises(NativeBuildError):
+            sanitize_mode()
+    finally:
+        del os.environ["MPI_TPU_SANITIZE"]

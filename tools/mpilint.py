@@ -1,0 +1,57 @@
+#!/usr/bin/env python
+"""Static MPI linter CLI (mpi_tpu/verify/lint.py — MPI-Checker style).
+
+Flags, over any .py files or directories:
+
+* MPL001 — rank-conditional collective with no matching call in the
+  other branch (divergent collective schedule);
+* MPL002 — send-send cycles between literal rank pairs (deadlock under
+  synchronous sends);
+* MPL003 — literal recv-count < send-count truncation (typed
+  MPI_Send/MPI_Recv);
+* MPL004 — operations on a revoked comm without an error handler.
+
+Suppress a deliberate pattern with ``# mpilint: ok`` on (or right
+above) the flagged line.  Exit code 1 iff findings remain.
+
+Usage::
+
+    python tools/mpilint.py examples/ mpi_tpu/
+    python tools/mpilint.py --select MPL001,MPL002 myprog.py
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from mpi_tpu.verify.lint import lint_paths  # noqa: E402
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("paths", nargs="+", help=".py files or directories")
+    ap.add_argument("--select", default=None,
+                    help="comma-separated codes to report (default: all)")
+    ap.add_argument("-q", "--quiet", action="store_true",
+                    help="suppress the OK line")
+    args = ap.parse_args(argv)
+    findings = lint_paths(args.paths)
+    if args.select:
+        keep = {c.strip() for c in args.select.split(",")}
+        findings = [f for f in findings if f.code in keep]
+    for f in findings:
+        print(f.render())
+    if findings:
+        print(f"mpilint: {len(findings)} finding(s)")
+        return 1
+    if not args.quiet:
+        print("mpilint: OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
