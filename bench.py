@@ -565,10 +565,14 @@ if __name__ == "__main__":
     if "--verify-overhead" in sys.argv[1:]:
         # verifier cost leg (ISSUE 5): asserts the off-mode zero-cost
         # contract (pvar-identical hot path) and prices the on-mode.
+        # --progress (ISSUE 6) adds the async-progress-engine leg:
+        # same pvar contracts with the engine's thread running.
         from benchmarks import verify_overhead
 
-        sys.exit(verify_overhead.main(
-            ["--quick"] if "--quick" in sys.argv[1:] else []))
+        args = ["--quick"] if "--quick" in sys.argv[1:] else []
+        if "--progress" in sys.argv[1:]:
+            args.append("--progress")
+        sys.exit(verify_overhead.main(args))
     if "--sweep" in sys.argv[1:]:
         # the OSU-style host data-plane size sweep (ISSUE 1 tentpole #4,
         # extended to alltoall/reduce_scatter/rabenseifner in ISSUE 2);

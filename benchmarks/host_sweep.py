@@ -62,6 +62,14 @@ SWEEP_BENCHES = (
     ("reduce_scatter", ("ring",)),
 )
 
+# Compute/communication overlap band (ISSUE 6): the osu_ialltoall-style
+# overlap leg swept 1-16MB under BOTH progress modes on both host
+# transports — the async progress engine's before/after artifact
+# (benchmarks/results/osu_overlap_{pre,post}.json; 'pre' is the
+# progress=none rows, byte-identical to the pre-engine code path).
+OVERLAP_SIZES = "1MB:16MB:2"
+OVERLAP_MODES = ("none", "thread")
+
 # Small-message band (ISSUE 4 satellite): osu_latency / osu_barrier plus
 # small allreduce swept 8B-64KB.  Small-message p50s are far less noisy
 # on an oversubscribed box than the 64MB bandwidth cells — this is the
@@ -123,6 +131,23 @@ def small_message_sweep(quick: bool = False) -> List[Dict]:
             for r in _osu_rows(backend, bench, szs, algos, iters, warmup):
                 r["leg"] = leg
                 rows.append(r)
+    return rows
+
+
+def overlap_sweep(quick: bool = False) -> List[Dict]:
+    """The compute/communication overlap leg (benchmarks/osu.py
+    ``--bench overlap``) on both host transports under progress=none
+    AND progress=thread; each row records its mode.  The acceptance
+    artifact of the async progress engine: on shm the thread mode's
+    overlap_pct at the ring-stall sizes (>=8MB) is the engine's win,
+    while the none rows are today's caller-financed behavior."""
+    sizes = "1KB" if quick else OVERLAP_SIZES
+    iters, warmup = (1, 0) if quick else (9, 2)
+    rows: List[Dict] = []
+    for backend in TRANSPORTS:
+        for mode in OVERLAP_MODES:
+            rows += _osu_rows(backend, "overlap", sizes, None, iters,
+                              warmup, env_extra={"MPI_TPU_PROGRESS": mode})
     return rows
 
 
@@ -250,6 +275,7 @@ def run_sweep(label: str, quick: bool = False) -> Dict:
         "alltoall_rows": benches["alltoall"],
         "reduce_scatter_rows": benches["reduce_scatter"],
         "small_message_rows": small_message_sweep(quick=quick),
+        "overlap_rows": overlap_sweep(quick=quick),
         "crossover": derive_crossover(rows),
         "rabenseifner_crossover": derive_rabenseifner_crossover(rows),
         "wall_s": round(time.time() - t0, 1),
@@ -259,9 +285,10 @@ def run_sweep(label: str, quick: bool = False) -> Dict:
     return result
 
 
-def run_small_sweep(label: str, quick: bool = False) -> Dict:
-    """Just the small-message band — the arena PR's pre/post artifact
-    (committed as benchmarks/results/osu_small_{pre,post}.json)."""
+def _band_result(label: str, quick: bool, rows_key: str, rows_fn) -> Dict:
+    """Shared envelope of the single-band sweeps (small-message,
+    overlap): one place for the nranks / oversubscription accounting so
+    the committed artifacts' stamps can never diverge between bands."""
     t0 = time.time()
     return {
         "label": label,
@@ -270,9 +297,24 @@ def run_small_sweep(label: str, quick: bool = False) -> Dict:
         "cpus": os.cpu_count(),
         # 2 rank processes + the sweep driver (see osu.run_bench)
         "oversubscribed": 3 > (os.cpu_count() or 1),
-        "small_message_rows": small_message_sweep(quick=quick),
+        rows_key: rows_fn(quick=quick),
         "wall_s": round(time.time() - t0, 1),
     }
+
+
+def run_small_sweep(label: str, quick: bool = False) -> Dict:
+    """Just the small-message band — the arena PR's pre/post artifact
+    (committed as benchmarks/results/osu_small_{pre,post}.json)."""
+    return _band_result(label, quick, "small_message_rows",
+                        small_message_sweep)
+
+
+def run_overlap_sweep(label: str, quick: bool = False) -> Dict:
+    """Just the overlap band — the async progress engine's pre/post
+    artifact (committed as benchmarks/results/osu_overlap_{pre,post}
+    .json: 'pre' holds the progress=none rows, 'post' the thread
+    rows)."""
+    return _band_result(label, quick, "overlap_rows", overlap_sweep)
 
 
 def main(argv=None) -> int:
@@ -284,8 +326,15 @@ def main(argv=None) -> int:
     ap.add_argument("--small", action="store_true",
                     help="small-message band only (osu_latency/osu_barrier/"
                          "small allreduce) — the arena pre/post artifact")
+    ap.add_argument("--overlap", action="store_true",
+                    help="overlap band only (ialltoall + fixed compute, "
+                         "both progress modes) — the async progress "
+                         "engine's pre/post artifact")
     args = ap.parse_args(argv)
-    result = (run_small_sweep(args.label, quick=args.quick) if args.small
+    result = (run_overlap_sweep(args.label, quick=args.quick)
+              if args.overlap
+              else run_small_sweep(args.label, quick=args.quick)
+              if args.small
               else run_sweep(args.label, quick=args.quick))
     text = json.dumps(result, indent=2)
     if args.out:

@@ -191,6 +191,9 @@ def cpu_bench_program(comm, bench: str, sizes: List[int], algos: List[str],
                              "p50_us": t * 1e6})
         return rows
 
+    if bench == "overlap":
+        return _overlap_bench(comm, sizes, iters, warmup)
+
     for nbytes in sizes:
         if bench == "allgather":
             # nbytes is the TOTAL gathered payload (busbw convention; matches
@@ -223,6 +226,115 @@ def cpu_bench_program(comm, bench: str, sizes: List[int], algos: List[str],
                     "algorithm": algo, "p50_us": p50 * 1e6,
                     "busbw_gbps": busbw_gbps(bench, nbytes, comm.size, p50),
                 })
+    return rows
+
+
+# ---------------------------------------------------------------------------
+# Compute/communication overlap (osu_ialltoall-style; ISSUE 6)
+# ---------------------------------------------------------------------------
+#
+# For each size: measure the pure nonblocking alltoall (post + immediate
+# wait), calibrate a fixed compute loop, then measure post -> compute ->
+# wait.  Reported per row:
+#
+#   overlap_pct      = 100 * max(0, 1 - (t_total - t_compute) / t_pure)
+#   availability_pct = 100 * t_compute / t_total   (CPU left to the app)
+#
+# The compute window is FIXED per size — ``nbytes`` at a nominal 4 GB/s
+# line rate (floor 200us), NOT scaled to the measured pure time — so
+# progress modes hide the SAME workload.  This matters: a mode whose
+# pure time is inflated by idle latency (the helper-paced shm stall)
+# would trivially "hide" its own slack under a pure-time-sized compute
+# loop, and the metric would reward slowness.  Against a fixed window
+# the question each row answers is the honest one: does a short compute
+# phase between post and wait buy anything, or does the communication
+# only progress once the caller blocks?  (MPI_TPU_PROGRESS governs the
+# mode; the row records it.)
+
+# nominal line rate that sizes the fixed compute window
+_OVERLAP_LINE_RATE = 4e9
+_OVERLAP_MIN_COMPUTE_S = 200e-6
+
+
+def _overlap_compute(n_iters: int, a: np.ndarray, b: np.ndarray) -> None:
+    """The dummy compute: small BLAS matmuls — numpy releases the GIL
+    around each, like real numerical compute, so background threads CAN
+    run; whether communication finishes inside the window is exactly
+    what the benchmark measures."""
+    for _ in range(n_iters):
+        np.dot(a, b)
+
+
+def _overlap_bench(comm, sizes: List[int], iters: int,
+                   warmup: int) -> List[Dict]:
+    a = np.zeros((64, 64), np.float32)
+    b = np.zeros((64, 64), np.float32)
+    _overlap_compute(32, a, b)  # warm the BLAS path
+    t0 = time.perf_counter()
+    _overlap_compute(64, a, b)
+    unit_s = (time.perf_counter() - t0) / 64
+
+    def red_max(x: float) -> float:
+        return float(np.asarray(comm.allreduce(
+            np.float64(x), op=mpi_tpu.MAX, algorithm="reduce_bcast")))
+
+    mode = "thread" if getattr(comm, "_progress", None) is not None \
+        else "none"
+    # warm the transport path (ring mappings, connection setup, recv
+    # pool) before the first measured size — first-touch page faults
+    # otherwise land entirely in the first cell's pure leg
+    warm = np.array_split(np.zeros(1 << 14, np.float32), comm.size)
+    for _ in range(3):
+        comm.ialltoall(warm).wait()
+    rows: List[Dict] = []
+    for nbytes in sizes:
+        x = np.zeros(max(comm.size, nbytes // 4), np.float32)
+        blocks = np.array_split(x, comm.size)
+
+        comm.barrier()
+        samples = []
+        for i in range(warmup + iters):
+            t0 = time.perf_counter()
+            comm.ialltoall(blocks).wait()
+            if i >= warmup:
+                samples.append(time.perf_counter() - t0)
+        t_pure = red_max(statistics.median(samples))
+
+        target_s = max(_OVERLAP_MIN_COMPUTE_S, nbytes / _OVERLAP_LINE_RATE)
+        n_units = max(1, int(round(target_s / unit_s)))
+        comm.barrier()
+        samples = []
+        for _ in range(max(3, min(7, warmup + iters))):
+            t0 = time.perf_counter()
+            _overlap_compute(n_units, a, b)
+            samples.append(time.perf_counter() - t0)
+        t_comp = red_max(statistics.median(samples))
+
+        comm.barrier()
+        samples = []
+        for i in range(warmup + iters):
+            t0 = time.perf_counter()
+            req = comm.ialltoall(blocks)
+            _overlap_compute(n_units, a, b)
+            req.wait()
+            if i >= warmup:
+                samples.append(time.perf_counter() - t0)
+        t_total = red_max(statistics.median(samples))
+
+        if comm.rank == 0:
+            rows.append({
+                "bench": "overlap", "nranks": comm.size, "bytes": nbytes,
+                "progress": mode,
+                "pure_us": t_pure * 1e6,
+                "compute_us": t_comp * 1e6,
+                "compute_target_us": target_s * 1e6,
+                "total_us": t_total * 1e6,
+                "p50_us": t_total * 1e6,
+                "overlap_pct": min(100.0, 100.0 * max(
+                    0.0, 1.0 - (t_total - t_comp) / max(t_pure, 1e-12))),
+                "availability_pct": min(100.0, 100.0 * t_comp
+                                        / max(t_total, 1e-12)),
+            })
     return rows
 
 
@@ -342,7 +454,7 @@ def tpu_bench(bench: str, sizes: List[int], algos: List[str], iters: int,
 # ---------------------------------------------------------------------------
 
 ALL_BENCHES = ["latency", "bw", "barrier", "bcast", "reduce", "allreduce",
-               "allgather", "alltoall", "reduce_scatter"]
+               "allgather", "alltoall", "reduce_scatter", "overlap"]
 DEFAULT_ALGOS = {
     "allreduce": ["ring", "recursive_halving", "fused"],  # + pallas_ring (tpu, opt-in)
     "bcast": ["tree", "fused"],
@@ -353,6 +465,7 @@ DEFAULT_ALGOS = {
     "latency": ["-"],
     "bw": ["-"],
     "barrier": ["-"],
+    "overlap": ["-"],
 }
 
 
@@ -360,9 +473,10 @@ def run_bench(bench: str, backend: str, nranks: int, sizes: List[int],
               algos: List[str], iters: int, warmup: int,
               algos_explicit: bool = False) -> List[Dict]:
     if backend == "tpu":
-        if bench in ("bw", "barrier"):
-            # SPMD has no standalone p2p stream and its barrier is a
-            # device-fused psum; both are process-backend benches
+        if bench in ("bw", "barrier", "overlap"):
+            # SPMD has no standalone p2p stream, its barrier is a
+            # device-fused psum, and its nonblocking ops are XLA's to
+            # schedule; all are process-backend benches
             return [{"bench": bench, "backend": "tpu",
                      "skipped": f"{bench} is a process-backend bench"}]
         return tpu_bench(bench, sizes, algos, iters, warmup, nranks)

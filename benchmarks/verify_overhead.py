@@ -52,11 +52,11 @@ def _allreduce_loop(comm, nbytes: int, iters: int):
 
 
 def _leg(nranks: int, nbytes: int, iters: int, samples: int,
-         verify: bool) -> Dict:
+         verify: bool, progress: str = "none") -> Dict:
     p50s = []
     for _ in range(samples):
         per_rank = run_local(_allreduce_loop, nranks, args=(nbytes, iters),
-                             verify=verify)
+                             verify=verify, progress=progress)
         p50s.append(statistics.median(per_rank))
     return {"p50_us": round(min(p50s), 1),
             "samples_us": [round(s, 1) for s in p50s]}
@@ -67,6 +67,12 @@ def main(argv=None) -> int:
     ap.add_argument("--quick", action="store_true",
                     help="tier-1 smoke: tiny sizes, 1 sample")
     ap.add_argument("--nranks", type=int, default=2)
+    ap.add_argument("--progress", action="store_true",
+                    help="also run the allreduce loop under "
+                         "progress=thread (verify off) and assert the "
+                         "off-mode pvar contracts hold with the engine "
+                         "running: 0 pickled array bytes, payload-copy "
+                         "count unchanged")
     args = ap.parse_args(argv)
     iters = 20 if args.quick else 200
     samples = 1 if args.quick else 5
@@ -79,8 +85,32 @@ def main(argv=None) -> int:
     # accounting — no pickled array bytes beyond the plain engine's (the
     # ring allreduce ships raw frames only) and zero verify events
     off_pickled = ses.read("bytes_pickled_sent")
+    off_copies = ses.read("payload_copies")
     off_events = sum(ses.read(p) for p in mpit.pvar_list()
                      if p.startswith("verify_"))
+    off_prog = sum(ses.read(p) for p in mpit.pvar_list()
+                   if p.startswith("progress_"))
+    progress_leg = None
+    if args.progress:
+        # ISSUE 6 satellite: the dedicated progress engine must not
+        # perturb the data plane's accounting — same zero-pickled-bytes
+        # and payload-copy contracts with the engine's thread running
+        # (its completions consume already-delivered payloads; no new
+        # wire traffic, no new copies)
+        ses.reset_all()
+        progress_leg = _leg(args.nranks, nbytes, iters, samples,
+                            verify=False, progress="thread")
+        progress_leg["bytes_pickled_sent"] = ses.read("bytes_pickled_sent")
+        progress_leg["payload_copies"] = ses.read("payload_copies")
+        progress_leg["progress_wakeups"] = ses.read("progress_wakeups")
+        progress_leg["progress_completions"] = \
+            ses.read("progress_completions")
+        assert progress_leg["bytes_pickled_sent"] == 0, \
+            (f"progress=thread ring allreduce pickled "
+             f"{progress_leg['bytes_pickled_sent']} bytes")
+        assert progress_leg["payload_copies"] == off_copies, \
+            (f"progress=thread changed the payload-copy count: "
+             f"{progress_leg['payload_copies']} != {off_copies}")
     ses.reset_all()
     on = _leg(args.nranks, nbytes, iters, samples, verify=True)
     on_pickled = ses.read("bytes_pickled_sent")
@@ -95,13 +125,19 @@ def main(argv=None) -> int:
         "overhead_x": round(on["p50_us"] / max(off["p50_us"], 1e-9), 3),
         # off-mode zero-cost evidence (hard assertions below)
         "off_bytes_pickled_sent": off_pickled,
+        "off_payload_copies": off_copies,
         "off_verify_events": off_events,
+        "off_progress_events": off_prog,
         # the signature ring is pickled control traffic — nonzero ON is
         # expected and recorded, never part of the off-mode contract
         "on_bytes_pickled_sent": on_pickled,
         "oversubscribed": (args.nranks + 1) > (os.cpu_count() or 1),
     }
+    if progress_leg is not None:
+        result["progress_thread"] = progress_leg
     assert off_events == 0, f"verifier ran with verify=False: {off_events}"
+    assert off_prog == 0, \
+        f"progress engine ran with progress=none: {off_prog} events"
     assert off_pickled == 0, \
         f"off-mode ring allreduce pickled {off_pickled} bytes"
     print(json.dumps(result, indent=2))

@@ -33,7 +33,7 @@ from .ops import SUM, PROD, MAX, MIN, LAND, LOR, LXOR, BAND, BOR, BXOR, ReduceOp
 from .communicator import Communicator, Message, P2PCommunicator, Request, Status
 from .transport.base import ANY_SOURCE, ANY_TAG
 from .transport.local import run_local
-from . import datatypes, errors, ft, io, mpi4, schedules, checker, checkpoint, profiling, trace, verify
+from . import datatypes, errors, ft, io, mpi4, progress, schedules, checker, checkpoint, profiling, trace, verify
 from .intercomm import InterComm, create_intercomm
 from .topology import (CartComm, GraphComm, HierarchicalComm, cart_create,
                        dims_create, dist_graph_create_adjacent,
@@ -50,7 +50,7 @@ __all__ = [
     "SUM", "PROD", "MAX", "MIN", "LAND", "LOR", "LXOR", "BAND", "BOR", "BXOR",
     "Communicator", "Message", "P2PCommunicator", "Request", "Status", "ANY_SOURCE", "ANY_TAG",
     "init", "finalize", "is_initialized", "run", "run_local",
-    "schedules", "checker", "checkpoint", "ft", "profiling", "trace", "verify", "COMM_WORLD", "io", "mpi4",
+    "schedules", "checker", "checkpoint", "ft", "profiling", "progress", "trace", "verify", "COMM_WORLD", "io", "mpi4",
     "CartComm", "GraphComm", "HierarchicalComm", "InterComm",
     "create_intercomm", "cart_create", "graph_create", "split_hierarchical",
     "dist_graph_create_adjacent", "dims_create", "Group",
@@ -114,6 +114,13 @@ def init(backend: Optional[str] = None) -> Communicator:
                 # + one analysis slice, divergent collectives as
                 # CollectiveMismatchError before their data moves
                 verify.enable(_world, rdv_dir=rdv)
+            if progress.resolve_mode() == "thread":
+                # async progress engine (mpi_tpu/progress.py): one
+                # daemon thread per world — background completion for
+                # nonblocking ops, doorbell-parked transport draining
+                # (MPI_TPU_PROGRESS=thread / launcher --progress /
+                # the ``progress`` cvar)
+                progress.enable(_world)
         elif backend in ("self", "local"):
             from .transport.local import LocalTransport, LocalWorld
 

@@ -558,6 +558,57 @@ def allgather(arena: Arena, comm, obj: Any) -> Any:
 
 
 @_sm_coll
+def alltoall(arena: Arena, comm, arr: Optional[np.ndarray]) -> Any:
+    """``arr`` is the stacked [P, ...] block array (the communicator's
+    ``_blocks_as_array`` eligibility view, None when the local payload
+    cannot ride): write ALL blocks into own slot → one flag round →
+    read your COLUMN (peer q's block ``rank``) in place.  One copy in,
+    one copy out per rank, versus the wire path's P-1 windowed
+    send/recv round trips.  Congruence is negotiated in-arena like the
+    reductions: any rank whose stack differs (object payloads, ragged
+    blocks, oversized) lands the whole group on the pairwise wire
+    exchange together."""
+    mine = _enter(arena, comm, arr)
+    if not _congruent(_metas(arena)):
+        return _decline(arena, comm)
+    p, r = arena._p, comm.rank
+    if mine.shape[0] != p:
+        return _decline(arena, comm)  # [P, ...] stacks only
+    n = mine.size
+    bn = n // p
+    items: List[np.ndarray] = [None] * p  # type: ignore[list-item]
+    for q in range(p):
+        dst = _codec.RECV_POOL.empty(mine.shape[1:], mine.dtype)
+        if bn:
+            lo = r * bn
+            dst.reshape(-1)[...] = arena.data(q, mine.dtype, n)[lo:lo + bn]
+        items[q] = dst
+    arena.barrier(comm)
+    _mpit.count(copies=1, coll_sm_hits=1)
+    return (items,)
+
+
+@_sm_coll
+def scan(arena: Arena, comm, arr: np.ndarray, op) -> Any:
+    """Inclusive prefix reduction: write own payload → one flag round →
+    rank r folds slots 0..r in rank order, in place from the arena —
+    every rank's P·N loads happen concurrently, versus the wire path's
+    log P serialized distance-doubling rounds."""
+    mine = _enter(arena, comm, arr)
+    if not _congruent(_metas(arena)):
+        return _decline(arena, comm)
+    out = np.empty(mine.shape, mine.dtype)
+    flat = out.reshape(-1)
+    if flat.size:
+        flat[...] = arena.data(0, mine.dtype, flat.size)
+        for q in range(1, comm.rank + 1):
+            op.combine_into(flat, arena.data(q, mine.dtype, flat.size))
+    arena.barrier(comm)
+    _mpit.count(copies=1, coll_sm_hits=1)
+    return (out,)
+
+
+@_sm_coll
 def reduce_scatter(arena: Arena, comm, arr: np.ndarray, op) -> Any:
     """``arr`` is the stacked [P, ...] block array (the communicator's
     ``_blocks_as_array`` eligibility view): write the whole input, one

@@ -220,10 +220,80 @@ def _unpost(reqs: Sequence["_RecvRequest"]) -> None:
     head would silently absorb the first frames of any LATER collective
     on the same (source, _TAG_COLL) channel and misfold; un-posting at
     least fails the next operation loudly (in-flight peer bytes may
-    still arrive — see _seg_exchange)."""
+    still arrive — see _seg_exchange).  Under the progress engine the
+    removal holds the completion lock — the engine thread may be
+    completing one of these requests right now."""
+    if not reqs:
+        return
+    eng = reqs[0]._comm._progress
+    if eng is not None:
+        with eng.cv:
+            for req in reqs:
+                if not req._done and req in req._queue:
+                    req._queue.remove(req)
+        return
     for req in reqs:
         if not req._done and req in req._queue:
             req._queue.remove(req)
+
+
+class _SegSender:
+    """Engine-advanced send window of one ``_seg_exchange`` step
+    (``progress=thread`` only): the pipelined sends beyond the initial
+    ``_SEG_WINDOW`` credit are posted by whoever completes the matching
+    receives — usually the progress engine's thread, via each pipeline
+    irecv's ``_on_complete`` callback — so the credit window advances
+    without the caller being inside ``_seg_exchange`` at all.
+
+    Sends happen UNDER the sender lock: two threads advancing
+    concurrently must emit spans in table order (the receiver folds by
+    position — an inverted pair would misfold silently).  A send
+    failure on the engine thread is recorded, never raised there; the
+    caller re-raises it at its next fold/drain step (``check``)."""
+
+    __slots__ = ("_comm", "_work", "_spans", "_dest", "_si", "_lock",
+                 "error")
+
+    def __init__(self, comm: "P2PCommunicator", work: np.ndarray,
+                 spans, dest: int):
+        self._comm, self._work, self._spans = comm, work, spans
+        self._dest = dest
+        self._si = 0
+        self._lock = threading.Lock()
+        self.error: Optional[BaseException] = None
+
+    def post(self, n: int) -> None:
+        with self._lock:
+            while n > 0 and self._si < len(self._spans):
+                lo, hi = self._spans[self._si]
+                self._si += 1
+                n -= 1
+                self._comm._send_internal(
+                    self._comm._coll_payload(self._work[lo:hi]),
+                    self._dest, _TAG_COLL)
+
+    def advance(self) -> None:
+        """One receive completed: extend the credit window by one span.
+        Runs on the completing thread (engine or caller), outside the
+        engine's completion lock."""
+        if self.error is not None:
+            return
+        try:
+            self.post(1)
+        except BaseException as e:  # noqa: BLE001 - re-raised by caller
+            self.error = e
+
+    def check(self) -> None:
+        if self.error is not None:
+            raise self.error
+
+    def drain(self) -> None:
+        """Caller, after every fold: post whatever the completion
+        callbacks have not (receive range shorter than the send range),
+        then surface any engine-side send failure."""
+        self.check()
+        self.post(len(self._spans))
+        self.check()
 
 
 def _as_array(obj: Any) -> Tuple[np.ndarray, bool]:
@@ -391,7 +461,17 @@ class _RecvRequest(Request):
     matching rule): completing a later request first drains its earlier
     siblings from the shared posted-queue.  (Posted-order across *mixed*
     wildcard and specific envelopes is not modeled — each exact key orders
-    independently.)"""
+    independently.)
+
+    With the async progress engine attached (mpi_tpu/progress.py,
+    ``progress=thread``) completion is SHARED between the caller and the
+    engine thread: both go through the engine's completion lock
+    (``ProgressEngine.try_complete``), so a message is consumed exactly
+    once and ``_done`` may flip in the background while the caller
+    computes.  ``_on_complete`` is the engine's post-completion callback
+    slot (segmented-engine send-window credit, _SegSender.advance)."""
+
+    _on_complete = None  # set by _seg_exchange under the progress engine
 
     def __init__(self, comm: "P2PCommunicator", source: int, tag: int,
                  queue: List["_RecvRequest"]):
@@ -412,6 +492,15 @@ class _RecvRequest(Request):
         return self._comm._t.poll(src_world, self._comm._ctx, self._tag)
 
     def wait(self) -> Any:
+        if self._comm._progress is not None:
+            # engine mode: completion is lock-serialized with the
+            # background thread — a blocking consume here could swallow
+            # a message the engine already matched to an earlier
+            # sibling (or strand this thread after the engine consumed
+            # ours), so the wait parks on the engine instead
+            self._comm._progress_wait_request(self)
+            self._vnote(True)
+            return self._value
         while not self._done:
             head = self._queue[0]  # earliest posted request gets the message
             # _recv_internal, not recv: the posting entry point already
@@ -423,6 +512,18 @@ class _RecvRequest(Request):
         return self._value
 
     def test(self) -> Tuple[bool, Any]:
+        eng = self._comm._progress
+        if eng is not None:
+            if not self._done:
+                with eng.cv:
+                    cbs = eng.try_complete(self)
+                for cb in cbs:  # credit-window sends, outside the lock
+                    cb()
+            if not self._done:
+                self._comm._empty_poll_check(self._source, self._tag)
+                return False, None
+            self._vnote(True, blocking=False)
+            return True, self._value
         while not self._done:
             head = self._queue[0]
             hit = head._poll_once()
@@ -999,6 +1100,12 @@ class P2PCommunicator(Communicator):
         # at each collective entry: it is only consulted for failures on
         # internal (negative) tags, which only occur inside collectives.
         self._coll_name: Optional[str] = None
+        # Async progress engine (mpi_tpu/progress.py ProgressEngine),
+        # inherited from the transport so split/dup/nbc children of an
+        # enabled world share the one engine thread; None = the entire
+        # feature is a single attribute test per operation
+        # (progress=none, the off-mode zero-cost contract).
+        self._progress = getattr(transport, "_progress_engine", None)
 
     # -- identity ----------------------------------------------------------
 
@@ -1099,6 +1206,8 @@ class P2PCommunicator(Communicator):
         start = time.monotonic()
         deadline = None if timeout is None else start + timeout
         block_id = vw.begin_block() if vw is not None else 0
+        if vw is not None:
+            vw.wait_enter()  # board-entry ownership: engine stands down
         try:
             while True:
                 if ft is not None:
@@ -1153,6 +1262,9 @@ class P2PCommunicator(Communicator):
             if vw is not None:
                 vw.clear_published()
             raise
+        finally:
+            if vw is not None:
+                vw.wait_exit()
 
     def _verify_stalled(self, vw, src_world: int, tag: int, block_id: int,
                         consume: bool) -> None:
@@ -1170,6 +1282,80 @@ class P2PCommunicator(Communicator):
             "recv" if consume else "probe",
             self._coll_name if tag < 0 else None, user_site(), block_id)
 
+    def _progress_wait_request(self, req: "_RecvRequest") -> None:
+        """Blocking wait on a posted receive under the async progress
+        engine (mpi_tpu/progress.py): completion is serialized with the
+        engine thread through the engine's completion lock, and the
+        caller PARKS on the engine's condition between slices instead
+        of consuming from the transport (a blocking consume here could
+        swallow a message the engine already matched to an earlier
+        sibling, or strand this thread after the engine consumed ours).
+
+        The slice structure mirrors _sliced_wait exactly — FT
+        detector/revocation checks, verifier stall publication, and the
+        communicator recv_timeout all keep their bounds — and each
+        slice retries completion itself, so the wait stays
+        caller-financed whenever the engine is busy elsewhere (or was
+        stopped): liveness never depends on the engine thread."""
+        eng = self._progress
+        ft = self._ft
+        vw = self._verify.world if self._verify is not None else None
+        timeout = self.recv_timeout
+        start = time.monotonic()
+        deadline = None if timeout is None else start + timeout
+        block_id = vw.begin_block() if vw is not None else 0
+        src_world = (ANY_SOURCE if req._source == ANY_SOURCE
+                     else self._world(req._source))
+        if vw is not None:
+            vw.wait_enter()  # board-entry ownership: engine stands down
+        try:
+            while True:
+                if ft is not None:
+                    ft.check(self)
+                if not req._done:
+                    with eng.cv:
+                        cbs = eng.try_complete(req)
+                    for cb in cbs:  # credit-window sends, lock released
+                        cb()
+                if req._done:
+                    return
+                if ft is not None:
+                    suspects = self._ft_suspects(src_world, req._tag)
+                    if suspects:
+                        what = (f"collective {self._coll_name!r}"
+                                if req._tag < 0
+                                else f"irecv(tag={req._tag})")
+                        raise ProcFailedError(
+                            f"rank {self._rank}: peer death detected "
+                            f"while waiting on {what}", failed=suspects,
+                            collective=self._coll_name if req._tag < 0
+                            else None)
+                now = time.monotonic()
+                if vw is not None and now - start >= vw.stall_timeout_s:
+                    self._verify_stalled(vw, src_world, req._tag,
+                                         block_id, True)
+                if deadline is not None and now >= deadline:
+                    raise RecvTimeout(
+                        f"irecv wait(source={src_world}, ctx={self._ctx}, "
+                        f"tag={req._tag}) timed out after {timeout}s; "
+                        f"pending={self._t.mailbox.pending_summary()}")
+                with eng.cv:
+                    # _done flips under eng.cv, so this re-check cannot
+                    # lose a wakeup; the bounded slice keeps FT/verify/
+                    # timeout cadence even if the engine thread is gone
+                    if not req._done:
+                        eng.cv.wait(_FT_POLL_S)
+        except (RecvTimeout, ProcFailedError, RevokedError):
+            # same retraction rule as _sliced_wait: the rank exits this
+            # wait alive, so a published 'blocked' entry must not keep
+            # implicating it (DeadlockError deliberately excluded)
+            if vw is not None:
+                vw.clear_published()
+            raise
+        finally:
+            if vw is not None:
+                vw.wait_exit()
+
     def _empty_poll_check(self, source: int, tag: int) -> None:
         """FT gate of the NONBLOCKING completion paths (Request.test,
         iprobe, improbe) on their EMPTY path: apply queued revocations
@@ -1181,9 +1367,16 @@ class P2PCommunicator(Communicator):
         opportunistically while doing useful work), so publishing it as
         'blocked' — let alone raising DeadlockError from it — would
         false-positive on correct programs.  Deadlock participation is
-        restricted to the blocking waits (_sliced_wait), MUST-style;
-        pure-polling drain loops are the documented residual
-        (ROADMAP)."""
+        restricted to the blocking waits (_sliced_wait), MUST-style —
+        EXCEPT under ``progress=thread``: the engine observes sustained
+        empty polls, publishes an OR-set entry on the rank's behalf, and
+        parks a proven DeadlockError here for the polling loop to
+        re-raise (the former pure-polling residual, closed by
+        mpi_tpu/progress.py)."""
+        eng = self._progress
+        if eng is not None:
+            eng.check_error()  # a proven Waitany-loop deadlock raises
+            eng.note_empty_poll()
         if self._ft is not None:
             self._ft.check(self)
             src_world = (ANY_SOURCE if source == ANY_SOURCE
@@ -1287,7 +1480,17 @@ class P2PCommunicator(Communicator):
         pipelined receives on the internal _TAG_COLL tag through here."""
         with self._lock:
             queue = self._irecv_queues.setdefault((source, tag), [])
-        return _RecvRequest(self, source, tag, queue)
+        req = _RecvRequest(self, source, tag, queue)
+        if self._progress is not None and \
+                not self.__dict__.get("_progress_registered"):
+            # background completion: the engine scans this comm's posted
+            # queues from its own thread.  The local flag keeps this to
+            # ONE lock acquisition per communicator — the engine may
+            # hold its completion lock through a long ring drain, and
+            # posting pipelined irecvs must not queue behind that.
+            self._progress.register(self)
+            self._progress_registered = True
+        return req
 
     def send_init(self, buf: Any, dest: int, tag: int = 0) -> PersistentRequest:
         """MPI_Send_init [S]: persistent send bound to ``buf``; each
@@ -1662,8 +1865,47 @@ class P2PCommunicator(Communicator):
         seg = self._seg_elems(work.itemsize)
         sspans = schedules.segment_spans(sbounds[0], sbounds[1], seg)
         rspans = schedules.segment_spans(rbounds[0], rbounds[1], seg)
-        reqs = [self._irecv_internal(src, _TAG_COLL) for _ in rspans]
+        eng = self._progress
+        if eng is not None and len(sspans) > _SEG_WINDOW:
+            # progress-engine mode: the sends beyond the initial credit
+            # are posted by whoever COMPLETES each receive — normally
+            # the engine thread, via _on_complete — so the window
+            # advances while the caller is folding (or not here at
+            # all); the caller only folds and, at the end, drains the
+            # tail the callbacks didn't cover.  Requests are posted and
+            # their callbacks attached UNDER the completion lock: the
+            # engine may otherwise complete an early receive in the gap
+            # between posting and attaching, silently losing that
+            # receive's send credit — a stall both sides of a symmetric
+            # exchange would share.
+            sender = _SegSender(self, work, sspans, dest)
+            with eng.cv:
+                reqs = []
+                for _ in rspans:
+                    req = self._irecv_internal(src, _TAG_COLL)
+                    req._on_complete = sender.advance
+                    reqs.append(req)
+        else:
+            sender = None
+            reqs = [self._irecv_internal(src, _TAG_COLL) for _ in rspans]
         try:
+            if sender is not None:
+                sender.post(_SEG_WINDOW)
+                for seg_i, ((lo, hi), req) in enumerate(zip(rspans, reqs)):
+                    sender.check()  # engine-side send failures surface
+                    try:
+                        got = req.wait()
+                    except ProcFailedError as e:
+                        if e.segment is None:  # name the stalled segment
+                            e.segment = seg_i
+                        raise
+                    view = work[lo:hi]
+                    if op is None:
+                        view[...] = got
+                    else:
+                        op.combine_into(view, got)
+                sender.drain()
+                return
             si = 0
             while si < min(len(sspans), _SEG_WINDOW):
                 lo, hi = sspans[si]
@@ -1909,8 +2151,11 @@ class P2PCommunicator(Communicator):
     def alltoall(self, objs: Sequence[Any], algorithm: str = "auto") -> List[Any]:
         """MPI_Alltoall.  ``algorithm``: ``"pairwise"`` (windowed
         nonblocking pairwise exchange, P-1 rounds — BASELINE.json:9);
-        ``"auto"`` and ``"fused"`` (the TPU tier) are aliases of it on
-        process backends.
+        ``"sm"`` (shm transports: the collective arena — write all P
+        blocks, one flag round, read your column in place,
+        mpi_tpu/coll_sm.py); ``"auto"`` tries the arena when the
+        transport has one, pairwise otherwise; ``"fused"`` (the TPU
+        tier) aliases pairwise.
 
         All P-1 receives are posted up front (each source is a distinct
         FIFO channel, so posted order is arrival order per peer) and the
@@ -1924,11 +2169,35 @@ class P2PCommunicator(Communicator):
         _mpit.count(collectives=1)
         self._coll_name = "alltoall"
         p, r = self.size, self._rank
-        _resolve_algorithm("alltoall", algorithm, ("pairwise",),
-                           {"auto": "pairwise", "fused": "pairwise"})
+        algorithm = _resolve_algorithm(
+            "alltoall", algorithm, ("auto", "pairwise") + _coll_sm.gate(self),
+            {"fused": "pairwise"})
         if len(objs) != p:
             raise ValueError(f"alltoall needs one payload per rank ({p}), got {len(objs)}")
-        self._verify_coll("alltoall", algorithm="pairwise")
+        self._verify_coll("alltoall", algorithm=algorithm)
+        if algorithm in ("auto", "sm") and p > 1:
+            # Arena path: write the whole [P·n] stack once, read your
+            # column in place.  Same eligibility discipline as the
+            # reduce_scatter arena gate: the stacked view is built only
+            # when the payload fits a slot (the stacking copy must not
+            # be paid on the decline path), and the in-arena meta
+            # negotiation lands every rank on pairwise together when
+            # any rank's blocks are ragged/objects/oversized.
+            arena = _coll_sm.arena_for(self)
+            arr_sm = None
+            if arena is not None:
+                try:
+                    # alltoall payloads may be ANY picklables — a ragged
+                    # nested list makes even the size probe raise, which
+                    # just means "cannot ride the arena"
+                    if self._blocks_nbytes(objs) <= arena.capacity:
+                        arr_sm = self._blocks_as_array(objs)
+                except (ValueError, TypeError):
+                    arr_sm = None
+            got = _coll_sm.alltoall(self, arr_sm)
+            if got is not _coll_sm.FALLBACK:
+                (items,) = got
+                return _maybe_stack(objs, items)
         result: List[Any] = [None] * p
         result[r] = objs[r]
         rounds = schedules.alltoall_rounds(p)
@@ -1969,7 +2238,14 @@ class P2PCommunicator(Communicator):
             self._send_internal(None, (r + off) % p, _TAG_BARRIER)
             self._recv_internal((r - off) % p, _TAG_BARRIER)
 
-    def scan(self, obj: Any, op: _ops.ReduceOp = _ops.SUM) -> Any:
+    def scan(self, obj: Any, op: _ops.ReduceOp = _ops.SUM,
+             algorithm: str = "auto") -> Any:
+        """MPI_Scan [S].  ``algorithm``: ``"doubling"`` (Hillis-Steele
+        distance-doubling partial prefixes, log2(P) rounds); ``"sm"``
+        (shm transports: the collective arena — write own payload, one
+        flag round, rank r folds slots 0..r in place); ``"auto"`` tries
+        the arena when the transport has one; ``"fused"`` aliases
+        doubling."""
         _mpit.count(collectives=1)
         self._coll_name = "scan"
         # Hillis-Steele inclusive scan: log2(P) rounds of distance-doubling
@@ -1977,7 +2253,17 @@ class P2PCommunicator(Communicator):
         # contiguous ndarray, so every round ships it as a raw frame —
         # never pickled (asserted in tests/test_segmented_collectives2.py).
         arr, scalar = _as_array(obj)
-        self._verify_coll("scan", op=op, payload=arr)
+        algorithm = _resolve_algorithm(
+            "scan", algorithm, ("auto", "doubling") + _coll_sm.gate(self),
+            {"fused": "doubling"})
+        self._verify_coll("scan", op=op, payload=arr, algorithm=algorithm)
+        if algorithm in ("auto", "sm") and self.size > 1:
+            # in-arena negotiation: object payloads / oversized /
+            # geometry drift land every rank back on doubling together
+            got = _coll_sm.scan(self, arr, op)
+            if got is not _coll_sm.FALLBACK:
+                (out,) = got
+                return _unwrap(out, scalar)
         acc = arr.copy()
         p, r = self.size, self._rank
         d = 1
@@ -2449,6 +2735,8 @@ class P2PCommunicator(Communicator):
         (the 'unreceived message' sanitizer check, SURVEY.md §5)."""
         if self._ft is not None:
             self._ft.world.stop()
+        if self._progress is not None:
+            self._progress.stop()
         pending = self._t.mailbox.drain()
         self._t.close()
         return pending

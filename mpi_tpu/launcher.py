@@ -217,12 +217,24 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                              "(MPI_TPU_VERIFY=1 on every rank): deadlock "
                              "detection, collective-matching signatures, "
                              "request lints — see mpi_tpu/verify")
+    parser.add_argument("--progress", choices=("none", "thread"),
+                        default=None,
+                        help="async progress mode for every rank "
+                             "(MPI_TPU_PROGRESS): 'thread' starts one "
+                             "dedicated progress engine per rank — "
+                             "background completion for nonblocking ops "
+                             "(mpi_tpu/progress.py)")
     parser.add_argument("script", help="python script to run on every rank")
     parser.add_argument("script_args", nargs=argparse.REMAINDER,
                         help="arguments passed to the script")
     args = parser.parse_args(argv)
+    env_extra = {}
+    if args.verify:
+        env_extra["MPI_TPU_VERIFY"] = "1"
+    if args.progress is not None:
+        env_extra["MPI_TPU_PROGRESS"] = args.progress
     return launch(args.nranks, [args.script, *args.script_args],
-                  env_extra={"MPI_TPU_VERIFY": "1"} if args.verify else None,
+                  env_extra=env_extra or None,
                   timeout=args.timeout, backend=args.backend,
                   restarts=args.restarts)
 

@@ -42,7 +42,8 @@ class _Counters:
                  "faulty_dropped", "faulty_duplicated", "attention_oob",
                  "sm_hits", "sm_bytes", "sm_fallbacks",
                  "v_deadlocks", "v_mismatches", "v_leaked", "v_double_waits",
-                 "v_buf_overlaps", "v_comms_unfreed")
+                 "v_buf_overlaps", "v_comms_unfreed",
+                 "prog_wakeups", "prog_completions", "prog_idle_parks")
 
     def __init__(self) -> None:
         self.sends = 0
@@ -68,6 +69,9 @@ class _Counters:
         self.v_double_waits = 0
         self.v_buf_overlaps = 0
         self.v_comms_unfreed = 0
+        self.prog_wakeups = 0
+        self.prog_completions = 0
+        self.prog_idle_parks = 0
 
 
 counters = _Counters()  # incremented by communicator.py / codec.py (count())
@@ -83,7 +87,9 @@ def count(sends: int = 0, send_bytes: int = 0, recvs: int = 0,
           verify_deadlocks: int = 0, verify_mismatches: int = 0,
           verify_requests_leaked: int = 0, verify_double_waits: int = 0,
           verify_buffer_overlaps: int = 0,
-          verify_comms_unfreed: int = 0) -> None:
+          verify_comms_unfreed: int = 0,
+          progress_wakeups: int = 0, progress_completions: int = 0,
+          progress_idle_parks: int = 0) -> None:
     """Thread-safe increment (rank-threads of the local backend share
     this process's counters; unsynchronized += would lose updates)."""
     with _lock:
@@ -110,6 +116,9 @@ def count(sends: int = 0, send_bytes: int = 0, recvs: int = 0,
         counters.v_double_waits += verify_double_waits
         counters.v_buf_overlaps += verify_buffer_overlaps
         counters.v_comms_unfreed += verify_comms_unfreed
+        counters.prog_wakeups += progress_wakeups
+        counters.prog_completions += progress_completions
+        counters.prog_idle_parks += progress_idle_parks
 
 _PVARS: Dict[str, Callable[[], int]] = {
     "msgs_sent": lambda: counters.sends,
@@ -167,6 +176,16 @@ _PVARS: Dict[str, Callable[[], int]] = {
     "verify_double_waits": lambda: counters.v_double_waits,
     "verify_buffer_overlaps": lambda: counters.v_buf_overlaps,
     "verify_comms_unfreed": lambda: counters.v_comms_unfreed,
+    # async progress engine (mpi_tpu/progress.py): engine-thread wakeups
+    # (the added cost the ``progress`` cvar prices), nonblocking
+    # requests completed in the BACKGROUND (by the engine rather than a
+    # caller's wait/test), and parks that expired with no traffic (the
+    # engine's idle duty cycle).  All exactly 0 with progress=none —
+    # the off-mode zero-cost contract (bench.py --verify-overhead
+    # --progress and tests/test_progress.py assert it).
+    "progress_wakeups": lambda: counters.prog_wakeups,
+    "progress_completions": lambda: counters.prog_completions,
+    "progress_idle_parks": lambda: counters.prog_idle_parks,
 }
 
 
@@ -252,6 +271,7 @@ def _ensure_builtin_cvars() -> None:
     from . import communicator as _c
     from . import ft as _ft
     from . import io as _io
+    from . import progress as _prog
     from .transport import shm as _shm
     from .verify import state as _vstate
 
@@ -323,6 +343,12 @@ def _ensure_builtin_cvars() -> None:
             raise ValueError("verify_stall_timeout_s must be > 0")
         _vstate._STALL_TIMEOUT_S = float(v)
 
+    def _set_progress(v):
+        if v not in _prog.MODES:
+            raise ValueError(
+                f"progress must be one of {list(_prog.MODES)}, got {v!r}")
+        _prog._DEFAULT_MODE = v
+
     with _lock:
         if _builtin_done:
             return
@@ -388,6 +414,17 @@ def _ensure_builtin_cvars() -> None:
             "deadlock analysis — a proven cross-rank cycle/knot raises "
             "DeadlockError naming every rank, its pending op, and its "
             "call site.  Read at verify.enable() time")
+        _CVARS["progress"] = (
+            lambda: _prog._DEFAULT_MODE, _set_progress,
+            "default async-progress mode of newly created worlds "
+            "(mpi_tpu/progress.py): 'thread' starts one dedicated "
+            "progress engine per world — background completion for "
+            "nonblocking ops, doorbell-parked transport draining, "
+            "pure-polling drain loops join deadlock detection; 'none' "
+            "(default) keeps progress caller-financed with a single "
+            "attribute test per operation.  Explicit run_local("
+            "progress=...) and the MPI_TPU_PROGRESS environment "
+            "variable override; read at world creation")
         _CVARS["coll_sm_arena_bytes"] = (
             lambda: _sm._ARENA_BYTES, _set_sm_arena,
             "size of the per-communicator shared-memory collective arena "

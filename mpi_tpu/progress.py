@@ -1,0 +1,352 @@
+"""Dedicated async progress engine — background completion for
+nonblocking operations (MPICH ``MPICH_ASYNC_PROGRESS`` analogue).
+
+Why: the segmented zero-copy engine and the i-collectives exist to
+overlap compute with communication, but without this module nonblocking
+operations progress only while some caller thread polls or waits —
+overlap is *caller-financed*.  Concretely, on the shm transport a rank
+whose threads are all computing drains its incoming rings at the helper
+thread's 20Hz last-resort cadence, so a symmetric exchange larger than
+the ring stalls in ~50ms quanta; and a posted ``irecv`` never completes
+(``req._done`` never flips) until somebody calls ``wait``/``test``.
+
+``progress=thread`` starts ONE daemon progress thread per world
+(:class:`ProgressEngine`, attached to the Transport) that
+
+* **parks on the transport's doorbell** instead of spinning — the
+  ``Transport.progress_park`` hook: the shared Mailbox condition
+  variable on socket/local worlds (reader threads / peer sends are the
+  doorbell), the native futex doorbell + inline ring drain on shm
+  (``ShmTransport.progress_park``), so incoming frames are drained into
+  the unexpected-message queue with ~µs latency even when no thread of
+  this rank is receiving;
+* **completes outstanding nonblocking requests** in the background:
+  every posted ``_RecvRequest`` queue of every registered communicator
+  is matched against the transport under the engine's completion lock
+  (the one lock that serializes engine-side and caller-side completion
+  — see ``try_complete``), so ``req._done`` flips without the caller;
+* **advances the segmented engine's credit windows**: a completed
+  pipeline receive runs its ``_on_complete`` callback
+  (``communicator._SegSender.advance``) posting the next windowed send
+  — ``_SEG_WINDOW`` credit advances without the caller being inside
+  ``_seg_exchange``;
+* **is itself a blocking waiter for the runtime verifier**: a rank
+  stuck in a pure-polling drain loop (``MPI_Waitany`` over ``test()``)
+  never enters a blocking wait, so it never published a pending-op
+  entry and escaped deadlock detection (the PR-5 residual).  The engine
+  observes the sustained empty polls, publishes an OR-set entry over
+  the pending requests' sources on the rank's behalf, runs the wait-for
+  analysis, and parks the resulting :class:`DeadlockError` where the
+  polling paths (``Request.test``, ``iprobe``) re-raise it.
+
+Off (the default, ``progress=none``) nothing here is imported on the
+hot path: the entire feature is one ``_progress is None`` attribute
+test per operation and the ``progress_*`` pvars stay 0 — asserted by
+tests/test_progress.py and ``bench.py --verify-overhead --progress``.
+
+Cost model (README "Async progress"): the engine's wakeups are priced
+by the ``progress_wakeups`` / ``progress_completions`` /
+``progress_idle_parks`` pvars.  On a box with spare cores the engine
+converts idle communication latency into compute/comm overlap; on an
+oversubscribed box it competes with ranks for cycles and adds one
+thread hop to blocking-receive latency — opt in per workload.
+
+Enable: ``MPI_TPU_PROGRESS=thread`` in the environment (read by
+``mpi_tpu.init``), ``run_local(..., progress="thread")``,
+``python -m mpi_tpu.launcher --progress thread``, or the ``progress``
+mpit cvar (the default mode new worlds pick up when none of the above
+say otherwise).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+import weakref
+from typing import List, Optional, Tuple
+
+from . import mpit as _mpit
+from .transport.base import ANY_SOURCE, TransportError
+
+# Accepted modes of the ``progress`` cvar / MPI_TPU_PROGRESS env var /
+# run_local(progress=...) / launcher --progress.
+MODES = ("none", "thread")
+
+# Process-wide default mode (mpit cvar ``progress``): what init() and
+# run_local() use when neither the explicit argument nor the
+# MPI_TPU_PROGRESS environment variable picks a mode.
+_DEFAULT_MODE = "none"
+
+# Longest idle park between bookkeeping passes: bounds how stale the
+# engine's view of newly posted requests / stalled-poll episodes can be
+# even if the transport doorbell never rings.
+_PARK_SLICE_S = 0.25
+
+# A pure-polling episode is "live" while the newest empty poll is at
+# most this old; a caller that stopped polling (gave up, went back to
+# computing) stops being published within one slice — an opportunistic
+# poll between real work must never read as a blocked rank.
+_POLL_FRESH_S = 1.0
+
+
+def resolve_mode(explicit: Optional[str] = None) -> str:
+    """The mode a new world should run: explicit argument beats the
+    MPI_TPU_PROGRESS environment variable beats the ``progress`` cvar
+    default."""
+    import os
+
+    mode = explicit or os.environ.get("MPI_TPU_PROGRESS") or _DEFAULT_MODE
+    if mode not in MODES:
+        raise ValueError(
+            f"unknown progress mode {mode!r}; accepted: {list(MODES)}")
+    return mode
+
+
+def enable(comm):
+    """Attach the per-world progress engine to ``comm`` (idempotent per
+    transport — one engine, one thread, shared by every communicator
+    derived from the transport; children created after this pick it up
+    at construction)."""
+    eng = getattr(comm._t, "_progress_engine", None)
+    if eng is None:
+        eng = ProgressEngine(comm._t)
+        comm._t._progress_engine = eng
+    comm._progress = eng
+    eng.register(comm)
+    return comm
+
+
+class ProgressEngine:
+    """One background progress thread per world (per Transport).
+
+    Lock discipline: ``self.cv`` (one condition + lock) serializes ALL
+    request completion — the engine's background pass and the callers'
+    opportunistic ``try_complete`` both hold it around the
+    poll-and-complete step, so a message can never be consumed twice
+    and a request can never be completed by two threads.  Completion
+    callbacks (segmented-engine send-window credit) run OUTSIDE the
+    lock: a callback may block in a ring-full send, and the engine must
+    never make callers wait on that.  The zero-copy pvar contracts are
+    untouched by construction — completion consumes already-delivered
+    mailbox payloads; the engine adds no wire traffic and no copies.
+    """
+
+    def __init__(self, transport) -> None:
+        self.t = transport
+        self.cv = threading.Condition(threading.RLock())
+        self._comms: "weakref.WeakSet" = weakref.WeakSet()
+        self._stop = threading.Event()
+        # Sticky verifier verdict from a stalled-poll analysis: polling
+        # completion paths (Request.test / iprobe / improbe via
+        # _empty_poll_check) re-raise it — a deadlock is permanent, so
+        # every later poll on this rank deserves the same diagnosis.
+        self.pending_error: Optional[BaseException] = None
+        self._last_progress = time.monotonic()
+        # pure-polling episode state (verifier publication on behalf of
+        # Waitany-style drain loops)
+        self._last_empty_poll = 0.0
+        self._episode_start: Optional[float] = None
+        self._episode_block = 0
+        self._published = False
+        self.thread = threading.Thread(
+            target=self._loop, daemon=True,
+            name=f"mpi-tpu-progress-{transport.world_rank}")
+        self.thread.start()
+
+    # -- registration / caller-side hooks ----------------------------------
+
+    def register(self, comm) -> None:
+        """Track a communicator whose posted irecv queues the engine
+        completes.  Called at enable() and from _irecv_internal (cheap:
+        WeakSet.add is idempotent)."""
+        with self.cv:
+            self._comms.add(comm)
+
+    def note_empty_poll(self) -> None:
+        """A nonblocking completion path came up empty (Request.test /
+        iprobe / improbe): the evidence a pure-polling drain loop
+        exists.  Publication on the rank's behalf needs recent AND
+        sustained polls — a single opportunistic poll never starts an
+        episode on its own (see _maybe_publish_stalled)."""
+        self._last_empty_poll = time.monotonic()
+
+    def check_error(self) -> None:
+        if self.pending_error is not None:
+            raise self.pending_error
+
+    def stop(self) -> None:
+        self._stop.set()
+        with self.cv:
+            self.cv.notify_all()
+        # pop the thread out of its transport park promptly: closing
+        # the transport does this too, but explicit stops (run_local
+        # teardown) may keep the transport alive for other use
+        try:
+            self.t.mailbox.nudge()
+        except Exception:  # noqa: BLE001 - teardown best effort
+            pass
+
+    # -- completion (the one locked step) ----------------------------------
+
+    def try_complete(self, req) -> List:
+        """Caller-side completion attempt for ``req``'s queue: complete
+        posted requests head-first (MPI posted-order matching) while
+        the transport has matching traffic.  Caller holds self.cv.
+        Returns the completion callbacks to run after RELEASING the
+        lock."""
+        cbs: List = []
+        q = req._queue
+        while not req._done and q:
+            head = q[0]
+            hit = head._poll_once()
+            if hit is None:
+                break
+            head._complete(hit[0])
+            self._note_complete(head, cbs)
+        return cbs
+
+    def _note_complete(self, req, cbs: List) -> None:
+        self._last_progress = time.monotonic()
+        vw = getattr(req._comm._t, "_verify_world", None)
+        if vw is not None:
+            # a background completion is real progress: stamp it so a
+            # published 'blocked'/'polling' entry retracts promptly
+            vw.note_progress()
+        cb = req._on_complete
+        if cb is not None:
+            cbs.append(cb)
+
+    def _complete_pass(self) -> Tuple[List, int]:
+        """One background pass over every registered communicator's
+        posted irecv queues.  Returns (callbacks, completed_count)."""
+        cbs: List = []
+        done = 0
+        with self.cv:
+            for comm in list(self._comms):
+                with comm._lock:
+                    queues = [q for q in comm._irecv_queues.values() if q]
+                for q in queues:
+                    while q:
+                        head = q[0]
+                        hit = head._poll_once()
+                        if hit is None:
+                            break
+                        head._complete(hit[0])
+                        self._note_complete(head, cbs)
+                        done += 1
+            if done:
+                self.cv.notify_all()
+        return cbs, done
+
+    # -- the loop ----------------------------------------------------------
+
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                cbs, done = self._complete_pass()
+            except TransportError:
+                return  # transport closed under us: world is exiting
+            _mpit.count(progress_wakeups=1,
+                        progress_completions=done)
+            for cb in cbs:
+                # credit-window advancement; send failures are recorded
+                # on the _SegSender and re-raised at the caller's next
+                # fold/drain step, never swallowed here
+                cb()
+            if done:
+                continue  # drained something: immediately look again
+            self._maybe_publish_stalled(time.monotonic())
+            if self._stop.is_set():
+                return
+            try:
+                if not self.t.progress_park(_PARK_SLICE_S):
+                    _mpit.count(progress_idle_parks=1)
+            except TransportError:
+                return
+
+    # -- verifier publication on behalf of pure-polling drain loops --------
+
+    def _pending_tracked(self) -> List[Tuple[object, object]]:
+        """(comm, request) pairs for every posted-and-incomplete
+        USER-level request (the ones with a verifier tracking record):
+        the wait set a polling drain loop is spinning on.  Caller holds
+        self.cv."""
+        out = []
+        for comm in list(self._comms):
+            with comm._lock:
+                queues = [q for q in comm._irecv_queues.values() if q]
+            for q in queues:
+                for req in list(q):
+                    if req._vinfo is not None:
+                        out.append((comm, req))
+        return out
+
+    def _maybe_publish_stalled(self, now: float) -> None:
+        vw = getattr(self.t, "_verify_world", None)
+        if vw is None or self.pending_error is not None:
+            return
+        if vw.active_waiters > 0:
+            # a REAL blocking wait is in flight: the rank's single board
+            # entry is that wait's to publish (it will stall-publish and
+            # analyze itself) — two publishers alternating entries would
+            # flap the stamps and peers' confirm pass could never close
+            self._end_episode(vw)
+            return
+        if now - self._last_empty_poll > _POLL_FRESH_S:
+            # nobody is polling (computing, or gave up): never publish
+            # — an idle posted irecv proves nothing about being stuck,
+            # and publishing it would false-positive on compute-overlap
+            # programs (the same rule _empty_poll_check documents for
+            # single polls)
+            self._end_episode(vw)
+            return
+        with self.cv:
+            pending = self._pending_tracked()
+        if not pending:
+            self._end_episode(vw)
+            return
+        if self._episode_start is None:
+            self._episode_start = now
+            self._episode_block = vw.begin_block()
+            return
+        if now - self._episode_start < vw.stall_timeout_s:
+            return
+        comm, first = pending[0]
+        targets: set = set()
+        for c, req in pending:
+            if req._source == ANY_SOURCE:
+                targets.update(w for w in c._group if w != c._t.world_rank)
+            else:
+                targets.add(c._world(req._source))
+        if not targets:
+            return
+        if vw.published and not self._published:
+            # a REAL blocking wait owns this rank's board entry (and its
+            # own analysis cadence): publishing over it would flap the
+            # entry and a later _end_episode would retract a live wait's
+            # entry mid-confirmation
+            return
+        from .verify import deadlock as _vdl
+
+        self._published = True
+        try:
+            # publishes the entry (OR semantics: ANY pending source
+            # progressing would release the drain loop) and runs the
+            # wait-for analysis + confirm pass, exactly like a blocking
+            # wait's slice — the engine IS this rank's blocking waiter
+            _vdl.check_stalled(
+                vw, comm, tuple(sorted(targets)), "OR", first._tag,
+                "waitany-poll", None,
+                first._vinfo.site if first._vinfo is not None
+                else "<polling loop>",
+                self._episode_block)
+        except _vdl.DeadlockError as e:
+            self.pending_error = e
+            with self.cv:
+                self.cv.notify_all()
+
+    def _end_episode(self, vw) -> None:
+        if self._published:
+            self._published = False
+            vw.clear_published()
+        self._episode_start = None

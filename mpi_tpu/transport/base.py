@@ -64,6 +64,25 @@ class Mailbox:
             self.deliveries += 1
             self._cv.notify_all()
 
+    def nudge(self) -> None:
+        """Wake every waiter without delivering anything — the progress
+        engine's stop path pops its parked thread out of wait_activity."""
+        with self._cv:
+            self._cv.notify_all()
+
+    def wait_activity(self, seen: int, timeout: float) -> int:
+        """Park until the delivery count moves past ``seen`` (or timeout);
+        returns the current count.  The progress engine's doorbell on
+        transports whose deliveries arrive from other threads (socket
+        reader threads, local-world peer sends).  Raises TransportError
+        once closed so a parked engine exits instead of spinning."""
+        with self._cv:
+            if self.deliveries == seen and not self._closed:
+                self._cv.wait(timeout)
+            if self._closed:
+                raise TransportError("transport closed while parked")
+            return self.deliveries
+
     def close(self) -> None:
         with self._cv:
             self._closed = True
@@ -238,6 +257,19 @@ class Transport(ABC):
         self, source: int, ctx, tag: int
     ) -> Optional[Tuple[int, int, Optional[int]]]:
         return self.mailbox.peek_nowait(source, ctx, tag)
+
+    def progress_park(self, timeout: float) -> bool:
+        """Progress-engine park hook (mpi_tpu/progress.py): block until
+        incoming activity or ``timeout``; True iff anything arrived.
+        Base implementation parks on the Mailbox condition variable —
+        correct for every transport whose deliveries are pushed by
+        other threads (socket reader threads, local-world peer sends).
+        Transports that need a consumer to PULL data (shm rings)
+        override this to drive their own progress machinery, parked on
+        a real doorbell instead of spinning.  Raises TransportError
+        once the transport closes, which is the engine's exit signal."""
+        seen = self.mailbox.deliveries
+        return self.mailbox.wait_activity(seen, timeout) != seen
 
     def close(self) -> None:
         self.mailbox.close()

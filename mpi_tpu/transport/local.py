@@ -61,6 +61,7 @@ def run_local(
     recv_timeout: Optional[float] = None,
     fault_tolerance: bool = False,
     verify: bool = False,
+    progress: Optional[str] = None,
 ) -> List[Any]:
     """Run ``fn(comm, *args, **kwargs)`` on ``nranks`` in-process ranks;
     return the per-rank results as a list indexed by rank.
@@ -84,9 +85,17 @@ def run_local(
     lints land in ``mpi_tpu.verify.take_report()`` + ``verify_*`` pvars.
     A rank whose ``fn`` returns publishes 'exited', so a peer blocked on
     it is diagnosed rather than stuck until the run_local timeout.
+
+    ``progress="thread"`` starts one async progress engine per rank
+    (mpi_tpu/progress.py): posted irecvs complete in the background and
+    pure-polling drain loops join deadlock detection.  ``None`` defers
+    to the MPI_TPU_PROGRESS environment variable / ``progress`` cvar;
+    ``"none"`` forces it off.
     """
+    from .. import progress as _progress
     from ..communicator import P2PCommunicator
 
+    progress_mode = _progress.resolve_mode(progress)
     kwargs = kwargs or {}
     world = LocalWorld(nranks, copy_payloads=copy_payloads)
     results: List[Any] = [None] * nranks
@@ -106,6 +115,7 @@ def run_local(
     def runner(r: int) -> None:
         ft_state = None
         v_state = None
+        engine = None
         try:
             t: Transport = LocalTransport(world, r)
             if transport_wrapper is not None:
@@ -119,6 +129,8 @@ def run_local(
                 from .. import verify as _verify
 
                 v_state = _verify.enable(comm, board=board)._verify
+            if progress_mode == "thread":
+                engine = _progress.enable(comm)._progress
             results[r] = fn(comm, *args, **kwargs)
             if v_state is not None:
                 v_state.world.mark_exited()
@@ -138,6 +150,8 @@ def run_local(
         finally:
             if ft_state is not None:
                 ft_state.world.stop()
+            if engine is not None:
+                engine.stop()
 
     threads = [
         threading.Thread(target=runner, args=(r,), name=f"mpi-tpu-rank-{r}", daemon=True)

@@ -385,6 +385,39 @@ class ShmTransport(Transport):
                 self._lib.shmdb_wait(self._db, seen, slice_s)
                 continue
 
+    def progress_park(self, timeout: float) -> bool:
+        """Progress-engine park hook (mpi_tpu/progress.py): the shm
+        rings need a consumer to PULL frames, so the engine's park IS a
+        progress step — take the progress lock and run one doorbell-
+        parked drain slice (exactly a user receiver's loop body), or
+        nap on the doorbell when another thread owns the engine.  This
+        is what replaces the helper thread's 20Hz last-resort cadence
+        with ~µs doorbell latency while every thread of this rank is
+        computing or stuck in a ring-full send: without it a symmetric
+        exchange larger than the ring advances in 50ms quanta (the
+        measured 16MB ialltoall stall the overlap bench prices).  User
+        receivers keep their one-wakeup inline-drain priority — when
+        one is waiting, the engine stands down onto the doorbell like
+        the helper does."""
+        if self._closing:
+            raise TransportError(
+                f"rank {self.world_rank}: transport closed while parked")
+        before = self.mailbox.deliveries
+        seen = self._lib.shmdb_read(self._db)
+        if (self._user_waiters == 0
+                and self._progress_lock.acquire(blocking=False)):
+            try:
+                if self._closing:
+                    raise TransportError(
+                        f"rank {self.world_rank}: transport closed while "
+                        f"parked")
+                self._progress_wait(timeout)
+            finally:
+                self._progress_lock.release()
+        elif self.mailbox.deliveries == before:
+            self._lib.shmdb_wait(self._db, seen, timeout)
+        return self.mailbox.deliveries != before
+
     # -- Transport interface (incoming) ------------------------------------
 
     def recv(self, source: int, ctx, tag: int,

@@ -124,12 +124,48 @@ class FileBoard:
     are atomic (tmp + rename) so a reader never sees a torn entry; a
     missing/corrupt file reads as 'no entry' (= running), which the
     analysis treats as able-to-progress — crash-safe in the direction
-    that never false-positives."""
+    that never false-positives.
+
+    Scaling (the PR-5 FileBoard residual): a naive ``read_all`` is O(P)
+    file read+parse per check slice, which at O(100) ranks puts real
+    I/O on every stalled wait's 0.25s cadence.  Readers therefore keep
+    a compacted ``pending.summary.json`` beside the per-rank files:
+    ``read_all`` stats each per-rank file (cheap) and re-reads ONLY the
+    ones whose ``(mtime_ns, size)`` identity moved past the summary's
+    record — AND any file touched within the last ``_MTIME_TRUST_S``,
+    because on a coarse-mtime filesystem two distinct publishes inside
+    one mtime tick with equal sizes would alias, and serving the stale
+    stamps could CONFIRM a false deadlock (the one direction this board
+    must never err).  A genuinely stalled rank republishes every check
+    slice, so 'recently touched' ≈ 'the blocked ranks': the compaction
+    still saves the parses for the quiet majority.  The summary is
+    republished after any fallback read (atomic rename,
+    last-writer-wins — every writer writes exactly what it just
+    verified fresh); each ``publish`` stamps a per-rank monotonic
+    ``_seq`` into the entry as forensic ordering evidence.  A stale or
+    corrupt summary only costs fallback reads — correctness never
+    depends on it."""
+
+    SUMMARY = "pending.summary.json"
+    # Cache-trust horizon: a file whose mtime is younger than this is
+    # always re-read (coarse-mtime aliasing guard, see class docstring).
+    # Must STRICTLY exceed the worst plausible mtime granularity (1-2s
+    # on ext3/NFS/FAT-class filesystems): mtimes floor DOWN, so a file
+    # can look up to one granule older than its newest write — only an
+    # apparent age past granularity + margin proves its mtime granule
+    # is really over and no same-identity rewrite can still be hiding.
+    _MTIME_TRUST_S = 2.5
 
     def __init__(self, rdv_dir: str, rank: int, size: int) -> None:
         self._rdv = rdv_dir
         self._rank = rank
         self._size = size
+        self._seq = 0
+        # summary cache: rank(str) -> {"id": [mtime_ns, size, seq],
+        # "entry": {...}}; loaded lazily from SUMMARY, refreshed on use
+        self._cache: Dict[str, dict] = {}
+        self._cache_loaded = False
+        self.fallback_reads = 0  # test/tool introspection
 
     def _path(self, rank: int) -> str:
         return os.path.join(self._rdv, f"pending.{rank}")
@@ -143,6 +179,9 @@ class FileBoard:
                 except FileNotFoundError:
                     pass
                 return
+            self._seq += 1
+            entry = dict(entry)
+            entry["_seq"] = self._seq
             tmp = path + ".tmp"
             with open(tmp, "w") as f:
                 json.dump(entry, f)
@@ -150,23 +189,80 @@ class FileBoard:
         except OSError:
             pass  # rendezvous dir tearing down — world is exiting
 
+    def _load_summary(self) -> None:
+        if self._cache_loaded:
+            return
+        self._cache_loaded = True
+        try:
+            with open(os.path.join(self._rdv, self.SUMMARY)) as f:
+                data = json.load(f)
+            if isinstance(data, dict):
+                self._cache = {
+                    r: rec for r, rec in data.items()
+                    if isinstance(rec, dict) and "id" in rec
+                    and "entry" in rec}
+        except (OSError, ValueError):
+            self._cache = {}  # absent/corrupt summary = just fall back
+
+    def _read_entry(self, path: str) -> Optional[dict]:
+        self.fallback_reads += 1
+        try:
+            with open(path) as f:
+                return json.load(f)
+        except (OSError, ValueError):
+            return None  # mid-replace / torn dir: treat as no entry
+
     def read_all(self) -> Dict[int, dict]:
         import time
 
+        self._load_summary()
         now = time.time()
         out: Dict[int, dict] = {}
+        dirty = False
         for r in range(self._size):
             path = self._path(r)
             try:
-                with open(path) as f:
-                    entry = json.load(f)
-                # wall-clock mtime: the one cross-process-comparable
-                # stamp (monotonic clocks don't compare across ranks)
-                entry["_age_s"] = max(0.0, now - os.stat(path).st_mtime)
-                out[r] = entry
-            except (OSError, ValueError):
+                st = os.stat(path)
+            except OSError:
+                if self._cache.pop(str(r), None) is not None:
+                    dirty = True
                 continue
+            rec = self._cache.get(str(r))
+            if (rec is not None
+                    and rec["id"][:2] == [st.st_mtime_ns, st.st_size]
+                    and now - st.st_mtime_ns / 1e9 >= self._MTIME_TRUST_S):
+                entry = dict(rec["entry"])
+            else:
+                entry = self._read_entry(path)
+                if entry is None:
+                    continue
+                new_rec = {
+                    "id": [st.st_mtime_ns, st.st_size,
+                           entry.get("_seq", 0)],
+                    "entry": entry}
+                # recency re-reads of an UNCHANGED file must not churn
+                # the summary — only a moved identity rewrites it
+                if rec is None or rec["id"] != new_rec["id"]:
+                    dirty = True
+                self._cache[str(r)] = new_rec
+                entry = dict(entry)
+            # wall-clock mtime: the one cross-process-comparable
+            # stamp (monotonic clocks don't compare across ranks)
+            entry["_age_s"] = max(0.0, now - st.st_mtime_ns / 1e9)
+            out[r] = entry
+        if dirty:
+            self._write_summary()
         return out
+
+    def _write_summary(self) -> None:
+        path = os.path.join(self._rdv, self.SUMMARY)
+        tmp = f"{path}.tmp.{os.getpid()}.{self._rank}"
+        try:
+            with open(tmp, "w") as f:
+                json.dump(self._cache, f)
+            os.replace(tmp, path)
+        except OSError:
+            pass  # rendezvous dir tearing down — summary is best effort
 
 
 # -- request / buffer lint bookkeeping ---------------------------------------
@@ -220,6 +316,12 @@ class WorldVerify:
         self._lock = threading.Lock()
         self.ops = 0          # completed sends+recvs: the progress stamp
         self.block_id = 0     # increments at every blocking-wait entry
+        # threads currently INSIDE a verified blocking wait: while any
+        # exist, the rank's board entry belongs to them — the progress
+        # engine's on-behalf-of-pollers publication stands down (two
+        # publishers alternating entries would flap the stamps and the
+        # confirm pass could never close)
+        self.active_waiters = 0
         self.published = False
         self._last_check = 0.0
         self._live: set = set()          # VInfos not yet completed/waited
@@ -254,6 +356,14 @@ class WorldVerify:
     def begin_block(self) -> int:
         self.block_id += 1
         return self.block_id
+
+    def wait_enter(self) -> None:
+        with self._lock:
+            self.active_waiters += 1
+
+    def wait_exit(self) -> None:
+        with self._lock:
+            self.active_waiters -= 1
 
     def mark_exited(self) -> None:
         """Published when the rank's program returns/finalizes: a peer

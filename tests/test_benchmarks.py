@@ -31,6 +31,20 @@ def test_busbw_convention():
     assert busbw_gbps("bcast", 10**9, 4, 1.0) == pytest.approx(1.0)
 
 
+def test_overlap_local_smoke():
+    """The overlap bench's row schema on the local backend: percentages
+    in range, the fixed compute target recorded, the progress mode
+    labeled (local backend without enable = none)."""
+    rows = run_bench("overlap", "local", 2, [4096], None, iters=2, warmup=0)
+    assert rows, "no overlap rows"
+    for r in rows:
+        assert r["bench"] == "overlap" and r["progress"] == "none"
+        assert 0.0 <= r["overlap_pct"] <= 100.0
+        assert 0.0 <= r["availability_pct"] <= 100.0
+        assert r["compute_target_us"] >= 200.0
+        assert np.isfinite(r["pure_us"]) and r["pure_us"] > 0
+
+
 @pytest.mark.parametrize("bench", ["latency", "allreduce", "allgather", "alltoall",
                                    "reduce_scatter"])
 def test_local_smoke(bench):
@@ -75,8 +89,17 @@ def test_host_sweep_quick_smoke():
     assert {r["leg"] for r in small} == {"osu_latency", "osu_barrier",
                                          "osu_allreduce"}
     assert {r["backend"] for r in small} == {"socket", "shm"}
+    # ISSUE 6 satellite: the compute/comm overlap leg rode along, under
+    # BOTH progress modes on both host transports, with sane percentages
+    ov = [r for r in result["overlap_rows"] if "overlap_pct" in r]
+    assert {r["backend"] for r in ov} == {"socket", "shm"}
+    assert {r["progress"] for r in ov} == {"none", "thread"}
+    for r in ov:
+        assert 0.0 <= r["overlap_pct"] <= 100.0, r
+        assert 0.0 <= r["availability_pct"] <= 100.0, r
+        assert r["pure_us"] > 0 and r["compute_us"] > 0
     assert "oversubscribed" in result
-    for key in ("allreduce_rows", "small_message_rows"):
+    for key in ("allreduce_rows", "small_message_rows", "overlap_rows"):
         for r in result[key]:
             if "p50_us" in r:
                 assert isinstance(r["oversubscribed"], bool), r

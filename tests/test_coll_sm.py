@@ -117,6 +117,101 @@ def test_allgather_ragged_and_object_payloads_fall_back():
     assert mpit.pvar_read("coll_sm_fallbacks") - f0 >= 3  # object leg
 
 
+@pytest.mark.parametrize("algo", ["sm", "auto"])
+def test_alltoall_parity(algo):
+    """Arena alltoall (write-all-blocks → flag round → read-your-column,
+    ISSUE 6 satellite) matches the pairwise wire exchange for every
+    group size, including [P, ...] ndarray inputs."""
+    for n in NRANKS:
+        def prog(comm):
+            blocks = [np.full(9, comm.rank * 100 + d, np.float64)
+                      for d in range(comm.size)]
+            out = comm.alltoall(blocks, algorithm=algo)
+            stacked = comm.alltoall(
+                np.stack(blocks), algorithm=algo)  # ndarray spelling
+            return np.asarray(out)[:, 0].tolist(), \
+                np.asarray(stacked)[:, 0].tolist()
+
+        for r, (got, got2) in enumerate(run_shm_world(prog, n)):
+            want = [q * 100.0 + r for q in range(n)]
+            assert got == want, (n, r, got)
+            assert got2 == want, (n, r, got2)
+
+
+@pytest.mark.parametrize("algo", ["sm", "auto"])
+def test_scan_parity(algo):
+    """Arena scan (write-own → flag round → fold slots 0..rank in
+    place) matches the distance-doubling wire scan, scalars included."""
+    for n in NRANKS:
+        data = [np.random.RandomState(30 * n + i).randn(17)
+                for i in range(n)]
+
+        def prog(comm):
+            v = comm.scan(data[comm.rank], ops.SUM, algorithm=algo)
+            s = comm.scan(float(comm.rank + 1), algorithm=algo)
+            return v, s
+
+        for r, (v, s) in enumerate(run_shm_world(prog, n)):
+            np.testing.assert_allclose(v, sum(data[:r + 1]),
+                                       err_msg=f"n={n} r={r}")
+            assert float(s) == sum(range(1, r + 2))
+
+
+def test_alltoall_scan_zero_frames_and_hits():
+    """The new arena paths keep the arena's contract: zero ring frames,
+    zero pickled bytes, ≤2 payload copies per rank, hits counted."""
+    n = 3
+
+    def prog(comm):
+        blocks = [np.full(64, comm.rank * 10 + d, np.float64)
+                  for d in range(comm.size)]
+        a2a = comm.alltoall(blocks, algorithm="sm")
+        sc = comm.scan(np.full(64, float(comm.rank)), algorithm="sm")
+        assert np.asarray(a2a)[:, 0].tolist() == \
+            [q * 10.0 + comm.rank for q in range(comm.size)]
+        np.testing.assert_allclose(
+            sc, np.full(64, float(sum(range(comm.rank + 1)))))
+        return True
+
+    names = ("msgs_sent", "bytes_pickled_sent", "payload_copies",
+             "coll_sm_hits", "bytes_raw_sent")
+    res, d = _deltas(run_shm_world, prog, n, names)
+    assert all(res)
+    assert d["msgs_sent"] == 0, f"arena alltoall/scan sent frames: {d}"
+    assert d["bytes_pickled_sent"] == 0 and d["bytes_raw_sent"] == 0
+    assert d["coll_sm_hits"] == 2 * n
+    assert d["payload_copies"] <= 2 * 2 * n  # ≤2 per rank per collective
+
+
+def test_alltoall_object_and_ragged_fall_back():
+    """Object payloads and ragged per-destination blocks decline the
+    arena THROUGH the in-arena negotiation (no deadlock, no wrong
+    answer) and complete on the pairwise wire path."""
+    def prog(comm):
+        objs = [{"from": comm.rank, "to": d} for d in range(comm.size)]
+        got = comm.alltoall(objs)  # auto: negotiation must decline
+        ragged = [np.arange(d + 1, dtype=np.float64)
+                  for d in range(comm.size)]
+        got_r = comm.alltoall(ragged)
+        return ([o["from"] for o in got],
+                [g.shape[0] for g in got_r])
+
+    for r, (froms, shapes) in enumerate(run_shm_world(prog, 3)):
+        assert froms == [0, 1, 2]
+        assert shapes == [r + 1] * 3
+
+
+def test_scan_gate_rejects_sm_off_shm():
+    def prog(comm):
+        with pytest.raises(ValueError, match="scan algorithm"):
+            comm.scan(1.0, algorithm="sm")
+        with pytest.raises(ValueError, match="alltoall algorithm"):
+            comm.alltoall([1.0] * comm.size, algorithm="sm")
+        return True
+
+    assert all(run_local(prog, 2))
+
+
 def test_mismatched_reduction_geometry_falls_back():
     """Cross-rank dtype drift must not misfold in place: the metas
     disagree, every rank declines together, and the generic wire path's

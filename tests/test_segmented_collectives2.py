@@ -93,7 +93,10 @@ def test_alltoall_zero_pickled_bytes(transport):
     def prog(comm):
         rng = np.random.RandomState(comm.rank)
         blocks = list(rng.randn(n, nelem))
-        got = comm.alltoall(blocks)
+        # pin the WIRE exchange: this test proves the windowed pairwise
+        # engine's byte plane (auto now routes to the coll/sm arena on
+        # shm, whose copy accounting is test_coll_sm.py's contract)
+        got = comm.alltoall(blocks, algorithm="pairwise")
         for s in range(n):
             np.testing.assert_array_equal(
                 np.asarray(got)[s],
@@ -346,7 +349,9 @@ def test_scatter_gather_scan_zero_pickled_array_bytes(transport):
         parts = rng.randn(n, nelem)
         mine = comm.scatter(list(parts) if comm.rank == 0 else None, root=0)
         np.testing.assert_array_equal(mine, parts[comm.rank])
-        sc = comm.scan(mine)
+        # pin the WIRE prefix exchange (auto routes scan to the coll/sm
+        # arena on shm; the arena's copy accounting is test_coll_sm.py's)
+        sc = comm.scan(mine, algorithm="doubling")
         np.testing.assert_allclose(sc, parts[:comm.rank + 1].sum(0))
         back = comm.gather(mine, root=0)
         if comm.rank == 0:

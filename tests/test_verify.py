@@ -554,6 +554,71 @@ def test_verify_overhead_quick_smoke():
 
 # -- process worlds (FileBoard) ----------------------------------------------
 
+
+def test_fileboard_summary_compaction(tmp_path):
+    """ISSUE 6 satellite (PR-5 FileBoard residual): at ≥8 ranks read_all
+    consults the compacted ``pending.summary.json`` first and re-reads
+    ONLY per-rank files whose stat identity moved — correctness
+    unchanged (entries, ages, staleness) with O(changed) parses instead
+    of O(P)."""
+    from mpi_tpu.verify.state import FileBoard
+
+    size = 10
+    rdv = str(tmp_path)
+    boards = [FileBoard(rdv, r, size) for r in range(size)]
+    for r in range(size):
+        boards[r].publish(r, {"state": "blocked", "rank": r,
+                              "targets": [(r + 1) % size], "mode": "AND"})
+
+    reader = FileBoard(rdv, 0, size)
+    out = reader.read_all()
+    assert set(out) == set(range(size))
+    assert all(out[r]["rank"] == r and "_age_s" in out[r]
+               for r in range(size))
+    assert reader.fallback_reads == size  # cold cache: full read once
+    import os as _os
+    import time as _time
+
+    assert _os.path.exists(_os.path.join(rdv, FileBoard.SUMMARY))
+
+    # steady state: nothing changed AND entries older than the mtime
+    # trust horizon → stats only, zero entry parses
+    _time.sleep(FileBoard._MTIME_TRUST_S + 0.1)
+    reader.read_all()  # recency re-reads of the now-aged entries
+    base_reads = reader.fallback_reads
+    out2 = reader.read_all()
+    assert reader.fallback_reads == base_reads
+    assert {r: out2[r]["rank"] for r in out2} == \
+        {r: out[r]["rank"] for r in out}
+
+    # one rank republishes → re-read (it is both changed and recent);
+    # the other aged, unchanged ranks stay served from the summary
+    boards[3].publish(3, {"state": "blocked", "rank": 3,
+                          "targets": [7], "mode": "AND"})
+    out3 = reader.read_all()
+    assert out3[3]["targets"] == [7]
+    assert reader.fallback_reads == base_reads + 1
+
+    # a retraction (unlink) disappears without any entry read (rank 3's
+    # fresh file stays inside the trust horizon → re-read, nothing else)
+    boards[5].publish(5, None)
+    before = reader.fallback_reads
+    out4 = reader.read_all()
+    assert 5 not in out4 and len(out4) == size - 1
+    assert reader.fallback_reads <= before + 1  # only recent rank 3
+
+    # a FRESH reader seeds from the summary: only changed/missing files
+    # need parsing (rank 3's record in the on-disk summary may predate
+    # its republish depending on writer order — at most that one read)
+    reader2 = FileBoard(rdv, 1, size)
+    out5 = reader2.read_all()
+    assert set(out5) == set(out4)
+    assert reader2.fallback_reads <= 1
+
+    # per-rank seq stamps are monotonic per publisher
+    assert out3[3]["_seq"] > out[3]["_seq"]
+
+
 _E2E_DEADLOCK = """
 import os, sys
 sys.path.insert(0, {repo!r})
