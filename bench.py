@@ -558,10 +558,29 @@ if __name__ == "__main__":
         # FaultyTransport drop/delay/duplicate sweep over the collective
         # family asserting diagnose-don't-hang (ISSUE 3 satellite);
         # --quick is the tier-1 smoke spelling, mirroring --sweep's.
+        # --serve (ISSUE 7) swaps in the resident-pool leg: continuous
+        # SIGKILL against a live world server, asserting worlds/sec
+        # never reaches zero and every lease completes or raises a
+        # named FT error.
         from benchmarks import chaos
 
-        sys.exit(chaos.main(
-            ["--quick"] if "--quick" in sys.argv[1:] else []))
+        args = ["--quick"] if "--quick" in sys.argv[1:] else []
+        if "--serve" in sys.argv[1:]:
+            args.append("--serve")
+        sys.exit(chaos.main(args))
+    if "--serve-bench" in sys.argv[1:]:
+        # world-churn leg (ISSUE 7): resident world server vs cold
+        # launch() — worlds/sec + p99 world-acquire latency; the full
+        # run writes the committed serve_{pre,post}.json artifacts.
+        from benchmarks import serve_bench
+
+        if "--quick" in sys.argv[1:]:
+            sys.exit(serve_bench.main(["--quick"]))
+        sys.exit(serve_bench.main(
+            ["--out-pre", os.path.join(REPO, "benchmarks", "results",
+                                       "serve_pre.json"),
+             "--out-post", os.path.join(REPO, "benchmarks", "results",
+                                        "serve_post.json")]))
     if "--verify-overhead" in sys.argv[1:]:
         # verifier cost leg (ISSUE 5): asserts the off-mode zero-cost
         # contract (pvar-identical hot path) and prices the on-mode.

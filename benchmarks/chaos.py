@@ -150,12 +150,151 @@ def run_chaos(quick: bool = False) -> Dict:
     }
 
 
+def run_serve_chaos(quick: bool = False, backend: str = "socket") -> Dict:
+    """The resident-pool chaos leg (ISSUE 7 satellite): continuous
+    ``SIGKILL`` against a live world server while a client churns
+    lease → allreduce → release cycles.  The contract under fire:
+
+    * every lease either COMPLETES (with the correct result) or raises
+      a NAMED error (ProcFailedError / RevokedError / the lease-timeout
+      TimeoutError) — never a hang, never an anonymous crash;
+    * worlds/sec never reaches zero: each observation window must
+      complete at least one world (the pool self-heals faster than the
+      killer drains it);
+    * the pool ends the run healed (full strength, epoch advanced, and
+      a final full-pool allreduce is correct).
+    """
+    import random
+    import signal as _signal
+
+    from mpi_tpu import serve
+    from mpi_tpu.errors import EpochSkewError
+
+    pool = 3
+    duration_s = 8.0 if quick else 20.0
+    kill_every_s = 2.0 if quick else 2.5
+    window_s = 4.0
+    rng = random.Random(1234)
+    t0 = time.time()
+    outcomes: List[Dict] = []
+    kills = 0
+    stop = [False]
+    with serve.WorldServer(pool_size=pool, backend=backend,
+                           detect_timeout_s=1.5, heartbeat_s=0.2,
+                           world_lease_timeout_s=10.0,
+                           rejoin_timeout_s=15.0) as srv:
+
+        def killer():
+            nonlocal kills
+            while not stop[0]:
+                time.sleep(kill_every_s)
+                if stop[0]:
+                    return
+                with srv._lock:
+                    live = [w.proc for w in srv._workers.values()
+                            if w.proc is not None
+                            and w.proc.poll() is None]
+                if live:
+                    try:
+                        os.kill(rng.choice(live).pid, _signal.SIGKILL)
+                        kills += 1
+                    except OSError:
+                        pass
+
+        import threading
+
+        kth = threading.Thread(target=killer, daemon=True)
+        kth.start()
+        client = serve.connect(srv)
+        deadline = time.monotonic() + duration_s
+        while time.monotonic() < deadline:
+            t_cycle = time.monotonic()
+            try:
+                lease = client.acquire(2, timeout=6.0)
+                try:
+                    got = lease.run(serve.job_allreduce, 256,
+                                    timeout=8.0)
+                    if got != 3.0:
+                        outcome = f"wrong_result:{got}"
+                    else:
+                        outcome = "ok"
+                finally:
+                    lease.release()
+            except (ProcFailedError, RevokedError, EpochSkewError,
+                    RecvTimeout, TransportError, TimeoutError) as e:
+                outcome = f"diagnosed:{type(e).__name__}"
+            except Exception as e:  # noqa: BLE001 - the failing verdict
+                outcome = f"error:{type(e).__name__}: {str(e)[:120]}"
+            outcomes.append({"at_s": round(time.monotonic()
+                                           - (deadline - duration_s), 2),
+                             "outcome": outcome,
+                             "wall_ms": round((time.monotonic()
+                                               - t_cycle) * 1e3, 1)})
+        stop[0] = True
+        kth.join(timeout=5.0)
+        # the pool must HEAL once the killing stops...
+        heal_deadline = time.monotonic() + 30.0
+        healed = False
+        while time.monotonic() < heal_deadline:
+            st = client.stats()
+            if st["idle"] == pool and not st["healing"]:
+                healed = True
+                break
+            time.sleep(0.3)
+        # ... and serve a correct full-pool world again
+        final_ok = False
+        if healed:
+            try:
+                final_ok = client.run(serve.job_allreduce, 256,
+                                      nranks=pool, timeout=15.0) == 6.0
+            except Exception:  # noqa: BLE001 - recorded below
+                final_ok = False
+        stats = client.stats()
+    completed = [o for o in outcomes if o["outcome"] == "ok"]
+    bad = [o for o in outcomes
+           if o["outcome"].startswith(("wrong_result", "error"))]
+    # worlds/sec never zero: every window must complete >= 1 world
+    nwin = max(1, int(duration_s // window_s))
+    windows = [0] * nwin
+    for o in completed:
+        windows[min(nwin - 1, int(o["at_s"] // window_s))] += 1
+    return {
+        "quick": quick, "backend": backend, "pool_size": pool,
+        "duration_s": duration_s, "kills": kills,
+        "cycles": len(outcomes), "completed_worlds": len(completed),
+        "worlds_per_s": round(len(completed) / duration_s, 2),
+        "windows_completed": windows,
+        # worlds churn at O(100)/s: keep the full record only for the
+        # abnormal cycles (diagnosed + failed), not thousands of "ok"s
+        "outcomes_abnormal": [o for o in outcomes
+                              if o["outcome"] != "ok"][:200],
+        "unnamed_failures": bad,
+        "healed": healed, "final_allreduce_ok": final_ok,
+        "final_epoch": stats["epoch"],
+        "heals_completed": stats["heals_completed"],
+        "oversubscribed": (pool + 2) > (os.cpu_count() or 1),
+        "ok": (not bad and healed and final_ok and kills > 0
+               and all(w > 0 for w in windows)),
+        "wall_s": round(time.time() - t0, 1),
+    }
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--quick", action="store_true",
                     help="smoke mode: a subset of collectives per fault")
+    ap.add_argument("--serve", action="store_true",
+                    help="resident-pool leg: continuous SIGKILL against "
+                         "a live world server; asserts worlds/sec never "
+                         "reaches zero and every lease completes or "
+                         "raises a named FT error")
+    ap.add_argument("--backend", choices=("socket", "shm"),
+                    default="socket")
     args = ap.parse_args(argv)
-    result = run_chaos(quick=args.quick)
+    if args.serve:
+        result = run_serve_chaos(quick=args.quick, backend=args.backend)
+    else:
+        result = run_chaos(quick=args.quick)
     print(json.dumps(result, indent=2))
     return 0 if result["ok"] else 1
 

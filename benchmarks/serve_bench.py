@@ -1,0 +1,156 @@
+#!/usr/bin/env python
+"""World-churn benchmark: resident world server vs cold ``launch()``.
+
+The resident server's whole thesis (ROADMAP direction #1) is that the
+"many small worlds at high rate" workload should not pay fork + import
++ transport handshake per world.  This harness prices both paths on the
+same box and the same job (a correctness-checked 2-rank allreduce):
+
+* **cold** (``serve_pre.json``): each world is a full
+  ``launcher.launch(2, script)`` — fork two interpreters, import
+  numpy/mpi_tpu, rendezvous, run the allreduce, tear down.  The
+  world-acquire latency IS the launch wall time.
+* **serve** (``serve_post.json``): one warm pool, then
+  ``acquire → run → release`` cycles; world-acquire latency is the
+  acquire round-trip (a reservation in server memory), and worlds/sec
+  counts completed cycles.
+
+Output rows carry ``oversubscribed`` like every bench artifact (this
+box runs pool + driver on 2 cores).  Acceptance (ISSUE 7): lease p99
+acquire >= 10x faster than cold launch.
+
+Usage::
+
+    python benchmarks/serve_bench.py [--quick] [--backend socket|shm]
+        [--out-pre PATH] [--out-post PATH]
+    python bench.py --serve-bench [--quick]    # the CI spelling
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import statistics
+import sys
+import tempfile
+import time
+from typing import Dict, List
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+_COLD_SCRIPT = """
+import sys
+sys.path.insert(0, {repo!r})
+import numpy as np
+import mpi_tpu
+comm = mpi_tpu.init()
+out = comm.allreduce(np.full(256, comm.rank + 1.0, np.float32))
+assert float(out[0]) == 3.0, out[0]
+"""
+
+
+def _pctl(xs: List[float], q: float) -> float:
+    if not xs:
+        return float("nan")
+    ordered = sorted(xs)
+    return ordered[min(len(ordered) - 1, int(q * len(ordered)))]
+
+
+def _stats_ms(xs: List[float]) -> Dict:
+    return {"n": len(xs),
+            "p50_ms": round(statistics.median(xs) * 1e3, 3),
+            "p99_ms": round(_pctl(xs, 0.99) * 1e3, 3),
+            "min_ms": round(min(xs) * 1e3, 3),
+            "max_ms": round(max(xs) * 1e3, 3)}
+
+
+def cold_leg(nworlds: int, backend: str) -> Dict:
+    from mpi_tpu import launcher
+
+    script = os.path.join(tempfile.mkdtemp(prefix="serve_bench_"),
+                          "world.py")
+    with open(script, "w") as f:
+        f.write(_COLD_SCRIPT.format(repo=REPO))
+    times: List[float] = []
+    for _ in range(nworlds):
+        t0 = time.monotonic()
+        rc = launcher.launch(2, [script], timeout=120.0, backend=backend)
+        times.append(time.monotonic() - t0)
+        assert rc == 0, f"cold world failed with exit code {rc}"
+    return {"mode": "cold_launch", "nranks": 2,
+            "worlds": nworlds,
+            "worlds_per_s": round(nworlds / sum(times), 3),
+            "acquire": _stats_ms(times),  # a cold acquire IS the launch
+            "world_total": _stats_ms(times)}
+
+
+def serve_leg(ncycles: int, backend: str) -> Dict:
+    from mpi_tpu import serve
+
+    acquire_s: List[float] = []
+    cycle_s: List[float] = []
+    with serve.WorldServer(pool_size=3, backend=backend,
+                           detect_timeout_s=2.0) as srv:
+        client = serve.connect(srv)
+        t_pool0 = srv._workers  # pool brought up inside WorldServer.start
+        for _ in range(ncycles):
+            t0 = time.monotonic()
+            lease = client.acquire(2, timeout=30.0)
+            acquire_s.append(time.monotonic() - t0)
+            got = lease.run(serve.job_allreduce, 256, timeout=30.0)
+            assert got == 3.0, got
+            lease.release()
+            cycle_s.append(time.monotonic() - t0)
+        stats = client.stats()
+    assert len(t_pool0) == 3
+    return {"mode": "resident_serve", "nranks": 2, "pool_size": 3,
+            "worlds": ncycles,
+            "worlds_per_s": round(ncycles / sum(cycle_s), 3),
+            "acquire": _stats_ms(acquire_s),
+            "world_total": _stats_ms(cycle_s),
+            "server_stats": {k: stats[k] for k in
+                             ("epoch", "leases_granted", "jobs_ok",
+                              "jobs_failed", "heals_completed")}}
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--quick", action="store_true",
+                    help="smoke mode: few worlds, stdout only")
+    ap.add_argument("--backend", choices=("socket", "shm"),
+                    default="socket")
+    ap.add_argument("--out-pre", default=None)
+    ap.add_argument("--out-post", default=None)
+    args = ap.parse_args(argv)
+    nworlds = 3 if args.quick else 7
+    ncycles = 25 if args.quick else 300
+    common = {
+        "backend": args.backend,
+        "payload_f32": 256,
+        # pool/world procs + the pytest/bench driver exceed this box's
+        # cores: latency tails here carry scheduler noise
+        "oversubscribed": 4 > (os.cpu_count() or 1),
+        "cpu_count": os.cpu_count(),
+    }
+    pre = {**common, **cold_leg(nworlds, args.backend)}
+    post = {**common, **serve_leg(ncycles, args.backend)}
+    ratio = (pre["acquire"]["p99_ms"] / post["acquire"]["p99_ms"]
+             if post["acquire"]["p99_ms"] else float("inf"))
+    summary = {
+        "pre": pre, "post": post,
+        "cold_p99_over_lease_p99_acquire": round(ratio, 1),
+        "acceptance_lease_10x_faster": ratio >= 10.0,
+    }
+    print(json.dumps(summary, indent=2))
+    if not args.quick:
+        for path, payload in ((args.out_pre, pre), (args.out_post, post)):
+            if path:
+                with open(path, "w") as f:
+                    json.dump(payload, f, indent=2)
+    return 0 if ratio >= 10.0 else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -33,7 +33,7 @@ from .ops import SUM, PROD, MAX, MIN, LAND, LOR, LXOR, BAND, BOR, BXOR, ReduceOp
 from .communicator import Communicator, Message, P2PCommunicator, Request, Status
 from .transport.base import ANY_SOURCE, ANY_TAG
 from .transport.local import run_local
-from . import datatypes, errors, ft, io, mpi4, progress, schedules, checker, checkpoint, profiling, trace, verify
+from . import datatypes, errors, ft, io, membership, mpi4, progress, schedules, checker, checkpoint, profiling, trace, verify
 from .intercomm import InterComm, create_intercomm
 from .topology import (CartComm, GraphComm, HierarchicalComm, cart_create,
                        dims_create, dist_graph_create_adjacent,
@@ -44,13 +44,28 @@ from .spawn import (comm_accept, comm_connect, comm_get_parent, comm_spawn,
                     publish_name, unpublish_name)
 from .shmwin import SharedWindow, win_allocate_shared
 from .window import GetFuture, P2PWindow
+from .membership import rejoin
+
+
+def connect(addr, timeout: float = 30.0):
+    """Connect to a resident world server (mpi_tpu/serve.py): returns a
+    :class:`~mpi_tpu.serve.ServerClient` whose ``acquire(nranks)``
+    leases a warm world in one round-trip.  ``addr`` is "host:port", a
+    (host, port) tuple, an in-process WorldServer, or the path to a
+    ``serve --addr-file`` file.  Lazy import: the serve module is also
+    the worker entry point (``python -m mpi_tpu.serve``), so the
+    package must not pre-import it."""
+    from . import serve as _serve
+
+    return _serve.connect(addr, timeout=timeout)
 
 __all__ = [
     "__version__", "ops", "ReduceOp",
     "SUM", "PROD", "MAX", "MIN", "LAND", "LOR", "LXOR", "BAND", "BOR", "BXOR",
     "Communicator", "Message", "P2PCommunicator", "Request", "Status", "ANY_SOURCE", "ANY_TAG",
     "init", "finalize", "is_initialized", "run", "run_local",
-    "schedules", "checker", "checkpoint", "ft", "profiling", "progress", "trace", "verify", "COMM_WORLD", "io", "mpi4",
+    "schedules", "checker", "checkpoint", "ft", "membership", "profiling", "progress", "trace", "verify", "COMM_WORLD", "io", "mpi4",
+    "connect", "rejoin", "serve",
     "CartComm", "GraphComm", "HierarchicalComm", "InterComm",
     "create_intercomm", "cart_create", "graph_create", "split_hierarchical",
     "dist_graph_create_adjacent", "dims_create", "Group",
@@ -98,7 +113,12 @@ def init(backend: Optional[str] = None) -> Communicator:
                 from .transport.shm import ShmTransport as _T
 
             t = _T(rank, size, rdv)
-            _world = P2PCommunicator(t, range(size))
+            # record which incarnation holds this world slot: the
+            # elastic-membership layer's identity file (membership.py)
+            # — accept_rejoin reads it to refuse an ousted-but-live
+            # incarnation re-entering before failure_ack
+            membership.publish_incarnation(rdv, rank)
+            _world = P2PCommunicator(t, range(size))._mark_generation()
             if os.environ.get("MPI_TPU_FT", "") not in ("", "0"):
                 # ULFM fault tolerance (mpi_tpu/ft.py): heartbeat files
                 # under the rendezvous dir + a detector thread, so a
@@ -226,4 +246,10 @@ def __getattr__(name: str):
         return init()
     if name == "COMM_SELF":
         return comm_self()
+    if name == "serve":
+        # lazy: mpi_tpu.serve doubles as the worker's ``-m`` entry
+        # point, and an eager import here would shadow runpy's execution
+        import importlib
+
+        return importlib.import_module(".serve", __name__)
     raise AttributeError(f"module 'mpi_tpu' has no attribute {name!r}")

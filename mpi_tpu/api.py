@@ -84,6 +84,7 @@ __all__ = [
     "MPIX_Comm_revoke", "MPIX_Comm_shrink", "MPIX_Comm_agree",
     "MPIX_Comm_failure_ack", "MPIX_Comm_failure_get_acked",
     "MPIX_Comm_get_failed",
+    "MPIX_Comm_accept_rejoin", "MPIX_Comm_rejoin", "MPIX_Comm_get_epoch",
     "MPI_Errhandler_create",
     "MPI_Comm_create_keyval", "MPI_Comm_free_keyval", "MPI_COMM_DUP_FN",
     "MPI_COMM_NULL_COPY_FN", "MPI_NO_COPY", "Keyval",
@@ -874,6 +875,36 @@ def MPIX_Comm_failure_get_acked(comm: Optional[Communicator] = None):
 def MPIX_Comm_get_failed(comm: Optional[Communicator] = None):
     """Comm ranks this process currently believes dead (sorted)."""
     return _call(comm, "get_failed")
+
+
+# -- elastic membership (mpi_tpu/membership.py) ------------------------------
+
+
+def MPIX_Comm_accept_rejoin(comm: Optional[Communicator] = None,
+                            timeout: Optional[float] = None):
+    """Survivor-side grow-back (collective on the SHRUNKEN
+    communicator): announce the vacant world slots under the post-shrink
+    membership epoch, admit replacement claims (refusing an
+    ousted-but-live incarnation until failure_ack), and return the
+    full-size communicator under the new epoch."""
+    return _call(comm, "accept_rejoin", timeout=timeout)
+
+
+def MPIX_Comm_rejoin(rdv_dir: Optional[str] = None,
+                     timeout: Optional[float] = None, **kwargs):
+    """Joiner-side grow-back, from a FRESH process (no communicator
+    yet): claim a vacant slot from the newest vacancy announcement on
+    the rendezvous dir and return the full-size world communicator
+    under the announced epoch (mpi_tpu.membership.rejoin)."""
+    from . import membership
+
+    return membership.rejoin(rdv_dir=rdv_dir, timeout=timeout, **kwargs)
+
+
+def MPIX_Comm_get_epoch(comm: Optional[Communicator] = None) -> int:
+    """The communicator's monotone membership epoch (0 at world
+    creation; bumped by every shrink / healing transition)."""
+    return _world(comm).membership_epoch
 
 
 # -- attribute caching (MPI-1 ch.5.7 keyvals) -------------------------------

@@ -2520,7 +2520,11 @@ class P2PCommunicator(Communicator):
         these ranks, and ``agree`` stops treating them as fatal.
         Returns the acknowledged comm ranks."""
         ft = self._require_ft("failure_ack")
-        ft.acked |= set(self.get_failed())
+        failed = set(self.get_failed())
+        ft.acked |= failed
+        # world-level record: the membership layer's re-admission gate
+        # (an ousted-but-live incarnation may rejoin only once acked)
+        ft.world.ack_world(self._group[r] for r in failed)
         return sorted(ft.acked)
 
     def failure_get_acked(self) -> List[int]:
@@ -2556,8 +2560,62 @@ class P2PCommunicator(Communicator):
         new = P2PCommunicator(self._t, [self._group[q] for q in survivors],
                               ctx, recv_timeout=self.recv_timeout)
         new._ft = _ftm.CommFT(ft.world, ctx)
+        # Membership epoch transition (mpi_tpu/membership.py): every
+        # survivor performs shrink in lockstep (it rides the agreement),
+        # so the bump is agreed by construction; the ousted rank raised
+        # above and stays on the OLD epoch — its future re-handshakes
+        # are rejected as EpochSkewError instead of cross-wiring.  The
+        # bumped epoch is what accept_rejoin announces a vacancy under.
+        # Only world-GENERATION comms bump (the full world at creation,
+        # or a prior generation's shrink result — chained shrinks are
+        # successive world transitions); a sub-communicator's shrink is
+        # not a world-membership change.
+        if self._ctx in getattr(self._t, "_gen_ctxs", ()):
+            self._t.epoch += 1
+            self._t._gen_ctxs.add(ctx)
         _mpit.count(shrinks=1)
         return self._inherit_errhandler(new)
+
+    def _mark_generation(self) -> "P2PCommunicator":
+        """Register this communicator as a world-GENERATION comm
+        (mpi_tpu/membership.py): its ``shrink()`` is a world-membership
+        transition and bumps the membership epoch.  Marked EXPLICITLY
+        at the world-creation sites (init(), run_local, rejoin,
+        accept_rejoin) and propagated by shrink — never inferred from
+        group size, which would also match per-call nbc clones and
+        per-lease serve comms (unbounded registry growth, and a user
+        shrink on a lease comm silently bumping the pool's epoch)."""
+        if not hasattr(self._t, "_gen_ctxs"):
+            self._t._gen_ctxs = set()
+        self._t._gen_ctxs.add(self._ctx)
+        return self
+
+    @property
+    def membership_epoch(self) -> int:
+        """The monotone membership epoch of this communicator's world
+        (mpi_tpu/membership.py): 0 at creation, bumped by every
+        ``shrink()`` (in survivor lockstep) and by the resident world
+        server's healing transitions.  Stamped into transport hellos so
+        generations can never cross-wire."""
+        return self._t.epoch
+
+    def accept_rejoin(self, timeout: Optional[float] = None
+                      ) -> "P2PCommunicator":
+        """Elastic recovery, the grow-back half of ULFM (mpi_tpu/
+        membership.py): collective over the SURVIVORS (call it on the
+        communicator ``shrink()`` returned), announces the vacant world
+        slots under the current (post-shrink) membership epoch on the
+        rendezvous dir, admits claims from fresh processes (refusing an
+        ousted-but-live incarnation until its failure was
+        ``failure_ack``ed — RejoinRefusedError on the claimer), waits
+        for every replacement to publish epoch-stamped endpoints, and
+        returns a FULL-SIZE communicator over the original world group
+        under the new epoch.  The matching joiner-side call is
+        ``mpi_tpu.membership.rejoin()`` (module-level: the fresh process
+        has no communicator yet)."""
+        from . import membership as _membership
+
+        return _membership.accept_rejoin(self, timeout=timeout)
 
     def agree(self, value: bool = True) -> bool:
         """MPIX_Comm_agree [S: ULFM]: fault-tolerant agreement on the

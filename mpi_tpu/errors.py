@@ -33,6 +33,7 @@ __all__ = [
     "MPI_ERR_PROC_FAILED", "MPI_ERR_REVOKED",
     "ERRORS_ARE_FATAL", "ERRORS_RETURN", "ErrorCode",
     "ProcFailedError", "RevokedError",
+    "EpochSkewError", "RejoinRefusedError",
     "DeadlockError", "CollectiveMismatchError",
     "error_class", "error_string",
 ]
@@ -120,6 +121,33 @@ class RevokedError(RuntimeError):
     (``comm.revoke()`` on any rank); every pending and future p2p or
     collective operation on it raises this — the mechanism that unblocks
     survivors who were not themselves talking to a dead rank."""
+
+
+class EpochSkewError(RuntimeError):
+    """Elastic-membership generation mismatch (mpi_tpu/membership.py):
+    this process tried to talk to a peer from a DIFFERENT membership
+    epoch — it was shrunk out (false suspicion or real death) and the
+    survivors moved on, or it is re-handshaking against endpoints a
+    replacement re-created under a newer epoch.  Raised instead of
+    silently cross-wiring two world generations (the FT residual-(b)
+    group-split hang, diagnosed).  Carries both epochs and the peer."""
+
+    def __init__(self, msg: str, local_epoch: Optional[int] = None,
+                 peer_epoch: Optional[int] = None,
+                 peer: Optional[int] = None):
+        super().__init__(msg)
+        self.local_epoch = local_epoch
+        self.peer_epoch = peer_epoch
+        self.peer = peer
+
+
+class RejoinRefusedError(RuntimeError):
+    """A rejoin claim was refused by the survivors (mpi_tpu/membership):
+    most commonly a falsely-suspected-but-live incarnation trying to
+    re-enter its old slot before the survivors ``failure_ack``ed its
+    failure — re-admitting it would resurrect the very split the epoch
+    protocol exists to prevent.  Ousted processes must come back as a
+    FRESH incarnation (or wait for acknowledgement)."""
 
 
 class DeadlockError(RuntimeError):
@@ -226,6 +254,12 @@ def error_class(exc: Any) -> int:
         return MPI_ERR_PROC_FAILED
     if isinstance(exc, RevokedError):
         return MPI_ERR_REVOKED
+    if isinstance(exc, EpochSkewError):
+        # the stale side's world generation is dead to the survivors —
+        # the closest ULFM class is "your communicator was revoked"
+        return MPI_ERR_REVOKED
+    if isinstance(exc, RejoinRefusedError):
+        return MPI_ERR_PROC_FAILED  # refused BECAUSE it is a declared corpse
     if isinstance(exc, DeadlockError):
         return MPI_ERR_PENDING  # operations pending forever: the closest class
     if isinstance(exc, CollectiveMismatchError):

@@ -135,6 +135,11 @@ class WorldFT:
         self.detect_timeout_s = float(detect_timeout_s)
         self.heartbeat_s = float(heartbeat_s)
         self.failed: set = set()  # world ranks; reads are snapshot-cheap
+        # world ranks whose failure ANY communicator acknowledged via
+        # failure_ack — the membership layer's re-admission gate: an
+        # ousted-but-live incarnation may only rejoin once its failure
+        # has been acknowledged (mpi_tpu/membership.py accept_rejoin)
+        self.acked_world: set = set()
         self._lock = threading.Lock()
         self._stop = threading.Event()
         # peer -> (last stamp seen, local monotonic time it changed)
@@ -202,6 +207,25 @@ class WorldFT:
         place of the ProcFailedError the caller is building."""
         with self._lock:
             return set(self.failed)
+
+    def ack_world(self, world_ranks) -> None:
+        """Record world ranks as failure-acknowledged (failure_ack)."""
+        with self._lock:
+            self.acked_world |= set(world_ranks)
+
+    def reset_rank(self, world_rank: int) -> None:
+        """Re-admit a replaced slot (mpi_tpu/membership.py epoch
+        transition): clear the failed/acked state and restart the
+        detection window so the rejoined incarnation gets a full
+        ``detect_timeout_s`` before it can be suspected again.  Called
+        AFTER the replacement published readiness (its heartbeat file
+        is fresh by then), so the detector cannot instantly re-fail it
+        off the corpse's stale mtime."""
+        with self._lock:
+            self.failed.discard(world_rank)
+            self.acked_world.discard(world_rank)
+        if world_rank != self._t.world_rank:
+            self._last[world_rank] = (None, time.monotonic())
 
     def stop(self) -> None:
         self._stop.set()

@@ -14,11 +14,9 @@ from __future__ import annotations
 
 import argparse
 import os
-import shutil
 import signal
 import subprocess
 import sys
-import tempfile
 import time
 from typing import List, Optional, Sequence
 
@@ -97,7 +95,11 @@ def _launch_once(
         from .native import ensure_built
 
         ensure_built()
-    rdv = tempfile.mkdtemp(prefix="mpi_tpu_rdv_")
+    # the rendezvous dir is the membership service's root (port/
+    # readiness/heartbeat/incarnation/claim files — mpi_tpu/membership)
+    from . import membership
+
+    rdv = membership.new_rendezvous_dir()
     procs: List[subprocess.Popen] = []
     try:
         for r in range(nranks):
@@ -134,23 +136,7 @@ def _launch_once(
             time.sleep(0.02)
     finally:
         _kill_all(procs)
-        _cleanup_shm(rdv)
-        shutil.rmtree(rdv, ignore_errors=True)
-
-
-def _cleanup_shm(rdv: str) -> None:
-    """Unlink any shm ring segments a crashed rank left behind (ranks unlink
-    their own rings on clean close; this is the crash path)."""
-    import glob
-
-    from .transport.shm import shm_prefix
-
-    session = os.path.basename(rdv.rstrip("/"))
-    for path in glob.glob("/dev/shm/" + shm_prefix(session) + "*"):
-        try:
-            os.unlink(path)
-        except OSError:
-            pass
+        membership.cleanup_rendezvous(rdv)
 
 
 def _kill_all(procs: List[subprocess.Popen]) -> None:
@@ -200,8 +186,19 @@ def _exit_summary(procs: List[subprocess.Popen]) -> str:
 
 
 def main(argv: Optional[Sequence[str]] = None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if argv and argv[0] == "serve":
+        # resident world server (mpi_tpu/serve.py): pools warm worker
+        # processes and leases worlds to clients in one round-trip;
+        # dead workers are shrunk out and replaced under a fresh
+        # membership epoch.  `python -m mpi_tpu.launcher serve --help`
+        from . import serve
+
+        return serve.main(argv[1:])
     parser = argparse.ArgumentParser(
-        prog="mpi_tpu.launcher", description="mpirun-alike launcher for mpi_tpu"
+        prog="mpi_tpu.launcher",
+        description="mpirun-alike launcher for mpi_tpu (or "
+                    "'... launcher serve' for the resident world server)"
     )
     parser.add_argument("-n", "--np", type=int, required=True, dest="nranks",
                         help="number of rank processes")

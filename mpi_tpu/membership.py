@@ -1,0 +1,560 @@
+"""Elastic membership: epochs, the rendezvous service, and rejoin.
+
+PR 3's ULFM layer can detect, revoke, and ``shrink()`` a failure, but a
+shrunken world could never grow back — a rank death was terminal for its
+slot.  This module is the grow-back half (the MPICH / Open MPI "elastic
+recovery" shape, SURVEY §5): membership changes become **epoch
+transitions**.
+
+* Every world carries a monotone **membership epoch**
+  (``Transport.epoch``, surfaced as ``comm.membership_epoch``).  It
+  starts at 0; ``shrink()`` bumps it in survivor lockstep (the bump
+  rides the shrink agreement, so every survivor lands on the same
+  number while the ousted rank — which raised inside shrink — stays on
+  the old one).
+* The epoch is **stamped into every transport hello**: the socket
+  connection handshake carries (rank, epoch) and answers with the
+  acceptor's epoch; the shm readiness file *contains* the epoch its
+  rings were created under.  A stale-epoch straggler — the
+  falsely-suspected live rank of FT residual (b) — is therefore
+  rejected LOUDLY (:class:`~mpi_tpu.errors.EpochSkewError`) instead of
+  cross-wiring two world generations through recycled rendezvous files.
+* A **rejoin protocol** on the rendezvous dir lets a fresh process fill
+  a vacant slot under the next epoch:
+
+  1. the survivors (``comm.accept_rejoin()``, collective on the
+     shrunken communicator) or the resident world server
+     (mpi_tpu/serve.py) write an *announce* file
+     ``rejoin.<epoch>.json`` listing the vacant slots;
+  2. a joiner (:func:`rejoin` — module-level: a fresh process has no
+     communicator yet) *claims* a slot with an atomic ``O_EXCL`` create
+     naming its incarnation id;
+  3. the announcer validates claims — an ousted-but-LIVE incarnation
+     (the false suspicion) is **refused** until its failure was
+     ``failure_ack``ed (:class:`~mpi_tpu.errors.RejoinRefusedError` on
+     the claimer; re-admitting it would resurrect the split) — and
+     *admits* the rest; a claimer that died mid-handshake (dead pid, no
+     readiness) has its claim cleared so the slot can be re-claimed
+     (no epoch fork);
+  4. the admitted joiner creates FRESH transport endpoints stamped with
+     the new epoch (the socket port file / shm rings + readiness are
+     atomically re-published over the corpse's), publishes *ready*, and
+     both sides build the full-world communicator under context
+     ``("epoch", E)`` and barrier.
+
+The rendezvous-dir helpers at the bottom (:func:`new_rendezvous_dir`,
+:func:`cleanup_rendezvous`) are the launcher's former private plumbing,
+refactored here so the launcher, the resident world server, and tests
+share ONE membership service (ROADMAP direction #1's unlocking
+refactor).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import tempfile
+import time
+import uuid
+from typing import Dict, Optional, Sequence, Tuple
+
+from . import mpit as _mpit
+from .errors import EpochSkewError, RejoinRefusedError  # noqa: F401 (re-export)
+from .transport.base import Transport, TransportError
+
+# Default bound on a rejoin handshake (claim -> admit -> endpoints ->
+# ready -> barrier) for BOTH sides.  mpit cvar: rejoin_timeout_s.
+_REJOIN_TIMEOUT_S = 30.0
+
+_POLL_S = 0.01  # rendezvous-file poll cadence (cheap stat/read)
+
+# Per-process incarnation id: the identity a claim presents.  ONE per
+# process (not per call): a falsely-suspected live rank re-claiming its
+# slot must present the SAME identity it was ousted under, so the
+# survivors can refuse it until failure_ack — a fresh uuid per call
+# would let the ousted process sneak back in as a "new" worker.
+_PROCESS_INCARNATION: Optional[str] = None
+
+
+def incarnation() -> str:
+    global _PROCESS_INCARNATION
+    if _PROCESS_INCARNATION is None:
+        _PROCESS_INCARNATION = uuid.uuid4().hex
+    return _PROCESS_INCARNATION
+
+
+# -- small atomic-file helpers ------------------------------------------------
+
+
+def _write_json(path: str, obj: dict) -> None:
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w") as f:
+        json.dump(obj, f)
+    os.replace(tmp, path)
+
+
+def _read_json(path: str) -> Optional[dict]:
+    try:
+        with open(path) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return None  # absent / mid-replace: caller re-polls
+
+
+def _pid_alive(pid: int) -> bool:
+    try:
+        os.kill(pid, 0)
+    except ProcessLookupError:
+        return False
+    except PermissionError:  # pragma: no cover - exists, not ours
+        return True
+    return True
+
+
+# -- incarnation registry -----------------------------------------------------
+
+
+def publish_incarnation(rdv_dir: str, rank: int,
+                        inc: Optional[str] = None) -> str:
+    """Record which incarnation currently holds world slot ``rank``
+    (file ``inc.<rank>``) — what accept_rejoin reads to know WHO was
+    ousted, so the refusal gate can tell the corpse's identity from a
+    fresh replacement's."""
+    inc = inc or incarnation()
+    _write_json(os.path.join(rdv_dir, f"inc.{rank}"),
+                {"incarnation": inc, "pid": os.getpid()})
+    return inc
+
+
+def read_incarnation(rdv_dir: str, rank: int) -> Optional[str]:
+    rec = _read_json(os.path.join(rdv_dir, f"inc.{rank}"))
+    return rec.get("incarnation") if rec else None
+
+
+# -- announce / claim / admit / ready protocol files --------------------------
+
+
+def _announce_path(rdv: str, epoch: int) -> str:
+    return os.path.join(rdv, f"rejoin.{epoch}.json")
+
+
+def announce_rejoin(rdv_dir: str, epoch: int, slots: Dict[int, dict],
+                    size: int, backend: str) -> None:
+    """Write the vacancy announcement for ``epoch``.  ``slots`` maps
+    vacant world rank -> {"ousted": incarnation-or-None, "acked": bool};
+    ``size``/``backend`` let a bare joiner (only MPI_TPU_RDV in hand)
+    construct the right transport."""
+    _write_json(_announce_path(rdv_dir, epoch), {
+        "epoch": int(epoch), "size": int(size), "backend": backend,
+        "slots": {str(s): dict(meta) for s, meta in slots.items()},
+    })
+
+
+def read_announce(rdv_dir: str, epoch: int) -> Optional[dict]:
+    return _read_json(_announce_path(rdv_dir, epoch))
+
+
+def latest_announce(rdv_dir: str) -> Optional[dict]:
+    """Newest (highest-epoch) announcement in the rendezvous dir."""
+    best = None
+    try:
+        names = os.listdir(rdv_dir)
+    except OSError:
+        return None
+    for name in names:
+        if name.startswith("rejoin.") and name.endswith(".json"):
+            rec = _read_json(os.path.join(rdv_dir, name))
+            if rec and (best is None or rec["epoch"] > best["epoch"]):
+                best = rec
+    return best
+
+
+def _claim_path(rdv: str, epoch: int, slot: int) -> str:
+    return os.path.join(rdv, f"claim.{epoch}.{slot}")
+
+
+def claim_slot(rdv_dir: str, epoch: int, slot: int,
+               inc: Optional[str] = None,
+               pid: Optional[int] = None) -> bool:
+    """Atomically claim a vacant slot (``O_EXCL`` create): exactly one
+    claimer wins; a double-claim (including a double-REJOIN of the same
+    worker id against a stale announce) fails cleanly."""
+    inc = inc or incarnation()
+    try:
+        fd = os.open(_claim_path(rdv_dir, epoch, slot),
+                     os.O_CREAT | os.O_EXCL | os.O_WRONLY, 0o600)
+    except FileExistsError:
+        return False
+    with os.fdopen(fd, "w") as f:
+        json.dump({"incarnation": inc,
+                   "pid": int(pid if pid is not None else os.getpid())}, f)
+    return True
+
+
+def read_claim(rdv_dir: str, epoch: int, slot: int) -> Optional[dict]:
+    return _read_json(_claim_path(rdv_dir, epoch, slot))
+
+
+def _admit_path(rdv: str, epoch: int, slot: int) -> str:
+    return os.path.join(rdv, f"admit.{epoch}.{slot}")
+
+
+def _refused_path(rdv: str, epoch: int, slot: int) -> str:
+    return os.path.join(rdv, f"refused.{epoch}.{slot}")
+
+
+def _ready_path(rdv: str, epoch: int, slot: int) -> str:
+    return os.path.join(rdv, f"ready.{epoch}.{slot}")
+
+
+def publish_ready(rdv_dir: str, epoch: int, slot: int,
+                  inc: Optional[str] = None) -> None:
+    _write_json(_ready_path(rdv_dir, epoch, slot),
+                {"incarnation": inc or incarnation(),
+                 "pid": os.getpid()})
+
+
+def process_claims(rdv_dir: str, epoch: int, slots: Dict[int, dict],
+                   acked_extra: Sequence[int] = ()) -> None:
+    """One validation pass over the claims of ``epoch`` — the
+    announcer-side step (rank-0 survivor in accept_rejoin, or the
+    resident world server), run every poll tick:
+
+    * a claim presenting the OUSTED incarnation of an un-acked slot is
+      REFUSED (written to ``refused.<epoch>.<slot>`` and the claim
+      cleared, so a legitimate replacement can claim): re-admitting a
+      falsely-suspected-but-live rank before ``failure_ack`` would
+      resurrect the very group split the epoch protocol prevents;
+    * a claimer that DIED mid-handshake (claim present, readiness
+      absent, pid gone) has its claim + admit cleared — the pool
+      recovers by re-claiming, no epoch fork;
+    * every other claim is ADMITTED (``admit.<epoch>.<slot>`` names the
+      admitted incarnation; the joiner waits on it before touching any
+      endpoint file, so a refused claimer can never trash the real
+      replacement's rendezvous files).
+    """
+    acked_extra = set(acked_extra)
+    for slot, meta in slots.items():
+        slot = int(slot)
+        claim = read_claim(rdv_dir, epoch, slot)
+        if claim is None:
+            continue
+        inc, pid = claim.get("incarnation"), claim.get("pid")
+        dead = pid is not None and not _pid_alive(int(pid))
+        ready = _read_json(_ready_path(rdv_dir, epoch, slot))
+        handshaken = ready is not None and ready.get("incarnation") == inc
+        if dead:
+            # Killed during (claim -> ... -> ready) OR just after ready:
+            # clear EVERYTHING — including a published readiness file —
+            # so the slot can be re-claimed under the same epoch (the
+            # announce stays valid, no epoch fork).  Leaving a dead
+            # claimer's ready behind would wedge healing forever: a
+            # respawned replacement's O_EXCL claim could never succeed.
+            for p in (_claim_path(rdv_dir, epoch, slot),
+                      _admit_path(rdv_dir, epoch, slot),
+                      _ready_path(rdv_dir, epoch, slot)):
+                try:
+                    os.unlink(p)
+                except OSError:
+                    pass
+            continue
+        if handshaken:
+            continue  # live and complete; nothing to validate
+        ousted = meta.get("ousted")
+        acked = bool(meta.get("acked")) or slot in acked_extra
+        if ousted is not None and inc == ousted and not acked:
+            _write_json(_refused_path(rdv_dir, epoch, slot), {
+                "incarnation": inc,
+                "reason": "suspected-but-live incarnation: re-admission "
+                          "refused until its failure is acknowledged "
+                          "(failure_ack)"})
+            for p in (_claim_path(rdv_dir, epoch, slot),
+                      _admit_path(rdv_dir, epoch, slot)):
+                try:
+                    os.unlink(p)
+                except OSError:
+                    pass
+            continue
+        admit = _read_json(_admit_path(rdv_dir, epoch, slot))
+        if admit is None or admit.get("incarnation") != inc:
+            _write_json(_admit_path(rdv_dir, epoch, slot),
+                        {"incarnation": inc})
+
+
+def wait_admitted(rdv_dir: str, epoch: int, slot: int, inc: str,
+                  deadline: float) -> None:
+    """Joiner-side: block until our claim is admitted (or refused)."""
+    while True:
+        admit = _read_json(_admit_path(rdv_dir, epoch, slot))
+        if admit is not None and admit.get("incarnation") == inc:
+            return
+        refused = _read_json(_refused_path(rdv_dir, epoch, slot))
+        if refused is not None and refused.get("incarnation") == inc:
+            raise RejoinRefusedError(
+                f"rejoin of slot {slot} at epoch {epoch} refused: "
+                f"{refused.get('reason', 'unspecified')}")
+        if time.monotonic() > deadline:
+            raise TransportError(
+                f"rejoin claim for slot {slot} (epoch {epoch}) not "
+                f"admitted in time")
+        time.sleep(_POLL_S)
+
+
+def wait_ready(rdv_dir: str, epoch: int, slots: Dict[int, dict],
+               deadline: float, validate: bool = False) -> None:
+    """Announcer/survivor-side: block until EVERY vacant slot's
+    replacement published readiness.  With ``validate`` (the announcer:
+    rank-0 survivor or the server) each tick also runs the claim
+    validation pass — refusals, dead-claimer cleanup, admissions."""
+    pending = {int(s) for s in slots}
+    while pending:
+        if validate:
+            process_claims(rdv_dir, epoch, slots)
+        for s in list(pending):
+            if _read_json(_ready_path(rdv_dir, epoch, s)) is not None:
+                pending.discard(s)
+        if not pending:
+            return
+        if time.monotonic() > deadline:
+            raise TransportError(
+                f"rejoin at epoch {epoch}: slots {sorted(pending)} "
+                f"published no replacement in time")
+        time.sleep(_POLL_S)
+
+
+# -- transport-level transitions ----------------------------------------------
+
+
+def make_transport(backend: str, rank: int, size: int, rdv_dir: str,
+                   epoch: int = 0) -> Transport:
+    """Construct a process-world transport for ``rank`` with fresh
+    endpoints stamped at ``epoch`` (the one constructor the launcher
+    init path, rejoin, and the world server all share)."""
+    if backend == "socket":
+        from .transport.socket import SocketTransport
+
+        return SocketTransport(rank, size, rdv_dir, epoch=epoch)
+    if backend == "shm":
+        from .transport.shm import ShmTransport
+
+        return ShmTransport(rank, size, rdv_dir, epoch=epoch)
+    raise ValueError(f"unknown process-world backend {backend!r} "
+                     f"(accepted: socket, shm)")
+
+
+def survivor_transition(transport: Transport, epoch: int,
+                        dead: Sequence[int]) -> None:
+    """Apply an epoch transition on a surviving rank's transport: adopt
+    the new epoch, require replaced slots to present it (their corpse's
+    leftover endpoints become unreachable), drop cached connections/
+    rings to them, and (shm) re-stamp our readiness so stale stragglers
+    doing fresh opens read the skew."""
+    transport.epoch = max(transport.epoch, int(epoch))
+    for d in dead:
+        transport.min_peer_epoch[int(d)] = int(epoch)
+    transport.membership_invalidate(list(dead))
+    republish = getattr(transport, "membership_republish", None)
+    if republish is not None:
+        republish()
+
+
+# -- the joiner (fresh process) ----------------------------------------------
+
+
+def rejoin_transport(rdv_dir: str, slot: Optional[int] = None,
+                     epoch: Optional[int] = None,
+                     backend: Optional[str] = None,
+                     timeout: Optional[float] = None
+                     ) -> Tuple[Transport, dict]:
+    """Claim a vacant slot and bring up epoch-stamped endpoints for it;
+    returns (transport, announce).  The communicator-building half
+    lives in :func:`rejoin`; the resident world server's replacement
+    workers use this directly (their lease communicators are built per
+    job, no full-world barrier needed)."""
+    timeout = _REJOIN_TIMEOUT_S if timeout is None else timeout
+    deadline = time.monotonic() + timeout
+    inc = incarnation()
+    ann = None
+    while True:
+        ann = (read_announce(rdv_dir, epoch) if epoch is not None
+               else latest_announce(rdv_dir))
+        if ann is not None:
+            break
+        if time.monotonic() > deadline:
+            raise TransportError(
+                f"rejoin: no vacancy announcement in {rdv_dir} "
+                f"(epoch={'latest' if epoch is None else epoch})")
+        time.sleep(_POLL_S)
+    claimed = None
+    while claimed is None:
+        e = int(ann["epoch"])
+        size = int(ann["size"])
+        backend = backend or ann.get("backend") or "socket"
+        candidates = ([int(slot)] if slot is not None
+                      else sorted(int(s) for s in ann["slots"]))
+        for s in candidates:
+            ready = _read_json(_ready_path(rdv_dir, e, s))
+            if ready is not None and ready.get("incarnation") == inc:
+                raise RejoinRefusedError(
+                    f"double rejoin: this incarnation already holds "
+                    f"slot {s} at epoch {e}")
+            if claim_slot(rdv_dir, e, s, inc=inc):
+                claimed = s
+                break
+        if claimed is None:
+            # every candidate claimed by someone else right now; a
+            # refused/dead claimer may free one — poll until deadline.
+            if time.monotonic() > deadline:
+                raise TransportError(
+                    f"rejoin: no claimable slot at epoch {e} "
+                    f"(candidates {candidates})")
+            time.sleep(_POLL_S)
+            if epoch is None:
+                # RE-READ the announcement each round: a completed
+                # earlier heal leaves its (fully-claimed) announce
+                # behind, and a NEWER vacancy published mid-wait must
+                # not be missed until the deadline
+                ann = latest_announce(rdv_dir) or ann
+    wait_admitted(rdv_dir, e, claimed, inc, deadline)
+    # ONLY an admitted claimer may touch endpoint files: construct the
+    # transport (socket: bind + atomically re-publish port.<slot>; shm:
+    # recreate rings + doorbell, readiness stamped with the epoch)
+    t = make_transport(backend, claimed, size, rdv_dir, epoch=e)
+    # require EVERY peer to have transitioned to our epoch before we
+    # adopt its endpoints: on shm a survivor RECREATES its inbound
+    # rings from our slot during survivor_transition (the corpse may
+    # have died mid-frame into them), and re-stamps its readiness with
+    # the new epoch only afterwards — opening earlier could append our
+    # first frames to the corpse's desynced byte stream.  Socket
+    # satisfies this trivially (survivors bumped their epoch at
+    # shrink/transition, so their hello-acks already carry it).
+    for p in range(size):
+        if p != claimed:
+            t.min_peer_epoch[p] = e
+    publish_incarnation(rdv_dir, claimed, inc)
+    return t, ann
+
+
+def rejoin(rdv_dir: Optional[str] = None, slot: Optional[int] = None,
+           epoch: Optional[int] = None, backend: Optional[str] = None,
+           timeout: Optional[float] = None,
+           recv_timeout: Optional[float] = None):
+    """Joiner-side entry point of the rejoin protocol: run from a FRESH
+    process (``rdv_dir`` defaults to the launcher's MPI_TPU_RDV), it
+    claims a vacant slot from the newest announcement, brings up
+    endpoints under the announced epoch, enables fault tolerance (and
+    the verifier, when MPI_TPU_VERIFY is set), publishes readiness, and
+    returns the FULL-SIZE world communicator — rendezvousing with the
+    survivors' ``comm.accept_rejoin()`` barrier."""
+    from . import ft as _ft
+    from .communicator import P2PCommunicator
+
+    rdv_dir = rdv_dir or os.environ.get("MPI_TPU_RDV")
+    if rdv_dir is None:
+        raise ValueError("rejoin needs a rendezvous dir: pass rdv_dir= "
+                         "or set MPI_TPU_RDV")
+    timeout = _REJOIN_TIMEOUT_S if timeout is None else timeout
+    t, ann = rejoin_transport(rdv_dir, slot=slot, epoch=epoch,
+                              backend=backend, timeout=timeout)
+    e = int(ann["epoch"])
+    comm = P2PCommunicator(t, range(t.world_size), ("epoch", e),
+                           recv_timeout=recv_timeout)._mark_generation()
+    _ft.enable(comm, rdv_dir=rdv_dir)  # fresh heartbeat over the corpse's
+    if os.environ.get("MPI_TPU_VERIFY", "") not in ("", "0"):
+        from . import verify as _verify
+
+        _verify.enable(comm, rdv_dir=rdv_dir)
+    publish_ready(rdv_dir, e, t.world_rank)
+    comm.barrier()  # meets the survivors' accept_rejoin barrier
+    _mpit.count(rejoins=1)
+    return comm
+
+
+# -- the survivors (accept side) ----------------------------------------------
+
+
+def accept_rejoin(comm, timeout: Optional[float] = None):
+    """Survivor-side half of the rejoin protocol — see
+    ``P2PCommunicator.accept_rejoin`` for the user-facing contract.
+    ``comm`` is the SHRUNKEN communicator (its group defines who
+    survived; the transport's world size defines the slots to refill).
+    Collective over the survivors; returns the full-world communicator
+    under the post-shrink epoch."""
+    from . import ft as _ftm
+    from .communicator import P2PCommunicator
+
+    ft = comm._require_ft("accept_rejoin")
+    t = comm._t
+    rdv = getattr(t, "_rdv", None)
+    if rdv is None:
+        raise RuntimeError(
+            "accept_rejoin needs a file-rendezvous process world "
+            "(socket/shm under the launcher); in-process local worlds "
+            "have no rendezvous dir for a fresh process to join through")
+    epoch = t.epoch
+    full = tuple(range(t.world_size))
+    dead = sorted(set(full) - set(comm._group))
+    if not dead:
+        raise ValueError("accept_rejoin: the world has no vacant slots")
+    timeout = _REJOIN_TIMEOUT_S if timeout is None else timeout
+    deadline = time.monotonic() + timeout
+    if comm.rank == 0:
+        acked = ft.world.acked_world
+        slots = {s: {"ousted": read_incarnation(rdv, s),
+                     "acked": s in acked} for s in dead}
+        announce_rejoin(rdv, epoch, slots, t.world_size,
+                        _backend_name(t))
+        wait_ready(rdv, epoch, slots, deadline, validate=True)
+    else:
+        wait_ready(rdv, epoch, {s: {} for s in dead}, deadline)
+    survivor_transition(t, epoch, dead)
+    for s in dead:
+        ft.world.reset_rank(s)
+    new = P2PCommunicator(t, full, ("epoch", epoch),
+                          recv_timeout=comm.recv_timeout)._mark_generation()
+    new._ft = _ftm.CommFT(ft.world, ("epoch", epoch))
+    if comm._verify is not None:
+        from .verify.state import CommVerify
+
+        new._verify = CommVerify(comm._verify.world)
+    new = comm._inherit_errhandler(new)
+    new.barrier()  # meets every joiner's rejoin() barrier
+    _mpit.count(rejoins=1)
+    return new
+
+
+def _backend_name(t: Transport) -> str:
+    name = type(t).__name__
+    return {"SocketTransport": "socket", "ShmTransport": "shm"}.get(
+        name, name.lower())
+
+
+# -- rendezvous-dir lifecycle (shared by launcher / serve / tests) ------------
+
+
+def new_rendezvous_dir(prefix: str = "mpi_tpu_rdv_") -> str:
+    """Create a fresh rendezvous directory (the membership service's
+    root: port/readiness/heartbeat/pending/claim files all live here)."""
+    return tempfile.mkdtemp(prefix=prefix)
+
+
+def cleanup_rendezvous(rdv: str) -> None:
+    """Tear a rendezvous dir down, unlinking any /dev/shm segments a
+    crashed rank left behind (ranks unlink their own on clean close;
+    this is the crash path) — the launcher's former private cleanup,
+    shared with the resident world server."""
+    import glob
+    import shutil
+
+    try:
+        from .transport.shm import shm_prefix
+
+        session = os.path.basename(rdv.rstrip("/"))
+        for path in glob.glob("/dev/shm/" + shm_prefix(session) + "*"):
+            try:
+                os.unlink(path)
+            except OSError:
+                pass
+    except Exception:  # noqa: BLE001 - native layer absent: nothing mapped
+        pass
+    shutil.rmtree(rdv, ignore_errors=True)

@@ -43,7 +43,8 @@ class _Counters:
                  "sm_hits", "sm_bytes", "sm_fallbacks",
                  "v_deadlocks", "v_mismatches", "v_leaked", "v_double_waits",
                  "v_buf_overlaps", "v_comms_unfreed",
-                 "prog_wakeups", "prog_completions", "prog_idle_parks")
+                 "prog_wakeups", "prog_completions", "prog_idle_parks",
+                 "rejoins", "epoch_skews")
 
     def __init__(self) -> None:
         self.sends = 0
@@ -72,6 +73,8 @@ class _Counters:
         self.prog_wakeups = 0
         self.prog_completions = 0
         self.prog_idle_parks = 0
+        self.rejoins = 0
+        self.epoch_skews = 0
 
 
 counters = _Counters()  # incremented by communicator.py / codec.py (count())
@@ -89,7 +92,8 @@ def count(sends: int = 0, send_bytes: int = 0, recvs: int = 0,
           verify_buffer_overlaps: int = 0,
           verify_comms_unfreed: int = 0,
           progress_wakeups: int = 0, progress_completions: int = 0,
-          progress_idle_parks: int = 0) -> None:
+          progress_idle_parks: int = 0,
+          rejoins: int = 0, epoch_skews: int = 0) -> None:
     """Thread-safe increment (rank-threads of the local backend share
     this process's counters; unsynchronized += would lose updates)."""
     with _lock:
@@ -119,6 +123,8 @@ def count(sends: int = 0, send_bytes: int = 0, recvs: int = 0,
         counters.prog_wakeups += progress_wakeups
         counters.prog_completions += progress_completions
         counters.prog_idle_parks += progress_idle_parks
+        counters.rejoins += rejoins
+        counters.epoch_skews += epoch_skews
 
 _PVARS: Dict[str, Callable[[], int]] = {
     "msgs_sent": lambda: counters.sends,
@@ -186,6 +192,12 @@ _PVARS: Dict[str, Callable[[], int]] = {
     "progress_wakeups": lambda: counters.prog_wakeups,
     "progress_completions": lambda: counters.prog_completions,
     "progress_idle_parks": lambda: counters.prog_idle_parks,
+    # elastic membership (mpi_tpu/membership.py): rejoin handshakes this
+    # process completed (either side), and stale-epoch handshakes it
+    # rejected/diagnosed (EpochSkewError — the false-suspicion group
+    # split surfacing as an error instead of a cross-wired hang)
+    "rejoins_completed": lambda: counters.rejoins,
+    "epoch_skews_detected": lambda: counters.epoch_skews,
 }
 
 
@@ -271,6 +283,7 @@ def _ensure_builtin_cvars() -> None:
     from . import communicator as _c
     from . import ft as _ft
     from . import io as _io
+    from . import membership as _membership
     from . import progress as _prog
     from .transport import shm as _shm
     from .verify import state as _vstate
@@ -440,6 +453,18 @@ def _ensure_builtin_cvars() -> None:
             "(latency-optimal); above it allreduce switches to the "
             "chunked in-place fold and reduce stays on the binomial "
             "tree")
+        def _set_rejoin_timeout(v):
+            if float(v) <= 0:
+                raise ValueError("rejoin_timeout_s must be > 0")
+            _membership._REJOIN_TIMEOUT_S = float(v)
+
+        _CVARS["rejoin_timeout_s"] = (
+            lambda: _membership._REJOIN_TIMEOUT_S, _set_rejoin_timeout,
+            "default bound on an elastic-membership rejoin handshake "
+            "(mpi_tpu/membership.py): claim -> admit -> epoch-stamped "
+            "endpoints -> ready -> barrier, on BOTH the joiner "
+            "(rejoin()) and survivor (accept_rejoin()) sides; explicit "
+            "timeout= arguments override per call")
         _CVARS["gather_replicated_warn_bytes"] = (
             lambda: _GATHER_WARN_BYTES[0],
             lambda v: _GATHER_WARN_BYTES.__setitem__(0, int(v)),

@@ -1,0 +1,1123 @@
+"""Resident world server: MPI-as-a-service over a warm worker pool.
+
+ROADMAP direction #1: the "millions of users" shape for an MPI library
+is many SMALL worlds churned at a high rate, not one big job — and the
+cold path (fork N interpreters, import numpy, bind ports, handshake
+rings) costs ~seconds per world.  This module keeps all of that warm:
+
+* ``WorldServer`` (the ``python -m mpi_tpu.launcher serve`` daemon)
+  spawns ``pool_size`` **worker processes once**, each holding its live
+  transport endpoints (socket connections / shm rings + pre-mapped
+  arenas) and an enabled ULFM detector, then **leases** sub-worlds to
+  clients: an acquire is one control round-trip that reserves idle
+  slots — no fork, no handshake — and a job builds its communicator
+  locally on every leased worker from ``(slots, job_id)`` (communicator
+  construction is pure bookkeeping over the warm transport).
+* ``mpi_tpu.connect(addr)`` is the client: ``acquire(nranks)`` →
+  ``lease.run(fn, *args)`` → ``release()``.  ``fn`` is pickled by
+  reference (workers import the same code), runs as ``fn(comm, *args)``
+  on every leased worker, and rank 0's return value comes back.  Every
+  lease either completes or raises a NAMED error — a worker death
+  mid-collective surfaces to the client as ``ProcFailedError``
+  (``MPI_ERR_PROC_FAILED``) within the detection bound, never a hang.
+* **Self-healing** (the elastic-membership layer, mpi_tpu/membership):
+  the server watches worker liveness (child exit + the PR-3 heartbeat
+  files); a death bumps the pool's membership epoch, survivors are
+  told to drop the corpse's endpoints (``survivor_transition``), and a
+  replacement worker is spawned to ``rejoin`` the world under the new
+  epoch through the claim/admit/ready protocol — so the pool keeps
+  serving under continuous ``kill -9`` chaos (``bench.py --chaos
+  --serve`` drives exactly that and asserts worlds/sec never reaches
+  zero).
+
+Wire protocol: length-prefixed pickle frames on a local TCP socket; the
+server is the only party that ever coordinates membership, so workers
+need no agreement rounds — their ULFM detectors only CONVERT blocked
+waits into errors.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import pickle
+import socket
+import struct
+import subprocess
+import sys
+import threading
+import time
+from typing import Any, Dict, List, Optional, Sequence
+
+from . import membership
+from .errors import (DeadlockError, EpochSkewError, ProcFailedError,
+                     RejoinRefusedError, RevokedError, error_class)
+from .transport.base import RecvTimeout, TransportError
+from .transport.socket import _recv_exact
+
+_FRAME = struct.Struct("!I")
+_HOST = "127.0.0.1"
+
+# serve defaults — the knobs the README documents; constructor / CLI
+# arguments override per server.
+_POOL_SIZE = 4
+_WORLD_LEASE_TIMEOUT_S = 30.0   # acquire wait + default run bound
+_REJOIN_TIMEOUT_S = 20.0        # one healing round's handshake bound
+_DETECT_TIMEOUT_S = 2.0         # pool-internal ULFM detection bound
+_HEARTBEAT_S = 0.25
+
+
+# -- framing ------------------------------------------------------------------
+
+
+def _send_msg(sock: socket.socket, lock: Optional[threading.Lock],
+              msg: dict) -> None:
+    blob = pickle.dumps(msg, protocol=pickle.HIGHEST_PROTOCOL)
+    frame = _FRAME.pack(len(blob)) + blob
+    if lock is None:
+        sock.sendall(frame)
+    else:
+        with lock:
+            sock.sendall(frame)
+
+
+def _recv_msg(sock: socket.socket) -> Optional[dict]:
+    head = _recv_exact(sock, _FRAME.size)
+    if head is None:
+        return None
+    (n,) = _FRAME.unpack(head)
+    body = _recv_exact(sock, n)
+    if body is None:
+        return None
+    return pickle.loads(body)
+
+
+# -- error shipping -----------------------------------------------------------
+
+_ERROR_KINDS = {
+    "ProcFailedError": ProcFailedError,
+    "RevokedError": RevokedError,
+    "DeadlockError": DeadlockError,
+    "EpochSkewError": EpochSkewError,
+    "RejoinRefusedError": RejoinRefusedError,
+    "RecvTimeout": RecvTimeout,
+    "TransportError": TransportError,
+}
+
+
+def _pack_error(exc: BaseException) -> dict:
+    return {"kind": type(exc).__name__, "code": error_class(exc),
+            "msg": str(exc),
+            "failed": list(getattr(exc, "failed", ()) or ()),
+            "collective": getattr(exc, "collective", None)}
+
+
+def _raise_error(err: dict) -> None:
+    """Re-raise a shipped worker/server error client-side under its own
+    name: the lease contract is 'completes or raises a NAMED FT error',
+    and `except ProcFailedError` must work across the wire."""
+    kind = err.get("kind", "RuntimeError")
+    msg = err.get("msg", "remote failure")
+    if kind == "LeaseTimeout":
+        raise TimeoutError(msg)
+    cls = _ERROR_KINDS.get(kind)
+    if cls is ProcFailedError:
+        raise ProcFailedError(msg, failed=err.get("failed", ()),
+                              collective=err.get("collective"))
+    if cls is not None:
+        raise cls(msg)
+    raise RuntimeError(f"{kind}: {msg}")
+
+
+# -- built-in jobs (bench / chaos / quickstart) -------------------------------
+
+
+def job_allreduce(comm, n: int = 1024) -> float:
+    """The demo/bench lease payload: a correctness-checkable allreduce.
+    Returns sum(1..P) so the client can assert the world really ran."""
+    import numpy as np
+
+    out = comm.allreduce(np.full(int(n), comm.rank + 1.0, np.float32))
+    return float(out[0])
+
+
+def job_kill_rank(comm, victim: int = 1, n: int = 4096) -> float:
+    """Chaos payload: lease-rank ``victim`` dies WITHOUT cleanup inside
+    the leased world (after the barrier, so every rank has entered the
+    job) while the rest run a collective on it — the kill-mid-lease
+    acceptance story.  Survivors surface ProcFailedError; the client
+    sees MPI_ERR_PROC_FAILED."""
+    import numpy as np
+
+    comm.barrier()
+    if comm.rank == victim:
+        os._exit(137)
+    out = comm.allreduce(np.ones(int(n), np.float32), algorithm="ring")
+    return float(out[0])
+
+
+def job_sleep(comm, seconds: float = 0.1) -> int:
+    comm.barrier()
+    time.sleep(float(seconds))
+    return comm.rank
+
+
+# -- the worker process -------------------------------------------------------
+
+
+def _worker_main() -> int:
+    """Body of one pool worker (``python -m mpi_tpu.serve --worker``):
+    bring up the world transport (fresh pool member via init(), or a
+    replacement rejoining under MPI_TPU_SERVE_REJOIN=epoch:slot), then
+    serve jobs from the control connection.  A control reader thread
+    applies membership transitions IMMEDIATELY (even mid-job — dropping
+    a corpse's endpoints must not wait for the current lease), while
+    the main thread runs one job at a time."""
+    import faulthandler
+    import queue
+    import signal as _signal
+
+    from . import ft as _ft
+    from . import init as _init
+    from . import mpit as _mpit
+    from .communicator import P2PCommunicator
+
+    # field diagnosability: the server SIGUSR2s a worker whose job
+    # blew the lease timeout, so the worker's stacks land on its
+    # inherited stderr — a wedged lease is diagnosable from the logs
+    faulthandler.register(_signal.SIGUSR2, all_threads=True, chain=True)
+
+    detect = os.environ.get("MPI_TPU_SERVE_DETECT_S")
+    if detect:
+        _mpit.cvar_write("fault_detect_timeout_s", float(detect))
+    hb = os.environ.get("MPI_TPU_SERVE_HEARTBEAT_S")
+    if hb:
+        _mpit.cvar_write("fault_heartbeat_interval_s", float(hb))
+    rdv = os.environ["MPI_TPU_RDV"]
+    backend = os.environ.get("MPI_TPU_BACKEND", "socket")
+    rejoin_spec = os.environ.get("MPI_TPU_SERVE_REJOIN")
+    if rejoin_spec:
+        epoch, slot = (int(x) for x in rejoin_spec.split(":"))
+        rj_timeout = float(os.environ.get(
+            "MPI_TPU_SERVE_REJOIN_TIMEOUT_S", 0) or 0) or None
+        t, _ann = membership.rejoin_transport(
+            rdv, slot=slot, epoch=epoch, backend=backend,
+            timeout=rj_timeout)
+        home = P2PCommunicator(t, range(t.world_size), ("epoch", epoch))
+        home._mark_generation()
+        _ft.enable(home, rdv_dir=rdv)
+        # readiness AFTER ft.enable: the heartbeat file must be fresh
+        # before survivors are told to re-admit this slot
+        membership.publish_ready(rdv, epoch, t.world_rank)
+        _mpit.count(rejoins=1)
+    else:
+        home = _init()  # MPI_TPU_FT=1 in the env: detector enabled
+        t = home._t
+    world_ft = t._ft_world
+    slot = t.world_rank
+
+    host, port = os.environ["MPI_TPU_SERVE_CTRL"].rsplit(":", 1)
+    ctrl = socket.create_connection((host, int(port)), timeout=30.0)
+    ctrl.settimeout(None)
+    ctrl.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+    send_lock = threading.Lock()
+    _send_msg(ctrl, send_lock, {
+        "op": "hello", "slot": slot, "pid": os.getpid(),
+        "incarnation": membership.incarnation(), "epoch": t.epoch})
+
+    jobs: "queue.Queue[Optional[dict]]" = queue.Queue()
+
+    def reader() -> None:
+        while True:
+            msg = _recv_msg(ctrl)
+            if msg is None or msg.get("op") == "shutdown":
+                jobs.put(None)
+                return
+            op = msg.get("op")
+            if op == "job":
+                jobs.put(msg)
+            elif op == "transition":
+                # observe() FIRST: a job thread wedged in a ring-full
+                # send to the corpse exits via the _peer_suspected
+                # check, releasing the per-dest send lock that
+                # survivor_transition's invalidate needs — the reverse
+                # order deadlocks this reader against that sender for
+                # a full local detection bound
+                # self-filter as a second line of defense: observing
+                # our own rank failed is never recoverable locally
+                dead = [d for d in msg["dead"] if d != slot]
+                for d in dead:
+                    world_ft.observe(d, "server-declared dead "
+                                        "(pool transition)")
+                # even mid-job: the corpse's endpoints must go NOW, or
+                # the current lease's sends keep streaming into them
+                membership.survivor_transition(t, msg["epoch"], dead)
+                _send_msg(ctrl, send_lock,
+                          {"op": "transition_ack", "slot": slot,
+                           "epoch": msg["epoch"]})
+            elif op == "rejoined":
+                world_ft.reset_rank(msg["slot"])
+                t.min_peer_epoch[int(msg["slot"])] = int(msg["epoch"])
+
+    threading.Thread(target=reader, daemon=True,
+                     name=f"serve-ctrl-{slot}").start()
+
+    while True:
+        msg = jobs.get()
+        if msg is None:
+            break
+        job_id, slots = msg["job_id"], list(msg["slots"])
+        try:
+            fn = pickle.loads(msg["fn"])
+            args = pickle.loads(msg["args"])
+            comm = P2PCommunicator(t, slots, ("lease", job_id))
+            comm._ft = _ft.CommFT(world_ft, ("lease", job_id))
+            # no coll/sm arena on lease comms: every job has a fresh
+            # context, so routing auto->arena would map a new multi-MB
+            # /dev/shm segment PER LEASE (same rationale as nbc clones;
+            # arena reuse across leases is a recorded residual)
+            comm._no_coll_sm = True
+            result = fn(comm, *args)
+            reply = {"op": "job_done", "job_id": job_id, "slot": slot,
+                     "ok": True}
+            if comm.rank == 0:
+                reply["result"] = pickle.dumps(
+                    result, protocol=pickle.HIGHEST_PROTOCOL)
+        except BaseException as e:  # noqa: BLE001 - shipped to the client
+            reply = {"op": "job_done", "job_id": job_id, "slot": slot,
+                     "ok": False, "error": _pack_error(e)}
+        try:
+            _send_msg(ctrl, send_lock, reply)
+        except OSError:
+            return 1  # server gone: nothing left to serve
+    return 0
+
+
+# -- the server ---------------------------------------------------------------
+
+
+class _Worker:
+    __slots__ = ("slot", "proc", "conn", "send_lock", "state",
+                 "incarnation", "epoch", "lease_id", "spawned_at")
+
+    def __init__(self, slot: int) -> None:
+        self.slot = slot
+        self.proc: Optional[subprocess.Popen] = None
+        self.conn: Optional[socket.socket] = None
+        self.send_lock = threading.Lock()
+        self.state = "starting"  # starting|idle|leased|dead
+        self.incarnation: Optional[str] = None
+        self.epoch = 0
+        self.lease_id: Optional[int] = None
+        self.spawned_at = time.monotonic()
+
+
+class WorldServer:
+    """The resident daemon: a pool of warm workers, leased as worlds.
+
+    Use as a context manager (tests / in-process benches) or through
+    ``python -m mpi_tpu.launcher serve`` (deployment).  ``addr`` is the
+    ``host:port`` clients pass to :func:`connect`."""
+
+    def __init__(self, pool_size: int = _POOL_SIZE, backend: str = "socket",
+                 host: str = _HOST, port: int = 0,
+                 detect_timeout_s: float = _DETECT_TIMEOUT_S,
+                 heartbeat_s: float = _HEARTBEAT_S,
+                 world_lease_timeout_s: float = _WORLD_LEASE_TIMEOUT_S,
+                 rejoin_timeout_s: float = _REJOIN_TIMEOUT_S,
+                 env_extra: Optional[dict] = None) -> None:
+        if pool_size < 1:
+            raise ValueError("pool_size must be >= 1")
+        if backend == "shm":
+            from .native import ensure_built
+
+            ensure_built()  # compile once, not pool_size racing ranks
+        self.pool_size = pool_size
+        self.backend = backend
+        self.detect_timeout_s = float(detect_timeout_s)
+        self.heartbeat_s = float(heartbeat_s)
+        self.world_lease_timeout_s = float(world_lease_timeout_s)
+        self.rejoin_timeout_s = float(rejoin_timeout_s)
+        self._env_extra = dict(env_extra or {})
+        self.rdv = membership.new_rendezvous_dir(prefix="mpi_tpu_serve_")
+        self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._listener.bind((host, port))
+        self._listener.listen(pool_size + 16)
+        self.addr = "%s:%d" % self._listener.getsockname()[:2]
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        self._closing = False
+        self.epoch = 0
+        self._workers: Dict[int, _Worker] = {}
+        self._leases: Dict[int, dict] = {}
+        self._jobs: Dict[int, dict] = {}
+        self._healing: Dict[int, dict] = {}  # slot -> {epoch, proc, since}
+        self._seq = 0
+        self.stats_counters = {"leases_granted": 0, "leases_denied": 0,
+                               "jobs_ok": 0, "jobs_failed": 0,
+                               "heals_completed": 0, "workers_lost": 0}
+        self._threads: List[threading.Thread] = []
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self, wait_ready: bool = True,
+              timeout: float = 120.0) -> "WorldServer":
+        for slot in range(self.pool_size):
+            self._workers[slot] = _Worker(slot)
+            self._spawn_worker(slot)
+        for name, target in (("accept", self._accept_loop),
+                             ("monitor", self._monitor_loop)):
+            th = threading.Thread(target=target, daemon=True,
+                                  name=f"serve-{name}")
+            th.start()
+            self._threads.append(th)
+        if wait_ready:
+            deadline = time.monotonic() + timeout
+            with self._cond:
+                while any(w.state == "starting"
+                          for w in self._workers.values()):
+                    if self._closing:
+                        raise RuntimeError("server stopped during start")
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        raise TimeoutError(
+                            f"worker pool not ready within {timeout}s: "
+                            + str({s: w.state for s, w
+                                   in self._workers.items()}))
+                    self._cond.wait(min(0.25, remaining))
+        return self
+
+    def __enter__(self) -> "WorldServer":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+    def stop(self) -> None:
+        with self._cond:
+            if self._closing:
+                return
+            self._closing = True
+            # every mutable read snapshotted HERE: the monitor thread
+            # may be mid-heal, mutating conns and self._healing
+            conns = [(w.conn, w.send_lock)
+                     for w in self._workers.values()
+                     if w.conn is not None]
+            procs = [w.proc for w in self._workers.values()
+                     if w.proc is not None]
+            procs += [h["proc"] for h in self._healing.values()
+                      if h.get("proc") is not None]
+            self._cond.notify_all()
+        for conn, lk in conns:
+            try:
+                _send_msg(conn, lk, {"op": "shutdown"})
+            except OSError:
+                pass
+        try:
+            self._listener.close()
+        except OSError:
+            pass
+        deadline = time.monotonic() + 5.0
+        for p in procs:
+            if p.poll() is None:
+                p.terminate()
+        for p in procs:
+            if p.poll() is None:
+                try:
+                    p.wait(max(0.0, deadline - time.monotonic()))
+                except subprocess.TimeoutExpired:
+                    p.kill()
+        for p in procs:
+            if p.poll() is None:
+                try:
+                    p.wait(2.0)
+                except subprocess.TimeoutExpired:  # pragma: no cover
+                    pass
+        membership.cleanup_rendezvous(self.rdv)
+
+    # -- worker processes --------------------------------------------------
+
+    def _worker_env(self, slot: int,
+                    rejoin_epoch: Optional[int] = None) -> dict:
+        from .launcher import cpu_pinned_env
+
+        env = dict(os.environ)
+        want = self._env_extra.get("MPI_TPU_RANK_JAX_PLATFORMS")
+        cpu_pinned_env(env, want)
+        env.update({
+            "MPI_TPU_RANK": str(slot),
+            "MPI_TPU_SIZE": str(self.pool_size),
+            "MPI_TPU_RDV": self.rdv,
+            "MPI_TPU_BACKEND": self.backend,
+            "MPI_TPU_FT": "1",
+            "MPI_TPU_SERVE_CTRL": self.addr,
+            "MPI_TPU_SERVE_DETECT_S": str(self.detect_timeout_s),
+            "MPI_TPU_SERVE_HEARTBEAT_S": str(self.heartbeat_s),
+        })
+        env.pop("MPI_TPU_SERVE_REJOIN", None)
+        if rejoin_epoch is not None:
+            env["MPI_TPU_SERVE_REJOIN"] = f"{rejoin_epoch}:{slot}"
+            env["MPI_TPU_SERVE_REJOIN_TIMEOUT_S"] = \
+                str(self.rejoin_timeout_s)
+        env.update(self._env_extra)
+        return env
+
+    def _spawn_worker(self, slot: int,
+                      rejoin_epoch: Optional[int] = None
+                      ) -> subprocess.Popen:
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "mpi_tpu.serve", "--worker"],
+            env=self._worker_env(slot, rejoin_epoch))
+        if rejoin_epoch is None:
+            self._workers[slot].proc = proc
+            self._workers[slot].spawned_at = time.monotonic()
+        return proc
+
+    # -- accept / connection handling --------------------------------------
+
+    def _accept_loop(self) -> None:
+        while not self._closing:
+            try:
+                conn, _ = self._listener.accept()
+            except OSError:
+                return
+            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            threading.Thread(target=self._conn_loop, args=(conn,),
+                             daemon=True, name="serve-conn").start()
+
+    def _conn_loop(self, conn: socket.socket) -> None:
+        first = _recv_msg(conn)
+        if first is None:
+            conn.close()
+            return
+        if first.get("op") == "hello":
+            self._worker_loop(conn, first)
+        else:
+            self._client_loop(conn, first)
+
+    # -- worker side -------------------------------------------------------
+
+    def _worker_loop(self, conn: socket.socket, hello: dict) -> None:
+        slot = int(hello["slot"])
+        with self._cond:
+            w = self._workers.get(slot)
+            if w is None:
+                conn.close()
+                return
+            heal = self._healing.pop(slot, None)
+            if heal is not None:
+                w.proc = heal["proc"]
+                self.stats_counters["heals_completed"] += 1
+            w.conn = conn
+            w.incarnation = hello.get("incarnation")
+            w.epoch = int(hello.get("epoch", 0))
+            w.lease_id = None
+            # (conn, lock) pairs snapshotted under the lock — see
+            # _begin_heal for the concurrent-death rationale
+            peers = [(p.conn, p.send_lock)
+                     for p in self._workers.values()
+                     if p is not w and p.conn is not None
+                     and p.state not in ("dead",)]
+            behind = w.epoch < self.epoch
+            catchup = {"op": "transition", "epoch": self.epoch,
+                       # never list the hello-ing worker's OWN slot
+                       # (its state is still 'dead' right here): a
+                       # worker observing itself failed would poison
+                       # every FT decision of its future leases
+                       "dead": [p.slot for p in self._workers.values()
+                                if p is not w
+                                and (p.state == "dead"
+                                     or p.slot in self._healing)]}
+        if behind:
+            # another death's transition was broadcast while this
+            # worker was still rejoining (excluded as 'dead'): resync
+            # it NOW or its first send to an up-epoch survivor raises
+            # EpochSkewError forever while stats report a healthy pool
+            try:
+                _send_msg(conn, w.send_lock, catchup)
+            except OSError:
+                pass  # EOF path marks it dead next
+        if heal is not None:
+            # tell the survivors the slot is live again under its epoch
+            # BEFORE the slot becomes leasable: a job dispatched to a
+            # peer rides the same FIFO control connection as this
+            # 'rejoined', so each peer clears its detector's failed
+            # entry before it can possibly run a lease with the healed
+            # slot — idle-first would let the first post-heal lease
+            # raise a spurious ProcFailedError off the stale failed set
+            for conn_p, lk_p in peers:
+                try:
+                    _send_msg(conn_p, lk_p,
+                              {"op": "rejoined", "slot": slot,
+                               "epoch": w.epoch})
+                except OSError:
+                    pass
+        with self._cond:
+            w.state = "idle"
+            self._cond.notify_all()
+        while True:
+            msg = _recv_msg(conn)
+            if msg is None:
+                with self._cond:
+                    if not self._closing and self._workers[slot] is w \
+                            and w.conn is conn and w.state != "dead":
+                        self._mark_dead_locked(w, "control channel EOF")
+                    self._cond.notify_all()
+                return
+            if msg.get("op") == "job_done":
+                self._job_done(slot, msg)
+            # transition_acks are informational: the monitor's spawn of
+            # the replacement does not wait on them (a wedged worker
+            # must not stall the pool's healing)
+
+    def _job_done(self, slot: int, msg: dict) -> None:
+        with self._cond:
+            job = self._jobs.get(msg["job_id"])
+            if job is None:
+                return
+            job["pending"].discard(slot)
+            if msg.get("ok"):
+                if "result" in msg:
+                    job["result"] = msg["result"]
+            else:
+                job["errors"].append(msg.get("error", {}))
+            if not job["pending"]:
+                job["event"].set()
+            self._cond.notify_all()
+
+    def _mark_dead_locked(self, w: _Worker, why: str) -> None:
+        """State transition for a lost worker (caller holds the lock):
+        epoch bump + fail its in-flight job; the monitor loop picks the
+        slot up for healing on its next tick."""
+        if w.state == "dead":
+            return
+        w.state = "dead"
+        w.conn = None
+        if w.proc is not None and w.proc.poll() is None:
+            # declared dead but the process lives (heartbeat-stale
+            # wedge): kill it — two live incarnations of one slot must
+            # never coexist, and the replacement hello overwrites
+            # w.proc, dropping stop()'s only handle on this one
+            try:
+                w.proc.kill()
+            except OSError:
+                pass
+        self.stats_counters["workers_lost"] += 1
+        self.epoch += 1
+        for job in self._jobs.values():
+            if w.slot in job["pending"]:
+                job["pending"].discard(w.slot)
+                job["errors"].append({
+                    "kind": "ProcFailedError",
+                    "code": error_class(ProcFailedError("")),
+                    "msg": f"leased worker slot {w.slot} died ({why})",
+                    "failed": [w.slot], "collective": None})
+                if not job["pending"]:
+                    job["event"].set()
+
+    # -- monitoring / healing ----------------------------------------------
+
+    def _hb_stale(self, slot: int, now: float) -> bool:
+        try:
+            st = os.stat(os.path.join(self.rdv, f"hb.{slot}"))
+        except OSError:
+            return False  # not yet published: proc liveness covers it
+        return now - st.st_mtime > 3.0 * self.detect_timeout_s
+
+    def _monitor_loop(self) -> None:
+        while not self._closing:
+            time.sleep(self.heartbeat_s)
+            if self._closing:
+                return
+            try:
+                self._monitor_tick()
+            except Exception:  # noqa: BLE001 - the pool's lifeline
+                if self._closing:
+                    return  # shutdown raced a heal (rdv dir removed)
+                import traceback
+
+                traceback.print_exc()
+                # a monitor crash must never silently end healing: log
+                # and keep ticking
+
+    def _monitor_tick(self) -> None:
+        now_wall = time.time()
+        with self._cond:
+            for w in self._workers.values():
+                if w.state == "dead" or w.slot in self._healing:
+                    continue
+                lost = (w.proc is not None
+                        and w.proc.poll() is not None)
+                if not lost and w.state != "starting":
+                    lost = self._hb_stale(w.slot, now_wall)
+                if lost:
+                    self._mark_dead_locked(
+                        w, "process exited"
+                        if w.proc is not None
+                        and w.proc.poll() is not None
+                        else "heartbeat stale")
+            # heal EVERY dead slot without a healing round in
+            # flight — deaths are marked both here and by the
+            # worker-connection EOF path, and both must converge on
+            # a replacement
+            dead_now = [w for w in self._workers.values()
+                        if w.state == "dead"
+                        and w.slot not in self._healing]
+            epoch = self.epoch
+            if dead_now:
+                self._cond.notify_all()
+        if dead_now:
+            self._begin_heal(dead_now, epoch)
+        self._drive_healing()
+
+    def _begin_heal(self, dead: List[_Worker], epoch: int) -> None:
+        """One healing round: tell survivors, announce the vacancies,
+        spawn replacements that rejoin under the new epoch."""
+        dead_slots = [w.slot for w in dead]
+        with self._lock:
+            # snapshot (conn, lock) PAIRS under the lock: a concurrent
+            # death nulls worker.conn, and re-reading it outside the
+            # lock would hand None to sendall (AttributeError kills the
+            # monitor thread — the pool would stop healing entirely)
+            live = [(p.conn, p.send_lock) for p in self._workers.values()
+                    if p.state not in ("dead", "starting")
+                    and p.conn is not None]
+        for conn, lk in live:
+            try:
+                _send_msg(conn, lk, {"op": "transition", "epoch": epoch,
+                                     "dead": dead_slots})
+            except OSError:
+                pass  # its own death will be noticed next tick
+        slots_meta = {
+            s: {"ousted": membership.read_incarnation(self.rdv, s),
+                # the server IS the membership authority: it observed
+                # the death and decided to replace, which is the ack —
+                # the refusal gate still protects against an UNINVITED
+                # ousted incarnation claiming before the server's
+                # replacement (it presents the ousted id; the spawned
+                # replacement presents a fresh one)
+                "acked": False}
+            for s in dead_slots}
+        membership.announce_rejoin(self.rdv, epoch, slots_meta,
+                                   self.pool_size, self.backend)
+        with self._lock:
+            if self._closing:
+                return  # a stop() racing this heal owns every process
+            for w in dead:
+                proc = self._spawn_worker(w.slot, rejoin_epoch=epoch)
+                self._healing[w.slot] = {
+                    "epoch": epoch, "proc": proc,
+                    "since": time.monotonic(), "meta": slots_meta}
+
+    def _drive_healing(self) -> None:
+        """Per-tick healing duties: validate claims/admit replacements
+        (the announcer role of the membership protocol), and respawn a
+        replacement that died during its own rejoin handshake — the
+        pool recovers, no epoch fork (the announce stays valid)."""
+        with self._lock:
+            healing = dict(self._healing)
+        for slot, h in healing.items():
+            membership.process_claims(self.rdv, h["epoch"],
+                                      {slot: h["meta"][slot]})
+            proc = h["proc"]
+            if proc.poll() is not None:
+                with self._lock:
+                    if self._closing or slot not in self._healing:
+                        continue
+                    h["proc"] = self._spawn_worker(
+                        slot, rejoin_epoch=h["epoch"])
+                    h["since"] = time.monotonic()
+                    self._healing[slot] = h
+            elif time.monotonic() - h["since"] > self.rejoin_timeout_s:
+                # the replacement is ALIVE but wedged in its handshake
+                # past the rejoin bound: kill it — next tick's poll()
+                # branch respawns, and process_claims sweeps its
+                # leftover claim (dead pid).  Re-check under the lock
+                # that this round is STILL healing (mirroring the
+                # respawn branch): the worker may have completed its
+                # hello since the snapshot, and killing a just-healed,
+                # possibly-leased worker would livelock healing
+                with self._lock:
+                    still = (not self._closing
+                             and self._healing.get(slot) is h)
+                if still:
+                    try:
+                        proc.kill()
+                    except OSError:
+                        pass
+
+    # -- client side -------------------------------------------------------
+
+    def _client_loop(self, conn: socket.socket, first: dict) -> None:
+        lock = threading.Lock()
+        owned: List[int] = []  # lease ids owned by this connection
+        msg: Optional[dict] = first
+        try:
+            while msg is not None:
+                try:
+                    reply = self._client_op(msg, owned)
+                except Exception as e:  # noqa: BLE001 - shipped back
+                    reply = {"error": _pack_error(e)}
+                try:
+                    _send_msg(conn, lock, reply)
+                except OSError:
+                    break
+                if msg.get("op") == "shutdown":
+                    threading.Thread(target=self.stop,
+                                     daemon=True).start()
+                    break
+                msg = _recv_msg(conn)
+        finally:
+            for lease_id in list(owned):
+                self._release(lease_id)
+            conn.close()
+
+    def _client_op(self, msg: dict, owned: List[int]) -> dict:
+        op = msg.get("op")
+        if op == "acquire":
+            return self._acquire(msg, owned)
+        if op == "run":
+            return self._run_job(msg)
+        if op == "release":
+            self._release(int(msg["lease_id"]))
+            if int(msg["lease_id"]) in owned:
+                owned.remove(int(msg["lease_id"]))
+            return {"ok": True}
+        if op == "stats":
+            return {"ok": True, "stats": self.stats()}
+        if op == "shutdown":
+            return {"ok": True}
+        return {"error": {"kind": "ValueError",
+                          "msg": f"unknown op {op!r}"}}
+
+    def _acquire(self, msg: dict, owned: List[int]) -> dict:
+        nranks = int(msg["nranks"])
+        if nranks < 1 or nranks > self.pool_size:
+            raise ValueError(
+                f"nranks must be in [1, {self.pool_size}] for this pool")
+        timeout = float(msg.get("timeout") or self.world_lease_timeout_s)
+        deadline = time.monotonic() + timeout
+        with self._cond:
+            while True:
+                if self._closing:
+                    raise RuntimeError("server shutting down")
+                idle = sorted(s for s, w in self._workers.items()
+                              if w.state == "idle")
+                if len(idle) >= nranks:
+                    break
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    self.stats_counters["leases_denied"] += 1
+                    return {"error": {
+                        "kind": "LeaseTimeout",
+                        "msg": f"no {nranks} idle workers within "
+                               f"{timeout}s (pool {self.pool_size}, "
+                               f"idle {len(idle)})"}}
+                self._cond.wait(min(0.25, remaining))
+            slots = idle[:nranks]
+            self._seq += 1
+            lease_id = self._seq
+            for s in slots:
+                self._workers[s].state = "leased"
+                self._workers[s].lease_id = lease_id
+            self._leases[lease_id] = {"slots": slots}
+            self.stats_counters["leases_granted"] += 1
+            epoch = self.epoch
+        owned.append(lease_id)
+        return {"ok": True, "lease_id": lease_id, "slots": slots,
+                "epoch": epoch}
+
+    def _run_job(self, msg: dict) -> dict:
+        lease_id = int(msg["lease_id"])
+        timeout = float(msg.get("timeout") or self.world_lease_timeout_s)
+        with self._cond:
+            lease = self._leases.get(lease_id)
+            if lease is None:
+                raise ValueError(f"unknown lease {lease_id}")
+            slots = list(lease["slots"])
+            dead = [s for s in slots
+                    if self._workers[s].state != "leased"
+                    or self._workers[s].lease_id != lease_id]
+            self._seq += 1
+            job_id = self._seq
+            job = {"pending": set(slots) - set(dead), "errors": [],
+                   "result": None, "event": threading.Event()}
+            if dead:
+                job["errors"].append({
+                    "kind": "ProcFailedError",
+                    "code": error_class(ProcFailedError("")),
+                    "msg": f"leased worker slot(s) {dead} died before "
+                           f"the job started",
+                    "failed": dead, "collective": None})
+            self._jobs[job_id] = job
+            targets = [(self._workers[s].conn, self._workers[s].send_lock)
+                       for s in job["pending"]]
+        if not job["pending"]:
+            job["event"].set()
+        for conn, lk in targets:
+            try:
+                _send_msg(conn, lk, {
+                    "op": "job", "job_id": job_id, "slots": slots,
+                    "fn": msg["fn"], "args": msg["args"]})
+            except OSError:
+                pass  # its death is noticed by the monitor and synthesized
+        ok = job["event"].wait(timeout)
+        with self._cond:
+            self._jobs.pop(job_id, None)
+            stuck = sorted(job["pending"])
+            # pin the exact PROC OBJECTS while holding the lock: a
+            # concurrent heal could install a healthy replacement under
+            # the same slot, and signalling by slot would dump/kill it
+            stuck_procs = [(s, self._workers[s].proc) for s in stuck]
+        if not ok:
+            # dump the unresponsive workers' stacks to their stderr
+            # (faulthandler SIGUSR2 handler) for the diagnosis, then
+            # QUARANTINE them by killing: a worker that blew the lease
+            # timeout is still wedged in the old job (its job loop is
+            # serial), and returning it to the idle pool on release
+            # would poison every subsequent lease it joins — killed, it
+            # takes the already-tested healing path and comes back as a
+            # fresh replacement under the next epoch
+            import signal as _signal
+
+            for s, proc in stuck_procs:
+                if proc is not None and proc.poll() is None:
+                    try:
+                        os.kill(proc.pid, _signal.SIGUSR2)
+                        time.sleep(0.1)  # let the dump reach stderr
+                        proc.kill()
+                    except OSError:
+                        pass
+            return {"error": {
+                "kind": "LeaseTimeout",
+                "msg": f"job on lease {lease_id} did not complete "
+                       f"within {timeout}s (unresponsive worker "
+                       f"slots {stuck}: stacks dumped to the server "
+                       f"log, workers killed for pool healing)"}}
+        if job["errors"]:
+            self.stats_counters["jobs_failed"] += 1
+            # the most diagnosable error wins: a named FT error over a
+            # generic one
+            errs = sorted(
+                job["errors"],
+                key=lambda e: 0 if e.get("kind") in _ERROR_KINDS else 1)
+            return {"error": errs[0]}
+        self.stats_counters["jobs_ok"] += 1
+        return {"ok": True, "result": job["result"]}
+
+    def _release(self, lease_id: int) -> None:
+        with self._cond:
+            lease = self._leases.pop(lease_id, None)
+            if lease is None:
+                return
+            for s in lease["slots"]:
+                w = self._workers[s]
+                if w.state == "leased" and w.lease_id == lease_id:
+                    w.state = "idle"
+                    w.lease_id = None
+            self._cond.notify_all()
+
+    def stats(self) -> dict:
+        with self._lock:
+            states = {s: w.state for s, w in self._workers.items()}
+            return {
+                "addr": self.addr, "backend": self.backend,
+                "pool_size": self.pool_size, "epoch": self.epoch,
+                "workers": states,
+                "idle": sum(1 for v in states.values() if v == "idle"),
+                "healing": sorted(self._healing),
+                "leases_active": len(self._leases),
+                **self.stats_counters,
+            }
+
+
+# -- the client ---------------------------------------------------------------
+
+
+class WorldLease:
+    """A leased world: run jobs on it, release it when done."""
+
+    def __init__(self, client: "ServerClient", lease_id: int,
+                 slots: List[int], epoch: int) -> None:
+        self._client = client
+        self.lease_id = lease_id
+        self.slots = list(slots)
+        self.epoch = int(epoch)
+        self._released = False
+
+    @property
+    def size(self) -> int:
+        return len(self.slots)
+
+    def run(self, fn, *args: Any, timeout: Optional[float] = None) -> Any:
+        """Execute ``fn(comm, *args)`` on every leased worker (``fn``
+        pickled by reference — workers must be able to import it);
+        returns lease-rank 0's return value.  Raises the worker-side
+        error BY NAME (ProcFailedError & co.) on any failure."""
+        reply = self._client._request({
+            "op": "run", "lease_id": self.lease_id,
+            "fn": pickle.dumps(fn, protocol=pickle.HIGHEST_PROTOCOL),
+            "args": pickle.dumps(args, protocol=pickle.HIGHEST_PROTOCOL),
+            "timeout": timeout})
+        blob = reply.get("result")
+        return pickle.loads(blob) if blob is not None else None
+
+    def release(self) -> None:
+        if not self._released:
+            self._released = True
+            self._client._request({"op": "release",
+                                   "lease_id": self.lease_id})
+
+    def __enter__(self) -> "WorldLease":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        try:
+            self.release()
+        except (TransportError, OSError):
+            pass  # server gone: the lease died with it (and a release
+            # failure must never mask the body's real exception)
+
+
+class ServerClient:
+    """Client handle to a resident world server (see :func:`connect`)."""
+
+    def __init__(self, host: str, port: int, timeout: float = 30.0) -> None:
+        self._sock = socket.create_connection((host, port),
+                                              timeout=timeout)
+        self._sock.settimeout(None)
+        self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        self._lock = threading.Lock()  # one request/response in flight
+
+    def _request(self, msg: dict) -> dict:
+        with self._lock:
+            _send_msg(self._sock, None, msg)
+            reply = _recv_msg(self._sock)
+        if reply is None:
+            raise TransportError("world server closed the connection")
+        if "error" in reply:
+            _raise_error(reply["error"])
+        return reply
+
+    def acquire(self, nranks: int,
+                timeout: Optional[float] = None) -> WorldLease:
+        """Lease ``nranks`` warm workers as a world: ONE round-trip (the
+        server reserves idle slots; no fork, no handshake).  Raises
+        TimeoutError when the pool cannot supply them in time."""
+        reply = self._request({"op": "acquire", "nranks": int(nranks),
+                               "timeout": timeout})
+        return WorldLease(self, reply["lease_id"], reply["slots"],
+                          reply["epoch"])
+
+    def run(self, fn, *args: Any, nranks: int = 2,
+            timeout: Optional[float] = None) -> Any:
+        """acquire + run + release in one call (the simple path)."""
+        lease = self.acquire(nranks, timeout=timeout)
+        try:
+            return lease.run(fn, *args, timeout=timeout)
+        finally:
+            try:
+                lease.release()
+            except (TransportError, OSError):
+                pass  # server gone: must not mask run()'s real error
+
+    def stats(self) -> dict:
+        return self._request({"op": "stats"})["stats"]
+
+    def shutdown(self) -> None:
+        """Ask the server process to stop (admin surface)."""
+        try:
+            self._request({"op": "shutdown"})
+        except (TransportError, OSError):
+            pass
+
+    def close(self) -> None:
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+    def __enter__(self) -> "ServerClient":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+def connect(addr: Any, timeout: float = 30.0) -> ServerClient:
+    """Connect to a resident world server.  ``addr`` is ``"host:port"``,
+    a ``(host, port)`` tuple, a :class:`WorldServer` (in-process), or a
+    path to a file containing ``host:port`` (the launcher's
+    ``serve --addr-file``)."""
+    if isinstance(addr, WorldServer):
+        addr = addr.addr
+    if isinstance(addr, (tuple, list)):
+        host, port = addr[0], int(addr[1])
+    else:
+        text = str(addr)
+        if os.path.exists(text):
+            with open(text) as f:
+                text = f.read().strip()
+        host, port = text.rsplit(":", 1)
+        port = int(port)
+    return ServerClient(host, port, timeout=timeout)
+
+
+# -- CLI ----------------------------------------------------------------------
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if argv and argv[0] == "--worker":
+        return _worker_main()
+    ap = argparse.ArgumentParser(
+        prog="mpi_tpu.launcher serve",
+        description="resident world server: pool warm workers, lease "
+                    "worlds to clients, self-heal under kill injection")
+    ap.add_argument("--pool-size", type=int, default=_POOL_SIZE)
+    ap.add_argument("--backend", choices=("socket", "shm"),
+                    default="socket")
+    ap.add_argument("--host", default=_HOST)
+    ap.add_argument("--port", type=int, default=0)
+    ap.add_argument("--addr-file", default=None,
+                    help="write host:port here once listening "
+                         "(clients: mpi_tpu.connect(path))")
+    ap.add_argument("--detect-timeout", type=float,
+                    default=_DETECT_TIMEOUT_S,
+                    help="pool-internal ULFM detection bound (s)")
+    ap.add_argument("--heartbeat", type=float, default=_HEARTBEAT_S)
+    ap.add_argument("--lease-timeout", type=float,
+                    default=_WORLD_LEASE_TIMEOUT_S,
+                    help="world_lease_timeout_s: max wait for idle "
+                         "workers / default job bound")
+    ap.add_argument("--rejoin-timeout", type=float,
+                    default=_REJOIN_TIMEOUT_S,
+                    help="rejoin_timeout_s of one healing handshake")
+    args = ap.parse_args(argv)
+    server = WorldServer(
+        pool_size=args.pool_size, backend=args.backend, host=args.host,
+        port=args.port, detect_timeout_s=args.detect_timeout,
+        heartbeat_s=args.heartbeat,
+        world_lease_timeout_s=args.lease_timeout,
+        rejoin_timeout_s=args.rejoin_timeout)
+    server.start()
+    print(f"mpi_tpu serve: listening on {server.addr} "
+          f"(pool {args.pool_size} x {args.backend})", flush=True)
+    if args.addr_file:
+        tmp = args.addr_file + ".tmp"
+        with open(tmp, "w") as f:
+            f.write(server.addr)
+        os.replace(tmp, args.addr_file)
+    try:
+        while not server._closing:
+            time.sleep(0.25)
+    except KeyboardInterrupt:
+        pass
+    finally:
+        server.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -19,7 +19,7 @@ from __future__ import annotations
 import threading
 import time
 from abc import ABC, abstractmethod
-from typing import Any, List, Optional, Tuple
+from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 ANY_SOURCE = -1
 ANY_TAG = -1
@@ -228,6 +228,18 @@ class Transport(ABC):
         self.world_rank = world_rank
         self.world_size = world_size
         self.mailbox = Mailbox()
+        # Elastic-membership generation (mpi_tpu/membership.py): the
+        # monotone epoch this process believes its world is in.  Bumped
+        # by shrink() (in survivor lockstep, riding the agreement) and
+        # by membership.survivor_transition(); stamped into transport
+        # hellos so a stale-epoch straggler is rejected loudly
+        # (EpochSkewError) instead of cross-wiring two generations.
+        self.epoch = 0
+        # world rank -> minimum endpoint epoch acceptable when (re)
+        # connecting to that peer: set to the transition epoch for
+        # REPLACED slots, so a survivor re-handshaking can never adopt
+        # the dead incarnation's leftover endpoints.
+        self.min_peer_epoch: Dict[int, int] = {}
 
     @abstractmethod
     def send(self, dest: int, ctx, tag: int, payload: Any) -> None:
@@ -257,6 +269,15 @@ class Transport(ABC):
         self, source: int, ctx, tag: int
     ) -> Optional[Tuple[int, int, Optional[int]]]:
         return self.mailbox.peek_nowait(source, ctx, tag)
+
+    def membership_invalidate(self, dead: Sequence[int]) -> None:
+        """Epoch-transition hook (mpi_tpu/membership.py): drop every
+        cached endpoint to the given world ranks so the next send
+        re-handshakes against the rendezvous dir (where a replacement
+        publishes fresh endpoints under the new epoch).  Base: nothing
+        cached per peer.  Transports with per-peer connections/rings
+        override; the override must exclude in-flight senders (take the
+        per-dest send lock) before tearing an endpoint down."""
 
     def progress_park(self, timeout: float) -> bool:
         """Progress-engine park hook (mpi_tpu/progress.py): block until

@@ -120,7 +120,9 @@ def run_local(
             t: Transport = LocalTransport(world, r)
             if transport_wrapper is not None:
                 t = transport_wrapper(t)
-            comm = P2PCommunicator(t, range(nranks), recv_timeout=recv_timeout)
+            comm = P2PCommunicator(t, range(nranks),
+                                   recv_timeout=recv_timeout)
+            comm._mark_generation()  # the world comm: shrink bumps epoch
             if liveness is not None:
                 from .. import ft as _ft
 

@@ -54,6 +54,8 @@ from typing import Any, Dict, Optional, Tuple
 
 import numpy as np
 
+from .. import mpit as _mpit
+from ..errors import EpochSkewError
 from ..native import load_shmring
 from . import codec
 from .base import ANY_SOURCE, Mailbox, RecvTimeout, Transport, TransportError
@@ -73,6 +75,24 @@ _SMALL = 8192  # frames up to this commit in one ring write (atomic + 1 bell)
 _SPIN_S = float(os.environ.get(
     "MPI_TPU_SHM_SPIN_US",
     "100" if (os.cpu_count() or 1) > 1 else "0")) * 1e-6
+# Grace window before an ahead-of-us readiness stamp is declared a
+# SKEW (see transport/socket.py _EPOCH_GRACE_S — same rationale: a
+# broadcast epoch transition reaches peers at slightly different
+# times, and only a genuinely ousted straggler stays behind).
+_EPOCH_GRACE_S = 2.0
+
+
+class _PeerDeadMidFrame(TransportError):
+    """A frame from ``src`` truncated because the failure detector
+    declared the sender dead: the CHANNEL is desynced (unknown bytes of
+    a frame are missing) but the rest of the transport is healthy —
+    _drain_once quarantines the one ring (``_dead_srcs``) instead of
+    closing the whole mailbox, and an epoch transition recreates it for
+    the slot's replacement (membership_invalidate)."""
+
+    def __init__(self, msg: str, src: int) -> None:
+        super().__init__(msg)
+        self.src = src
 
 
 def _addr(buf) -> int:
@@ -112,8 +132,10 @@ class ShmTransport(Transport):
 
     def __init__(self, rank: int, size: int, rdv_dir: str,
                  ring_bytes: int = _RING_BYTES,
-                 connect_timeout: float = _OPEN_TIMEOUT) -> None:
+                 connect_timeout: float = _OPEN_TIMEOUT,
+                 epoch: int = 0) -> None:
         super().__init__(rank, size)
+        self.epoch = epoch  # a rejoiner is BORN into the current epoch
         self._lib = load_shmring()
         self._session = os.path.basename(rdv_dir.rstrip("/"))
         self._rdv = rdv_dir
@@ -121,6 +143,10 @@ class ShmTransport(Transport):
         self._ring_bytes = ring_bytes
         self._closing = False
 
+        # inbound channels quarantined mid-frame (their sender died with
+        # a frame half-written — the byte stream is desynced); skipped
+        # by _drain_once until an epoch transition recreates the ring
+        self._dead_srcs: set = set()
         # consumer side: create my incoming rings + doorbell, then publish
         self._in_rings: Dict[int, int] = {}
         for src in range(size):
@@ -136,10 +162,12 @@ class ShmTransport(Transport):
         self._db = self._lib.shmdb_create(_db_name(self._session, rank))
         if not self._db:
             raise TransportError(f"rank {rank}: doorbell create failed")
-        tmp = os.path.join(rdv_dir, f".shm.{rank}.tmp")
-        with open(tmp, "w") as f:
-            f.write("ready")
-        os.replace(tmp, os.path.join(rdv_dir, f"shm.{rank}"))
+        # readiness file content = the membership epoch these rings were
+        # created under (mpi_tpu/membership.py): a rejoiner replacing a
+        # dead slot re-publishes this file atomically, and openers check
+        # the stamp so neither a survivor nor a stale straggler can map
+        # the wrong generation's segments (see _out_ring_locked)
+        self._write_readiness()
 
         # producer side: outgoing rings + doorbells open lazily on first send
         self._out_rings: Dict[int, int] = {}
@@ -216,6 +244,16 @@ class ShmTransport(Transport):
                 raise TransportError(
                     f"rank {self.world_rank}: transport closed mid-frame "
                     f"from {src}")
+            if self._peer_suspected(src):
+                # quarantine THIS channel only (the mailbox and every
+                # other channel stay live — a pool survivor must remain
+                # usable after a peer dies mid-frame); a blocked
+                # receiver on the corpse is unblocked by the detector
+                # (comm-level sliced waits raise ProcFailedError)
+                raise _PeerDeadMidFrame(
+                    f"rank {self.world_rank}: frame from {src} truncated "
+                    f"mid-stream: the failure detector declared rank "
+                    f"{src} dead", src)
             if time.monotonic() > stall:
                 self.mailbox.close()  # failure must reach blocked recvs
                 raise TransportError(
@@ -277,10 +315,18 @@ class ShmTransport(Transport):
         lib = self._lib
         progressed = False
         for src, ring in self._in_items:
-            while lib.shmring_avail(ring) >= _LEN.size:
-                ctx, tag, obj = self._read_frame(src, ring)
-                self.mailbox.deliver(src, ctx, tag, obj)
-                progressed = True
+            if src in self._dead_srcs:
+                # desynced mid-frame channel: quarantined until an
+                # epoch transition recreates the ring
+                continue
+            try:
+                while lib.shmring_avail(ring) >= _LEN.size:
+                    ctx, tag, obj = self._read_frame(src, ring)
+                    self.mailbox.deliver(src, ctx, tag, obj)
+                    progressed = True
+            except _PeerDeadMidFrame:
+                self._dead_srcs.add(src)
+                continue  # other channels keep draining
         if progressed:
             # Local delivery-ring: threads that lost the progress-lock race
             # wait on the doorbell (not the mailbox cv), so tell them their
@@ -460,19 +506,64 @@ class ShmTransport(Transport):
                 lock = self._send_locks[dest] = threading.Lock()
             return lock
 
+    def _peer_epoch_once(self, dest: int) -> Optional[int]:
+        """Epoch stamped in the peer's shm readiness file, or None when
+        not (yet) published.  Pre-epoch files ('ready') read as 0."""
+        try:
+            with open(os.path.join(self._rdv, f"shm.{dest}")) as f:
+                text = f.read().strip()
+        except OSError:
+            return None
+        try:
+            return int(text)
+        except ValueError:
+            return 0
+
     def _out_ring_locked(self, dest: int) -> int:
         with self._state_lock:
             ring = self._out_rings.get(dest)
         if ring is not None:
             return ring
-        # wait for the peer to have created its incoming rings
-        flag = os.path.join(self._rdv, f"shm.{dest}")
+        # Wait for the peer to have created its incoming rings — at an
+        # acceptable membership epoch.  Three readiness-stamp cases:
+        # newer than ours = WE were shrunk out (EpochSkewError, the
+        # diagnosed straggler); below min_peer_epoch[dest] = the STALE
+        # incarnation's leftover file on a replaced slot (keep polling
+        # for the rejoiner's republish — mapping the old segment would
+        # stream bytes into a corpse's ring); otherwise open.
+        need = self.min_peer_epoch.get(dest, 0)
         deadline = time.monotonic() + self._connect_timeout
-        while not os.path.exists(flag):
+        skew_since = None
+        while True:
+            fe = self._peer_epoch_once(dest)
+            if fe is not None:
+                if fe > self.epoch:
+                    # grace before the skew verdict (mirrors the socket
+                    # hello): our own epoch bump may be milliseconds
+                    # behind a broadcast transition — self.epoch is
+                    # re-read every poll round.  A genuinely ousted
+                    # straggler never catches up and still raises.
+                    if skew_since is None:
+                        skew_since = time.monotonic()
+                    if time.monotonic() - skew_since > _EPOCH_GRACE_S:
+                        _mpit.count(epoch_skews=1)
+                        raise EpochSkewError(
+                            f"rank {self.world_rank}: peer {dest} "
+                            f"published shm endpoints at membership "
+                            f"epoch {fe}, this process at {self.epoch} "
+                            f"— this process was shrunk out of the "
+                            f"world (stale-epoch straggler)",
+                            local_epoch=self.epoch, peer_epoch=fe,
+                            peer=dest)
+                elif fe >= need:
+                    break
+                else:
+                    skew_since = None
             if time.monotonic() > deadline:
                 raise TransportError(
                     f"rank {self.world_rank}: peer {dest} did not publish "
-                    f"shm readiness within {self._connect_timeout}s")
+                    f"shm readiness at epoch >= {need} within "
+                    f"{self._connect_timeout}s")
             time.sleep(0.005)
         name = _ring_name(self._session, self.world_rank, dest)
         ring = self._lib.shmring_open(name, self._connect_timeout)
@@ -559,6 +650,17 @@ class ShmTransport(Transport):
             self._lib.shmdb_ring(self._out_dbs[dest])
             self._write_all(ring, blob, len(blob), dest)
 
+    def _peer_suspected(self, peer: int) -> bool:
+        """True once the ULFM detector (mpi_tpu/ft.py, attached to this
+        transport by ft.enable) has declared ``peer`` dead.  Consulted
+        between native wait slices on BOTH no-progress paths — a sender
+        stuck mid-frame in a dead consumer's full ring, and a reader
+        stuck mid-frame from a dead producer — so the data plane gives
+        up within the detection bound instead of spinning out the full
+        ``shm_write_timeout_s`` stall constant (FT residual (a))."""
+        ft = getattr(self, "_ft_world", None)
+        return ft is not None and peer in ft.failed
+
     def _write_all(self, ring: int, buf, n: int, dest: int) -> None:
         """Stream exactly ``n`` bytes into ``ring`` in short native slices
         (same teardown/dead-peer rationale as _read_exact).  ``buf`` is
@@ -576,6 +678,12 @@ class ShmTransport(Transport):
                 raise TransportError(
                     f"rank {self.world_rank}: transport closed during send "
                     f"to {dest}")
+            if self._peer_suspected(dest):
+                raise TransportError(
+                    f"rank {self.world_rank}: send to {dest} aborted "
+                    f"mid-frame ({done}/{n} bytes): the failure detector "
+                    f"declared rank {dest} dead (its ring will never "
+                    f"drain)")
             if time.monotonic() > stall:
                 raise TransportError(
                     f"rank {self.world_rank}: send to {dest} timed out "
@@ -586,6 +694,83 @@ class ShmTransport(Transport):
             if got:
                 done += got
                 stall = time.monotonic() + _WRITE_TIMEOUT
+
+    # -- membership (mpi_tpu/membership.py) --------------------------------
+
+    def _write_readiness(self) -> None:
+        """Atomically publish ``shm.<rank>`` containing the CURRENT
+        epoch — the one spelling shared by startup and epoch-transition
+        republish (the stamp format must never diverge between them)."""
+        tmp = os.path.join(self._rdv, f".shm.{self.world_rank}.tmp")
+        with open(tmp, "w") as f:
+            f.write(str(self.epoch))
+        os.replace(tmp, os.path.join(self._rdv,
+                                     f"shm.{self.world_rank}"))
+
+    def membership_republish(self) -> None:
+        """Re-stamp this rank's readiness file with the CURRENT epoch
+        (called by survivor_transition after an epoch bump): shm has no
+        per-connection hello, so the readiness stamp is where a stale
+        straggler doing a fresh ring-open against a survivor reads the
+        skew and raises EpochSkewError instead of mapping segments of a
+        world that moved on.  It is ALSO the replacement's green light:
+        a rejoiner requires every peer's stamp to reach its epoch
+        before opening rings (membership.rejoin_transport), which is
+        what guarantees it never appends to an inbound ring this
+        survivor has not yet recreated (membership_invalidate below)."""
+        try:
+            self._write_readiness()
+        except OSError:
+            pass  # rendezvous dir tearing down — world is exiting
+
+    def membership_invalidate(self, dead) -> None:
+        """Epoch transition, shm edition.  Two halves per replaced slot:
+
+        * OUTGOING rings/doorbells are dropped: their segments belong
+          to the dead incarnation (the rejoiner recreates its own
+          inbound side under the new epoch).  Takes each per-dest send
+          lock — a sender still streaming into the old ring must exit
+          first (the _peer_suspected check bounds that to the
+          detection timeout) before its mapping is unmapped.
+        * INBOUND rings from the slot are RECREATED (close + fresh
+          shmring_create, which unlinks the stale segment): the corpse
+          may have died mid-frame, leaving the byte stream desynced
+          (quarantined in ``_dead_srcs``), and the replacement must
+          never append to that garbage — it only opens our rings after
+          our readiness file shows the new epoch (membership_republish
+          runs after this, see survivor_transition).  The swap holds
+          the progress lock: the drain loop iterates these rings.
+        """
+        for dest in dead:
+            try:
+                lock = self._send_lock(dest)
+            except TransportError:
+                return  # transport closing: close() tears everything down
+            with lock:
+                with self._state_lock:
+                    ring = self._out_rings.pop(dest, None)
+                    db = self._out_dbs.pop(dest, None)
+                if ring is not None:
+                    self._lib.shmring_close(ring)
+                if db is not None:
+                    self._lib.shmdb_close(db)
+        with self._progress_lock:
+            if self._closing:
+                return
+            for src in dead:
+                old = self._in_rings.pop(int(src), None)
+                if old is None:
+                    continue
+                self._lib.shmring_close(old)
+                name = _ring_name(self._session, int(src),
+                                  self.world_rank)
+                ring = self._lib.shmring_create(name, self._ring_bytes)
+                if ring:
+                    self._in_rings[int(src)] = ring
+                    self._dead_srcs.discard(int(src))
+                # creation failure leaves the channel out of the scan:
+                # sends from the replacement would time out loudly
+            self._in_items = list(self._in_rings.items())
 
     # -- shutdown ----------------------------------------------------------
 

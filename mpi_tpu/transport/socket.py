@@ -29,12 +29,29 @@ import threading
 import time
 from typing import Any, Dict, Optional
 
+from .. import mpit as _mpit
+from ..errors import EpochSkewError
 from . import codec
 from .base import Transport, TransportError
 
-_HELLO = struct.Struct("!i")
+# Connection handshake: the connector sends (world rank, membership
+# epoch), the acceptor answers with ITS epoch.  The epoch stamp is the
+# elastic-membership guard (mpi_tpu/membership.py): after a shrink +
+# rejoin every survivor requires replaced slots to present the new
+# epoch, and a stale-epoch straggler (the falsely-suspected ousted rank)
+# is rejected LOUDLY — EpochSkewError on the stale side — instead of
+# cross-wiring two world generations through recycled rendezvous files.
+_HELLO = struct.Struct("!iq")      # rank, epoch
+_HELLO_ACK = struct.Struct("!q")   # acceptor's epoch
 _HEADER = struct.Struct("!QQ")  # flags|payload_len, seq
 _HOST = "127.0.0.1"
+# Grace window before an ahead-of-us peer epoch is declared a SKEW: an
+# epoch transition is broadcast, and a healthy member whose reader/
+# control thread is scheduler-starved may see a peer's new epoch
+# milliseconds before applying its own bump.  A genuinely ousted
+# straggler's epoch never catches up, so the diagnosis still fires —
+# just one grace later.
+_EPOCH_GRACE_S = 2.0
 
 
 def _recv_exact(sock: socket.socket, n: int) -> Optional[bytes]:
@@ -81,8 +98,10 @@ class SocketTransport(Transport):
         size: int,
         rdv_dir: str,
         connect_timeout: float = 60.0,
+        epoch: int = 0,
     ) -> None:
         super().__init__(rank, size)
+        self.epoch = epoch  # a rejoiner is BORN into the current epoch
         self._rdv = rdv_dir
         self._connect_timeout = connect_timeout
         self._closing = False
@@ -110,25 +129,48 @@ class SocketTransport(Transport):
     # -- incoming ----------------------------------------------------------
 
     def _accept_loop(self) -> None:
+        # accept ONLY; the hello/ack handshake runs in the per-
+        # connection thread — a connector that stalls mid-hello (or a
+        # scheduler-starved handshake on a loaded box) must never
+        # serialize every OTHER peer's connection setup behind it
         while not self._closing:
             try:
                 conn, _ = self._listener.accept()
             except OSError:
                 return
-            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-            hello = _recv_exact(conn, _HELLO.size)
-            if hello is None:
-                conn.close()
-                continue
-            (src,) = _HELLO.unpack(hello)
             t = threading.Thread(
-                target=self._reader_loop,
-                args=(conn, src),
-                name=f"mpi-tpu-reader-{self.world_rank}<-{src}",
-                daemon=True,
-            )
+                target=self._handshake_and_read, args=(conn,),
+                name=f"mpi-tpu-reader-{self.world_rank}", daemon=True)
+            # prune finished readers while appending: resident-server
+            # worlds accept reconnects at every epoch transition, and
+            # an append-only list would grow for the process lifetime
+            self._reader_threads = [r for r in self._reader_threads
+                                    if r.is_alive()]
             self._reader_threads.append(t)
             t.start()
+
+    def _handshake_and_read(self, conn: socket.socket) -> None:
+        conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        hello = _recv_exact(conn, _HELLO.size)
+        if hello is None:
+            conn.close()
+            return
+        src, peer_epoch = _HELLO.unpack(hello)
+        try:
+            # always answer with our epoch FIRST: a rejected stale
+            # connector needs it to diagnose (EpochSkewError) rather
+            # than see an unexplained dead channel
+            conn.sendall(_HELLO_ACK.pack(self.epoch))
+        except OSError:
+            conn.close()
+            return
+        if peer_epoch < self.min_peer_epoch.get(src, 0):
+            # a dead-and-replaced slot's OLD incarnation dialing in:
+            # admitting its reader would cross-wire two generations
+            _mpit.count(epoch_skews=1)
+            conn.close()
+            return
+        self._reader_loop(conn, src)
 
     def _reader_loop(self, conn: socket.socket, src: int) -> None:
         while True:
@@ -185,17 +227,24 @@ class SocketTransport(Transport):
 
     # -- outgoing ----------------------------------------------------------
 
+    def _peer_port_once(self, dest: int) -> Optional[int]:
+        """Current content of the peer's rendezvous port file, or None.
+        Re-read on every connection retry: a REPLACED slot's rejoiner
+        re-publishes this file (atomic rename), and connecting to the
+        stale port forever would turn an epoch transition into a hang."""
+        try:
+            with open(os.path.join(self._rdv, f"port.{dest}")) as f:
+                text = f.read().strip()
+            return int(text) if text else None
+        except (FileNotFoundError, ValueError):
+            return None
+
     def _peer_port(self, dest: int) -> int:
-        path = os.path.join(self._rdv, f"port.{dest}")
         deadline = time.monotonic() + self._connect_timeout
         while True:
-            try:
-                with open(path) as f:
-                    text = f.read().strip()
-                if text:
-                    return int(text)
-            except FileNotFoundError:
-                pass
+            port = self._peer_port_once(dest)
+            if port is not None:
+                return port
             if time.monotonic() > deadline:
                 raise TransportError(
                     f"rank {self.world_rank}: peer {dest} did not publish a port "
@@ -214,30 +263,82 @@ class SocketTransport(Transport):
             return lock
 
     def _get_conn_locked(self, dest: int) -> socket.socket:
-        """Return the connection to ``dest``; caller holds the per-dest lock."""
+        """Return the connection to ``dest``; caller holds the per-dest
+        lock.  The handshake is hello(rank, epoch) → ack(peer epoch):
+
+        * ack epoch NEWER than ours — WE are the stale straggler (shrunk
+          out while we stalled past the detection bound): EpochSkewError,
+          the diagnosed spelling of the false-suspicion group split.
+        * ack epoch below ``min_peer_epoch[dest]`` — the PEER is a stale
+          incarnation still squatting on the old rendezvous endpoint of a
+          replaced slot: drop it and retry against a re-read port file
+          until the replacement publishes.
+        """
         with self._conn_lock:
             conn = self._conns.get(dest)
         if conn is not None:
             return conn
-        port = self._peer_port(dest)
+        self._peer_port(dest)  # bounded wait for a first publication
         deadline = time.monotonic() + self._connect_timeout
+        skew_since = None
         while True:
-            try:
-                conn = socket.create_connection((_HOST, port), timeout=5.0)
-                break
-            except OSError:
-                if time.monotonic() > deadline:
-                    raise TransportError(
-                        f"rank {self.world_rank}: cannot connect to rank {dest} "
-                        f"on port {port}"
-                    )
-                time.sleep(0.01)
-        conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-        conn.settimeout(None)
-        conn.sendall(_HELLO.pack(self.world_rank))
-        with self._conn_lock:
-            self._conns[dest] = conn
-        return conn
+            port = self._peer_port_once(dest)
+            conn = None
+            if port is not None:
+                try:
+                    conn = socket.create_connection((_HOST, port),
+                                                    timeout=5.0)
+                except OSError:
+                    conn = None
+            if conn is not None:
+                conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+                # generous ack window (an abandoned attempt just
+                # retries): on an oversubscribed box the acceptor's
+                # handshake thread can be scheduler-starved for whole
+                # seconds, and hair-trigger ack timeouts turn that into
+                # connect churn
+                conn.settimeout(10.0)
+                try:
+                    conn.sendall(_HELLO.pack(self.world_rank, self.epoch))
+                    ack = _recv_exact(conn, _HELLO_ACK.size)
+                except OSError:
+                    ack = None
+                if ack is not None:
+                    (peer_epoch,) = _HELLO_ACK.unpack(ack)
+                    if peer_epoch > self.epoch:
+                        conn.close()
+                        # grace before the skew verdict: our own epoch
+                        # bump may be milliseconds behind a broadcast
+                        # transition (self.epoch is re-read each retry)
+                        if skew_since is None:
+                            skew_since = time.monotonic()
+                        if time.monotonic() - skew_since \
+                                > _EPOCH_GRACE_S:
+                            _mpit.count(epoch_skews=1)
+                            raise EpochSkewError(
+                                f"rank {self.world_rank}: peer {dest} is "
+                                f"at membership epoch {peer_epoch}, this "
+                                f"process at {self.epoch} — this process "
+                                f"was shrunk out of the world "
+                                f"(stale-epoch straggler)",
+                                local_epoch=self.epoch,
+                                peer_epoch=peer_epoch, peer=dest)
+                        time.sleep(0.01)
+                        continue
+                    skew_since = None
+                    if peer_epoch >= self.min_peer_epoch.get(dest, 0):
+                        conn.settimeout(None)
+                        with self._conn_lock:
+                            self._conns[dest] = conn
+                        return conn
+                conn.close()  # stale incarnation (or torn handshake)
+            if time.monotonic() > deadline:
+                raise TransportError(
+                    f"rank {self.world_rank}: cannot connect to rank "
+                    f"{dest} at epoch >= "
+                    f"{self.min_peer_epoch.get(dest, 0)} within "
+                    f"{self._connect_timeout}s")
+            time.sleep(0.01)
 
     def send(self, dest: int, ctx, tag: int, payload: Any) -> None:
         if not (0 <= dest < self.world_size):
@@ -278,6 +379,27 @@ class SocketTransport(Transport):
                 raise TransportError(
                     f"rank {self.world_rank}: send to rank {dest} failed: {e}"
                 ) from e
+
+    # -- membership (mpi_tpu/membership.py) --------------------------------
+
+    def membership_invalidate(self, dead) -> None:
+        """Drop cached connections to replaced slots so the next send
+        re-handshakes (port-file re-read + epoch-checked hello).  Takes
+        each per-dest send lock: a send streaming a frame on the old
+        connection must finish (or fail) before its socket vanishes."""
+        for dest in dead:
+            with self._send_lock(dest):
+                with self._conn_lock:
+                    conn = self._conns.pop(dest, None)
+                if conn is not None:
+                    try:
+                        conn.shutdown(socket.SHUT_RDWR)
+                    except OSError:
+                        pass
+                    try:
+                        conn.close()
+                    except OSError:
+                        pass
 
     # -- shutdown ----------------------------------------------------------
 

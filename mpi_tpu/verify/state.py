@@ -138,15 +138,29 @@ class FileBoard:
     stamps could CONFIRM a false deadlock (the one direction this board
     must never err).  A genuinely stalled rank republishes every check
     slice, so 'recently touched' ≈ 'the blocked ranks': the compaction
-    still saves the parses for the quiet majority.  The summary is
-    republished after any fallback read (atomic rename,
-    last-writer-wins — every writer writes exactly what it just
-    verified fresh); each ``publish`` stamps a per-rank monotonic
-    ``_seq`` into the entry as forensic ordering evidence.  A stale or
-    corrupt summary only costs fallback reads — correctness never
-    depends on it."""
+    still saves the parses for the quiet majority.  Each ``publish``
+    stamps a per-rank monotonic ``_seq`` into the entry as forensic
+    ordering evidence.  A stale or corrupt summary only costs fallback
+    reads — correctness never depends on it.
+
+    Compaction is SERIALIZED behind ``pending.summary.lock`` (atomic
+    ``O_EXCL`` create; stale locks from a reader that died mid-
+    compaction are taken over past ``_LOCK_STALE_S``): the summary used
+    to be last-writer-wins, so N concurrently-stalled readers would
+    each redo the same fallback reads and overwrite each other's
+    compactions.  Now exactly one reader compacts at a time; a reader
+    that loses the lock race RELOADS the holder's freshly-written
+    summary instead of re-parsing unchanged files, performs only the
+    fallback reads correctness still requires (its dirtiness is
+    remembered and flushed under the lock on a later slice), and never
+    writes.  Lock unavailability can only ever cost duplicate reads —
+    never a wrong entry."""
 
     SUMMARY = "pending.summary.json"
+    LOCK = "pending.summary.lock"
+    # a compaction lock untouched this long belongs to a dead reader
+    # (a live one holds it for one json dump); take it over
+    _LOCK_STALE_S = 5.0
     # Cache-trust horizon: a file whose mtime is younger than this is
     # always re-read (coarse-mtime aliasing guard, see class docstring).
     # Must STRICTLY exceed the worst plausible mtime granularity (1-2s
@@ -165,7 +179,10 @@ class FileBoard:
         # "entry": {...}}; loaded lazily from SUMMARY, refreshed on use
         self._cache: Dict[str, dict] = {}
         self._cache_loaded = False
-        self.fallback_reads = 0  # test/tool introspection
+        self._dirty = False  # cache moved past the on-disk summary
+        self.fallback_reads = 0   # test/tool introspection
+        self.summary_writes = 0   # compactions this reader performed
+        self.lock_takeovers = 0   # stale locks reclaimed
 
     def _path(self, rank: int) -> str:
         return os.path.join(self._rdv, f"pending.{rank}")
@@ -189,20 +206,83 @@ class FileBoard:
         except OSError:
             pass  # rendezvous dir tearing down — world is exiting
 
-    def _load_summary(self) -> None:
-        if self._cache_loaded:
+    def _load_summary(self, force: bool = False) -> None:
+        if self._cache_loaded and not force:
             return
         self._cache_loaded = True
         try:
             with open(os.path.join(self._rdv, self.SUMMARY)) as f:
                 data = json.load(f)
             if isinstance(data, dict):
-                self._cache = {
+                loaded = {
                     r: rec for r, rec in data.items()
                     if isinstance(rec, dict) and "id" in rec
                     and "entry" in rec}
+                if force:
+                    # adopting a CONCURRENT compactor's summary: merge —
+                    # keep whichever record is newer per rank (ours may
+                    # hold a fallback read the holder hasn't seen)
+                    for r, rec in loaded.items():
+                        mine = self._cache.get(r)
+                        if mine is None or mine["id"][:2] < rec["id"][:2]:
+                            self._cache[r] = rec
+                else:
+                    self._cache = loaded
         except (OSError, ValueError):
-            self._cache = {}  # absent/corrupt summary = just fall back
+            if not force:
+                self._cache = {}  # absent/corrupt summary = just fall back
+
+    # -- compaction lock ---------------------------------------------------
+
+    def _lock_path(self) -> str:
+        return os.path.join(self._rdv, self.LOCK)
+
+    def _try_lock(self) -> bool:
+        """One non-blocking attempt on the compaction lock, with
+        stale-lock takeover: unlink a lock whose mtime is past
+        _LOCK_STALE_S and retry the O_EXCL create ONCE — two racing
+        takeovers both unlink (idempotent) and the create arbitrates."""
+        path = self._lock_path()
+        for attempt in (0, 1):
+            try:
+                fd = os.open(path, os.O_CREAT | os.O_EXCL | os.O_WRONLY,
+                             0o600)
+            except FileExistsError:
+                if attempt:
+                    return False
+                import time
+
+                try:
+                    if time.time() - os.stat(path).st_mtime \
+                            < self._LOCK_STALE_S:
+                        return False
+                    os.unlink(path)
+                    self.lock_takeovers += 1
+                except OSError:
+                    return False  # vanished/unreadable: holder is live
+                continue
+            except OSError:
+                return False  # rendezvous dir tearing down
+            with os.fdopen(fd, "w") as f:
+                f.write(f"{os.getpid()}.{self._rank}")
+            return True
+        return False  # pragma: no cover - loop always returns
+
+    def _unlock(self) -> None:
+        path = self._lock_path()
+        try:
+            # ownership check: if WE were descheduled past the stale
+            # bound mid-compaction, another reader legitimately took
+            # the lock over — unlinking ITS lock would re-enable the
+            # concurrent-writer races this lock exists to prevent.
+            # (A check-then-unlink window remains; it requires TWO
+            # takeovers inside one scheduling gap — accepted.)
+            with open(path) as f:
+                if f.read() != f"{os.getpid()}.{self._rank}":
+                    return
+            os.unlink(path)
+        except OSError:
+            pass
 
     def _read_entry(self, path: str) -> Optional[dict]:
         self.fallback_reads += 1
@@ -212,46 +292,80 @@ class FileBoard:
         except (OSError, ValueError):
             return None  # mid-replace / torn dir: treat as no entry
 
+    def _cache_hit(self, r: int, st, now: float) -> Optional[dict]:
+        """The summary record for rank ``r`` iff it is trustworthy:
+        identity unchanged AND outside the mtime-aliasing horizon."""
+        rec = self._cache.get(str(r))
+        if (rec is not None
+                and rec["id"][:2] == [st.st_mtime_ns, st.st_size]
+                and now - st.st_mtime_ns / 1e9 >= self._MTIME_TRUST_S):
+            return dict(rec["entry"])
+        return None
+
     def read_all(self) -> Dict[int, dict]:
         import time
 
         self._load_summary()
         now = time.time()
         out: Dict[int, dict] = {}
-        dirty = False
+        stats: Dict[int, os.stat_result] = {}
+        need: List[int] = []
         for r in range(self._size):
-            path = self._path(r)
             try:
-                st = os.stat(path)
+                st = os.stat(self._path(r))
             except OSError:
                 if self._cache.pop(str(r), None) is not None:
-                    dirty = True
+                    self._dirty = True
                 continue
-            rec = self._cache.get(str(r))
-            if (rec is not None
-                    and rec["id"][:2] == [st.st_mtime_ns, st.st_size]
-                    and now - st.st_mtime_ns / 1e9 >= self._MTIME_TRUST_S):
-                entry = dict(rec["entry"])
+            stats[r] = st
+            entry = self._cache_hit(r, st, now)
+            if entry is not None:
+                out[r] = entry
             else:
-                entry = self._read_entry(path)
+                need.append(r)
+        locked = False
+        if need or self._dirty:
+            locked = self._try_lock()
+            if not locked and need:
+                # a concurrent reader is compacting: adopt whatever it
+                # already wrote instead of redoing its fallback reads —
+                # only ranks the fresh summary STILL cannot answer get
+                # parsed here
+                self._load_summary(force=True)
+                still: List[int] = []
+                for r in need:
+                    entry = self._cache_hit(r, stats[r], now)
+                    if entry is None:
+                        still.append(r)
+                    else:
+                        out[r] = entry
+                need = still
+        try:
+            for r in need:
+                entry = self._read_entry(self._path(r))
                 if entry is None:
                     continue
+                st = stats[r]
                 new_rec = {
                     "id": [st.st_mtime_ns, st.st_size,
                            entry.get("_seq", 0)],
                     "entry": entry}
                 # recency re-reads of an UNCHANGED file must not churn
                 # the summary — only a moved identity rewrites it
-                if rec is None or rec["id"] != new_rec["id"]:
-                    dirty = True
+                if self._cache.get(str(r), {}).get("id") != new_rec["id"]:
+                    self._dirty = True
                 self._cache[str(r)] = new_rec
-                entry = dict(entry)
+                out[r] = dict(entry)
+            if locked and self._dirty:
+                self._write_summary()
+                self._dirty = False
+        finally:
+            if locked:
+                self._unlock()
+        for r, entry in out.items():
             # wall-clock mtime: the one cross-process-comparable
             # stamp (monotonic clocks don't compare across ranks)
-            entry["_age_s"] = max(0.0, now - st.st_mtime_ns / 1e9)
-            out[r] = entry
-        if dirty:
-            self._write_summary()
+            entry["_age_s"] = max(0.0, now - stats[r].st_mtime_ns / 1e9)
         return out
 
     def _write_summary(self) -> None:
@@ -261,6 +375,7 @@ class FileBoard:
             with open(tmp, "w") as f:
                 json.dump(self._cache, f)
             os.replace(tmp, path)
+            self.summary_writes += 1
         except OSError:
             pass  # rendezvous dir tearing down — summary is best effort
 

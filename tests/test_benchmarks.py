@@ -124,6 +124,43 @@ def test_chaos_quick_smoke():
     assert result["injected"]["duplicated"] >= 1
 
 
+def test_serve_chaos_quick_smoke():
+    """The resident-pool chaos leg (ISSUE 7 satellite; the ``bench.py
+    --chaos --serve --quick`` CI spelling): continuous SIGKILL against
+    a live world server — every lease completes or raises a NAMED FT
+    error, worlds/sec never reaches zero (every observation window
+    completes >= 1 world), and the pool ends healed with the epoch
+    advanced past every kill."""
+    from benchmarks import chaos
+
+    result = chaos.run_serve_chaos(quick=True)
+    assert result["ok"], {k: result[k] for k in
+                          ("unnamed_failures", "windows_completed",
+                           "healed", "final_allreduce_ok", "kills")}
+    assert result["kills"] >= 1
+    assert result["completed_worlds"] >= 1
+    assert all(w > 0 for w in result["windows_completed"])
+    assert result["final_epoch"] >= 1
+    assert result["unnamed_failures"] == []
+
+
+def test_serve_bench_quick_smoke():
+    """The world-churn harness end to end in --quick mode (the
+    ``bench.py --serve-bench --quick`` CI spelling): cold launch() vs
+    resident-pool leases on the same job, asserting the acceptance
+    ratio — a warm world-acquire must beat a cold fork+handshake by
+    >= 10x at p99 (measured ~4000x on this box; 10x holds under any
+    plausible load)."""
+    from benchmarks import serve_bench
+
+    cold = serve_bench.cold_leg(2, "socket")
+    warm = serve_bench.serve_leg(10, "socket")
+    assert cold["worlds"] == 2 and warm["worlds"] == 10
+    assert warm["server_stats"]["jobs_ok"] == 10
+    assert warm["acquire"]["p99_ms"] * 10 < cold["acquire"]["p99_ms"], (
+        warm["acquire"], cold["acquire"])
+
+
 @pytest.mark.parametrize("bench", ["allreduce", "bcast", "alltoall"])
 def test_tpu_smoke(bench):
     algos = {"allreduce": ["ring", "fused"], "bcast": ["tree"],

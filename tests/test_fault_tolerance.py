@@ -522,6 +522,77 @@ def test_kill_mid_allreduce_detect_revoke_shrink(tmp_path, backend):
         assert "recovered in" in out, out
 
 
+_RING_FULL_PROG = """
+import os, sys, time
+sys.path.insert(0, {repo!r})
+import numpy as np
+import mpi_tpu
+from mpi_tpu import mpit
+from mpi_tpu.errors import ProcFailedError
+
+mpit.cvar_write("fault_detect_timeout_s", 2.0)
+mpit.cvar_write("fault_heartbeat_interval_s", 0.2)
+comm = mpi_tpu.init()
+if comm.rank == 1:
+    # die with endpoints up but NOTHING ever draining: no recv, and the
+    # helper thread dies with the process — the sender's ring stays full
+    os._exit(9)
+payload = np.ones(1 << 20, np.float32)  # 4MB frames = one whole ring
+_detect = float(mpit.cvar_read("fault_detect_timeout_s"))
+BOUND = 3.0 * _detect + (25.0 if (os.cpu_count() or 1) < 4 else 8.0)
+t0 = time.monotonic()
+try:
+    # the corpse's helper may drain a frame or two in its last instants;
+    # 50 x 4MB into a 4MB ring wedges mid-write regardless
+    for i in range(50):
+        comm.send(payload, 1, tag=5)
+    sys.exit(7)  # impossibly enqueued 200MB into a ring nobody drains
+except ProcFailedError as e:
+    took = time.monotonic() - t0
+    assert 1 in e.failed, e.failed
+    assert took < BOUND, f"sender stuck {{took:.1f}}s (> {{BOUND:.0f}}s)"
+    assert "dead" in str(e), e
+print(f"sender unstuck in {{time.monotonic() - t0:.1f}}s", flush=True)
+sys.exit(0)
+"""
+
+
+def test_shm_sender_unstuck_from_dead_consumers_full_ring(tmp_path):
+    """FT residual (a), converted: a sender mid-write into a DEAD
+    consumer's full shm ring used to spin out the full 120s
+    shm_write_timeout_s stall constant (the detector could fire but
+    nothing consulted it between native write slices).  Now the
+    ring-full wait path checks the FT suspect set every slice, so the
+    send surfaces ProcFailedError within the detection bound."""
+    from mpi_tpu.native import ensure_built
+
+    try:
+        ensure_built()
+    except Exception as e:  # pragma: no cover - no toolchain
+        pytest.skip(f"native shm ring unavailable: {e}")
+    script = tmp_path / "ringfull.py"
+    script.write_text(_RING_FULL_PROG.format(repo=REPO))
+    rdv = tmp_path / "rdv"
+    rdv.mkdir()
+    procs = []
+    for r in range(2):
+        env = dict(os.environ)
+        env.update({"MPI_TPU_RANK": str(r), "MPI_TPU_SIZE": "2",
+                    "MPI_TPU_RDV": str(rdv), "MPI_TPU_BACKEND": "shm",
+                    "MPI_TPU_FT": "1", "JAX_PLATFORMS": "cpu"})
+        procs.append(subprocess.Popen(
+            [sys.executable, str(script)], env=env, cwd=REPO,
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True))
+    outs = {}
+    for r, p in enumerate(procs):
+        out, err = p.communicate(timeout=90.0)
+        outs[r] = (p.returncode, out, err)
+    assert outs[1][0] == 9
+    code, out, err = outs[0]
+    assert code == 0, f"sender: {err[-900:]}"
+    assert "sender unstuck" in out, out
+
+
 def test_launcher_exit_summary(tmp_path):
     """Any nonzero outcome prints the per-rank exit table (rank, code,
     signal) so failure-story logs are diagnosable without spelunking."""

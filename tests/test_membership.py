@@ -1,0 +1,462 @@
+"""Elastic membership (ISSUE 7 tentpole): epoch'd worlds + rejoin.
+
+Three layers, cheapest first:
+
+* the rendezvous PROTOCOL FILES (claim/admit/refuse/ready) as pure
+  tmp-dir unit tests — including the three rejoin edge cases the issue
+  names: false-suspicion refusal until ``failure_ack``, double-rejoin
+  of the same worker id, and a claimer killed mid-handshake;
+* TRANSPORT epoch stamping in-process: a stale-epoch straggler's
+  re-handshake is diagnosed as EpochSkewError on socket AND shm, and
+  ``survivor_transition`` drops replaced endpoints;
+* the END-TO-END story in real processes on both transports: rank dies
+  → survivors shrink (epoch bumps in lockstep) → ``accept_rejoin`` +
+  a fresh process's ``membership.rejoin()`` rebuild the full world
+  under the next epoch and complete a correct allreduce.
+"""
+
+import os
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+import mpi_tpu
+from mpi_tpu import api, membership, mpit
+from mpi_tpu.errors import EpochSkewError, RejoinRefusedError
+from mpi_tpu.transport.base import TransportError
+from mpi_tpu.transport.faulty import KilledRankError
+from mpi_tpu.transport.local import run_local
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+DETECT_S = 1.0
+
+
+@pytest.fixture(autouse=True)
+def _tight_detection():
+    """In-process worlds use a tight detection bound (the default 5s
+    would push every 6*DETECT_S assertion past its margin)."""
+    old = {k: mpit.cvar_read(k) for k in
+           ("fault_detect_timeout_s", "fault_heartbeat_interval_s")}
+    mpit.cvar_write("fault_detect_timeout_s", DETECT_S)
+    mpit.cvar_write("fault_heartbeat_interval_s", 0.05)
+    yield
+    for k, v in old.items():
+        mpit.cvar_write(k, v)
+
+
+# -- protocol files (pure unit) ----------------------------------------------
+
+
+def test_claim_is_exclusive(tmp_path):
+    rdv = str(tmp_path)
+    assert membership.claim_slot(rdv, 1, 2, inc="aaa")
+    # double-claim (same or different worker id) fails cleanly
+    assert not membership.claim_slot(rdv, 1, 2, inc="aaa")
+    assert not membership.claim_slot(rdv, 1, 2, inc="bbb")
+    # other slots / epochs are independent
+    assert membership.claim_slot(rdv, 1, 3, inc="bbb")
+    assert membership.claim_slot(rdv, 2, 2, inc="ccc")
+
+
+def test_announce_roundtrip_and_latest(tmp_path):
+    rdv = str(tmp_path)
+    membership.announce_rejoin(rdv, 1, {2: {"ousted": None,
+                                            "acked": False}}, 4, "socket")
+    membership.announce_rejoin(rdv, 3, {1: {"ousted": "xyz",
+                                            "acked": True}}, 4, "shm")
+    assert membership.read_announce(rdv, 1)["backend"] == "socket"
+    latest = membership.latest_announce(rdv)
+    assert latest["epoch"] == 3 and latest["backend"] == "shm"
+    assert latest["slots"]["1"]["ousted"] == "xyz"
+
+
+def test_false_suspicion_refused_until_acked(tmp_path):
+    """The rejoin edge case FT residual (b) was carried for: a
+    suspected-but-LIVE rank presenting its ousted incarnation must be
+    refused re-admission until the survivors failure_ack'd it —
+    re-admitting would resurrect the split.  After the ack, the same
+    incarnation is admitted."""
+    rdv = str(tmp_path)
+    slots = {1: {"ousted": "live-zombie", "acked": False}}
+    membership.announce_rejoin(rdv, 1, slots, 3, "socket")
+    assert membership.claim_slot(rdv, 1, 1, inc="live-zombie")
+    membership.process_claims(rdv, 1, slots)
+    with pytest.raises(RejoinRefusedError, match="failure_ack"):
+        membership.wait_admitted(rdv, 1, 1, "live-zombie",
+                                 time.monotonic() + 5.0)
+    # the refused claim was cleared: a FRESH incarnation can claim...
+    assert membership.claim_slot(rdv, 1, 1, inc="fresh-worker")
+    membership.process_claims(rdv, 1, slots)
+    membership.wait_admitted(rdv, 1, 1, "fresh-worker",
+                             time.monotonic() + 5.0)
+    # ...and once ACKED, even the ousted id itself re-enters (fresh
+    # announce: the survivors acknowledged the failure first)
+    slots2 = {2: {"ousted": "live-zombie", "acked": True}}
+    membership.announce_rejoin(rdv, 2, slots2, 3, "socket")
+    assert membership.claim_slot(rdv, 2, 2, inc="live-zombie")
+    membership.process_claims(rdv, 2, slots2)
+    membership.wait_admitted(rdv, 2, 2, "live-zombie",
+                             time.monotonic() + 5.0)
+
+
+def test_kill_during_rejoin_handshake_reclaims(tmp_path):
+    """A claimer that died between claim and ready (dead pid, no
+    readiness) is swept by the validation pass so the slot can be
+    re-claimed under the SAME epoch — the pool recovers, no epoch
+    fork."""
+    rdv = str(tmp_path)
+    slots = {0: {"ousted": None, "acked": False}}
+    # a pid that cannot exist (pid_max is < 2**22 by default)
+    dead_pid = 2 ** 22 + 17
+    assert membership.claim_slot(rdv, 1, 0, inc="doomed", pid=dead_pid)
+    membership.process_claims(rdv, 1, slots)
+    # claim swept -> re-claimable; the replacement is admitted
+    assert membership.claim_slot(rdv, 1, 0, inc="second")
+    membership.process_claims(rdv, 1, slots)
+    membership.wait_admitted(rdv, 1, 0, "second", time.monotonic() + 5.0)
+    membership.publish_ready(rdv, 1, 0, inc="second")
+    membership.wait_ready(rdv, 1, slots, time.monotonic() + 5.0,
+                          validate=True)
+
+
+def test_claimer_dead_after_ready_is_swept(tmp_path):
+    """The nastier mid-handshake death window: the claimer published
+    READY and then died (before the pool/survivors could use it).  The
+    validation pass must sweep claim+admit+ready — a leftover ready
+    from a corpse would make every future O_EXCL claim fail and wedge
+    the slot's healing forever."""
+    rdv = str(tmp_path)
+    slots = {0: {"ousted": None, "acked": False}}
+    dead_pid = 2 ** 22 + 23
+    assert membership.claim_slot(rdv, 1, 0, inc="ghost", pid=dead_pid)
+    membership.publish_ready(rdv, 1, 0, inc="ghost")
+    membership.process_claims(rdv, 1, slots)
+    # the slot is claimable again under the SAME epoch, and the fresh
+    # claimer completes the whole handshake
+    assert membership.claim_slot(rdv, 1, 0, inc="replacement")
+    membership.process_claims(rdv, 1, slots)
+    membership.wait_admitted(rdv, 1, 0, "replacement",
+                             time.monotonic() + 5.0)
+    membership.publish_ready(rdv, 1, 0, inc="replacement")
+    membership.wait_ready(rdv, 1, slots, time.monotonic() + 5.0,
+                          validate=True)
+
+
+def test_double_rejoin_same_worker_id_refused(tmp_path, monkeypatch):
+    """A worker id that already completed a rejoin (its readiness file
+    names its incarnation) must not re-enter through the same stale
+    announce."""
+    rdv = str(tmp_path)
+    membership.announce_rejoin(rdv, 1, {0: {"ousted": None,
+                                            "acked": False}}, 2, "socket")
+    monkeypatch.setattr(membership, "_PROCESS_INCARNATION", "me-again")
+    membership.publish_ready(rdv, 1, 0, inc="me-again")
+    with pytest.raises(RejoinRefusedError, match="double rejoin"):
+        membership.rejoin_transport(rdv, slot=0, epoch=1, timeout=2.0)
+
+
+def test_incarnation_registry(tmp_path):
+    rdv = str(tmp_path)
+    inc = membership.publish_incarnation(rdv, 3)
+    assert membership.read_incarnation(rdv, 3) == inc
+    assert membership.read_incarnation(rdv, 4) is None
+    # per-process singleton: a second publish reuses the same identity
+    assert membership.publish_incarnation(rdv, 5) == inc
+
+
+# -- epoch bookkeeping (local world) -----------------------------------------
+
+
+def test_shrink_bumps_membership_epoch():
+    """Every survivor's shrink bumps the transport's membership epoch
+    in lockstep; the epoch is visible as comm.membership_epoch and via
+    the MPIX mirror."""
+    def fn(comm):
+        assert comm.membership_epoch == 0
+        assert api.MPIX_Comm_get_epoch(comm) == 0
+        if comm.rank == 1:
+            raise KilledRankError("dead on arrival")
+        t0 = time.monotonic()
+        while comm.get_failed() != [1]:
+            assert time.monotonic() - t0 < 6 * DETECT_S
+            time.sleep(0.02)
+        new = comm.shrink()
+        assert comm.membership_epoch == 1
+        assert new.membership_epoch == 1
+        return comm._t.epoch
+
+    res = run_local(fn, 3, fault_tolerance=True, timeout=60)
+    assert res[0] == res[2] == 1
+
+
+def test_failure_ack_records_world_level():
+    """failure_ack feeds the membership layer's re-admission gate
+    (WorldFT.acked_world carries WORLD ranks)."""
+    def fn(comm):
+        if comm.rank == 1:
+            raise KilledRankError("dead on arrival")
+        t0 = time.monotonic()
+        while comm.get_failed() != [1]:
+            assert time.monotonic() - t0 < 6 * DETECT_S
+            time.sleep(0.02)
+        assert comm._ft.world.acked_world == set()
+        comm.failure_ack()
+        assert comm._ft.world.acked_world == {1}
+        return "ok"
+
+    res = run_local(fn, 3, fault_tolerance=True, timeout=60)
+    assert res[0] == res[2] == "ok"
+
+
+def test_subcomm_shrink_does_not_bump_epoch():
+    """The membership epoch counts WORLD transitions: shrinking a
+    proper sub-communicator must NOT bump the shared transport epoch —
+    healthy members of other subgroups would otherwise read as stale
+    stragglers at their next handshake.  Shrinking a world-generation
+    comm (and chained shrinks of its results) does bump."""
+    def fn(comm):
+        # split is collective: every rank participates; rank 2 opts out
+        sub = comm.split(0 if comm.rank < 2 else None)
+        if comm.rank == 2:
+            return "bystander"  # not in the shrinking subgroup
+        if comm.rank == 1:
+            raise KilledRankError("dead on arrival")
+        t0 = time.monotonic()
+        # rank 2 returned already and stops heartbeating — it may
+        # legitimately join the failed set too; we only need rank 1
+        while 1 not in comm.get_failed():
+            assert time.monotonic() - t0 < 6 * DETECT_S
+            time.sleep(0.02)
+        shrunk_sub = sub.shrink()
+        assert shrunk_sub.size == 1
+        assert comm.membership_epoch == 0  # sub-comm shrink: no bump
+        new = comm.shrink()  # the WORLD's shrink is the transition
+        assert comm.membership_epoch == 1
+        # chained: the shrunken world comm is itself a generation comm
+        assert new._ctx in comm._t._gen_ctxs
+        return "ok"
+
+    res = run_local(fn, 3, fault_tolerance=True, timeout=60)
+    assert res[0] == "ok" and res[2] == "bystander"
+
+
+# -- transport epoch stamping (in-process) -----------------------------------
+
+
+def test_socket_stale_straggler_diagnosed(tmp_path):
+    """A stale-epoch straggler's NEW connection is rejected loudly on
+    both sides of the hello: the straggler raises EpochSkewError (the
+    diagnosed spelling of the false-suspicion split), the survivor
+    refuses the reader; the pvar counts."""
+    from mpi_tpu.transport.socket import SocketTransport
+
+    base = mpit.pvar_read("epoch_skews_detected")
+    rdv = str(tmp_path)
+    survivor = SocketTransport(0, 2, rdv, epoch=2)
+    survivor.min_peer_epoch[1] = 2
+    straggler = SocketTransport(1, 2, rdv, epoch=0)
+    try:
+        with pytest.raises(EpochSkewError) as ei:
+            straggler.send(0, 0, 5, b"stale hello")
+        assert ei.value.local_epoch == 0 and ei.value.peer_epoch == 2
+        assert mpit.pvar_read("epoch_skews_detected") > base
+    finally:
+        survivor.close()
+        straggler.close()
+
+
+def test_socket_survivor_transition_drops_endpoints(tmp_path):
+    from mpi_tpu.transport.socket import SocketTransport
+
+    rdv = str(tmp_path)
+    a = SocketTransport(0, 2, rdv)
+    b = SocketTransport(1, 2, rdv)
+    try:
+        a.send(1, 0, 7, b"warm the connection")
+        assert b.recv(0, 0, 7)[0] == b"warm the connection"
+        assert 1 in a._conns
+        membership.survivor_transition(a, 1, [1])
+        assert a.epoch == 1 and a.min_peer_epoch[1] == 1
+        assert 1 not in a._conns  # dropped: next send re-handshakes
+        # the replaced slot's OLD incarnation (epoch 0) can no longer
+        # be adopted: reconnect demands epoch >= 1 and times out
+        a._connect_timeout = 1.0
+        with pytest.raises(TransportError, match="epoch >= 1"):
+            a.send(1, 0, 8, b"nobody new there yet")
+    finally:
+        a.close()
+        b.close()
+
+
+def test_shm_stale_straggler_diagnosed(tmp_path):
+    from mpi_tpu.native import ensure_built
+
+    try:
+        ensure_built()
+    except Exception as e:  # pragma: no cover - no toolchain
+        pytest.skip(f"native shm ring unavailable: {e}")
+    from mpi_tpu.transport.shm import ShmTransport
+
+    rdv = str(tmp_path)
+    survivor = ShmTransport(0, 2, rdv, epoch=3)
+    straggler = ShmTransport(1, 2, rdv, epoch=1)
+    try:
+        with pytest.raises(EpochSkewError) as ei:
+            straggler.send(0, 0, 5, b"stale open")
+        assert ei.value.peer_epoch == 3 and ei.value.local_epoch == 1
+    finally:
+        survivor.close()
+        straggler.close()
+
+
+def test_shm_transition_recreates_inbound_rings(tmp_path):
+    """An shm epoch transition must RECREATE the survivor's inbound
+    rings from replaced slots (the corpse may have died mid-frame,
+    desyncing the byte stream) and clear their quarantine, and only
+    then re-stamp readiness — so a replacement that honors the epoch
+    gate always appends to a fresh ring and its frames arrive clean."""
+    from mpi_tpu.native import ensure_built
+
+    try:
+        ensure_built()
+    except Exception as e:  # pragma: no cover - no toolchain
+        pytest.skip(f"native shm ring unavailable: {e}")
+    from mpi_tpu.transport.shm import ShmTransport
+
+    rdv = str(tmp_path)
+    survivor = ShmTransport(0, 2, rdv)
+    first = ShmTransport(1, 2, rdv)
+    try:
+        first.send(0, 0, 7, b"from the first incarnation")
+        assert survivor.recv(1, 0, 7)[0] == b"from the first incarnation"
+        # leave UNDRAINED bytes in the inbound ring (as the corpse's
+        # half-written frame would), then quarantine the channel
+        survivor._dead_srcs.add(1)
+        first.send(0, 0, 7, b"leftover garbage from the corpse")
+        membership.survivor_transition(survivor, 1, [1])
+        # recreated: the fresh ring is EMPTY (the garbage is gone) and
+        # the quarantine is lifted
+        assert survivor._lib.shmring_avail(survivor._in_rings[1]) == 0
+        assert 1 not in survivor._dead_srcs
+        first.close()
+        # the replacement (epoch 1, gated on the survivor's re-stamp)
+        # talks over the FRESH ring
+        replacement = ShmTransport(1, 2, rdv, epoch=1)
+        replacement.min_peer_epoch[0] = 1
+        try:
+            replacement.send(0, 0, 8, b"fresh generation")
+            assert survivor.recv(1, 0, 8)[0] == b"fresh generation"
+        finally:
+            replacement.close()
+    finally:
+        survivor.close()
+
+
+# -- end-to-end: kill -> shrink -> accept_rejoin + rejoin --------------------
+
+_SURVIVOR_PROG = """
+import os, sys, time
+sys.path.insert(0, {repo!r})
+import numpy as np
+import mpi_tpu
+from mpi_tpu import mpit
+from mpi_tpu.errors import ProcFailedError, RevokedError
+
+mpit.cvar_write("fault_detect_timeout_s", 2.0)
+mpit.cvar_write("fault_heartbeat_interval_s", 0.2)
+comm = mpi_tpu.init()
+if comm.rank == 1:
+    time.sleep(0.5)
+    os._exit(42)
+t0 = time.monotonic()
+try:
+    if comm.rank == 0:
+        comm.allreduce(np.ones(1024, np.float32), algorithm="ring")
+        sys.exit(7)
+    else:
+        comm.recv(source=0, tag=9)
+        sys.exit(7)
+except ProcFailedError:
+    comm.revoke()
+except RevokedError:
+    pass
+new = comm.shrink()
+assert comm.membership_epoch == 1, comm.membership_epoch
+full = new.accept_rejoin(timeout=40.0)
+assert full.size == 3 and full.membership_epoch == 1
+assert full.rank == comm.rank  # slots keep their identity
+out = full.allreduce(np.full(8, float(full.rank + 1), np.float32))
+assert float(out[0]) == 6.0, out[0]
+assert mpit.pvar_read("rejoins_completed") == 1
+print(f"rank {{comm.rank}} grew back in {{time.monotonic()-t0:.1f}}s",
+      flush=True)
+"""
+
+_JOINER_PROG = """
+import os, sys, time
+sys.path.insert(0, {repo!r})
+import numpy as np
+import mpi_tpu
+from mpi_tpu import mpit
+
+mpit.cvar_write("fault_detect_timeout_s", 2.0)
+mpit.cvar_write("fault_heartbeat_interval_s", 0.2)
+comm = mpi_tpu.membership.rejoin(timeout=40.0)
+assert comm.size == 3 and comm.rank == 1, (comm.size, comm.rank)
+assert comm.membership_epoch == 1, comm.membership_epoch
+out = comm.allreduce(np.full(8, float(comm.rank + 1), np.float32))
+assert float(out[0]) == 6.0, out[0]
+assert mpit.pvar_read("rejoins_completed") == 1
+print("joiner filled the slot", flush=True)
+"""
+
+
+@pytest.mark.parametrize("backend", ["socket", "shm"])
+def test_rejoin_e2e(tmp_path, backend):
+    """The grow-back acceptance story: a 3-rank process world loses
+    rank 1; survivors detect/revoke/shrink (epoch 0 -> 1) and
+    accept_rejoin; a FRESH process rejoins through the rendezvous dir
+    into slot 1 under epoch 1; the rebuilt full world completes a
+    correct allreduce on every member.  Socket AND shm."""
+    if backend == "shm":
+        from mpi_tpu.native import ensure_built
+
+        try:
+            ensure_built()
+        except Exception as e:  # pragma: no cover - no toolchain
+            pytest.skip(f"native shm ring unavailable: {e}")
+    surv = tmp_path / "survivor.py"
+    surv.write_text(_SURVIVOR_PROG.format(repo=REPO))
+    join = tmp_path / "joiner.py"
+    join.write_text(_JOINER_PROG.format(repo=REPO))
+    rdv = tmp_path / "rdv"
+    rdv.mkdir()
+    base = {"MPI_TPU_RDV": str(rdv), "MPI_TPU_BACKEND": backend,
+            "JAX_PLATFORMS": "cpu"}
+    procs = []
+    for r in range(3):
+        env = dict(os.environ, **base, MPI_TPU_RANK=str(r),
+                   MPI_TPU_SIZE="3", MPI_TPU_FT="1")
+        procs.append(subprocess.Popen(
+            [sys.executable, str(surv)], env=env, cwd=REPO,
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True))
+    # the joiner needs no rank env: everything comes from the announce
+    joiner = subprocess.Popen(
+        [sys.executable, str(join)], env=dict(os.environ, **base),
+        cwd=REPO, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+        text=True)
+    outs = {}
+    for r, p in enumerate(procs):
+        out, err = p.communicate(timeout=120.0)
+        outs[r] = (p.returncode, out, err)
+    jout, jerr = joiner.communicate(timeout=120.0)
+    assert outs[1][0] == 42
+    for r in (0, 2):
+        code, out, err = outs[r]
+        assert code == 0, f"rank {r}: {err[-900:]}"
+        assert "grew back" in out, out
+    assert joiner.returncode == 0, jerr[-900:]
+    assert "joiner filled the slot" in jout

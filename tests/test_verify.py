@@ -619,6 +619,132 @@ def test_fileboard_summary_compaction(tmp_path):
     assert out3[3]["_seq"] > out[3]["_seq"]
 
 
+def test_fileboard_summary_lock_serializes_compaction(tmp_path):
+    """ISSUE 7 satellite (PR-6 FileBoard residual): the summary used to
+    be last-writer-wins, so concurrent readers redid each other's
+    fallback reads and overwrote each other's compactions.  Compaction
+    now runs behind ``pending.summary.lock``:
+
+    * the lock holder is the ONLY summary writer;
+    * a reader that loses the race RELOADS the holder's summary instead
+      of re-parsing unchanged files, still performs any reads
+      correctness requires, and flushes its own dirtiness later;
+    * a lock left by a dead reader is taken over past the staleness
+      bound.
+    """
+    import os as _os
+    import time as _time
+
+    from mpi_tpu.verify.state import FileBoard
+
+    size = 8
+    rdv = str(tmp_path)
+    pub = [FileBoard(rdv, r, size) for r in range(size)]
+    for r in range(size):
+        pub[r].publish(r, {"state": "blocked", "rank": r,
+                           "targets": [(r + 1) % size], "mode": "AND"})
+    _time.sleep(FileBoard._MTIME_TRUST_S + 0.1)
+
+    # reader A compacts (writes the summary, holding the lock briefly)
+    a = FileBoard(rdv, 0, size)
+    out_a = a.read_all()
+    assert set(out_a) == set(range(size))
+    assert a.summary_writes == 1
+    lock_path = _os.path.join(rdv, FileBoard.LOCK)
+    assert not _os.path.exists(lock_path)  # released after the write
+
+    # reader B arrives while "someone else" holds the lock: it must
+    # adopt A's summary (zero redone parses for unchanged files), still
+    # return every entry correctly, and NOT write the summary
+    fd = _os.open(lock_path, _os.O_CREAT | _os.O_EXCL | _os.O_WRONLY)
+    _os.close(fd)
+    summary_path = _os.path.join(rdv, FileBoard.SUMMARY)
+    mtime_before = _os.stat(summary_path).st_mtime_ns
+    b = FileBoard(rdv, 1, size)
+    b._cache_loaded = True  # simulate a reader whose cache is cold/stale
+    b._cache = {}
+    out_b = b.read_all()
+    assert {r: out_b[r]["rank"] for r in out_b} == \
+        {r: out_a[r]["rank"] for r in out_a}
+    assert b.fallback_reads == 0          # adopted A's compaction
+    assert b.summary_writes == 0
+    assert _os.stat(summary_path).st_mtime_ns == mtime_before
+
+    # a genuinely-changed rank is STILL read under a held lock
+    # (correctness never waits on the lock), and the dirtiness is
+    # remembered and flushed once the lock frees
+    pub[4].publish(4, {"state": "blocked", "rank": 4, "targets": [0],
+                       "mode": "AND"})
+    _time.sleep(FileBoard._MTIME_TRUST_S + 0.1)
+    out_b2 = b.read_all()
+    assert out_b2[4]["targets"] == [0]
+    assert b.fallback_reads == 1 and b.summary_writes == 0
+    _os.unlink(lock_path)
+    b.read_all()
+    assert b.summary_writes == 1  # deferred compaction flushed
+
+    # stale-lock takeover: a lock whose holder died mid-compaction is
+    # reclaimed once its mtime is past the staleness bound
+    fd = _os.open(lock_path, _os.O_CREAT | _os.O_EXCL | _os.O_WRONLY)
+    _os.close(fd)
+    old = _time.time() - FileBoard._LOCK_STALE_S - 1.0
+    _os.utime(lock_path, (old, old))
+    pub[6].publish(6, {"state": "blocked", "rank": 6, "targets": [2],
+                       "mode": "AND"})
+    _time.sleep(FileBoard._MTIME_TRUST_S + 0.1)
+    out_b3 = b.read_all()
+    assert out_b3[6]["targets"] == [2]
+    assert b.lock_takeovers == 1 and b.summary_writes == 2
+    assert not _os.path.exists(lock_path)
+
+
+def test_fileboard_two_concurrent_readers(tmp_path):
+    """Two readers hammering read_all concurrently while publishers
+    churn: every read returns a consistent snapshot (no torn entries,
+    correct ranks) and the lock never deadlocks or leaks."""
+    import os as _os
+    import threading as _th
+    import time as _time
+
+    from mpi_tpu.verify.state import FileBoard
+
+    size = 6
+    rdv = str(tmp_path)
+    pub = [FileBoard(rdv, r, size) for r in range(size)]
+    for r in range(size):
+        pub[r].publish(r, {"state": "blocked", "rank": r,
+                           "targets": [(r + 1) % size], "mode": "AND"})
+    stop = _time.monotonic() + 2.0
+    errors = []
+
+    def reader_loop(rank):
+        board = FileBoard(rdv, rank, size)
+        while _time.monotonic() < stop:
+            out = board.read_all()
+            for r, e in out.items():
+                if e["rank"] != r:
+                    errors.append(f"torn entry: {r} -> {e}")
+
+    def publisher_loop():
+        i = 0
+        while _time.monotonic() < stop:
+            i += 1
+            pub[i % size].publish(i % size, {
+                "state": "blocked", "rank": i % size,
+                "targets": [(i + 1) % size], "mode": "AND"})
+            _time.sleep(0.01)
+
+    threads = [_th.Thread(target=reader_loop, args=(0,)),
+               _th.Thread(target=reader_loop, args=(1,)),
+               _th.Thread(target=publisher_loop)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(30.0)
+    assert not errors, errors[:5]
+    assert not _os.path.exists(_os.path.join(rdv, FileBoard.LOCK))
+
+
 _E2E_DEADLOCK = """
 import os, sys
 sys.path.insert(0, {repo!r})
