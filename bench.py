@@ -581,6 +581,21 @@ if __name__ == "__main__":
                                        "serve_pre.json"),
              "--out-post", os.path.join(REPO, "benchmarks", "results",
                                         "serve_post.json")]))
+    if "--compress" in sys.argv[1:]:
+        # compressed-collectives leg (ISSUE 8): 64MB allreduce/
+        # reduce_scatter under ring vs bf16/int8/top-k wire formats on
+        # both host transports, byte-plane pvars recorded per call; the
+        # full run writes the committed compress_{pre,post}.json
+        # artifacts, --quick is the tier-1 smoke spelling.
+        from benchmarks import compress_bench
+
+        if "--quick" in sys.argv[1:]:
+            sys.exit(compress_bench.main(["--quick"]))
+        sys.exit(compress_bench.main(
+            ["--out-pre", os.path.join(REPO, "benchmarks", "results",
+                                       "compress_pre.json"),
+             "--out-post", os.path.join(REPO, "benchmarks", "results",
+                                        "compress_post.json")]))
     if "--verify-overhead" in sys.argv[1:]:
         # verifier cost leg (ISSUE 5): asserts the off-mode zero-cost
         # contract (pvar-identical hot path) and prices the on-mode.

@@ -1,0 +1,161 @@
+#!/usr/bin/env python
+"""Compressed-collectives bench (ISSUE 8): the 64MB gradient-traffic leg.
+
+Runs 2-rank worlds on BOTH host transports (socket, shm) and measures
+``allreduce`` and ``reduce_scatter`` at 64MB f32 under the classic ring
+versus the compressed wire formats (bf16, scaled-int8, top-k), recording
+per-call p50 AND the byte-plane pvars — so the artifact carries the
+acceptance evidence directly: ``bytes_raw_sent`` halves (exactly, same
+spans at 2 bytes/element) at bf16 with zero pickled array bytes, and
+``bytes_compressed_saved`` prices every format.
+
+Artifacts (oversubscribed-stamped like every bench JSON):
+
+* ``benchmarks/results/compress_pre.json``  — the uncompressed ring rows
+  (the contemporary baseline: byte-identical code path to a pre-ISSUE-8
+  checkout's ring);
+* ``benchmarks/results/compress_post.json`` — the compressed rows plus
+  the derived per-transport byte ratios.
+
+Usage::
+
+    python bench.py --compress            # full 64MB run, writes artifacts
+    python bench.py --compress --quick    # tier-1 smoke (256KB, stdout only)
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+from typing import Dict, List, Optional
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+NBYTES = 64 << 20
+QUICK_NBYTES = 256 << 10
+TRANSPORTS = ("socket", "shm")
+# (bench, algorithm) legs; ring rows are the 'pre' side of the artifact
+LEGS = (
+    ("allreduce", "ring"),
+    ("allreduce", "compressed:bf16"),
+    ("allreduce", "compressed:int8"),
+    ("allreduce", "compressed:topk"),
+    ("reduce_scatter", "ring"),
+    ("reduce_scatter", "compressed:bf16"),
+)
+
+RANK_PROG = """
+import json, os, statistics, sys, time
+sys.path.insert(0, {repo!r})
+import numpy as np
+import mpi_tpu
+from mpi_tpu import mpit
+
+comm = mpi_tpu.init()
+nbytes = int(os.environ["CB_NBYTES"])
+iters = int(os.environ["CB_ITERS"])
+n = nbytes // 4
+rng = np.random.RandomState(1234 + comm.rank)
+x = rng.randn(n).astype(np.float32)
+p = comm.size
+blocks = x.reshape(p, n // p)
+legs = json.loads(os.environ["CB_LEGS"])
+pv = ("bytes_raw_sent", "bytes_pickled_sent", "bytes_compressed_saved")
+rows = []
+for bench, algo in legs:
+    call = ((lambda: comm.allreduce(x, algorithm=algo))
+            if bench == "allreduce"
+            else (lambda: comm.reduce_scatter(blocks, algorithm=algo)))
+    call()  # warmup
+    base = {{k: mpit.pvar_read(k) for k in pv}}
+    ts = []
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        call()
+        ts.append(time.perf_counter() - t0)
+    d = {{k: mpit.pvar_read(k) - base[k] for k in pv}}
+    rows.append({{
+        "bench": bench, "algorithm": algo, "backend": os.environ["CB_BACKEND"],
+        "nbytes": nbytes, "nranks": p, "iters": iters,
+        "p50_us": statistics.median(ts) * 1e6,
+        # this rank's wire-plane bytes PER CALL (2-rank symmetric: the
+        # global volume is p x this)
+        "raw_bytes_per_call": d["bytes_raw_sent"] // iters,
+        "pickled_bytes_per_call": d["bytes_pickled_sent"] // iters,
+        "saved_bytes_per_call": d["bytes_compressed_saved"] // iters,
+    }})
+if comm.rank == 0:
+    with open(os.environ["CB_OUT"], "w") as f:
+        for row in rows:
+            f.write(json.dumps(row) + "\\n")
+mpi_tpu.finalize()
+"""
+
+
+def _transport_rows(backend: str, nbytes: int, iters: int) -> List[Dict]:
+    from mpi_tpu.launcher import launch
+
+    with tempfile.TemporaryDirectory() as td:
+        out = os.path.join(td, "rows.jsonl")
+        prog = os.path.join(td, "prog.py")
+        with open(prog, "w") as f:
+            f.write(RANK_PROG.format(repo=REPO))
+        rc = launch(2, [prog], timeout=1800.0, backend=backend,
+                    env_extra={"CB_OUT": out, "CB_BACKEND": backend,
+                               "CB_NBYTES": str(nbytes),
+                               "CB_ITERS": str(iters),
+                               "CB_LEGS": json.dumps(LEGS)})
+        if rc != 0:
+            raise RuntimeError(f"{backend} compress bench exited {rc}")
+        with open(out) as f:
+            return [json.loads(line) for line in f if line.strip()]
+
+
+def run(quick: bool = False) -> Dict:
+    nbytes = QUICK_NBYTES if quick else NBYTES
+    iters = 1 if quick else 3
+    rows: List[Dict] = []
+    for backend in TRANSPORTS:
+        rows += _transport_rows(backend, nbytes, iters)
+    ratios = {}
+    for backend in TRANSPORTS:
+        by_algo = {r["algorithm"]: r for r in rows
+                   if r["backend"] == backend and r["bench"] == "allreduce"}
+        base = by_algo["ring"]["raw_bytes_per_call"]
+        ratios[backend] = {
+            a: round(by_algo[a]["raw_bytes_per_call"] / base, 4)
+            for a in by_algo if a != "ring" and base}
+    return {"quick": quick, "nbytes": nbytes, "nranks": 2, "rows": rows,
+            "allreduce_raw_byte_ratio_vs_ring": ratios,
+            "oversubscribed": 3 > (os.cpu_count() or 1)}
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--quick", action="store_true")
+    ap.add_argument("--out-pre")
+    ap.add_argument("--out-post")
+    args = ap.parse_args(argv)
+    result = run(quick=args.quick)
+    pre_rows = [r for r in result["rows"] if r["algorithm"] == "ring"]
+    post_rows = [r for r in result["rows"] if r["algorithm"] != "ring"]
+    shared = {k: v for k, v in result.items() if k != "rows"}
+    pre = {**shared, "label": "pre", "rows": pre_rows}
+    pre.pop("allreduce_raw_byte_ratio_vs_ring", None)
+    post = {**shared, "label": "post", "rows": post_rows}
+    if args.quick or not (args.out_pre and args.out_post):
+        print(json.dumps({**post, "pre_rows": pre_rows}, indent=2))
+        return 0
+    for path, doc in ((args.out_pre, pre), (args.out_post, post)):
+        with open(path, "w") as f:
+            json.dump(doc, f, indent=2)
+        print(f"wrote {path}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -92,6 +92,8 @@ _OPEN_TIMEOUT = 60.0
 
 _KIND_NONE = 0      # "my payload cannot ride the arena" (or no payload)
 _KIND_DATA = 1
+_KIND_WIRE = 2      # wire-encoded payload (ISSUE 8: compressed slot
+#                     writes, fold-dtype folds — see allreduce_wire)
 
 # name -> {"refs": int, "creator": bool} — the _CFG_GENERATIONS-style
 # registry: locked, refcounted, pruned as handles close; lets tests
@@ -508,6 +510,107 @@ def allreduce(arena: Arena, comm, arr: np.ndarray, op) -> Any:
     arena.barrier(comm)  # slots free for the next collective
     _mpit.count(copies=1, coll_sm_hits=1)
     return out
+
+
+# -- compressed eager path (ISSUE 8) -----------------------------------------
+#
+# algorithm="compressed" on an shm world routes HERE first, exactly like
+# auto's arena tier, so compression and the arena stay one coherent
+# policy: each rank writes its payload ENCODED (the wire dtype — bf16
+# bits / scale+int8, laid segment-by-segment 8-byte-aligned after the
+# meta region) and every rank decodes all P slots and folds in the FOLD
+# dtype.  The meta word carries (wire name, payload desc, segment
+# descs), so mixing compressed/uncompressed (or bf16/int8) entries is
+# non-congruent and the whole group declines to the wire algorithms
+# together — the same negotiation the plain entries use.  Eager sizes
+# only: above ``coll_sm_eager_bytes`` (encoded) the segmented compressed
+# ring wins like the plain block path would, so the arena declines.
+
+_WIRE_ALIGN = 8
+
+
+def _wire_slot_layout(seg_descs) -> List[int]:
+    """Byte offsets (within the slot, after the meta region) where each
+    encoded segment lives — one rule for writer and readers."""
+    offs, off = [], _META_MAX
+    for dtype_str, shape in seg_descs:
+        off = (off + _WIRE_ALIGN - 1) & ~(_WIRE_ALIGN - 1)
+        offs.append(off)
+        n = 1
+        for s in shape:
+            n *= int(s)
+        off += n * np.dtype(dtype_str).itemsize
+    offs.append(off)  # total extent (capacity check)
+    return offs
+
+
+def _read_wire_segs(arena: Arena, rank: int, seg_descs) -> List[np.ndarray]:
+    """Rank ``rank``'s encoded segments as in-place views of its slot."""
+    slot = arena._slot(rank)
+    offs = _wire_slot_layout(seg_descs)
+    out = []
+    for (dtype_str, shape), off in zip(seg_descs, offs):
+        dt = np.dtype(dtype_str)
+        n = 1
+        for s in shape:
+            n *= int(s)
+        out.append(slot[off:off + n * dt.itemsize].view(dt))
+    return out
+
+
+@_sm_coll
+def allreduce_wire(arena: Arena, comm, arr: np.ndarray, op, wire) -> Any:
+    """Compressed eager allreduce: write own ENCODED payload → barrier →
+    decode every slot and fold in the fold dtype (rank order — bit-
+    identical on every rank) → barrier.  Returns the result in the
+    payload's dtype, or FALLBACK (group-coherent) when the encoded
+    payload cannot ride — the caller runs the compressed wire ring."""
+    from . import compress as _compress
+
+    fdt = _compress.fold_dtype(arr.dtype)
+    flat = np.ascontiguousarray(arr, dtype=fdt).reshape(-1)
+    est = wire.wire_nbytes(flat.size, fdt.itemsize) + _META_MAX \
+        + _WIRE_ALIGN * 4
+    desc = None
+    if not arr.dtype.hasobject and est <= min(arena.capacity, _EAGER_BYTES):
+        enc = wire.encode(flat)
+        seg_descs = [(s.dtype.str, s.shape) for s in enc.segs]
+        offs = _wire_slot_layout(seg_descs)
+        desc = (wire.name, arr.dtype.str, tuple(arr.shape), seg_descs)
+        meta = pickle.dumps((_KIND_WIRE, desc),
+                            protocol=pickle.HIGHEST_PROTOCOL)
+        if (len(meta) > _META_MAX - _META_LEN.size
+                or offs[-1] > arena.slot_bytes):
+            desc = None
+    if desc is None:
+        arena.write_meta(_KIND_NONE, None)
+    else:
+        slot = arena._slot(comm.rank)
+        slot[:_META_LEN.size] = np.frombuffer(
+            _META_LEN.pack(len(meta)), np.uint8)
+        slot[_META_LEN.size:_META_LEN.size + len(meta)] = np.frombuffer(
+            meta, np.uint8)
+        for s, off in zip(enc.segs, offs):
+            if s.nbytes:
+                slot[off:off + s.nbytes].view(s.dtype)[...] = s.reshape(-1)
+        _mpit.count(copies=1,
+                    coll_sm_bytes=sum(int(s.nbytes) for s in enc.segs))
+    arena.barrier(comm)
+    metas = _metas(arena)
+    kind0, desc0 = metas[0]
+    if not (kind0 == _KIND_WIRE and all(
+            kind == _KIND_WIRE and d == desc0 for kind, d in metas)):
+        return _decline(arena, comm)
+    seg_descs = desc0[3]
+    # private fold buffer (slot views die at the exit barrier)
+    out = np.array(wire.decode_segs(_read_wire_segs(arena, 0, seg_descs)),
+                   dtype=fdt)
+    for q in range(1, arena._p):
+        op.combine_into(out, _read_wire_segs(arena, q, seg_descs),
+                        wire.decode_segs)
+    arena.barrier(comm)  # slots free for the next collective
+    _mpit.count(copies=1, coll_sm_hits=1)
+    return out.astype(arr.dtype, copy=False).reshape(arr.shape)
 
 
 @_sm_coll

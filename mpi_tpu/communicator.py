@@ -32,6 +32,7 @@ from typing import Any, List, Optional, Sequence, Tuple
 import numpy as np
 
 from . import coll_sm as _coll_sm
+from . import compress as _compress
 from . import mpit as _mpit
 from . import ops as _ops
 from . import schedules
@@ -252,14 +253,15 @@ class _SegSender:
     caller re-raises it at its next fold/drain step (``check``)."""
 
     __slots__ = ("_comm", "_work", "_spans", "_dest", "_si", "_lock",
-                 "error")
+                 "_wire", "error")
 
     def __init__(self, comm: "P2PCommunicator", work: np.ndarray,
-                 spans, dest: int):
+                 spans, dest: int, wire=None):
         self._comm, self._work, self._spans = comm, work, spans
         self._dest = dest
         self._si = 0
         self._lock = threading.Lock()
+        self._wire = wire  # wire-dtype codec (compress.py), None = plain
         self.error: Optional[BaseException] = None
 
     def post(self, n: int) -> None:
@@ -268,9 +270,12 @@ class _SegSender:
                 lo, hi = self._spans[self._si]
                 self._si += 1
                 n -= 1
-                self._comm._send_internal(
-                    self._comm._coll_payload(self._work[lo:hi]),
-                    self._dest, _TAG_COLL)
+                view = self._work[lo:hi]
+                # encode-on-send: the wire codec emits fresh buffers, so
+                # the aliasing-transport snapshot is already paid
+                payload = (self._wire.encode(view) if self._wire is not None
+                           else self._comm._coll_payload(view))
+                self._comm._send_internal(payload, self._dest, _TAG_COLL)
 
     def advance(self) -> None:
         """One receive completed: extend the credit window by one span.
@@ -1773,17 +1778,51 @@ class P2PCommunicator(Communicator):
         arena first on shm transports, else halving below the measured
         _RING_CROSSOVER_BYTES on pow2 groups, rabenseifner at or above
         _RABENSEIFNER_CROSSOVER_BYTES, ring in between.  ``"fused"``
-        (the TPU tier) aliases to ``"auto"`` on process backends."""
+        (the TPU tier) aliases to ``"auto"`` on process backends.
+
+        ``"compressed"`` / ``"compressed:bf16"`` / ``"compressed:int8"``
+        / ``"compressed:topk"`` (mpi_tpu/compress.py) split the WIRE
+        dtype from the FOLD dtype: bytes cross as bf16 / scaled-int8 /
+        sparse (indices, values) top-k pairs while accumulation stays
+        f32 (f64 payloads f64); the plain spelling follows the
+        ``compress_wire_dtype`` cvar.  Ineligible payloads (non-float
+        dtype, unsupported op) decline group-coherently to ``"auto"``
+        (``compress_fallbacks`` pvar); the verifier signature carries
+        the RESOLVED wire dtype so mixed groups raise
+        CollectiveMismatchError instead of desynchronizing."""
         _mpit.count(collectives=1)
         self._coll_name = "allreduce"
         arr, scalar = _as_array(obj)
         algorithm = _resolve_algorithm(
             "allreduce", algorithm,
             ("auto", "ring", "recursive_halving", "rabenseifner",
-             "reduce_bcast") + _coll_sm.gate(self),
+             "reduce_bcast") + _compress.ALLREDUCE_NAMES
+            + _coll_sm.gate(self),
             {"fused": "auto"})  # no fused path on sockets; best schedule
+        wire = vcounts = None
+        if _compress.is_compressed(algorithm):
+            # resolve BEFORE the signature exchange: the ring must carry
+            # "compressed:bf16" (and top-k's resolved k), never the
+            # cvar-dependent "compressed" alias (ISSUE 8 satellite)
+            wire, algorithm, vcounts = _compress.resolve(
+                self, "allreduce", arr, op, algorithm)
         self._verify_coll("allreduce", op=op, payload=arr,
-                          algorithm=algorithm)
+                          algorithm=algorithm, counts=vcounts)
+        if wire is not None:
+            if self.size == 1:
+                return _unwrap(arr.copy(), scalar)
+            if wire is _compress.TOPK:
+                return _unwrap(_compress.topk_allreduce(self, arr, op),
+                               scalar)
+            # shm transports: the arena's compressed eager path first
+            # (encoded slot writes, fold-dtype folds) so compressed
+            # requests route exactly like auto's arena tier
+            got = _coll_sm.allreduce_wire(self, arr, op, wire)
+            if got is not _coll_sm.FALLBACK:
+                return _unwrap(np.asarray(got), scalar)
+            fold = arr.astype(_compress.fold_dtype(arr.dtype), copy=False)
+            out = self._allreduce_ring(fold, op, wire=wire)
+            return _unwrap(out.astype(arr.dtype, copy=False), scalar)
         if algorithm in ("auto", "sm") and self.size > 1:
             # shm transports: the collective arena first — flat slot
             # folds at eager sizes, in-place chunk folds above
@@ -1849,7 +1888,8 @@ class P2PCommunicator(Communicator):
 
     def _seg_exchange(self, work: np.ndarray, sbounds: Tuple[int, int],
                       rbounds: Tuple[int, int], dest: int, src: int,
-                      op: Optional[_ops.ReduceOp] = None) -> None:
+                      op: Optional[_ops.ReduceOp] = None,
+                      wire=None) -> None:
         """One pipelined exchange step: send ``work[sbounds]`` to ``dest``
         while receiving the same global element range ``rbounds`` from
         ``src``, folding (``op``) or copying (``op=None``) each segment
@@ -1861,10 +1901,18 @@ class P2PCommunicator(Communicator):
         pointer: enough in flight to keep the wire busy, little enough
         that a symmetric exchange can never fill the shm ring with
         nobody draining.  Both sides compute spans from the same global
-        tables, so message boundaries agree with zero metadata traffic."""
+        tables, so message boundaries agree with zero metadata traffic.
+
+        ``wire`` (mpi_tpu/compress.py WireFormat) is the wire-dtype !=
+        fold-dtype seam: each outgoing segment is ENCODED into a
+        wire-tagged raw frame at send time and DECODED at its fold/copy
+        site, so compression composes with the segment pipeline and the
+        progress engine's credit callbacks unchanged — spans stay in
+        fold-dtype elements (the encoded frames are self-describing)."""
         seg = self._seg_elems(work.itemsize)
         sspans = schedules.segment_spans(sbounds[0], sbounds[1], seg)
         rspans = schedules.segment_spans(rbounds[0], rbounds[1], seg)
+        decode = None if wire is None else wire.decode
         eng = self._progress
         if eng is not None and len(sspans) > _SEG_WINDOW:
             # progress-engine mode: the sends beyond the initial credit
@@ -1878,7 +1926,7 @@ class P2PCommunicator(Communicator):
             # between posting and attaching, silently losing that
             # receive's send credit — a stall both sides of a symmetric
             # exchange would share.
-            sender = _SegSender(self, work, sspans, dest)
+            sender = _SegSender(self, work, sspans, dest, wire)
             with eng.cv:
                 reqs = []
                 for _ in rspans:
@@ -1901,16 +1949,21 @@ class P2PCommunicator(Communicator):
                         raise
                     view = work[lo:hi]
                     if op is None:
-                        view[...] = got
+                        view[...] = got if decode is None else decode(got)
                     else:
-                        op.combine_into(view, got)
+                        op.combine_into(view, got, decode)
                 sender.drain()
                 return
+
+            def snd_payload(lo_: int, hi_: int):
+                view_ = work[lo_:hi_]
+                return (wire.encode(view_) if wire is not None
+                        else self._coll_payload(view_))
+
             si = 0
             while si < min(len(sspans), _SEG_WINDOW):
                 lo, hi = sspans[si]
-                self._send_internal(self._coll_payload(work[lo:hi]), dest,
-                                    _TAG_COLL)
+                self._send_internal(snd_payload(lo, hi), dest, _TAG_COLL)
                 si += 1
             for seg_i, ((lo, hi), req) in enumerate(zip(rspans, reqs)):
                 try:
@@ -1921,18 +1974,17 @@ class P2PCommunicator(Communicator):
                     raise
                 view = work[lo:hi]
                 if op is None:
-                    view[...] = got
+                    view[...] = got if decode is None else decode(got)
                 else:
-                    op.combine_into(view, got)
+                    op.combine_into(view, got, decode)
                 if si < len(sspans):
                     slo, shi = sspans[si]
-                    self._send_internal(self._coll_payload(work[slo:shi]),
-                                        dest, _TAG_COLL)
+                    self._send_internal(snd_payload(slo, shi), dest,
+                                        _TAG_COLL)
                     si += 1
             while si < len(sspans):  # recv range empty/shorter: drain tail
                 slo, shi = sspans[si]
-                self._send_internal(self._coll_payload(work[slo:shi]), dest,
-                                    _TAG_COLL)
+                self._send_internal(snd_payload(slo, shi), dest, _TAG_COLL)
                 si += 1
         except BaseException:
             # Un-post OUR pending irecvs: a failed exchange (recv timeout,
@@ -1945,10 +1997,16 @@ class P2PCommunicator(Communicator):
             _unpost(reqs)
             raise
 
-    def _allreduce_ring(self, arr: np.ndarray, op: _ops.ReduceOp) -> np.ndarray:
+    def _allreduce_ring(self, arr: np.ndarray, op: _ops.ReduceOp,
+                        wire=None) -> np.ndarray:
         # Reduce-scatter ring + allgather ring, 2(P-1) steps (SURVEY.md
         # §3.3), segmented and in place: one flat working copy of the
-        # input, every wire payload a contiguous view of it.
+        # input, every wire payload a contiguous view of it.  ``wire``
+        # (compress.py) encodes BOTH phases — partial sums and the final
+        # reduced chunks alike cross in the wire dtype, which is what
+        # halves the bytes; the fold stays in work's (fold) dtype, and
+        # quantization error therefore compounds ~linearly in P (bound
+        # measured in tests/test_compress.py).
         p, r = self.size, self._rank
         shape = arr.shape
         work = arr.flatten()  # flatten always copies — our mutable buffer
@@ -1958,12 +2016,14 @@ class P2PCommunicator(Communicator):
             si = schedules.ring_rs_send_chunk(r, step, p)
             ri = schedules.ring_rs_recv_chunk(r, step, p)
             self._seg_exchange(work, (offs[si], offs[si + 1]),
-                               (offs[ri], offs[ri + 1]), right, left, op)
+                               (offs[ri], offs[ri + 1]), right, left, op,
+                               wire=wire)
         for step in range(p - 1):
             si = schedules.ring_ag_send_chunk(r, step, p)
             ri = schedules.ring_ag_recv_chunk(r, step, p)
             self._seg_exchange(work, (offs[si], offs[si + 1]),
-                               (offs[ri], offs[ri + 1]), right, left)
+                               (offs[ri], offs[ri + 1]), right, left,
+                               wire=wire)
         return work.reshape(shape)
 
     def _allreduce_halving(self, arr: np.ndarray, op: _ops.ReduceOp) -> np.ndarray:
@@ -2332,17 +2392,32 @@ class P2PCommunicator(Communicator):
         buffer, folds are in-place (op.combine_into), and each of the
         P-1 exchange steps pipelines via schedules.segment_spans — the
         seed path's per-step block copy, combine allocation, and
-        blocking sendrecv serialization are all gone."""
+        blocking sendrecv serialization are all gone.
+
+        ``"compressed"`` / ``"compressed:bf16"`` / ``"compressed:int8"``
+        run the same block ring with the wire-dtype != fold-dtype seam
+        (mpi_tpu/compress.py): segments cross encoded, folds stay f32
+        (f64 payloads f64), the result block is cast back to the
+        payload dtype.  No ``"compressed:topk"`` here — sparsified
+        entries have no per-destination blockwise home."""
         _mpit.count(collectives=1)
         self._coll_name = "reduce_scatter"
         p, r = self.size, self._rank
         algorithm = _resolve_algorithm(
             "reduce_scatter", algorithm,
-            ("auto", "ring") + _coll_sm.gate(self),
+            ("auto", "ring") + _compress.REDUCE_SCATTER_NAMES
+            + _coll_sm.gate(self),
             {"fused": "ring"})
         if len(blocks) != p:
             raise ValueError(
                 f"reduce_scatter needs one block per rank ({p}), got {len(blocks)}")
+        wire = None
+        if _compress.is_compressed(algorithm):
+            # resolved wire dtype into the signature (never the cvar-
+            # dependent "compressed" alias) — see allreduce
+            wire, algorithm, _ = _compress.resolve(
+                self, "reduce_scatter", np.asarray(blocks[0]), op,
+                algorithm)
         # geometry class of block 0 (cheap: no stacking copy) + the block
         # count — mismatched reduce geometry across ranks is flagged
         # before the ring/arena can misfold or truncate
@@ -2370,28 +2445,47 @@ class P2PCommunicator(Communicator):
         # path below would throw away (same discipline as the segmented
         # bcast's eligibility gate).
         nbytes = self._blocks_nbytes(blocks)
-        use_seg = (nbytes >= _RS_SEGMENT_MIN_BYTES
+        use_seg = (wire is not None or nbytes >= _RS_SEGMENT_MIN_BYTES
                    or 0 < _SEGMENT_BYTES < nbytes)
         arr = self._blocks_as_array(blocks) if use_seg and p > 1 else None
+        if wire is not None and arr is None:
+            # heterogeneous/object blocks cannot ride the flat working
+            # buffer the encoded exchange needs; block geometry is
+            # congruent across ranks, so everyone declines together —
+            # the wire-path analogue of the arena meta round
+            _compress._decline()
+            wire = None
         if arr is not None:
             was_scalar = arr.ndim == 1
             shape = arr.shape[1:]
+            out_dtype = arr.dtype
+            fdt = (_compress.fold_dtype(arr.dtype) if wire is not None
+                   else arr.dtype)
             # list payloads: np.asarray already STACKED the blocks into a
             # fresh contiguous buffer nobody else holds — reshape is the
             # working buffer with zero extra copies; ndarray payloads
             # alias the caller's memory, so flatten's copy is mandatory
-            work = (arr.reshape(-1) if not isinstance(blocks, np.ndarray)
-                    else arr.flatten())
+            # (a fold-dtype astype is itself the fresh copy)
+            if fdt != arr.dtype:
+                work = arr.astype(fdt).reshape(-1)
+            elif not isinstance(blocks, np.ndarray):
+                work = arr.reshape(-1)
+            else:
+                work = arr.flatten()
             bn = work.size // p
             right, left = (r + 1) % p, (r - 1) % p
             for step in range(p - 1):
                 si = schedules.ring_rs_block_send_chunk(r, step, p)
                 ri = schedules.ring_rs_block_recv_chunk(r, step, p)
                 self._seg_exchange(work, (si * bn, (si + 1) * bn),
-                                   (ri * bn, (ri + 1) * bn), right, left, op)
+                                   (ri * bn, (ri + 1) * bn), right, left, op,
+                                   wire=wire)
             # own block copied out so the P·n working buffer is released
-            return _unwrap(work[r * bn:(r + 1) * bn].reshape(shape).copy(),
-                           was_scalar)
+            # (the fold-dtype cast back to the payload dtype IS a copy)
+            mine = work[r * bn:(r + 1) * bn].reshape(shape)
+            mine = (mine.astype(out_dtype) if mine.dtype != out_dtype
+                    else mine.copy())
+            return _unwrap(mine, was_scalar)
         # Generic path (per-destination block shapes/dtypes differ):
         # only the chunks this rank folds INTO need a private copy — the
         # ring's fold targets are every chunk except (r-1)%p, which is

@@ -1,0 +1,383 @@
+"""Compressed/quantized collectives for gradient traffic (ISSUE 8).
+
+The segmented engine (PRs 1-2) made every collective byte ride zero-copy
+raw frames, but large data-parallel payloads are WIRE-BOUND: every byte
+still crosses at fold precision.  The standard production answer
+(DGC / 1-bit-Adam / PowerSGD-class gradient compression) is to split the
+WIRE dtype from the FOLD dtype — transmit a lossy low-precision encoding,
+accumulate in full precision.  This module owns that split for the host
+backend:
+
+* ``algorithm="compressed"`` (and the explicit spellings
+  ``"compressed:bf16"`` / ``"compressed:int8"``) for ``allreduce`` and
+  ``reduce_scatter``: every pipeline segment is ENCODED at send time into
+  a wire-tagged raw frame (transport/codec.py ``Encoded``) and DECODED at
+  its fold site, while the working buffer folds in float32 (float64 for
+  f64 payloads).  bf16 halves f32 wire bytes; the fp8-style scaled-int
+  format quarters them (per-segment max-abs scale + int8 mantissas).
+* ``algorithm="compressed:topk"`` (allreduce, SUM only): each rank ships
+  only its ``compress_topk_ratio`` largest-magnitude gradient entries as
+  (indices, values) pairs riding the codec's multi-segment raw frames —
+  zero pickled array bytes, like every other hot path — accumulated
+  densely in f32 on every rank.  ERROR FEEDBACK (the DGC residual): the
+  unsent remainder is accumulated per (shape, dtype, op) slot on the
+  communicator and added to the NEXT same-geometry gradient, so repeated
+  steps converge on the dense sum instead of permanently dropping mass.
+  The residual slot is keyed by payload geometry, not tensor identity —
+  a program alternating two same-geometry tensors through topk shares
+  one slot (documented limitation; ``reset_residuals`` clears them).
+
+Group coherence: reductions REQUIRE congruent payloads (same dtype and
+shape on every rank — the MPI contract the ring folds already lean on),
+so the eligibility decision below is a pure function of congruent inputs
+plus process-wide cvars and every rank declines (or proceeds) together —
+the wire-path analogue of the arena's in-arena meta negotiation, with
+the decline counted in the ``compress_fallbacks`` pvar and the caller
+landing on the classic ``auto`` policy.  Divergence that the contract
+cannot rule out (per-rank cvar skew, one rank passing ``"compressed"``
+while another passes ``"ring"``) is caught BEFORE data moves by the
+runtime verifier: the collective signature carries the RESOLVED wire
+dtype (``"compressed:bf16"``, not the ``"compressed"`` alias), so mixed
+groups raise CollectiveMismatchError naming both signatures instead of
+desynchronizing the segment exchange.  Without the verifier, a decode of
+a mismatched frame raises a typed error rather than misfolding silently.
+
+Error bounds (measured in tests/test_compress.py): the ring re-encodes
+PARTIAL SUMS at every one of its hops, so quantization error compounds
+~linearly in P — bf16 keeps a relative bound of about ``(P+1) * 2^-8``,
+scaled-int about ``(P+1) * amax/127``.  When that is too coarse, don't
+compress (see README "when not to use").
+
+Observability: ``bytes_compressed_saved`` (logical fold-dtype bytes
+minus wire bytes, accumulated at encode time; negative for a top-k
+ratio that overshoots dense) and ``compress_fallbacks`` mpit pvars;
+the codec's ``bytes_raw_sent`` keeps counting the actual wire bytes, so
+the bf16 halving is assertable exactly like the zero-pickle contract.
+
+The TPU sibling of this seam is the attention backward ring
+(tpu/pallas_attention.py): K/V circulate in the input dtype while dK/dV
+accumulate and circulate in f32 — same wire-dtype != fold-dtype split,
+credit protocol unchanged (VERDICT r5 #5).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, List, Optional, Tuple
+
+import numpy as np
+
+from . import mpit as _mpit
+from .transport import codec as _codec
+
+try:  # jax's dtype extension package — round-to-nearest-even bf16 casts
+    import ml_dtypes as _ml_dtypes
+
+    _BF16_DTYPE: Optional[np.dtype] = np.dtype(_ml_dtypes.bfloat16)
+except ImportError:  # pragma: no cover - container ships ml_dtypes
+    _ml_dtypes = None
+    _BF16_DTYPE = None
+
+# Process-wide knobs (mpit cvars ``compress_wire_dtype`` /
+# ``compress_topk_ratio``).  Like every algorithm-steering cvar
+# (collective_segment_bytes, the crossovers) these must agree across the
+# group; the verifier's resolved-wire signature diagnoses skew.
+_WIRE_DTYPE = "bf16"
+_TOPK_RATIO = 0.01
+
+# The arena declined / the payload cannot ride compression — the caller
+# runs the classic policy (mirrors coll_sm.FALLBACK).
+FALLBACK = object()
+
+# resolve() marker for the sparsified path (it is not a WireFormat: the
+# exchange is an (indices, values) allgather, not a segment codec).
+TOPK = object()
+
+# Input dtypes the quantizers accept.  f16/bf16 inputs fold in f32 (the
+# seam's whole point); f64 payloads keep f64 folds.
+_FLOAT_DTYPES = {np.dtype(np.float16), np.dtype(np.float32),
+                 np.dtype(np.float64)}
+if _BF16_DTYPE is not None:
+    _FLOAT_DTYPES.add(_BF16_DTYPE)
+
+# Reduction ops the dense wire formats accept: both encodings are
+# MONOTONE (rint/clip and RNE preserve <=), so MAX/MIN stay meaningful —
+# the result is the true extremum quantized.  Everything else (logical/
+# bitwise ops on floats make no sense; PROD compounds relative error
+# multiplicatively per hop) declines to the classic path.
+_DENSE_OPS = frozenset({"sum", "max", "min"})
+
+
+def fold_dtype(dtype: Any) -> np.dtype:
+    """The accumulation dtype of a compressed collective: f64 payloads
+    keep f64 folds, every other float folds in f32."""
+    return (np.dtype(np.float64) if np.dtype(dtype) == np.float64
+            else np.dtype(np.float32))
+
+
+# -- bf16 bit conversions -----------------------------------------------------
+#
+# ml_dtypes (jax's dtype package) provides round-to-nearest-even casts;
+# the pure-numpy fallback implements the same RNE via the carry trick,
+# with NaNs quieted so a mantissa carry can never turn NaN into inf.
+# Parity of the two paths is asserted in tests/test_compress.py.
+
+
+def f32_to_bf16_bits(x32: np.ndarray) -> np.ndarray:
+    """f32 -> uint16 bf16 bit patterns, round-to-nearest-even."""
+    x32 = np.ascontiguousarray(x32, dtype=np.float32)
+    if _BF16_DTYPE is not None:
+        return x32.astype(_BF16_DTYPE).view(np.uint16)
+    b = x32.view(np.uint32)
+    nan = (b & np.uint32(0x7FFFFFFF)) > np.uint32(0x7F800000)
+    r = b + (np.uint32(0x7FFF) + ((b >> np.uint32(16)) & np.uint32(1)))
+    r = np.where(nan, b | np.uint32(0x00400000), r)
+    return (r >> np.uint32(16)).astype(np.uint16)
+
+
+def bf16_bits_to_f32(u16: np.ndarray) -> np.ndarray:
+    """uint16 bf16 bit patterns -> f32 (exact)."""
+    return (np.ascontiguousarray(u16, dtype=np.uint16)
+            .astype(np.uint32) << np.uint32(16)).view(np.float32)
+
+
+# -- wire formats -------------------------------------------------------------
+
+
+class WireFormat:
+    """One dense wire encoding: fold-dtype view -> raw segments and back.
+
+    ``encode`` returns a codec :class:`~mpi_tpu.transport.codec.Encoded`
+    (fresh buffers — safe on aliasing transports without a snapshot);
+    ``decode`` accepts the Encoded a peer's frame reconstructed (or this
+    format's raw segment list, the arena slot path) and returns a flat
+    fold-dtype array.  Both are pure numpy passes, no Python loops."""
+
+    name: str = "?"
+
+    def encode_segs(self, x: np.ndarray) -> List[np.ndarray]:
+        raise NotImplementedError
+
+    def decode_segs(self, segs: List[np.ndarray]) -> np.ndarray:
+        raise NotImplementedError
+
+    def wire_nbytes(self, n: int, itemsize: int) -> int:
+        """Encoded payload bytes for ``n`` fold-dtype elements (arena
+        slot sizing; must match what encode_segs produces)."""
+        raise NotImplementedError
+
+    def encode(self, view: np.ndarray) -> _codec.Encoded:
+        segs = self.encode_segs(view)
+        _mpit.count(bytes_compressed_saved=int(view.nbytes)
+                    - sum(int(s.nbytes) for s in segs))
+        return _codec.Encoded(self.name, segs)
+
+    def decode(self, payload: Any) -> np.ndarray:
+        """Fold-site decode; a payload that is not this format's frame
+        (a peer ran uncompressed, or a different wire dtype slipped past
+        a disabled verifier) raises a TYPED error instead of misfolding."""
+        if not (type(payload) is _codec.Encoded and payload.wire == self.name):
+            raise TypeError(
+                f"compressed collective expected a {self.name!r} wire "
+                f"frame, got {type(payload).__name__}"
+                f"{'' if type(payload) is not _codec.Encoded else ' ' + repr(payload.wire)}"
+                " — is every rank running the same algorithm and "
+                "compress_wire_dtype? (enable mpi_tpu.verify to diagnose "
+                "divergence before data moves)")
+        return self.decode_segs(payload.segs)
+
+
+class _Bf16(WireFormat):
+    """bf16 wire: 2 bytes/element, ~8 mantissa bits dropped.  Exact for
+    values already representable in bf16 — a bf16 INPUT round-trips its
+    first hop bit-identically (no double-convert loss)."""
+
+    name = "bf16"
+
+    def encode_segs(self, x: np.ndarray) -> List[np.ndarray]:
+        return [f32_to_bf16_bits(np.asarray(x, dtype=np.float32))]
+
+    def decode_segs(self, segs: List[np.ndarray]) -> np.ndarray:
+        return bf16_bits_to_f32(segs[0])
+
+    def wire_nbytes(self, n: int, itemsize: int) -> int:
+        return 2 * n
+
+
+class _Int8(WireFormat):
+    """fp8-style scaled-int wire: a per-SEGMENT f32 max-abs scale + int8
+    mantissas — 1 byte/element + 4 bytes/segment.  Per-segment scaling
+    is what makes the bound usable: each pipeline segment quantizes
+    against its OWN dynamic range, so one large outlier only coarsens
+    its segment.  The mapping is monotone (MAX/MIN stay meaningful).
+
+    Non-finite segments (an overflowed mixed-precision gradient — the
+    loss scaler NEEDS to see the inf/NaN) cannot ride a max-abs scale:
+    the scale itself would be non-finite, poisoning every finite value
+    in the segment (or silently zeroing NaNs).  Such a segment ships as
+    a RAW f32 passthrough instead — the frame is self-describing per
+    segment, so the receiver keys on the value segment's dtype and the
+    divergence signal propagates exactly, like the classic ring would.
+    Non-finiteness is rank-local (not congruent), so this must be an
+    in-band frame form, never an eligibility decline."""
+
+    name = "int8"
+
+    def encode_segs(self, x: np.ndarray) -> List[np.ndarray]:
+        x32 = np.ascontiguousarray(x, dtype=np.float32)
+        amax = float(np.max(np.abs(x32))) if x32.size else 0.0
+        if not np.isfinite(amax):
+            return [np.array([np.nan], np.float32), x32]
+        scale = amax / 127.0 if amax > 0.0 else 1.0
+        q = np.clip(np.rint(x32 / scale), -127, 127).astype(np.int8)
+        return [np.array([scale], np.float32), q]
+
+    def decode_segs(self, segs: List[np.ndarray]) -> np.ndarray:
+        scale, q = segs
+        if q.dtype != np.int8:  # non-finite passthrough segment
+            return q.astype(np.float32, copy=False)
+        return q.astype(np.float32) * np.float32(scale[0])
+
+    def wire_nbytes(self, n: int, itemsize: int) -> int:
+        return n + 4
+
+
+BF16 = _Bf16()
+INT8 = _Int8()
+FORMATS = {f.name: f for f in (BF16, INT8)}
+
+# The algorithm= spellings the communicator gate accepts.  reduce_scatter
+# takes the dense formats only — top-k sparsification has no blockwise
+# scatter semantics (absent entries have no per-destination home).
+ALLREDUCE_NAMES = ("compressed", "compressed:bf16", "compressed:int8",
+                   "compressed:topk")
+REDUCE_SCATTER_NAMES = ("compressed", "compressed:bf16", "compressed:int8")
+
+
+def is_compressed(algorithm: str) -> bool:
+    return algorithm == "compressed" or algorithm.startswith("compressed:")
+
+
+def _decline() -> None:
+    _mpit.count(compress_fallbacks=1)
+
+
+def _array_eligible(arr: np.ndarray) -> bool:
+    return (not arr.dtype.hasobject and np.dtype(arr.dtype) in _FLOAT_DTYPES)
+
+
+def topk_k(n: int) -> int:
+    """Selection count for an ``n``-element gradient: ceil(ratio * n),
+    at least 1, clamped to n (a ratio >= 1 degrades to dense — the
+    k >= n edge case is defined, not an error)."""
+    if n <= 0:
+        return 0
+    return min(n, max(1, int(math.ceil(_TOPK_RATIO * float(n)))))
+
+
+def resolve(comm, coll: str, payload: np.ndarray, op,
+            algorithm: str) -> Tuple[Any, str, Optional[Tuple]]:
+    """The ``"compressed"`` half of the algorithm gate: returns
+    ``(wire, resolved_algorithm, verify_counts)``.
+
+    ``wire`` is a :class:`WireFormat`, the :data:`TOPK` marker, or None —
+    a group-coherent decline (ineligible dtype/op; counted in
+    ``compress_fallbacks``) that lands the caller on the classic
+    ``"auto"`` policy, exactly like an arena decline.  The RESOLVED name
+    (``"compressed:bf16"``, never the ``"compressed"`` alias) is what
+    the verifier circulates, so wire-dtype skew across ranks raises
+    CollectiveMismatchError before any data moves; for top-k the
+    resolved k rides ``verify_counts`` so ratio skew is caught the same
+    way (a divergent k would misfold silently otherwise)."""
+    kind = algorithm.split(":", 1)[1] if ":" in algorithm else _WIRE_DTYPE
+    if kind == "topk":
+        if not _array_eligible(payload) or op.name != "sum":
+            _decline()
+            return None, "auto", None
+        return TOPK, "compressed:topk", (topk_k(int(payload.size)),)
+    fmt = FORMATS.get(kind)
+    if fmt is None:
+        raise ValueError(
+            f"compress_wire_dtype cvar holds unknown format {kind!r}; "
+            f"accepted: {sorted(FORMATS)}")
+    if not _array_eligible(payload) or op.name not in _DENSE_OPS:
+        _decline()
+        return None, "auto", None
+    return fmt, "compressed:" + fmt.name, None
+
+
+# -- top-k sparsified allreduce ----------------------------------------------
+
+
+def _idx_dtype(n: int) -> np.dtype:
+    return np.dtype(np.int32 if n <= np.iinfo(np.int32).max else np.int64)
+
+
+def reset_residuals(comm) -> None:
+    """Drop the communicator's error-feedback residual slots (e.g. at an
+    optimizer boundary, or between unrelated same-geometry tensors)."""
+    comm.__dict__.pop("_compress_residuals", None)
+
+
+def topk_allreduce(comm, arr: np.ndarray, op) -> np.ndarray:
+    """Sparsified SUM allreduce: local top-k selection (by magnitude,
+    after adding this slot's error-feedback residual), then a P-1 ring
+    allgather of every rank's (indices, values) pair — each hop one
+    wire-tagged multi-segment raw frame — scatter-added into a dense
+    fold-dtype accumulator on every rank.
+
+    Per-rank wire volume is (P-1) * k * (index + value bytes) versus the
+    ring's 2(P-1)/P * n * itemsize; the saving is counted (possibly
+    negative — an overshooting ratio is honest) into the
+    ``bytes_compressed_saved`` pvar.  Ties at the k-th magnitude are
+    broken arbitrarily (np.argpartition); ANY valid top-k set yields the
+    same bound, and the unselected remainder lands in the residual
+    either way."""
+    from .communicator import _TAG_COLL
+
+    shape = tuple(arr.shape)
+    fdt = fold_dtype(arr.dtype)
+    x = np.asarray(arr, dtype=fdt).reshape(-1).copy()
+    n = x.size
+    k = topk_k(n)
+    store = comm.__dict__.setdefault("_compress_residuals", {})
+    key = ("allreduce", str(arr.dtype), shape, op.name)
+    residual = store.get(key)
+    if residual is not None and residual.shape == x.shape:
+        x += residual
+    idt = _idx_dtype(n)
+    if k >= n:
+        idx = np.arange(n, dtype=idt)
+    elif k:
+        idx = np.argpartition(np.abs(x), n - k)[n - k:].astype(idt)
+    else:
+        idx = np.zeros(0, idt)
+    vals = x[idx].astype(np.float32)
+    residual = x  # our private copy — it BECOMES the residual
+    # what peers receive is the f32-cast values, so the residual keeps
+    # the cast's remainder too (exactly 0 for f32 folds)
+    residual[idx] = residual[idx] - vals.astype(fdt, copy=False)
+    store[key] = residual
+    out = np.zeros(n, fdt)
+    # indices are duplicate-free by construction (argpartition over
+    # distinct positions / arange), so fancy-index add is correct and
+    # ~10x cheaper than np.add.at's unbuffered loop on this hot path
+    out[idx] += vals
+    p, r = comm.size, comm.rank
+    if p > 1:
+        right, left = (r + 1) % p, (r - 1) % p
+        payload = _codec.Encoded("topk", [idx, vals])
+        dense = 2 * (p - 1) * n * fdt.itemsize // max(1, p)
+        _mpit.count(bytes_compressed_saved=dense
+                    - (p - 1) * int(payload.nbytes))
+        for _ in range(p - 1):
+            got = comm._sendrecv_internal(payload, right, left, _TAG_COLL)
+            if not (type(got) is _codec.Encoded and got.wire == "topk"):
+                raise TypeError(
+                    f"compressed:topk expected a 'topk' wire frame, got "
+                    f"{type(got).__name__} — is every rank running "
+                    f"compressed:topk with the same compress_topk_ratio?")
+            gi, gv = got.segs
+            out[gi] += gv.astype(fdt, copy=False)
+            payload = got  # forward the received pair around the ring
+    return out.astype(arr.dtype, copy=False).reshape(shape)

@@ -44,7 +44,8 @@ class _Counters:
                  "v_deadlocks", "v_mismatches", "v_leaked", "v_double_waits",
                  "v_buf_overlaps", "v_comms_unfreed",
                  "prog_wakeups", "prog_completions", "prog_idle_parks",
-                 "rejoins", "epoch_skews")
+                 "rejoins", "epoch_skews",
+                 "comp_saved", "comp_fallbacks")
 
     def __init__(self) -> None:
         self.sends = 0
@@ -75,6 +76,8 @@ class _Counters:
         self.prog_idle_parks = 0
         self.rejoins = 0
         self.epoch_skews = 0
+        self.comp_saved = 0
+        self.comp_fallbacks = 0
 
 
 counters = _Counters()  # incremented by communicator.py / codec.py (count())
@@ -93,7 +96,9 @@ def count(sends: int = 0, send_bytes: int = 0, recvs: int = 0,
           verify_comms_unfreed: int = 0,
           progress_wakeups: int = 0, progress_completions: int = 0,
           progress_idle_parks: int = 0,
-          rejoins: int = 0, epoch_skews: int = 0) -> None:
+          rejoins: int = 0, epoch_skews: int = 0,
+          bytes_compressed_saved: int = 0,
+          compress_fallbacks: int = 0) -> None:
     """Thread-safe increment (rank-threads of the local backend share
     this process's counters; unsynchronized += would lose updates)."""
     with _lock:
@@ -125,6 +130,8 @@ def count(sends: int = 0, send_bytes: int = 0, recvs: int = 0,
         counters.prog_idle_parks += progress_idle_parks
         counters.rejoins += rejoins
         counters.epoch_skews += epoch_skews
+        counters.comp_saved += bytes_compressed_saved
+        counters.comp_fallbacks += compress_fallbacks
 
 _PVARS: Dict[str, Callable[[], int]] = {
     "msgs_sent": lambda: counters.sends,
@@ -198,6 +205,15 @@ _PVARS: Dict[str, Callable[[], int]] = {
     # split surfacing as an error instead of a cross-wired hang)
     "rejoins_completed": lambda: counters.rejoins,
     "epoch_skews_detected": lambda: counters.epoch_skews,
+    # compressed collectives (mpi_tpu/compress.py): logical fold-dtype
+    # bytes minus actual wire bytes, accumulated at encode time (bf16
+    # halves, scaled-int quarters; a top-k ratio that overshoots dense
+    # counts NEGATIVE — honest accounting), and eligible
+    # algorithm="compressed" requests that declined to the classic path
+    # (non-float dtype, unsupported op).  bytes_raw_sent keeps counting
+    # the actual wire bytes, so the halving claim is assertable.
+    "bytes_compressed_saved": lambda: counters.comp_saved,
+    "compress_fallbacks": lambda: counters.comp_fallbacks,
 }
 
 
@@ -281,6 +297,7 @@ def _ensure_builtin_cvars() -> None:
     # never observe done=True with the registry still empty
     from . import coll_sm as _sm
     from . import communicator as _c
+    from . import compress as _compress
     from . import ft as _ft
     from . import io as _io
     from . import membership as _membership
@@ -453,6 +470,40 @@ def _ensure_builtin_cvars() -> None:
             "(latency-optimal); above it allreduce switches to the "
             "chunked in-place fold and reduce stays on the binomial "
             "tree")
+        def _set_wire_dtype(v):
+            if v not in _compress.FORMATS:
+                raise ValueError(
+                    f"compress_wire_dtype must be one of "
+                    f"{sorted(_compress.FORMATS)}, got {v!r}")
+            _compress._WIRE_DTYPE = v
+
+        def _set_topk_ratio(v):
+            if float(v) <= 0:
+                raise ValueError("compress_topk_ratio must be > 0")
+            _compress._TOPK_RATIO = float(v)
+
+        _CVARS["compress_wire_dtype"] = (
+            lambda: _compress._WIRE_DTYPE, _set_wire_dtype,
+            "wire encoding the plain algorithm='compressed' spelling "
+            "resolves to (mpi_tpu/compress.py): 'bf16' (2 bytes/elem, "
+            "RNE) or 'int8' (fp8-style per-segment max-abs scale + int8 "
+            "mantissas, 1 byte/elem).  Folds stay f32 (f64 payloads "
+            "f64).  Must agree across the group — the runtime "
+            "verifier's collective signature carries the RESOLVED wire "
+            "dtype, so skew raises CollectiveMismatchError before data "
+            "moves.  Explicit 'compressed:bf16'/'compressed:int8' "
+            "override per call")
+        _CVARS["compress_topk_ratio"] = (
+            lambda: _compress._TOPK_RATIO, _set_topk_ratio,
+            "fraction of gradient entries algorithm='compressed:topk' "
+            "transmits per rank (ceil(ratio*n), >= 1, clamped to n — "
+            "ratios >= 1 degrade to dense).  The unsent remainder "
+            "accumulates in the per-(shape,dtype,op) error-feedback "
+            "residual on the communicator "
+            "(mpi_tpu.compress.reset_residuals clears).  Must agree "
+            "across the group: the resolved k rides the verifier "
+            "signature's counts field")
+
         def _set_rejoin_timeout(v):
             if float(v) <= 0:
                 raise ValueError("rejoin_timeout_s must be > 0")

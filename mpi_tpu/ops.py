@@ -57,12 +57,21 @@ class ReduceOp:
     commutative: bool = True
     ufunc: Any = None  # numpy ufunc for in-place host accumulation
 
-    def combine_into(self, acc: np.ndarray, value: Any) -> np.ndarray:
+    def combine_into(self, acc: np.ndarray, value: Any,
+                     decode: Callable[[Any], Any] = None) -> np.ndarray:
         """Accumulate ``value`` into ndarray ``acc`` IN PLACE (host data
         plane only — numpy, never tracers): zero result allocations for
         builtin ops, one temporary for user ops.  Always preserves acc's
         dtype — MPI reduces in the datatype, so a user combine that
-        upcasts is cast back at every fold, not once at the end."""
+        upcasts is cast back at every fold, not once at the end.
+
+        ``decode`` is the wire-dtype != fold-dtype seam (ISSUE 8,
+        mpi_tpu/compress.py): when set, ``value`` arrived in a WIRE
+        encoding and is decoded to the fold dtype HERE — the one point
+        where the two dtypes meet — so every fold site (segmented
+        exchanges, arena slots) splits the dtypes identically."""
+        if decode is not None:
+            value = decode(value)
         if self.ufunc is not None:
             self.ufunc(acc, value, out=acc)
             return acc

@@ -58,11 +58,15 @@ budget (no tile fits) is diagnosed at trace time with the math shown.
 **Fused backward** (``_bwd_kernel`` — VERDICT r4 missing #3): under
 differentiation the forward also emits the per-row logsumexp
 ``L = m + log l`` (skipped entirely on inference/fallback paths); the
-backward is its own ring kernel in which [K, V, dK, dV] circulate
-(f32) for a FULL cycle of P sends — each device recomputes its block
-pair's probabilities from (Q, L), accumulates dQ locally, adds its
-dK/dV contribution into the circulating payload, and forwards; after P
-hops the accumulators land back home.  Fold-before-forward ordering
+backward is its own ring kernel in which [K, V] circulate in the INPUT
+dtype and [dK, dV] in f32 (the wire-dtype != fold-dtype split, ISSUE
+8 / VERDICT r5 #5: pristine K/V inputs lose nothing below f32 — bf16
+halves their wire bytes — while the dK/dV partial sums keep full
+precision; two RDMAs per hop on per-plane semaphore columns, protocol
+otherwise unchanged) for a FULL cycle of P sends — each device
+recomputes its block pair's probabilities from (Q, L), accumulates dQ
+locally, adds its dK/dV contribution into the circulating payload, and
+forwards; after P hops the accumulators land back home.  Fold-before-forward ordering
 (the payload is mutated before it moves on) with the same
 double-buffer + credit discipline — model-checked separately by
 ``ring_model.AttentionBwdSim``.  The backward fold is VMEM-planned
@@ -227,21 +231,61 @@ def _pair_grad_tile(qh, doh, lse1, delta1, kb, vb, scale, mask=None):
             jnp.dot(p.T, doh, preferred_element_type=jnp.float32))
 
 
-def _mk_snd(first_src, comm_hbm, send_sem, recv_sem, dev_kw, right):
+def _mk_snd(first_src, comm_hbm, send_sem, recv_sem, dev_kw, right,
+            col=None):
     """Send-descriptor factory shared by both ring kernels: send ``u``
     forwards from ``first_src`` (u == 0: the block that never landed in
     a slot) or comm slot u%2, into the right neighbor's slot (u+1)%2,
     on the (parity)-indexed send/recv semaphores.  One definition —
-    the slot/sem indexing IS the protocol the models check."""
+    the slot/sem indexing IS the protocol the models check.
+
+    ``col`` selects a PLANE column of (parity, plane)-shaped semaphores:
+    the split-dtype backward (wire-dtype K/V + f32 dK/dV, ISSUE 8 /
+    VERDICT r5 #5) circulates two buffers per hop, each on its own
+    semaphore column but the SAME slot parity — the protocol schedule is
+    untouched, only the payload is split."""
     def snd(u):
         dst_slot = (u + 1) % 2
         src = first_src if u == 0 else comm_hbm.at[u % 2]
+        if col is None:
+            ss, rs = send_sem.at[dst_slot], recv_sem.at[dst_slot]
+        else:
+            ss, rs = send_sem.at[dst_slot, col], recv_sem.at[dst_slot, col]
         return pltpu.make_async_remote_copy(
             src_ref=src, dst_ref=comm_hbm.at[dst_slot],
-            send_sem=send_sem.at[dst_slot], recv_sem=recv_sem.at[dst_slot],
+            send_sem=ss, recv_sem=rs,
             **dev_kw(right))
 
     return snd
+
+
+class _SndPair:
+    """Both planes of one split-dtype circulation hop as one descriptor:
+    every protocol call fans out to the K/V-plane and dK/dV-plane RDMAs
+    (same hop, same slot parity, per-plane semaphore columns), so the
+    backward's send/credit schedule reads — and model-checks — exactly
+    like the single-buffer version."""
+
+    __slots__ = ("kv", "dkv")
+
+    def __init__(self, kv, dkv):
+        self.kv, self.dkv = kv, dkv
+
+    def start(self):
+        self.kv.start()
+        self.dkv.start()
+
+    def wait(self):
+        self.kv.wait()
+        self.dkv.wait()
+
+    def wait_send(self):
+        self.kv.wait_send()
+        self.dkv.wait_send()
+
+    def wait_recv(self):
+        self.kv.wait_recv()
+        self.dkv.wait_recv()
 
 
 def attention_vmem_plan(sb: int, d: int, hq: int, hkv: int, dtype,
@@ -271,7 +315,7 @@ def attention_vmem_plan(sb: int, d: int, hq: int, hkv: int, dtype,
         resident = (hq * sb * d * esz          # Q
                     + hq * sb * d * esz        # dOut
                     + 2 * hq * sb * _LANES * 4  # lse, delta staging
-                    + 2 * hkv * sb * d * 4     # K/V staging (f32 payload)
+                    + 2 * hkv * sb * d * esz   # K/V staging (wire dtype)
                     + 2 * hkv * sb * d * 4     # dK/dV staging
                     + hq * sb * d * 4          # dQ accumulator
                     + 4 * sb * sb * 4          # s/p/dp/ds temporaries
@@ -282,7 +326,7 @@ def attention_vmem_plan(sb: int, d: int, hq: int, hkv: int, dtype,
             t = sub * mdiv
             tiled = (2 * t * d * esz           # q/do tiles
                      + 2 * t * _LANES * 4      # lse/delta tiles
-                     + 2 * t * d * 4           # k/v tiles (f32)
+                     + 2 * t * d * esz         # k/v tiles (wire dtype)
                      + 2 * t * d * 4           # dk/dv staging buffers
                      + t * d * 4               # dq tile
                      + 2 * t * d * 4           # dk/dv loop carries
@@ -570,16 +614,17 @@ def _kernel(params_smem, q_hbm, kv_hbm, *refs,
     neighbor_barrier()
 
 
-def _bwd_kernel(params_smem, q_hbm, kv32_hbm, do_hbm, lse_hbm, delta_hbm,
-                dq_hbm, dkv_hbm, own_hbm, comm_hbm, *refs,
+def _bwd_kernel(params_smem, q_hbm, kv_hbm, do_hbm, lse_hbm, delta_hbm,
+                dq_hbm, dkv_hbm, own_kv_hbm, own_dkv_hbm,
+                comm_kv_hbm, comm_dkv_hbm, *refs,
                 axis_name: str, size: int, sb: int, d: int, scale: float,
                 pipelined: bool, mesh_ids: bool, causal: bool,
                 hq: int, hkv: int,
                 tiles: Optional[Tuple[int, int]] = None):
-    """Fused ring-attention backward: [K, V, dK, dV] circulate (f32,
-    one RDMA per hop) for a FULL cycle of P sends; dQ accumulates
-    locally; dK/dV accumulate in the payload and land home at arrival
-    P.  Fold-BEFORE-forward (the payload is mutated, then moves on),
+    """Fused ring-attention backward: [K, V] and [dK, dV] circulate for
+    a FULL cycle of P sends; dQ accumulates locally; dK/dV accumulate
+    in the circulating payload and land home at arrival P.
+    Fold-BEFORE-forward (the payload is mutated, then moves on),
     double-buffered slots, credits gating sends u >= 2; the retire +
     credit of hop u-1 comes BEFORE hop u's credit wait — a signal must
     precede, in program order, any wait it transitively feeds, or the
@@ -589,11 +634,22 @@ def _bwd_kernel(params_smem, q_hbm, kv32_hbm, do_hbm, lse_hbm, delta_hbm,
     home arrival consumed without forwarding — exhaustive interleaving
     search + adversarial schedules, tests/test_pallas_protocol.py).
 
+    SPLIT-DTYPE circulation (ISSUE 8 / VERDICT r5 #5 — the TPU side of
+    the wire-dtype != fold-dtype seam): the K/V planes are PRISTINE
+    INPUTS, so they circulate in the input dtype (bf16 inputs: half the
+    wire bytes, bit-identical values — bf16→f32 is exact, so nothing is
+    lost versus the old f32 circulation); the dK/dV planes are PARTIAL
+    SUMS, so they circulate f32.  Each hop is two RDMAs on per-plane
+    semaphore columns sharing one slot parity (_SndPair): the
+    send/credit/barrier protocol — and therefore the model check — is
+    unchanged, only the payload is split.
+
     Per-pair algebra (flash backward, exact):  P_ = exp(S - L) (the
     saved logsumexp — no rescaling pass), dP = dO·Vᵀ,
     dS = P_∘(dP - D)·scale with D = rowsum(dO∘Out) precomputed,
-    dQ += dS·K, dK += dSᵀ·Q, dV = P_ᵀ·dO.  bf16 inputs circulate f32
-    (2× wire bytes; the MXU folds are f32 regardless).
+    dQ += dS·K, dK += dSᵀ·Q, dV = P_ᵀ·dO.  The MXU folds are f32
+    regardless of the circulation dtype (staged K/V tiles upcast at the
+    matmul).
 
     ``tiles=None`` → resident fold (everything staged whole in VMEM);
     ``tiles=(tq, tk)`` → flash-style tiling (round 5: the fused
@@ -623,8 +679,15 @@ def _bwd_kernel(params_smem, q_hbm, kv32_hbm, do_hbm, lse_hbm, delta_hbm,
     copy_par = _mk_copy_par(par_sems)
 
     # send u (0..P-1): the block folded at step u moves on; send 0
-    # reads the assembled own-block scratch, not a comm slot
-    snd = _mk_snd(own_hbm, comm_hbm, send_sem, recv_sem, dev_kw, right)
+    # reads the assembled own-block scratch, not a comm slot.  Two
+    # planes per hop (split dtypes), one protocol (_SndPair).
+    snd_kv = _mk_snd(own_kv_hbm, comm_kv_hbm, send_sem, recv_sem, dev_kw,
+                     right, col=0)
+    snd_dkv = _mk_snd(own_dkv_hbm, comm_dkv_hbm, send_sem, recv_sem,
+                      dev_kw, right, col=1)
+
+    def snd(u):
+        return _SndPair(snd_kv(u), snd_dkv(u))
 
     def pair_grads(kv_idx, masked):
         """dQ/dK/dV contributions of my Q rows against the K/V block in
@@ -642,8 +705,9 @@ def _bwd_kernel(params_smem, q_hbm, kv32_hbm, do_hbm, lse_hbm, delta_hbm,
                 q_vmem[rows, :].astype(jnp.float32),
                 do_vmem[rows, :].astype(jnp.float32),
                 lse_vmem[rows, :][:, :1], delta_vmem[rows, :][:, :1],
-                kv_vmem[pl.ds(kvh * sb, sb), :],
-                kv_vmem[pl.ds((hkv + kvh) * sb, sb), :], scale, mask)
+                kv_vmem[pl.ds(kvh * sb, sb), :].astype(jnp.float32),
+                kv_vmem[pl.ds((hkv + kvh) * sb, sb), :]
+                .astype(jnp.float32), scale, mask)
             dq_vmem[rows, :] = dq_vmem[rows, :] + dq_c
             krows = pl.ds(kvh * sb, sb)
             vrows = pl.ds((hkv + kvh) * sb, sb)
@@ -697,7 +761,8 @@ def _bwd_kernel(params_smem, q_hbm, kv32_hbm, do_hbm, lse_hbm, delta_hbm,
                         qt_vmem[:].astype(jnp.float32),
                         dot_vmem[:].astype(jnp.float32),
                         lset_vmem[:, :1], deltat_vmem[:, :1],
-                        kt_vmem[:], vt_vmem[:], scale, mask)
+                        kt_vmem[:].astype(jnp.float32),
+                        vt_vmem[:].astype(jnp.float32), scale, mask)
                     dqt_vmem[:] = dqt_vmem[:] + dq_c
                     copy_sync(dqt_vmem, dq_hbm.at[pl.ds(r0, tq)])
                     return dk + dk_c, dv + dv_c
@@ -730,18 +795,19 @@ def _bwd_kernel(params_smem, q_hbm, kv32_hbm, do_hbm, lse_hbm, delta_hbm,
         lax.fori_loop(0, (hq * sb) // tq, zq_body, 0)
 
     # fold 0 (own block) and assemble the circulating payload: K/V
-    # planes straight from the input (already f32), dK/dV planes = my
-    # own contribution (every other rank's accumulates en route)
-    copy_sync(kv32_hbm, own_hbm.at[pl.ds(0, kv_rows)])
+    # planes straight from the input (IN the input/wire dtype), dK/dV
+    # planes = my own f32 contribution (every other rank's accumulates
+    # en route)
+    copy_sync(kv_hbm, own_kv_hbm)
     if tiles is None:
-        copy_sync(kv32_hbm, kv_vmem)
+        copy_sync(kv_hbm, kv_vmem)
         dkv_vmem[:] = jnp.zeros((kv_rows, d), jnp.float32)
         pair_grads(my, masked=causal)  # a=0 is the diagonal block
-        copy_sync(dkv_vmem, own_hbm.at[pl.ds(kv_rows, kv_rows)])
+        copy_sync(dkv_vmem, own_dkv_hbm)
     else:
         pair_grads_tiled(
-            my, kv_at=lambda r0, n: kv32_hbm.at[pl.ds(r0, n)],
-            dkv_at=lambda r0, n: own_hbm.at[pl.ds(kv_rows + r0, n)],
+            my, kv_at=lambda r0, n: kv_hbm.at[pl.ds(r0, n)],
+            dkv_at=lambda r0, n: own_dkv_hbm.at[pl.ds(r0, n)],
             init_zero=True, masked=causal)
 
     neighbor_barrier()
@@ -760,20 +826,17 @@ def _bwd_kernel(params_smem, q_hbm, kv32_hbm, do_hbm, lse_hbm, delta_hbm,
             # contribution when the block moves on
             def consume(kv_idx, masked, slot=slot):
                 if tiles is None:
-                    copy_sync(comm_hbm.at[slot, pl.ds(0, kv_rows)],
-                              kv_vmem)
-                    copy_sync(comm_hbm.at[slot, pl.ds(kv_rows, kv_rows)],
-                              dkv_vmem)
+                    copy_sync(comm_kv_hbm.at[slot], kv_vmem)
+                    copy_sync(comm_dkv_hbm.at[slot], dkv_vmem)
                     pair_grads(kv_idx, masked)
-                    copy_sync(dkv_vmem,
-                              comm_hbm.at[slot, pl.ds(kv_rows, kv_rows)])
+                    copy_sync(dkv_vmem, comm_dkv_hbm.at[slot])
                 else:
                     pair_grads_tiled(
                         kv_idx,
-                        kv_at=lambda r0, n: comm_hbm.at[
+                        kv_at=lambda r0, n: comm_kv_hbm.at[
                             slot, pl.ds(r0, n)],
-                        dkv_at=lambda r0, n: comm_hbm.at[
-                            slot, pl.ds(kv_rows + r0, n)],
+                        dkv_at=lambda r0, n: comm_dkv_hbm.at[
+                            slot, pl.ds(r0, n)],
                         init_zero=False, masked=masked)
 
             if causal:
@@ -807,7 +870,7 @@ def _bwd_kernel(params_smem, q_hbm, kv32_hbm, do_hbm, lse_hbm, delta_hbm,
             # home arrival: my block returns with every rank's dK/dV
             if pipelined:
                 snd(a - 1).wait_send()
-            copy_sync(comm_hbm.at[slot, pl.ds(kv_rows, kv_rows)], dkv_hbm)
+            copy_sync(comm_dkv_hbm.at[slot], dkv_hbm)
 
     if tiles is None:
         copy_sync(dq_vmem, dq_hbm)  # tiled mode accumulated in place
@@ -1072,7 +1135,10 @@ def pallas_ring_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
         vf = v_.reshape(hkv * sb, d) if multihead else v_
         dof = ct.reshape(hq * sb, d) if multihead else ct
         outf = out.reshape(hq * sb, d) if multihead else out
-        kv32 = jnp.concatenate([kf, vf], axis=0).astype(jnp.float32)
+        # the K/V planes circulate in the INPUT dtype (split-dtype seam:
+        # pristine inputs lose nothing below f32, and bf16 halves their
+        # wire bytes); only the dK/dV partial sums ride f32
+        kv = jnp.concatenate([kf, vf], axis=0)
         delta = jnp.sum(dof.astype(jnp.float32) * outf.astype(jnp.float32),
                         axis=1, keepdims=True)
         delta = jnp.broadcast_to(delta, (hq * sb, _LANES))
@@ -1085,8 +1151,10 @@ def pallas_ring_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
             collective_id=17, has_side_effects=True)
         kv_rows = 2 * hkv * sb
         scratch = [
-            pl.ANY((kv_rows * 2, d), jnp.float32),       # own [K,V,dK,dV]
-            pl.ANY((2, kv_rows * 2, d), jnp.float32),    # landing slots
+            pl.ANY((kv_rows, d), q.dtype),               # own [K,V] (wire)
+            pl.ANY((kv_rows, d), jnp.float32),           # own [dK,dV]
+            pl.ANY((2, kv_rows, d), q.dtype),            # K/V landing slots
+            pl.ANY((2, kv_rows, d), jnp.float32),        # dK/dV landing slots
         ]
         if bwd_tiles is None:
             scratch += [
@@ -1094,7 +1162,7 @@ def pallas_ring_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
                 pltpu.VMEM((hq * sb, d), q.dtype),           # dOut
                 pltpu.VMEM((hq * sb, _LANES), jnp.float32),  # lse
                 pltpu.VMEM((hq * sb, _LANES), jnp.float32),  # delta
-                pltpu.VMEM((kv_rows, d), jnp.float32),       # K/V staging
+                pltpu.VMEM((kv_rows, d), q.dtype),           # K/V staging
                 pltpu.VMEM((kv_rows, d), jnp.float32),       # dK/dV staging
                 pltpu.VMEM((hq * sb, d), jnp.float32),       # dQ accum
             ]
@@ -1105,16 +1173,16 @@ def pallas_ring_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
                 pltpu.VMEM((tqb, d), q.dtype),               # dOut tile
                 pltpu.VMEM((tqb, _LANES), jnp.float32),      # lse tile
                 pltpu.VMEM((tqb, _LANES), jnp.float32),      # delta tile
-                pltpu.VMEM((tkb, d), jnp.float32),           # k tile
-                pltpu.VMEM((tkb, d), jnp.float32),           # v tile
+                pltpu.VMEM((tkb, d), q.dtype),               # k tile
+                pltpu.VMEM((tkb, d), q.dtype),               # v tile
                 pltpu.VMEM((tkb, d), jnp.float32),           # dk buffer
                 pltpu.VMEM((tkb, d), jnp.float32),           # dv buffer
                 pltpu.VMEM((tqb, d), jnp.float32),           # dq tile
             ]
         scratch += [
             pltpu.SemaphoreType.DMA(()),
-            pltpu.SemaphoreType.DMA((2,)),               # send (parity)
-            pltpu.SemaphoreType.DMA((2,)),               # recv (parity)
+            pltpu.SemaphoreType.DMA((2, 2)),             # send (parity, plane)
+            pltpu.SemaphoreType.DMA((2, 2)),             # recv (parity, plane)
             pltpu.SemaphoreType.REGULAR((2,)),           # slot credits
             pltpu.SemaphoreType.DMA((8,)),               # parallel tiles
         ]
@@ -1128,7 +1196,7 @@ def pallas_ring_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
             scratch_shapes=scratch,
             compiler_params=compiler_params,
             interpret=interpret,
-        )(params, qf, kv32, dof, lse, delta)
+        )(params, qf, kv, dof, lse, delta)
         dq = dq.astype(q_.dtype)
         dk = dkv[:hkv * sb].astype(k_.dtype)
         dv = dkv[hkv * sb:].astype(v_.dtype)

@@ -21,6 +21,17 @@ the top bit of the u64 length word (RAW_FLAG):
   every array byte through the pickler twice (ISSUE 1 tentpole #2).  The
   receiver reads each segment into its own pooled destination
   (``RECV_POOL``) and delivers the reassembled list.
+* wire-tagged raw frames (ISSUE 8, the wire-dtype ≠ fold-dtype seam) —
+  an :class:`Encoded` payload ships its segments exactly like the
+  multi-segment frame but the meta grows a WIRE-DTYPE HEADER field:
+  ``(ctx, tag, [(dtype.str, shape), ...], wire)`` (a 4-tuple whose third
+  element is a LIST, vs the single-array meta's 4-tuple whose third
+  element is a str — both frame kinds keep sharing RAW_FLAG).  The
+  receiver reconstructs an ``Encoded`` carrying the same wire tag, so
+  the payload stays in its wire encoding all the way to the FOLD site
+  (encode-on-send / decode-on-fold — mpi_tpu/compress.py names the
+  encodings); compression therefore composes with segment pipelining
+  and the progress engine's credit callbacks with zero extra copies.
 
 Eligibility for the raw path: any ``np.ndarray`` without Python-object
 fields (object dtypes and structured/void dtypes fall back to pickle,
@@ -102,6 +113,31 @@ def as_raw_segments(payload: Any) -> Optional[List[np.ndarray]]:
     return [_contiguous(item) for item in payload]
 
 
+class Encoded:
+    """A payload in a WIRE encoding distinct from its fold dtype
+    (ISSUE 8): ``segs`` are the contiguous raw-eligible arrays that ship
+    back to back in one wire-tagged raw frame, ``wire`` names the
+    encoding (a mpi_tpu/compress.py codec name) so the receiving fold
+    site knows how to decode.  Deliberately dumb — the codec layer moves
+    it; compress.py owns what the bytes mean."""
+
+    __slots__ = ("wire", "segs")
+
+    def __init__(self, wire: str, segs: List[np.ndarray]):
+        self.wire = wire
+        self.segs = segs
+
+    @property
+    def nbytes(self) -> int:
+        """Wire payload size (probe/Status sizing, transport.base
+        ``payload_nbytes`` duck-types on this attribute)."""
+        return sum(int(s.nbytes) for s in self.segs)
+
+    def __repr__(self) -> str:  # pragma: no cover - cosmetic
+        return (f"Encoded({self.wire!r}, "
+                f"{[(s.dtype.str, s.shape) for s in self.segs]})")
+
+
 def _is_plain_raw_list(payload: Any) -> bool:
     """Whether a list payload gets element-wise array treatment — the ONE
     predicate behind both the wire path (as_raw_segments) and the
@@ -132,6 +168,9 @@ def pack_raw_frame(ctx, tag: int,
     or None → the payload must ride pickle.  The ONE place both
     byte-stream transports decide a payload's frame kind, so their wire
     behavior cannot diverge."""
+    if type(payload) is Encoded:
+        segs = [_contiguous(s) for s in payload.segs]
+        return pack_raw_wire_meta(ctx, tag, segs, payload.wire), tuple(segs)
     arr = as_raw_array(payload)
     if arr is not None:
         return pack_raw_meta(ctx, tag, arr), (arr,)
@@ -156,6 +195,19 @@ def pack_raw_segs_meta(ctx, tag: int, segs: List[np.ndarray]) -> bytes:
     backward compatible."""
     meta = pickle.dumps((ctx, tag, [(a.dtype.str, a.shape) for a in segs]),
                         protocol=_PROTO)
+    _mpit.count(bytes_raw=sum(int(a.nbytes) for a in segs))
+    return META.pack(len(meta)) + meta
+
+
+def pack_raw_wire_meta(ctx, tag: int, segs: List[np.ndarray],
+                       wire: str) -> bytes:
+    """Wire-tagged meta (ISSUE 8): the multi-segment descriptor list plus
+    the wire-encoding name — a 4-tuple whose third element is a LIST,
+    disambiguated from the single-array 4-tuple (third element a str) by
+    type, so all three raw frame kinds keep sharing RAW_FLAG."""
+    meta = pickle.dumps(
+        (ctx, tag, [(a.dtype.str, a.shape) for a in segs], wire),
+        protocol=_PROTO)
     _mpit.count(bytes_raw=sum(int(a.nbytes) for a in segs))
     return META.pack(len(meta)) + meta
 
@@ -237,7 +289,7 @@ class _BufferPool:
 RECV_POOL = _BufferPool()
 
 
-RawPayload = Union[np.ndarray, List[np.ndarray]]
+RawPayload = Union[np.ndarray, List[np.ndarray], "Encoded"]
 
 
 def unpack_raw_meta(meta: bytes) -> Tuple[Any, int, RawPayload]:
@@ -245,19 +297,30 @@ def unpack_raw_meta(meta: bytes) -> Tuple[Any, int, RawPayload]:
     read the raw bytes into — pooled at bandwidth sizes, see _BufferPool).
     A multi-segment meta (3-tuple, see pack_raw_segs_meta) yields a LIST
     of destination arrays, each pooled independently, to be filled in
-    order from the frame body."""
+    order from the frame body; a wire-tagged meta (4-tuple with a list,
+    see pack_raw_wire_meta) yields an :class:`Encoded` wrapping its
+    destination segments, so the wire encoding survives to the fold
+    site."""
     tup = pickle.loads(meta)
-    if len(tup) == 4:
+    if len(tup) == 4 and isinstance(tup[2], str):
         ctx, tag, dtype_str, shape = tup
         return ctx, tag, RECV_POOL.empty(shape, np.dtype(dtype_str))
+    if len(tup) == 4:
+        ctx, tag, descs, wire = tup
+        return ctx, tag, Encoded(wire, [
+            RECV_POOL.empty(shape, np.dtype(dtype_str))
+            for dtype_str, shape in descs])
     ctx, tag, descs = tup
     return ctx, tag, [RECV_POOL.empty(shape, np.dtype(dtype_str))
                       for dtype_str, shape in descs]
 
 
 def raw_destinations(payload: RawPayload) -> List[np.ndarray]:
-    """The fill/drain order of a raw payload's buffers (single array or
-    multi-segment list) — the one place both transports iterate it."""
+    """The fill/drain order of a raw payload's buffers (single array,
+    multi-segment list, or wire-tagged Encoded) — the one place both
+    transports iterate it."""
+    if type(payload) is Encoded:
+        return payload.segs
     return payload if isinstance(payload, list) else [payload]
 
 
@@ -280,9 +343,13 @@ def parse_raw_body(body: bytes) -> Tuple[Any, int, RawPayload]:
         off += n * dtype.itemsize
         return arr
 
-    if len(tup) == 4:
+    if len(tup) == 4 and isinstance(tup[2], str):
         ctx, tag, dtype_str, shape = tup
         return ctx, tag, take(dtype_str, shape)
+    if len(tup) == 4:
+        ctx, tag, descs, wire = tup
+        return ctx, tag, Encoded(wire,
+                                 [take(ds, shape) for ds, shape in descs])
     ctx, tag, descs = tup
     return ctx, tag, [take(ds, shape) for ds, shape in descs]
 
@@ -300,6 +367,9 @@ def value_copy(payload: Any) -> Any:
     if isinstance(payload, np.ndarray):
         _mpit.count(copies=1)
         return payload.copy()
+    if type(payload) is Encoded:
+        _mpit.count(copies=len(payload.segs))
+        return Encoded(payload.wire, [s.copy() for s in payload.segs])
     if _is_plain_raw_list(payload):
         # the shared predicate, not a bare type check: an object-dtype
         # element's .copy() would be shallow, and a duplicate-object

@@ -302,22 +302,48 @@ class FileBoard:
             return dict(rec["entry"])
         return None
 
+    def _scan_pending(self) -> Dict[int, os.stat_result]:
+        """One ``os.scandir`` pass over the rendezvous dir → rank ->
+        stat of its ``pending.<rank>`` file.  The per-rank ``os.stat``
+        loop this replaces cost O(P) path lookups per check slice —
+        mostly ENOENT misses, because running ranks have NO pending
+        file; one directory read finds exactly the files that exist
+        (ISSUE 8 satellite / PR-5 FileBoard residual (d) tail).  The
+        summary/lock/tmp siblings fail the integer-suffix test and are
+        skipped; a file vanishing between scandir and DirEntry.stat
+        reads as 'no entry', same as before."""
+        found: Dict[int, os.stat_result] = {}
+        try:
+            with os.scandir(self._rdv) as it:
+                for de in it:
+                    suffix = de.name[8:] if de.name.startswith("pending.") \
+                        else ""
+                    if not suffix.isdigit():
+                        continue
+                    r = int(suffix)
+                    if 0 <= r < self._size:
+                        try:
+                            found[r] = de.stat()
+                        except OSError:
+                            pass  # vanished mid-scan: no entry
+        except OSError:
+            pass  # rendezvous dir tearing down: everything reads absent
+        return found
+
     def read_all(self) -> Dict[int, dict]:
         import time
 
         self._load_summary()
         now = time.time()
         out: Dict[int, dict] = {}
-        stats: Dict[int, os.stat_result] = {}
+        stats = self._scan_pending()
         need: List[int] = []
         for r in range(self._size):
-            try:
-                st = os.stat(self._path(r))
-            except OSError:
+            st = stats.get(r)
+            if st is None:
                 if self._cache.pop(str(r), None) is not None:
                     self._dirty = True
                 continue
-            stats[r] = st
             entry = self._cache_hit(r, st, now)
             if entry is not None:
                 out[r] = entry

@@ -211,3 +211,30 @@ def test_io_bench_smoke():
     for r in rows:
         assert r["write_gbps"] > 0 and r["read_gbps"] > 0
         assert r["nranks"] == 4
+
+
+def test_compress_quick_smoke():
+    """The compressed-collectives bench harness end to end in --quick
+    mode (the ``bench.py --compress --quick`` CI spelling): real
+    launcher-spawned rank processes on BOTH transports, every leg
+    present, and the acceptance ratios hold at smoke size — bf16 raw
+    bytes exactly half of ring's (same spans, 2 bytes/element; the
+    committed 64MB artifacts show the same exact ratio), int8 about a
+    quarter, zero pickled array bytes everywhere."""
+    from benchmarks import compress_bench
+
+    result = compress_bench.run(quick=True)
+    assert result["quick"] and result["nranks"] == 2
+    rows = result["rows"]
+    assert {r["backend"] for r in rows} == {"socket", "shm"}
+    assert {(r["bench"], r["algorithm"]) for r in rows} == set(
+        compress_bench.LEGS)
+    for r in rows:
+        assert r["p50_us"] > 0 and np.isfinite(r["p50_us"])
+        assert r["pickled_bytes_per_call"] == 0, r
+        if r["algorithm"] != "ring":
+            assert r["saved_bytes_per_call"] > 0, r
+    for backend, ratios in result["allreduce_raw_byte_ratio_vs_ring"].items():
+        assert abs(ratios["compressed:bf16"] - 0.5) <= 0.05 * 0.5, ratios
+        assert ratios["compressed:int8"] <= 0.27, ratios
+        assert ratios["compressed:topk"] < 0.5, ratios

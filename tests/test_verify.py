@@ -953,3 +953,149 @@ def test_check_sh_gate_runs_green():
         env={**os.environ, "JAX_PLATFORMS": "cpu"})
     assert proc.returncode == 0, proc.stdout + proc.stderr
     assert "check.sh: OK" in proc.stdout
+
+
+# -- compressed collectives: resolved wire dtype in the signature ------------
+
+
+def test_compressed_wire_dtype_divergence():
+    """ISSUE 8 satellite: the signature ring carries the RESOLVED wire
+    dtype, so a group mixing bf16/int8 compressed entries raises
+    CollectiveMismatchError naming both resolved signatures — instead
+    of desynchronizing the segment exchange (one rank decoding frames
+    the other never encoded)."""
+    ses = mpit.session_create()
+    ses.reset_all()
+
+    def fn(comm):
+        algo = "compressed:bf16" if comm.rank == 0 else "compressed:int8"
+        comm.allreduce(np.ones(64, np.float32), algorithm=algo)  # mpilint: ok
+
+    with pytest.raises(RuntimeError) as ei:
+        _run(fn)
+    cause = ei.value.__cause__
+    assert isinstance(cause, CollectiveMismatchError)
+    msg = str(cause)
+    assert "compressed:bf16" in msg and "compressed:int8" in msg
+    assert sorted(cause.ranks) == [0, 1]
+    assert ses.read("verify_collective_mismatches") >= 1
+
+
+def test_compressed_vs_uncompressed_divergence():
+    """One rank compressed, the other on the classic ring: the
+    algorithm field diverges and both ranks get the named error before
+    any data moves."""
+
+    def fn(comm):
+        algo = "compressed" if comm.rank == 0 else "ring"
+        comm.allreduce(np.ones(64, np.float32), algorithm=algo)  # mpilint: ok
+
+    with pytest.raises(RuntimeError) as ei:
+        _run(fn)
+    cause = ei.value.__cause__
+    assert isinstance(cause, CollectiveMismatchError)
+    assert "compressed:bf16" in str(cause) and "ring" in str(cause)
+
+
+def test_compressed_topk_k_rides_signature_counts(monkeypatch):
+    """The resolved k rides the signature's COUNTS field (not just the
+    algorithm string), so per-rank compress_topk_ratio skew — same
+    spelling, same geometry, different k, which would silently misfold
+    the sparse accumulation — is diagnosed.  The process-global cvar
+    cannot be diverged per thread rank without racing, so the skew is
+    injected at the signature boundary itself: a spy on collcheck.check
+    first RECORDS that the resolver's k reaches the counts argument,
+    then perturbs rank 1's counts and the real ring compare raises."""
+    from mpi_tpu import compress
+    from mpi_tpu.verify import collcheck
+
+    n = 64
+    seen = []
+    real_check = collcheck.check
+
+    def spy(comm, coll, **kw):
+        seen.append((comm.rank, coll, kw.get("counts")))
+        return real_check(comm, coll, **kw)
+
+    monkeypatch.setattr(collcheck, "check", spy)
+    _run(lambda c: c.allreduce(np.ones(n, np.float32),
+                               algorithm="compressed:topk"))
+    k = compress.topk_k(n)
+    assert sorted((r, cnt) for r, _, cnt in seen) == [(0, (k,)), (1, (k,))]
+
+    def skewed(comm, coll, **kw):
+        if comm.rank == 1 and kw.get("counts") is not None:
+            kw["counts"] = (kw["counts"][0] + 1,)  # ratio-skew analogue
+        return real_check(comm, coll, **kw)
+
+    monkeypatch.setattr(collcheck, "check", skewed)
+    with pytest.raises(RuntimeError) as ei:
+        _run(lambda c: c.allreduce(np.ones(n, np.float32),
+                                   algorithm="compressed:topk"))
+    cause = ei.value.__cause__
+    assert isinstance(cause, CollectiveMismatchError)
+    assert f"counts=[{k}]" in str(cause) and f"counts=[{k + 1}]" in str(cause)
+
+
+def test_fileboard_scandir_single_pass(tmp_path):
+    """ISSUE 8 satellite (verifier residual (d) tail): read_all's
+    presence probe is ONE os.scandir pass over the rendezvous dir —
+    never a per-rank os.stat loop (O(P) path lookups, mostly ENOENT
+    for the running majority).  At 10 ranks with a sparse board:
+    correctness identical (entries, ages, (mtime_ns,size) validation,
+    trust horizon), non-pending siblings ignored, and os.stat provably
+    out of the loop."""
+    import os as _os
+    import time as _time
+
+    from mpi_tpu.verify.state import FileBoard
+
+    size = 10
+    rdv = str(tmp_path)
+    boards = [FileBoard(rdv, r, size) for r in range(size)]
+    blocked = [1, 4, 7, 9]  # the common case: only the stalled publish
+    for r in blocked:
+        boards[r].publish(r, {"state": "blocked", "rank": r,
+                              "targets": [(r + 1) % size], "mode": "AND"})
+    # sibling files the integer-suffix test must skip
+    (tmp_path / "pending.summary.json.tmp.999.0").write_text("junk")
+    (tmp_path / "pending.3.tmp").write_text("torn")
+    (tmp_path / f"pending.{size + 5}").write_text("{}")  # out of range
+    (tmp_path / "port.0").write_text("0")
+
+    reader = FileBoard(rdv, 0, size)
+    real_stat = _os.stat
+
+    def no_pending_stat(path, *a, **kw):
+        if isinstance(path, str) and "pending." in _os.path.basename(path) \
+                and not _os.path.basename(path).startswith(
+                    ("pending.summary",)):
+            raise AssertionError(f"per-rank os.stat loop is back: {path}")
+        return real_stat(path, *a, **kw)
+
+    _os.stat = no_pending_stat
+    try:
+        out = reader.read_all()
+    finally:
+        _os.stat = real_stat
+    assert set(out) == set(blocked)
+    assert all(out[r]["rank"] == r and out[r]["_age_s"] >= 0.0
+               for r in blocked)
+    assert reader.fallback_reads == len(blocked)  # absent ranks: no read
+
+    # identity validation + trust horizon carry over: age past the
+    # horizon, re-read nothing; republish one, re-read exactly it
+    _time.sleep(FileBoard._MTIME_TRUST_S + 0.1)
+    reader.read_all()  # recency re-reads of the now-aged entries
+    base = reader.fallback_reads
+    steady = reader.read_all()
+    assert set(steady) == set(blocked)
+    assert reader.fallback_reads == base  # stats only, zero parses
+    boards[4].publish(4, {"state": "blocked", "rank": 4, "targets": [0],
+                          "mode": "AND"})
+    out2 = reader.read_all()
+    assert out2[4]["targets"] == [0]
+    assert reader.fallback_reads == base + 1
+    # retraction: unlink disappears with no parse
+    boards[7].publish(7, None)
+    assert 7 not in reader.read_all()

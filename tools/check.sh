@@ -18,7 +18,7 @@ cd "$(dirname "$0")/.."
 echo "check.sh: python -m compileall (syntax gate)"
 python -m compileall -q mpi_tpu tools examples benchmarks tests bench.py
 
-echo "check.sh: mpilint over examples/ + mpi_tpu/ (incl. membership.py, serve.py)"
+echo "check.sh: mpilint over examples/ + mpi_tpu/ (incl. compress.py, membership.py, serve.py)"
 python tools/mpilint.py examples mpi_tpu
 
 if [ "${1:-}" != "" ]; then
