@@ -607,6 +607,19 @@ if __name__ == "__main__":
         if "--progress" in sys.argv[1:]:
             args.append("--progress")
         sys.exit(verify_overhead.main(args))
+    if "--tune" in sys.argv[1:]:
+        # tuned-dispatch table generator (ISSUE 9): sweeps (transport x
+        # P x payload x algorithm — including the arena as a measured
+        # algorithm) and writes the per-machine tuning table under
+        # benchmarks/results/tuning/ that algorithm='auto' consults
+        # (mpi_tpu/tuning).  --quick is the tier-1 smoke spelling
+        # (1KB, P=2, 1 sample, stdout only — no artifact written).
+        sys.path.insert(0, os.path.join(REPO, "tools"))
+        import tune
+
+        if "--quick" in sys.argv[1:]:
+            sys.exit(tune.main(["--quick"]))
+        sys.exit(tune.main([]))
     if "--sweep" in sys.argv[1:]:
         # the OSU-style host data-plane size sweep (ISSUE 1 tentpole #4,
         # extended to alltoall/reduce_scatter/rabenseifner in ISSUE 2);

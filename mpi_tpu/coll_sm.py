@@ -67,6 +67,7 @@ import numpy as np
 
 from . import mpit as _mpit
 from . import schedules
+from . import tuning as _tuning
 from .transport import codec as _codec
 from .transport.base import ANY_SOURCE, RecvTimeout, TransportError
 
@@ -482,7 +483,16 @@ def allreduce(arena: Arena, comm, arr: np.ndarray, op) -> Any:
     out = np.empty(mine.shape, mine.dtype)
     flat = out.reshape(-1)
     n = flat.size
-    if mine.nbytes <= _EAGER_BYTES:
+    # flat-vs-chunked is a tuned decision (mpi_tpu/tuning "sm_allreduce"
+    # rows): the table overrides the coll_sm_eager_bytes constant where
+    # the sweep measured this machine; payloads are congruent, so every
+    # rank picks the same side.  No row: the seed constant.
+    eager = mine.nbytes <= _EAGER_BYTES
+    pick = _tuning.pick(comm, "sm_allreduce", int(mine.nbytes),
+                        ("flat", "chunked"))
+    if pick is not None:
+        eager = pick == "flat"
+    if eager:
         # flat: every rank folds every slot, in rank order — the result
         # is deterministic and bit-identical on every rank
         if n:
@@ -617,8 +627,15 @@ def allreduce_wire(arena: Arena, comm, arr: np.ndarray, op, wire) -> Any:
 def reduce(arena: Arena, comm, arr: np.ndarray, op, root: int) -> Any:
     # Above eager the binomial tree's distributed folds beat a flat P·N
     # fold at the root; reduction payloads are congruent, so every rank
-    # gates identically without consulting the metas.
-    if arr.nbytes > _EAGER_BYTES:
+    # gates identically without consulting the metas.  The gate is a
+    # tuned decision (mpi_tpu/tuning "sm_reduce" rows: "arena"/"tree")
+    # falling back to the coll_sm_eager_bytes constant.
+    use_arena = arr.nbytes <= _EAGER_BYTES
+    pick = _tuning.pick(comm, "sm_reduce", int(arr.nbytes),
+                        ("arena", "tree"))
+    if pick is not None:
+        use_arena = pick == "arena"
+    if not use_arena:
         arena.write_meta(_KIND_NONE, None)
         arena.barrier(comm)
         mine = None

@@ -36,6 +36,7 @@ from . import compress as _compress
 from . import mpit as _mpit
 from . import ops as _ops
 from . import schedules
+from . import tuning as _tuning
 from .errors import ProcFailedError, RevokedError
 from .transport import codec as _codec
 from .transport.base import (ANY_SOURCE, ANY_TAG, RecvTimeout, Transport,
@@ -194,6 +195,26 @@ class Status:
 def _check_user_tag(tag: int) -> None:
     if tag != ANY_TAG and tag < 0:
         raise ValueError(f"user tags must be >= 0 (got {tag}); negative tags are reserved")
+
+
+def seed_allreduce_algorithm(nbytes: int, size: int) -> str:
+    """The seed constants' ``auto`` allreduce pick — the wire-algorithm
+    policy that runs when no tuning-table row matches (mpi_tpu/tuning).
+    The Rabenseifner composition once the measured sweep shows it
+    stably at-or-below ring (checked FIRST so lowering its cvar below
+    the ring crossover takes effect on pow2 groups too);
+    latency-optimal recursive halving for small payloads on
+    power-of-two groups; bandwidth-optimal ring otherwise (the
+    crossover the reference benchmarks head-to-head, BASELINE.json:10).
+
+    ``tools/tune.py`` reads THIS function for its tie-bias incumbent,
+    so the sweep's recorded ``seed`` column can never structurally
+    drift from real dispatch."""
+    if nbytes >= _RABENSEIFNER_CROSSOVER_BYTES:
+        return "rabenseifner"
+    if schedules.is_pow2(size) and nbytes < _RING_CROSSOVER_BYTES:
+        return "recursive_halving"
+    return "ring"
 
 
 def _resolve_algorithm(coll: str, algorithm: str, real: Tuple[str, ...],
@@ -1767,7 +1788,8 @@ class P2PCommunicator(Communicator):
         return _unwrap(acc, scalar) if self._rank == root else None
 
     def allreduce(self, obj: Any, op: _ops.ReduceOp = _ops.SUM,
-                  algorithm: str = "auto") -> Any:
+                  algorithm: str = "auto",
+                  compress_key: Any = None) -> Any:
         """MPI_Allreduce.  ``algorithm``: ``"ring"`` (bandwidth-optimal
         reduce-scatter ring + allgather ring), ``"recursive_halving"``
         (latency-optimal, power-of-two groups only), ``"rabenseifner"``
@@ -1789,7 +1811,16 @@ class P2PCommunicator(Communicator):
         dtype, unsupported op) decline group-coherently to ``"auto"``
         (``compress_fallbacks`` pvar); the verifier signature carries
         the RESOLVED wire dtype so mixed groups raise
-        CollectiveMismatchError instead of desynchronizing."""
+        CollectiveMismatchError instead of desynchronizing.
+
+        ``compress_key`` (``compressed:topk`` only, process backends):
+        caller-supplied TENSOR IDENTITY for the error-feedback residual
+        slot.  Residuals default to keying by payload geometry
+        (shape, dtype, op), so a program alternating two distinct
+        same-geometry tensors through top-k cross-contaminates their
+        residuals; passing a distinct ``compress_key`` per logical
+        tensor (e.g. the parameter name) gives each its own slot.  Must
+        agree across the group like every compression knob."""
         _mpit.count(collectives=1)
         self._coll_name = "allreduce"
         arr, scalar = _as_array(obj)
@@ -1812,8 +1843,8 @@ class P2PCommunicator(Communicator):
             if self.size == 1:
                 return _unwrap(arr.copy(), scalar)
             if wire is _compress.TOPK:
-                return _unwrap(_compress.topk_allreduce(self, arr, op),
-                               scalar)
+                return _unwrap(_compress.topk_allreduce(
+                    self, arr, op, compress_key=compress_key), scalar)
             # shm transports: the arena's compressed eager path first
             # (encoded slot writes, fold-dtype folds) so compressed
             # requests route exactly like auto's arena tier
@@ -1823,6 +1854,24 @@ class P2PCommunicator(Communicator):
             fold = arr.astype(_compress.fold_dtype(arr.dtype), copy=False)
             out = self._allreduce_ring(fold, op, wire=wire)
             return _unwrap(out.astype(arr.dtype, copy=False), scalar)
+        if algorithm == "auto" and self.size > 1:
+            # Tuned dispatch (mpi_tpu/tuning): a measured table row for
+            # (transport, P, allreduce, payload band) overrides the
+            # seed policy below — including routing AWAY from the
+            # arena-first tier ("ring" at >=1MB where the sweep showed
+            # the wire ring beating the chunked arena fold) or INTO it
+            # ("sm").  Payload geometry is congruent across ranks (the
+            # reduction contract), so the band — like the table itself,
+            # which must be identical group-wide — keys the same row
+            # everywhere.  No matching row: exactly the seed constants.
+            pick = _tuning.pick(
+                self, "allreduce", arr.nbytes,
+                ("ring", "rabenseifner", "reduce_bcast")
+                + (("recursive_halving",)
+                   if schedules.is_pow2(self.size) else ())
+                + _coll_sm.gate(self))
+            if pick is not None:
+                algorithm = pick
         if algorithm in ("auto", "sm") and self.size > 1:
             # shm transports: the collective arena first — flat slot
             # folds at eager sizes, in-place chunk folds above
@@ -1833,20 +1882,7 @@ class P2PCommunicator(Communicator):
                 return _unwrap(np.asarray(got), scalar)
             algorithm = "auto"
         if algorithm == "auto":
-            # The Rabenseifner composition once the measured sweep shows
-            # it stably at-or-below ring (checked FIRST so lowering its
-            # cvar below the ring crossover takes effect on pow2 groups
-            # too); latency-optimal recursive halving for small payloads
-            # on power-of-two groups; bandwidth-optimal ring otherwise
-            # (the crossover the reference benchmarks head-to-head,
-            # BASELINE.json:10).
-            if arr.nbytes >= _RABENSEIFNER_CROSSOVER_BYTES:
-                algorithm = "rabenseifner"
-            elif schedules.is_pow2(self.size) and \
-                    arr.nbytes < _RING_CROSSOVER_BYTES:
-                algorithm = "recursive_halving"
-            else:
-                algorithm = "ring"
+            algorithm = seed_allreduce_algorithm(arr.nbytes, self.size)
         if self.size == 1:
             return _unwrap(arr.copy(), scalar)
         if algorithm == "ring":
@@ -2235,6 +2271,24 @@ class P2PCommunicator(Communicator):
         if len(objs) != p:
             raise ValueError(f"alltoall needs one payload per rank ({p}), got {len(objs)}")
         self._verify_coll("alltoall", algorithm=algorithm)
+        tuned_wire = False
+        if algorithm == "auto" and p > 1:
+            # Tuned dispatch.  Unlike the reductions, alltoall payload
+            # sizes may be RANK-VARYING (ragged/object payloads), so a
+            # "pairwise" row must never skip the arena's group
+            # negotiation outright — instead this rank enters the arena
+            # with no payload, which lands the WHOLE group on pairwise
+            # together even when peers' bands disagree (the in-arena
+            # meta round is the coherence mechanism).  Unsizable
+            # payloads skip the consult entirely.
+            try:
+                nb = self._blocks_nbytes(objs)
+            except (ValueError, TypeError):
+                nb = None
+            if nb is not None:
+                pick = _tuning.pick(self, "alltoall", nb,
+                                    ("pairwise",) + _coll_sm.gate(self))
+                tuned_wire = pick == "pairwise"
         if algorithm in ("auto", "sm") and p > 1:
             # Arena path: write the whole [P·n] stack once, read your
             # column in place.  Same eligibility discipline as the
@@ -2245,7 +2299,7 @@ class P2PCommunicator(Communicator):
             # any rank's blocks are ragged/objects/oversized.
             arena = _coll_sm.arena_for(self)
             arr_sm = None
-            if arena is not None:
+            if arena is not None and not tuned_wire:
                 try:
                     # alltoall payloads may be ANY picklables — a ragged
                     # nested list makes even the size probe raise, which
@@ -2424,6 +2478,17 @@ class P2PCommunicator(Communicator):
         self._verify_coll("reduce_scatter", op=op,
                           payload=np.asarray(blocks[0]),
                           algorithm=algorithm, counts=(p,))
+        if algorithm == "auto" and p > 1:
+            # Tuned dispatch: the measured arena-vs-wire-ring axis
+            # (host-engine residual (c)) — a "ring" row skips the
+            # arena-first tier, an "sm" row keeps it.  reduce_scatter
+            # blocks are geometry-congruent across ranks, so the band
+            # keys the same row everywhere.
+            pick = _tuning.pick(self, "reduce_scatter",
+                                self._blocks_nbytes(blocks),
+                                ("ring",) + _coll_sm.gate(self))
+            if pick is not None:
+                algorithm = pick
         if algorithm in ("auto", "sm") and p > 1:
             # Arena path: write the whole [P·n] input once, fold only
             # block ``rank`` reading peers in place.  The stacked-array
@@ -2842,10 +2907,13 @@ class P2PCommunicator(Communicator):
                                  root)
 
     def iallreduce(self, obj: Any, op: _ops.ReduceOp = _ops.SUM,
-                   algorithm: str = "auto") -> Request:
+                   algorithm: str = "auto",
+                   compress_key: Any = None) -> Request:
         c = self._nbc_comm()
-        return self._nbc_request("iallreduce",
-                                 lambda: c.allreduce(obj, op, algorithm))
+        return self._nbc_request(
+            "iallreduce",
+            lambda: c.allreduce(obj, op, algorithm,
+                                compress_key=compress_key))
 
     def iallgather(self, obj: Any) -> Request:
         c = self._nbc_comm()

@@ -23,9 +23,12 @@ backend:
   unsent remainder is accumulated per (shape, dtype, op) slot on the
   communicator and added to the NEXT same-geometry gradient, so repeated
   steps converge on the dense sum instead of permanently dropping mass.
-  The residual slot is keyed by payload geometry, not tensor identity —
-  a program alternating two same-geometry tensors through topk shares
-  one slot (documented limitation; ``reset_residuals`` clears them).
+  The residual slot defaults to keying by payload geometry — a program
+  alternating two same-geometry tensors through topk shares one slot —
+  UNLESS the caller names the tensor: ``allreduce(...,
+  compress_key=...)`` threads an identity into the slot key, giving
+  each logical tensor its own residual (``reset_residuals`` clears
+  them either way).
 
 Group coherence: reductions REQUIRE congruent payloads (same dtype and
 shape on every rank — the MPI contract the ring folds already lean on),
@@ -319,7 +322,8 @@ def reset_residuals(comm) -> None:
     comm.__dict__.pop("_compress_residuals", None)
 
 
-def topk_allreduce(comm, arr: np.ndarray, op) -> np.ndarray:
+def topk_allreduce(comm, arr: np.ndarray, op,
+                   compress_key: Any = None) -> np.ndarray:
     """Sparsified SUM allreduce: local top-k selection (by magnitude,
     after adding this slot's error-feedback residual), then a P-1 ring
     allgather of every rank's (indices, values) pair — each hop one
@@ -332,7 +336,14 @@ def topk_allreduce(comm, arr: np.ndarray, op) -> np.ndarray:
     ``bytes_compressed_saved`` pvar.  Ties at the k-th magnitude are
     broken arbitrarily (np.argpartition); ANY valid top-k set yields the
     same bound, and the unselected remainder lands in the residual
-    either way."""
+    either way.
+
+    ``compress_key`` names the TENSOR the residual belongs to (ISSUE 9
+    satellite / PR-8 residual (c)): the slot key is (compress_key,
+    geometry), so two logically distinct tensors that happen to share
+    (shape, dtype, op) stop sharing one residual the moment the caller
+    tells them apart.  None (the default) preserves the geometry-only
+    keying."""
     from .communicator import _TAG_COLL
 
     shape = tuple(arr.shape)
@@ -341,7 +352,7 @@ def topk_allreduce(comm, arr: np.ndarray, op) -> np.ndarray:
     n = x.size
     k = topk_k(n)
     store = comm.__dict__.setdefault("_compress_residuals", {})
-    key = ("allreduce", str(arr.dtype), shape, op.name)
+    key = ("allreduce", compress_key, str(arr.dtype), shape, op.name)
     residual = store.get(key)
     if residual is not None and residual.shape == x.shape:
         x += residual

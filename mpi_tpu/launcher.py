@@ -221,6 +221,14 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                              "dedicated progress engine per rank — "
                              "background completion for nonblocking ops "
                              "(mpi_tpu/progress.py)")
+    parser.add_argument("--tuning-table", default=None, metavar="PATH",
+                        help="per-machine tuned-dispatch table for every "
+                             "rank (MPI_TPU_TUNING_TABLE): measured "
+                             "(transport, nranks, collective, payload-"
+                             "band) -> algorithm rows that "
+                             "algorithm='auto' consults before the "
+                             "built-in constants (mpi_tpu/tuning; "
+                             "generate with tools/tune.py)")
     parser.add_argument("script", help="python script to run on every rank")
     parser.add_argument("script_args", nargs=argparse.REMAINDER,
                         help="arguments passed to the script")
@@ -230,6 +238,9 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         env_extra["MPI_TPU_VERIFY"] = "1"
     if args.progress is not None:
         env_extra["MPI_TPU_PROGRESS"] = args.progress
+    if args.tuning_table is not None:
+        env_extra["MPI_TPU_TUNING_TABLE"] = os.path.abspath(
+            args.tuning_table)
     return launch(args.nranks, [args.script, *args.script_args],
                   env_extra=env_extra or None,
                   timeout=args.timeout, backend=args.backend,

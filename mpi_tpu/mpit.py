@@ -45,7 +45,8 @@ class _Counters:
                  "v_buf_overlaps", "v_comms_unfreed",
                  "prog_wakeups", "prog_completions", "prog_idle_parks",
                  "rejoins", "epoch_skews",
-                 "comp_saved", "comp_fallbacks")
+                 "comp_saved", "comp_fallbacks",
+                 "tuned_hits", "tuned_fallbacks")
 
     def __init__(self) -> None:
         self.sends = 0
@@ -78,6 +79,8 @@ class _Counters:
         self.epoch_skews = 0
         self.comp_saved = 0
         self.comp_fallbacks = 0
+        self.tuned_hits = 0
+        self.tuned_fallbacks = 0
 
 
 counters = _Counters()  # incremented by communicator.py / codec.py (count())
@@ -98,7 +101,9 @@ def count(sends: int = 0, send_bytes: int = 0, recvs: int = 0,
           progress_idle_parks: int = 0,
           rejoins: int = 0, epoch_skews: int = 0,
           bytes_compressed_saved: int = 0,
-          compress_fallbacks: int = 0) -> None:
+          compress_fallbacks: int = 0,
+          tuned_table_hits: int = 0,
+          tuned_table_fallbacks: int = 0) -> None:
     """Thread-safe increment (rank-threads of the local backend share
     this process's counters; unsynchronized += would lose updates)."""
     with _lock:
@@ -132,6 +137,8 @@ def count(sends: int = 0, send_bytes: int = 0, recvs: int = 0,
         counters.epoch_skews += epoch_skews
         counters.comp_saved += bytes_compressed_saved
         counters.comp_fallbacks += compress_fallbacks
+        counters.tuned_hits += tuned_table_hits
+        counters.tuned_fallbacks += tuned_table_fallbacks
 
 _PVARS: Dict[str, Callable[[], int]] = {
     "msgs_sent": lambda: counters.sends,
@@ -214,6 +221,14 @@ _PVARS: Dict[str, Callable[[], int]] = {
     # the actual wire bytes, so the halving claim is assertable.
     "bytes_compressed_saved": lambda: counters.comp_saved,
     "compress_fallbacks": lambda: counters.comp_fallbacks,
+    # tuned dispatch (mpi_tpu/tuning): algorithm="auto" decisions served
+    # by a matching tuning-table row vs decisions that fell back to the
+    # built-in seed constants (no table / no matching row / row not
+    # applicable to this group).  With no table configured every auto
+    # decision is a fallback and dispatch is byte-identical to the
+    # constants (asserted in tests/test_tuning.py).
+    "tuned_table_hits": lambda: counters.tuned_hits,
+    "tuned_table_fallbacks": lambda: counters.tuned_fallbacks,
 }
 
 
@@ -302,6 +317,7 @@ def _ensure_builtin_cvars() -> None:
     from . import io as _io
     from . import membership as _membership
     from . import progress as _prog
+    from . import tuning as _tuning
     from .transport import shm as _shm
     from .verify import state as _vstate
 
@@ -516,6 +532,20 @@ def _ensure_builtin_cvars() -> None:
             "endpoints -> ready -> barrier, on BOTH the joiner "
             "(rejoin()) and survivor (accept_rejoin()) sides; explicit "
             "timeout= arguments override per call")
+        _CVARS["tuning_table_path"] = (
+            _tuning.table_path,
+            lambda v: _tuning.set_table_path(str(v) if v else None),
+            "path of the active per-machine tuning table (mpi_tpu/"
+            "tuning): measured (transport, nranks, collective, payload-"
+            "band) -> algorithm rows that algorithm='auto' consults "
+            "before the built-in seed constants (tuned_table_hits / "
+            "tuned_table_fallbacks pvars).  Empty = no table (seed "
+            "constants only).  Writing loads + validates immediately "
+            "(malformed tables raise TuningTableError); a table whose "
+            "machine fingerprint does not match this host loads but "
+            "never serves.  Must agree across the group, like every "
+            "algorithm-steering cvar.  MPI_TPU_TUNING_TABLE / run_local("
+            "tuning_table=) / launcher --tuning-table set it per world")
         _CVARS["gather_replicated_warn_bytes"] = (
             lambda: _GATHER_WARN_BYTES[0],
             lambda v: _GATHER_WARN_BYTES.__setitem__(0, int(v)),

@@ -420,6 +420,27 @@ def graph_create(comm: Communicator, edges: Sequence[Pair]) -> GraphComm:
     return GraphComm(comm, edges)
 
 
+def multihost_node_key(comm: Communicator):
+    """Per-rank DCN node ids discovered from the multi-host jax runtime
+    (tpu/multihost.py ``init_distributed``): each rank contributes its
+    jax process index — the DCN granule, one per host — and the
+    allgathered list becomes the pure ``node_key`` function the
+    hierarchical splits need.  Single-process runtimes (and worlds
+    without jax) collapse to one node, which is also the truth for the
+    single-host worlds this library's launcher starts; tests inject
+    synthetic keys instead to exercise multi-node shapes on one box."""
+    try:
+        import jax
+
+        dom = (int(jax.process_index())
+               if int(jax.process_count()) > 1 else 0)
+    except Exception:  # noqa: BLE001 - no (initialized) jax: one node
+        dom = 0
+    domains = comm.allgather(dom)
+    table = [int(d) for d in domains]
+    return lambda r: table[r]
+
+
 def split_hierarchical(comm: Communicator, node_key=None
                        ) -> Tuple[Communicator, Optional[Communicator],
                                   List[int]]:
@@ -451,29 +472,112 @@ def split_hierarchical(comm: Communicator, node_key=None
     return intra, leaders, node_of
 
 
+def _dense(keys: List) -> List[int]:
+    """Dense ids in first-appearance order (node n's leader — its lowest
+    rank — is member n of any leader communicator keyed by old rank)."""
+    order: dict = {}
+    for k in keys:
+        order.setdefault(k, len(order))
+    return [order[k] for k in keys]
+
+
+def split_hierarchical3(comm: Communicator, numa_key=None, node_key=None
+                        ) -> Tuple[Communicator, Optional[Communicator],
+                                   Optional[Communicator], List[int],
+                                   List[int]]:
+    """The THREE-level split (ISSUE 9): ``(numa, node_leaders,
+    dcn_leaders, numa_of, node_of)``.
+
+    * ``numa`` groups the ranks sharing ``(node_key(r), numa_key(r))``
+      — one communicator per NUMA domain, ordered by old rank, so the
+      domain's lowest rank is its leader (numa rank 0);
+    * ``node_leaders`` groups each node's NUMA leaders (None on
+      non-leader ranks) — the intra-node inter-NUMA tier, whose rank 0
+      is the node leader (the node's lowest rank);
+    * ``dcn_leaders`` groups the node leaders across nodes (None
+      elsewhere) — the tier whose traffic crosses the data-center
+      network; node n sits at dcn rank n (nodes numbered in
+      first-appearance order = lowest-rank order).
+
+    Both keys must be pure functions of the comm rank, identical on
+    every rank (the split_by_rank contract).  ``node_key`` defaults to
+    the single-node domain; pass :func:`multihost_node_key`'s result on
+    a real multi-host runtime, or synthetic keys in tests.  ``numa_key``
+    defaults to one NUMA domain per node (collapsing the middle tier to
+    size-1 node_leaders — the degenerate spelling of the PR-4 two-level
+    split)."""
+    if numa_key is None:
+        numa_key = lambda r: 0  # noqa: E731 - one NUMA domain per node
+    if node_key is None:
+        # "where available": a multi-host jax runtime supplies the real
+        # DCN node ids (one allgather); everything else is one node
+        node_key = multihost_node_key(comm)
+    numa_of = _dense([(node_key(r), numa_key(r))
+                      for r in range(comm.size)])
+    node_of = _dense([node_key(r) for r in range(comm.size)])
+    numa = comm.split(numa_of[comm.rank], key=comm.rank)
+    numa_leader = numa.rank == 0
+    node_leaders = comm.split(node_of[comm.rank] if numa_leader else None,
+                              key=comm.rank)
+    node_leader = node_leaders is not None and node_leaders.rank == 0
+    dcn_leaders = comm.split(0 if node_leader else None, key=comm.rank)
+    return numa, node_leaders, dcn_leaders, numa_of, node_of
+
+
 class HierarchicalComm:
-    """Hierarchical collective dispatch over a two-level split: the
-    intra-node tier runs on each node's own communicator — where the shm
-    transport's collective arena (mpi_tpu/coll_sm.py) serves collectives
-    by load/store — and the inter-node tier runs the measured wire
-    algorithms (ring / Rabenseifner via ``inter_algorithm``) between the
-    node leaders only.  An allreduce therefore moves each payload once
-    per node over the wire instead of once per rank: intra reduce →
-    leaders allreduce → intra bcast.
+    """Hierarchical collective dispatch over a two- or THREE-level
+    split: the intra tiers run on their own communicators — where the
+    shm transport's collective arena (mpi_tpu/coll_sm.py) serves
+    collectives by load/store — and the top tier runs the measured wire
+    algorithms between the leaders only.  An allreduce therefore moves
+    each payload once per node over the wire instead of once per rank:
+    intra reduce → leaders allreduce → intra bcast.
+
+    Two-level (the PR-4 shape, default): ``node_key`` partitions ranks
+    into nodes; ``intra`` is the node communicator, ``leaders`` the
+    inter-node tier.
+
+    Three-level (ISSUE 9, selected by passing ``numa_key``): NUMA →
+    node → DCN leaders.  ``numa_key(r)`` names rank r's NUMA domain
+    WITHIN its node, ``node_key(r)`` its node (on a real multi-host
+    runtime, :func:`multihost_node_key` derives it from
+    tpu/multihost.py's process index; tests inject synthetic keys).
+    An allreduce climbs ``numa.reduce`` → ``node_leaders.reduce`` →
+    ``dcn_leaders.allreduce`` and descends by bcast — and every level's
+    ``algorithm="auto"`` call consults the tuned-dispatch resolver
+    (mpi_tpu/tuning) with ITS OWN (transport, size, payload) key, so a
+    per-machine table steers each tier independently (the
+    ``tuned_table_hits`` pvar counts one consult per level).
 
     Wraps (never mutates) an existing communicator, like CartComm."""
 
     def __init__(self, comm: Communicator, node_key=None,
-                 inter_algorithm: str = "auto"):
+                 inter_algorithm: str = "auto", numa_key=None):
         self.comm = comm
-        self.intra, self.leaders, self._node_of = split_hierarchical(
-            comm, node_key)
+        self._inter = inter_algorithm
+        if numa_key is None:
+            # -- two-level (PR 4) — unchanged ------------------------------
+            self.numa = self.node_leaders = self.dcn_leaders = None
+            self.intra, self.leaders, self._node_of = split_hierarchical(
+                comm, node_key)
+        else:
+            # -- three-level (ISSUE 9) -------------------------------------
+            (self.numa, self.node_leaders, self.dcn_leaders,
+             self._numa_of, self._node_of) = split_hierarchical3(
+                comm, numa_key, node_key)
+            # compatibility aliases: the finest tier and the top tier
+            self.intra = self.numa
+            self.leaders = self.dcn_leaders
+            numa_members: List[List[int]] = [
+                [] for _ in range(max(self._numa_of) + 1)]
+            for r, n in enumerate(self._numa_of):
+                numa_members[n].append(r)
+            self._numa_leader_of = [m[0] for m in numa_members]
         self._members: List[List[int]] = [
             [] for _ in range(max(self._node_of) + 1)]
         for r, n in enumerate(self._node_of):
             self._members[n].append(r)
         self._leader_of = [m[0] for m in self._members]
-        self._inter = inter_algorithm
 
     # -- identity ----------------------------------------------------------
 
@@ -499,11 +603,27 @@ class HierarchicalComm:
         got = self.comm.exchange(obj, [(root, leader)])
         return got if self.comm.rank == leader else obj
 
+    def _hop(self, obj: Any, src: int, dst: int) -> Any:
+        """One point-to-point hop on the full communicator (identity
+        when src == dst); bystanders keep their own payload."""
+        if src == dst:
+            return obj
+        got = self.comm.exchange(obj, [(src, dst)])
+        return got if self.comm.rank == dst else obj
+
     # -- collectives -------------------------------------------------------
 
     def barrier(self) -> None:
-        """Gather phase in every node, one inter-node round among the
-        leaders, release phase in every node."""
+        """Gather phase up every tier, release phase back down."""
+        if self.numa is not None:
+            self.numa.barrier()
+            if self.node_leaders is not None:
+                self.node_leaders.barrier()
+                if self.dcn_leaders is not None:
+                    self.dcn_leaders.barrier()
+                self.node_leaders.barrier()
+            self.numa.barrier()
+            return
         self.intra.barrier()
         if self.leaders is not None:
             self.leaders.barrier()
@@ -513,6 +633,18 @@ class HierarchicalComm:
         from . import ops as _ops
 
         op = op or _ops.SUM
+        if self.numa is not None:
+            # reduce up the tiers, allreduce once across the DCN, bcast
+            # back down — each tier's auto call keys the tuned-dispatch
+            # resolver with its own (transport, size, payload)
+            part = self.numa.reduce(obj, op, root=0)
+            if self.node_leaders is not None:
+                part = self.node_leaders.reduce(part, op, root=0)
+                if self.dcn_leaders is not None:
+                    part = self.dcn_leaders.allreduce(
+                        part, op, algorithm=self._inter)
+                part = self.node_leaders.bcast(part, root=0)
+            return self.numa.bcast(part, root=0)
         part = self.intra.reduce(obj, op, root=0)
         if self.leaders is not None:
             part = self.leaders.allreduce(part, op,
@@ -523,6 +655,11 @@ class HierarchicalComm:
         from . import ops as _ops
 
         op = op or _ops.SUM
+        if self.numa is not None:
+            # three-level reduce rides the allreduce chain (every tier
+            # already deduplicates wire traffic); only root keeps it
+            val = self.allreduce(obj, op)
+            return val if self.comm.rank == root else None
         part = self.intra.reduce(obj, op, root=0)
         rn = self._node_of[root]
         val = (self.leaders.reduce(part, op, root=rn)
@@ -535,6 +672,19 @@ class HierarchicalComm:
         return val if self.comm.rank == root else None
 
     def bcast(self, obj: Any, root: int = 0) -> Any:
+        if self.numa is not None:
+            # climb: root -> its NUMA leader -> its node leader; fan
+            # out: dcn bcast -> node bcast -> numa bcast
+            nl = self._numa_leader_of[self._numa_of[root]]
+            top = self._leader_of[self._node_of[root]]
+            obj = self._hop(obj, root, nl)
+            obj = self._hop(obj, nl, top)
+            if self.dcn_leaders is not None:
+                obj = self.dcn_leaders.bcast(obj,
+                                             root=self._node_of[root])
+            if self.node_leaders is not None:
+                obj = self.node_leaders.bcast(obj, root=0)
+            return self.numa.bcast(obj, root=0)
         obj = self._to_leader(obj, root)
         if self.leaders is not None:
             obj = self.leaders.bcast(obj, root=self._node_of[root])
@@ -543,8 +693,26 @@ class HierarchicalComm:
     def allgather(self, obj: Any) -> Any:
         from .communicator import _maybe_stack
 
+        if self.numa is not None:
+            # (rank, payload) pairs climb the tiers as object lists,
+            # the assembled world list descends by bcast: per-rank wire
+            # volume stays one copy of each payload per TIER edge
+            got = self.numa.gather((self.comm.rank, obj), root=0)
+            if self.node_leaders is not None:
+                per = self.node_leaders.gather(got, root=0)
+                if per is not None:
+                    got = [pair for sub in per for pair in sub]
+                if self.dcn_leaders is not None:
+                    per_node = self.dcn_leaders.allgather([got])
+                    got = [pair for (sub,) in per_node for pair in sub]
+                got = self.node_leaders.bcast(got, root=0)
+            got = self.numa.bcast(got, root=0)
+            full: List[Any] = [None] * self.comm.size
+            for rk, item in got:
+                full[rk] = item
+            return _maybe_stack(obj, full)
         node_items = self.intra.gather(obj, root=0)
-        full: List[Any] = [None] * self.comm.size
+        full = [None] * self.comm.size
         if self.leaders is not None:  # exactly the leaders (intra rank 0)
             per_node = self.leaders.allgather([list(node_items)])
             for n, (items,) in enumerate(per_node):

@@ -30,6 +30,11 @@ class LocalWorld:
 
 
 class LocalTransport(Transport):
+    # Tuned-dispatch table key (mpi_tpu/tuning): lets tests pin a
+    # "local" table row against in-process worlds; tools/tune.py only
+    # sweeps the real host transports.
+    tuning_transport = "local"
+
     def __init__(self, world: LocalWorld, rank: int) -> None:
         super().__init__(rank, world.size)
         self._world = world
@@ -62,6 +67,7 @@ def run_local(
     fault_tolerance: bool = False,
     verify: bool = False,
     progress: Optional[str] = None,
+    tuning_table: Optional[str] = None,
 ) -> List[Any]:
     """Run ``fn(comm, *args, **kwargs)`` on ``nranks`` in-process ranks;
     return the per-rank results as a list indexed by rank.
@@ -91,11 +97,23 @@ def run_local(
     pure-polling drain loops join deadlock detection.  ``None`` defers
     to the MPI_TPU_PROGRESS environment variable / ``progress`` cvar;
     ``"none"`` forces it off.
+
+    ``tuning_table`` activates a tuned-dispatch table (mpi_tpu/tuning)
+    for the run: ``algorithm="auto"`` consults its measured rows before
+    the built-in constants.  Process-wide state, like the cvar it sets
+    — restored to the previous table when the world completes.  ``None``
+    leaves the current process configuration (MPI_TPU_TUNING_TABLE /
+    the ``tuning_table_path`` cvar) alone.
     """
     from .. import progress as _progress
+    from .. import tuning as _tuning
     from ..communicator import P2PCommunicator
 
     progress_mode = _progress.resolve_mode(progress)
+    prev_table = None
+    if tuning_table is not None:
+        prev_table = _tuning.table_path()
+        _tuning.set_table_path(tuning_table)
     kwargs = kwargs or {}
     world = LocalWorld(nranks, copy_payloads=copy_payloads)
     results: List[Any] = [None] * nranks
@@ -159,10 +177,17 @@ def run_local(
         threading.Thread(target=runner, args=(r,), name=f"mpi-tpu-rank-{r}", daemon=True)
         for r in range(nranks)
     ]
-    for t in threads:
-        t.start()
-    for t in threads:
-        t.join(timeout)
+    try:
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout)
+    finally:
+        if prev_table is not None:
+            try:
+                _tuning.set_table_path(prev_table or None)
+            except _tuning.TuningTableError:
+                _tuning.set_table_path(None)  # prior table went away
     stuck = [t for t in threads if t.is_alive()]
     if stuck:
         # snapshot where each stuck rank is blocked before unblocking them —

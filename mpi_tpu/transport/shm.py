@@ -130,6 +130,11 @@ class ShmTransport(Transport):
     # the handles register in _coll_arenas and close() tears them down.
     supports_coll_sm = True
 
+    # Tuned-dispatch table key (mpi_tpu/tuning): rows measured on this
+    # data plane.  Wrapper transports (FaultyTransport) deliberately
+    # carry no name, so chaos legs bypass the table.
+    tuning_transport = "shm"
+
     def __init__(self, rank: int, size: int, rdv_dir: str,
                  ring_bytes: int = _RING_BYTES,
                  connect_timeout: float = _OPEN_TIMEOUT,
@@ -434,22 +439,32 @@ class ShmTransport(Transport):
     def progress_park(self, timeout: float) -> bool:
         """Progress-engine park hook (mpi_tpu/progress.py): the shm
         rings need a consumer to PULL frames, so the engine's park IS a
-        progress step — take the progress lock and run one doorbell-
-        parked drain slice (exactly a user receiver's loop body), or
-        nap on the doorbell when another thread owns the engine.  This
-        is what replaces the helper thread's 20Hz last-resort cadence
-        with ~µs doorbell latency while every thread of this rank is
-        computing or stuck in a ring-full send: without it a symmetric
-        exchange larger than the ring advances in 50ms quanta (the
-        measured 16MB ialltoall stall the overlap bench prices).  User
-        receivers keep their one-wakeup inline-drain priority — when
-        one is waiting, the engine stands down onto the doorbell like
-        the helper does."""
+        progress step — take the progress lock for ONE drain pass, then
+        nap on the doorbell with the lock RELEASED.  This is what
+        replaces the helper thread's 20Hz last-resort cadence with ~µs
+        doorbell latency while every thread of this rank is computing
+        or stuck in a ring-full send: without it a symmetric exchange
+        larger than the ring advances in 50ms quanta (the measured 16MB
+        ialltoall stall the overlap bench prices).  User receivers keep
+        their one-wakeup inline-drain priority — when one is waiting,
+        the engine stands down onto the doorbell like the helper does.
+
+        The lock must NOT be held across the nap (PR-6 residual (c)): a
+        blocking user receive that arrives mid-park would lose the
+        progress-lock race and have to wait for the ENGINE to wake,
+        drain and re-ring the bell — one extra thread hop on every such
+        receive.  With the lock free during the nap the receiver takes
+        the engine inline immediately (asserted by
+        tests/test_progress.py test_park_releases_progress_lock)."""
         if self._closing:
             raise TransportError(
                 f"rank {self.world_rank}: transport closed while parked")
         before = self.mailbox.deliveries
+        # Seqlock order (see _progress_wait): snapshot the bell BEFORE
+        # the drain scan, so a frame landing between scan and nap has
+        # already bumped it past `seen` and shmdb_wait returns at once.
         seen = self._lib.shmdb_read(self._db)
+        drained = False
         if (self._user_waiters == 0
                 and self._progress_lock.acquire(blocking=False)):
             try:
@@ -457,11 +472,35 @@ class ShmTransport(Transport):
                     raise TransportError(
                         f"rank {self.world_rank}: transport closed while "
                         f"parked")
-                self._progress_wait(timeout)
+                drained = self._drain_once()
             finally:
                 self._progress_lock.release()
-        elif self.mailbox.deliveries == before:
-            self._lib.shmdb_wait(self._db, seen, timeout)
+        if not drained and self.mailbox.deliveries == before:
+            # Lock-free spin before the futex nap (same 1-core rationale
+            # as _progress_wait's _SPIN_S phase): senders ring OUR
+            # doorbell on every frame, so polling the bell word catches
+            # a frame landing microseconds after the drain pass without
+            # paying a futex sleep/wake round-trip — and without the
+            # progress lock, which must stay free for user receivers.
+            if _SPIN_S > 0.0:
+                spin_deadline = time.monotonic() + min(_SPIN_S, timeout)
+                while (time.monotonic() < spin_deadline
+                       and not self._closing
+                       and self._lib.shmdb_read(self._db) == seen):
+                    os.sched_yield()
+            if (not self._closing
+                    and self._lib.shmdb_read(self._db) == seen):
+                self._lib.shmdb_wait(self._db, seen, timeout)
+            # the bell rang (or the slice expired): pull whatever
+            # arrived before reporting, unless a user receiver already
+            # owns the engine — their inline drain delivers it
+            if (self._user_waiters == 0
+                    and self._progress_lock.acquire(blocking=False)):
+                try:
+                    if not self._closing:
+                        self._drain_once()
+                finally:
+                    self._progress_lock.release()
         return self.mailbox.deliveries != before
 
     # -- Transport interface (incoming) ------------------------------------

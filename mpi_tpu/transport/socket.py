@@ -92,6 +92,10 @@ class SocketTransport(Transport):
     # allreduce point, so prefer few, large frames here.
     coll_segment_hint = 4 << 20
 
+    # Tuned-dispatch table key (mpi_tpu/tuning): rows measured on this
+    # data plane.
+    tuning_transport = "socket"
+
     def __init__(
         self,
         rank: int,

@@ -1,0 +1,27 @@
+"""Topology-aware tuned dispatch (ISSUE 9): measured per-machine
+algorithm-selection tables replacing the hardcoded crossovers.
+
+* :mod:`~mpi_tpu.tuning.table` — the versioned JSON table format
+  (machine fingerprint, trust-stamped (transport, nranks, collective,
+  payload-band) -> algorithm rows) + strict validation.
+* :mod:`~mpi_tpu.tuning.resolver` — the process-wide `pick` every
+  ``algorithm="auto"`` decision consults (``tuned_table_hits`` /
+  ``tuned_table_fallbacks`` pvars; ``tuning_table_path`` cvar /
+  ``MPI_TPU_TUNING_TABLE`` / ``run_local(tuning_table=)`` / launcher
+  ``--tuning-table``).
+* ``tools/tune.py`` — the sweep generator that measures and emits a
+  table for THIS machine (``--check`` validates committed ones in CI).
+"""
+
+from .resolver import (ENV_TABLE, active_table, explain, last_decision,
+                       pick, reason, set_table_path, table_path)
+from .table import (FORMAT, KNOWN_ALGORITHMS, VERSION, Row, TuningTable,
+                    TuningTableError, band_edges, fingerprint, new_doc,
+                    validate)
+
+__all__ = [
+    "ENV_TABLE", "active_table", "explain", "last_decision", "pick",
+    "reason", "set_table_path", "table_path",
+    "FORMAT", "KNOWN_ALGORITHMS", "VERSION", "Row", "TuningTable",
+    "TuningTableError", "band_edges", "fingerprint", "new_doc", "validate",
+]

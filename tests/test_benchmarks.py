@@ -105,6 +105,44 @@ def test_host_sweep_quick_smoke():
                 assert isinstance(r["oversubscribed"], bool), r
 
 
+def test_tune_quick_smoke():
+    """The tuned-dispatch sweep generator end to end in --quick mode
+    (the ``bench.py --tune --quick`` CI spelling): real launcher-spawned
+    ranks on both host transports, every grid collective measured
+    (including the arena 'sm' leg on shm), the emitted document passes
+    the same strict validation tools/tune.py --check enforces, and
+    every row is trust-stamped from its leg's oversubscription."""
+    import os
+    import sys
+
+    sys.path.insert(0, os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "tools"))
+    try:
+        import tune
+    finally:
+        sys.path.pop(0)
+    from mpi_tpu import tuning
+
+    doc = tune.sweep(quick=True)
+    rows = tuning.validate(doc)  # raises on any malformation
+    assert rows, "quick sweep emitted no rows"
+    cells = {(r.transport, r.collective) for r in rows}
+    for t in ("socket", "shm"):
+        for coll in ("allreduce", "reduce_scatter", "alltoall"):
+            assert (t, coll) in cells, (t, coll, cells)
+    for r in rows:
+        assert r.nranks == 2
+        assert isinstance(r.extra["p50_us"], dict) and r.extra["p50_us"]
+        assert all(v > 0 for v in r.extra["p50_us"].values())
+        assert r.extra["seed"] in tuning.KNOWN_ALGORITHMS[r.collective]
+    # the arena rode the shm legs as a measured algorithm
+    shm_allreduce = [r for r in rows
+                    if (r.transport, r.collective) == ("shm", "allreduce")]
+    assert any("sm" in r.extra["p50_us"] for r in shm_allreduce)
+    assert doc["generated"]["oversubscribed"] == (3 > (os.cpu_count() or 1))
+
+
 def test_chaos_quick_smoke():
     """The chaos harness end to end in --quick mode (the ``bench.py
     --chaos --quick`` CI spelling): FaultyTransport drop/delay/duplicate

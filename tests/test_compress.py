@@ -246,6 +246,44 @@ def test_topk_residual_key_and_reset(topk_ratio):
     assert all(run_local(prog, 2))
 
 
+def test_topk_compress_key_isolates_residuals(topk_ratio):
+    """PR-8 residual (c) regression: two DISTINCT tensors sharing a
+    geometry must not cross-contaminate error-feedback residuals when
+    the caller names them (allreduce(..., compress_key=...)).  With
+    identity keys, B's first reduction is bit-identical to B reduced in
+    a fresh world; with the default geometry key (the documented legacy
+    behavior) A's residual leaks into B's — which is exactly what makes
+    this test's teeth real."""
+    topk_ratio(0.05)
+    p, n = 2, 200
+    a = _payloads(p, n, seed=21)
+    b = _payloads(p, n, seed=22)
+
+    def fresh_b(c):
+        return c.allreduce(b[c.rank], algorithm="compressed:topk")
+
+    def keyed(c):
+        c.allreduce(a[c.rank], algorithm="compressed:topk",
+                    compress_key="tensor-a")
+        return c.allreduce(b[c.rank], algorithm="compressed:topk",
+                           compress_key="tensor-b")
+
+    def geometry_keyed(c):
+        c.allreduce(a[c.rank], algorithm="compressed:topk")
+        return c.allreduce(b[c.rank], algorithm="compressed:topk")
+
+    want = run_local(fresh_b, p)
+    got = run_local(keyed, p)
+    for w, g in zip(want, got):
+        np.testing.assert_array_equal(np.asarray(g), np.asarray(w))
+    # teeth: the default geometry key DOES contaminate (A's residual
+    # mass rides into B's reduction), so the isolation above is the
+    # new compress_key's doing, not an accident of the inputs
+    legacy = run_local(geometry_keyed, p)
+    assert any(not np.array_equal(np.asarray(lg), np.asarray(w))
+               for lg, w in zip(legacy, want))
+
+
 def test_topk_rejected_for_reduce_scatter():
     def prog(c):
         with pytest.raises(ValueError, match="reduce_scatter algorithm"):

@@ -83,6 +83,58 @@ def test_irecv_completes_in_background_shm():
     assert out[1] == float((1 << 15) - 1)
 
 
+def test_park_releases_progress_lock_and_recv_completes_inline():
+    """PR-6 residual (c) regression: ``ShmTransport.progress_park``
+    must NOT hold the progress lock across its futex nap.  While a
+    parker naps, the lock is observably FREE — so a blocking user recv
+    that starts mid-park takes the inline drain path itself instead of
+    waiting a thread hop behind the engine — and the recv completes
+    far inside the 5s park slice."""
+    import threading
+
+    def prog(comm):
+        t = comm._t
+        if comm.rank == 0:
+            comm.barrier(algorithm="dissemination")
+            time.sleep(0.35)  # rank 1's parker is napping by now
+            comm.send(np.arange(1024.0), 1, tag=7)
+            comm.barrier(algorithm="dissemination")
+            return None
+        stop = threading.Event()
+
+        def parker():  # stands in for the engine loop's park call
+            while not stop.is_set():
+                try:
+                    t.progress_park(5.0)
+                except Exception:  # noqa: BLE001 - teardown race
+                    return
+
+        th = threading.Thread(target=parker, daemon=True)
+        comm.barrier(algorithm="dissemination")
+        th.start()
+        time.sleep(0.15)  # inside the nap, before rank 0's send
+        lock_free = t._progress_lock.acquire(blocking=False)
+        if lock_free:
+            t._progress_lock.release()
+        t0 = time.monotonic()
+        got = comm.recv(0, tag=7)
+        took = time.monotonic() - t0
+        stop.set()
+        # the closing barrier's arrival rings the doorbell, popping the
+        # parker out of its nap to observe `stop`
+        comm.barrier(algorithm="dissemination")
+        th.join(7.0)
+        assert not th.is_alive(), "parker never exited"
+        assert lock_free, \
+            "progress_park held the progress lock across its futex nap"
+        assert took < 2.0, \
+            f"recv waited {took:.2f}s against a parked engine"
+        return float(np.asarray(got)[-1])
+
+    out = run_shm_world(prog, 2)
+    assert out[1] == 1023.0
+
+
 def test_collective_parity_and_wire_contract_under_thread():
     """The whole family stays exact under the engine, and the ring
     allreduce's zero-pickled-bytes contract survives — engine

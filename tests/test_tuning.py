@@ -1,0 +1,448 @@
+"""Topology-aware tuned dispatch (ISSUE 9 — mpi_tpu/tuning + the
+three-level hierarchy in mpi_tpu/topology.py).
+
+Contracts:
+
+* table load/validate — malformed, stale-version, unknown-algorithm and
+  bad-band tables raise TuningTableError naming the offence (the
+  ``tools/tune.py --check`` CI gate);
+* trust — a trusted row always beats an untrusted row for the same
+  cell; untrusted rows serve when nothing trusted matches;
+* fingerprint — a table measured on another machine loads but never
+  serves (every auto decision falls back to the seed constants);
+* mechanical dispatch — with a pinned table ``algorithm="auto"``
+  resolves to the row's entry (observable in the wire schedule: ring
+  sends 2(P-1) messages per rank where recursive halving sends log2 P)
+  and ``tuned_table_hits`` counts it; with no table behavior is the
+  seed constants and ``tuned_table_fallbacks`` counts it;
+* arena gates — "sm_allreduce"/"sm_reduce" rows steer the arena's
+  flat-vs-chunked and arena-vs-tree splits; an alltoall "pairwise" row
+  declines INSIDE the arena negotiation (group-coherent under band
+  skew);
+* three-level hierarchy — NUMA → node → DCN-leaders parity with
+  injected keys, each level's auto call consulting the resolver.
+"""
+
+import json
+import os
+import socket as _socket
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from mpi_tpu import coll_sm, mpit, topology, tuning
+from mpi_tpu.transport.local import run_local
+from tests.test_shm_backend import run_shm_world
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _doc(rows, hostname=None, cpu_count=None, version=tuning.VERSION,
+         fmt=tuning.FORMAT):
+    return {
+        "format": fmt,
+        "version": version,
+        "fingerprint": {
+            "hostname": _socket.gethostname() if hostname is None
+            else hostname,
+            "cpu_count": (os.cpu_count() or 1) if cpu_count is None
+            else cpu_count,
+            "transports": ["local", "shm"],
+        },
+        "rows": rows,
+    }
+
+
+def _row(transport="local", nranks=2, collective="allreduce", lo=0,
+         hi=None, algorithm="ring", trusted=True, **extra):
+    d = {"transport": transport, "nranks": nranks,
+         "collective": collective, "lo_bytes": lo, "hi_bytes": hi,
+         "algorithm": algorithm, "trusted": trusted}
+    d.update(extra)
+    return d
+
+
+@pytest.fixture()
+def table(tmp_path):
+    """Write a doc, activate it via the cvar, deactivate afterwards."""
+    paths = []
+
+    def activate(doc):
+        p = tmp_path / f"table{len(paths)}.json"
+        p.write_text(json.dumps(doc))
+        paths.append(p)
+        mpit.cvar_write("tuning_table_path", str(p))
+        return str(p)
+
+    try:
+        yield activate
+    finally:
+        mpit.cvar_write("tuning_table_path", "")
+
+
+# -- format / validation -----------------------------------------------------
+
+
+def test_load_validate_and_band_match(tmp_path):
+    doc = _doc([
+        _row(lo=0, hi=1024, algorithm="recursive_halving"),
+        _row(lo=1024, hi=None, algorithm="ring"),
+    ])
+    p = tmp_path / "t.json"
+    p.write_text(json.dumps(doc))
+    tab = tuning.TuningTable.load(str(p))
+    assert tab.matches_machine()
+    assert tab.match("local", 2, "allreduce", 16).algorithm == \
+        "recursive_halving"
+    assert tab.match("local", 2, "allreduce", 1024).algorithm == "ring"
+    assert tab.match("local", 2, "allreduce", 1 << 30).algorithm == "ring"
+    # no row for other transports / sizes / collectives
+    assert tab.match("shm", 2, "allreduce", 16) is None
+    assert tab.match("local", 3, "allreduce", 16) is None
+    assert tab.match("local", 2, "alltoall", 16) is None
+
+
+@pytest.mark.parametrize("mutate, msg", [
+    (lambda d: d.update(version=99), "version"),
+    (lambda d: d.update(format="nope"), "not a tuning table"),
+    (lambda d: d.update(fingerprint={"hostname": 3}), "fingerprint"),
+    (lambda d: d.update(rows="x"), "rows must be a list"),
+    (lambda d: d["rows"].append(_row(collective="frobnicate")),
+     "unknown collective"),
+    (lambda d: d["rows"].append(_row(algorithm="quantum")),
+     "unknown allreduce algorithm"),
+    (lambda d: d["rows"].append(_row(lo=-1)), "lo_bytes"),
+    (lambda d: d["rows"].append(_row(lo=64, hi=64)), "hi_bytes"),
+    (lambda d: d["rows"].append(_row(nranks=1)), "nranks"),
+    (lambda d: d["rows"].append(
+        _row(nranks=3, algorithm="recursive_halving")), "power-of-two"),
+    (lambda d: d["rows"].append(_row(trusted="yes")), "trusted"),
+])
+def test_reject_malformed(tmp_path, mutate, msg):
+    doc = _doc([_row()])
+    mutate(doc)
+    p = tmp_path / "bad.json"
+    p.write_text(json.dumps(doc))
+    with pytest.raises(tuning.TuningTableError, match=msg):
+        tuning.TuningTable.load(str(p))
+    # the strict cvar writer surfaces the same error and keeps the
+    # previous (empty) configuration
+    with pytest.raises(tuning.TuningTableError):
+        mpit.cvar_write("tuning_table_path", str(p))
+    assert mpit.cvar_read("tuning_table_path") == ""
+
+
+def test_reject_non_json(tmp_path):
+    p = tmp_path / "nope.json"
+    p.write_text("{not json")
+    with pytest.raises(tuning.TuningTableError, match="JSON"):
+        tuning.TuningTable.load(str(p))
+
+
+def test_trusted_beats_untrusted(tmp_path):
+    doc = _doc([
+        _row(algorithm="ring", trusted=False),
+        _row(algorithm="rabenseifner", trusted=True),
+    ])
+    p = tmp_path / "t.json"
+    p.write_text(json.dumps(doc))
+    tab = tuning.TuningTable.load(str(p))
+    # trusted wins regardless of file order...
+    assert tab.match("local", 2, "allreduce", 16).algorithm == \
+        "rabenseifner"
+    # ...and untrusted serves where nothing trusted matches
+    doc2 = _doc([_row(algorithm="ring", trusted=False)])
+    p.write_text(json.dumps(doc2))
+    assert tuning.TuningTable.load(str(p)).match(
+        "local", 2, "allreduce", 16).algorithm == "ring"
+
+
+# -- resolver / auto integration ---------------------------------------------
+
+
+def _allreduce_sends(nranks, payload, **run_kwargs):
+    """msgs_sent of one P-rank allreduce world (the schedule
+    fingerprint: ring = 2(P-1) sends per rank, halving = log2 P)."""
+    before = mpit.pvar_read("msgs_sent")
+    res = run_local(lambda c: c.allreduce(payload), nranks, **run_kwargs)
+    for r in res:
+        np.testing.assert_allclose(r, payload * nranks)
+    return mpit.pvar_read("msgs_sent") - before
+
+
+def test_auto_cites_pinned_row(table):
+    """THE acceptance contract: with a pinned table the resolved
+    algorithm equals the row's entry — observable in the wire schedule
+    at P=4, where ring sends 2(P-1)=6 messages per rank and the seed's
+    recursive halving sends 2·log2(P)=4 — tuned_table_hits counts it,
+    and the decision is introspectable."""
+    payload = np.ones(8, np.float32)  # 32B: seed picks halving at P=4
+    seed_sends = _allreduce_sends(4, payload)
+    assert seed_sends == 16  # halving: 4 sends per rank
+    table(_doc([_row(nranks=4, algorithm="ring")]))
+    h0 = mpit.pvar_read("tuned_table_hits")
+    ring_sends = _allreduce_sends(4, payload)
+    assert ring_sends == 24  # ring: 6 sends per rank
+    assert mpit.pvar_read("tuned_table_hits") - h0 == 4  # one per rank
+    last = tuning.last_decision()
+    assert last["algorithm"] == "ring"
+    assert last["source"] == "table:trusted"
+    exp = tuning.explain("local", 4, "allreduce", payload.nbytes)
+    assert exp["algorithm"] == "ring" and exp["row"]["trusted"] is True
+
+
+def test_no_table_is_seed_constants_and_counted():
+    mpit.cvar_write("tuning_table_path", "")
+    f0 = mpit.pvar_read("tuned_table_fallbacks")
+    assert _allreduce_sends(4, np.ones(8, np.float32)) == 16  # halving
+    assert mpit.pvar_read("tuned_table_fallbacks") - f0 == 4
+    # the no-table fast path records nothing; explain() still answers
+    assert tuning.explain("local", 4, "allreduce", 32)["source"] == "seed"
+
+
+def test_active_table_unmatched_row_records_seed(table):
+    """With a table active but no matching row, the fallback IS
+    recorded (source 'seed') — the introspectable half of the
+    fallbacks counter."""
+    table(_doc([_row(collective="alltoall", algorithm="pairwise")]))
+    f0 = mpit.pvar_read("tuned_table_fallbacks")
+    run_local(lambda c: c.allreduce(np.ones(8, np.float32)), 2)
+    assert mpit.pvar_read("tuned_table_fallbacks") - f0 == 2
+    last = tuning.last_decision()
+    assert last["source"] == "seed" and last["collective"] == "allreduce"
+
+
+def test_fingerprint_mismatch_falls_back_to_seed(table):
+    table(_doc([_row(algorithm="ring")], hostname="definitely-not-here"))
+    assert tuning.reason() is not None
+    assert "fingerprint mismatch" in tuning.reason()
+    h0 = mpit.pvar_read("tuned_table_hits")
+    # seed halving: 2·log2(2) = 2 sends per rank
+    assert _allreduce_sends(2, np.ones(8, np.float32)) == 4
+    assert mpit.pvar_read("tuned_table_hits") == h0
+
+
+def test_inapplicable_row_falls_back(table):
+    """A row whose algorithm cannot run here (halving at P=3) is skipped
+    — validation already rejects it keyed to nranks=3, so pin a P=3
+    'sm' row against the arena-less local transport instead."""
+    table(_doc([_row(nranks=3, algorithm="sm", collective="allreduce")]))
+    h0 = mpit.pvar_read("tuned_table_hits")
+    res = run_local(lambda c: c.allreduce(np.ones(4, np.float32)), 3)
+    for r in res:
+        np.testing.assert_allclose(r, np.full(4, 3.0))
+    assert mpit.pvar_read("tuned_table_hits") == h0  # never served
+
+
+def test_run_local_tuning_table_param(tmp_path):
+    p = tmp_path / "t.json"
+    p.write_text(json.dumps(_doc([_row(algorithm="ring")])))
+    h0 = mpit.pvar_read("tuned_table_hits")
+    run_local(lambda c: c.allreduce(np.ones(8, np.float32)), 2,
+              tuning_table=str(p))
+    assert mpit.pvar_read("tuned_table_hits") - h0 == 2
+    # process state restored: the table no longer serves
+    assert mpit.cvar_read("tuning_table_path") == ""
+
+
+def test_env_var_activates_table(tmp_path):
+    """MPI_TPU_TUNING_TABLE is read lazily once per process — assert in
+    a fresh interpreter (the launcher's --tuning-table rides this)."""
+    p = tmp_path / "t.json"
+    p.write_text(json.dumps(_doc([_row(algorithm="ring")])))
+    prog = (
+        "import numpy as np\n"
+        "from mpi_tpu.transport.local import run_local\n"
+        "from mpi_tpu import mpit\n"
+        "run_local(lambda c: c.allreduce(np.ones(8, np.float32)), 2)\n"
+        "print('HITS', mpit.pvar_read('tuned_table_hits'))\n"
+    )
+    env = dict(os.environ, MPI_TPU_TUNING_TABLE=str(p),
+               JAX_PLATFORMS="cpu", PYTHONPATH=REPO)
+    out = subprocess.run([sys.executable, "-c", prog], env=env,
+                         capture_output=True, text=True, timeout=120)
+    assert out.returncode == 0, out.stderr
+    assert "HITS 2" in out.stdout, out.stdout
+
+
+def test_pick_counts_exactly_one_per_consult(table):
+    table(_doc([_row(algorithm="ring")]))
+    h0 = mpit.pvar_read("tuned_table_hits")
+    f0 = mpit.pvar_read("tuned_table_fallbacks")
+    run_local(lambda c: c.allreduce(np.ones(8, np.float32)), 2)
+    dh = mpit.pvar_read("tuned_table_hits") - h0
+    df = mpit.pvar_read("tuned_table_fallbacks") - f0
+    assert (dh, df) == (2, 0)
+
+
+# -- arena gates (shm) -------------------------------------------------------
+
+
+def test_sm_eager_gate_from_table(table):
+    """An "sm_allreduce" row overrides the coll_sm_eager_bytes constant:
+    a 1KB payload (seed: flat) folds via the CHUNKED path when the
+    table says so — parity held, decision introspectable."""
+    table(_doc([
+        _row(transport="shm", collective="sm_allreduce",
+             algorithm="chunked"),
+        # keep auto routed into the arena for the outer decision
+        _row(transport="shm", collective="allreduce", algorithm="sm"),
+    ]))
+
+    def prog(comm):
+        return comm.allreduce(np.full(256, 1.0 + comm.rank), algorithm="sm")
+
+    h0 = mpit.pvar_read("coll_sm_hits")
+    for out in run_shm_world(prog, 2):
+        np.testing.assert_allclose(out, np.full(256, 3.0))
+    assert mpit.pvar_read("coll_sm_hits") > h0  # arena served it
+    last = tuning.last_decision()
+    assert last["collective"] == "sm_allreduce"
+    assert last["algorithm"] == "chunked"
+
+
+def test_sm_reduce_gate_from_table(table):
+    """An "sm_reduce" -> "tree" row pushes an eager-size reduce off the
+    arena onto the binomial tree (group-coherently: the arena declines
+    via its meta round, counted in coll_sm_fallbacks)."""
+    table(_doc([
+        _row(transport="shm", collective="sm_reduce", algorithm="tree"),
+    ]))
+
+    def prog(comm):
+        return comm.reduce(np.full(64, 1.0), algorithm="sm")
+
+    f0 = mpit.pvar_read("coll_sm_fallbacks")
+    res = run_shm_world(prog, 2)
+    np.testing.assert_allclose(res[0], np.full(64, 2.0))
+    assert res[1] is None
+    assert mpit.pvar_read("coll_sm_fallbacks") > f0
+
+
+def test_alltoall_pairwise_row_declines_inside_arena(table):
+    """A tuned "pairwise" alltoall row must not skip the arena's group
+    negotiation (band skew on ragged payloads could split the group):
+    the rank enters with no payload, everyone lands on pairwise
+    together — no arena hit, one negotiated fallback, full parity."""
+    table(_doc([
+        _row(transport="shm", collective="alltoall",
+             algorithm="pairwise"),
+    ]))
+
+    def prog(comm):
+        blocks = [np.full(16, float(comm.rank * 10 + d)) for d in range(2)]
+        return comm.alltoall(blocks)
+
+    h0 = mpit.pvar_read("coll_sm_hits")
+    f0 = mpit.pvar_read("coll_sm_fallbacks")
+    th0 = mpit.pvar_read("tuned_table_hits")
+    res = run_shm_world(prog, 2)
+    for r, out in enumerate(res):
+        np.testing.assert_array_equal(
+            np.asarray(out), np.stack([np.full(16, float(q * 10 + r))
+                                       for q in range(2)]))
+    assert mpit.pvar_read("tuned_table_hits") > th0
+    assert mpit.pvar_read("coll_sm_hits") == h0, \
+        "tuned pairwise row still rode the arena"
+    assert mpit.pvar_read("coll_sm_fallbacks") > f0
+
+
+def test_tune_arena_capacity_mirror():
+    """tools/tune.py's sm size cap must track coll_sm's real slot
+    arithmetic — a drift would make the sweep measure the wire fallback
+    under the 'sm' label."""
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    try:
+        import tune
+    finally:
+        sys.path.pop(0)
+    for p in (2, 3, 4, 8):
+        slot = ((coll_sm._ARENA_BYTES - coll_sm._LINE * p) // p) \
+            // coll_sm._LINE * coll_sm._LINE
+        assert tune._arena_capacity(p) == slot - coll_sm._META_MAX
+
+
+# -- three-level hierarchy ---------------------------------------------------
+
+
+def test_three_level_parity_with_injected_keys():
+    """NUMA -> node -> DCN-leaders on 8 local ranks (2 nodes x 2 NUMA
+    x 2): allreduce/bcast/reduce/allgather/barrier parity."""
+    def prog(comm):
+        h = topology.HierarchicalComm(comm, node_key=lambda r: r // 4,
+                                      numa_key=lambda r: (r // 2) % 2)
+        x = np.arange(6.0) + comm.rank
+        out = {"ar": h.allreduce(x),
+               "bc": h.bcast(np.full(3, 9.0) if comm.rank == 5 else None,
+                             root=5),
+               "rd": h.reduce(x, root=3),
+               "ag": h.allgather(np.full(2, float(comm.rank))),
+               "sizes": (h.numa.size,
+                         None if h.node_leaders is None
+                         else h.node_leaders.size,
+                         None if h.dcn_leaders is None
+                         else h.dcn_leaders.size)}
+        h.barrier()
+        assert h.n_nodes == 2
+        return out
+
+    res = run_local(prog, 8)
+    want = np.arange(6.0) * 8 + sum(range(8))
+    for r, o in enumerate(res):
+        np.testing.assert_allclose(o["ar"], want)
+        np.testing.assert_array_equal(o["bc"], np.full(3, 9.0))
+        if r == 3:
+            np.testing.assert_allclose(o["rd"], want)
+        else:
+            assert o["rd"] is None
+        np.testing.assert_array_equal(
+            np.asarray(o["ag"]),
+            np.stack([np.full(2, float(q)) for q in range(8)]))
+        assert o["sizes"][0] == 2
+    # NUMA leaders (0,2,4,6) sit in 2-member node tiers; node leaders
+    # (0,4) in the 2-member DCN tier; everyone else in neither
+    assert [o["sizes"][1] for o in res] == [2, None, 2, None,
+                                            2, None, 2, None]
+    assert [o["sizes"][2] for o in res] == [2, None, None, None,
+                                            2, None, None, None]
+
+
+def test_three_level_routes_dcn_level_through_resolver(table):
+    """Each hierarchy level's auto call keys the resolver with its OWN
+    communicator: pin a (local, P=2, allreduce) row and the DCN-leader
+    tier's allreduce cites it (one hit per DCN member), while the
+    NUMA/node tiers (reduce/bcast) key their own decisions."""
+    table(_doc([_row(algorithm="ring")]))
+
+    def prog(comm):
+        h = topology.HierarchicalComm(comm, node_key=lambda r: r // 2,
+                                      numa_key=lambda r: 0)
+        return h.allreduce(np.ones(4, np.float32))
+
+    h0 = mpit.pvar_read("tuned_table_hits")
+    for out in run_local(prog, 4):
+        np.testing.assert_allclose(out, np.full(4, 4.0))
+    assert mpit.pvar_read("tuned_table_hits") - h0 == 2  # the 2 DCN leaders
+    assert tuning.last_decision()["algorithm"] == "ring"
+
+
+def test_two_level_hierarchy_unchanged():
+    def prog(comm):
+        h = topology.HierarchicalComm(comm, node_key=lambda r: r // 2)
+        assert h.numa is None and h.dcn_leaders is None
+        return h.allreduce(np.ones(4))
+
+    for out in run_local(prog, 4):
+        np.testing.assert_allclose(out, np.full(4, 4.0))
+
+
+def test_multihost_node_key_single_process():
+    """Without a multi-process jax runtime every rank lands on node 0 —
+    the honest single-host truth the docstring promises."""
+    def prog(comm):
+        key = topology.multihost_node_key(comm)
+        return [key(r) for r in range(comm.size)]
+
+    assert run_local(prog, 3) == [[0, 0, 0]] * 3

@@ -21,6 +21,16 @@ python -m compileall -q mpi_tpu tools examples benchmarks tests bench.py
 echo "check.sh: mpilint over examples/ + mpi_tpu/ (incl. compress.py, membership.py, serve.py)"
 python tools/mpilint.py examples mpi_tpu
 
+echo "check.sh: tune.py --check over committed tuning tables"
+tables=$(ls benchmarks/results/tuning/*.json 2>/dev/null || true)
+if [ -n "$tables" ]; then
+    # shellcheck disable=SC2086 - word-splitting the glob is the point
+    python tools/tune.py --check $tables
+else
+    echo "check.sh: no committed tuning tables — step skipped" \
+         "(generate one with: python bench.py --tune)"
+fi
+
 if [ "${1:-}" != "" ]; then
     echo "check.sh: tier1_guard on $1"
     python tools/tier1_guard.py "$1"
