@@ -561,12 +561,22 @@ if __name__ == "__main__":
         # --serve (ISSUE 7) swaps in the resident-pool leg: continuous
         # SIGKILL against a live world server, asserting worlds/sec
         # never reaches zero and every lease completes or raises a
-        # named FT error.
+        # named FT error.  --links (ISSUE 10) swaps in the link-fault
+        # leg: connection resets against a 3-rank socket world under a
+        # mixed-collective stream, asserting bit-parity with an
+        # uninjected run, zero ProcFailedError, link_reconnects >=
+        # resets, and that a genuine SIGKILL is still diagnosed within
+        # the detection bound; --no-healing is the honest "pre" run
+        # (link_retry_timeout_s=0, the same resets terminal).
         from benchmarks import chaos
 
         args = ["--quick"] if "--quick" in sys.argv[1:] else []
         if "--serve" in sys.argv[1:]:
             args.append("--serve")
+        if "--links" in sys.argv[1:]:
+            args.append("--links")
+        if "--no-healing" in sys.argv[1:]:
+            args.append("--no-healing")
         sys.exit(chaos.main(args))
     if "--serve-bench" in sys.argv[1:]:
         # world-churn leg (ISSUE 7): resident world server vs cold

@@ -200,6 +200,30 @@ class WorldFT:
             self.failed.add(world_rank)
         _mpit.count(proc_failed=1)
 
+    def link_suspect(self, peer: int) -> bool:
+        """PEER-fault verdict for the socket link layer's fault
+        classification (mpi_tpu/resilience.py): True when ``peer`` is
+        already in the failed set OR its heartbeat is stale past the
+        detection bound right now — the direct read covers the window
+        where the detector thread has the evidence but has not ticked
+        yet, so a reconnect loop never spends its budget courting a
+        corpse.  Fresh heartbeat evidence (a stamp that moved since the
+        detector last looked) is an immediate NOT-suspect verdict."""
+        with self._lock:
+            if peer in self.failed:
+                return True
+        last = self._last.get(peer)
+        if last is None:
+            return False  # self, or an unknown rank: no liveness claim
+        stamp, changed = last
+        try:
+            cur = self._liveness.stamp(peer)
+        except Exception:  # pragma: no cover - substrate tearing down
+            return False
+        if cur is not None and cur != stamp:
+            return False
+        return time.monotonic() - changed > self.detect_timeout_s
+
     def failed_snapshot(self) -> set:
         """Consistent copy of the failed set: callers iterate/intersect
         it, and an unlocked copy racing the detector's add() can raise

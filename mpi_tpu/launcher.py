@@ -221,6 +221,17 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                              "dedicated progress engine per rank — "
                              "background completion for nonblocking ops "
                              "(mpi_tpu/progress.py)")
+    parser.add_argument("--link-retry-timeout", type=float, default=None,
+                        metavar="S",
+                        help="socket link-healing budget for every rank "
+                             "(MPI_TPU_LINK_RETRY_S -> the "
+                             "link_retry_timeout_s cvar): a send-path "
+                             "OSError whose peer is not failure-"
+                             "suspected reconnects with backoff for up "
+                             "to this many seconds, replaying unacked "
+                             "frames (mpi_tpu/resilience.py).  Keep it "
+                             "below fault_detect_timeout_s; 0 disables "
+                             "healing (every link fault terminal)")
     parser.add_argument("--tuning-table", default=None, metavar="PATH",
                         help="per-machine tuned-dispatch table for every "
                              "rank (MPI_TPU_TUNING_TABLE): measured "
@@ -238,6 +249,8 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         env_extra["MPI_TPU_VERIFY"] = "1"
     if args.progress is not None:
         env_extra["MPI_TPU_PROGRESS"] = args.progress
+    if args.link_retry_timeout is not None:
+        env_extra["MPI_TPU_LINK_RETRY_S"] = str(args.link_retry_timeout)
     if args.tuning_table is not None:
         env_extra["MPI_TPU_TUNING_TABLE"] = os.path.abspath(
             args.tuning_table)

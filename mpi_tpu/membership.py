@@ -348,7 +348,20 @@ def survivor_transition(transport: Transport, epoch: int,
     the new epoch, require replaced slots to present it (their corpse's
     leftover endpoints become unreachable), drop cached connections/
     rings to them, and (shm) re-stamp our readiness so stale stragglers
-    doing fresh opens read the skew."""
+    doing fresh opens read the skew.
+
+    RESUME vs REJOIN (ISSUE 10): the socket link layer's resume
+    handshake (mpi_tpu/resilience.py — replay unacked frames over a
+    rebuilt connection) heals faults WITHIN one membership epoch: same
+    incarnation, same streams.  An epoch transition is the boundary
+    where resume must NOT happen — the replaced slot's replacement is a
+    different incarnation with fresh streams, so membership_invalidate
+    purges the per-dest resilience state (retained replay window, seq
+    counters, delivery marks) along with the connections.  A stale
+    incarnation attempting to resume across the boundary is already
+    rejected by the epoch-checked hello (min_peer_epoch / EpochSkew),
+    and the purge guarantees the survivor offers a rejoiner
+    ``resume(0)`` — never the corpse's replay."""
     transport.epoch = max(transport.epoch, int(epoch))
     for d in dead:
         transport.min_peer_epoch[int(d)] = int(epoch)

@@ -46,7 +46,9 @@ class _Counters:
                  "prog_wakeups", "prog_completions", "prog_idle_parks",
                  "rejoins", "epoch_skews",
                  "comp_saved", "comp_fallbacks",
-                 "tuned_hits", "tuned_fallbacks")
+                 "tuned_hits", "tuned_fallbacks",
+                 "link_reconnects", "link_replayed", "link_masked",
+                 "link_retained")
 
     def __init__(self) -> None:
         self.sends = 0
@@ -81,6 +83,10 @@ class _Counters:
         self.comp_fallbacks = 0
         self.tuned_hits = 0
         self.tuned_fallbacks = 0
+        self.link_reconnects = 0
+        self.link_replayed = 0
+        self.link_masked = 0
+        self.link_retained = 0
 
 
 counters = _Counters()  # incremented by communicator.py / codec.py (count())
@@ -103,7 +109,11 @@ def count(sends: int = 0, send_bytes: int = 0, recvs: int = 0,
           bytes_compressed_saved: int = 0,
           compress_fallbacks: int = 0,
           tuned_table_hits: int = 0,
-          tuned_table_fallbacks: int = 0) -> None:
+          tuned_table_fallbacks: int = 0,
+          link_reconnects: int = 0,
+          link_frames_replayed: int = 0,
+          link_faults_masked: int = 0,
+          link_bytes_retained: int = 0) -> None:
     """Thread-safe increment (rank-threads of the local backend share
     this process's counters; unsynchronized += would lose updates)."""
     with _lock:
@@ -139,6 +149,10 @@ def count(sends: int = 0, send_bytes: int = 0, recvs: int = 0,
         counters.comp_fallbacks += compress_fallbacks
         counters.tuned_hits += tuned_table_hits
         counters.tuned_fallbacks += tuned_table_fallbacks
+        counters.link_reconnects += link_reconnects
+        counters.link_replayed += link_frames_replayed
+        counters.link_masked += link_faults_masked
+        counters.link_retained += link_bytes_retained
 
 _PVARS: Dict[str, Callable[[], int]] = {
     "msgs_sent": lambda: counters.sends,
@@ -229,6 +243,19 @@ _PVARS: Dict[str, Callable[[], int]] = {
     # constants (asserted in tests/test_tuning.py).
     "tuned_table_hits": lambda: counters.tuned_hits,
     "tuned_table_fallbacks": lambda: counters.tuned_fallbacks,
+    # resilient socket links (mpi_tpu/resilience.py + transport/
+    # socket.py): connections re-established after an ESTABLISHED one
+    # was lost (link faults healed by reconnect, not initial setup),
+    # retained frames replayed through a resume handshake, send-path
+    # OSErrors classified as link faults and masked end-to-end (the
+    # caller's send completed despite the fault), and the bytes copied
+    # into the retained replay window (the honest price of
+    # replay-after-reset — the user-space analogue of the kernel
+    # socket buffer a reset discards).
+    "link_reconnects": lambda: counters.link_reconnects,
+    "link_frames_replayed": lambda: counters.link_replayed,
+    "link_faults_masked": lambda: counters.link_masked,
+    "link_bytes_retained": lambda: counters.link_retained,
 }
 
 
@@ -317,8 +344,10 @@ def _ensure_builtin_cvars() -> None:
     from . import io as _io
     from . import membership as _membership
     from . import progress as _prog
+    from . import resilience as _resilience
     from . import tuning as _tuning
     from .transport import shm as _shm
+    from .transport import socket as _socket
     from .verify import state as _vstate
 
     def _set_sm_arena(v):
@@ -519,6 +548,75 @@ def _ensure_builtin_cvars() -> None:
             "(mpi_tpu.compress.reset_residuals clears).  Must agree "
             "across the group: the resolved k rides the verifier "
             "signature's counts field")
+
+        def _set_link_retry(v):
+            if float(v) < 0:
+                raise ValueError(
+                    "link_retry_timeout_s must be >= 0 (0 = healing off)")
+            _resilience._RETRY_TIMEOUT_S = float(v)
+
+        def _set_link_window(v):
+            if int(v) <= 0:
+                raise ValueError("link_window_bytes must be > 0")
+            _resilience._WINDOW_BYTES = int(v)
+
+        def _set_connect_retry(v):
+            if float(v) < 0:
+                raise ValueError(
+                    "connect_retry_timeout_s must be >= 0 "
+                    "(0 = first-failure raise)")
+            _resilience._CONNECT_RETRY_TIMEOUT_S = float(v)
+
+        def _set_epoch_grace(v):
+            if float(v) < 0:
+                raise ValueError("epoch_grace_s must be >= 0")
+            # one knob, both byte-stream transports: the grace window
+            # exists wherever an epoch stamp is compared (socket hello
+            # acks, shm readiness files)
+            _socket._EPOCH_GRACE_S = float(v)
+            _shm._EPOCH_GRACE_S = float(v)
+
+        _CVARS["link_retry_timeout_s"] = (
+            lambda: _resilience._RETRY_TIMEOUT_S, _set_link_retry,
+            "socket link-healing budget (mpi_tpu/resilience.py): a "
+            "send-path OSError whose destination is NOT failure-"
+            "suspected enters a reconnect loop (exponential backoff + "
+            "jitter, resume handshake, retained-frame replay) bounded "
+            "by this many seconds; also the no-ack-progress bound of a "
+            "full retained window.  Keep it BELOW "
+            "fault_detect_timeout_s so a dead peer resolves to "
+            "ProcFailedError, never a masked hang.  0 disables healing "
+            "(every link fault is terminal, frames stream unretained — "
+            "the pre-resilience behavior; set it BEFORE the world's "
+            "first send: frames sent while healing was off were never "
+            "retained and cannot be replayed by a later enable).  "
+            "MPI_TPU_LINK_RETRY_S seeds the default")
+        _CVARS["link_window_bytes"] = (
+            lambda: _resilience._WINDOW_BYTES, _set_link_window,
+            "per-destination retained-frame window of the resilient "
+            "socket link: sends block once this many unacked bytes "
+            "are outstanding (one oversized frame may proceed alone); "
+            "the window is what a reconnect replays, so it bounds both "
+            "memory and replay time.  MPI_TPU_LINK_WINDOW_BYTES seeds "
+            "the default")
+        _CVARS["connect_retry_timeout_s"] = (
+            lambda: _resilience._CONNECT_RETRY_TIMEOUT_S,
+            _set_connect_retry,
+            "initial server-connect retry budget of mpi_tpu.connect() "
+            "/ serve.ServerClient: ConnectionRefusedError (the server "
+            "is still binding) is retried with backoff + jitter for "
+            "this long instead of raising on first failure.  0 "
+            "restores first-failure raise.  MPI_TPU_CONNECT_RETRY_S "
+            "seeds the default")
+        _CVARS["epoch_grace_s"] = (
+            lambda: _socket._EPOCH_GRACE_S, _set_epoch_grace,
+            "grace window before an ahead-of-us membership epoch is "
+            "declared EpochSkewError (socket hello acks AND shm "
+            "readiness stamps): a healthy member applying a broadcast "
+            "epoch transition milliseconds late keeps retrying with "
+            "its own epoch re-read until the grace expires; a "
+            "genuinely ousted straggler never catches up and still "
+            "raises.  MPI_TPU_EPOCH_GRACE_S seeds the default")
 
         def _set_rejoin_timeout(v):
             if float(v) <= 0:

@@ -44,6 +44,14 @@ hot path: the entire feature is one ``_progress is None`` attribute
 test per operation and the ``progress_*`` pvars stay 0 — asserted by
 tests/test_progress.py and ``bench.py --verify-overhead --progress``.
 
+Link faults (ISSUE 10): the engine is oblivious to socket link healing
+by construction — engine-owned completions consume from the MAILBOX,
+and the resilient link layer (mpi_tpu/resilience.py) delivers into the
+mailbox only full, deduplicated, in-order frames regardless of how
+many reconnect/replay rounds the wire needed.  A posted irecv whose
+sender's connection is torn and rebuilt mid-flight completes normally
+(tests/test_resilience.py::test_engine_owned_recv_survives_reconnect).
+
 Cost model (README "Async progress"): the engine's wakeups are priced
 by the ``progress_wakeups`` / ``progress_completions`` /
 ``progress_idle_parks`` pvars.  On a box with spare cores the engine

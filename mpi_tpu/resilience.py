@@ -1,0 +1,429 @@
+"""Link resilience: sequenced frames, cumulative acks, bounded replay.
+
+The socket transport's failure story used to conflate two different
+faults: a mid-send ``OSError`` (a *link* fault — TCP reset, a dropped
+connection, an injected chaos event) raised the same ``TransportError``
+as a dead peer, so a transient reset either killed the sender or, under
+fault tolerance, shrank a perfectly healthy rank out of the world.
+Production collective stacks separate the two (NCCL's transport retry,
+UCX's error-handling endpoints): a **link** fault is healed
+transparently by reconnecting and replaying what the peer did not
+receive, while a **peer** fault keeps today's diagnosed
+``ProcFailedError`` path.  This module is the transport-agnostic state
+machine for the healing half; transport/socket.py does the wire surgery.
+
+Design (the user-space analogue of the kernel TCP send buffer):
+
+* every data frame to a destination carries a **per-destination
+  sequence number** (monotone from 1, assigned in wire order under the
+  per-dest send lock);
+* the sender **retains a copy of each in-flight frame** in a bounded
+  window (``link_window_bytes`` mpit cvar) until the receiver's
+  **cumulative ack** covers it.  Acks are piggybacked on every data
+  frame headed the other way and flushed by a per-transport idle
+  flusher, so one-way streams are acked too.  The copy is deliberate —
+  the caller may reuse its buffer the moment ``send`` returns (MPI
+  buffered-send semantics), so replay-after-reset is only bit-exact
+  from a snapshot; this is exactly the copy the kernel socket buffer
+  made before a reset discarded it.  ``link_bytes_retained`` counts it
+  honestly;
+* the receiver **dedups by (src, seq)**: only the next contiguous
+  sequence is delivered, anything at-or-below the high-water mark is a
+  replay duplicate and dropped, and a *gap* is a protocol error (TCP
+  FIFO + replay-from-last-delivered make it impossible in a healthy
+  stream), answered loudly rather than by silent reordering;
+* the connection handshake's hello-ack carries ``resume(last
+  delivered seq)``, so a rebuilt connection prunes the acked prefix of
+  the retained window and **replays only unacked frames** — frames are
+  neither lost nor duplicated across a teardown.
+
+What this module does NOT decide: when to reconnect and what a fault
+means.  Classification lives with the transport (transport/socket.py
+``_heal_link_locked``): a peer in the FT suspect set — or past its
+heartbeat bound, ``mpi_tpu.ft.WorldFT.link_suspect`` — keeps the
+ProcFailedError path unchanged; everything else enters a reconnect
+loop with exponential backoff + jitter bounded by the
+``link_retry_timeout_s`` cvar, whose default sits BELOW
+``fault_detect_timeout_s`` so a genuinely dead peer still resolves to
+``ProcFailedError`` and is never masked into a hang.
+
+The shm transport has no link-fault class on purpose: its "link" is a
+mapped shared-memory ring — memory does not reset mid-frame, and every
+shm fault is already a peer/process fault (README "Failure semantics").
+"""
+
+from __future__ import annotations
+
+import os
+import random
+import threading
+import time
+from collections import deque
+from typing import Callable, Deque, Dict, Iterator, List, Optional, Tuple
+
+from . import mpit as _mpit
+from .transport.base import TransportError
+
+# Reconnect budget for ONE link fault: total time the sender may spend
+# re-establishing a torn connection (and the no-ack-progress bound of a
+# full retained window) before the fault is promoted to a peer fault
+# (TransportError -> ProcFailedError under FT).  Deliberately below the
+# fault_detect_timeout_s default (5s): a dead peer must resolve to the
+# DIAGNOSED path, never to a masked retry hang.  0 disables healing
+# entirely (every link fault is terminal — the pre-resilience behavior,
+# and the honest "pre" leg of bench.py --chaos --links).
+# mpit cvar: link_retry_timeout_s; env default: MPI_TPU_LINK_RETRY_S.
+_RETRY_TIMEOUT_S = float(os.environ.get("MPI_TPU_LINK_RETRY_S", "4.0"))
+
+# Retained-window ceiling per destination: sends block (in FT-checked
+# slices) once this many unacked bytes are outstanding, and a window
+# that makes no ack progress for link_retry_timeout_s is itself a link
+# verdict.  A single frame larger than the window is allowed once the
+# window is otherwise empty (the classic streaming-window rule).
+# mpit cvar: link_window_bytes; env default: MPI_TPU_LINK_WINDOW_BYTES.
+_WINDOW_BYTES = int(os.environ.get("MPI_TPU_LINK_WINDOW_BYTES",
+                                   str(64 << 20)))
+
+# Initial-connect retry budget for control-plane clients
+# (serve.ServerClient / mpi_tpu.connect): ConnectionRefusedError is
+# retried with the same backoff schedule for this long — the server may
+# simply still be binding.  0 restores first-failure raise.
+# mpit cvar: connect_retry_timeout_s; env: MPI_TPU_CONNECT_RETRY_S.
+_CONNECT_RETRY_TIMEOUT_S = float(
+    os.environ.get("MPI_TPU_CONNECT_RETRY_S", "10.0"))
+
+# Backoff schedule shape (shared by link reconnect and client connect):
+# exponential with full jitter, capped.  Values are generous for a
+# loopback box; the cap keeps a long outage polling at a human cadence.
+_BACKOFF_BASE_S = 0.02
+_BACKOFF_FACTOR = 2.0
+_BACKOFF_CAP_S = 0.5
+
+_WINDOW_POLL_S = 0.05  # slice of the window-full wait (FT re-checks)
+
+
+def backoff_delays(base: float = _BACKOFF_BASE_S,
+                   factor: float = _BACKOFF_FACTOR,
+                   cap: float = _BACKOFF_CAP_S,
+                   rng: Optional[random.Random] = None) -> Iterator[float]:
+    """Endless exponential-backoff-with-full-jitter schedule: the k-th
+    delay is uniform in [0, min(cap, base * factor**k)].  Full jitter
+    (AWS-style) rather than +/- fuzz: simultaneous retriers (every rank
+    of a world saw the same reset) must not reconverge on the same
+    retry instants."""
+    rng = rng or random
+    ceiling = base
+    while True:
+        yield rng.uniform(0.0, ceiling)
+        ceiling = min(cap, ceiling * factor)
+
+
+def retry_connect(dial: Callable[[], "object"],
+                  timeout_s: Optional[float] = None,
+                  rng: Optional[random.Random] = None):
+    """Run ``dial()`` (a socket factory) retrying ConnectionRefusedError
+    with backoff + jitter for up to ``timeout_s`` (default: the
+    connect_retry_timeout_s cvar).  The refused-connection case is the
+    server-still-binding race; any OTHER failure propagates immediately
+    (an unroutable host or a protocol error is not healed by patience)."""
+    budget = _CONNECT_RETRY_TIMEOUT_S if timeout_s is None else timeout_s
+    deadline = time.monotonic() + budget
+    delays = backoff_delays(rng=rng)
+    while True:
+        try:
+            return dial()
+        except ConnectionRefusedError:
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                raise
+            time.sleep(min(next(delays), remaining))
+
+
+class _TxState:
+    """Per-destination sender stream: next seq, the retained unacked
+    frames (seq, header word, body snapshot), and the cumulative ack
+    high-water mark received back from the peer."""
+
+    __slots__ = ("seq", "acked", "retained", "retained_bytes",
+                 "was_connected")
+
+    def __init__(self) -> None:
+        self.seq = 0          # last sequence number assigned
+        self.acked = 0        # highest cumulative ack received
+        self.retained: Deque[Tuple[int, int, bytes]] = deque()
+        self.retained_bytes = 0
+        # whether a connection to this destination was ever established:
+        # distinguishes a RE-connect (counted in link_reconnects) from
+        # the world's initial connection setup
+        self.was_connected = False
+
+
+class _RxState:
+    """Per-source receiver stream: the contiguous-delivery high-water
+    mark and the ack bookkeeping the flusher consults."""
+
+    __slots__ = ("delivered", "ack_sent")
+
+    def __init__(self) -> None:
+        self.delivered = 0    # highest contiguously delivered seq
+        self.ack_sent = 0     # highest ack value put on the wire
+
+
+class LinkState:
+    """The per-transport resilience state: one tx stream per
+    destination, one rx stream per source, a condition variable for the
+    retained-window waiters and the ack flusher.  All methods are
+    thread-safe; wire-order-sensitive ones (seq assignment, resume)
+    additionally require the transport's per-dest send lock, which is
+    what serializes writes to one connection anyway."""
+
+    def __init__(self, world_size: int) -> None:
+        self._tx: Dict[int, _TxState] = {}
+        self._rx: Dict[int, _RxState] = {}
+        self._lock = threading.Lock()
+        self._cv = threading.Condition(self._lock)
+        # sources with delivered > ack_sent (the flusher's work list)
+        self._ack_pending: set = set()
+        # per-peer STREAM GENERATION, bumped by purge_peer: a reader
+        # thread still draining a replaced slot's old connection
+        # captures the generation at handshake time, and its acks/
+        # frames are dropped once the slot was purged — otherwise one
+        # stale piggybacked ack (e.g. 57) applied to the replacement's
+        # fresh tx stream would make every real ack (1, 2, ...) read
+        # as stale, the retained window would never prune, and a
+        # HEALTHY rejoiner would be declared link-dead.
+        self._gen: Dict[int, int] = {}
+        self._closed = False
+
+    # -- tiny accessors ----------------------------------------------------
+
+    def _tx_of(self, dest: int) -> _TxState:
+        st = self._tx.get(dest)
+        if st is None:
+            st = self._tx[dest] = _TxState()
+        return st
+
+    def _rx_of(self, src: int) -> _RxState:
+        st = self._rx.get(src)
+        if st is None:
+            st = self._rx[src] = _RxState()
+        return st
+
+    def delivered(self, src: int) -> int:
+        """Contiguous-delivery high-water mark for ``src`` — what the
+        hello-ack's resume field reports to a (re)connecting peer."""
+        with self._lock:
+            return self._rx_of(src).delivered
+
+    def peer_gen(self, rank: int) -> int:
+        """Current stream generation of ``rank`` (see ``_gen``): reader
+        threads capture it at handshake and present it with every
+        ack/frame, so a purge invalidates them wholesale."""
+        with self._lock:
+            return self._gen.get(rank, 0)
+
+    def retained_bytes(self, dest: int) -> int:
+        with self._lock:
+            return self._tx_of(dest).retained_bytes
+
+    def mark_connected(self, dest: int) -> bool:
+        """Record an established connection; True iff this replaced an
+        EARLIER established one (i.e. a reconnect, not initial setup)."""
+        with self._lock:
+            st = self._tx_of(dest)
+            was = st.was_connected
+            st.was_connected = True
+            return was
+
+    # -- sender side -------------------------------------------------------
+
+    def wait_window(self, dest: int, nbytes: int,
+                    suspect: Callable[[int], bool],
+                    closing: Callable[[], bool]) -> None:
+        """Block until ``nbytes`` more retained bytes fit the window (or
+        the window is empty — one oversized frame may always proceed).
+        Re-checks the FT suspect verdict every slice and bounds the
+        no-ack-progress wait by link_retry_timeout_s: a peer that stops
+        acking for that long IS a link verdict, promoted to
+        TransportError here (-> ProcFailedError under FT).
+
+        With healing DISABLED (link_retry_timeout_s = 0) there is no
+        window at all: frames are not retained (socket.py streams them
+        directly, the pre-resilience path), so enforcing a floor here
+        would declare a healthy link dead on any 100ms receiver stall
+        — the kernel socket buffer is the only backpressure, exactly
+        as before this layer existed."""
+        if _RETRY_TIMEOUT_S <= 0:
+            return
+        deadline = time.monotonic() + _RETRY_TIMEOUT_S
+        with self._cv:
+            while True:
+                st = self._tx_of(dest)
+                if (st.retained_bytes == 0
+                        or st.retained_bytes + nbytes <= _WINDOW_BYTES):
+                    return
+                if self._closed or closing():
+                    raise TransportError(
+                        "transport closed while waiting for link window")
+                progress_mark = st.acked
+                self._cv.wait(_WINDOW_POLL_S)
+                if st.acked > progress_mark:
+                    deadline = time.monotonic() + _RETRY_TIMEOUT_S
+                    continue
+                if suspect(dest):
+                    raise TransportError(
+                        f"peer {dest} declared failed while its link "
+                        f"window was full ({st.retained_bytes} unacked "
+                        f"bytes)")
+                if time.monotonic() > deadline:
+                    raise TransportError(
+                        f"link to rank {dest}: no ack progress for "
+                        f"{_RETRY_TIMEOUT_S}s with {st.retained_bytes} "
+                        f"retained bytes (window {_WINDOW_BYTES}); "
+                        f"declaring the link dead")
+
+    def tx_retain(self, dest: int, word: int, body: bytes) -> int:
+        """Assign the next sequence number for ``dest`` and retain the
+        frame snapshot until acked.  Caller holds the per-dest send
+        lock (seq order must equal wire order)."""
+        with self._lock:
+            st = self._tx_of(dest)
+            st.seq += 1
+            st.retained.append((st.seq, word, body))
+            st.retained_bytes += len(body)
+            _mpit.count(link_bytes_retained=len(body))
+            return st.seq
+
+    def tx_next_seq(self, dest: int) -> int:
+        """Sequence-only assignment (healing disabled): the receiver
+        still requires contiguous seqs, but nothing is retained —
+        there is no replay to feed.  Caller holds the send lock."""
+        with self._lock:
+            st = self._tx_of(dest)
+            st.seq += 1
+            return st.seq
+
+    def tx_ack(self, dest: int, ack: int,
+               gen: Optional[int] = None) -> None:
+        """Apply a cumulative ack from ``dest`` (piggybacked or
+        standalone): prune the retained prefix, wake window waiters.
+        Acks are monotone; a stale value (a replayed header) is a
+        no-op.  ``gen`` is the reader's captured stream generation —
+        an ack arriving on a connection from a since-purged (replaced)
+        incarnation is dropped whole, not applied to the
+        replacement's fresh stream."""
+        with self._cv:
+            if gen is not None and gen != self._gen.get(dest, 0):
+                return
+            st = self._tx_of(dest)
+            if ack <= st.acked:
+                return
+            st.acked = ack
+            retained = st.retained
+            while retained and retained[0][0] <= ack:
+                _, _, body = retained.popleft()
+                st.retained_bytes -= len(body)
+            self._cv.notify_all()
+
+    def resume(self, dest: int, last_delivered: int
+               ) -> List[Tuple[int, int, bytes]]:
+        """Reconnect-time resume: the peer reported the last seq it
+        delivered from us — treat it as an ack (frames at or below it
+        arrived; replaying them would only be dropped as dups) and
+        return the retained frames BEYOND it for replay, in seq order.
+        Caller holds the per-dest send lock."""
+        self.tx_ack(dest, last_delivered)
+        with self._lock:
+            return list(self._tx_of(dest).retained)
+
+    # -- receiver side -----------------------------------------------------
+
+    def rx_gate(self, src: int, seq: int, deliver: Callable[[], None],
+                gen: Optional[int] = None) -> bool:
+        """Deliver-or-drop decision for an arriving data frame, atomic
+        with the delivery itself (two reader threads of one src — the
+        dying connection's and its replacement's — may race here, and
+        FIFO into the mailbox must follow seq order).  Returns True iff
+        delivered.  A frame arriving on a since-purged (replaced)
+        incarnation's connection (``gen`` mismatch) is dropped whole —
+        its stream died with the slot.  A seq GAP is a protocol
+        violation (impossible under TCP FIFO + resume-replay): raised
+        loudly, never reordered around."""
+        with self._cv:
+            if gen is not None and gen != self._gen.get(src, 0):
+                return False
+            st = self._rx_of(src)
+            if seq <= st.delivered:
+                return False  # replay duplicate: already delivered
+            if seq != st.delivered + 1:
+                raise TransportError(
+                    f"sequence gap from rank {src}: got frame {seq}, "
+                    f"expected {st.delivered + 1} — sequenced-link "
+                    f"protocol violation")
+            deliver()
+            st.delivered = seq
+            if st.delivered > st.ack_sent:
+                self._ack_pending.add(src)
+                self._cv.notify_all()
+            return True
+
+    def peek_ack(self, src: int) -> Optional[int]:
+        """The ack value a standalone ACK frame to ``src`` should carry
+        right now, or None when the peer already has it."""
+        with self._lock:
+            st = self._rx_of(src)
+            return st.delivered if st.delivered > st.ack_sent else None
+
+    def note_ack_sent(self, src: int, value: int) -> None:
+        """Record ``value`` as on the wire (call AFTER the send
+        succeeded — an optimistic mark on a failed send would starve
+        the peer's window)."""
+        with self._lock:
+            st = self._rx_of(src)
+            if value > st.ack_sent:
+                st.ack_sent = value
+            if st.ack_sent >= st.delivered:
+                self._ack_pending.discard(src)
+
+    def piggyback_ack(self, src: int) -> int:
+        """Ack value to stamp into a data frame headed to ``src``.
+        Deliberately does NOT mark it sent — the frame may still fail
+        and be replayed with a fresher value; the flusher's standalone
+        ack is simply skipped by the peer's monotone tx_ack if the
+        piggyback beat it."""
+        with self._lock:
+            return self._rx_of(src).delivered
+
+    def wait_ack_pending(self, timeout: float) -> List[int]:
+        """Flusher park: block until some source has undelivered acks
+        (or timeout); returns the pending sources (cleared lazily by
+        note_ack_sent)."""
+        with self._cv:
+            if not self._ack_pending and not self._closed:
+                self._cv.wait(timeout)
+            return sorted(self._ack_pending)
+
+    # -- membership / lifecycle -------------------------------------------
+
+    def purge_peer(self, rank: int) -> None:
+        """Slot replacement (membership_invalidate): the old
+        incarnation's sequenced streams die with it.  Dropping the tx
+        state discards its retained replay window (a rejoiner must
+        NEVER see a stale replay: its streams start at seq 1) and
+        resets our seq; dropping the rx state accepts the
+        replacement's fresh stream from 1.  The generation bump
+        invalidates every reader thread still draining the OLD
+        incarnation's connections (their captured gen goes stale, so
+        their acks/frames no-op instead of poisoning the fresh
+        streams)."""
+        with self._cv:
+            self._tx.pop(rank, None)
+            self._rx.pop(rank, None)
+            self._ack_pending.discard(rank)
+            self._gen[rank] = self._gen.get(rank, 0) + 1
+            self._cv.notify_all()
+
+    def close(self) -> None:
+        with self._cv:
+            self._closed = True
+            self._cv.notify_all()

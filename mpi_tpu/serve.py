@@ -162,6 +162,27 @@ def job_sleep(comm, seconds: float = 0.1) -> int:
     return comm.rank
 
 
+def job_allreduce_link_chaos(comm, n: int = 1024, resets: int = 2) -> float:
+    """Link-chaos lease payload (ISSUE 10): each leased rank hard-resets
+    its cached connection to the next rank ``resets`` times while
+    running allreduces — a lease must ride HEALED links (socket pool:
+    the resilient layer reconnects + replays; no ProcFailedError, no
+    wrong result).  Returns the last allreduce's checkable value.  On
+    transports without connection links (shm pool) the injector is a
+    no-op and the job degenerates to job_allreduce."""
+    import numpy as np
+
+    inject = getattr(comm._t, "_inject_link_reset", None)
+    comm.barrier()
+    out = None
+    for i in range(int(resets) + 1):
+        if inject is not None and i < int(resets) and comm.size > 1:
+            inject((comm._group[(comm.rank + 1) % comm.size]))
+        out = comm.allreduce(np.full(int(n), comm.rank + 1.0, np.float32),
+                             algorithm="ring")
+    return float(out[0])
+
+
 # -- the worker process -------------------------------------------------------
 
 
@@ -981,11 +1002,22 @@ class WorldLease:
 
 
 class ServerClient:
-    """Client handle to a resident world server (see :func:`connect`)."""
+    """Client handle to a resident world server (see :func:`connect`).
+
+    The initial connect retries ``ConnectionRefusedError`` with
+    exponential backoff + jitter for up to the ``connect_retry_timeout_s``
+    mpit cvar (mpi_tpu/resilience.py): a freshly-spawned server
+    (``launcher serve --addr-file`` races its own bind) looks exactly
+    like a refused connection, and first-failure raise forced every
+    caller to hand-roll the same sleep loop.  Any other failure — or a
+    refusal that outlives the budget — raises as before."""
 
     def __init__(self, host: str, port: int, timeout: float = 30.0) -> None:
-        self._sock = socket.create_connection((host, port),
-                                              timeout=timeout)
+        from .resilience import retry_connect
+
+        self._sock = retry_connect(
+            lambda: socket.create_connection((host, port),
+                                             timeout=timeout))
         self._sock.settimeout(None)
         self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         self._lock = threading.Lock()  # one request/response in flight

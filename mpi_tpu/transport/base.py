@@ -200,7 +200,18 @@ class Mailbox:
 
 
 class Transport(ABC):
-    """Moves payloads between world ranks; owns a Mailbox for incoming traffic."""
+    """Moves payloads between world ranks; owns a Mailbox for incoming
+    traffic.
+
+    Fault taxonomy (ISSUE 10): transports distinguish LINK faults (a
+    connection-level hiccup between two live processes — healed
+    transparently where the transport has connections to heal, see
+    transport/socket.py + mpi_tpu/resilience.py) from PEER faults (the
+    process on the other end is gone — surfaced as TransportError and
+    wrapped into ProcFailedError by the FT layer).  Transports without
+    a connection link have no link-fault class: shm's "link" is a
+    mapped ring (memory does not reset mid-frame), the local transport's
+    is a queue append."""
 
     # True only for transports that deliver payloads BY REFERENCE (the
     # in-process local transport with copy_payloads=False): callers that
@@ -277,7 +288,12 @@ class Transport(ABC):
         publishes fresh endpoints under the new epoch).  Base: nothing
         cached per peer.  Transports with per-peer connections/rings
         override; the override must exclude in-flight senders (take the
-        per-dest send lock) before tearing an endpoint down."""
+        per-dest send lock) before tearing an endpoint down — and a
+        transport with per-peer LINK-RESILIENCE state (sequenced
+        streams, retained replay windows: the socket transport,
+        mpi_tpu/resilience.py) must purge that state too, because a
+        replaced slot's rejoiner starts fresh streams at seq 1 and must
+        never be handed the corpse's replay or dedup horizon."""
 
     def progress_park(self, timeout: float) -> bool:
         """Progress-engine park hook (mpi_tpu/progress.py): block until

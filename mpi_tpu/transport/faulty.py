@@ -19,6 +19,34 @@ Wraps any Transport and injects configurable faults on the send path:
   first send addressed to a specific world rank (dies *before*
   delivering), for failure placement at an exact schedule edge.
 
+Connection-level link faults (ISSUE 10) — distinct from the payload
+faults above, these exercise the resilient link layer
+(mpi_tpu/resilience.py) of transports with real connections (socket):
+
+* ``link_reset_every`` — every k-th frame, hard-reset (RST) the cached
+  connection to its destination BEFORE any byte of the frame is
+  written (a reset between frames: the frame is lost whole and must be
+  replayed);
+* ``link_reset_midframe_every`` — every k-th frame, reset the
+  connection AFTER the header but before the body (a reset mid-frame:
+  the receiver holds a partial frame it must discard);
+* ``link_stall_every`` / ``link_stall_s`` — every k-th frame, stall
+  the link for ``link_stall_s`` seconds before sending (a slow link is
+  NOT a fault: nothing may reconnect, suspect, or error);
+* ``link_accept_drop`` — the ACCEPTOR drops this many incoming
+  connections after reading the hello, without answering (exercises
+  the connector's bounded retry).
+
+Unlike the payload faults, link faults are INSTALLED into the wrapped
+transport (``SocketTransport.install_link_faults``) and fire inside
+its send path no matter which communicator handle triggered the send —
+so a process-world rank can wrap its own live world transport purely
+to inject, while its communicators keep using the inner transport
+directly.  Transports without a connection-level link (local threads,
+shm — memory is the link) reject the kwargs with ``ValueError``.
+Injection tallies live on the wrapper (``link_resets`` /
+``link_midframe_resets`` / ``link_stalls``).
+
 The ``dropped``/``duplicated`` tallies are mpit pvars
 (``faulty_dropped`` / ``faulty_duplicated``) as well as instance
 attributes, so chaos sweeps can assert injection actually happened
@@ -53,7 +81,11 @@ class FaultyTransport(Transport):
     def __init__(self, inner: Transport, drop_every: int = 0,
                  delay_s: float = 0.0, duplicate_every: int = 0,
                  kill_after_n: int = 0,
-                 crash_on_send_to: Optional[int] = None) -> None:
+                 crash_on_send_to: Optional[int] = None,
+                 link_reset_every: int = 0,
+                 link_reset_midframe_every: int = 0,
+                 link_stall_every: int = 0, link_stall_s: float = 0.0,
+                 link_accept_drop: int = 0) -> None:
         self.inner = inner
         self.world_rank = inner.world_rank
         self.world_size = inner.world_size
@@ -72,11 +104,56 @@ class FaultyTransport(Transport):
         self.dropped = 0
         self.duplicated = 0
         self.killed = False  # read by the ft.py detector (stops beating)
+        # connection-level link faults (installed INTO the inner
+        # transport's send path — see module docstring)
+        self.link_reset_every = link_reset_every
+        self.link_reset_midframe_every = link_reset_midframe_every
+        self.link_stall_every = link_stall_every
+        self.link_stall_s = link_stall_s
+        self.link_accept_drop = link_accept_drop
+        self._link_n = 0
+        self.link_resets = 0
+        self.link_midframe_resets = 0
+        self.link_stalls = 0
+        if (link_reset_every or link_reset_midframe_every
+                or link_stall_every or link_accept_drop):
+            install = getattr(inner, "install_link_faults", None)
+            if install is None:
+                raise ValueError(
+                    f"link-fault injection needs a transport with "
+                    f"connection-level links (socket); "
+                    f"{type(inner).__name__} has none — shm/local "
+                    f"faults are process faults (memory is the link)")
+            install(self)
 
     @classmethod
     def wrapper(cls, **kwargs):
         """For run_local's transport_wrapper hook."""
         return lambda inner: cls(inner, **kwargs)
+
+    def _link_hook(self, dest: int, stage: str) -> None:
+        """Fired by the inner transport's send path: ``pre`` before any
+        byte of a frame, ``mid`` between header and body.  Frames are
+        counted once (at ``pre``); each fault kind keys off the same
+        counter so cadences compose deterministically."""
+        if stage == "pre":
+            with self._lock:
+                self._link_n += 1
+                n = self._link_n
+            if (self.link_stall_every and self.link_stall_s
+                    and n % self.link_stall_every == 0):
+                self.link_stalls += 1
+                time.sleep(self.link_stall_s)
+            if self.link_reset_every and n % self.link_reset_every == 0:
+                self.link_resets += 1
+                self.inner._inject_link_reset(dest)
+        elif stage == "mid":
+            with self._lock:
+                n = self._link_n
+            if (self.link_reset_midframe_every
+                    and n % self.link_reset_midframe_every == 0):
+                self.link_midframe_resets += 1
+                self.inner._inject_link_reset(dest)
 
     def _die(self, why: str) -> None:
         self.killed = True

@@ -79,7 +79,9 @@ _SPIN_S = float(os.environ.get(
 # SKEW (see transport/socket.py _EPOCH_GRACE_S — same rationale: a
 # broadcast epoch transition reaches peers at slightly different
 # times, and only a genuinely ousted straggler stays behind).
-_EPOCH_GRACE_S = 2.0
+# mpit cvar: epoch_grace_s (one knob writes both transports' globals);
+# env default: MPI_TPU_EPOCH_GRACE_S.
+_EPOCH_GRACE_S = float(os.environ.get("MPI_TPU_EPOCH_GRACE_S", "2.0"))
 
 
 class _PeerDeadMidFrame(TransportError):

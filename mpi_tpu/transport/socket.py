@@ -6,17 +6,37 @@ blocking matched receive.  This backend exists for two reasons (SURVEY.md §4
 item 4): it is the CPU fallback, and it is the source-compatibility proof —
 the same user program must run here and on backend=tpu.
 
-Wire format per message: a fixed header ``!QQ`` = (flags|payload_len, seq)
-followed by ``payload_len`` body bytes — either a pickle of the envelope
-``(ctx, tag, obj)``, or (RAW_FLAG set, see transport/codec.py) a raw-array
-frame whose numpy payload is sent straight from / received straight into
-the array buffer, never pickled.  The context id is an arbitrary hashable
-(tree-path tuple), so it rides inside the meta pickle rather than a
-fixed-width header field.  The sender's world rank
-is established once per connection by a hello frame (``!i``), not repeated
-per message.  Rank discovery is file-based rendezvous: each rank binds an
-OS-assigned port and publishes it as ``<rdv>/port.<rank>``; peers poll.  The
-launcher (mpi_tpu/launcher.py) provides the rendezvous directory.
+Wire format per message: a fixed header ``!QQQ`` = (flags|payload_len,
+seq, ack) followed by ``payload_len`` body bytes — either a pickle of
+the envelope ``(ctx, tag, obj)``, or (RAW_FLAG set, see
+transport/codec.py) a raw-array frame whose numpy payload is sent
+straight from / received straight into the array buffer, never pickled.
+``seq`` is the per-destination sequence number of the resilient link
+layer (mpi_tpu/resilience.py): the sender retains a bounded window of
+unacked frames, the receiver delivers contiguously and dedups replays,
+and ``ack`` piggybacks the cumulative delivery high-water mark of the
+REVERSE stream on every frame (a header-only ``_ACK_FLAG`` control
+frame carries it when no data flows the other way).  A torn connection
+is therefore rebuilt without losing or duplicating frames: the hello
+handshake answers with ``resume(last delivered seq)`` and the sender
+replays only what the receiver never got.  The context id is an
+arbitrary hashable (tree-path tuple), so it rides inside the meta
+pickle rather than a fixed-width header field.  The sender's world rank
+is established once per connection by a hello frame, not repeated per
+message.  Rank discovery is file-based rendezvous: each rank binds an
+OS-assigned port and publishes it as ``<rdv>/port.<rank>``; peers poll.
+The launcher (mpi_tpu/launcher.py) provides the rendezvous directory.
+
+Fault classification (ISSUE 10): a send-path ``OSError`` is a PEER
+fault when the destination is in the FT suspect set or past its
+heartbeat bound (``ft.WorldFT.link_suspect``) — that keeps today's
+TransportError -> ProcFailedError path — and a LINK fault otherwise,
+healed by a reconnect loop with exponential backoff + jitter bounded
+by the ``link_retry_timeout_s`` cvar (default BELOW
+``fault_detect_timeout_s``, so a dead peer still resolves to
+ProcFailedError rather than a masked hang).  The receive side needs no
+classification: a reader whose connection dies simply exits and keeps
+the rx stream state — the sender reconnects and replays.
 """
 
 from __future__ import annotations
@@ -30,28 +50,53 @@ import time
 from typing import Any, Dict, Optional
 
 from .. import mpit as _mpit
+from .. import resilience as _resilience
 from ..errors import EpochSkewError
+from ..resilience import LinkState, backoff_delays
 from . import codec
 from .base import Transport, TransportError
 
 # Connection handshake: the connector sends (world rank, membership
-# epoch), the acceptor answers with ITS epoch.  The epoch stamp is the
-# elastic-membership guard (mpi_tpu/membership.py): after a shrink +
-# rejoin every survivor requires replaced slots to present the new
-# epoch, and a stale-epoch straggler (the falsely-suspected ousted rank)
-# is rejected LOUDLY — EpochSkewError on the stale side — instead of
-# cross-wiring two world generations through recycled rendezvous files.
-_HELLO = struct.Struct("!iq")      # rank, epoch
-_HELLO_ACK = struct.Struct("!q")   # acceptor's epoch
-_HEADER = struct.Struct("!QQ")  # flags|payload_len, seq
+# epoch), the acceptor answers with ITS epoch plus the last sequence
+# number it contiguously delivered from this connector — the RESUME
+# round of the resilient link layer (a fresh world answers 0; a
+# reconnect prunes the retained window to that mark and replays the
+# rest).  The epoch stamp is the elastic-membership guard
+# (mpi_tpu/membership.py): after a shrink + rejoin every survivor
+# requires replaced slots to present the new epoch, and a stale-epoch
+# straggler (the falsely-suspected ousted rank) is rejected LOUDLY —
+# EpochSkewError on the stale side — instead of cross-wiring two world
+# generations through recycled rendezvous files.
+_HELLO = struct.Struct("!iq")       # rank, epoch
+_HELLO_ACK = struct.Struct("!qQ")   # acceptor's epoch, resume(last delivered)
+_HEADER = struct.Struct("!QQQ")     # flags|payload_len, seq, cumulative ack
+# Header word bit 62: a standalone cumulative-ack control frame (no
+# body, seq 0, rides OUTSIDE the sequenced stream).  codec.RAW_FLAG is
+# bit 63, so body lengths live in the low 62 bits.
+_ACK_FLAG = 1 << 62
+_LEN_MASK = _ACK_FLAG - 1
 _HOST = "127.0.0.1"
 # Grace window before an ahead-of-us peer epoch is declared a SKEW: an
 # epoch transition is broadcast, and a healthy member whose reader/
 # control thread is scheduler-starved may see a peer's new epoch
 # milliseconds before applying its own bump.  A genuinely ousted
 # straggler's epoch never catches up, so the diagnosis still fires —
-# just one grace later.
-_EPOCH_GRACE_S = 2.0
+# just one grace later.  mpit cvar: epoch_grace_s (sets the shm
+# transport's twin too); env default: MPI_TPU_EPOCH_GRACE_S.
+_EPOCH_GRACE_S = float(os.environ.get("MPI_TPU_EPOCH_GRACE_S", "2.0"))
+
+# Ack-flusher cadence: once woken by a pending ack, batch for this long
+# before flushing (coalesces a burst of deliveries into one control
+# frame); the park itself is condition-variable based, so an idle
+# transport costs a wakeup only every _ACK_IDLE_S.
+_ACK_BATCH_S = 0.002
+_ACK_IDLE_S = 0.25
+
+
+class _LinkAbort(TransportError):
+    """Internal healing-loop abort (transport closing / peer became a
+    failure suspect mid-retry) — distinguishes the classified verdicts
+    from an ordinary dial failure inside ``_establish_locked``."""
 
 
 def _recv_exact(sock: socket.socket, n: int) -> Optional[bytes]:
@@ -113,7 +158,23 @@ class SocketTransport(Transport):
         self._conns: Dict[int, socket.socket] = {}
         self._conn_lock = threading.Lock()
         self._reader_threads = []
-        self._seq = 0
+        # inbound connections by source rank: membership_invalidate
+        # closes a replaced slot's readers so a stale incarnation (or a
+        # reader accepted moments BEFORE the purge, whose captured
+        # stream generation just went stale) dies promptly — the new
+        # incarnation's sender then heals by reconnect + replay onto a
+        # fresh-generation reader, losing nothing
+        self._reader_conns: Dict[int, list] = {}
+        # Resilient link layer (mpi_tpu/resilience.py): per-dest
+        # sequenced streams + retained replay windows + cumulative acks.
+        self._link = LinkState(size)
+        # Chaos hooks (transport/faulty.py link-fault injection): a
+        # callable (dest, stage) fired on the send path ('pre' = before
+        # any byte of a frame, 'mid' = between header and body), and a
+        # countdown of incoming connections the acceptor drops after
+        # reading the hello (exercises the connector's retry).
+        self._link_fault_hook = None
+        self._accept_drop_n = 0
 
         self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
@@ -129,6 +190,16 @@ class SocketTransport(Transport):
             target=self._accept_loop, name=f"mpi-tpu-accept-{rank}", daemon=True
         )
         self._accept_thread.start()
+        # Ack flusher: cumulative acks ride every data frame for free
+        # (piggyback), but a one-way stream (gather fan-in, a pure
+        # producer) would never ack — and the peer's retained window
+        # would fill.  This daemon parks on the link state's condition
+        # and flushes standalone ACK control frames for sources whose
+        # delivery mark moved past the last ack on the wire.
+        self._ack_thread = threading.Thread(
+            target=self._ack_flush_loop,
+            name=f"mpi-tpu-linkack-{rank}", daemon=True)
+        self._ack_thread.start()
 
     # -- incoming ----------------------------------------------------------
 
@@ -160,11 +231,20 @@ class SocketTransport(Transport):
             conn.close()
             return
         src, peer_epoch = _HELLO.unpack(hello)
+        if self._accept_drop_n > 0:
+            # injected accept-side drop (link chaos): vanish without an
+            # ack — the connector's bounded retry loop must recover
+            self._accept_drop_n -= 1
+            conn.close()
+            return
         try:
             # always answer with our epoch FIRST: a rejected stale
             # connector needs it to diagnose (EpochSkewError) rather
-            # than see an unexplained dead channel
-            conn.sendall(_HELLO_ACK.pack(self.epoch))
+            # than see an unexplained dead channel.  The resume field
+            # tells a RE-connecting peer what we already delivered, so
+            # it replays only the frames we never got.
+            conn.sendall(_HELLO_ACK.pack(self.epoch,
+                                         self._link.delivered(src)))
         except OSError:
             conn.close()
             return
@@ -174,16 +254,43 @@ class SocketTransport(Transport):
             _mpit.count(epoch_skews=1)
             conn.close()
             return
-        self._reader_loop(conn, src)
+        # capture the stream generation: if this slot is purged while
+        # we read (membership replacement), every later ack/frame on
+        # this connection no-ops instead of poisoning the fresh streams
+        # — and the purge CLOSES this connection (see _reader_conns),
+        # so a legitimate new incarnation whose hello raced the purge
+        # reconnects and replays instead of streaming into the fence
+        with self._conn_lock:
+            conns = self._reader_conns.setdefault(src, [])
+            conns[:] = [c for c in conns if c.fileno() >= 0]
+            conns.append(conn)
+        try:
+            self._reader_loop(conn, src, self._link.peer_gen(src))
+        finally:
+            with self._conn_lock:
+                try:
+                    self._reader_conns.get(src, []).remove(conn)
+                except ValueError:
+                    pass
 
-    def _reader_loop(self, conn: socket.socket, src: int) -> None:
+    def _reader_loop(self, conn: socket.socket, src: int,
+                     gen: int) -> None:
         while True:
             head = _recv_exact(conn, _HEADER.size)
             if head is None:
+                # link fault (reset / sender gone): keep the rx stream
+                # state — the sender reconnects and replays unacked
+                # frames; a mid-frame partial below is discarded the
+                # same way (delivery marks only advance on FULL frames)
                 conn.close()
                 return
-            word, _seq = _HEADER.unpack(head)
-            plen = word & codec.LEN_MASK
+            word, seq, ack = _HEADER.unpack(head)
+            if ack:
+                # piggybacked cumulative ack for OUR stream toward src
+                self._link.tx_ack(src, ack, gen)
+            if word & _ACK_FLAG:
+                continue  # header-only control frame
+            plen = word & _LEN_MASK
             if word & codec.RAW_FLAG:
                 # raw frame: tiny meta pickle, then the bytes stream
                 # straight into the freshly-allocated result array(s) —
@@ -220,14 +327,90 @@ class SocketTransport(Transport):
                 if not ok:
                     conn.close()
                     return
-                self.mailbox.deliver(src, ctx, tag, out)
+                self._deliver_seq(conn, src, seq, ctx, tag, out, gen)
                 continue
             payload = _recv_exact(conn, plen)
             if payload is None:
                 conn.close()
                 return
             ctx, tag, obj = pickle.loads(payload)
-            self.mailbox.deliver(src, ctx, tag, obj)
+            self._deliver_seq(conn, src, seq, ctx, tag, obj, gen)
+
+    def _deliver_seq(self, conn: socket.socket, src: int, seq: int,
+                     ctx, tag: int, obj: Any, gen: int) -> None:
+        """Sequenced delivery: contiguous frames reach the mailbox,
+        replay duplicates (and frames from a since-purged incarnation's
+        connection) are dropped, a gap is a loud protocol error
+        (resilience.LinkState.rx_gate).  The gate + deliver are atomic
+        per source, so a dying connection's reader racing its
+        replacement's cannot reorder the mailbox FIFO.  A gate error
+        kills the channel first (close-then-raise, like the raw-length
+        mismatch) so the sender discovers a dead channel instead of
+        streaming into kernel buffers nobody drains."""
+        try:
+            self._link.rx_gate(
+                src, seq, lambda: self.mailbox.deliver(src, ctx, tag, obj),
+                gen)
+        except TransportError:
+            conn.close()
+            raise
+
+    # -- cumulative-ack flusher (mpi_tpu/resilience.py) --------------------
+
+    def _ack_flush_loop(self) -> None:
+        link = self._link
+        # per-peer dial cool-down: a vanished-but-unsuspected peer (FT
+        # off, or the detector not yet fired) must not let its 2s dial
+        # fuse serially starve standalone acks to every OTHER source —
+        # consecutive failures back the peer off exponentially (5s cap)
+        # while the data path's piggyback stays instant for everyone
+        next_try: Dict[int, float] = {}
+        fails: Dict[int, int] = {}
+        while not self._closing:
+            try:
+                srcs = link.wait_ack_pending(_ACK_IDLE_S)
+            except Exception:  # pragma: no cover - teardown race
+                return
+            if not srcs or self._closing:
+                continue
+            time.sleep(_ACK_BATCH_S)  # coalesce a delivery burst
+            for src in srcs:
+                if self._closing:
+                    return
+                value = link.peek_ack(src)
+                if value is None:
+                    continue  # a piggyback beat us to it
+                if self._suspect(src):
+                    # dead peer: nobody is waiting on these acks, and
+                    # redialing its corpse every round would spin
+                    link.note_ack_sent(src, value)
+                    continue
+                if time.monotonic() < next_try.get(src, 0.0):
+                    continue  # cooling down after failed dials
+                try:
+                    with self._send_lock(src):
+                        with self._conn_lock:
+                            conn = self._conns.get(src)
+                        if conn is None:
+                            # short-fused dial (the peer published a
+                            # port at world start): an unreachable peer
+                            # is retried next round, not camped on
+                            conn = self._establish_locked(
+                                src, time.monotonic() + 2.0,
+                                backoff_delays())
+                        conn.sendall(_HEADER.pack(_ACK_FLAG, 0, value))
+                    link.note_ack_sent(src, value)
+                    fails.pop(src, None)
+                    next_try.pop(src, None)
+                except (OSError, TransportError, EpochSkewError):
+                    # best-effort: drop a broken conn so a later round
+                    # re-dials (the peer's window depends on these acks
+                    # when no data flows back); real diagnosis belongs
+                    # to the data path / membership layer
+                    self._drop_conn(src)
+                    fails[src] = fails.get(src, 0) + 1
+                    next_try[src] = time.monotonic() + min(
+                        5.0, 0.25 * (2.0 ** fails[src]))
 
     # -- outgoing ----------------------------------------------------------
 
@@ -266,26 +449,79 @@ class SocketTransport(Transport):
                 lock = self._send_locks[dest] = threading.Lock()
             return lock
 
+    def _drop_conn(self, dest: int) -> None:
+        """Forget + close the cached connection to ``dest`` (link-fault
+        teardown / failed ack flush).  The retained window and seq
+        state survive — that is the whole point."""
+        with self._conn_lock:
+            conn = self._conns.pop(dest, None)
+        if conn is not None:
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    def _suspect(self, dest: int) -> bool:
+        """PEER-fault verdict for link classification: the FT detector's
+        suspect set, or a heartbeat stale past the detection bound
+        (ft.WorldFT.link_suspect).  Without fault tolerance enabled
+        there is no peer-death authority, so every fault is a link
+        fault and only the bounded retry budget decides."""
+        world = getattr(self, "_ft_world", None)
+        return world is not None and world.link_suspect(dest)
+
     def _get_conn_locked(self, dest: int) -> socket.socket:
         """Return the connection to ``dest``; caller holds the per-dest
-        lock.  The handshake is hello(rank, epoch) → ack(peer epoch):
-
-        * ack epoch NEWER than ours — WE are the stale straggler (shrunk
-          out while we stalled past the detection bound): EpochSkewError,
-          the diagnosed spelling of the false-suspicion group split.
-        * ack epoch below ``min_peer_epoch[dest]`` — the PEER is a stale
-          incarnation still squatting on the old rendezvous endpoint of a
-          replaced slot: drop it and retry against a re-read port file
-          until the replacement publishes.
-        """
+        lock.  First connection of a world: bounded by
+        ``connect_timeout`` at a polite poll cadence."""
         with self._conn_lock:
             conn = self._conns.get(dest)
         if conn is not None:
             return conn
         self._peer_port(dest)  # bounded wait for a first publication
         deadline = time.monotonic() + self._connect_timeout
+
+        def abort() -> None:
+            # the initial-connect loop honors the same classification
+            # as healing: a peer the FT layer declares dead mid-dial
+            # surfaces as a peer fault NOW (TransportError -> wrapped
+            # ProcFailedError), not after connect_timeout's 60s camp
+            if self._closing:
+                raise _LinkAbort(
+                    f"rank {self.world_rank}: transport closed while "
+                    f"connecting to rank {dest}")
+            if self._suspect(dest):
+                raise _LinkAbort(
+                    f"rank {self.world_rank}: peer {dest} declared "
+                    f"failed while connecting to it")
+
+        return self._establish_locked(dest, deadline,
+                                      iter(lambda: 0.01, None),
+                                      abort=abort)
+
+    def _establish_locked(self, dest: int, deadline: float, delays,
+                          abort=None) -> socket.socket:
+        """Dial + handshake + resume-replay loop; caller holds the
+        per-dest send lock.  The handshake is hello(rank, epoch) →
+        ack(peer epoch, last delivered seq):
+
+        * ack epoch NEWER than ours — WE are the stale straggler (shrunk
+          out while we stalled past the detection bound): EpochSkewError
+          after the epoch grace, the diagnosed spelling of the
+          false-suspicion group split.
+        * ack epoch below ``min_peer_epoch[dest]`` — the PEER is a stale
+          incarnation still squatting on the old rendezvous endpoint of a
+          replaced slot: drop it and retry against a re-read port file
+          until the replacement publishes.
+        * otherwise — prune the retained window to the peer's resume
+          mark and REPLAY the frames beyond it (the peer's rx gate
+          drops any the teardown raced through), then register the
+          connection.
+        """
         skew_since = None
         while True:
+            if abort is not None:
+                abort()  # healing-path closing/suspect checks may raise
             port = self._peer_port_once(dest)
             conn = None
             if port is not None:
@@ -293,6 +529,26 @@ class SocketTransport(Transport):
                     conn = socket.create_connection((_HOST, port),
                                                     timeout=5.0)
                 except OSError:
+                    conn = None
+            if conn is not None:
+                try:
+                    if conn.getsockname() == conn.getpeername():
+                        # Linux loopback SELF-CONNECT: dialing a port
+                        # nobody listens on can land the ephemeral
+                        # SOURCE port on the destination port itself
+                        # (TCP simultaneous open) — the socket is then
+                        # connected to US, and the handshake would
+                        # misparse our own hello as the peer's ack.  A
+                        # reconnect loop against a dead peer's stale
+                        # port hits this reliably; treat as a failed
+                        # dial.
+                        conn.close()
+                        conn = None
+                except OSError:
+                    try:
+                        conn.close()
+                    except OSError:
+                        pass
                     conn = None
             if conn is not None:
                 conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
@@ -308,7 +564,7 @@ class SocketTransport(Transport):
                 except OSError:
                     ack = None
                 if ack is not None:
-                    (peer_epoch,) = _HELLO_ACK.unpack(ack)
+                    peer_epoch, resume_seq = _HELLO_ACK.unpack(ack)
                     if peer_epoch > self.epoch:
                         conn.close()
                         # grace before the skew verdict: our own epoch
@@ -331,18 +587,91 @@ class SocketTransport(Transport):
                         continue
                     skew_since = None
                     if peer_epoch >= self.min_peer_epoch.get(dest, 0):
-                        conn.settimeout(None)
-                        with self._conn_lock:
-                            self._conns[dest] = conn
-                        return conn
-                conn.close()  # stale incarnation (or torn handshake)
+                        if self._replay_locked(dest, conn, resume_seq):
+                            conn.settimeout(None)
+                            with self._conn_lock:
+                                self._conns[dest] = conn
+                            if self._link.mark_connected(dest):
+                                _mpit.count(link_reconnects=1)
+                            return conn
+                        conn = None  # replay tripped: count as a miss
+                if conn is not None:
+                    conn.close()  # stale incarnation (or torn handshake)
             if time.monotonic() > deadline:
                 raise TransportError(
                     f"rank {self.world_rank}: cannot connect to rank "
                     f"{dest} at epoch >= "
-                    f"{self.min_peer_epoch.get(dest, 0)} within "
-                    f"{self._connect_timeout}s")
-            time.sleep(0.01)
+                    f"{self.min_peer_epoch.get(dest, 0)} within the "
+                    f"connection deadline")
+            time.sleep(next(delays))
+
+    def _replay_locked(self, dest: int, conn: socket.socket,
+                       resume_seq: int) -> bool:
+        """Resume round of a fresh handshake: prune the retained window
+        to the peer's delivery mark, replay every frame beyond it in
+        seq order (with a fresh piggyback ack — the retained header
+        word/seq are authoritative, the ack field is not).  False on a
+        mid-replay socket error (caller retries the whole dial)."""
+        pending = self._link.resume(dest, resume_seq)
+        for seq, word, body in pending:
+            try:
+                conn.sendall(_HEADER.pack(
+                    word, seq, self._link.piggyback_ack(dest)))
+                conn.sendall(body)
+            except OSError:
+                try:
+                    conn.close()
+                except OSError:
+                    pass
+                return False
+            _mpit.count(link_frames_replayed=1)
+        return True
+
+    def _heal_link_locked(self, dest: int, err: OSError) -> None:
+        """A send-path OSError, classified (ISSUE 10): peer fault →
+        TransportError now (the communicator wraps it into
+        ProcFailedError and the detector records the evidence); link
+        fault → reconnect with exponential backoff + jitter bounded by
+        ``link_retry_timeout_s``.  On success the retained-window
+        replay already resent the failed frame — the caller's send is
+        complete.  Caller holds the per-dest send lock."""
+        self._drop_conn(dest)
+        retry_s = _resilience._RETRY_TIMEOUT_S
+        if retry_s <= 0:
+            raise TransportError(
+                f"rank {self.world_rank}: send to rank {dest} failed: "
+                f"{err} (link healing disabled)") from err
+        if self._suspect(dest):
+            raise TransportError(
+                f"rank {self.world_rank}: send to rank {dest} failed "
+                f"({err}); peer is failure-suspected — not retrying a "
+                f"dead peer's link") from err
+
+        def abort() -> None:
+            if self._closing:
+                raise _LinkAbort(
+                    f"rank {self.world_rank}: transport closed while "
+                    f"healing link to rank {dest}")
+            if self._suspect(dest):
+                raise _LinkAbort(
+                    f"rank {self.world_rank}: peer {dest} declared "
+                    f"failed while re-establishing its link "
+                    f"(original fault: {err})")
+
+        try:
+            self._establish_locked(
+                dest, time.monotonic() + retry_s, backoff_delays(),
+                abort=abort)
+        except EpochSkewError:
+            raise  # membership diagnosis outranks link healing
+        except _LinkAbort as e:
+            raise TransportError(str(e)) from err
+        except (OSError, TransportError):
+            raise TransportError(
+                f"rank {self.world_rank}: link to rank {dest} not "
+                f"re-established within link_retry_timeout_s="
+                f"{retry_s} (original fault: {err})") from err
+        _mpit.count(link_faults_masked=1)
 
     def send(self, dest: int, ctx, tag: int, payload: Any) -> None:
         if not (0 <= dest < self.world_size):
@@ -354,35 +683,90 @@ class SocketTransport(Transport):
         frame = codec.pack_raw_frame(ctx, tag, payload)
         if frame is not None:
             head, bufs = frame
-            body = len(head) + sum(b.nbytes for b in bufs)
-            with self._send_lock(dest):
-                conn = self._get_conn_locked(dest)
-                self._seq += 1
-                prefix = _HEADER.pack(codec.RAW_FLAG | body, self._seq) + head
-                try:
-                    conn.sendall(prefix)
-                    for b in bufs:
-                        if b.nbytes:
-                            # sendall reads the array's buffer directly —
-                            # the payload is never pickled or re-copied
-                            # host-side
-                            conn.sendall(memoryview(b).cast("B"))
-                except OSError as e:
-                    raise TransportError(
-                        f"rank {self.world_rank}: send to rank {dest} "
-                        f"failed: {e}") from e
+            parts = [head, *(memoryview(b).cast("B")
+                             for b in bufs if b.nbytes)]
+            self._send_parts(dest, codec.RAW_FLAG, parts)
             return
         blob = codec.pack_pickle_body(ctx, tag, payload)
+        self._send_parts(dest, 0, [blob])
+
+    def _send_parts(self, dest: int, flags: int, parts) -> None:
+        """Sequenced frame send.  With healing ENABLED: wait for
+        retained-window room, snapshot the body into ONE flat bytes
+        (what sendall streams AND what the window replays after a
+        reset — the caller may mutate its array the moment send
+        returns, so replay must come from a snapshot, exactly like the
+        kernel socket buffer a reset discards; ``link_bytes_retained``
+        prices it, ``payload_copies`` stays the codec plane's number),
+        retain it, stream, heal on OSError.  With healing DISABLED
+        (``link_retry_timeout_s`` = 0): no snapshot, no window, no
+        retention — stream each buffer directly (the pre-resilience
+        zero-copy path; replay can never happen, so retaining would be
+        pure cost), seqs still assigned so the receiver's contiguity
+        gate keeps holding."""
+        link = self._link
+        healing = _resilience._RETRY_TIMEOUT_S > 0
+        body: Any
+        if healing:
+            body = parts[0] if len(parts) == 1 else b"".join(parts)
+            nbytes = len(body)
+            link.wait_window(dest, nbytes, self._suspect,
+                             lambda: self._closing)
+        else:
+            nbytes = sum(len(p) for p in parts)
+        word = flags | nbytes
+        hook = self._link_fault_hook
         with self._send_lock(dest):
             conn = self._get_conn_locked(dest)
-            self._seq += 1
-            frame = _HEADER.pack(len(blob), self._seq) + blob
+            seq = (link.tx_retain(dest, word, body) if healing
+                   else link.tx_next_seq(dest))
+            header = _HEADER.pack(word, seq, link.piggyback_ack(dest))
             try:
-                conn.sendall(frame)
+                if hook is not None:
+                    hook(dest, "pre")  # chaos: reset between frames / stall
+                conn.sendall(header)
+                if hook is not None:
+                    hook(dest, "mid")  # chaos: reset mid-frame
+                if healing:
+                    conn.sendall(body)
+                else:
+                    for p in parts:
+                        conn.sendall(p)
             except OSError as e:
-                raise TransportError(
-                    f"rank {self.world_rank}: send to rank {dest} failed: {e}"
-                ) from e
+                # classification + healing; the retained window replays
+                # this frame on a successful reconnect (with healing
+                # off this raises terminally — pre-resilience behavior)
+                self._heal_link_locked(dest, e)
+
+    # -- chaos hooks (transport/faulty.py link-fault injection) ------------
+
+    def install_link_faults(self, injector) -> None:
+        """Attach a connection-level fault injector (see FaultyTransport
+        link_* kwargs): its hook fires inside this transport's send
+        path regardless of which communicator handle triggered the
+        send, and its accept-drop budget is consumed by the acceptor."""
+        self._link_fault_hook = injector._link_hook
+        self._accept_drop_n += int(
+            getattr(injector, "link_accept_drop", 0))
+
+    def _inject_link_reset(self, dest: int) -> None:
+        """Chaos primitive: tear down the cached connection to ``dest``
+        NOW (RST — SO_LINGER 0 — so the peer sees a hard reset, not a
+        polite FIN).  Called synchronously from the send-path hook, so
+        the in-flight sendall fails on the closed descriptor and the
+        healing path takes over; the retained window is untouched."""
+        with self._conn_lock:
+            conn = self._conns.pop(dest, None)
+        if conn is not None:
+            try:
+                conn.setsockopt(socket.SOL_SOCKET, socket.SO_LINGER,
+                                struct.pack("ii", 1, 0))
+            except OSError:
+                pass
+            try:
+                conn.close()
+            except OSError:
+                pass
 
     # -- membership (mpi_tpu/membership.py) --------------------------------
 
@@ -390,7 +774,12 @@ class SocketTransport(Transport):
         """Drop cached connections to replaced slots so the next send
         re-handshakes (port-file re-read + epoch-checked hello).  Takes
         each per-dest send lock: a send streaming a frame on the old
-        connection must finish (or fail) before its socket vanishes."""
+        connection must finish (or fail) before its socket vanishes.
+        The per-dest RESILIENCE state goes with it (purge_peer): the
+        dead incarnation's retained replay window and seq/delivery
+        marks belong to ITS streams — a rejoiner starts at seq 1 and
+        must never see a stale replay or inherit the corpse's dedup
+        horizon."""
         for dest in dead:
             with self._send_lock(dest):
                 with self._conn_lock:
@@ -404,6 +793,25 @@ class SocketTransport(Transport):
                         conn.close()
                     except OSError:
                         pass
+                self._link.purge_peer(dest)
+            # kill the slot's INBOUND readers too: their captured
+            # stream generation just went stale, so every frame they
+            # read would be fence-dropped — for the corpse that is the
+            # point, and for a replacement whose hello RACED this
+            # transition the close makes its sender reconnect and
+            # replay the (unacked) fence-dropped frames onto a reader
+            # that captures the fresh generation
+            with self._conn_lock:
+                readers = self._reader_conns.pop(dest, [])
+            for rc in readers:
+                try:
+                    rc.shutdown(socket.SHUT_RDWR)
+                except OSError:
+                    pass
+                try:
+                    rc.close()
+                except OSError:
+                    pass
 
     # -- shutdown ----------------------------------------------------------
 
@@ -413,6 +821,7 @@ class SocketTransport(Transport):
             self._listener.close()
         except OSError:
             pass
+        self._link.close()  # frees window waiters + parks the flusher out
         with self._conn_lock:
             for conn in self._conns.values():
                 try:

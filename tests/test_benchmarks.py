@@ -182,6 +182,30 @@ def test_serve_chaos_quick_smoke():
     assert result["unnamed_failures"] == []
 
 
+def test_links_chaos_quick_smoke():
+    """The link-fault chaos leg (ISSUE 10; the ``bench.py --chaos
+    --links --quick`` CI spelling): connection resets — between frames
+    AND mid-frame — hammered into a 3-rank socket world running a
+    mixed-collective stream.  The contract: bit-identical per-rank
+    digests vs an uninjected run, zero ProcFailedError, every reset
+    healed by a counted reconnect (link_reconnects >= resets), and a
+    genuine mid-run death under the SAME harness still surfaces
+    MPI_ERR_PROC_FAILED within the detection bound — healing never
+    masks real death."""
+    from benchmarks import chaos
+
+    result = chaos.run_links_chaos(quick=True)
+    assert result["ok"], {k: result[k] for k in
+                          ("resets_injected", "link_reconnects",
+                           "bit_parity_vs_uninjected",
+                           "zero_proc_failed", "kill_still_diagnosed",
+                           "injected", "kill")}
+    assert result["resets_injected"] >= 6
+    assert result["link_reconnects"] >= result["resets_injected"]
+    assert result["bit_parity_vs_uninjected"]
+    assert result["kill_still_diagnosed"]
+
+
 def test_serve_bench_quick_smoke():
     """The world-churn harness end to end in --quick mode (the
     ``bench.py --serve-bench --quick`` CI spelling): cold launch() vs

@@ -593,6 +593,139 @@ def test_shm_sender_unstuck_from_dead_consumers_full_ring(tmp_path):
     assert "sender unstuck" in out, out
 
 
+_FREEZE_PROG = """
+import os, sys, time
+sys.path.insert(0, {repo!r})
+import numpy as np
+import mpi_tpu
+from mpi_tpu import mpit
+from mpi_tpu.errors import ProcFailedError, RevokedError
+
+mpit.cvar_write("fault_detect_timeout_s", 2.5)
+mpit.cvar_write("fault_heartbeat_interval_s", 0.2)
+comm = mpi_tpu.init()
+mode = os.environ["MPI_TPU_FREEZE_MODE"]   # "within" | "past"
+_detect = float(mpit.cvar_read("fault_detect_timeout_s"))
+BOUND = 3.0 * _detect + (25.0 if (os.cpu_count() or 1) < 4 else 8.0)
+comm.barrier()
+# tell the driver this rank is inside the collective loop era
+open(os.path.join(os.environ["MPI_TPU_RDV"],
+                  f"frozen_ready.{{comm.rank}}"), "w").close()
+t0 = time.monotonic()
+try:
+    # small payloads on purpose: a frozen peer must stall SLICED
+    # receives (FT-checked), not fill kernel socket buffers and wedge
+    # an unsliceable sendall
+    for i in range(70):
+        out = comm.allreduce(np.full(512, 1.0), algorithm="ring")
+        assert float(out[0]) == float(comm.size), out[0]
+        time.sleep(0.05)
+    outcome = "ok"
+except ProcFailedError as e:
+    took = time.monotonic() - t0
+    assert mode == "past", f"false shrink of a resumed-in-bound rank: {{e}}"
+    assert 1 in e.failed, e.failed
+    assert took < BOUND, f"freeze diagnosis took {{took:.1f}}s (> {{BOUND}}s)"
+    outcome = "diagnosed"
+    try:
+        comm.revoke()   # unblock the survivor not facing the corpse
+    except Exception:
+        pass
+except RevokedError:
+    assert mode == "past", "false revoke in a resumed-in-bound world"
+    outcome = "diagnosed"
+print(f"OUTCOME rank={{comm.rank}} {{outcome}}", flush=True)
+sys.exit(0)
+"""
+
+
+def _spawn_freeze_world(tmp_path, mode):
+    script = tmp_path / "freeze.py"
+    script.write_text(_FREEZE_PROG.format(repo=REPO))
+    rdv = tmp_path / "rdv"
+    rdv.mkdir()
+    procs = []
+    for r in range(3):
+        env = dict(os.environ)
+        env.update({"MPI_TPU_RANK": str(r), "MPI_TPU_SIZE": "3",
+                    "MPI_TPU_RDV": str(rdv), "MPI_TPU_BACKEND": "socket",
+                    "MPI_TPU_FT": "1", "JAX_PLATFORMS": "cpu",
+                    "MPI_TPU_FREEZE_MODE": mode})
+        procs.append(subprocess.Popen(
+            [sys.executable, str(script)], env=env, cwd=REPO,
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True))
+    deadline = time.monotonic() + 60.0
+    while time.monotonic() < deadline:
+        if all((rdv / f"frozen_ready.{r}").exists() for r in range(3)):
+            break
+        if any(p.poll() is not None for p in procs):
+            break  # a rank died during startup: fall through to asserts
+        time.sleep(0.02)
+    return procs
+
+
+def test_freeze_within_bound_not_falsely_shrunk(tmp_path):
+    """satellite (ISSUE 10): SIGSTOP a rank for LESS than the detection
+    bound, then SIGCONT — the detector's staleness window must tolerate
+    the pause (and its own-stall restart must keep the resumed rank
+    from counter-accusing the survivors): NOBODY raises, every rank
+    finishes the collective stream clean."""
+    import signal as _signal
+
+    procs = _spawn_freeze_world(tmp_path, "within")
+    try:
+        os.kill(procs[1].pid, _signal.SIGSTOP)
+        time.sleep(0.8)   # well inside the 2.5s detection bound
+        os.kill(procs[1].pid, _signal.SIGCONT)
+        outs = {}
+        for r, p in enumerate(procs):
+            out, err = p.communicate(timeout=90.0)
+            outs[r] = (p.returncode, out, err)
+        for r in range(3):
+            code, out, err = outs[r]
+            assert code == 0, f"rank {r}: {err[-900:]}"
+            assert f"OUTCOME rank={r} ok" in out, (r, out, err[-400:])
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                try:
+                    os.kill(p.pid, _signal.SIGCONT)
+                except OSError:
+                    pass
+                p.kill()
+
+
+def test_freeze_past_bound_named_proc_failed(tmp_path):
+    """satellite (ISSUE 10): a rank paused PAST the detection bound is
+    indistinguishable from death and must be NAMED — the survivors
+    surface ProcFailedError/RevokedError listing rank 1 within the
+    derived bound (the link layer's healing must not convert a frozen
+    peer into an unbounded retry)."""
+    import signal as _signal
+
+    procs = _spawn_freeze_world(tmp_path, "past")
+    try:
+        os.kill(procs[1].pid, _signal.SIGSTOP)   # ... and never CONT
+        outs = {}
+        for r in (0, 2):
+            out, err = procs[r].communicate(timeout=90.0)
+            outs[r] = (procs[r].returncode, out, err)
+        for r in (0, 2):
+            code, out, err = outs[r]
+            assert code == 0, f"rank {r}: {err[-900:]}"
+            assert f"OUTCOME rank={r} diagnosed" in out, (r, out,
+                                                          err[-400:])
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                try:
+                    os.kill(p.pid, _signal.SIGCONT)
+                except OSError:
+                    pass
+                p.kill()
+                p.wait(5.0)
+
+
 def test_launcher_exit_summary(tmp_path):
     """Any nonzero outcome prints the per-rank exit table (rank, code,
     signal) so failure-story logs are diagnosable without spelunking."""
