@@ -578,6 +578,22 @@ if __name__ == "__main__":
         if "--no-healing" in sys.argv[1:]:
             args.append("--no-healing")
         sys.exit(chaos.main(args))
+    if "--hotpath" in sys.argv[1:]:
+        # zero-copy hot-path leg (ISSUE 11): 16MB socket allreduce
+        # under healing-off / eager-retain / zero-copy retention modes
+        # (pvar-proven retention-without-copy + one sendmsg per frame)
+        # plus the lease-rides-the-pooled-arena check; the full run
+        # writes the committed hotpath_{pre,post}.json artifacts,
+        # --quick is the tier-1 smoke spelling.
+        from benchmarks import hotpath
+
+        if "--quick" in sys.argv[1:]:
+            sys.exit(hotpath.main(["--quick"]))
+        sys.exit(hotpath.main(
+            ["--out-pre", os.path.join(REPO, "benchmarks", "results",
+                                       "hotpath_pre.json"),
+             "--out-post", os.path.join(REPO, "benchmarks", "results",
+                                        "hotpath_post.json")]))
     if "--serve-bench" in sys.argv[1:]:
         # world-churn leg (ISSUE 7): resident world server vs cold
         # launch() — worlds/sec + p99 world-acquire latency; the full

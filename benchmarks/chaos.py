@@ -414,6 +414,8 @@ print(json.dumps({{
     "link_reconnects": mpit.pvar_read("link_reconnects"),
     "link_frames_replayed": mpit.pvar_read("link_frames_replayed"),
     "link_faults_masked": mpit.pvar_read("link_faults_masked"),
+    "link_bytes_retained": mpit.pvar_read("link_bytes_retained"),
+    "link_cow_snapshots": mpit.pvar_read("link_cow_snapshots"),
     "proc_failures_detected": mpit.pvar_read("proc_failures_detected"),
 }}), flush=True)
 sys.exit(0 if outcome.startswith(("ok", "diagnosed")) else 3)
@@ -521,6 +523,16 @@ def run_links_chaos(quick: bool = False, healing: bool = True) -> Dict:
     reconnects = sum(r.get("link_reconnects", 0) for r in injected)
     replayed = sum(r.get("link_frames_replayed", 0) for r in injected)
     masked = sum(r.get("link_faults_masked", 0) for r in injected)
+    # ISSUE 11 retention-by-reference, observed under chaos: the
+    # retained window prices real bytes with no eager snapshot, and
+    # the mix's genuine reuse sites (scan folds into its just-sent
+    # accumulator) fire copy-on-write — the bit-parity assertion below
+    # is then LIVE proof the snapshots land BEFORE the folds, or every
+    # replayed scan frame would carry post-fold bytes.  The zero-reuse
+    # zero-copy contract is asserted where reuse is absent
+    # (benchmarks/hotpath.py's ring leg + tests/test_resilience.py).
+    retained = sum(r.get("link_bytes_retained", 0) for r in injected)
+    cow_snaps = sum(r.get("link_cow_snapshots", 0) for r in injected)
     parity = all(
         b.get("digest") and b.get("digest") == i.get("digest")
         for b, i in zip(baseline, injected))
@@ -539,6 +551,10 @@ def run_links_chaos(quick: bool = False, healing: bool = True) -> Dict:
         "link_reconnects": reconnects,
         "link_frames_replayed": replayed,
         "link_faults_masked": masked,
+        "link_bytes_retained": retained,
+        "link_cow_snapshots": cow_snaps,
+        "retention_by_reference": (retained > 0 if healing
+                                   else retained == 0),
         "bit_parity_vs_uninjected": parity,
         "zero_proc_failed": clean,
         "kill_still_diagnosed": kill_ok,

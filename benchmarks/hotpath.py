@@ -1,0 +1,266 @@
+"""Zero-copy hot-path benchmark (ISSUE 11 acceptance: ``bench.py
+--hotpath [--quick]``).
+
+Two legs:
+
+* **16MB socket allreduce** (2 rank processes over loopback TCP, ring
+  algorithm) under three retention modes of the resilient link layer:
+
+  - ``healing_off`` — ``link_retry_timeout_s = 0``: no window, no
+    retention, the pre-resilience floor;
+  - ``healing_on_retain_copy`` — ``link_retain_copy = 1``: ISSUE 10's
+    eager per-frame snapshot (one full memcpy of every frame body into
+    the retained window) — the committed "pre" cost;
+  - ``healing_on_zero_copy`` — the ISSUE 11 default: retention BY
+    REFERENCE with copy-on-write on proven reuse.
+
+  Each mode records rank 0's p50 plus the pvar deltas that prove the
+  decoupling: ``link_bytes_retained`` > 0 with ``link_cow_snapshots``
+  == 0 on the no-reuse path (retention without copy), and
+  ``link_send_syscalls / frames`` ~= 1 (one vectored sendmsg per frame
+  where the pre-sendmsg path took one write per header/meta/segment).
+
+* **lease arena hit** (shm pool): two consecutive ``lease.run``
+  allreduces on a resident world server must ride the POOLED collective
+  arena — ``coll_sm_hits > 0`` inside the lease, same arena segment
+  both times (the PR-7 "leases skip the arena" residual, closed).
+
+Usage::
+
+    python benchmarks/hotpath.py [--quick] [--out-pre F] [--out-post F]
+    python bench.py --hotpath [--quick]     # the CI spelling
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import statistics
+import subprocess
+import sys
+import tempfile
+import time
+from typing import Dict, List, Optional
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+_PVARS = ("link_bytes_retained", "link_cow_snapshots", "link_cow_bytes",
+          "payload_copies", "link_send_syscalls", "msgs_sent")
+
+_PROG = """
+import json, os, statistics, sys, time
+sys.path.insert(0, {repo!r})
+import numpy as np
+import mpi_tpu
+from mpi_tpu import mpit
+
+nbytes = int(os.environ["HOTPATH_NBYTES"])
+iters = int(os.environ["HOTPATH_ITERS"])
+warmup = int(os.environ["HOTPATH_WARMUP"])
+comm = mpi_tpu.init()
+x = np.ones(max(1, nbytes // 4), np.float32)
+for _ in range(warmup):
+    comm.allreduce(x, algorithm="ring")
+names = {pvars!r}
+before = {{n: mpit.pvar_read(n) for n in names}}
+ts = []
+for _ in range(iters):
+    t0 = time.perf_counter()
+    comm.allreduce(x, algorithm="ring")
+    ts.append(time.perf_counter() - t0)
+after = {{n: mpit.pvar_read(n) for n in names}}
+if comm.rank == 0:
+    print(json.dumps({{
+        "p50_us": statistics.median(ts) * 1e6,
+        "pvars": {{n: after[n] - before[n] for n in names}}}}))
+mpi_tpu.finalize()
+"""
+
+
+def _run_world(script: str, env_extra: Dict, nranks: int = 2,
+               timeout: float = 300.0) -> Dict:
+    """One 2-rank socket world; returns rank 0's JSON report."""
+    from mpi_tpu import membership
+
+    rdv = membership.new_rendezvous_dir(prefix="mpi_tpu_hotpath_")
+    procs = []
+    try:
+        for r in range(nranks):
+            env = dict(os.environ)
+            env.update({"MPI_TPU_RANK": str(r),
+                        "MPI_TPU_SIZE": str(nranks),
+                        "MPI_TPU_RDV": rdv,
+                        "MPI_TPU_BACKEND": "socket",
+                        "JAX_PLATFORMS": "cpu"})
+            env.update(env_extra)
+            procs.append(subprocess.Popen(
+                [sys.executable, script], env=env, cwd=REPO,
+                stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+                text=True))
+        rec: Dict = {}
+        for r, p in enumerate(procs):
+            try:
+                stdout, stderr = p.communicate(timeout=timeout)
+            except subprocess.TimeoutExpired:
+                p.kill()
+                stdout, stderr = p.communicate()
+                raise RuntimeError(
+                    f"hotpath rank {r} hung: {stderr[-400:]}")
+            if p.returncode != 0:
+                raise RuntimeError(f"hotpath rank {r} exited "
+                                   f"{p.returncode}: {stderr[-400:]}")
+            if r == 0:
+                rec = json.loads(stdout.strip().splitlines()[-1])
+        return rec
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+        membership.cleanup_rendezvous(rdv)
+
+
+_MODES = {
+    # mode -> resilience env overrides
+    "healing_off": {"MPI_TPU_LINK_RETRY_S": "0",
+                    "MPI_TPU_LINK_RETAIN_COPY": "0"},
+    "healing_on_retain_copy": {"MPI_TPU_LINK_RETRY_S": "4.0",
+                               "MPI_TPU_LINK_RETAIN_COPY": "1"},
+    "healing_on_zero_copy": {"MPI_TPU_LINK_RETRY_S": "4.0",
+                             "MPI_TPU_LINK_RETAIN_COPY": "0"},
+}
+
+
+def _allreduce_legs(quick: bool) -> Dict[str, Dict]:
+    nbytes = (1 << 20) if quick else (16 << 20)
+    iters = 4 if quick else 15
+    warmup = 1 if quick else 3
+    samples = 1 if quick else 3
+    legs: Dict[str, Dict] = {}
+    with tempfile.TemporaryDirectory(prefix="mpi_tpu_hotpath_") as td:
+        script = os.path.join(td, "hotpath_rank.py")
+        with open(script, "w") as f:
+            f.write(_PROG.format(repo=REPO, pvars=tuple(_PVARS)))
+        base = {"HOTPATH_NBYTES": str(nbytes),
+                "HOTPATH_ITERS": str(iters),
+                "HOTPATH_WARMUP": str(warmup)}
+        for mode, overrides in _MODES.items():
+            runs = [_run_world(script, dict(base, **overrides))
+                    for _ in range(samples)]
+            best = min(runs, key=lambda r: r["p50_us"])
+            pv = best["pvars"]
+            frames = max(1, pv["msgs_sent"])
+            legs[mode] = {
+                "nbytes": nbytes, "iters": iters, "samples": samples,
+                "p50_us": round(best["p50_us"], 1),
+                "p50_us_samples": [round(r["p50_us"], 1) for r in runs],
+                "pvars": pv,
+                "syscalls_per_frame": round(
+                    pv["link_send_syscalls"] / frames, 3),
+            }
+    return legs
+
+
+def _lease_arena_leg(quick: bool) -> Dict:
+    from mpi_tpu import serve
+
+    with serve.WorldServer(pool_size=2, backend="shm",
+                           detect_timeout_s=2.0,
+                           heartbeat_s=0.25) as srv:
+        client = serve.connect(srv)
+        try:
+            n = 4096 if quick else 65536
+            v1, hits1, names1 = client.run(serve.job_allreduce_arena, n,
+                                           nranks=2, timeout=60.0)
+            v2, hits2, names2 = client.run(serve.job_allreduce_arena, n,
+                                           nranks=2, timeout=60.0)
+        finally:
+            client.close()
+    return {"value": v1, "expect": 3.0,
+            "coll_sm_hits_first": hits1, "coll_sm_hits_second": hits2,
+            "arena_reused": bool(names1 and names1 == names2),
+            "ok": (v1 == 3.0 and v2 == 3.0 and hits1 > 0 and hits2 > 0
+                   and bool(names1) and names1 == names2)}
+
+
+def run_hotpath(quick: bool = False) -> Dict:
+    t0 = time.time()
+    legs = _allreduce_legs(quick)
+    lease = _lease_arena_leg(quick)
+    zc = legs["healing_on_zero_copy"]
+    off = legs["healing_off"]
+    zc_pv, off_pv = zc["pvars"], off["pvars"]
+    # the decoupling acceptance: retention priced WITHOUT copies on the
+    # no-reuse path, and payload_copies identical to the no-retention
+    # floor (retention never leaks into the codec plane's number)
+    decoupled = (zc_pv["link_bytes_retained"] > 0
+                 and zc_pv["link_cow_snapshots"] == 0
+                 and zc_pv["payload_copies"] == off_pv["payload_copies"])
+    result = {
+        "quick": quick,
+        "legs": legs,
+        "healing_on_over_off_p50": round(
+            zc["p50_us"] / off["p50_us"], 3),
+        "retain_copy_over_off_p50": round(
+            legs["healing_on_retain_copy"]["p50_us"] / off["p50_us"], 3),
+        "retention_without_copy": decoupled,
+        "lease_arena": lease,
+        "oversubscribed": 3 > (os.cpu_count() or 1),
+        "wall_s": round(time.time() - t0, 1),
+    }
+    result["ok"] = (
+        decoupled and lease["ok"]
+        # one vectored sendmsg per frame (a 16MB ring frame is 3+ wire
+        # parts; pre-sendmsg this ratio was >= 2)
+        and zc["syscalls_per_frame"] <= 1.25
+        # "within this box's noise": generous on an oversubscribed
+        # 2-core host whose cells swing 2-3x — the structural pvars
+        # above are the sharp acceptance, the ratio is the honest
+        # story.  Quick mode (1 sample, tier-1 smoke) stays
+        # structural-only: a single contended sample must not flake CI.
+        and (quick or result["healing_on_over_off_p50"] < 1.6))
+    return result
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--quick", action="store_true",
+                    help="smoke mode: 1MB payload, 1 sample per mode")
+    ap.add_argument("--out-pre", default=None,
+                    help="write the eager-retain (ISSUE 10) doc here")
+    ap.add_argument("--out-post", default=None,
+                    help="write the zero-copy (ISSUE 11) doc here")
+    args = ap.parse_args(argv)
+    result = run_hotpath(quick=args.quick)
+    if args.out_pre:
+        pre = {"mode": "eager-retain (ISSUE 10 semantics: "
+                       "link_retain_copy=1)",
+               "quick": result["quick"],
+               "legs": {k: result["legs"][k] for k in
+                        ("healing_off", "healing_on_retain_copy")},
+               "healing_on_over_off_p50":
+                   result["retain_copy_over_off_p50"],
+               "oversubscribed": result["oversubscribed"]}
+        with open(args.out_pre, "w") as f:
+            json.dump(pre, f, indent=2)
+    if args.out_post:
+        post = {"mode": "zero-copy (ISSUE 11: retention by reference "
+                        "+ CoW + sendmsg)",
+                "quick": result["quick"],
+                "legs": {k: result["legs"][k] for k in
+                         ("healing_off", "healing_on_zero_copy")},
+                "healing_on_over_off_p50":
+                    result["healing_on_over_off_p50"],
+                "retention_without_copy":
+                    result["retention_without_copy"],
+                "lease_arena": result["lease_arena"],
+                "oversubscribed": result["oversubscribed"]}
+        with open(args.out_post, "w") as f:
+            json.dump(post, f, indent=2)
+    print(json.dumps(result, indent=2))
+    return 0 if result["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -87,6 +87,21 @@ def busbw_gbps(bench: str, nbytes: int, p: int, seconds: float) -> float:
 # ---------------------------------------------------------------------------
 
 
+# Arena-gate spellings (ISSUE 11 satellite: measured rows for the
+# coll_sm INTERNAL gates, PR-9's consult-only residual).  Each maps a
+# pseudo-algorithm to (real algorithm, forced coll_sm_eager_bytes): the
+# gate under sweep is the eager constant itself, so the leg pins it to
+# one side around an ``algorithm="sm"`` run — every rank applies the
+# same override in the same cell order, keeping the group coherent.
+# ``sm_reduce``'s "tree" side needs no spelling: it IS the plain wire
+# algorithm ("tree"), measured as such.
+_GATE_LEGS = {
+    ("allreduce", "sm_flat"): ("sm", 1 << 62),   # flat P·N slot folds
+    ("allreduce", "sm_chunked"): ("sm", 0),      # block in-place folds
+    ("reduce", "sm_arena"): ("sm", 1 << 62),     # flat root fold
+}
+
+
 def _cpu_collective_call(comm, bench: str, x: np.ndarray, algo: str):
     if bench == "allreduce":
         return comm.allreduce(x, algorithm=algo)
@@ -202,19 +217,32 @@ def cpu_bench_program(comm, bench: str, sizes: List[int], algos: List[str],
         else:
             x = np.zeros(max(1, nbytes // 4), np.float32)
         for algo in algos:
+            real_algo, forced_eager = _GATE_LEGS.get((bench, algo),
+                                                     (algo, None))
             try:
-                comm.barrier()
-                samples = []
-                for i in range(warmup + iters):
-                    t0 = time.perf_counter()
-                    _cpu_collective_call(comm, bench, x, algo)
-                    dt = time.perf_counter() - t0
-                    if i >= warmup:
-                        samples.append(dt)
-                # report the slowest rank's median (collective completion time)
-                p50 = float(np.asarray(comm.allreduce(
-                    np.float64(statistics.median(samples)), op=mpi_tpu.MAX,
-                    algorithm="reduce_bcast")))
+                if forced_eager is not None:
+                    old_eager = mpi_tpu.mpit.cvar_read(
+                        "coll_sm_eager_bytes")
+                    mpi_tpu.mpit.cvar_write("coll_sm_eager_bytes",
+                                            forced_eager)
+                try:
+                    comm.barrier()
+                    samples = []
+                    for i in range(warmup + iters):
+                        t0 = time.perf_counter()
+                        _cpu_collective_call(comm, bench, x, real_algo)
+                        dt = time.perf_counter() - t0
+                        if i >= warmup:
+                            samples.append(dt)
+                    # report the slowest rank's median (collective
+                    # completion time)
+                    p50 = float(np.asarray(comm.allreduce(
+                        np.float64(statistics.median(samples)),
+                        op=mpi_tpu.MAX, algorithm="reduce_bcast")))
+                finally:
+                    if forced_eager is not None:
+                        mpi_tpu.mpit.cvar_write("coll_sm_eager_bytes",
+                                                old_eager)
             except ValueError as e:
                 if comm.rank == 0:
                     rows.append({"bench": bench, "bytes": nbytes, "algorithm": algo,

@@ -1,0 +1,257 @@
+"""Refcounted buffer ownership at the codec seam (ISSUE 11 tentpole).
+
+The resilient socket link (mpi_tpu/resilience.py) must be able to
+REPLAY any unacked frame after a connection reset, which is why ISSUE 10
+snapshotted every frame body into the retained window — a full memcpy
+per frame on the default (healing-on) hot path.  The UCX
+registration-cache / NCCL buffer-pool designs show the cheaper shape:
+**own buffers by reference, copy only on proven reuse**.  This module is
+that ownership layer:
+
+* :class:`BufRef` — one retained frame body as a list of buffer views
+  (the header/meta ``bytes`` plus memoryviews of the caller's arrays),
+  refcounted two ways: a **pin count** held while a thread is streaming
+  the views onto a socket (first transmission or replay), and
+  registration in the module-wide live-range index while any view is
+  still mutable caller memory.
+* **Copy-on-write** — :func:`touch` consults the live-range index (the
+  same address-interval overlap rule the runtime verifier's
+  buffer-overlap lint uses for pending nonblocking buffers) and
+  SNAPSHOTS every overlapping un-snapshotted ref — one flat ``bytes``
+  copy, made BEFORE the caller's write lands, so a later replay is
+  bit-exact.  Every in-place mutation path inside mpi_tpu notifies:
+  ``ReduceOp.combine_into`` (all fold sites), the segmented engine's
+  copy-into-working-buffer sites, ``isendrecv_replace``'s completion
+  refill, and the verifier's write-buffer registration.  A caller that
+  mutates a sent buffer OUTSIDE any mpi_tpu operation must call
+  :func:`note_write` first (the documented borrow contract), or set the
+  ``link_retain_copy`` cvar to restore ISSUE 10's eager-copy semantics
+  wholesale.
+* The **reuse-on-send** trigger — sending a region that overlaps a
+  still-retained (unacked) frame also snapshots the older frames, so
+  repeated sends from one buffer never share mutable views.
+
+Pricing: ``link_bytes_retained`` keeps counting every retained body
+byte (retention is still the resilience price — it pins memory and
+bounds replay), but the no-reuse path now takes ZERO copies;
+``link_cow_snapshots`` / ``link_cow_bytes`` price exactly the copies
+that reuse forced.  ``payload_copies`` deliberately does NOT tick for
+CoW: it is the codec plane's number, and CoW firing depends on ack
+timing, which would make exact-copy-count tests nondeterministic.
+
+Pinning rule: a snapshot must never race a thread that is mid-
+``sendmsg`` over the same views (the wire would carry half-mutated
+bytes).  ``pin()`` marks the views in use; :func:`touch` waits for the
+pin count to drain before snapshotting.  ``release()`` (ack prune /
+membership purge) defers freeing until the last pin drops, and a
+replay that finds its ref already released simply skips the frame —
+an acked frame was delivered, so the receiver dedups it anyway.
+
+Everything here is transport-agnostic bookkeeping; transport/socket.py
+does the wire work and mpi_tpu/resilience.py owns the window.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import List, Optional, Sequence, Tuple
+
+from . import mpit as _mpit
+
+# One process-wide condition guards every ref's pins/parts AND the
+# live-range index: CoW, pinning, and release are rare enough that
+# registry-level sharding would buy nothing, and a single lock makes
+# the wait-for-pins protocol trivially correct.  ``_NLIVE`` is the
+# lock-free fast-path gate — the count of range-bearing live refs —
+# read without the lock by touch()/active() so the common case (no
+# socket retention anywhere in the process: shm/local worlds, healing
+# off, everything acked) costs one int compare per fold.
+_cv = threading.Condition()
+_live: dict = {}   # id(ref) -> ref, refs that still hold mutable ranges
+_NLIVE = 0
+
+
+def _addr_range(arr) -> Optional[Tuple[int, int]]:
+    """[start, end) of an ndarray's backing bytes, or None for payloads
+    with no stable buffer address (the same guard the verifier's
+    buffer-overlap lint uses)."""
+    try:
+        start = int(arr.__array_interface__["data"][0])
+        nbytes = int(arr.nbytes)
+    except (AttributeError, KeyError, TypeError):
+        return None
+    return (start, start + nbytes)
+
+
+class BufRef:
+    """One retained frame body, by reference until acked or copied."""
+
+    __slots__ = ("_iov", "_owners", "ranges", "nbytes", "_pins",
+                 "snapshotted", "_released")
+
+    def __init__(self, parts: Sequence, register: bool = True) -> None:
+        iov: List[memoryview] = []
+        owners = []
+        ranges: List[Tuple[int, int]] = []
+        nbytes = 0
+        for p in parts:
+            if isinstance(p, (bytes, bytearray, memoryview)):
+                mv = memoryview(p)
+                if mv.nbytes:
+                    iov.append(mv if mv.format == "B" and mv.ndim == 1
+                               else mv.cast("B"))
+                    nbytes += mv.nbytes
+                continue
+            # an ndarray (contiguous — codec compacted it): keep the
+            # OWNER alive too, which is what vetoes codec.RECV_POOL
+            # recycling a pooled array that is still retained here
+            if not p.nbytes:
+                continue
+            iov.append(memoryview(p).cast("B"))
+            owners.append(p)
+            r = _addr_range(p)
+            if r is not None:
+                ranges.append(r)
+            nbytes += int(p.nbytes)
+        self._iov = iov
+        self._owners = tuple(owners)
+        self.ranges = tuple(ranges)
+        self.nbytes = nbytes
+        self._pins = 0
+        self.snapshotted = not self.ranges  # immutable bodies need no CoW
+        self._released = False
+        if register and self.ranges:
+            _register(self)
+
+    # -- streaming (transport/socket.py) -----------------------------------
+
+    def pin(self) -> Optional[List[memoryview]]:
+        """Borrow the views for one streaming pass (sendmsg/sendall);
+        None when the ref was already released (frame acked mid-replay:
+        safe to skip — the receiver delivered it and dedups a replay).
+        Pair with :meth:`unpin`."""
+        with _cv:
+            if self._released:
+                return None
+            self._pins += 1
+            return list(self._iov)
+
+    def unpin(self) -> None:
+        with _cv:
+            self._pins -= 1
+            if self._released and self._pins == 0:
+                self._clear_locked()
+            _cv.notify_all()
+
+    # -- ownership transitions ---------------------------------------------
+
+    def snapshot(self) -> None:
+        """Eager-copy spelling (the ``link_retain_copy`` cvar and pickle
+        bodies): one flat bytes, counted as retention only — policy,
+        not reuse, so the CoW pvars stay a pure reuse signal."""
+        with _cv:
+            self._snapshot_locked(count_cow=False)
+
+    def _snapshot_locked(self, count_cow: bool = True) -> None:
+        if self.snapshotted or self._released:
+            return
+        while self._pins:
+            # a sender is streaming these exact views: copying under a
+            # concurrent sendmsg is fine, but the CALLER of touch() is
+            # about to MUTATE them — it must not proceed until the
+            # in-flight pass is off the buffer
+            _cv.wait(0.05)
+            if self.snapshotted or self._released:
+                return
+        blob = b"".join(bytes(mv) for mv in self._iov)
+        self._iov = [memoryview(blob)]
+        self._owners = ()
+        self.snapshotted = True
+        _unregister_locked(self)
+        if count_cow:
+            _mpit.count(link_cow_snapshots=1, link_cow_bytes=len(blob))
+
+    def release(self) -> None:
+        """Ack prune / membership purge / window teardown: drop the
+        ranges from the index now; free the views once unpinned."""
+        with _cv:
+            if self._released:
+                return
+            self._released = True
+            _unregister_locked(self)
+            if self._pins == 0:
+                self._clear_locked()
+            _cv.notify_all()
+
+    def _clear_locked(self) -> None:
+        self._iov = []
+        self._owners = ()
+
+    def tobytes(self) -> bytes:
+        """Flat body content (tests / diagnostics)."""
+        with _cv:
+            return b"".join(bytes(mv) for mv in self._iov)
+
+
+def _register(ref: BufRef) -> None:
+    global _NLIVE
+    with _cv:
+        _live[id(ref)] = ref
+        _NLIVE = len(_live)
+
+
+def _unregister_locked(ref: BufRef) -> None:
+    global _NLIVE
+    _live.pop(id(ref), None)
+    _NLIVE = len(_live)
+
+
+def live_refs() -> int:
+    """Range-bearing retained refs process-wide (test introspection)."""
+    with _cv:
+        return len(_live)
+
+
+def touch_ranges(ranges: Sequence[Tuple[int, int]],
+                 exclude: Optional[BufRef] = None) -> int:
+    """Copy-on-write core: snapshot every live retained ref overlapping
+    any of ``ranges`` (address intervals), BEFORE the caller's write or
+    conflicting send proceeds.  Returns snapshots taken."""
+    if not _NLIVE or not ranges:
+        return 0
+    took = 0
+    with _cv:
+        for ref in list(_live.values()):
+            if ref is exclude or ref.snapshotted:
+                continue
+            hit = any(s < e2 and s2 < e
+                      for (s, e) in ref.ranges
+                      for (s2, e2) in ranges)
+            if hit:
+                ref._snapshot_locked()
+                took += 1
+    return took
+
+
+def touch(arr) -> int:
+    """Notify the ownership layer that ``arr``'s bytes are about to be
+    WRITTEN in place.  Called by every internal mutation site (fold
+    sites via ``ReduceOp.combine_into``, the segmented engine's
+    copy-into-buffer sites, ``isendrecv_replace``'s refill, the
+    verifier's write-buffer registration); snapshot-copies any retained
+    unacked frame still referencing the region.  Near-free when nothing
+    is retained (one int compare)."""
+    if not _NLIVE:
+        return 0
+    r = _addr_range(arr)
+    if r is None:
+        return 0
+    return touch_ranges((r,))
+
+
+def note_write(arr) -> int:
+    """Public spelling of :func:`touch` — the borrow contract's hook for
+    user code that mutates a just-sent buffer outside any mpi_tpu
+    operation (see README "Buffer ownership").  Returns the number of
+    retained frames snapshotted."""
+    return touch(arr)

@@ -114,7 +114,10 @@ def gate(comm) -> Tuple[str, ...]:
 def arena_for(comm) -> Optional["Arena"]:
     """This communicator's arena, created collectively on first use; None
     when the arena cannot serve it (socket/local transport, size-1 group,
-    a nonblocking-collective clone, or the cvar kill switch)."""
+    a nonblocking-collective clone, or the cvar kill switch).  A
+    communicator stamped with ``_coll_sm_pool_ctx`` (serve lease comms,
+    ISSUE 11) resolves through the transport-level POOL instead: one
+    epoch-stamped arena per worker set, reused across leases."""
     if _ARENA_BYTES <= 0 or comm.size < 2:
         return None
     if not getattr(comm._t, "supports_coll_sm", False):
@@ -123,9 +126,53 @@ def arena_for(comm) -> Optional["Arena"]:
         return None
     arena = comm.__dict__.get("_coll_sm_arena")
     if arena is None:
-        arena = Arena(comm)
+        pool_ctx = getattr(comm, "_coll_sm_pool_ctx", None)
+        if pool_ctx is not None:
+            arena = _pooled_arena(comm, pool_ctx)
+        else:
+            arena = Arena(comm)
         comm._coll_sm_arena = arena
     return arena
+
+
+def _pooled_arena(comm, pool_ctx: Tuple) -> "Arena":
+    """Arena reuse across serve leases (ISSUE 11 tentpole #3, closes
+    PR-7 residual (a)): lease communicators get fresh contexts per job,
+    so routing them through the per-communicator path would map (and
+    unlink) a multi-MB /dev/shm segment PER LEASE — which is why leases
+    skipped the arena tier entirely.  Instead the arena is keyed
+    ``(pool_ctx, worker set)`` in the transport's ``_coll_arenas``
+    registry (the same dict world finalize already tears down) and
+    survives lease teardown: the next lease over the same workers
+    remaps NOTHING and rides the warm one-copy tier.
+
+    ``pool_ctx`` carries the pool's membership EPOCH as granted by the
+    server with the lease (one value for the whole group — a local
+    ``t.epoch`` read could race a concurrent transition broadcast and
+    split the group across two segment names).  An epoch bump after a
+    worker death retires the old segment: the first same-group lease
+    under the new epoch closes the stale arena (the creator unlinks)
+    and builds a fresh one the replacement worker can map.  Barrier
+    sequence state lives in the mapped flag lines themselves (each
+    rank resumes from its own posted value — see Arena.__init__), so a
+    rank that re-attaches stays in lockstep with peers that kept their
+    handles."""
+    t = comm._t
+    pool = t._coll_arenas = getattr(t, "_coll_arenas", {})
+    key = (pool_ctx, comm._group)
+    arena = pool.get(key)
+    if arena is not None and not arena._closed:
+        return arena
+    # retire stale same-group arenas from older epochs: survivors hold
+    # handles to a segment the replacement worker must never map
+    for (ctx2, grp2) in list(pool):
+        if (grp2 == comm._group and ctx2 != pool_ctx
+                and isinstance(ctx2, tuple) and ctx2[:1] == pool_ctx[:1]):
+            # force_unlink: the stale segment's CREATOR may be exactly
+            # the dead worker this epoch bump mourned — without it the
+            # multi-MB /dev/shm segment would outlive every handle
+            pool.pop((ctx2, grp2)).close(force_unlink=True)
+    return Arena(comm, ctx=pool_ctx)
 
 
 def _arena_name(session: str, ctx, group) -> str:
@@ -146,7 +193,7 @@ class Arena:
     """One mapped collective arena: flag lines + data slots + the sliced
     flag-wait that converts peer death into ProcFailedError."""
 
-    def __init__(self, comm):
+    def __init__(self, comm, ctx=None):
         from .native import load_shmring
 
         t = comm._t
@@ -162,7 +209,12 @@ class Arena:
         self.slot_bytes = slot
         self.capacity = slot - _META_MAX  # payload bytes per slot
         nbytes = _LINE * p + slot * p
-        self.name = _arena_name(t._session, comm._ctx, comm._group)
+        # ``ctx`` overrides the naming/registration context: pooled
+        # lease arenas (ISSUE 11) must share one name across leases
+        # whose communicator contexts differ per job
+        if ctx is None:
+            ctx = comm._ctx
+        self.name = _arena_name(t._session, ctx, comm._group)
         self._creator = comm.rank == 0
         with _LIVE_LOCK:
             ent = _LIVE.setdefault(self.name, {"refs": 0, "creator": False})
@@ -224,13 +276,19 @@ class Arena:
         self._cbuf = cbuf  # keeps the mapping's python view alive
         self._mem: Optional[np.ndarray] = np.frombuffer(cbuf, np.uint8)
         self._slots_off = _LINE * p
-        self.seq = 0
+        # Barrier sequence resumes from THIS RANK'S OWN FLAG LINE: a
+        # fresh segment reads 0 (created zero-filled — identical to the
+        # old constant), and a pooled-arena rank that dropped and
+        # re-attached its handle (ISSUE 11 lease pooling) resumes in
+        # lockstep with peers that kept theirs — the mapped flags, not
+        # per-handle counters, are the authoritative barrier state.
+        self.seq = int(self._lib.shmflag_read(self._flag_addr(self._rank)))
         self._closed = False
         self._active = 0  # collectives currently touching the mapping
         # registered on the TRANSPORT (arenas of sub-communicators share
         # it), closed by ShmTransport.close() at world finalize
         t._coll_arenas = getattr(t, "_coll_arenas", {})
-        t._coll_arenas[(comm._ctx, comm._group)] = self
+        t._coll_arenas[(ctx, comm._group)] = self
 
     # -- slots -------------------------------------------------------------
 
@@ -336,7 +394,7 @@ class Arena:
     def _end(self) -> None:
         self._active -= 1
 
-    def close(self) -> None:
+    def close(self, force_unlink: bool = False) -> None:
         if self._closed:
             return
         self._closed = True
@@ -356,7 +414,12 @@ class Arena:
                 ent["refs"] -= 1
                 if ent["refs"] <= 0:
                     _LIVE.pop(self.name, None)
-        if self._creator:
+        # ``force_unlink``: pooled lease arenas retired by an epoch
+        # bump (ISSUE 11) may have lost their creator with the dead
+        # worker — every survivor unlinks; shm_unlink of an
+        # already-gone name is a harmless ENOENT (return unchecked,
+        # like the creator path always was)
+        if self._creator or force_unlink:
             self._lib.shmarena_unlink(self.name.encode())
             if self._flag_file is not None:
                 try:

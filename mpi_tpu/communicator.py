@@ -31,6 +31,7 @@ from typing import Any, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from . import bufpool as _bufpool
 from . import coll_sm as _coll_sm
 from . import compress as _compress
 from . import mpit as _mpit
@@ -463,6 +464,10 @@ class _ReplaceRequest(Request):
         import numpy as _np
 
         if isinstance(self._buf, _np.ndarray):
+            # the refill mutates the caller's SEND buffer in place —
+            # which a resilient link may still retain by reference
+            # (copy-on-write before the write, mpi_tpu/bufpool.py)
+            _bufpool.touch(self._buf)
             self._buf[...] = got
         self._done, self._value = True, got
         return got
@@ -647,6 +652,7 @@ class PersistentRequest(Request):
             self._comm._verify.world.buffer_release(self._buf_key)
             self._buf_key = None
         if self._kind == "recv" and isinstance(self._buf, np.ndarray):
+            _bufpool.touch(self._buf)  # ownership CoW before the refill
             self._buf[...] = value
 
 
@@ -1985,6 +1991,10 @@ class P2PCommunicator(Communicator):
                         raise
                     view = work[lo:hi]
                     if op is None:
+                        # ownership CoW (bufpool.py): the working
+                        # buffer's spans were just SENT — retained
+                        # frames must snapshot before this overwrite
+                        _bufpool.touch(view)
                         view[...] = got if decode is None else decode(got)
                     else:
                         op.combine_into(view, got, decode)
@@ -2010,6 +2020,7 @@ class P2PCommunicator(Communicator):
                     raise
                 view = work[lo:hi]
                 if op is None:
+                    _bufpool.touch(view)  # see the engine path above
                     view[...] = got if decode is None else decode(got)
                 else:
                     op.combine_into(view, got, decode)

@@ -48,7 +48,8 @@ class _Counters:
                  "comp_saved", "comp_fallbacks",
                  "tuned_hits", "tuned_fallbacks",
                  "link_reconnects", "link_replayed", "link_masked",
-                 "link_retained")
+                 "link_retained", "link_cow_snaps", "link_cow_bytes",
+                 "link_syscalls")
 
     def __init__(self) -> None:
         self.sends = 0
@@ -87,6 +88,9 @@ class _Counters:
         self.link_replayed = 0
         self.link_masked = 0
         self.link_retained = 0
+        self.link_cow_snaps = 0
+        self.link_cow_bytes = 0
+        self.link_syscalls = 0
 
 
 counters = _Counters()  # incremented by communicator.py / codec.py (count())
@@ -113,7 +117,10 @@ def count(sends: int = 0, send_bytes: int = 0, recvs: int = 0,
           link_reconnects: int = 0,
           link_frames_replayed: int = 0,
           link_faults_masked: int = 0,
-          link_bytes_retained: int = 0) -> None:
+          link_bytes_retained: int = 0,
+          link_cow_snapshots: int = 0,
+          link_cow_bytes: int = 0,
+          link_send_syscalls: int = 0) -> None:
     """Thread-safe increment (rank-threads of the local backend share
     this process's counters; unsynchronized += would lose updates)."""
     with _lock:
@@ -153,6 +160,9 @@ def count(sends: int = 0, send_bytes: int = 0, recvs: int = 0,
         counters.link_replayed += link_frames_replayed
         counters.link_masked += link_faults_masked
         counters.link_retained += link_bytes_retained
+        counters.link_cow_snaps += link_cow_snapshots
+        counters.link_cow_bytes += link_cow_bytes
+        counters.link_syscalls += link_send_syscalls
 
 _PVARS: Dict[str, Callable[[], int]] = {
     "msgs_sent": lambda: counters.sends,
@@ -256,6 +266,19 @@ _PVARS: Dict[str, Callable[[], int]] = {
     "link_frames_replayed": lambda: counters.link_replayed,
     "link_faults_masked": lambda: counters.link_masked,
     "link_bytes_retained": lambda: counters.link_retained,
+    # refcounted buffer ownership (mpi_tpu/bufpool.py, ISSUE 11):
+    # retained frames are now by-REFERENCE views of the caller's
+    # buffers, so link_bytes_retained prices retention (pinned memory,
+    # replay bound) without a copy; these two price exactly the
+    # copy-on-write snapshots that buffer REUSE forced (fold into /
+    # conflicting send over / posted write buffer on a still-unacked
+    # region).  Zero on the no-reuse path — the decoupling the ISSUE 11
+    # acceptance demands.  link_send_syscalls counts data-plane socket
+    # write calls (one vectored sendmsg per frame on the batched path,
+    # vs one write per header/meta/segment before it).
+    "link_cow_snapshots": lambda: counters.link_cow_snaps,
+    "link_cow_bytes": lambda: counters.link_cow_bytes,
+    "link_send_syscalls": lambda: counters.link_syscalls,
 }
 
 
@@ -567,6 +590,15 @@ def _ensure_builtin_cvars() -> None:
                     "(0 = first-failure raise)")
             _resilience._CONNECT_RETRY_TIMEOUT_S = float(v)
 
+        def _set_retain_copy(v):
+            _resilience._RETAIN_COPY = int(bool(int(v)))
+
+        def _set_keepalive(v):
+            if float(v) < 0:
+                raise ValueError(
+                    "link_keepalive_s must be >= 0 (0 = no probing)")
+            _resilience._KEEPALIVE_S = float(v)
+
         def _set_epoch_grace(v):
             if float(v) < 0:
                 raise ValueError("epoch_grace_s must be >= 0")
@@ -599,6 +631,27 @@ def _ensure_builtin_cvars() -> None:
             "the window is what a reconnect replays, so it bounds both "
             "memory and replay time.  MPI_TPU_LINK_WINDOW_BYTES seeds "
             "the default")
+        _CVARS["link_retain_copy"] = (
+            lambda: _resilience._RETAIN_COPY, _set_retain_copy,
+            "retained-window ownership mode (mpi_tpu/bufpool.py): 0 "
+            "(default) retains frame bodies BY REFERENCE with "
+            "copy-on-write on proven reuse — zero copies on the "
+            "no-reuse hot path, but a buffer mutated outside any "
+            "mpi_tpu operation while its frames are unacked needs "
+            "bufpool.note_write() first (the borrow contract); 1 "
+            "restores the eager per-frame snapshot (strict MPI "
+            "buffered-send reusability, one memcpy per frame).  "
+            "MPI_TPU_LINK_RETAIN_COPY seeds the default")
+        _CVARS["link_keepalive_s"] = (
+            lambda: _resilience._KEEPALIVE_S, _set_keepalive,
+            "idle-link keepalive cadence of the resilient socket "
+            "transport: connections that sent nothing for this long "
+            "are probed with a header-only ack frame by the ack "
+            "flusher, so a link torn while IDLE heals proactively "
+            "instead of spiking the next send's latency.  0 disables "
+            "probing; ignored entirely when link healing is off "
+            "(link_retry_timeout_s = 0).  MPI_TPU_LINK_KEEPALIVE_S "
+            "seeds the default")
         _CVARS["connect_retry_timeout_s"] = (
             lambda: _resilience._CONNECT_RETRY_TIMEOUT_S,
             _set_connect_retry,

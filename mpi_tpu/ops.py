@@ -20,6 +20,8 @@ from typing import Any, Callable
 
 import numpy as np
 
+from . import bufpool as _bufpool
+
 
 def _is_jax(x: Any) -> bool:
     mod = type(x).__module__
@@ -72,6 +74,14 @@ class ReduceOp:
         exchanges, arena slots) splits the dtypes identically."""
         if decode is not None:
             value = decode(value)
+        # buffer-ownership notification (mpi_tpu/bufpool.py, ISSUE 11):
+        # every fold mutates ``acc`` in place, and ``acc`` may still be
+        # RETAINED by reference in a resilient link's unacked replay
+        # window (ring/halving exchanges send the working buffer they
+        # then fold into) — snapshot any overlapping retained frame
+        # BEFORE the write lands so a replay stays bit-exact.  One int
+        # compare when nothing is retained anywhere in the process.
+        _bufpool.touch(acc)
         if self.ufunc is not None:
             self.ufunc(acc, value, out=acc)
             return acc

@@ -17,16 +17,23 @@ Design (the user-space analogue of the kernel TCP send buffer):
 * every data frame to a destination carries a **per-destination
   sequence number** (monotone from 1, assigned in wire order under the
   per-dest send lock);
-* the sender **retains a copy of each in-flight frame** in a bounded
+* the sender **retains each in-flight frame BY REFERENCE** in a bounded
   window (``link_window_bytes`` mpit cvar) until the receiver's
   **cumulative ack** covers it.  Acks are piggybacked on every data
   frame headed the other way and flushed by a per-transport idle
-  flusher, so one-way streams are acked too.  The copy is deliberate —
-  the caller may reuse its buffer the moment ``send`` returns (MPI
-  buffered-send semantics), so replay-after-reset is only bit-exact
-  from a snapshot; this is exactly the copy the kernel socket buffer
-  made before a reset discarded it.  ``link_bytes_retained`` counts it
-  honestly;
+  flusher, so one-way streams are acked too.  ISSUE 10 snapshotted
+  every body into flat ``bytes`` here (a full memcpy per frame — the
+  resilience price the zero-copy plane paid on its default path);
+  ISSUE 11 replaced the snapshot with a refcounted
+  :class:`mpi_tpu.bufpool.BufRef` over the caller's buffers,
+  **copy-on-write** only when the ownership layer sees the region
+  reused while unacked (fold sites, conflicting sends, write-buffer
+  posts — see bufpool.py for the borrow contract and the
+  ``link_retain_copy`` cvar that restores eager snapshots).
+  ``link_bytes_retained`` still counts every retained byte (retention
+  pins memory and bounds replay time whether or not it copied);
+  ``link_cow_snapshots``/``link_cow_bytes`` price exactly the copies
+  reuse forced;
 * the receiver **dedups by (src, seq)**: only the next contiguous
   sequence is delivered, anything at-or-below the high-water mark is a
   replay duplicate and dropped, and a *gap* is a protocol error (TCP
@@ -61,6 +68,7 @@ import time
 from collections import deque
 from typing import Callable, Deque, Dict, Iterator, List, Optional, Tuple
 
+from . import bufpool as _bufpool
 from . import mpit as _mpit
 from .transport.base import TransportError
 
@@ -83,6 +91,23 @@ _RETRY_TIMEOUT_S = float(os.environ.get("MPI_TPU_LINK_RETRY_S", "4.0"))
 # mpit cvar: link_window_bytes; env default: MPI_TPU_LINK_WINDOW_BYTES.
 _WINDOW_BYTES = int(os.environ.get("MPI_TPU_LINK_WINDOW_BYTES",
                                    str(64 << 20)))
+
+# Eager-snapshot escape hatch (ISSUE 11): 1 restores ISSUE 10's
+# copy-at-retain semantics wholesale — strict MPI buffered-send
+# reusability with zero caller obligations, at one memcpy per frame.
+# Default 0: retain by reference, copy-on-write on proven reuse.
+# mpit cvar: link_retain_copy; env default: MPI_TPU_LINK_RETAIN_COPY.
+_RETAIN_COPY = int(os.environ.get("MPI_TPU_LINK_RETAIN_COPY", "0"))
+
+# Idle-link keepalive cadence (ISSUE 11 satellite, closes PR-10
+# residual (b)): the ack flusher probes every cached connection that
+# has sent nothing for this long with a header-only ack frame, so a
+# link torn while IDLE (peer-side reset after our last sendall
+# returned) is discovered and healed by the probe instead of adding a
+# reconnect latency spike to the next real send.  0 disables probing.
+# Only meaningful with healing enabled (link_retry_timeout_s > 0).
+# mpit cvar: link_keepalive_s; env default: MPI_TPU_LINK_KEEPALIVE_S.
+_KEEPALIVE_S = float(os.environ.get("MPI_TPU_LINK_KEEPALIVE_S", "1.0"))
 
 # Initial-connect retry budget for control-plane clients
 # (serve.ServerClient / mpi_tpu.connect): ConnectionRefusedError is
@@ -141,8 +166,8 @@ def retry_connect(dial: Callable[[], "object"],
 
 class _TxState:
     """Per-destination sender stream: next seq, the retained unacked
-    frames (seq, header word, body snapshot), and the cumulative ack
-    high-water mark received back from the peer."""
+    frames (seq, header word, body :class:`bufpool.BufRef`), and the
+    cumulative ack high-water mark received back from the peer."""
 
     __slots__ = ("seq", "acked", "retained", "retained_bytes",
                  "was_connected")
@@ -150,7 +175,7 @@ class _TxState:
     def __init__(self) -> None:
         self.seq = 0          # last sequence number assigned
         self.acked = 0        # highest cumulative ack received
-        self.retained: Deque[Tuple[int, int, bytes]] = deque()
+        self.retained: Deque[Tuple[int, int, _bufpool.BufRef]] = deque()
         self.retained_bytes = 0
         # whether a connection to this destination was ever established:
         # distinguishes a RE-connect (counted in link_reconnects) from
@@ -282,16 +307,21 @@ class LinkState:
                         f"retained bytes (window {_WINDOW_BYTES}); "
                         f"declaring the link dead")
 
-    def tx_retain(self, dest: int, word: int, body: bytes) -> int:
+    def tx_retain(self, dest: int, word: int, body) -> int:
         """Assign the next sequence number for ``dest`` and retain the
-        frame snapshot until acked.  Caller holds the per-dest send
-        lock (seq order must equal wire order)."""
+        frame body — a :class:`bufpool.BufRef` (by-reference views of
+        the caller's buffers, ISSUE 11) or raw ``bytes`` (wrapped into
+        an immutable ref; unit tests and pickle blobs) — until acked.
+        Caller holds the per-dest send lock (seq order must equal wire
+        order)."""
+        if not isinstance(body, _bufpool.BufRef):
+            body = _bufpool.BufRef([bytes(body)], register=False)
         with self._lock:
             st = self._tx_of(dest)
             st.seq += 1
             st.retained.append((st.seq, word, body))
-            st.retained_bytes += len(body)
-            _mpit.count(link_bytes_retained=len(body))
+            st.retained_bytes += body.nbytes
+            _mpit.count(link_bytes_retained=body.nbytes)
             return st.seq
 
     def tx_next_seq(self, dest: int) -> int:
@@ -322,11 +352,12 @@ class LinkState:
             retained = st.retained
             while retained and retained[0][0] <= ack:
                 _, _, body = retained.popleft()
-                st.retained_bytes -= len(body)
+                st.retained_bytes -= body.nbytes
+                body.release()  # unpins the caller's buffer + ranges
             self._cv.notify_all()
 
     def resume(self, dest: int, last_delivered: int
-               ) -> List[Tuple[int, int, bytes]]:
+               ) -> List[Tuple[int, int, _bufpool.BufRef]]:
         """Reconnect-time resume: the peer reported the last seq it
         delivered from us — treat it as an ack (frames at or below it
         arrived; replaying them would only be dropped as dups) and
@@ -417,13 +448,23 @@ class LinkState:
         their acks/frames no-op instead of poisoning the fresh
         streams)."""
         with self._cv:
-            self._tx.pop(rank, None)
+            st = self._tx.pop(rank, None)
             self._rx.pop(rank, None)
             self._ack_pending.discard(rank)
             self._gen[rank] = self._gen.get(rank, 0) + 1
             self._cv.notify_all()
+        if st is not None:
+            for _, _, body in st.retained:
+                body.release()
 
     def close(self) -> None:
         with self._cv:
             self._closed = True
+            states = list(self._tx.values())
             self._cv.notify_all()
+        # free the retained windows: the refs pin caller buffers (and
+        # veto codec.RECV_POOL recycling) for exactly as long as a
+        # replay could still need them — which is never, once closed
+        for st in states:
+            for _, _, body in st.retained:
+                body.release()

@@ -162,6 +162,23 @@ def job_sleep(comm, seconds: float = 0.1) -> int:
     return comm.rank
 
 
+def job_allreduce_arena(comm, n: int = 1024) -> tuple:
+    """Arena-observability lease payload (ISSUE 11): one auto-routed
+    allreduce, returning ``(value, coll_sm_hits delta, live arena
+    names)`` from lease-rank 0 so the client can assert the lease rode
+    the warm POOLED arena tier (``coll_sm_hits > 0`` under a shm pool;
+    on socket pools the delta is honestly 0 — there is no arena)."""
+    import numpy as np
+
+    from . import coll_sm as _coll_sm
+    from . import mpit as _mpit
+
+    before = _mpit.pvar_read("coll_sm_hits")
+    out = comm.allreduce(np.full(int(n), comm.rank + 1.0, np.float32))
+    hits = _mpit.pvar_read("coll_sm_hits") - before
+    return (float(out[0]), int(hits), sorted(_coll_sm.live_arenas()))
+
+
 def job_allreduce_link_chaos(comm, n: int = 1024, resets: int = 2) -> float:
     """Link-chaos lease payload (ISSUE 10): each leased rank hard-resets
     its cached connection to the next rank ``resets`` times while
@@ -293,11 +310,14 @@ def _worker_main() -> int:
             args = pickle.loads(msg["args"])
             comm = P2PCommunicator(t, slots, ("lease", job_id))
             comm._ft = _ft.CommFT(world_ft, ("lease", job_id))
-            # no coll/sm arena on lease comms: every job has a fresh
-            # context, so routing auto->arena would map a new multi-MB
-            # /dev/shm segment PER LEASE (same rationale as nbc clones;
-            # arena reuse across leases is a recorded residual)
-            comm._no_coll_sm = True
+            # coll/sm arena via the POOLED path (ISSUE 11, closes the
+            # PR-7 "leases skip the arena" residual): one epoch-stamped
+            # arena per worker set, reused across leases — the epoch is
+            # the SERVER's stamp shipped with the job, so every leased
+            # worker keys the same segment even if a concurrent
+            # transition broadcast races the dispatch
+            comm._coll_sm_pool_ctx = ("lease-pool",
+                                      int(msg.get("epoch", 0)))
             result = fn(comm, *args)
             reply = {"op": "job_done", "job_id": job_id, "slot": slot,
                      "ok": True}
@@ -842,9 +862,9 @@ class WorldServer:
             for s in slots:
                 self._workers[s].state = "leased"
                 self._workers[s].lease_id = lease_id
-            self._leases[lease_id] = {"slots": slots}
-            self.stats_counters["leases_granted"] += 1
             epoch = self.epoch
+            self._leases[lease_id] = {"slots": slots, "epoch": epoch}
+            self.stats_counters["leases_granted"] += 1
         owned.append(lease_id)
         return {"ok": True, "lease_id": lease_id, "slots": slots,
                 "epoch": epoch}
@@ -880,6 +900,9 @@ class WorldServer:
             try:
                 _send_msg(conn, lk, {
                     "op": "job", "job_id": job_id, "slots": slots,
+                    # the lease's epoch stamp: keys the pooled coll/sm
+                    # arena identically on every leased worker
+                    "epoch": lease.get("epoch", 0),
                     "fn": msg["fn"], "args": msg["args"]})
             except OSError:
                 pass  # its death is noticed by the monitor and synthesized

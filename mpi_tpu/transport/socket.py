@@ -49,6 +49,7 @@ import threading
 import time
 from typing import Any, Dict, Optional
 
+from .. import bufpool as _bufpool
 from .. import mpit as _mpit
 from .. import resilience as _resilience
 from ..errors import EpochSkewError
@@ -88,9 +89,49 @@ _EPOCH_GRACE_S = float(os.environ.get("MPI_TPU_EPOCH_GRACE_S", "2.0"))
 # Ack-flusher cadence: once woken by a pending ack, batch for this long
 # before flushing (coalesces a burst of deliveries into one control
 # frame); the park itself is condition-variable based, so an idle
-# transport costs a wakeup only every _ACK_IDLE_S.
+# transport costs a wakeup only every _ACK_IDLE_S — which is also the
+# scan cadence of the idle-link keepalive probe (ISSUE 11 satellite).
 _ACK_BATCH_S = 0.002
 _ACK_IDLE_S = 0.25
+
+# Scatter-gather batching (ISSUE 11): header + meta + body segments go
+# out in ONE socket.sendmsg call instead of one sendall per part.
+# Linux caps an iovec at IOV_MAX (1024) entries; frames with more
+# segments simply take one extra syscall per batch.
+_IOV_MAX = 1024
+_HAS_SENDMSG = hasattr(socket.socket, "sendmsg")
+
+
+def _sendmsg_views(conn: socket.socket, views) -> None:
+    """Stream ``views`` (zero-copy byte buffers: memoryviews/bytes) with
+    vectored ``sendmsg`` — one syscall per IOV_MAX batch in the common
+    case, looping on partial writes (the kernel may accept fewer bytes
+    than the iovec carries).  Counted in ``link_send_syscalls`` so the
+    fewer-syscalls-per-frame contract is pvar-assertable."""
+    if not _HAS_SENDMSG:  # pragma: no cover - non-sendmsg platform
+        for v in views:
+            conn.sendall(v)
+            _mpit.count(link_send_syscalls=1)
+        return
+    idx, off = 0, 0
+    n = len(views)
+    while idx < n:
+        if off:
+            batch = [memoryview(views[idx])[off:]]
+            batch.extend(views[idx + 1:idx + _IOV_MAX])
+        else:
+            batch = views[idx:idx + _IOV_MAX]
+        sent = conn.sendmsg(batch)
+        _mpit.count(link_send_syscalls=1)
+        while sent > 0:
+            rem = memoryview(views[idx]).nbytes - off
+            if sent < rem:
+                off += sent
+                sent = 0
+            else:
+                sent -= rem
+                idx += 1
+                off = 0
 
 
 class _LinkAbort(TransportError):
@@ -168,6 +209,10 @@ class SocketTransport(Transport):
         # Resilient link layer (mpi_tpu/resilience.py): per-dest
         # sequenced streams + retained replay windows + cumulative acks.
         self._link = LinkState(size)
+        # last successful data/probe write per destination — what the
+        # idle-link keepalive (ISSUE 11, link_keepalive_s cvar) scans
+        # to find connections worth probing
+        self._last_send: Dict[int, float] = {}
         # Chaos hooks (transport/faulty.py link-fault injection): a
         # callable (dest, stage) fired on the send path ('pre' = before
         # any byte of a frame, 'mid' = between header and body), and a
@@ -357,6 +402,24 @@ class SocketTransport(Transport):
 
     # -- cumulative-ack flusher (mpi_tpu/resilience.py) --------------------
 
+    @staticmethod
+    def _dial_ok(dest: int, fails: Dict[int, int],
+                 next_try: Dict[int, float]) -> None:
+        """Reset one peer's flusher dial-backoff state after a
+        successful write/redial."""
+        fails.pop(dest, None)
+        next_try.pop(dest, None)
+
+    @staticmethod
+    def _dial_backoff(dest: int, fails: Dict[int, int],
+                      next_try: Dict[int, float]) -> None:
+        """One failed flusher dial: exponential per-peer cool-down
+        (5s cap) — the single spelling of the policy shared by the
+        standalone-ack path and the keepalive probe."""
+        fails[dest] = fails.get(dest, 0) + 1
+        next_try[dest] = time.monotonic() + min(
+            5.0, 0.25 * (2.0 ** fails[dest]))
+
     def _ack_flush_loop(self) -> None:
         link = self._link
         # per-peer dial cool-down: a vanished-but-unsuspected peer (FT
@@ -371,7 +434,13 @@ class SocketTransport(Transport):
                 srcs = link.wait_ack_pending(_ACK_IDLE_S)
             except Exception:  # pragma: no cover - teardown race
                 return
-            if not srcs or self._closing:
+            if self._closing:
+                return
+            # idle-link keepalive (ISSUE 11 satellite): runs every park
+            # wakeup, whether or not acks are pending — a fully idle
+            # transport still probes its cached connections
+            self._keepalive_probe(next_try, fails)
+            if not srcs:
                 continue
             time.sleep(_ACK_BATCH_S)  # coalesce a delivery burst
             for src in srcs:
@@ -400,17 +469,68 @@ class SocketTransport(Transport):
                                 backoff_delays())
                         conn.sendall(_HEADER.pack(_ACK_FLAG, 0, value))
                     link.note_ack_sent(src, value)
-                    fails.pop(src, None)
-                    next_try.pop(src, None)
+                    self._dial_ok(src, fails, next_try)
                 except (OSError, TransportError, EpochSkewError):
                     # best-effort: drop a broken conn so a later round
                     # re-dials (the peer's window depends on these acks
                     # when no data flows back); real diagnosis belongs
                     # to the data path / membership layer
                     self._drop_conn(src)
-                    fails[src] = fails.get(src, 0) + 1
-                    next_try[src] = time.monotonic() + min(
-                        5.0, 0.25 * (2.0 ** fails[src]))
+                    self._dial_backoff(src, fails, next_try)
+
+    def _keepalive_probe(self, next_try: Dict[int, float],
+                         fails: Dict[int, int]) -> None:
+        """Idle-link keepalive (link_keepalive_s cvar, closes PR-10
+        residual (b)): probe every CACHED connection that sent nothing
+        for the keepalive period with a header-only ack frame.  A link
+        torn while idle (peer-side reset after our last write returned)
+        fails the probe, and the flusher heals it HERE — reconnect +
+        resume-replay on a short fuse — so the next real send finds a
+        live link instead of paying the reconnect spike itself.  Probes
+        never block behind an in-flight send (non-blocking lock try: a
+        busy link is by definition not idle) and honor the same per-dest
+        cool-down as failed ack dials.  No-op when probing is disabled
+        or healing is off (a probe failure would be terminal — worse
+        than leaving the fault to the send path's classified raise)."""
+        ka = _resilience._KEEPALIVE_S
+        if ka <= 0 or _resilience._RETRY_TIMEOUT_S <= 0:
+            return
+        now = time.monotonic()
+        with self._conn_lock:
+            idle = [d for d in self._conns
+                    if now - self._last_send.get(d, 0.0) >= ka]
+        for dest in idle:
+            if self._closing:
+                return
+            if self._suspect(dest) or now < next_try.get(dest, 0.0):
+                continue
+            lock = self._send_lock(dest)
+            if not lock.acquire(blocking=False):
+                continue  # a send is mid-frame: the link is not idle
+            try:
+                with self._conn_lock:
+                    conn = self._conns.get(dest)
+                if conn is None:
+                    continue
+                try:
+                    conn.sendall(_HEADER.pack(
+                        _ACK_FLAG, 0, self._link.piggyback_ack(dest)))
+                    self._last_send[dest] = time.monotonic()
+                    self._dial_ok(dest, fails, next_try)
+                except OSError:
+                    self._drop_conn(dest)
+                    try:
+                        self._establish_locked(
+                            dest, time.monotonic() + 2.0,
+                            backoff_delays())
+                        _mpit.count(link_faults_masked=1)
+                        self._dial_ok(dest, fails, next_try)
+                    except (OSError, TransportError, EpochSkewError):
+                        # unreachable right now: back off, the next
+                        # probe round (or the send path) retries
+                        self._dial_backoff(dest, fails, next_try)
+            finally:
+                lock.release()
 
     # -- outgoing ----------------------------------------------------------
 
@@ -591,6 +711,9 @@ class SocketTransport(Transport):
                             conn.settimeout(None)
                             with self._conn_lock:
                                 self._conns[dest] = conn
+                            # a fresh connection needs no probe for a
+                            # full keepalive period
+                            self._last_send[dest] = time.monotonic()
                             if self._link.mark_connected(dest):
                                 _mpit.count(link_reconnects=1)
                             return conn
@@ -614,16 +737,25 @@ class SocketTransport(Transport):
         mid-replay socket error (caller retries the whole dial)."""
         pending = self._link.resume(dest, resume_seq)
         for seq, word, body in pending:
+            views = body.pin()
+            if views is None:
+                # released mid-replay (acked on another path / purge):
+                # an acked frame was delivered — the receiver's rx gate
+                # dedups a replay anyway, so skipping loses nothing
+                continue
             try:
-                conn.sendall(_HEADER.pack(
-                    word, seq, self._link.piggyback_ack(dest)))
-                conn.sendall(body)
+                _sendmsg_views(conn, [
+                    _HEADER.pack(word, seq,
+                                 self._link.piggyback_ack(dest)),
+                    *views])
             except OSError:
                 try:
                     conn.close()
                 except OSError:
                     pass
                 return False
+            finally:
+                body.unpin()
             _mpit.count(link_frames_replayed=1)
         return True
 
@@ -682,61 +814,115 @@ class SocketTransport(Transport):
             return
         frame = codec.pack_raw_frame(ctx, tag, payload)
         if frame is not None:
+            # the ndarrays ride whole (not pre-cast to memoryviews):
+            # the ownership layer needs the OWNER objects to register
+            # live address ranges and keep pooled buffers unrecycled
+            # while their frames are retained (mpi_tpu/bufpool.py)
             head, bufs = frame
-            parts = [head, *(memoryview(b).cast("B")
-                             for b in bufs if b.nbytes)]
-            self._send_parts(dest, codec.RAW_FLAG, parts)
+            self._send_parts(dest, codec.RAW_FLAG,
+                             [head, *(b for b in bufs if b.nbytes)])
             return
         blob = codec.pack_pickle_body(ctx, tag, payload)
         self._send_parts(dest, 0, [blob])
 
     def _send_parts(self, dest: int, flags: int, parts) -> None:
         """Sequenced frame send.  With healing ENABLED: wait for
-        retained-window room, snapshot the body into ONE flat bytes
-        (what sendall streams AND what the window replays after a
-        reset — the caller may mutate its array the moment send
-        returns, so replay must come from a snapshot, exactly like the
-        kernel socket buffer a reset discards; ``link_bytes_retained``
-        prices it, ``payload_copies`` stays the codec plane's number),
-        retain it, stream, heal on OSError.  With healing DISABLED
-        (``link_retry_timeout_s`` = 0): no snapshot, no window, no
-        retention — stream each buffer directly (the pre-resilience
-        zero-copy path; replay can never happen, so retaining would be
-        pure cost), seqs still assigned so the receiver's contiguity
-        gate keeps holding."""
+        retained-window room, retain the body BY REFERENCE as a
+        :class:`bufpool.BufRef` over the caller's buffers (ISSUE 11 —
+        replacing ISSUE 10's flat ``bytes`` snapshot, a full memcpy per
+        frame), stream it with one vectored ``sendmsg``, heal on
+        OSError.  A replay after a reset is bit-exact because every
+        internal mutation site notifies the ownership layer, which
+        copy-on-writes any overlapping retained frame BEFORE the write
+        lands (``link_retain_copy`` = 1 restores the eager snapshot;
+        ``link_bytes_retained`` still prices retention, the cow pvars
+        price exactly the copies reuse forced).  With healing DISABLED
+        (``link_retry_timeout_s`` = 0): no refs, no window, no
+        retention — the buffers stream directly (the pre-resilience
+        zero-copy path, now also one sendmsg), seqs still assigned so
+        the receiver's contiguity gate keeps holding."""
         link = self._link
         healing = _resilience._RETRY_TIMEOUT_S > 0
-        body: Any
+        body: Any = None
         if healing:
-            body = parts[0] if len(parts) == 1 else b"".join(parts)
-            nbytes = len(body)
-            link.wait_window(dest, nbytes, self._suspect,
-                             lambda: self._closing)
+            body = _bufpool.BufRef(
+                parts, register=not _resilience._RETAIN_COPY)
+            if _resilience._RETAIN_COPY:
+                body.snapshot()  # ISSUE 10 semantics wholesale
+            elif body.ranges:
+                # reuse-on-send: a region already sitting unacked in
+                # the retained window is about to ship again — the
+                # OLDER frames lose their claim to the shared mutable
+                # views (snapshot) so later mutation notifications
+                # cannot race two refs over one region
+                _bufpool.touch_ranges(body.ranges, exclude=body)
+            nbytes = body.nbytes
         else:
-            nbytes = sum(len(p) for p in parts)
+            views = [memoryview(p).cast("B")
+                     if not isinstance(p, (bytes, bytearray, memoryview))
+                     else memoryview(p) for p in parts]
+            nbytes = sum(v.nbytes for v in views)
         word = flags | nbytes
         hook = self._link_fault_hook
-        with self._send_lock(dest):
-            conn = self._get_conn_locked(dest)
-            seq = (link.tx_retain(dest, word, body) if healing
-                   else link.tx_next_seq(dest))
-            header = _HEADER.pack(word, seq, link.piggyback_ack(dest))
+        try:
+            if healing:
+                # outside the send lock: a window-full wait must not
+                # hold the lock the ack flusher needs for this dest
+                link.wait_window(dest, nbytes, self._suspect,
+                                 lambda: self._closing)
+            lock = self._send_lock(dest)
+            lock.acquire()
             try:
-                if hook is not None:
-                    hook(dest, "pre")  # chaos: reset between frames / stall
-                conn.sendall(header)
-                if hook is not None:
-                    hook(dest, "mid")  # chaos: reset mid-frame
-                if healing:
-                    conn.sendall(body)
+                conn = self._get_conn_locked(dest)
+                seq = (link.tx_retain(dest, word, body) if healing
+                       else link.tx_next_seq(dest))
+            except BaseException:
+                lock.release()
+                raise
+        except BaseException:
+            # until tx_retain hands the ref to the window (which then
+            # owns its release on ack/purge/close), every raise on this
+            # path — window stall verdict, failed connect, peer-fault
+            # classification — must release it, or the live-range index
+            # leaks a ref that CoW-snapshots unrelated later buffers
+            # landing at the same address
+            if healing:
+                body.release()
+            raise
+        try:
+            header = _HEADER.pack(word, seq, link.piggyback_ack(dest))
+            if healing:
+                pinned = body.pin()
+                if pinned is None:
+                    return  # ref released: window torn down (closing)
+            else:
+                pinned = views
+            try:
+                if hook is None:
+                    # the hot path: header + meta + every segment in
+                    # ONE scatter-gather syscall (IOV_MAX batched)
+                    _sendmsg_views(conn, [header, *pinned])
                 else:
-                    for p in parts:
-                        conn.sendall(p)
+                    # chaos instrumentation: the header/body split is
+                    # load-bearing ('mid' = reset between header and
+                    # body), so the hooked path keeps two stages —
+                    # body still vectored
+                    hook(dest, "pre")  # chaos: reset between frames
+                    conn.sendall(header)
+                    _mpit.count(link_send_syscalls=1)
+                    hook(dest, "mid")  # chaos: reset mid-frame
+                    _sendmsg_views(conn, pinned)
+                self._last_send[dest] = time.monotonic()
             except OSError as e:
                 # classification + healing; the retained window replays
                 # this frame on a successful reconnect (with healing
                 # off this raises terminally — pre-resilience behavior)
                 self._heal_link_locked(dest, e)
+            finally:
+                if healing:
+                    body.unpin()
+        finally:
+            lock.release()
 
     # -- chaos hooks (transport/faulty.py link-fault injection) ------------
 

@@ -541,6 +541,15 @@ class WorldVerify:
         except (AttributeError, KeyError, TypeError):
             return None  # not a buffer-backed payload: nothing to race on
         end = start + nbytes
+        if writes:
+            # buffer-ownership notification (mpi_tpu/bufpool.py,
+            # ISSUE 11): a write-mode registration means a pending op
+            # WILL mutate this region — a resilient link still
+            # retaining it by reference must snapshot first (the same
+            # interval-overlap rule as the race lint below)
+            from .. import bufpool as _bufpool
+
+            _bufpool.touch_ranges(((start, end),))
         with self._lock:
             for (s, e, w, d) in self._bufs.values():
                 if s < end and start < e and (w or writes):

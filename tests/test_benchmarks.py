@@ -206,6 +206,32 @@ def test_links_chaos_quick_smoke():
     assert result["kill_still_diagnosed"]
 
 
+def test_hotpath_quick_smoke():
+    """The zero-copy hot-path leg (ISSUE 11; the ``bench.py --hotpath
+    --quick`` CI spelling): the socket allreduce under healing-off /
+    eager-retain / zero-copy retention modes plus the lease-arena
+    check.  The sharp acceptance is structural: retention bytes > 0
+    with ZERO cow snapshots and payload_copies identical to the
+    no-retention floor (link_bytes_retained decoupled from
+    payload_copies), one vectored sendmsg per frame, and a lease
+    allreduce showing coll_sm_hits > 0 on the SAME pooled arena across
+    two leases."""
+    from benchmarks import hotpath
+
+    result = hotpath.run_hotpath(quick=True)
+    assert result["ok"], {k: result[k] for k in
+                          ("retention_without_copy", "lease_arena",
+                           "healing_on_over_off_p50")}
+    zc = result["legs"]["healing_on_zero_copy"]
+    assert zc["pvars"]["link_bytes_retained"] > 0
+    assert zc["pvars"]["link_cow_snapshots"] == 0
+    assert zc["syscalls_per_frame"] <= 1.25
+    assert result["legs"]["healing_off"]["pvars"][
+        "link_bytes_retained"] == 0
+    lease = result["lease_arena"]
+    assert lease["coll_sm_hits_first"] > 0 and lease["arena_reused"]
+
+
 def test_serve_bench_quick_smoke():
     """The world-churn harness end to end in --quick mode (the
     ``bench.py --serve-bench --quick`` CI spelling): cold launch() vs

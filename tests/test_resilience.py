@@ -566,3 +566,191 @@ def test_serve_lease_rides_healed_links():
         assert client.run(serve.job_allreduce, 64, nranks=2,
                           timeout=30.0) == 3.0
         client.close()
+
+
+# -- refcounted buffer ownership (ISSUE 11: mpi_tpu/bufpool.py) ---------------
+
+
+def test_bufref_touch_snapshots_before_mutation():
+    """Copy-on-write unit contract: touch() snapshots every overlapping
+    retained ref BEFORE the caller's write lands, exactly once, priced
+    by the cow pvars (never payload_copies)."""
+    from mpi_tpu import bufpool
+
+    ses = mpit.session_create()
+    ses.reset_all()
+    arr = np.arange(64, dtype=np.float64)
+    ref = bufpool.BufRef([b"head", arr])
+    assert bufpool.live_refs() == 1
+    want = ref.tobytes()
+    assert bufpool.touch(arr) == 1        # snapshot BEFORE the write
+    arr[:] = -1.0
+    assert ref.tobytes() == want          # a replay stays bit-exact
+    assert bufpool.touch(arr) == 0        # second write: nothing to do
+    assert bufpool.live_refs() == 0       # snapshotted refs leave the index
+    assert ses.read("link_cow_snapshots") == 1
+    assert ses.read("link_cow_bytes") == len(want)
+    assert ses.read("payload_copies") == 0  # the decoupling
+    ref.release()
+    assert ref.tobytes() == b""
+
+
+def test_bufref_pin_defers_release_and_skips_replay():
+    from mpi_tpu import bufpool
+
+    arr = np.ones(8, np.uint8)
+    ref = bufpool.BufRef([arr])
+    views = ref.pin()
+    assert views and views[0].nbytes == 8
+    ref.release()               # acked while a replay streams the views
+    assert ref.pin() is None    # later replays skip the frame (dedup'd)
+    ref.unpin()                 # the last pin actually frees
+    assert ref.tobytes() == b""
+    assert bufpool.live_refs() == 0
+
+
+def test_retention_by_reference_zero_copies_on_no_reuse():
+    """The ISSUE 11 decoupling: a no-reuse send stream retains every
+    frame (link_bytes_retained prices the replay bound) with ZERO
+    retention-attributed copies — no cow snapshots, payload_copies
+    untouched by retention."""
+    ses = mpit.session_create()
+    ses.reset_all()
+
+    def prog(comm):
+        out = None
+        if comm.rank == 0:
+            for i in range(6):
+                comm.send(np.full(2048, float(i)), dest=1, tag=3)
+            out = (ses.read("link_bytes_retained"),
+                   ses.read("link_cow_snapshots"),
+                   ses.read("link_cow_bytes"))
+        else:
+            for i in range(6):
+                got = comm.recv(source=0, tag=3)
+                assert float(got[0]) == float(i)
+        comm.barrier()
+        return out
+
+    res = run_socket_world(prog, 2)
+    retained, snaps, cow_bytes = res[0]
+    assert retained >= 6 * 2048 * 8
+    assert snaps == 0 and cow_bytes == 0
+
+
+def test_buffer_reuse_under_resets_is_bit_exact_with_cow():
+    """The chaos leg the ISSUE names: ONE buffer reused across sends
+    (note_write per the borrow contract before each off-op mutation)
+    while link_reset_every tears connections — every replay must be
+    bit-exact against the content AT SEND TIME, and the cow pvars must
+    show reuse actually forced snapshots."""
+    from mpi_tpu import bufpool
+
+    ses = mpit.session_create()
+    ses.reset_all()
+    base = np.arange(4096.0)
+
+    def prog(comm):
+        inj = None
+        if comm.rank == 0:
+            inj = FaultyTransport(comm._t, link_reset_every=3)
+            buf = np.empty(4096, np.float64)
+            for i in range(10):
+                bufpool.note_write(buf)   # the documented borrow contract
+                buf[:] = base + float(i)
+                comm.send(buf, dest=1, tag=5)
+        else:
+            for i in range(10):
+                got = comm.recv(source=0, tag=5)
+                assert np.array_equal(got, base + float(i)), i
+        comm.barrier()
+        return 0 if inj is None else inj.link_resets
+
+    res = run_socket_world(prog, 2, timeout=90)
+    assert res[0] >= 2                            # resets really fired
+    assert ses.read("link_reconnects") >= res[0]  # ... and healed
+    assert ses.read("link_cow_snapshots") >= 1    # reuse forced copies
+
+
+def test_sendmsg_batches_whole_frame_into_one_syscall():
+    """Vectored sends: a multi-segment raw frame (header + meta + 6
+    segment bodies = 8 wire parts) goes out in ONE sendmsg syscall —
+    the fewer-syscalls-per-frame acceptance, counter-asserted via the
+    link_send_syscalls pvar."""
+    ses = mpit.session_create()
+    ses.reset_all()
+    segs = [np.arange(256.0) + i for i in range(6)]
+
+    def prog(comm):
+        out = None
+        if comm.rank == 0:
+            before = mpit.pvar_read("link_send_syscalls")
+            for i in range(4):
+                comm.send([s * (i + 1) for s in segs], dest=1, tag=2)
+            out = mpit.pvar_read("link_send_syscalls") - before
+        else:
+            for i in range(4):
+                got = comm.recv(source=0, tag=2)
+                assert len(got) == 6
+                assert np.array_equal(got[0], segs[0] * (i + 1))
+        comm.barrier()
+        return out
+
+    res = run_socket_world(prog, 2)
+    assert res[0] == 4, f"expected 1 syscall per frame, saw {res[0]}/4"
+
+
+# -- idle-link keepalive (ISSUE 11 satellite: PR-10 residual (b)) -------------
+
+
+def test_idle_link_keepalive_heals_before_next_send():
+    """A link torn while IDLE (the remote endpoint hard-reset, as a
+    SIGKILL of the peer's old incarnation would) is discovered and
+    healed by the keepalive probe — link_reconnects ticks with NO send
+    in flight — so the next real send finds a live link instead of
+    paying the reconnect spike."""
+    import struct as _struct
+
+    ses = mpit.session_create()
+    ses.reset_all()
+    old = mpit.cvar_read("link_keepalive_s")
+    mpit.cvar_write("link_keepalive_s", 0.25)
+    sent = threading.Event()
+    torn = threading.Event()
+    try:
+        def prog(comm):
+            if comm.rank == 0:
+                comm.send(np.arange(32.0), dest=1, tag=1)
+                sent.set()
+                assert torn.wait(10.0)
+                deadline = time.monotonic() + 8.0
+                while (ses.read("link_reconnects") < 1
+                       and time.monotonic() < deadline):
+                    time.sleep(0.05)
+                healed_first = ses.read("link_reconnects") >= 1
+                comm.send(np.arange(64.0), dest=1, tag=2)
+                return healed_first
+            got = comm.recv(source=0, tag=1)
+            assert np.array_equal(got, np.arange(32.0))
+            sent.wait(10.0)
+            # hard-reset the REMOTE END of rank 0's (now idle) link:
+            # rank 0's cached connection is a corpse from here on
+            with comm._t._conn_lock:
+                conns = list(comm._t._reader_conns.get(0, []))
+            for c in conns:
+                try:
+                    c.setsockopt(_socketlib.SOL_SOCKET,
+                                 _socketlib.SO_LINGER,
+                                 _struct.pack("ii", 1, 0))
+                    c.close()
+                except OSError:
+                    pass
+            torn.set()
+            got2 = comm.recv(source=0, tag=2)
+            assert np.array_equal(got2, np.arange(64.0))
+            return True
+
+        res = run_socket_world(prog, 2, timeout=60)
+        assert res[0], "the idle probe never healed the torn link"
+    finally:
+        mpit.cvar_write("link_keepalive_s", old)

@@ -235,3 +235,45 @@ def test_launcher_serve_subcommand(tmp_path):
         if proc.poll() is None:
             proc.kill()
             proc.wait(10.0)
+
+
+# -- pooled coll/sm arena across leases (ISSUE 11 tentpole #3) ----------------
+
+
+def test_lease_allreduce_rides_pooled_arena():
+    """Closes PR-7 residual (a): on a shm pool a lease allreduce routes
+    through the POOLED collective arena (``coll_sm_hits > 0`` inside
+    ``lease.run``) instead of skipping the fastest tier; the SECOND
+    lease over the same worker set reuses the very same segment (same
+    live-arena name, no per-lease /dev/shm churn); and a kill-mid-lease
+    is still diagnosed as MPI_ERR_PROC_FAILED, after which the healed
+    pool's next lease rides a FRESH arena under the bumped epoch."""
+    with _pool(pool_size=2, backend="shm") as srv:
+        client = serve.connect(srv)
+        try:
+            val, hits, names = client.run(serve.job_allreduce_arena, 512,
+                                          nranks=2, timeout=30.0)
+            assert val == 3.0
+            assert hits > 0, "lease allreduce did not ride the arena"
+            assert len(names) == 1
+            val2, hits2, names2 = client.run(serve.job_allreduce_arena,
+                                             512, nranks=2, timeout=30.0)
+            assert (val2, True) == (3.0, hits2 > 0)
+            # reuse, not churn: the same pooled segment served both
+            assert names2 == names
+            # kill-under-fire diagnosis is unchanged by the pooling
+            lease = client.acquire(2, timeout=15.0)
+            with pytest.raises(ProcFailedError) as ei:
+                lease.run(serve.job_kill_rank, 1, 1024,
+                          timeout=3 * DETECT_S + LOAD_MARGIN_S)
+            assert error_class(ei.value) == MPI_ERR_PROC_FAILED
+            lease.release()
+            st = _wait_healed(client, 2, timeout=30.0 + LOAD_MARGIN_S)
+            assert st["epoch"] >= 1
+            val3, hits3, names3 = client.run(serve.job_allreduce_arena,
+                                             512, nranks=2, timeout=30.0)
+            assert (val3, True) == (3.0, hits3 > 0)
+            # the bumped epoch retired the old segment name
+            assert names3 != names
+        finally:
+            client.close()

@@ -120,6 +120,33 @@ def _payload_bytes(nominal: int, p: int, coll: str) -> int:
     return nominal
 
 
+# Arena-gate sweep legs (ISSUE 11 satellite, closes PR-9's
+# consult-only residual): the coll_sm INTERNAL gates — flat-vs-chunked
+# allreduce folds, arena-vs-tree reduce — were tuned-table consumers
+# with no generator emitting their rows, so they always fell back to
+# the coll_sm_eager_bytes seed constant.  Each entry is
+# (row collective, osu bench, {osu algorithm spelling -> row
+# algorithm}): the spellings force the gate via benchmarks/osu.py
+# _GATE_LEGS; "tree" is the plain wire algorithm, measured as itself.
+GATES = (
+    ("sm_allreduce", "allreduce",
+     {"sm_flat": "flat", "sm_chunked": "chunked"}),
+    ("sm_reduce", "reduce",
+     {"sm_arena": "arena", "tree": "tree"}),
+)
+
+
+def _gate_seed(coll: str, nbytes: int) -> str:
+    """The seed side of one arena gate — coll_sm's real eager constant,
+    read live (not a copy)."""
+    from mpi_tpu import coll_sm as _sm
+
+    eager = nbytes <= _sm._EAGER_BYTES
+    if coll == "sm_allreduce":
+        return "flat" if eager else "chunked"
+    return "arena" if eager else "tree"
+
+
 def _algorithms(transport: str, p: int, coll: str) -> List[str]:
     """The wire algorithms measured for one (transport, P, collective)
     leg; "sm" is swept separately (size-capped by the arena slot)."""
@@ -136,23 +163,37 @@ def _algorithms(transport: str, p: int, coll: str) -> List[str]:
 def _osu_rows(backend: str, bench: str, nranks: int, sizes: List[int],
               algos: List[str], iters: int, warmup: int) -> List[Dict]:
     """One launcher invocation of benchmarks/osu.py — the measured
-    program is exactly the shipping benchmark (host_sweep's recipe)."""
+    program is exactly the shipping benchmark (host_sweep's recipe).
+
+    The measuring ranks must be TABLE-BLIND: wire algorithms are
+    forced by name, but the coll_sm INTERNAL gates (the sm_allreduce/
+    sm_reduce legs this tool now sweeps) consult an active tuned table
+    BEFORE the eager constant — with MPI_TPU_TUNING_TABLE inherited,
+    both spellings of a gate leg would measure the already-dispatched
+    path and the emitted rows would be noise-decided and
+    self-reinforcing.  Rank processes inherit os.environ, so the var
+    is stripped for the launch and restored after."""
     from mpi_tpu.launcher import launch
 
-    with tempfile.TemporaryDirectory() as td:
-        out = os.path.join(td, "rows.jsonl")
-        argv = [os.path.join(REPO, "benchmarks", "osu.py"),
-                "--bench", bench, "--backend", backend,
-                "-n", str(nranks),
-                "--sizes", ",".join(str(s) for s in sizes),
-                "--iters", str(iters), "--warmup", str(warmup),
-                "--algorithms", ",".join(algos), "--out", out]
-        rc = launch(nranks, argv, timeout=1800.0, backend=backend)
-        if rc != 0:
-            raise RuntimeError(
-                f"{backend} {bench} P={nranks} tune leg exited {rc}")
-        with open(out) as f:
-            return [json.loads(line) for line in f if line.strip()]
+    saved_table = os.environ.pop("MPI_TPU_TUNING_TABLE", None)
+    try:
+        with tempfile.TemporaryDirectory() as td:
+            out = os.path.join(td, "rows.jsonl")
+            argv = [os.path.join(REPO, "benchmarks", "osu.py"),
+                    "--bench", bench, "--backend", backend,
+                    "-n", str(nranks),
+                    "--sizes", ",".join(str(s) for s in sizes),
+                    "--iters", str(iters), "--warmup", str(warmup),
+                    "--algorithms", ",".join(algos), "--out", out]
+            rc = launch(nranks, argv, timeout=1800.0, backend=backend)
+            if rc != 0:
+                raise RuntimeError(
+                    f"{backend} {bench} P={nranks} tune leg exited {rc}")
+            with open(out) as f:
+                return [json.loads(line) for line in f if line.strip()]
+    finally:
+        if saved_table is not None:
+            os.environ["MPI_TPU_TUNING_TABLE"] = saved_table
 
 
 def _iters_for(nbytes: int, quick: bool) -> Tuple[int, int]:
@@ -222,6 +263,45 @@ def sweep(quick: bool = False,
                         chosen = seed  # stability bias: noise never flips
                     rows.append(_table.Row(
                         transport, p, coll, lo, hi, chosen,
+                        trusted, extra={
+                            "measured_bytes": s,
+                            "p50_us": {a: round(v, 1)
+                                       for a, v in sorted(algs.items())},
+                            "seed": seed,
+                        }))
+            if transport != "shm":
+                continue
+            # arena-gate rows (ISSUE 11): swept only where the payload
+            # fits a slot — the gates are never consulted above it
+            cap = _arena_capacity(p)
+            gate_sizes = [s for s in sizes if s <= cap]
+            if not gate_sizes:
+                continue
+            for gate_coll, bench, spell in GATES:
+                cells = {s: {} for s in gate_sizes}
+                by_iters = {}
+                for s in gate_sizes:
+                    by_iters.setdefault(_iters_for(s, quick),
+                                        []).append(s)
+                for (iters, warmup), szs in by_iters.items():
+                    for r in _osu_rows(transport, bench, p, sorted(szs),
+                                       sorted(spell), iters, warmup):
+                        if "p50_us" in r:
+                            cells[r["bytes"]][spell[r["algorithm"]]] = \
+                                r["p50_us"]
+                            measured.append(r)
+                for lo, hi, s in _table.band_edges(gate_sizes):
+                    algs = cells.get(s) or {}
+                    if not algs:
+                        continue
+                    winner = min(algs, key=algs.get)
+                    seed = _gate_seed(gate_coll, s)
+                    chosen = winner
+                    if (seed in algs and winner != seed
+                            and algs[seed] <= tie_factor * algs[winner]):
+                        chosen = seed
+                    rows.append(_table.Row(
+                        transport, p, gate_coll, lo, hi, chosen,
                         trusted, extra={
                             "measured_bytes": s,
                             "p50_us": {a: round(v, 1)
