@@ -646,6 +646,27 @@ if __name__ == "__main__":
         if "--quick" in sys.argv[1:]:
             sys.exit(tune.main(["--quick"]))
         sys.exit(tune.main([]))
+    if "--persist" in sys.argv[1:]:
+        # persistent-collective leg (ISSUE 12): osu_allreduce_persistent-
+        # shaped fresh-call vs start() re-fire p50s at small payloads on
+        # both host transports; writes BOTH committed artifacts —
+        # persist_pre.json pins MPI_TPU_NBC=thread (the seed's per-call-
+        # thread semantics) and persist_post.json nbc=auto (engine
+        # schedule state machines).  --quick is the tier-1 smoke
+        # spelling (stdout only).
+        from benchmarks import host_sweep
+
+        if "--quick" in sys.argv[1:]:
+            sys.exit(host_sweep.main(["--persist", "--label", "post",
+                                      "--quick"]))
+        rc = host_sweep.main(
+            ["--persist", "--label", "pre",
+             "--out", os.path.join(REPO, "benchmarks", "results",
+                                   "persist_pre.json")])
+        sys.exit(rc or host_sweep.main(
+            ["--persist", "--label", "post",
+             "--out", os.path.join(REPO, "benchmarks", "results",
+                                   "persist_post.json")]))
     if "--sweep" in sys.argv[1:]:
         # the OSU-style host data-plane size sweep (ISSUE 1 tentpole #4,
         # extended to alltoall/reduce_scatter/rabenseifner in ISSUE 2);

@@ -70,6 +70,16 @@ SWEEP_BENCHES = (
 OVERLAP_SIZES = "1MB:16MB:2"
 OVERLAP_MODES = ("none", "thread")
 
+# Persistent-collective band (ISSUE 12): osu_allreduce_persistent-shaped
+# fresh-call vs ``start()`` re-fire p50s at the SMALL payloads the
+# persistent hoist targets (the latency regime — large payloads are
+# bandwidth-bound and the hoisted work vanishes in the transfer).
+# Always under progress=thread; MPI_TPU_NBC selects the dispatch: the
+# committed 'pre' artifact pins nbc=thread (today's one-thread-per-call
+# start(), where the handle buys nothing) and 'post' nbc=auto (engine
+# state machines, where the re-fire is the hot-loop win).
+PERSIST_SIZES = "256,1KB,4KB,16KB"
+
 # Small-message band (ISSUE 4 satellite): osu_latency / osu_barrier plus
 # small allreduce swept 8B-64KB.  Small-message p50s are far less noisy
 # on an oversubscribed box than the 64MB bandwidth cells — this is the
@@ -148,6 +158,24 @@ def overlap_sweep(quick: bool = False) -> List[Dict]:
         for mode in OVERLAP_MODES:
             rows += _osu_rows(backend, "overlap", sizes, None, iters,
                               warmup, env_extra={"MPI_TPU_PROGRESS": mode})
+    return rows
+
+
+def persist_sweep(quick: bool = False, nbc_mode: str = "auto") -> List[Dict]:
+    """The persistent-collective leg (benchmarks/osu.py ``--bench
+    persist``) on both host transports under progress=thread: each row
+    carries the fresh-call p50, the ``start()`` re-fire p50, and their
+    ratio (``refire_speedup``), plus the nbc dispatch mode that produced
+    it."""
+    sizes = "1KB" if quick else PERSIST_SIZES
+    # small-payload calls are sub-ms: a large population is cheap and
+    # the median needs it on the oversubscribed reference box
+    iters, warmup = (1, 0) if quick else (300, 30)
+    rows: List[Dict] = []
+    for backend in TRANSPORTS:
+        rows += _osu_rows(backend, "persist", sizes, None, iters, warmup,
+                          env_extra={"MPI_TPU_PROGRESS": "thread",
+                                     "MPI_TPU_NBC": nbc_mode})
     return rows
 
 
@@ -276,6 +304,7 @@ def run_sweep(label: str, quick: bool = False) -> Dict:
         "reduce_scatter_rows": benches["reduce_scatter"],
         "small_message_rows": small_message_sweep(quick=quick),
         "overlap_rows": overlap_sweep(quick=quick),
+        "persist_rows": persist_sweep(quick=quick),
         "crossover": derive_crossover(rows),
         "rabenseifner_crossover": derive_rabenseifner_crossover(rows),
         "wall_s": round(time.time() - t0, 1),
@@ -317,6 +346,17 @@ def run_overlap_sweep(label: str, quick: bool = False) -> Dict:
     return _band_result(label, quick, "overlap_rows", overlap_sweep)
 
 
+def run_persist_sweep(label: str, quick: bool = False) -> Dict:
+    """Just the persistent-collective band — the engine-owned-nbc PR's
+    pre/post artifact (committed as benchmarks/results/persist_{pre,
+    post}.json): 'pre' pins MPI_TPU_NBC=thread (per-call threads, the
+    seed semantics), 'post' nbc=auto (schedule state machines)."""
+    mode = "thread" if label == "pre" else "auto"
+    return _band_result(
+        label, quick, "persist_rows",
+        lambda quick: persist_sweep(quick=quick, nbc_mode=mode))
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--label", default="post")
@@ -330,8 +370,15 @@ def main(argv=None) -> int:
                     help="overlap band only (ialltoall + fixed compute, "
                          "both progress modes) — the async progress "
                          "engine's pre/post artifact")
+    ap.add_argument("--persist", action="store_true",
+                    help="persistent-collective band only (fresh call vs "
+                         "start() re-fire; --label pre pins nbc=thread, "
+                         "post nbc=auto) — the engine-owned-nbc pre/post "
+                         "artifact")
     args = ap.parse_args(argv)
-    result = (run_overlap_sweep(args.label, quick=args.quick)
+    result = (run_persist_sweep(args.label, quick=args.quick)
+              if args.persist
+              else run_overlap_sweep(args.label, quick=args.quick)
               if args.overlap
               else run_small_sweep(args.label, quick=args.quick)
               if args.small

@@ -209,6 +209,9 @@ def cpu_bench_program(comm, bench: str, sizes: List[int], algos: List[str],
     if bench == "overlap":
         return _overlap_bench(comm, sizes, iters, warmup)
 
+    if bench == "persist":
+        return _persist_bench(comm, sizes, iters, warmup)
+
     for nbytes in sizes:
         if bench == "allgather":
             # nbytes is the TOTAL gathered payload (busbw convention; matches
@@ -367,6 +370,67 @@ def _overlap_bench(comm, sizes: List[int], iters: int,
 
 
 # ---------------------------------------------------------------------------
+# Persistent collectives (osu_allreduce_persistent shape; ISSUE 12)
+# ---------------------------------------------------------------------------
+#
+# For each size: p50 of a FRESH ``iallreduce(x).wait()`` (post + wait,
+# the per-call path — schedule compile, child-context creation, tuned
+# resolution every call) against p50 of ``h.start().wait()`` re-fires of
+# one ``allreduce_init`` handle (everything hoisted to init).  Both legs
+# run whatever dispatch the environment selects (MPI_TPU_PROGRESS /
+# MPI_TPU_NBC) and each row records it, so the same harness prices both
+# sides of the PR: with the engine the re-fire is the hot-loop win;
+# without it both legs spawn a thread per round and the handle buys
+# nothing — the honest 'pre' rows.
+
+
+def _persist_bench(comm, sizes: List[int], iters: int,
+                   warmup: int) -> List[Dict]:
+    from mpi_tpu import nbc
+
+    def red_max(x: float) -> float:
+        return float(np.asarray(comm.allreduce(
+            np.float64(x), op=mpi_tpu.MAX, algorithm="reduce_bcast")))
+
+    mode = "thread" if getattr(comm, "_progress", None) is not None \
+        else "none"
+    rows: List[Dict] = []
+    for nbytes in sizes:
+        x = np.zeros(max(1, nbytes // 4), np.float32)
+
+        comm.barrier()
+        samples = []
+        for i in range(warmup + iters):
+            t0 = time.perf_counter()
+            comm.iallreduce(x).wait()
+            if i >= warmup:
+                samples.append(time.perf_counter() - t0)
+        t_fresh = red_max(statistics.median(samples))
+
+        h = comm.allreduce_init(x)
+        h.start().wait()  # warm the handle (first-round lazy work)
+        comm.barrier()
+        samples = []
+        for i in range(warmup + iters):
+            t0 = time.perf_counter()
+            h.start().wait()
+            if i >= warmup:
+                samples.append(time.perf_counter() - t0)
+        t_refire = red_max(statistics.median(samples))
+
+        if comm.rank == 0:
+            rows.append({
+                "bench": "persist", "nranks": comm.size, "bytes": nbytes,
+                "progress": mode, "nbc": nbc.mode(),
+                "fresh_us": t_fresh * 1e6,
+                "refire_us": t_refire * 1e6,
+                "p50_us": t_refire * 1e6,
+                "refire_speedup": t_fresh / max(t_refire, 1e-12),
+            })
+    return rows
+
+
+# ---------------------------------------------------------------------------
 # TPU backend: one jitted shard_map program per (bench, size, algorithm)
 # ---------------------------------------------------------------------------
 
@@ -482,7 +546,8 @@ def tpu_bench(bench: str, sizes: List[int], algos: List[str], iters: int,
 # ---------------------------------------------------------------------------
 
 ALL_BENCHES = ["latency", "bw", "barrier", "bcast", "reduce", "allreduce",
-               "allgather", "alltoall", "reduce_scatter", "overlap"]
+               "allgather", "alltoall", "reduce_scatter", "overlap",
+               "persist"]
 DEFAULT_ALGOS = {
     "allreduce": ["ring", "recursive_halving", "fused"],  # + pallas_ring (tpu, opt-in)
     "bcast": ["tree", "fused"],
@@ -494,6 +559,7 @@ DEFAULT_ALGOS = {
     "bw": ["-"],
     "barrier": ["-"],
     "overlap": ["-"],
+    "persist": ["-"],
 }
 
 
@@ -501,7 +567,7 @@ def run_bench(bench: str, backend: str, nranks: int, sizes: List[int],
               algos: List[str], iters: int, warmup: int,
               algos_explicit: bool = False) -> List[Dict]:
     if backend == "tpu":
-        if bench in ("bw", "barrier", "overlap"):
+        if bench in ("bw", "barrier", "overlap", "persist"):
             # SPMD has no standalone p2p stream, its barrier is a
             # device-fused psum, and its nonblocking ops are XLA's to
             # schedule; all are process-backend benches

@@ -61,6 +61,7 @@ __all__ = [
     "MPI_T_pvar_list", "MPI_T_pvar_read", "MPI_T_pvar_session_create",
     "MPI_Bcast_init", "MPI_Allreduce_init", "MPI_Reduce_init",
     "MPI_Allgather_init", "MPI_Alltoall_init", "MPI_Barrier_init",
+    "MPI_Reduce_scatter_init",
     "MPI_Session_init", "MPI_Session_finalize", "MPI_Session_get_num_psets",
     "MPI_Session_get_nth_pset", "MPI_Session_get_info",
     "MPI_Group_from_session_pset", "MPI_Comm_create_from_group",
@@ -1332,6 +1333,13 @@ def MPI_Alltoall_init(objs: Any, comm: Optional[Communicator] = None):
     from .mpi4 import persistent_collective
 
     return persistent_collective(_world(comm), "alltoall", objs)
+
+
+def MPI_Reduce_scatter_init(blocks: Any, op=ops.SUM,
+                            comm: Optional[Communicator] = None):
+    from .mpi4 import persistent_collective
+
+    return persistent_collective(_world(comm), "reduce_scatter", blocks, op)
 
 
 def MPI_Barrier_init(comm: Optional[Communicator] = None):

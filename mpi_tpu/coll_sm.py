@@ -211,7 +211,11 @@ class Arena:
         nbytes = _LINE * p + slot * p
         # ``ctx`` overrides the naming/registration context: pooled
         # lease arenas (ISSUE 11) must share one name across leases
-        # whose communicator contexts differ per job
+        # whose communicator contexts differ per job.  Pooled arenas
+        # also retire differently at finalize (retire_pooled): their
+        # creator may be a long-dead worker, so EVERY closing handle
+        # unlinks, not just the creator's.
+        self._pooled = ctx is not None
         if ctx is None:
             ctx = comm._ctx
         self.name = _arena_name(t._session, ctx, comm._group)
@@ -432,6 +436,28 @@ def live_arenas() -> Dict[str, int]:
     """name -> live handle count (test/tool introspection)."""
     with _LIVE_LOCK:
         return {k: v["refs"] for k, v in _LIVE.items()}
+
+
+def retire_pooled(transport) -> int:
+    """World-finalize sweep over the POOLED lease arenas (ISSUE 12
+    satellite, closing PR-11 residual (d)): a pooled arena whose worker
+    set never re-leases is retired by nothing — the epoch-bump sweep in
+    ``_pooled_arena`` only runs when a NEW same-group lease arrives — so
+    until this sweep it held its multi-MB /dev/shm segment mapped for
+    the life of the worker process, and if its creator was the dead
+    worker an epoch bump mourned, the segment outlived the process too
+    (only the creator unlinks on the plain close path).  Called when a
+    serve worker drains its job loop at pool shutdown; ``force_unlink``
+    makes every surviving handle unlink (double-unlink is a harmless
+    ENOENT).  Returns the number of arenas retired."""
+    pool = getattr(transport, "_coll_arenas", None) or {}
+    retired = 0
+    for key, arena in list(pool.items()):
+        if getattr(arena, "_pooled", False):
+            pool.pop(key, None)
+            arena.close(force_unlink=True)
+            retired += 1
+    return retired
 
 
 # -- the collectives ---------------------------------------------------------

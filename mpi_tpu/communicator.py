@@ -665,9 +665,15 @@ def startall(requests: Sequence[PersistentRequest]) -> List[PersistentRequest]:
 
 class _ThreadRequest(Request):
     """Nonblocking collective in flight: the blocking algorithm runs on a
-    thread against an isolated context (see P2PCommunicator._nbc_comm)."""
+    thread against an isolated context (see P2PCommunicator._nbc_comm).
+
+    This is the FALLBACK path (ISSUE 12): worlds running the async
+    progress engine dispatch i-collectives as schedule state machines
+    instead (mpi_tpu/nbc.py — zero per-call threads, pvar-asserted via
+    ``nbc_threads_spawned``, which counts every spawn here)."""
 
     def __init__(self, fn):
+        _mpit.count(nbc_threads_spawned=1)
         self._value: Any = None
         self._error: Optional[BaseException] = None
 
@@ -1388,7 +1394,7 @@ class P2PCommunicator(Communicator):
             if vw is not None:
                 vw.wait_exit()
 
-    def _empty_poll_check(self, source: int, tag: int) -> None:
+    def _empty_poll_check(self, source: int, tag: int, req=None) -> None:
         """FT gate of the NONBLOCKING completion paths (Request.test,
         iprobe, improbe) on their EMPTY path: apply queued revocations
         and convert a detector hit on a relevant peer into
@@ -1408,7 +1414,10 @@ class P2PCommunicator(Communicator):
         eng = self._progress
         if eng is not None:
             eng.check_error()  # a proven Waitany-loop deadlock raises
-            eng.note_empty_poll()
+            # ``req`` (state-machine requests, mpi_tpu/nbc.py) lets the
+            # engine publish THAT call's exact pending OR-set instead
+            # of the union over all tracked requests
+            eng.note_empty_poll(req)
         if self._ft is not None:
             self._ft.check(self)
             src_world = (ANY_SOURCE if source == ANY_SOURCE
@@ -1667,6 +1676,12 @@ class P2PCommunicator(Communicator):
         BEFORE any collective data moves.  A single attribute test when
         the verifier is off."""
         if self._verify is not None and self.size > 1:
+            if getattr(self, "_verify_sig_frozen", False):
+                # persistent collective (mpi_tpu/nbc.py): the signature
+                # was exchanged ONCE at init and MPI-4 binds the
+                # argument list, so per-round re-checks are frozen —
+                # the hoist the persistent handle exists for
+                return
             from .verify import collcheck as _vcc
 
             _vcc.check(self, coll, root=root, op=op, payload=payload,
@@ -2907,12 +2922,37 @@ class P2PCommunicator(Communicator):
             self._track_request(req, kind, root, _TAG_COLL)
         return req
 
+    def _nbc_sm(self, kind: str, *args: Any, **kwargs: Any) -> Optional[Request]:
+        """Engine-owned attempt of one i-collective (mpi_tpu/nbc.py,
+        ISSUE 12): a Request when this call compiled into a schedule
+        state machine on the progress engine, None for the per-call-
+        thread fallback below.  Verified worlds keep the thread — the
+        per-call signature exchange is a blocking ring the state
+        machine deliberately skips (persistent collectives hoist it to
+        init instead).  Eligibility depends only on group-congruent
+        facts (world engine/verifier/mode, kind, root, reduction
+        geometry), so every rank takes the same path and the plan's
+        wire traffic stays the blocking algorithm's frame sequence."""
+        if self._progress is None or self._verify is not None:
+            return None
+        from . import nbc as _nbc
+
+        if _nbc.mode() != "auto":
+            return None
+        return _nbc.try_state_machine(self, kind, *args, **kwargs)
+
     def ibcast(self, obj: Any, root: int = 0) -> Request:
+        req = self._nbc_sm("ibcast", obj, root=root)
+        if req is not None:
+            return req
         c = self._nbc_comm()
         return self._nbc_request("ibcast", lambda: c.bcast(obj, root), root)
 
     def ireduce(self, obj: Any, op: _ops.ReduceOp = _ops.SUM,
                 root: int = 0) -> Request:
+        req = self._nbc_sm("ireduce", obj, op=op, root=root)
+        if req is not None:
+            return req
         c = self._nbc_comm()
         return self._nbc_request("ireduce", lambda: c.reduce(obj, op, root),
                                  root)
@@ -2920,6 +2960,10 @@ class P2PCommunicator(Communicator):
     def iallreduce(self, obj: Any, op: _ops.ReduceOp = _ops.SUM,
                    algorithm: str = "auto",
                    compress_key: Any = None) -> Request:
+        req = self._nbc_sm("iallreduce", obj, op=op, algorithm=algorithm,
+                           compress_key=compress_key)
+        if req is not None:
+            return req
         c = self._nbc_comm()
         return self._nbc_request(
             "iallreduce",
@@ -2927,26 +2971,80 @@ class P2PCommunicator(Communicator):
                                 compress_key=compress_key))
 
     def iallgather(self, obj: Any) -> Request:
+        req = self._nbc_sm("iallgather", obj)
+        if req is not None:
+            return req
         c = self._nbc_comm()
         return self._nbc_request("iallgather", lambda: c.allgather(obj))
 
     def ialltoall(self, objs: Sequence[Any]) -> Request:
+        req = self._nbc_sm("ialltoall", objs)
+        if req is not None:
+            return req
         c = self._nbc_comm()
         return self._nbc_request("ialltoall", lambda: c.alltoall(objs))
 
     def ibarrier(self) -> Request:
+        req = self._nbc_sm("ibarrier")
+        if req is not None:
+            return req
         c = self._nbc_comm()
         return self._nbc_request("ibarrier", c.barrier)
 
     def iscatter(self, objs: Optional[Sequence[Any]], root: int = 0) -> Request:
+        req = self._nbc_sm("iscatter", objs, root=root)
+        if req is not None:
+            return req
         c = self._nbc_comm()
         return self._nbc_request("iscatter", lambda: c.scatter(objs, root),
                                  root)
 
     def igather(self, obj: Any, root: int = 0) -> Request:
+        req = self._nbc_sm("igather", obj, root=root)
+        if req is not None:
+            return req
         c = self._nbc_comm()
         return self._nbc_request("igather", lambda: c.gather(obj, root),
                                  root)
+
+    # -- persistent collectives (MPI_Allreduce_init & co. [S: MPI-4
+    # ch.6.11], mpi_tpu/nbc.py) — plan once, start() every step --------------
+
+    def allreduce_init(self, obj: Any, op: _ops.ReduceOp = _ops.SUM,
+                       algorithm: str = "auto",
+                       compress_key: Any = None):
+        """MPI_Allreduce_init: returns a PersistentColl handle that
+        hoists child-context creation, tuned-table resolution, schedule
+        compilation, and the verifier signature exchange out of the
+        per-iteration path; ``start()`` re-reads ``obj`` (the MPI
+        buffer-reuse idiom) and re-fires the compiled plan."""
+        from . import nbc as _nbc
+
+        return _nbc.persistent_init(self, "allreduce", obj, op, algorithm,
+                                    compress_key)
+
+    def bcast_init(self, obj: Any, root: int = 0, algorithm: str = "auto"):
+        """MPI_Bcast_init [S: MPI-4]: planned broadcast (binomial-tree
+        plan on the engine; the blocking algorithm per round off it)."""
+        from . import nbc as _nbc
+
+        return _nbc.persistent_init(self, "bcast", obj, root, algorithm)
+
+    def alltoall_init(self, objs: Sequence[Any], algorithm: str = "auto"):
+        """MPI_Alltoall_init [S: MPI-4]: planned pairwise exchange."""
+        from . import nbc as _nbc
+
+        return _nbc.persistent_init(self, "alltoall", objs, algorithm)
+
+    def reduce_scatter_init(self, blocks: Any,
+                            op: _ops.ReduceOp = _ops.SUM,
+                            algorithm: str = "auto"):
+        """MPI_Reduce_scatter_init [S: MPI-4]: planned block-ring
+        reduce_scatter."""
+        from . import nbc as _nbc
+
+        return _nbc.persistent_init(self, "reduce_scatter", blocks, op,
+                                    algorithm)
 
     def free(self) -> None:
         """Sub-communicators share the world transport: no-op (plus the

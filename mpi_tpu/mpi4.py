@@ -98,13 +98,22 @@ class PersistentCollective(Request):
 
 
 def persistent_collective(comm: Communicator, method: str, *args: Any,
-                          **kwargs: Any) -> PersistentCollective:
+                          **kwargs: Any):
     """Generic MPI_*_init for collectives: ``method`` is the Communicator
     method name ('bcast', 'allreduce', 'reduce', 'allgather', 'alltoall',
-    'barrier', ...)."""
+    'barrier', ...).  The plannable kinds (allreduce/bcast/alltoall/
+    reduce_scatter) return the engine-owned handle (mpi_tpu/nbc.py,
+    ISSUE 12) — compiled schedule, hoisted child context + tuned-table
+    resolution + verifier signature, zero-thread ``start()`` re-fires on
+    progress-engine worlds; everything else keeps the generic
+    one-thread-per-round handle with identical start/wait discipline."""
     c = _require_p2p(comm, "persistent collectives")
     if not callable(getattr(c, method, None)):
         raise ValueError(f"unknown collective method {method!r}")
+    from . import nbc as _nbc
+
+    if method in _nbc.PERSISTENT_KINDS:
+        return _nbc.persistent_init(c, method, *args, **kwargs)
     return PersistentCollective(c, method, args, kwargs)
 
 

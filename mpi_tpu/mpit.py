@@ -49,7 +49,8 @@ class _Counters:
                  "tuned_hits", "tuned_fallbacks",
                  "link_reconnects", "link_replayed", "link_masked",
                  "link_retained", "link_cow_snaps", "link_cow_bytes",
-                 "link_syscalls")
+                 "link_syscalls",
+                 "nbc_threads", "nbc_sms", "persist_starts")
 
     def __init__(self) -> None:
         self.sends = 0
@@ -91,6 +92,9 @@ class _Counters:
         self.link_cow_snaps = 0
         self.link_cow_bytes = 0
         self.link_syscalls = 0
+        self.nbc_threads = 0
+        self.nbc_sms = 0
+        self.persist_starts = 0
 
 
 counters = _Counters()  # incremented by communicator.py / codec.py (count())
@@ -120,7 +124,10 @@ def count(sends: int = 0, send_bytes: int = 0, recvs: int = 0,
           link_bytes_retained: int = 0,
           link_cow_snapshots: int = 0,
           link_cow_bytes: int = 0,
-          link_send_syscalls: int = 0) -> None:
+          link_send_syscalls: int = 0,
+          nbc_threads_spawned: int = 0,
+          nbc_state_machines: int = 0,
+          persistent_starts: int = 0) -> None:
     """Thread-safe increment (rank-threads of the local backend share
     this process's counters; unsynchronized += would lose updates)."""
     with _lock:
@@ -163,6 +170,9 @@ def count(sends: int = 0, send_bytes: int = 0, recvs: int = 0,
         counters.link_cow_snaps += link_cow_snapshots
         counters.link_cow_bytes += link_cow_bytes
         counters.link_syscalls += link_send_syscalls
+        counters.nbc_threads += nbc_threads_spawned
+        counters.nbc_sms += nbc_state_machines
+        counters.persist_starts += persistent_starts
 
 _PVARS: Dict[str, Callable[[], int]] = {
     "msgs_sent": lambda: counters.sends,
@@ -279,6 +289,15 @@ _PVARS: Dict[str, Callable[[], int]] = {
     "link_cow_snapshots": lambda: counters.link_cow_snaps,
     "link_cow_bytes": lambda: counters.link_cow_bytes,
     "link_send_syscalls": lambda: counters.link_syscalls,
+    # engine-owned nonblocking collectives (mpi_tpu/nbc.py, ISSUE 12):
+    # per-call _ThreadRequest threads actually SPAWNED (the cost the
+    # state machines remove — exactly 0 when every i-collective rode
+    # the engine), schedule state machines launched in their place, and
+    # persistent-collective start() re-fires (the hot-loop path that
+    # skips per-call compile/resolve/verify work).
+    "nbc_threads_spawned": lambda: counters.nbc_threads,
+    "nbc_state_machines": lambda: counters.nbc_sms,
+    "persistent_starts": lambda: counters.persist_starts,
 }
 
 
@@ -366,6 +385,7 @@ def _ensure_builtin_cvars() -> None:
     from . import ft as _ft
     from . import io as _io
     from . import membership as _membership
+    from . import nbc as _nbc
     from . import progress as _prog
     from . import resilience as _resilience
     from . import tuning as _tuning
@@ -447,6 +467,22 @@ def _ensure_builtin_cvars() -> None:
                 f"progress must be one of {list(_prog.MODES)}, got {v!r}")
         _prog._DEFAULT_MODE = v
 
+    def _set_nbc_mode(v):
+        if v not in _nbc.MODES:
+            raise ValueError(
+                f"nbc_mode must be one of {list(_nbc.MODES)}, got {v!r}")
+        _nbc._MODE = v
+
+    def _set_nbc_fold_workers(v):
+        if int(v) < 1:
+            raise ValueError("nbc_fold_workers must be >= 1")
+        _nbc._FOLD_WORKERS = int(v)
+
+    def _set_nbc_sm_max(v):
+        if int(v) < 0:
+            raise ValueError("nbc_sm_max_bytes must be >= 0 (0 = no cap)")
+        _nbc._SM_MAX_BYTES = int(v)
+
     with _lock:
         if _builtin_done:
             return
@@ -523,6 +559,40 @@ def _ensure_builtin_cvars() -> None:
             "attribute test per operation.  Explicit run_local("
             "progress=...) and the MPI_TPU_PROGRESS environment "
             "variable override; read at world creation")
+        _CVARS["nbc_mode"] = (
+            lambda: _nbc._MODE, _set_nbc_mode,
+            "nonblocking-collective dispatch mode (mpi_tpu/nbc.py): "
+            "'auto' compiles i-collectives into schedule state machines "
+            "advanced by the async progress engine whenever the world "
+            "runs one (zero per-call threads — nbc_threads_spawned "
+            "stays 0, nbc_state_machines counts); 'thread' forces "
+            "today's one-_ThreadRequest-per-call semantics everywhere "
+            "(the escape hatch, and the honest pre/post bench toggle).  "
+            "Worlds without the engine always take the thread path.  "
+            "MPI_TPU_NBC seeds the default")
+        _CVARS["nbc_fold_workers"] = (
+            lambda: _nbc._FOLD_WORKERS, _set_nbc_fold_workers,
+            "width of the per-world fold pool that advances collective "
+            "state machines (mpi_tpu/nbc.py): receive completions "
+            "enqueue the machine, a pool worker applies its folds/"
+            "copies and posts the sends they unlock — so reductions "
+            "never run on the engine thread.  2 (default) keeps one "
+            "worker free while another blocks in a ring-full forward.  "
+            "Read at a world's first state machine; "
+            "MPI_TPU_NBC_FOLD_WORKERS seeds the default")
+        _CVARS["nbc_sm_max_bytes"] = (
+            lambda: _nbc._SM_MAX_BYTES, _set_nbc_sm_max,
+            "payload ceiling of the state-machine i-collective path "
+            "(mpi_tpu/nbc.py): reductions whose working buffer — or "
+            "ialltoall calls whose largest block — exceeds this many "
+            "bytes keep the threaded blocking algorithms, whose "
+            "SEGMENTED pipelines own the bandwidth regime, while "
+            "latency-bound calls below it ride the engine with zero "
+            "per-call threads.  0 removes the cap.  Must agree across "
+            "the group for the reductions (geometry-congruent plans); "
+            "the alltoall gate is rank-local by design (both paths "
+            "emit the identical pairwise frame sequence).  "
+            "MPI_TPU_NBC_SM_MAX_BYTES seeds the default")
         _CVARS["coll_sm_arena_bytes"] = (
             lambda: _sm._ARENA_BYTES, _set_sm_arena,
             "size of the per-communicator shared-memory collective arena "

@@ -1,0 +1,902 @@
+"""Engine-owned nonblocking collectives — schedule state machines, not
+threads (ISSUE 12 tentpole; the MPICH/libNBC shape) — plus MPI-4
+persistent collectives built on the same compiled-schedule object.
+
+Why: every i-collective used to spawn one ``_ThreadRequest`` thread per
+call (``communicator.py``): at production request rates thread spawn is
+the latency floor, and 1000 concurrent iallreduces meant 1000 OS
+threads.  With the async progress engine attached (``progress=thread``,
+mpi_tpu/progress.py) the schedules already exist as pure data
+(mpi_tpu/schedules.py), so a nonblocking collective compiles into a
+per-rank *step plan* — ``[(sends, recvs), ...]`` span/value tables —
+and runs as a state machine advanced by the engine's completion
+callbacks:
+
+* every internal receive of the plan is posted up front on an isolated
+  per-call context (the ``_nbc_comm`` scheme), with ``_on_complete``
+  kicking this machine;
+* receive ACTIONS (fold via ``op.combine_into`` / copy / store) are
+  applied strictly in plan order on a small bounded **fold pool**
+  (cvar ``nbc_fold_workers``, default 2, one pool per world) so
+  reductions never run on the engine thread;
+* sends are credit-limited ``send_ahead`` steps past the last completed
+  step — exactly the blocking algorithms' dependency/window structure
+  (ring folds gate the next forward; pairwise alltoall keeps
+  ``_SEG_WINDOW`` rounds in flight);
+* ``wait()``/``test()`` stay caller-financed fallbacks (the engine
+  merely makes them unnecessary), with the same FT detector /
+  revocation / recv_timeout slicing as ``_progress_wait_request``.
+
+Zero per-call thread creation is pvar-asserted: ``nbc_threads_spawned``
+counts every ``_ThreadRequest`` spawn and stays 0 for the state-machine
+path, while ``nbc_state_machines`` counts compiled-schedule requests.
+
+Fallbacks (today's thread semantics, unchanged): ``progress=none``
+worlds, the runtime verifier (per-call signature exchange is a blocking
+ring — state machines skip it, so verified i-collectives keep the
+thread), compressed/topk algorithms, payloads a span plan cannot fold
+(object dtypes), and the ``nbc_mode=thread`` cvar kill switch.  Mixed
+eligibility inside one group is safe by construction for the payload-
+dependent cases (alltoall/reduce): the plan's wire traffic is the
+blocking algorithm's frame sequence on the same per-call context.
+
+MPI-4 persistent collectives (``allreduce_init`` / ``bcast_init`` /
+``alltoall_init`` / ``reduce_scatter_init`` [S: MPI-4 ch.6.11]) hoist
+everything a hot training loop pays per call — child-context creation,
+tuned-table algorithm resolution, schedule compilation, working-buffer
+allocation, and the verifier's collective signature (exchanged ONCE at
+init; per-round checks are frozen) — into init; each ``start()`` only
+refills the bound buffer, re-posts the plan's receives, and fires
+(``persistent_starts`` pvar).  Without the engine, ``start()`` falls
+back to one thread per round on the same hoisted context.
+"""
+
+from __future__ import annotations
+
+import os
+import queue
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from . import bufpool as _bufpool
+from . import coll_sm as _coll_sm
+from . import mpit as _mpit
+from . import ops as _ops
+from . import schedules
+from . import tuning as _tuning
+from .communicator import (P2PCommunicator, Request, _CompletedRequest,
+                           _FT_POLL_S, _SEG_WINDOW, _TAG_COLL, _as_array,
+                           _maybe_stack, _unpost, _unwrap,
+                           seed_allreduce_algorithm)
+from .errors import ProcFailedError
+from .transport.base import ANY_SOURCE, RecvTimeout, payload_nbytes
+
+__all__ = ["try_state_machine", "persistent_init", "PersistentColl"]
+
+# Dispatch mode: "auto" = state machines whenever the world runs the
+# progress engine (the i-collective entry points consult this through
+# communicator._nbc_sm); "thread" = always today's one-thread-per-call
+# _ThreadRequest semantics (the honest pre/post bench toggle and the
+# escape hatch).  mpit cvar ``nbc_mode``; MPI_TPU_NBC seeds the default.
+MODES = ("auto", "thread")
+_MODE = os.environ.get("MPI_TPU_NBC", "auto")
+
+# Payload ceiling of the state-machine path (mpit cvar
+# ``nbc_sm_max_bytes``, 0 = no cap): calls larger than this keep the
+# threaded blocking algorithms — their SEGMENTED pipelines (sub-span
+# frames + windowed credit, _seg_exchange) own the bandwidth regime,
+# and a blocking caller's recv-wait drains its own ring INLINE
+# (the user-waiter priority) where a state machine's waiter rides the
+# engine thread's doorbell hops.  Two spellings:
+#
+# * span modes gate on the working buffer — reduction geometry is
+#   congruent across ranks (the reduction contract), so the gate is
+#   group-coherent by construction;
+# * ialltoall gates on its largest BLOCK (the largest single frame a
+#   value plan would ship whole).  This decision is rank-local and
+#   deliberately so: both paths emit the identical pairwise
+#   whole-frame sequence on the same per-call context, so a gated rank
+#   interoperates frame-for-frame with an ungated peer.
+#
+# The remaining value plans (bcast/allgather/gather/scatter/barrier)
+# are NOT size-gated: bcast receivers don't know the payload size
+# before the frame lands, and allgather's thread fallback picks
+# DOUBLING on pow2 groups (a different wire pattern than the ring
+# plan) — a payload-conditioned gate there could split one group
+# across incompatible algorithms.
+
+_SM_MAX_BYTES = int(os.environ.get("MPI_TPU_NBC_SM_MAX_BYTES",
+                                   str(1 << 20)))
+
+# Fold-pool width per world (mpit cvar ``nbc_fold_workers``; read at the
+# pool's first use).  2 keeps one worker free while another blocks in a
+# ring-full forward; the pool is deliberately tiny — it exists so folds
+# never run on the engine thread, not to parallelize numpy.
+_FOLD_WORKERS = int(os.environ.get("MPI_TPU_NBC_FOLD_WORKERS", "2"))
+
+# The initial send window is emitted inline on the issuing caller when
+# it is at most this many bytes (latency path: skip one pool hop);
+# larger first windows go to the fold pool so issue() never blocks the
+# caller in a ring-full send of a bandwidth-size payload.
+_INLINE_FIRE_MAX = 64 << 10
+
+# Compiled plan memo: (kind, algorithm, p, rank, geometry) -> steps.
+# Plans are pure data; 1000 concurrent same-shape iallreduces compile
+# once.  Bounded FIFO — plans are cheap to rebuild.
+_PLAN_MEMO: Dict[Tuple, Tuple] = {}
+_PLAN_MEMO_MAX = 256
+_PLAN_LOCK = threading.Lock()
+
+
+def mode() -> str:
+    return _MODE
+
+
+def _plan(key: Tuple, build: Callable[[], Tuple]) -> Tuple:
+    with _PLAN_LOCK:
+        hit = _PLAN_MEMO.get(key)
+    if hit is not None:
+        return hit
+    steps = build()
+    with _PLAN_LOCK:
+        if len(_PLAN_MEMO) >= _PLAN_MEMO_MAX:
+            _PLAN_MEMO.pop(next(iter(_PLAN_MEMO)))
+        _PLAN_MEMO[key] = steps
+    return steps
+
+
+# -- the bounded fold pool ----------------------------------------------------
+
+
+class FoldPool:
+    """A tiny per-world worker pool that advances state machines: recv
+    completions enqueue the machine (deduplicated), a worker drains its
+    ready actions and posts the sends they unlock.  Workers are created
+    ONCE per world — the fixed-cost counterpart of the per-call threads
+    this module removes (``nbc_threads_spawned`` stays 0)."""
+
+    def __init__(self, nworkers: int) -> None:
+        self._q: "queue.SimpleQueue" = queue.SimpleQueue()
+        self._threads = []
+        for i in range(max(1, int(nworkers))):
+            t = threading.Thread(target=self._run, daemon=True,
+                                 name=f"mpi-tpu-nbc-fold-{i}")
+            t.start()
+            self._threads.append(t)
+
+    def submit(self, sm: "_SMColl") -> None:
+        self._q.put(sm)
+
+    def _run(self) -> None:
+        while True:
+            sm = self._q.get()
+            if sm is None:
+                return
+            # _pump records its own errors on the machine; a raise here
+            # would only kill the worker
+            sm._pump()
+
+    def stop(self) -> None:
+        for _ in self._threads:
+            self._q.put(None)
+
+
+def pool_for(transport) -> FoldPool:
+    pool = getattr(transport, "_nbc_fold_pool", None)
+    if pool is None:
+        with _PLAN_LOCK:  # two first-machines racing must share one pool
+            pool = getattr(transport, "_nbc_fold_pool", None)
+            if pool is None:
+                pool = transport._nbc_fold_pool = FoldPool(_FOLD_WORKERS)
+    return pool
+
+
+# -- the state machine --------------------------------------------------------
+
+
+class _SMColl(Request):
+    """One nonblocking collective as a schedule state machine.
+
+    State is guarded by ``self._lock``; advancement (``_pump``) is
+    idempotent and may run on a fold-pool worker, the engine's
+    completion callback path (via the pool), or the waiting caller —
+    whoever gets there first.  Receive actions apply strictly in plan
+    order (deterministic fold order, the blocking algorithms' exact
+    sequence); sends are emitted in step order once their credit is
+    due.  Errors (transport, FT, fold) are recorded and re-raised at
+    wait()/test(), with the machine's remaining posted receives
+    un-posted so no stale queue heads survive (the ``_unpost`` rule)."""
+
+    __slots__ = ("kind", "_parent", "_comm", "_mode", "_steps",
+                 "_send_ahead", "_work", "_svals", "_rvals", "_op",
+                 "_finish", "_actions", "_srem", "_ai", "_rdt", "_nss",
+                 "_done", "_error", "_result", "_lock", "_qlock",
+                 "_queued", "_pool")
+
+    # every frame of a state machine travels on the internal collective
+    # tag — what the engine's stalled-poll publication reports
+    _tag = _TAG_COLL
+
+    def __init__(self, parent: P2PCommunicator, child: P2PCommunicator,
+                 kind: str, plan_mode: str, steps: Tuple,
+                 send_ahead: int, work: Optional[np.ndarray],
+                 svals: Optional[list], rvals: Optional[list],
+                 op: Optional[_ops.ReduceOp],
+                 finish: Callable[["_SMColl"], Any]) -> None:
+        self.kind = kind
+        self._parent = parent
+        self._comm = child
+        self._mode = plan_mode
+        self._steps = steps
+        self._send_ahead = max(1, send_ahead)
+        self._work = work
+        self._svals = svals
+        self._rvals = rvals
+        self._op = op
+        self._finish = finish
+        self._srem = [len(st[1]) for st in steps]
+        self._actions: List[Tuple[Any, int, Tuple]] = []
+        self._ai = 0
+        self._rdt = 0   # recv-done-through: first step with recvs pending
+        self._nss = 0   # next step whose sends are not yet emitted
+        self._done = False
+        self._error: Optional[BaseException] = None
+        self._result: Any = None
+        self._lock = threading.Lock()
+        self._qlock = threading.Lock()
+        self._queued = False
+        self._pool = pool_for(child._t)
+        child._coll_name = kind  # ProcFailedError diagnoses name the coll
+
+    # -- issue-time arming -------------------------------------------------
+
+    def _arm(self) -> "_SMColl":
+        """Post every receive of the plan (in step order — per-source
+        FIFO then matches the peer's step-ordered sends) with this
+        machine's kick as the completion callback, atomically with the
+        engine (the post-then-attach gap rule from _seg_exchange), then
+        fire the initial send window."""
+        eng = self._parent._progress
+        child = self._comm
+        with eng.cv:
+            for step_i, (sends, recvs) in enumerate(self._steps):
+                for spec in recvs:
+                    req = child._irecv_internal(spec[0], _TAG_COLL)
+                    req._on_complete = self._kick
+                    self._actions.append((req, step_i, spec))
+        if self._first_window_bytes() <= _INLINE_FIRE_MAX:
+            self._pump()
+        else:
+            self._pool.submit(self)
+        return self
+
+    def _first_window_bytes(self) -> int:
+        total = 0
+        for st in self._steps[:self._send_ahead]:
+            for spec in st[0]:
+                if self._mode == "span":
+                    total += (spec[2] - spec[1]) * self._work.itemsize
+                else:
+                    v = None if spec[1] < 0 else self._svals[spec[1]]
+                    total += payload_nbytes(v) or 0
+        return total
+
+    # -- advancement -------------------------------------------------------
+
+    def _kick(self) -> None:
+        with self._qlock:
+            if self._queued:
+                return
+            self._queued = True
+        self._pool.submit(self)
+
+    def _pump(self) -> None:
+        with self._qlock:
+            self._queued = False
+        with self._lock:
+            if self._done or self._error is not None:
+                return
+            try:
+                self._advance_locked()
+            except BaseException as e:  # noqa: BLE001 - surfaced at wait
+                self._error = e
+                _unpost([r for r, _, _ in self._actions[self._ai:]
+                         if r is not None and not r._done])
+                self._notify()
+
+    def _advance_locked(self) -> None:
+        n = len(self._steps)
+        progressed = True
+        while progressed:
+            progressed = False
+            while self._ai < len(self._actions):
+                req, step_i, spec = self._actions[self._ai]
+                if not req._done:
+                    break
+                self._apply(spec, req._value)
+                self._srem[step_i] -= 1
+                self._ai += 1
+                progressed = True
+            while self._rdt < n and self._srem[self._rdt] == 0:
+                self._rdt += 1
+                progressed = True
+            while self._nss < n and self._nss < self._rdt + self._send_ahead:
+                for spec in self._steps[self._nss][0]:
+                    self._emit(spec)
+                self._nss += 1
+                progressed = True
+        if self._rdt == n and self._nss == n and not self._done:
+            self._result = self._finish(self)
+            self._done = True
+            self._notify()
+
+    def _apply(self, spec: Tuple, got: Any) -> None:
+        if self._mode == "span":
+            _, lo, hi, fold = spec
+            view = self._work[lo:hi]
+            if fold:
+                self._op.combine_into(view, got)
+            else:
+                # ownership CoW (bufpool.py): the span may have just been
+                # SENT — retained frames must snapshot before overwrite
+                _bufpool.touch(view)
+                view[...] = got
+        else:
+            _, slot = spec
+            if slot >= 0:
+                self._rvals[slot] = got
+
+    def _emit(self, spec: Tuple) -> None:
+        child = self._comm
+        if self._mode == "span":
+            dst, lo, hi = spec
+            child._send_internal(child._coll_payload(self._work[lo:hi]),
+                                 dst, _TAG_COLL)
+        else:
+            dst, slot = spec
+            payload = None if slot < 0 else self._svals[slot]
+            child._send_internal(payload, dst, _TAG_COLL)
+
+    def _notify(self) -> None:
+        eng = self._parent._progress
+        with eng.cv:
+            eng.cv.notify_all()
+
+    def _fail(self, err: BaseException) -> None:
+        """Record a CALLER-detected failure (FT verdict, recv timeout)
+        on the machine, exactly like _pump records advancement errors:
+        remaining posted receives are un-posted so no stale queue heads
+        survive on a reused persistent child context, and later
+        wait()/test() calls re-raise ``err`` instead of reporting the
+        round still in flight."""
+        with self._lock:
+            if self._done or self._error is not None:
+                return
+            self._error = err
+            _unpost([r for r, _, _ in self._actions[self._ai:]
+                     if not r._done])
+        self._notify()
+
+    def _pending_world_srcs(self) -> Tuple[int, ...]:
+        """World ranks whose frames this machine is still waiting on —
+        the exact per-call OR-set (verifier residual (d))."""
+        child = self._comm
+        out = set()
+        for req, _, _ in self._actions[self._ai:]:
+            if not req._done:
+                out.add(child._world(req._source))
+        return tuple(sorted(out))
+
+    # -- completion --------------------------------------------------------
+
+    def _drive(self) -> None:
+        """Caller-financed completion attempt: drain our posted queues
+        through the engine's completion lock (never a blocking consume
+        — the engine may already have matched a sibling), then advance
+        inline.  Liveness never depends on the engine thread or the
+        fold pool."""
+        eng = self._parent._progress
+        cbs: List = []
+        with eng.cv:
+            for req, _, _ in self._actions[self._ai:]:
+                if not req._done:
+                    cbs.extend(eng.try_complete(req))
+        for cb in cbs:
+            cb()
+        self._pump()
+
+    def wait(self) -> Any:
+        eng = self._parent._progress
+        child = self._comm
+        ft = child._ft
+        timeout = child.recv_timeout
+        start = time.monotonic()
+        deadline = None if timeout is None else start + timeout
+        while True:
+            if not self._done and self._error is None:
+                self._drive()
+            if self._error is not None:
+                self._vnote(True)
+                raise self._error
+            if self._done:
+                self._vnote(True)
+                return self._result
+            if ft is not None:
+                ft.check(child)
+                suspects = child._ft_suspects(ANY_SOURCE, _TAG_COLL)
+                if suspects:
+                    err: BaseException = ProcFailedError(
+                        f"rank {child.rank}: peer death detected while "
+                        f"waiting on nonblocking collective {self.kind!r}",
+                        failed=suspects, collective=self.kind)
+                    self._fail(err)
+                    raise err
+            if deadline is not None and time.monotonic() >= deadline:
+                err = RecvTimeout(
+                    f"{self.kind} state machine timed out after {timeout}s "
+                    f"waiting on sources {self._pending_world_srcs()}; "
+                    f"pending={child._t.mailbox.pending_summary()}")
+                self._fail(err)
+                raise err
+            with eng.cv:
+                if not self._done and self._error is None:
+                    eng.cv.wait(_FT_POLL_S)
+
+    def test(self) -> Tuple[bool, Any]:
+        if not self._done and self._error is None:
+            self._drive()
+        if self._error is not None:
+            self._vnote(True, blocking=False)
+            raise self._error
+        if self._done:
+            self._vnote(True, blocking=False)
+            return True, self._result
+        # empty path: FT gate + per-call OR-set poll note (the engine
+        # publishes exactly the sources THIS machine still waits on)
+        self._comm._empty_poll_check(ANY_SOURCE, _TAG_COLL, req=self)
+        return False, None
+
+
+# -- plan construction --------------------------------------------------------
+
+
+def _resolve_allreduce_algorithm(comm: P2PCommunicator, arr: np.ndarray,
+                                 algorithm: str) -> Optional[str]:
+    """The algorithm an nbc clone's blocking allreduce would execute
+    (its arena always declines): tuned rows first, seed constants
+    otherwise.  None = not a plan-able wire algorithm (compressed, or
+    an unknown name — the thread path owns raising for those)."""
+    if algorithm in ("auto", "sm", "fused"):
+        pick = None
+        if algorithm in ("auto", "fused") and comm.size > 1:
+            pick = _tuning.pick(
+                comm, "allreduce", arr.nbytes,
+                ("ring", "rabenseifner", "reduce_bcast")
+                + (("recursive_halving",)
+                   if schedules.is_pow2(comm.size) else ())
+                + _coll_sm.gate(comm))
+        if pick is not None and pick != "sm":
+            return pick
+        return seed_allreduce_algorithm(arr.nbytes, comm.size)
+    if algorithm in ("ring", "rabenseifner", "reduce_bcast"):
+        return algorithm
+    if algorithm == "recursive_halving" and schedules.is_pow2(comm.size):
+        return algorithm
+    return None
+
+
+def _allreduce_steps(algorithm: str, p: int, r: int, n: int) -> Tuple:
+    key = ("allreduce", algorithm, p, r, n)
+    if algorithm == "reduce_bcast":
+        return _plan(key, lambda: tuple(
+            schedules.reduce_bcast_allreduce_steps(p, r, n)))
+    offs = schedules.chunk_offsets(n, p)
+    build = {"ring": schedules.ring_allreduce_steps,
+             "recursive_halving": schedules.halving_allreduce_steps,
+             "rabenseifner": schedules.rabenseifner_allreduce_steps}[algorithm]
+    return _plan(key, lambda: tuple(build(p, r, offs)))
+
+
+def _build(parent: P2PCommunicator, kind: str, args: tuple,
+           kwargs: dict) -> Optional[dict]:
+    """Phase 1 — pure: validate + resolve + compile.  Returns the build
+    dict (plan + buffers + finisher) or None when this call cannot ride
+    a state machine (the caller falls back to the thread path, which
+    re-raises any user error at wait() exactly as before)."""
+    p, r = parent.size, parent.rank
+    if kind == "iallreduce":
+        obj, = args
+        op = kwargs.get("op", _ops.SUM)
+        algorithm = kwargs.get("algorithm", "auto")
+        if kwargs.get("compress_key") is not None:
+            return None  # top-k residual state: the blocking path owns it
+        arr, scalar = _as_array(obj)
+        if arr.dtype.hasobject or arr.dtype.kind == "V":
+            return None
+        if _SM_MAX_BYTES and arr.nbytes > _SM_MAX_BYTES:
+            return None  # bandwidth regime: segmented threaded path
+        algorithm = _resolve_allreduce_algorithm(parent, arr, algorithm)
+        if algorithm is None:
+            return None
+        if p == 1:
+            return {"done": _unwrap(arr.copy(), scalar)}
+        work = arr.flatten()
+        shape = arr.shape
+        return {
+            "mode": "span", "send_ahead": 1, "op": op, "work": work,
+            "steps": _allreduce_steps(algorithm, p, r, work.size),
+            "finish": lambda sm: _unwrap(sm._work.reshape(shape), scalar),
+        }
+
+    if kind == "ireduce":
+        obj, = args
+        op = kwargs.get("op", _ops.SUM)
+        root = int(kwargs.get("root", 0))
+        if not (0 <= root < p):
+            return None  # thread path raises the standard error at wait
+        arr, scalar = _as_array(obj)
+        if arr.dtype.hasobject or arr.dtype.kind == "V":
+            return None
+        if _SM_MAX_BYTES and arr.nbytes > _SM_MAX_BYTES:
+            return None
+        if p == 1:
+            return {"done": _unwrap(arr.copy(), scalar)}
+        work = arr.flatten()
+        shape = arr.shape
+        is_root = r == root
+        return {
+            "mode": "span", "send_ahead": 1, "op": op, "work": work,
+            "steps": _plan(("reduce", p, r, root, work.size), lambda: tuple(
+                schedules.reduce_tree_steps(p, r, root, work.size))),
+            "finish": lambda sm: (_unwrap(sm._work.reshape(shape), scalar)
+                                  if is_root else None),
+        }
+
+    if kind == "ibcast":
+        obj, = args
+        root = int(kwargs.get("root", 0))
+        if not (0 <= root < p):
+            return None
+        if p == 1:
+            return {"done": obj}
+        vals = [obj if r == root else None]
+        return {
+            "mode": "value", "send_ahead": 1, "svals": vals, "rvals": vals,
+            "steps": _plan(("bcast", p, r, root), lambda: tuple(
+                schedules.bcast_value_steps(p, r, root))),
+            "finish": lambda sm: sm._rvals[0],
+        }
+
+    if kind == "iallgather":
+        obj, = args
+        if p == 1:
+            return {"done": [obj]}
+        vals: List[Any] = [None] * p
+        vals[r] = obj
+        return {
+            "mode": "value", "send_ahead": 1, "svals": vals, "rvals": vals,
+            "steps": _plan(("allgather", p, r), lambda: tuple(
+                schedules.allgather_ring_value_steps(p, r))),
+            "finish": lambda sm: _maybe_stack(obj, list(sm._rvals)),
+        }
+
+    if kind == "ialltoall":
+        orig, = args
+        try:
+            if len(orig) != p:
+                return None  # thread path raises the standard error
+        except TypeError:
+            return None
+        objs = list(orig)
+        if p == 1:
+            return {"done": _maybe_stack(orig, [objs[0]])}
+        if _SM_MAX_BYTES and max(
+                (payload_nbytes(o) or 0) for o in objs) > _SM_MAX_BYTES:
+            # bandwidth regime: the caller-financed windowed blocking
+            # exchange owns it (rank-local gate — see _SM_MAX_BYTES)
+            return None
+        rvals: List[Any] = [None] * p
+        rvals[r] = objs[r]
+        return {
+            "mode": "value", "send_ahead": _SEG_WINDOW,
+            "svals": objs, "rvals": rvals,
+            "steps": _plan(("alltoall", p, r), lambda: tuple(
+                schedules.alltoall_value_steps(p, r))),
+            # stack against the ORIGINAL payload (an [P, ...] array
+            # input stacks, a list never does — blocking parity)
+            "finish": lambda sm, _orig=orig: _maybe_stack(
+                _orig, list(sm._rvals)),
+        }
+
+    if kind == "ireduce_scatter":
+        blocks, = args
+        op = kwargs.get("op", _ops.SUM)
+        algorithm = kwargs.get("algorithm", "auto")
+        if algorithm not in ("auto", "ring", "fused", "sm"):
+            return None  # compressed / unknown: the blocking path owns it
+        try:
+            if len(blocks) != p:
+                return None
+        except TypeError:
+            return None
+        arr = parent._blocks_as_array(blocks)
+        if arr is None:
+            return None  # heterogeneous/object blocks: generic path
+        if _SM_MAX_BYTES and arr.nbytes > _SM_MAX_BYTES:
+            return None
+        was_scalar = arr.ndim == 1
+        if p == 1:
+            return {"done": _unwrap(np.asarray(blocks[0]).copy(),
+                                    was_scalar)}
+        shape = arr.shape[1:]
+        work = (arr.reshape(-1).copy()
+                if isinstance(blocks, np.ndarray) else arr.reshape(-1))
+        bn = work.size // p
+        return {
+            "mode": "span", "send_ahead": 1, "op": op, "work": work,
+            "steps": _plan(("reduce_scatter", p, r, work.size),
+                           lambda: tuple(
+                schedules.block_ring_reduce_scatter_steps(p, r, bn))),
+            "finish": lambda sm: _unwrap(
+                sm._work[r * bn:(r + 1) * bn].reshape(shape).copy(),
+                was_scalar),
+        }
+
+    if kind == "ibarrier":
+        if p == 1:
+            return {"done": None}
+        return {
+            "mode": "value", "send_ahead": 1, "svals": [], "rvals": [],
+            "steps": _plan(("barrier", p, r), lambda: tuple(
+                schedules.barrier_value_steps(p, r))),
+            "finish": lambda sm: None,
+        }
+
+    if kind == "igather":
+        obj, = args
+        root = int(kwargs.get("root", 0))
+        if not (0 <= root < p):
+            return None
+        if p == 1:
+            return {"done": [obj]}
+        if r == root:
+            rvals: List[Any] = [None] * p
+            rvals[r] = obj
+            steps = ((tuple(), tuple((s, s) for s in range(p)
+                                     if s != root)),)
+            return {"mode": "value", "send_ahead": 1, "svals": rvals,
+                    "rvals": rvals, "steps": steps,
+                    "finish": lambda sm: list(sm._rvals)}
+        vals = [obj]
+        return {"mode": "value", "send_ahead": 1, "svals": vals,
+                "rvals": vals, "steps": ((((root, 0),), tuple()),),
+                "finish": lambda sm: None}
+
+    if kind == "iscatter":
+        objs, = args
+        root = int(kwargs.get("root", 0))
+        if not (0 <= root < p):
+            return None
+        if r == root:
+            try:
+                if objs is None or len(objs) != p:
+                    return None  # thread path raises the standard error
+            except TypeError:
+                return None
+            objs = list(objs)
+            if p == 1:
+                return {"done": objs[0]}
+            steps = ((tuple((d, d) for d in range(p) if d != root),
+                      tuple()),)
+            return {"mode": "value", "send_ahead": 1, "svals": objs,
+                    "rvals": objs, "steps": steps,
+                    "finish": lambda sm, _root=root: sm._svals[_root]}
+        vals = [None]
+        return {"mode": "value", "send_ahead": 1, "svals": vals,
+                "rvals": vals, "steps": ((tuple(), ((root, 0),)),),
+                "finish": lambda sm: sm._rvals[0]}
+
+    return None
+
+
+def _launch(parent: P2PCommunicator, kind: str, build: dict,
+            child: Optional[P2PCommunicator] = None) -> Request:
+    _mpit.count(collectives=1)  # thread rounds count in the blocking call
+    if "done" in build:
+        return _CompletedRequest(build["done"])
+    if child is None:
+        child = parent._nbc_comm()
+    _mpit.count(nbc_state_machines=1)
+    sm = _SMColl(parent, child, kind, build["mode"], build["steps"],
+                 build["send_ahead"], build.get("work"),
+                 build.get("svals"), build.get("rvals"),
+                 build.get("op"), build["finish"])
+    return sm._arm()
+
+
+def try_state_machine(parent: P2PCommunicator, kind: str, *args: Any,
+                      **kwargs: Any) -> Optional[Request]:
+    """The i-collective entry points' state-machine attempt: a Request
+    when this call compiled onto the engine, None to take the thread
+    path.  Caller already checked engine-on / verifier-off / mode."""
+    build = _build(parent, kind, args, kwargs)
+    if build is None:
+        return None
+    return _launch(parent, kind, build)
+
+
+# -- MPI-4 persistent collectives --------------------------------------------
+
+
+#: kinds persistent_init compiles (everything else stays on the generic
+#: thread-backed mpi4.PersistentCollective)
+PERSISTENT_KINDS = ("allreduce", "bcast", "alltoall", "reduce_scatter")
+
+
+class PersistentColl(Request):
+    """A planned collective handle (MPI_Allreduce_init & co.).
+
+    Init hoists: one private child context for every round, tuned-table
+    algorithm resolution, compiled schedule, working-buffer allocation,
+    and — with the runtime verifier on — the collective-signature
+    exchange (checked ONCE here; the per-round check is frozen on the
+    child, per MPI-4: a persistent collective's arguments cannot change
+    between starts).  ``start()`` re-reads the bound buffer (the MPI
+    buffer-reuse idiom), re-posts the plan's receives on the same
+    context, and fires; rounds on one context can never cross-match
+    because start() requires the previous round complete and every rank
+    starts its persistent collectives in the same order [S].
+    """
+
+    def __init__(self, parent: P2PCommunicator, kind: str, args: tuple,
+                 kwargs: dict) -> None:
+        self._parent = parent
+        self._kind = kind
+        self._args, self._kwargs = args, kwargs
+        self._child = parent._nbc_comm()
+        self._child._coll_name = kind
+        self._req: Optional[Request] = None
+        self._last: Any = None
+        self._started = False
+        # resolve + compile once, from the bound buffer's geometry; a
+        # None build means every round runs the blocking method on a
+        # thread (same hoisted context)
+        self._build0 = _build(parent, "i" + kind, args, kwargs)
+        self._geometry = self._payload_geometry()
+        if (kind == "allreduce" and self._build0 is not None
+                and "done" not in self._build0):
+            # hoist the tuned-table consult: the geometry is bound, so
+            # the resolution is too — per-start rebuilds see the
+            # explicit algorithm name and skip the table
+            arr, _ = _as_array(args[0])
+            resolved = _resolve_allreduce_algorithm(
+                parent, arr, kwargs.get("algorithm", "auto"))
+            if resolved is not None:
+                self._kwargs = {**kwargs, "algorithm": resolved}
+        if parent._verify is not None and parent.size > 1:
+            op = kwargs.get("op")
+            payload = None
+            if kind in ("allreduce", "reduce_scatter"):
+                # block 0 for reduce_scatter (the blocking path's exact
+                # signature geometry — never a stacking asarray, which
+                # RAISES on the ragged blocks the generic thread rounds
+                # support)
+                payload = np.asarray(args[0] if kind == "allreduce"
+                                     else args[0][0])
+            self._child._verify_coll(
+                kind, root=kwargs.get("root"), op=op, payload=payload,
+                algorithm=kwargs.get("algorithm", "auto"))
+            # per MPI-4 the argument list is bound: freeze the per-round
+            # signature exchange on the child — the hoist this handle
+            # exists for
+            self._child._verify_sig_frozen = True
+
+    def _payload_geometry(self) -> Optional[Tuple]:
+        if self._kind in ("allreduce", "reduce_scatter"):
+            try:
+                arr = np.asarray(self._args[0])
+            except ValueError:
+                return None  # ragged blocks: the generic rounds own them
+            if arr.dtype.hasobject:
+                return None  # object payloads have no bindable geometry
+            return (arr.shape, arr.dtype)
+        return None
+
+    @property
+    def active(self) -> bool:
+        return self._req is not None
+
+    def start(self) -> "PersistentColl":
+        if self._req is not None and not self._req.test()[0]:
+            raise RuntimeError(
+                "start() while the previous round of this persistent "
+                "collective is still in flight (wait() it first)")
+        _mpit.count(persistent_starts=1)
+        self._started = True
+        if self._geometry is not None:
+            arr = np.asarray(self._args[0])
+            if (arr.shape, arr.dtype) != self._geometry:
+                raise ValueError(
+                    f"persistent {self._kind}: bound buffer geometry "
+                    f"changed since init ({self._geometry} -> "
+                    f"{(arr.shape, arr.dtype)}); MPI persistent "
+                    f"collectives bind the argument list")
+        build = self._round_build()
+        if build is not None:
+            self._req = _launch(self._parent, "i" + self._kind, build,
+                                child=self._child)
+        else:
+            from .communicator import _ThreadRequest
+
+            fn = getattr(self._child, self._kind)
+            a, kw = self._args, self._kwargs
+            self._req = _ThreadRequest(lambda: fn(*a, **kw))
+        return self
+
+    def _round_build(self) -> Optional[dict]:
+        """Per-start plan refresh: reuse the compiled steps, re-read the
+        bound buffer content (start-time snapshot [S]).  None = thread
+        fallback (no engine, verifier per-round coverage wanted off the
+        frozen path, or an uncompilable payload)."""
+        if (self._build0 is None or self._parent._progress is None
+                or _MODE != "auto"):
+            return None
+        # span work buffers are per-round flatten() copies and the
+        # value finishers return fresh lists, so round results never
+        # alias the bound buffer or a later round's state — safe to
+        # hand out without a defensive copy.  Size-1 "done" builds must
+        # also re-run: _build0's snapshot was taken at INIT, and start()
+        # promises a start-time read of the bound buffer.
+        return _build(self._parent, "i" + self._kind, self._args,
+                      self._kwargs)
+
+    def wait(self) -> Any:
+        if self._req is None:
+            if not self._started:
+                raise RuntimeError(
+                    "wait() before start() on a persistent collective")
+            return self._last
+        value = self._req.wait()
+        self._last, self._req = value, None
+        return value
+
+    def test(self) -> Tuple[bool, Any]:
+        if self._req is None:
+            return (True, self._last) if self._started else (False, None)
+        done, value = self._req.test()
+        if done:
+            self._last, self._req = value, None
+        return done, value
+
+
+# positional-argument names of each persistent kind, mirroring the
+# blocking methods' signatures — persistent_init normalizes positionals
+# into kwargs so _build (i-collective shape) and the thread fallback
+# (blocking method shape) read one canonical form
+_PERSISTENT_SIG = {
+    "allreduce": ("op", "algorithm", "compress_key"),
+    "bcast": ("root", "algorithm"),
+    "alltoall": ("algorithm",),
+    "reduce_scatter": ("op", "algorithm"),
+}
+
+
+def persistent_init(comm: P2PCommunicator, kind: str, payload: Any,
+                    *args: Any, **kwargs: Any) -> PersistentColl:
+    if kind not in PERSISTENT_KINDS:
+        raise ValueError(
+            f"no engine-owned persistent plan for {kind!r}; have "
+            f"{list(PERSISTENT_KINDS)}")
+    names = _PERSISTENT_SIG[kind]
+    if len(args) > len(names):
+        raise TypeError(
+            f"{kind}_init takes at most {1 + len(names)} positional "
+            f"arguments ({('payload',) + names}), got {1 + len(args)}")
+    for name, value in zip(names, args):
+        if name in kwargs:
+            raise TypeError(f"{kind}_init got {name!r} twice")
+        kwargs[name] = value
+    return PersistentColl(comm, kind, (payload,), kwargs)

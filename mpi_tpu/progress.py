@@ -151,8 +151,12 @@ class ProgressEngine:
         self.pending_error: Optional[BaseException] = None
         self._last_progress = time.monotonic()
         # pure-polling episode state (verifier publication on behalf of
-        # Waitany-style drain loops)
+        # Waitany-style drain loops); _poll_req remembers the specific
+        # state-machine collective the freshest empty poll was FOR, so
+        # publication can use that call's exact OR-set (weakref: the
+        # episode must never keep a completed request alive)
         self._last_empty_poll = 0.0
+        self._poll_req: Optional["weakref.ref"] = None
         self._episode_start: Optional[float] = None
         self._episode_block = 0
         self._published = False
@@ -170,13 +174,23 @@ class ProgressEngine:
         with self.cv:
             self._comms.add(comm)
 
-    def note_empty_poll(self) -> None:
+    def note_empty_poll(self, req=None) -> None:
         """A nonblocking completion path came up empty (Request.test /
         iprobe / improbe): the evidence a pure-polling drain loop
         exists.  Publication on the rank's behalf needs recent AND
         sustained polls — a single opportunistic poll never starts an
-        episode on its own (see _maybe_publish_stalled)."""
+        episode on its own (see _maybe_publish_stalled).
+
+        ``req`` (a schedule-state-machine collective, mpi_tpu/nbc.py)
+        identifies WHICH call is being polled: the engine then
+        publishes that call's exact pending OR-set — the sources this
+        Waitany-style poll is actually stuck on — instead of the union
+        over ALL tracked requests (ISSUE 12 verifier residual (d)).
+        State-machine internals are untracked (no _vinfo), so without
+        ``req`` a pure SM drain loop would otherwise have NO pending
+        evidence at all and escape publication entirely."""
         self._last_empty_poll = time.monotonic()
+        self._poll_req = None if req is None else weakref.ref(req)
 
     def check_error(self) -> None:
         if self.pending_error is not None:
@@ -186,6 +200,14 @@ class ProgressEngine:
         self._stop.set()
         with self.cv:
             self.cv.notify_all()
+        # the nonblocking-collective fold pool (mpi_tpu/nbc.py) is
+        # engine-owned machinery: its workers die with the engine, or a
+        # process churning many worlds would accumulate 2 parked
+        # threads per finalized world
+        pool = getattr(self.t, "_nbc_fold_pool", None)
+        if pool is not None:
+            self.t._nbc_fold_pool = None
+            pool.stop()
         # pop the thread out of its transport park promptly: closing
         # the transport does this too, but explicit stops (run_local
         # teardown) may keep the transport alive for other use
@@ -308,24 +330,53 @@ class ProgressEngine:
             # single polls)
             self._end_episode(vw)
             return
-        with self.cv:
-            pending = self._pending_tracked()
-        if not pending:
-            self._end_episode(vw)
-            return
+        # the freshest poll's own request, when it is a schedule state
+        # machine (mpi_tpu/nbc.py): publish THAT call's exact pending
+        # OR-set — its internal receives are untracked, so the union
+        # below can neither see them nor narrow to them
+        sm = None
+        ref = self._poll_req
+        if ref is not None:
+            cand = ref()
+            if (cand is not None and not cand._done
+                    and cand._error is None):
+                sm = cand
+            else:
+                self._poll_req = None
+        if sm is not None:
+            with self.cv:  # serialize the _done reads with completion
+                sm_targets = sm._pending_world_srcs()
+            if not sm_targets:
+                self._end_episode(vw)
+                return
+        else:
+            with self.cv:
+                pending = self._pending_tracked()
+            if not pending:
+                self._end_episode(vw)
+                return
         if self._episode_start is None:
             self._episode_start = now
             self._episode_block = vw.begin_block()
             return
         if now - self._episode_start < vw.stall_timeout_s:
             return
-        comm, first = pending[0]
-        targets: set = set()
-        for c, req in pending:
-            if req._source == ANY_SOURCE:
-                targets.update(w for w in c._group if w != c._t.world_rank)
-            else:
-                targets.add(c._world(req._source))
+        if sm is not None:
+            comm, tag, coll = sm._comm, sm._tag, sm.kind
+            site = f"<nbc:{sm.kind} state machine>"
+            targets = set(sm_targets)
+        else:
+            comm, first = pending[0]
+            tag, coll = first._tag, None
+            site = (first._vinfo.site if first._vinfo is not None
+                    else "<polling loop>")
+            targets = set()
+            for c, req in pending:
+                if req._source == ANY_SOURCE:
+                    targets.update(w for w in c._group
+                                   if w != c._t.world_rank)
+                else:
+                    targets.add(c._world(req._source))
         if not targets:
             return
         if vw.published and not self._published:
@@ -343,11 +394,8 @@ class ProgressEngine:
             # wait-for analysis + confirm pass, exactly like a blocking
             # wait's slice — the engine IS this rank's blocking waiter
             _vdl.check_stalled(
-                vw, comm, tuple(sorted(targets)), "OR", first._tag,
-                "waitany-poll", None,
-                first._vinfo.site if first._vinfo is not None
-                else "<polling loop>",
-                self._episode_block)
+                vw, comm, tuple(sorted(targets)), "OR", tag,
+                "waitany-poll", coll, site, self._episode_block)
         except _vdl.DeadlockError as e:
             self.pending_error = e
             with self.cv:

@@ -331,6 +331,12 @@ def _worker_main() -> int:
             _send_msg(ctrl, send_lock, reply)
         except OSError:
             return 1  # server gone: nothing left to serve
+    # orderly pool shutdown: retire the pooled lease arenas (ISSUE 12
+    # satellite, PR-11 residual (d)) — a worker set that never re-leased
+    # after its last job has nobody else to unlink its /dev/shm segment
+    from . import coll_sm as _coll_sm
+
+    _coll_sm.retire_pooled(t)
     return 0
 
 

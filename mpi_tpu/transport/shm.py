@@ -823,7 +823,10 @@ class ShmTransport(Transport):
         # flag wait surfaces a TransportError instead of touching a
         # freed mapping.
         for arena in list(getattr(self, "_coll_arenas", {}).values()):
-            arena.close()
+            # pooled lease arenas (ISSUE 11/12): every closing handle
+            # unlinks — their creator may be a dead worker whose close
+            # never ran, and a name nobody unlinks outlives the process
+            arena.close(force_unlink=getattr(arena, "_pooled", False))
         if self._db:
             self._lib.shmdb_ring(self._db)  # pop any thread out of its nap
         if self._helper.is_alive():

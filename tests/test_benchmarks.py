@@ -45,6 +45,26 @@ def test_overlap_local_smoke():
         assert np.isfinite(r["pure_us"]) and r["pure_us"] > 0
 
 
+def test_persist_local_smoke():
+    """The persistent-collective bench's row schema on the local
+    backend (ISSUE 12): fresh and re-fire columns positive, the
+    dispatch mode stamped, and the re-fires counted by the
+    ``persistent_starts`` pvar."""
+    from mpi_tpu import mpit
+
+    base = mpit.pvar_read("persistent_starts")
+    rows = run_bench("persist", "local", 2, [1024], None, iters=2, warmup=0)
+    assert rows, "no persist rows"
+    for r in rows:
+        assert r["bench"] == "persist" and r["nbc"] in ("auto", "thread")
+        assert r["progress"] in ("none", "thread")
+        assert r["fresh_us"] > 0 and r["refire_us"] > 0
+        assert r["p50_us"] == r["refire_us"]
+        assert np.isfinite(r["refire_speedup"]) and r["refire_speedup"] > 0
+    # 2 ranks x (1 warm + 2 measured) starts
+    assert mpit.pvar_read("persistent_starts") - base == 6
+
+
 @pytest.mark.parametrize("bench", ["latency", "allreduce", "allgather", "alltoall",
                                    "reduce_scatter"])
 def test_local_smoke(bench):
@@ -98,8 +118,18 @@ def test_host_sweep_quick_smoke():
         assert 0.0 <= r["overlap_pct"] <= 100.0, r
         assert 0.0 <= r["availability_pct"] <= 100.0, r
         assert r["pure_us"] > 0 and r["compute_us"] > 0
+    # ISSUE 12 satellite: the persistent-collective leg rode along on
+    # both transports — fresh vs re-fire columns populated, dispatch
+    # mode stamped (the sweep runs the shipping nbc=auto side)
+    pe = [r for r in result["persist_rows"] if "refire_us" in r]
+    assert {r["backend"] for r in pe} == {"socket", "shm"}
+    for r in pe:
+        assert r["progress"] == "thread" and r["nbc"] == "auto", r
+        assert r["fresh_us"] > 0 and r["refire_us"] > 0
+        assert np.isfinite(r["refire_speedup"])
     assert "oversubscribed" in result
-    for key in ("allreduce_rows", "small_message_rows", "overlap_rows"):
+    for key in ("allreduce_rows", "small_message_rows", "overlap_rows",
+                "persist_rows"):
         for r in result[key]:
             if "p50_us" in r:
                 assert isinstance(r["oversubscribed"], bool), r

@@ -411,6 +411,51 @@ def test_nonblocking_collectives_skip_the_arena():
     assert len(coll_sm.live_arenas()) == before  # no leaked nbc arenas
 
 
+def test_retire_pooled_sweeps_lease_arenas_at_finalize():
+    """ISSUE 12 satellite (closes PR-11 residual (d)): a POOLED lease
+    arena whose worker set never re-leases is retired by nothing — only
+    a NEW same-group lease under a bumped epoch sweeps it — so until
+    the ``retire_pooled`` finalize sweep it held its /dev/shm segment
+    mapped for the life of the worker process.  The sweep must retire
+    exactly the pooled arenas (every handle force-unlinks: the creator
+    may be a long-dead worker) and leave per-communicator arenas to the
+    normal refcounted close path."""
+    seen = {}
+
+    def prog(comm):
+        comm.allreduce(np.ones(4), algorithm="sm")  # per-comm arena
+        lease = comm.split(0, key=comm.rank)
+        lease._coll_sm_pool_ctx = ("lease-pool", 0)  # the serve stamp
+        out = lease.allreduce(np.full(2, float(comm.rank)), algorithm="sm")
+        pooled = lease._coll_sm_arena
+        assert pooled._pooled and not comm._coll_sm_arena._pooled
+        if comm.rank == 0:
+            seen["file"] = glob.glob("/dev/shm" + pooled.name)
+        comm.barrier()
+        retired = coll_sm.retire_pooled(comm._t)
+        comm.barrier()  # every handle closed before the unlink check
+        if comm.rank == 0:
+            seen["gone"] = glob.glob("/dev/shm" + pooled.name)
+            seen["live"] = dict(coll_sm.live_arenas())
+            seen["world_name"] = comm._coll_sm_arena.name
+        # idempotent: the pool registry was pruned, a second sweep
+        # (e.g. transport close re-walking _coll_arenas) finds nothing
+        return float(np.asarray(out)[0]), retired, coll_sm.retire_pooled(
+            comm._t)
+
+    res = run_shm_world(prog, 3)
+    assert [r[0] for r in res] == [3.0, 3.0, 3.0]
+    assert [r[1] for r in res] == [1, 1, 1], "sweep missed a pooled arena"
+    assert [r[2] for r in res] == [0, 0, 0]
+    # the pooled segment existed mid-world and is unlinked by the sweep
+    # while the world (and its per-communicator arena) is still alive
+    assert len(seen["file"]) == 1
+    assert seen["gone"] == []
+    assert seen["world_name"] in seen["live"]
+    # finalize then prunes the per-communicator arena as always
+    assert coll_sm.live_arenas() == {}
+
+
 def test_stale_arena_from_crashed_run_is_not_opened():
     """A crashed earlier run with the same session basename leaves its
     arena segment behind (ranks that die never close); the NEXT run's

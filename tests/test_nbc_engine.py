@@ -1,0 +1,406 @@
+"""Engine-owned nonblocking collectives (ISSUE 12 tentpole —
+mpi_tpu/nbc.py): schedule state machines advanced by the async progress
+engine instead of one ``_ThreadRequest`` thread per call, plus the MPI-4
+persistent collectives built on the same compiled-schedule object.
+
+Five contracts:
+
+* zero per-call threads — 1000 concurrent iallreduce on one
+  ``progress=thread`` world complete correctly with
+  ``nbc_threads_spawned == 0`` (pvar-asserted) while
+  ``nbc_state_machines`` counts every call;
+* parity — the whole i-collective family produces bit-identical results
+  on the state-machine path (``progress=thread``) and the thread path
+  (``progress=none``), across ops, dtypes, roots, and group sizes, with
+  the size gate (``nbc_sm_max_bytes``) and the ``nbc_mode=thread`` cvar
+  both restoring today's one-thread-per-call semantics exactly;
+* persistent collectives — ``allreduce_init`` & co. hoist compile/
+  resolve/verify out of the loop: ``start()`` re-reads the bound buffer
+  (MPI buffer-reuse idiom), geometry changes raise, re-fire works on
+  engine AND engine-less worlds, and ``mpi4.persistent_collective``
+  routes the plannable kinds here;
+* diagnostics — a polled state machine publishes its EXACT pending
+  OR-set on the deadlock board (the per-Waitany-call tightening, ISSUE
+  12 satellite), and a rank killed mid-persistent-round surfaces
+  ProcFailedError on the survivors within the detection bound;
+* lifecycle — the per-world fold pool dies with the progress engine
+  (no thread accumulation across worlds).
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from mpi_tpu import mpi4, mpit, nbc, ops
+from mpi_tpu.errors import ProcFailedError
+from mpi_tpu.transport.faulty import FaultyTransport
+from mpi_tpu.transport.local import KILLED, run_local
+
+DETECT_S = 1.0
+
+
+def _deltas(prog, nranks, names, **kw):
+    base = {n: mpit.pvar_read(n) for n in names}
+    res = run_local(prog, nranks, **kw)
+    return res, {n: mpit.pvar_read(n) - base[n] for n in names}
+
+
+# -- zero per-call thread creation -------------------------------------------
+
+
+def test_thousand_concurrent_iallreduce_zero_threads():
+    """The headline acceptance: 1000 in-flight iallreduces on one
+    engine world are 1000 state machines, not 1000 OS threads."""
+
+    def prog(comm):
+        reqs = [comm.iallreduce(np.full(4, float(i + comm.rank)))
+                for i in range(1000)]
+        for i, req in enumerate(reqs):
+            out = req.wait()
+            exp = comm.size * i + sum(range(comm.size))
+            assert out[0] == exp, (i, out[0], exp)
+        return True
+
+    res, d = _deltas(prog, 2, ("nbc_threads_spawned", "nbc_state_machines"),
+                     progress="thread", timeout=240)
+    assert res == [True, True]
+    assert d["nbc_threads_spawned"] == 0, d
+    assert d["nbc_state_machines"] == 2 * 1000, d
+
+
+def test_fold_pool_dies_with_the_engine():
+    """The fixed-cost pool is per-world machinery: after run_local tears
+    the world down no nbc fold worker survives."""
+
+    def prog(comm):
+        comm.iallreduce(np.ones(8)).wait()
+        return True
+
+    assert run_local(prog, 2, progress="thread") == [True, True]
+    deadline = time.time() + 5.0  # stop() handshake: workers drain a sentinel
+    while time.time() < deadline:
+        alive = [t.name for t in threading.enumerate()
+                 if t.name.startswith("mpi-tpu-nbc-fold")]
+        if not alive:
+            break
+        time.sleep(0.05)
+    assert not alive, alive
+
+
+# -- parity: state machines vs the thread path -------------------------------
+
+
+def _family(comm):
+    p = comm.size
+    out = {}
+    out["allreduce"] = comm.iallreduce(np.arange(8.0) + comm.rank).wait()
+    out["allreduce_max"] = float(
+        comm.iallreduce(np.float64(comm.rank), op=ops.MAX).wait())
+    out["allreduce_i32"] = comm.iallreduce(
+        np.arange(4, dtype=np.int32) + comm.rank, op=ops.PROD).wait()
+    r = comm.ireduce(np.full(3, comm.rank + 1.0), root=p - 1).wait()
+    out["reduce"] = None if r is None else r.tolist()
+    out["bcast"] = comm.ibcast({"k": 1} if comm.rank == 0 else None).wait()
+    out["barrier"] = comm.ibarrier().wait()
+    out["gather"] = comm.igather(comm.rank * 3, root=0).wait()
+    out["scatter"] = comm.iscatter(
+        [f"s{i}" for i in range(p)] if comm.rank == 1 else None,
+        root=1).wait()
+    out["allgather"] = comm.iallgather(np.full(2, float(comm.rank))).wait()
+    out["alltoall"] = comm.ialltoall(
+        [np.full(2, float(comm.rank * p + d)) for d in range(p)]).wait()
+    return out
+
+
+def _canon(res):
+    return [[(k, np.asarray(v).tolist() if v is not None else None)
+             for k, v in r.items()] for r in res]
+
+
+@pytest.mark.parametrize("p", [2, 3, 4])
+def test_family_parity_engine_vs_thread(p):
+    sm_res, d_sm = _deltas(_family, p, ("nbc_threads_spawned",),
+                           progress="thread")
+    th_res, d_th = _deltas(_family, p, ("nbc_threads_spawned",),
+                           progress="none")
+    assert _canon(sm_res) == _canon(th_res)
+    assert d_sm["nbc_threads_spawned"] == 0, d_sm
+    assert d_th["nbc_threads_spawned"] > 0  # engine-less worlds: threads
+
+
+def test_nbc_mode_thread_cvar_is_the_escape_hatch():
+    """nbc_mode=thread under a live engine keeps today's semantics —
+    every i-collective spawns its thread, no machine is compiled."""
+    old = mpit.cvar_read("nbc_mode")
+    mpit.cvar_write("nbc_mode", "thread")
+    try:
+        res, d = _deltas(_family, 3,
+                         ("nbc_threads_spawned", "nbc_state_machines"),
+                         progress="thread")
+    finally:
+        mpit.cvar_write("nbc_mode", old)
+    assert d["nbc_state_machines"] == 0, d
+    assert d["nbc_threads_spawned"] > 0
+    assert _canon(res) == _canon(run_local(_family, 3, progress="none"))
+
+
+def test_size_gate_keeps_bandwidth_payloads_on_segmented_threads():
+    """Payloads above nbc_sm_max_bytes ride the threaded SEGMENTED
+    algorithms (the bandwidth regime); 0 removes the cap.  The
+    ialltoall spelling gates on the largest BLOCK (one value-plan
+    frame) — the overlap bench's large symmetric exchange must keep
+    the caller-financed windowed blocking path."""
+    big = 1 << 18  # 2MB float64 > the 1MB default ceiling
+
+    def prog(comm):
+        blocks = [np.ones(big) for _ in range(comm.size)]  # 2MB frames
+        a2a = comm.ialltoall(blocks).wait()
+        assert float(np.asarray(a2a[0])[0]) == 1.0
+        return comm.iallreduce(np.ones(big)).wait()[0]
+
+    res, d = _deltas(prog, 2, ("nbc_threads_spawned", "nbc_state_machines"),
+                     progress="thread")
+    assert res == [2.0, 2.0]
+    assert d["nbc_state_machines"] == 0, d
+    assert d["nbc_threads_spawned"] == 4  # ialltoall + iallreduce per rank
+    old = mpit.cvar_read("nbc_sm_max_bytes")
+    mpit.cvar_write("nbc_sm_max_bytes", 0)
+    try:
+        res, d = _deltas(prog, 2,
+                         ("nbc_threads_spawned", "nbc_state_machines"),
+                         progress="thread")
+    finally:
+        mpit.cvar_write("nbc_sm_max_bytes", old)
+    assert res == [2.0, 2.0]
+    assert d["nbc_state_machines"] == 4, d
+    assert d["nbc_threads_spawned"] == 0
+
+
+# -- MPI-4 persistent collectives --------------------------------------------
+
+
+@pytest.mark.parametrize("p", [2, 3])
+def test_persistent_allreduce_parity_across_ops_dtypes(p):
+    """One handle per (op, dtype), three re-fires each, against the
+    blocking oracle — on the engine path."""
+
+    def prog(comm):
+        outs = []
+        for op in (ops.SUM, ops.MAX, ops.PROD):
+            for dt in (np.float64, np.float32, np.int64):
+                x = np.arange(1, 5, dtype=dt)
+                h = comm.allreduce_init(x, op=op)
+                for rd in range(3):
+                    x[:] = np.arange(1, 5, dtype=dt) * (rd + comm.rank + 1)
+                    got = h.start().wait()
+                    ref = comm.allreduce(x, op=op)
+                    assert got.dtype == ref.dtype, (op, dt)
+                    np.testing.assert_array_equal(got, ref)
+                    outs.append(got.sum())
+        return [float(o) for o in outs]
+
+    res, d = _deltas(prog, p, ("nbc_threads_spawned", "persistent_starts"),
+                     progress="thread", timeout=240)
+    assert all(r == res[0] for r in res)
+    assert d["nbc_threads_spawned"] == 0, d
+    assert d["persistent_starts"] == p * 9 * 3
+
+
+def test_persistent_family_refire_and_engineless_fallback():
+    """bcast/alltoall/reduce_scatter handles re-fire with refilled
+    buffers on BOTH progress modes (engine-less start() falls back to
+    one thread per round on the same hoisted context)."""
+
+    def prog(comm):
+        p = comm.size
+        rounds = []
+        payload = {"r": None}
+        hb = comm.bcast_init(payload if comm.rank == 0 else None, root=0)
+        blocks = np.zeros((p, 2))
+        hrs = comm.reduce_scatter_init(blocks)
+        objs = [None] * p
+        ha = comm.alltoall_init(objs)
+        for rd in range(3):
+            payload["r"] = rd          # bcast re-reads bound CONTENT
+            blocks[:] = rd + comm.rank
+            objs[:] = [(comm.rank, d, rd) for d in range(p)]
+            b = hb.start().wait()
+            rs = hrs.start().wait()
+            a = ha.start().wait()
+            assert b == {"r": rd}
+            np.testing.assert_array_equal(
+                rs, np.full(2, sum(rd + r for r in range(p))))
+            assert a == [(s, comm.rank, rd) for s in range(p)]
+            rounds.append(rd)
+        return rounds
+
+    for mode in ("thread", "none"):
+        assert run_local(prog, 3, progress=mode) == [[0, 1, 2]] * 3
+
+
+def test_persistent_size1_refire_reads_bound_buffer():
+    """The MPI buffer-reuse idiom holds on size-1 worlds too: start()
+    must re-read the bound buffer, not hand back the init-time
+    snapshot the compiled 'done' build captured."""
+
+    def prog(comm):
+        x = np.ones(4)
+        h = comm.allreduce_init(x)
+        a = h.start().wait()
+        x[:] = 5.0
+        b = h.start().wait()
+        return float(np.asarray(a)[0]), float(np.asarray(b)[0])
+
+    for mode in ("thread", "none"):
+        assert run_local(prog, 1, progress=mode) == [(1.0, 5.0)]
+
+
+def test_persistent_ragged_reduce_scatter_init_falls_back():
+    """Ragged per-destination blocks (supported by the blocking
+    generic reduce_scatter) must not crash persistent init's geometry
+    probe — the handle falls back to thread rounds and re-fires."""
+
+    def prog(comm):
+        blocks = [np.full(2 + d, float(comm.rank + 1))
+                  for d in range(comm.size)]
+        h = comm.reduce_scatter_init(blocks)
+        outs = []
+        for rd in range(2):
+            for d in range(comm.size):
+                blocks[d][:] = comm.rank + 1 + rd
+            outs.append(h.start().wait().tolist())
+        return outs
+
+    res = run_local(prog, 2, progress="thread")
+    assert res[0] == [[3.0, 3.0], [5.0, 5.0]]
+    assert res[1] == [[3.0] * 3, [5.0] * 3]
+
+
+def test_persistent_geometry_bound_and_start_discipline():
+    def prog(comm):
+        x = np.ones(4)
+        h = comm.allreduce_init(x)
+        with pytest.raises(RuntimeError, match="before start"):
+            h.wait()
+        h.start()
+        h.wait()
+        h2 = comm.allreduce_init(np.ones(4))
+        h2._args = (np.ones(5),)  # rebind: geometry changed since init
+        with pytest.raises(ValueError, match="geometry"):
+            h2.start()
+        # leave h2's group coherent: peers compiled for n=4
+        h2._args = (np.ones(4),)
+        h2.start().wait()
+        return True
+
+    assert run_local(prog, 2, progress="thread") == [True, True]
+
+
+def test_mpi4_persistent_collective_routes_plannable_kinds():
+    """The generic MPI_*_init surface returns the engine-owned handle
+    for allreduce/bcast/alltoall/reduce_scatter and the thread-backed
+    generic one for everything else — same start/wait discipline."""
+
+    def prog(comm):
+        h = mpi4.persistent_collective(comm, "allreduce", np.ones(4))
+        assert isinstance(h, nbc.PersistentColl), type(h)
+        v = h.start().wait()
+        hr = mpi4.persistent_collective(comm, "reduce", np.ones(2), ops.SUM)
+        assert isinstance(hr, mpi4.PersistentCollective), type(hr)
+        r = hr.start().wait()
+        hbar = mpi4.persistent_collective(comm, "barrier")
+        hbar.start().wait()
+        return float(v[0]), None if r is None else float(r[0])
+
+    res = run_local(prog, 2, progress="thread")
+    assert res == [(2.0, 2.0), (2.0, None)], res  # reduce root=0
+
+
+# -- diagnostics -------------------------------------------------------------
+
+
+@pytest.fixture
+def _fast_stall():
+    old = mpit.cvar_read("verify_stall_timeout_s")
+    mpit.cvar_write("verify_stall_timeout_s", 1.0)
+    yield
+    mpit.cvar_write("verify_stall_timeout_s", old)
+
+
+def test_sm_poll_publishes_exact_per_call_or_set(_fast_stall):
+    """ISSUE 12 satellite (verifier residual (d)): the engine publishes
+    the polled state machine's OWN pending sources — rank 0's ring
+    allreduce pends only on its left neighbor (rank 2), and the entry
+    pins exactly that, NOT the union with the unrelated tracked irecv
+    from rank 1 (which the old union-over-all-requests would include —
+    and without the req hand-off the untracked SM internals would
+    publish nothing at all)."""
+
+    def prog(comm):
+        h = comm.allreduce_init(np.ones(4), algorithm="ring")
+        if comm.rank == 0:
+            stray = comm.irecv(1, tag=9)  # tracked, never polled
+            h.start()
+            entry, deadline = None, time.time() + 8.0
+            while time.time() < deadline:
+                done, _ = h.test()
+                if done:
+                    break
+                e = comm._verify.world.board.read_all().get(comm.rank)
+                if e and e.get("kind") == "waitany-poll":
+                    entry = dict(e)
+                    break
+                time.sleep(0.002)
+            out = h.wait()
+            return entry, float(out[0]), stray.wait()
+        time.sleep(2.5)  # long enough for rank 0's episode to publish
+        if comm.rank == 1:
+            comm.send(b"stray", 0, tag=9)
+        return float(h.start().wait()[0])
+
+    res = run_local(prog, 3, verify=True, progress="thread", timeout=60)
+    entry, val, stray = res[0]
+    assert (val, stray) == (3.0, b"stray")
+    assert res[1] == res[2] == 3.0
+    assert entry is not None, "stalled SM poll never published"
+    assert entry["targets"] == [2], entry      # exact OR-set, not {1, 2}
+    assert entry["mode"] == "OR"
+    assert entry["coll"] == "iallreduce"
+    assert "state machine" in entry["site"]
+
+
+def test_ft_kill_mid_persistent_diagnosed_in_bound():
+    """Rank 1 dies mid-round of a persistent allreduce: the survivors'
+    wait() converts the detector hit into ProcFailedError naming the
+    corpse within the usual multiple of the detection bound."""
+    old = {k: mpit.cvar_read(k) for k in ("fault_detect_timeout_s",
+                                          "fault_heartbeat_interval_s")}
+    mpit.cvar_write("fault_detect_timeout_s", DETECT_S)
+    mpit.cvar_write("fault_heartbeat_interval_s", 0.05)
+    try:
+        def kill_rank1(inner):
+            return (FaultyTransport(inner, kill_after_n=2)
+                    if inner.world_rank == 1 else inner)
+
+        def prog(comm):
+            h = comm.allreduce_init(np.ones(1 << 10), algorithm="ring")
+            h.start()  # rank 1 dies inside this round's sends
+            if comm.rank == 1:
+                return h.wait()  # re-raises its own KilledRankError
+            t0 = time.monotonic()
+            with pytest.raises(ProcFailedError) as ei:
+                h.wait()
+            assert time.monotonic() - t0 < 6 * DETECT_S
+            assert 1 in ei.value.failed
+            return "diagnosed"
+
+        out = run_local(prog, 3, transport_wrapper=kill_rank1,
+                        fault_tolerance=True, progress="thread",
+                        timeout=60)
+        assert out[0] == out[2] == "diagnosed"
+        assert out[1] is KILLED
+    finally:
+        for k, v in old.items():
+            mpit.cvar_write(k, v)

@@ -135,6 +135,40 @@ def test_park_releases_progress_lock_and_recv_completes_inline():
     assert out[1] == 1023.0
 
 
+def test_user_recv_latency_unchanged_while_engine_parked():
+    """ISSUE 12 satellite — the stronger spelling of PR-6 residual (c):
+    with a REAL engine attached and parked on the doorbell, a blocking
+    user recv whose message arrives mid-park completes at inline-drain
+    latency.  If the park ever re-held the progress lock across its
+    nap, each recv would queue up to a full park slice (0.25s) behind
+    the engine — the median below would jump past the bound."""
+    def prog(comm):
+        progress.enable(comm)
+        comm.barrier(algorithm="dissemination")
+        if comm.rank == 0:
+            for i in range(8):
+                time.sleep(0.05)  # peer is blocked in recv, engine parked
+                comm.send(np.arange(256.0), 1, tag=20 + i)
+            comm.barrier(algorithm="dissemination")
+            return None
+        lats = []
+        for i in range(8):
+            t0 = time.monotonic()
+            comm.recv(0, tag=20 + i)
+            lats.append(time.monotonic() - t0)
+        comm.barrier(algorithm="dissemination")
+        return sorted(lats)[len(lats) // 2]
+
+    idle0 = mpit.pvar_read("progress_idle_parks")
+    out = run_shm_world(prog, 2)
+    assert mpit.pvar_read("progress_idle_parks") > idle0, \
+        "engine never actually parked during the run"
+    # send cadence is 50ms, so the inline-drain median sits just above
+    # it; a lock-across-the-nap regression adds ~a 250ms park slice
+    assert out[1] < 0.15, \
+        f"median blocking-recv latency {out[1]:.3f}s against a parked engine"
+
+
 def test_collective_parity_and_wire_contract_under_thread():
     """The whole family stays exact under the engine, and the ring
     allreduce's zero-pickled-bytes contract survives — engine
