@@ -577,6 +577,13 @@ if __name__ == "__main__":
             args.append("--links")
         if "--no-healing" in sys.argv[1:]:
             args.append("--no-healing")
+        if "--trace-dir" in sys.argv[1:]:
+            # ISSUE 13 satellite: run the links leg under the flight
+            # recorder and merge the per-rank Chrome traces
+            idx = sys.argv.index("--trace-dir")
+            if idx + 1 >= len(sys.argv):
+                sys.exit("bench.py: --trace-dir needs a directory")
+            args += ["--trace-dir", sys.argv[idx + 1]]
         sys.exit(chaos.main(args))
     if "--hotpath" in sys.argv[1:]:
         # zero-copy hot-path leg (ISSUE 11): 16MB socket allreduce
@@ -627,11 +634,16 @@ if __name__ == "__main__":
         # contract (pvar-identical hot path) and prices the on-mode.
         # --progress (ISSUE 6) adds the async-progress-engine leg:
         # same pvar contracts with the engine's thread running.
+        # --trace (ISSUE 13) adds the flight-recorder leg: trace-off
+        # asserts 0 trace events + unchanged wire accounting, trace-on
+        # prices the ring buffer.
         from benchmarks import verify_overhead
 
         args = ["--quick"] if "--quick" in sys.argv[1:] else []
         if "--progress" in sys.argv[1:]:
             args.append("--progress")
+        if "--trace" in sys.argv[1:]:
+            args.append("--trace")
         sys.exit(verify_overhead.main(args))
     if "--tune" in sys.argv[1:]:
         # tuned-dispatch table generator (ISSUE 9): sweeps (transport x

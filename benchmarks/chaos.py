@@ -476,7 +476,8 @@ def _run_links_world(script_path: str, env_extra: Dict,
         membership.cleanup_rendezvous(rdv)
 
 
-def run_links_chaos(quick: bool = False, healing: bool = True) -> Dict:
+def run_links_chaos(quick: bool = False, healing: bool = True,
+                    trace_dir: str = None) -> Dict:
     """The link-fault chaos leg (ISSUE 10 acceptance): a 3-rank socket
     world under FT runs a mixed-collective stream with per-rank
     oracle checks while connection resets (between frames AND
@@ -493,6 +494,13 @@ def run_links_chaos(quick: bool = False, healing: bool = True) -> Dict:
     * with ``healing=False`` (``link_retry_timeout_s = 0``, the honest
       "pre" leg) the same resets are terminal — committed as
       chaos_links_pre.json so the healed run has a measured baseline.
+
+    ``trace_dir`` (ISSUE 13 satellite) additionally runs the INJECTED
+    leg under the flight recorder (``MPI_TPU_TRACE=1``): each rank
+    exports a Chrome trace into ``trace_dir``, tools/tracecat.py merges
+    them into ``<trace_dir>/chaos_links_trace.json``, and the result
+    records how many reset→reconnect→replay events the merged timeline
+    carries — the "name the war story in minutes" artifact.
     """
     import tempfile
 
@@ -509,15 +517,35 @@ def run_links_chaos(quick: bool = False, healing: bool = True) -> Dict:
         inject_env = dict(base_env,
                           MPI_TPU_LINKS_RESET_EVERY=str(reset_every),
                           MPI_TPU_LINKS_MIDFRAME_EVERY=str(mid_every))
+        if trace_dir:
+            # the injected leg ONLY: the baseline/kill worlds reuse the
+            # dir across legs and would mix their rank files in
+            os.makedirs(trace_dir, exist_ok=True)
+            # exports are pid-suffixed, so a PREVIOUS run's rank files
+            # survive here and would alias this run's (src, dst, seq)
+            # triples in the merge — same garbled-offsets failure as
+            # tracing the kill leg
+            import glob as _glob
+
+            for stale in _glob.glob(os.path.join(trace_dir,
+                                                 "trace.r*.json")):
+                os.unlink(stale)
+            inject_env = dict(inject_env, MPI_TPU_TRACE="1",
+                              MPI_TPU_TRACE_DIR=os.path.abspath(
+                                  trace_dir))
         baseline = _run_links_world(script, base_env)
         injected = _run_links_world(script, inject_env)
         # the kill-contrast leg keeps the injection ONLY while healing
         # is on (healing must not mask real death UNDER fire); with
         # healing off the first reset is itself terminal and would
         # shadow the kill — the classification check runs clean there
-        kill = _run_links_world(
-            script, dict(inject_env if healing else base_env,
-                         MPI_TPU_LINKS_KILL_RANK="1"))
+        kill_env = dict(inject_env if healing else base_env,
+                        MPI_TPU_LINKS_KILL_RANK="1")
+        # never traced: its survivors would export into trace_dir and
+        # the merge would alias two runs' (src, dst, seq) triples
+        kill_env.pop("MPI_TPU_TRACE", None)
+        kill_env.pop("MPI_TPU_TRACE_DIR", None)
+        kill = _run_links_world(script, kill_env)
 
     resets = sum(r.get("resets_injected", 0) for r in injected)
     reconnects = sum(r.get("link_reconnects", 0) for r in injected)
@@ -573,7 +601,40 @@ def run_links_chaos(quick: bool = False, healing: bool = True) -> Dict:
         result["ok"] = (kill_ok and resets >= 1
                         and not all(r.get("outcome") == "ok"
                                     for r in injected))
+    if trace_dir:
+        result["trace"] = _merge_links_trace(trace_dir)
     return result
+
+
+def _merge_links_trace(trace_dir: str) -> Dict:
+    """Merge the injected leg's per-rank traces (tools/tracecat.py) and
+    summarize the fault-story events the merged timeline carries."""
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    try:
+        import tracecat
+    finally:
+        sys.path.pop(0)
+    out = os.path.join(trace_dir, "chaos_links_trace.json")
+    doc = tracecat.merge_paths([trace_dir], out)
+    counts: Dict[str, int] = {}
+    for e in doc["traceEvents"]:
+        if e.get("cat") in ("link", "coll", "frame", "ft"):
+            key = f"{e['cat']}.{e['name']}"
+            counts[key] = counts.get(key, 0) + 1
+    return {
+        "merged": out,
+        "ranks": len(doc["mpi_tpu"]["ranks"]),
+        "events": len(doc["traceEvents"]),
+        "offsets_us": doc["mpi_tpu"]["offsets_us"],
+        "negative_latency_frames":
+            doc["mpi_tpu"]["negative_latency_frames"],
+        "link_events": {k: v for k, v in sorted(counts.items())
+                        if k.startswith("link.")},
+        "coll_events": sum(v for k, v in counts.items()
+                           if k.startswith("coll.")),
+        "frame_events": sum(v for k, v in counts.items()
+                            if k.startswith("frame.")),
+    }
 
 
 def main(argv=None) -> int:
@@ -595,12 +656,18 @@ def main(argv=None) -> int:
                     help="(with --links) disable link healing "
                          "(link_retry_timeout_s=0): the honest 'pre' "
                          "leg where the same resets are terminal")
+    ap.add_argument("--trace-dir", default=None, metavar="DIR",
+                    help="(with --links) run the injected leg under "
+                         "the flight recorder and merge the per-rank "
+                         "Chrome traces into DIR/chaos_links_trace."
+                         "json (tools/tracecat.py)")
     ap.add_argument("--backend", choices=("socket", "shm"),
                     default="socket")
     args = ap.parse_args(argv)
     if args.links:
         result = run_links_chaos(quick=args.quick,
-                                 healing=not args.no_healing)
+                                 healing=not args.no_healing,
+                                 trace_dir=args.trace_dir)
     elif args.serve:
         result = run_serve_chaos(quick=args.quick, backend=args.backend)
     else:

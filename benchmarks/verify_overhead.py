@@ -52,11 +52,12 @@ def _allreduce_loop(comm, nbytes: int, iters: int):
 
 
 def _leg(nranks: int, nbytes: int, iters: int, samples: int,
-         verify: bool, progress: str = "none") -> Dict:
+         verify: bool, progress: str = "none",
+         trace: bool = False) -> Dict:
     p50s = []
     for _ in range(samples):
         per_rank = run_local(_allreduce_loop, nranks, args=(nbytes, iters),
-                             verify=verify, progress=progress)
+                             verify=verify, progress=progress, trace=trace)
         p50s.append(statistics.median(per_rank))
     return {"p50_us": round(min(p50s), 1),
             "samples_us": [round(s, 1) for s in p50s]}
@@ -73,6 +74,12 @@ def main(argv=None) -> int:
                          "off-mode pvar contracts hold with the engine "
                          "running: 0 pickled array bytes, payload-copy "
                          "count unchanged")
+    ap.add_argument("--trace", action="store_true",
+                    help="also run the allreduce loop under the flight "
+                         "recorder (mpi_tpu/telemetry, ISSUE 13) and "
+                         "price it; the trace-OFF leg's contract — 0 "
+                         "trace events, unchanged payload_copies/"
+                         "bytes_pickled_sent — is asserted either way")
     args = ap.parse_args(argv)
     iters = 20 if args.quick else 200
     samples = 1 if args.quick else 5
@@ -90,6 +97,30 @@ def main(argv=None) -> int:
                      if p.startswith("verify_"))
     off_prog = sum(ses.read(p) for p in mpit.pvar_list()
                    if p.startswith("progress_"))
+    off_trace = ses.read("trace_events")
+    trace_leg = None
+    if args.trace:
+        # ISSUE 13: the flight recorder must not perturb the wire
+        # accounting — same zero-pickled-bytes and payload-copy
+        # contracts with the ring buffer recording; its own cost is
+        # the recorded p50 delta, priced not promised
+        from mpi_tpu import telemetry
+
+        ses.reset_all()
+        trace_leg = _leg(args.nranks, nbytes, iters, samples,
+                         verify=False, trace=True)
+        trace_leg["trace_events"] = ses.read("trace_events")
+        trace_leg["bytes_pickled_sent"] = ses.read("bytes_pickled_sent")
+        trace_leg["payload_copies"] = ses.read("payload_copies")
+        telemetry.disable()
+        assert trace_leg["trace_events"] > 0, \
+            "tracing on recorded zero events"
+        assert trace_leg["bytes_pickled_sent"] == 0, \
+            (f"traced ring allreduce pickled "
+             f"{trace_leg['bytes_pickled_sent']} bytes")
+        assert trace_leg["payload_copies"] == off_copies, \
+            (f"tracing changed the payload-copy count: "
+             f"{trace_leg['payload_copies']} != {off_copies}")
     progress_leg = None
     if args.progress:
         # ISSUE 6 satellite: the dedicated progress engine must not
@@ -128,6 +159,7 @@ def main(argv=None) -> int:
         "off_payload_copies": off_copies,
         "off_verify_events": off_events,
         "off_progress_events": off_prog,
+        "off_trace_events": off_trace,
         # the signature ring is pickled control traffic — nonzero ON is
         # expected and recorded, never part of the off-mode contract
         "on_bytes_pickled_sent": on_pickled,
@@ -135,9 +167,15 @@ def main(argv=None) -> int:
     }
     if progress_leg is not None:
         result["progress_thread"] = progress_leg
+    if trace_leg is not None:
+        result["trace_on"] = trace_leg
+        result["trace_overhead_x"] = round(
+            trace_leg["p50_us"] / max(off["p50_us"], 1e-9), 3)
     assert off_events == 0, f"verifier ran with verify=False: {off_events}"
     assert off_prog == 0, \
         f"progress engine ran with progress=none: {off_prog} events"
+    assert off_trace == 0, \
+        f"flight recorder ran with tracing off: {off_trace} events"
     assert off_pickled == 0, \
         f"off-mode ring allreduce pickled {off_pickled} bytes"
     print(json.dumps(result, indent=2))

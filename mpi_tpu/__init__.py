@@ -33,7 +33,7 @@ from .ops import SUM, PROD, MAX, MIN, LAND, LOR, LXOR, BAND, BOR, BXOR, ReduceOp
 from .communicator import Communicator, Message, P2PCommunicator, Request, Status
 from .transport.base import ANY_SOURCE, ANY_TAG
 from .transport.local import run_local
-from . import datatypes, errors, ft, io, membership, mpi4, progress, schedules, checker, checkpoint, profiling, trace, verify
+from . import datatypes, errors, ft, io, membership, mpi4, progress, schedules, checker, checkpoint, profiling, telemetry, trace, verify
 from .intercomm import InterComm, create_intercomm
 from .topology import (CartComm, GraphComm, HierarchicalComm, cart_create,
                        dims_create, dist_graph_create_adjacent,
@@ -64,7 +64,7 @@ __all__ = [
     "SUM", "PROD", "MAX", "MIN", "LAND", "LOR", "LXOR", "BAND", "BOR", "BXOR",
     "Communicator", "Message", "P2PCommunicator", "Request", "Status", "ANY_SOURCE", "ANY_TAG",
     "init", "finalize", "is_initialized", "run", "run_local",
-    "schedules", "checker", "checkpoint", "ft", "membership", "profiling", "progress", "trace", "verify", "COMM_WORLD", "io", "mpi4",
+    "schedules", "checker", "checkpoint", "ft", "membership", "profiling", "progress", "telemetry", "trace", "verify", "COMM_WORLD", "io", "mpi4",
     "connect", "rejoin", "serve",
     "CartComm", "GraphComm", "HierarchicalComm", "InterComm",
     "create_intercomm", "cart_create", "graph_create", "split_hierarchical",
@@ -113,6 +113,11 @@ def init(backend: Optional[str] = None) -> Communicator:
                 from .transport.shm import ShmTransport as _T
 
             t = _T(rank, size, rdv)
+            # flight recorder (mpi_tpu/telemetry, ISSUE 13):
+            # MPI_TPU_TRACE=1 / launcher --trace-dir — enabled before
+            # the first collective so world-construction traffic is on
+            # the timeline too
+            telemetry.enable_from_env(rank=rank)
             # record which incarnation holds this world slot: the
             # elastic-membership layer's identity file (membership.py)
             # — accept_rejoin reads it to refuse an ousted-but-live
@@ -144,6 +149,7 @@ def init(backend: Optional[str] = None) -> Communicator:
         elif backend in ("self", "local"):
             from .transport.local import LocalTransport, LocalWorld
 
+            telemetry.enable_from_env(rank=0)
             t = LocalTransport(LocalWorld(1), 0)
             _world = P2PCommunicator(t, range(1))
         else:
@@ -166,6 +172,12 @@ def finalize() -> None:
         verified = _world._verify is not None
         if verified:
             _world._verify.world.mark_exited()
+        rec = telemetry.REC
+        if rec is not None and rec.trace_dir:
+            # export at the orderly exit too (atexit covers sys.exit
+            # paths; same filename, atomic replace — double export is
+            # idempotent)
+            rec.export_to_dir()
         pending = _world.close_transport()
         _world = None
     from . import mpi4 as _mpi4

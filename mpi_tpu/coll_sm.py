@@ -67,6 +67,7 @@ import numpy as np
 
 from . import mpit as _mpit
 from . import schedules
+from . import telemetry as _telemetry
 from . import tuning as _tuning
 from .transport import codec as _codec
 from .transport.base import ANY_SOURCE, RecvTimeout, TransportError
@@ -487,9 +488,21 @@ def _sm_coll(fn):
             return FALLBACK
         arena._begin()
         try:
-            return fn(arena, comm, *args)
+            out = fn(arena, comm, *args)
         finally:
             arena._end()
+        rec = _telemetry.REC
+        if rec is not None:
+            # flight recorder (ISSUE 13): one event per arena attempt —
+            # hit (served by load/store) or fallback (declined to the
+            # wire algorithms inside the meta negotiation); a hit is
+            # also the collective span's final concrete algorithm
+            if out is not FALLBACK:
+                rec.note_algorithm("sm")
+            rec.emit("arena",
+                     "hit" if out is not FALLBACK else "fallback",
+                     attrs={"coll": fn.__name__})
+        return out
     return run
 
 

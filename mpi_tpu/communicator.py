@@ -23,6 +23,7 @@ API conventions (MPI-1.x semantics [S], pythonic spelling):
 
 from __future__ import annotations
 
+import functools
 import pickle
 import threading
 import time
@@ -37,6 +38,7 @@ from . import compress as _compress
 from . import mpit as _mpit
 from . import ops as _ops
 from . import schedules
+from . import telemetry as _telemetry
 from . import tuning as _tuning
 from .errors import ProcFailedError, RevokedError
 from .transport import codec as _codec
@@ -229,12 +231,81 @@ def _resolve_algorithm(coll: str, algorithm: str, real: Tuple[str, ...],
     'fused' but silently ran pairwise with no documentation, and the
     error messages never said what WAS accepted."""
     if algorithm in aliases:
-        return aliases[algorithm]
-    if algorithm in real:
-        return algorithm
-    accepted = sorted(set(real) | set(aliases))
-    raise ValueError(
-        f"unknown {coll} algorithm {algorithm!r}; accepted: {accepted}")
+        resolved = aliases[algorithm]
+    elif algorithm in real:
+        resolved = algorithm
+    else:
+        accepted = sorted(set(real) | set(aliases))
+        raise ValueError(
+            f"unknown {coll} algorithm {algorithm!r}; accepted: {accepted}")
+    rec = _telemetry.REC
+    if rec is not None:
+        # flight recorder (ISSUE 13): the ONE gate every host collective
+        # passes — stamp the RESOLVED algorithm into the open trace span
+        # (the requested spelling may have been 'auto'/'fused')
+        rec.note_algorithm(resolved)
+    return resolved
+
+
+def _trace_nbytes(obj: Any) -> Optional[int]:
+    """Cheap payload-size guess for a collective trace span (tracing-on
+    path only): arrays report nbytes, list payloads (alltoall/scatter)
+    sum their sized elements, opaque objects report None."""
+    n = getattr(obj, "nbytes", None)
+    if n is not None:
+        return int(n)
+    if isinstance(obj, (bytes, bytearray)):
+        return len(obj)
+    if isinstance(obj, (list, tuple)):
+        total = 0
+        for item in obj:
+            total += getattr(item, "nbytes", 0) or 0
+        return total or None
+    return None
+
+
+def _note_alg(algorithm: str) -> str:
+    """Stamp the FINAL concrete algorithm into the open trace span.
+    The ``_resolve_algorithm`` gate passes ``'auto'`` through (tuning/
+    arena/seed policy pick later), so each wire dispatch point calls
+    this once the pick is real; an arena hit notes ``'sm'`` centrally
+    in ``coll_sm._sm_coll``.  Returns its argument so assignment sites
+    can wrap in place."""
+    rec = _telemetry.REC
+    if rec is not None:
+        rec.note_algorithm(algorithm)
+    return algorithm
+
+
+def _traced_coll(fn):
+    """Collective begin/end tracing (mpi_tpu/telemetry, ISSUE 13).  Off
+    mode is ONE module-attribute None test before the undecorated call
+    — the same shape as the ft/verify/progress gates, pvar-asserted by
+    ``bench.py --verify-overhead --trace``.  On: a span carrying the
+    collective name, requested->resolved algorithm (rewritten at the
+    ``_resolve_algorithm`` gate and again at the concrete dispatch
+    pick), payload bytes, duration, and the error class on a raising
+    exit; completed spans also feed the ``coll_latency_s`` histogram
+    pvar and profiling.CommStats."""
+    name = fn.__name__
+
+    @functools.wraps(fn)
+    def wrapper(self, *args, **kwargs):
+        rec = _telemetry.REC
+        if rec is None:
+            return fn(self, *args, **kwargs)
+        cell = rec.coll_begin(
+            name, kwargs.get("algorithm"),
+            _trace_nbytes(args[0]) if args else None)
+        try:
+            out = fn(self, *args, **kwargs)
+        except BaseException as e:
+            rec.coll_end(cell, error=type(e).__name__)
+            raise
+        rec.coll_end(cell)
+        return out
+
+    return wrapper
 
 
 def _unpost(reqs: Sequence["_RecvRequest"]) -> None:
@@ -1207,14 +1278,32 @@ class P2PCommunicator(Communicator):
         _check_user_tag(tag)
         return self._recv_internal(source, tag, status)
 
+    def _plain_recv(self, src_world: int, tag: int):
+        """The no-ft/no-verify blocking receive, with the same blocked-
+        wait trace span `_sliced_wait` emits — "where was this rank
+        stuck" must not require enabling a checker.  Off mode is the
+        one attribute test."""
+        rec = _telemetry.REC
+        if rec is None:
+            return self._t.recv(src_world, self._ctx, tag,
+                                timeout=self.recv_timeout)
+        t_trace = time.perf_counter_ns()
+        out = self._t.recv(src_world, self._ctx, tag,
+                           timeout=self.recv_timeout)
+        dur = time.perf_counter_ns() - t_trace
+        if dur >= _telemetry.WAIT_MIN_NS:
+            rec.emit("wait", "recv", dur_ns=dur,
+                     attrs={"src": src_world, "tag": tag,
+                            "coll": self._coll_name if tag < 0 else None})
+        return out
+
     def _recv_internal(self, source: int, tag: int,
                        status: Optional[Status] = None) -> Any:
         src_world = ANY_SOURCE if source == ANY_SOURCE else self._world(source)
         if self._ft is not None or self._verify is not None:
             obj, src, t = self._sliced_wait(src_world, tag)
         else:
-            obj, src, t = self._t.recv(src_world, self._ctx, tag,
-                                       timeout=self.recv_timeout)
+            obj, src, t = self._plain_recv(src_world, tag)
         _mpit.count(recvs=1)
         if status is not None:
             status._fill(self._from_world(src), t, obj)
@@ -1240,6 +1329,8 @@ class P2PCommunicator(Communicator):
         slice-poll plumbing rather than stacking a second poller."""
         ft = self._ft
         vw = self._verify.world if self._verify is not None else None
+        rec = _telemetry.REC
+        t_trace = time.perf_counter_ns() if rec is not None else 0
         timeout = self.recv_timeout
         start = time.monotonic()
         deadline = None if timeout is None else start + timeout
@@ -1303,6 +1394,18 @@ class P2PCommunicator(Communicator):
         finally:
             if vw is not None:
                 vw.wait_exit()
+            if rec is not None:
+                # flight recorder: blocked waits past the noise floor
+                # (WAIT_MIN_NS) become spans — the per-rank timeline's
+                # "where was this rank stuck" row
+                dur = time.perf_counter_ns() - t_trace
+                if dur >= _telemetry.WAIT_MIN_NS:
+                    rec.emit(
+                        "wait", "recv" if consume else "probe",
+                        dur_ns=dur,
+                        attrs={"src": src_world, "tag": tag,
+                               "coll": self._coll_name
+                               if tag < 0 else None})
 
     def _verify_stalled(self, vw, src_world: int, tag: int, block_id: int,
                         consume: bool) -> None:
@@ -1574,8 +1677,7 @@ class P2PCommunicator(Communicator):
         if self._ft is not None or self._verify is not None:
             obj, src, t = self._sliced_wait(src_world, tag)
         else:
-            obj, src, t = self._t.recv(src_world, self._ctx, tag,
-                                       timeout=self.recv_timeout)
+            obj, src, t = self._plain_recv(src_world, tag)
         msg = Message(obj, self._from_world(src), t, comm=self)
         if status is not None:
             status._fill(msg.source, msg.tag, obj)
@@ -1697,6 +1799,7 @@ class P2PCommunicator(Communicator):
                                          user_site())
         return req
 
+    @_traced_coll
     def bcast(self, obj: Any, root: int = 0, algorithm: str = "auto") -> Any:
         """MPI_Bcast.  ``algorithm``: ``"tree"`` (binomial tree, log2(P)
         rounds — BASELINE.json:8); ``"sm"`` (shm transports only: the
@@ -1725,6 +1828,7 @@ class P2PCommunicator(Communicator):
             got = _coll_sm.bcast(self, obj, root)
             if got is not _coll_sm.FALLBACK:
                 return got
+        _note_alg("tree")
         parent, children = schedules.binomial_tree_links(
             self.size, self._rank, root)
         if self._rank == root:
@@ -1775,6 +1879,7 @@ class P2PCommunicator(Communicator):
             self._send_internal(got, c, _TAG_COLL)
         return got
 
+    @_traced_coll
     def reduce(self, obj: Any, op: _ops.ReduceOp = _ops.SUM, root: int = 0,
                algorithm: str = "auto") -> Any:
         """MPI_Reduce.  ``algorithm``: ``"tree"`` (binomial tree with
@@ -1797,6 +1902,7 @@ class P2PCommunicator(Communicator):
                 (out,) = got
                 return (_unwrap(np.asarray(out), scalar)
                         if self._rank == root else None)
+        _note_alg("tree")
         acc = arr.copy()
         for pairs in schedules.binomial_reduce_rounds(self.size, root):
             for s, d in pairs:
@@ -1808,6 +1914,7 @@ class P2PCommunicator(Communicator):
                     op.combine_into(acc, self._recv_internal(s, _TAG_COLL))
         return _unwrap(acc, scalar) if self._rank == root else None
 
+    @_traced_coll
     def allreduce(self, obj: Any, op: _ops.ReduceOp = _ops.SUM,
                   algorithm: str = "auto",
                   compress_key: Any = None) -> Any:
@@ -1858,6 +1965,9 @@ class P2PCommunicator(Communicator):
             # cvar-dependent "compressed" alias (ISSUE 8 satellite)
             wire, algorithm, vcounts = _compress.resolve(
                 self, "allreduce", arr, op, algorithm)
+            # the trace span follows the signature rule: resolved wire
+            # spelling, never the "compressed" alias
+            _note_alg(algorithm)
         self._verify_coll("allreduce", op=op, payload=arr,
                           algorithm=algorithm, counts=vcounts)
         if wire is not None:
@@ -1904,6 +2014,7 @@ class P2PCommunicator(Communicator):
             algorithm = "auto"
         if algorithm == "auto":
             algorithm = seed_allreduce_algorithm(arr.nbytes, self.size)
+        _note_alg(algorithm)
         if self.size == 1:
             return _unwrap(arr.copy(), scalar)
         if algorithm == "ring":
@@ -2149,6 +2260,7 @@ class P2PCommunicator(Communicator):
                                (offs[ri], offs[ri + 1]), right, left)
         return work.reshape(shape)
 
+    @_traced_coll
     def allgather(self, obj: Any, algorithm: str = "auto") -> List[Any]:
         """MPI_Allgather.  ``algorithm``: ``"ring"`` (rotating row views
         of one [P, ...] buffer, raw frames), ``"doubling"`` (recursive
@@ -2184,7 +2296,8 @@ class P2PCommunicator(Communicator):
             # (log P rounds) on pow2 groups; bandwidth-bound array
             # workloads should request "ring" explicitly for the
             # raw-frame row buffer.
-            algorithm = "doubling" if schedules.is_pow2(p) else "ring"
+            algorithm = _note_alg("doubling" if schedules.is_pow2(p)
+                                  else "ring")
         items: List[Any] = [None] * p
         items[r] = obj
         if p == 1:
@@ -2270,6 +2383,7 @@ class P2PCommunicator(Communicator):
             raise ValueError(f"unknown allgather algorithm {algorithm!r}")
         return _maybe_stack(obj, items)
 
+    @_traced_coll
     def alltoall(self, objs: Sequence[Any], algorithm: str = "auto") -> List[Any]:
         """MPI_Alltoall.  ``algorithm``: ``"pairwise"`` (windowed
         nonblocking pairwise exchange, P-1 rounds — BASELINE.json:9);
@@ -2338,6 +2452,7 @@ class P2PCommunicator(Communicator):
             if got is not _coll_sm.FALLBACK:
                 (items,) = got
                 return _maybe_stack(objs, items)
+        _note_alg("pairwise")
         result: List[Any] = [None] * p
         result[r] = objs[r]
         rounds = schedules.alltoall_rounds(p)
@@ -2358,6 +2473,7 @@ class P2PCommunicator(Communicator):
             raise
         return _maybe_stack(objs, result)
 
+    @_traced_coll
     def barrier(self, algorithm: str = "auto") -> None:
         """MPI_Barrier.  ``algorithm``: ``"dissemination"`` (ceil(log2 P)
         message rounds [S]), ``"sm"`` (shm transports: one flag round in
@@ -2374,10 +2490,12 @@ class P2PCommunicator(Communicator):
         if algorithm in ("auto", "sm") and p > 1:
             if _coll_sm.barrier(self) is not _coll_sm.FALLBACK:
                 return
+        _note_alg("dissemination")
         for off in schedules.dissemination_offsets(p):
             self._send_internal(None, (r + off) % p, _TAG_BARRIER)
             self._recv_internal((r - off) % p, _TAG_BARRIER)
 
+    @_traced_coll
     def scan(self, obj: Any, op: _ops.ReduceOp = _ops.SUM,
              algorithm: str = "auto") -> Any:
         """MPI_Scan [S].  ``algorithm``: ``"doubling"`` (Hillis-Steele
@@ -2404,6 +2522,7 @@ class P2PCommunicator(Communicator):
             if got is not _coll_sm.FALLBACK:
                 (out,) = got
                 return _unwrap(out, scalar)
+        _note_alg("doubling")
         acc = arr.copy()
         p, r = self.size, self._rank
         d = 1
@@ -2455,6 +2574,7 @@ class P2PCommunicator(Communicator):
             return None
         return arr
 
+    @_traced_coll
     def reduce_scatter(self, blocks: Any, op: _ops.ReduceOp = _ops.SUM,
                        algorithm: str = "auto") -> Any:
         """MPI_Reduce_scatter_block [S]: ``blocks`` holds one block per
@@ -2546,6 +2666,10 @@ class P2PCommunicator(Communicator):
             # the wire-path analogue of the arena meta round
             _compress._decline()
             wire = None
+        # span algorithm = what actually runs: the resolved compressed
+        # spelling on the encoded ring, plain "ring" otherwise
+        # (including a compressed request the decline above downgraded)
+        _note_alg(algorithm if wire is not None else "ring")
         if arr is not None:
             was_scalar = arr.ndim == 1
             shape = arr.shape[1:]
@@ -2609,6 +2733,7 @@ class P2PCommunicator(Communicator):
                 chunks[ri] = np.asarray(op.combine(mine, recvd))
         return _unwrap(chunks[r], was_scalar)
 
+    @_traced_coll
     def scatter(self, objs: Optional[Sequence[Any]], root: int = 0) -> Any:
         """MPI_Scatter: rank d receives ``objs[d]`` from ``root``.  The
         root's fan-out is nonblocking — every payload is enqueued on the
@@ -2628,6 +2753,7 @@ class P2PCommunicator(Communicator):
             return objs[root]
         return self._recv_internal(root, _TAG_COLL)
 
+    @_traced_coll
     def gather(self, obj: Any, root: int = 0) -> Optional[List[Any]]:
         """MPI_Gather: root returns ``[payload_0, ..., payload_{P-1}]``.
         The root posts every receive up front (nonblocking fan-in): each

@@ -56,6 +56,7 @@ import time
 from typing import Dict, List, Optional, Tuple
 
 from . import mpit as _mpit
+from . import telemetry as _telemetry
 from .errors import ProcFailedError, RevokedError
 from .transport.base import ANY_SOURCE, RecvTimeout, TransportError
 
@@ -199,6 +200,12 @@ class WorldFT:
                 return
             self.failed.add(world_rank)
         _mpit.count(proc_failed=1)
+        rec = _telemetry.REC
+        if rec is not None:
+            # the rejoin-hello-race / lease-stall class of war story is
+            # exactly "WHEN did this rank first suspect whom, and why"
+            rec.emit("ft", "suspect",
+                     attrs={"rank": world_rank, "why": why[:120]})
 
     def link_suspect(self, peer: int) -> bool:
         """PEER-fault verdict for the socket link layer's fault

@@ -232,6 +232,13 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                              "frames (mpi_tpu/resilience.py).  Keep it "
                              "below fault_detect_timeout_s; 0 disables "
                              "healing (every link fault terminal)")
+    parser.add_argument("--trace-dir", default=None, metavar="DIR",
+                        help="enable the flight recorder on every rank "
+                             "(MPI_TPU_TRACE=1, mpi_tpu/telemetry) and "
+                             "export one Chrome-trace/Perfetto JSON per "
+                             "rank into DIR at exit; merge them onto "
+                             "one aligned timeline with "
+                             "tools/tracecat.py DIR -o merged.json")
     parser.add_argument("--tuning-table", default=None, metavar="PATH",
                         help="per-machine tuned-dispatch table for every "
                              "rank (MPI_TPU_TUNING_TABLE): measured "
@@ -251,6 +258,9 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         env_extra["MPI_TPU_PROGRESS"] = args.progress
     if args.link_retry_timeout is not None:
         env_extra["MPI_TPU_LINK_RETRY_S"] = str(args.link_retry_timeout)
+    if args.trace_dir is not None:
+        env_extra["MPI_TPU_TRACE"] = "1"
+        env_extra["MPI_TPU_TRACE_DIR"] = os.path.abspath(args.trace_dir)
     if args.tuning_table is not None:
         env_extra["MPI_TPU_TUNING_TABLE"] = os.path.abspath(
             args.tuning_table)

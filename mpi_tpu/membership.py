@@ -59,6 +59,7 @@ import uuid
 from typing import Dict, Optional, Sequence, Tuple
 
 from . import mpit as _mpit
+from . import telemetry as _telemetry
 from .errors import EpochSkewError, RejoinRefusedError  # noqa: F401 (re-export)
 from .transport.base import Transport, TransportError
 
@@ -362,6 +363,10 @@ def survivor_transition(transport: Transport, epoch: int,
     rejected by the epoch-checked hello (min_peer_epoch / EpochSkew),
     and the purge guarantees the survivor offers a rejoiner
     ``resume(0)`` — never the corpse's replay."""
+    rec = _telemetry.REC
+    if rec is not None:
+        rec.emit("ft", "epoch_bump",
+                 attrs={"epoch": int(epoch), "dead": list(map(int, dead))})
     transport.epoch = max(transport.epoch, int(epoch))
     for d in dead:
         transport.min_peer_epoch[int(d)] = int(epoch)

@@ -27,6 +27,8 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 __all__ = [
     "cvar_register", "cvar_list", "cvar_read", "cvar_write",
     "pvar_list", "pvar_read", "pvar_reset",
+    "hist_record", "pvar_hist_list", "pvar_hist_read",
+    "pvar_hist_reset", "hist_quantile", "hist_cumulative",
     "Session", "session_create",
 ]
 
@@ -50,7 +52,8 @@ class _Counters:
                  "link_reconnects", "link_replayed", "link_masked",
                  "link_retained", "link_cow_snaps", "link_cow_bytes",
                  "link_syscalls",
-                 "nbc_threads", "nbc_sms", "persist_starts")
+                 "nbc_threads", "nbc_sms", "persist_starts",
+                 "trace_events")
 
     def __init__(self) -> None:
         self.sends = 0
@@ -95,6 +98,7 @@ class _Counters:
         self.nbc_threads = 0
         self.nbc_sms = 0
         self.persist_starts = 0
+        self.trace_events = 0
 
 
 counters = _Counters()  # incremented by communicator.py / codec.py (count())
@@ -127,7 +131,8 @@ def count(sends: int = 0, send_bytes: int = 0, recvs: int = 0,
           link_send_syscalls: int = 0,
           nbc_threads_spawned: int = 0,
           nbc_state_machines: int = 0,
-          persistent_starts: int = 0) -> None:
+          persistent_starts: int = 0,
+          trace_events: int = 0) -> None:
     """Thread-safe increment (rank-threads of the local backend share
     this process's counters; unsynchronized += would lose updates)."""
     with _lock:
@@ -173,6 +178,7 @@ def count(sends: int = 0, send_bytes: int = 0, recvs: int = 0,
         counters.nbc_threads += nbc_threads_spawned
         counters.nbc_sms += nbc_state_machines
         counters.persist_starts += persistent_starts
+        counters.trace_events += trace_events
 
 _PVARS: Dict[str, Callable[[], int]] = {
     "msgs_sent": lambda: counters.sends,
@@ -298,6 +304,12 @@ _PVARS: Dict[str, Callable[[], int]] = {
     "nbc_threads_spawned": lambda: counters.nbc_threads,
     "nbc_state_machines": lambda: counters.nbc_sms,
     "persistent_starts": lambda: counters.persist_starts,
+    # flight recorder (mpi_tpu/telemetry, ISSUE 13): events recorded
+    # into the per-rank ring buffer.  Exactly 0 with tracing off — the
+    # off-mode zero-cost contract (every instrumented seam is one
+    # `telemetry.REC is None` attribute test; bench.py --verify-overhead
+    # --trace asserts it alongside the unchanged wire accounting).
+    "trace_events": lambda: counters.trace_events,
 }
 
 
@@ -318,6 +330,138 @@ def pvar_reset(name: str) -> int:
     """MPI_T semantics put reset in the session; module-level reset just
     returns the current value to subtract (see Session)."""
     return pvar_read(name)
+
+
+# -- histogram pvars (ISSUE 13: distributions beside the counters) -----------
+#
+# Log-bucketed (base-2) histograms for the latencies a mean would lie
+# about: bucket k holds values in [2^(k-1), 2^k) nanoseconds, so 64
+# buckets span sub-ns to ~292 years with zero configuration and O(1)
+# record cost (one bit_length + one increment under the module lock).
+# Quantiles are estimated from the bucket boundaries (geometric
+# midpoint 2^(k-0.5), clamped to the observed min/max) — the standard
+# HDR-style tradeoff: <= ~41% relative error per estimate, which is
+# exactly enough to tell a 1.5ms lease p99 from a 6s one.
+#
+# Recording sites: every traced collective (coll_latency_s — gated on
+# the flight recorder, it is the HOT path), every serve lease grant
+# (lease_acquire_s — always on, the grant is a control round-trip) and
+# every socket link heal (link_heal_s — always on, healing is already
+# a multi-ms reconnect).  hist_record() accepts any name, so new
+# distributions need no registry edit.
+
+_HIST_BUCKETS = 64
+
+
+class _Hist:
+    __slots__ = ("counts", "n", "total_ns", "min_ns", "max_ns")
+
+    def __init__(self) -> None:
+        self.counts = [0] * _HIST_BUCKETS
+        self.n = 0
+        self.total_ns = 0
+        self.min_ns = None  # type: Optional[int]
+        self.max_ns = 0
+
+    def add(self, ns: int) -> None:
+        self.counts[min(_HIST_BUCKETS - 1, ns.bit_length())] += 1
+        self.n += 1
+        self.total_ns += ns
+        if self.min_ns is None or ns < self.min_ns:
+            self.min_ns = ns
+        if ns > self.max_ns:
+            self.max_ns = ns
+
+
+# pre-seeded so pvar_hist_list() is stable before any event fires (the
+# three distributions the README documents); hist_record creates others
+# on demand.
+_HISTS: Dict[str, _Hist] = {
+    "coll_latency_s": _Hist(),
+    "lease_acquire_s": _Hist(),
+    "link_heal_s": _Hist(),
+}
+
+
+def hist_record(name: str, seconds: float) -> None:
+    """Record one sample (seconds; negative clamps to 0) into the named
+    log-bucketed histogram, creating it on first use."""
+    ns = max(0, int(seconds * 1e9))
+    with _lock:
+        h = _HISTS.get(name)
+        if h is None:
+            h = _HISTS[name] = _Hist()
+        h.add(ns)
+
+
+def pvar_hist_list() -> List[str]:
+    with _lock:
+        return sorted(_HISTS)
+
+
+def pvar_hist_read(name: str) -> Dict[str, Any]:
+    """Snapshot of a histogram pvar: count/sum/min/max plus the
+    non-empty buckets as ``{upper_bound_seconds: count}``."""
+    with _lock:
+        h = _HISTS.get(name)
+        if h is None:
+            raise KeyError(f"unknown histogram pvar {name!r}; have "
+                           f"{sorted(_HISTS)}")
+        return {
+            "count": h.n,
+            "sum_s": h.total_ns / 1e9,
+            "min_s": (h.min_ns or 0) / 1e9,
+            "max_s": h.max_ns / 1e9,
+            "buckets": {(1 << k) / 1e9: c
+                        for k, c in enumerate(h.counts) if c},
+        }
+
+
+def pvar_hist_reset(name: str) -> None:
+    with _lock:
+        if name in _HISTS:
+            _HISTS[name] = _Hist()
+
+
+def hist_cumulative(name: str) -> List[Tuple[float, int]]:
+    """Cumulative (upper_bound_seconds, count<=bound) pairs over the
+    non-empty prefix — the Prometheus ``le`` bucket series."""
+    with _lock:
+        h = _HISTS.get(name)
+        if h is None:
+            raise KeyError(f"unknown histogram pvar {name!r}")
+        out: List[Tuple[float, int]] = []
+        cum = 0
+        top = max((k for k, c in enumerate(h.counts) if c), default=-1)
+        for k in range(top + 1):
+            cum += h.counts[k]
+            out.append(((1 << k) / 1e9, cum))
+        return out
+
+
+def hist_quantile(name: str, q: float) -> Optional[float]:
+    """Estimated q-quantile (seconds) from the bucket boundaries, or
+    None for an empty histogram."""
+    if not 0.0 <= q <= 1.0:
+        raise ValueError(f"quantile must be in [0, 1], got {q}")
+    with _lock:
+        h = _HISTS.get(name)
+        if h is None:
+            raise KeyError(f"unknown histogram pvar {name!r}")
+        if h.n == 0:
+            return None
+        target = q * h.n
+        cum = 0
+        for k, c in enumerate(h.counts):
+            cum += c
+            if cum >= target and c:
+                # geometric midpoint of [2^(k-1), 2^k), clamped to the
+                # observed extremes so a single-sample histogram reads
+                # back its own value
+                est = 2.0 ** (k - 0.5)
+                return min(max(est, float(h.min_ns or 0)),
+                           float(h.max_ns)) / 1e9
+        return h.max_ns / 1e9  # pragma: no cover - cum==n on last bucket
 
 
 # -- control variables -------------------------------------------------------

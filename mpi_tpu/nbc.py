@@ -66,6 +66,7 @@ from . import coll_sm as _coll_sm
 from . import mpit as _mpit
 from . import ops as _ops
 from . import schedules
+from . import telemetry as _telemetry
 from . import tuning as _tuning
 from .communicator import (P2PCommunicator, Request, _CompletedRequest,
                            _FT_POLL_S, _SEG_WINDOW, _TAG_COLL, _as_array,
@@ -214,7 +215,7 @@ class _SMColl(Request):
                  "_send_ahead", "_work", "_svals", "_rvals", "_op",
                  "_finish", "_actions", "_srem", "_ai", "_rdt", "_nss",
                  "_done", "_error", "_result", "_lock", "_qlock",
-                 "_queued", "_pool")
+                 "_queued", "_pool", "_t0")
 
     # every frame of a state machine travels on the internal collective
     # tag — what the engine's stalled-poll publication reports
@@ -249,6 +250,9 @@ class _SMColl(Request):
         self._qlock = threading.Lock()
         self._queued = False
         self._pool = pool_for(child._t)
+        # flight-recorder span anchor (0 = tracing off at issue time)
+        rec = _telemetry.REC
+        self._t0 = time.perf_counter_ns() if rec is not None else 0
         child._coll_name = kind  # ProcFailedError diagnoses name the coll
 
     # -- issue-time arming -------------------------------------------------
@@ -267,6 +271,11 @@ class _SMColl(Request):
                     req = child._irecv_internal(spec[0], _TAG_COLL)
                     req._on_complete = self._kick
                     self._actions.append((req, step_i, spec))
+        rec = _telemetry.REC
+        if rec is not None:
+            rec.emit("sm", "arm",
+                     attrs={"kind": self.kind, "steps": len(self._steps),
+                            "recvs": len(self._actions)})
         if self._first_window_bytes() <= _INLINE_FIRE_MAX:
             self._pump()
         else:
@@ -305,10 +314,16 @@ class _SMColl(Request):
                 self._error = e
                 _unpost([r for r, _, _ in self._actions[self._ai:]
                          if r is not None and not r._done])
+                rec = _telemetry.REC
+                if rec is not None:
+                    rec.emit("sm", "fail",
+                             attrs={"kind": self.kind,
+                                    "error": type(e).__name__})
                 self._notify()
 
     def _advance_locked(self) -> None:
         n = len(self._steps)
+        rdt0, nss0 = self._rdt, self._nss
         progressed = True
         while progressed:
             progressed = False
@@ -328,9 +343,22 @@ class _SMColl(Request):
                     self._emit(spec)
                 self._nss += 1
                 progressed = True
+        rec = _telemetry.REC
+        if rec is not None and (self._rdt, self._nss) != (rdt0, nss0):
+            # one SM-step transition event per pump that moved the
+            # machine (recv-done-through / next-send-step watermarks —
+            # the libNBC progress picture, per call, per rank)
+            rec.emit("sm", "step",
+                     attrs={"kind": self.kind, "rdt": self._rdt,
+                            "nss": self._nss, "of": n})
         if self._rdt == n and self._nss == n and not self._done:
             self._result = self._finish(self)
             self._done = True
+            if rec is not None:
+                rec.emit("sm", "done",
+                         dur_ns=(time.perf_counter_ns() - self._t0
+                                 if self._t0 else 0),
+                         attrs={"kind": self.kind, "steps": n})
             self._notify()
 
     def _apply(self, spec: Tuple, got: Any) -> None:
@@ -378,6 +406,11 @@ class _SMColl(Request):
             self._error = err
             _unpost([r for r, _, _ in self._actions[self._ai:]
                      if not r._done])
+        rec = _telemetry.REC
+        if rec is not None:
+            rec.emit("sm", "fail",
+                     attrs={"kind": self.kind,
+                            "error": type(err).__name__})
         self._notify()
 
     def _pending_world_srcs(self) -> Tuple[int, ...]:

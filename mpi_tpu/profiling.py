@@ -5,8 +5,11 @@
 * :func:`timeit` — robust wall-clock timing of a jax callable
   (``block_until_ready`` fencing, warmup, median/percentiles) — the
   measurement core shared by bench.py and benchmarks/osu.py conventions.
-* :class:`CommStats` — per-op counters a Communicator wrapper can fill;
-  structured (JSON-able) so observability output stays mechanical.
+* :class:`CommStats` — per-op counters (counts + bytes).  Since ISSUE
+  13 this is no longer dead API waiting for a wrapper that never came:
+  the flight recorder (mpi_tpu/telemetry) fills one per traced run —
+  every traced collective records (op, payload bytes) — and
+  :func:`comm_stats` returns it.
 """
 
 from __future__ import annotations
@@ -73,7 +76,9 @@ def timeit(fn: Callable[[], Any], iters: int = 50, warmup: int = 5) -> Timing:
 
 @dataclass
 class CommStats:
-    """Structured per-op counters (counts + bytes), JSON-able for logs."""
+    """Structured per-op counters (counts + bytes), JSON-able for logs.
+    The live instance of a traced run hangs off the flight recorder
+    (``telemetry.Recorder.stats``); :func:`comm_stats` fetches it."""
 
     ops: Dict[str, int] = field(default_factory=dict)
     bytes: Dict[str, int] = field(default_factory=dict)
@@ -84,3 +89,14 @@ class CommStats:
 
     def to_json(self) -> str:
         return json.dumps({"ops": self.ops, "bytes": self.bytes})
+
+
+def comm_stats() -> "CommStats | None":
+    """The per-op counters of the active (or last) traced run — filled
+    by every collective while the flight recorder is enabled
+    (``MPI_TPU_TRACE=1`` / ``run_local(trace=True)`` /
+    ``telemetry.enable()``).  None when nothing was ever traced."""
+    from . import telemetry as _telemetry
+
+    rec = _telemetry.recorder()
+    return rec.stats if rec is not None else None

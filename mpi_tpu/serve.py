@@ -50,6 +50,8 @@ import time
 from typing import Any, Dict, List, Optional, Sequence
 
 from . import membership
+from . import mpit as _mpit
+from . import telemetry as _telemetry
 from .errors import (DeadlockError, EpochSkewError, ProcFailedError,
                      RejoinRefusedError, RevokedError, error_class)
 from .transport.base import RecvTimeout, TransportError
@@ -65,6 +67,18 @@ _WORLD_LEASE_TIMEOUT_S = 30.0   # acquire wait + default run bound
 _REJOIN_TIMEOUT_S = 20.0        # one healing round's handshake bound
 _DETECT_TIMEOUT_S = 2.0         # pool-internal ULFM detection bound
 _HEARTBEAT_S = 0.25
+
+# Worker pvars piggybacked on every job_done reply (ISSUE 13): the
+# server keeps the latest snapshot per slot and stats()/the metrics
+# endpoint aggregate them — the pool's data-plane story (healed links,
+# arena hits, detected deaths) without a second control round-trip.
+_WORKER_PVARS = ("msgs_sent", "collectives_started", "link_reconnects",
+                 "link_faults_masked", "coll_sm_hits",
+                 "proc_failures_detected", "epoch_skews_detected",
+                 "trace_events")
+
+# Sliding window of the worlds/s gauge (per-second completion buckets).
+_RATE_WINDOW_S = 60.0
 
 
 # -- framing ------------------------------------------------------------------
@@ -238,6 +252,11 @@ def _worker_main() -> int:
         epoch, slot = (int(x) for x in rejoin_spec.split(":"))
         rj_timeout = float(os.environ.get(
             "MPI_TPU_SERVE_REJOIN_TIMEOUT_S", 0) or 0) or None
+        # the init() path enables tracing from the environment; the
+        # rejoin path builds its transport directly, so mirror it here
+        # — BEFORE the rejoin handshake, which is exactly the window
+        # the rejoin-hello-race class of war story lives in
+        _telemetry.enable_from_env(rank=slot)
         t, _ann = membership.rejoin_transport(
             rdv, slot=slot, epoch=epoch, backend=backend,
             timeout=rj_timeout)
@@ -305,6 +324,8 @@ def _worker_main() -> int:
         if msg is None:
             break
         job_id, slots = msg["job_id"], list(msg["slots"])
+        rec = _telemetry.REC
+        t_job = time.perf_counter_ns() if rec is not None else 0
         try:
             fn = pickle.loads(msg["fn"])
             args = pickle.loads(msg["args"])
@@ -327,6 +348,16 @@ def _worker_main() -> int:
         except BaseException as e:  # noqa: BLE001 - shipped to the client
             reply = {"op": "job_done", "job_id": job_id, "slot": slot,
                      "ok": False, "error": _pack_error(e)}
+        if rec is not None:
+            rec.emit("lease", "job",
+                     dur_ns=time.perf_counter_ns() - t_job,
+                     attrs={"job_id": job_id, "slots": slots,
+                            "ok": reply["ok"],
+                            "error": (reply.get("error") or {}).get(
+                                "kind")})
+        # ISSUE 13: piggyback a pvar snapshot for the server's metrics
+        # aggregation — latest-per-slot, summed by stats()
+        reply["pvars"] = {n: _mpit.pvar_read(n) for n in _WORKER_PVARS}
         try:
             _send_msg(ctrl, send_lock, reply)
         except OSError:
@@ -372,7 +403,8 @@ class WorldServer:
                  heartbeat_s: float = _HEARTBEAT_S,
                  world_lease_timeout_s: float = _WORLD_LEASE_TIMEOUT_S,
                  rejoin_timeout_s: float = _REJOIN_TIMEOUT_S,
-                 env_extra: Optional[dict] = None) -> None:
+                 env_extra: Optional[dict] = None,
+                 metrics_port: Optional[int] = None) -> None:
         if pool_size < 1:
             raise ValueError("pool_size must be >= 1")
         if backend == "shm":
@@ -405,11 +437,31 @@ class WorldServer:
                                "jobs_ok": 0, "jobs_failed": 0,
                                "heals_completed": 0, "workers_lost": 0}
         self._threads: List[threading.Thread] = []
+        # observability (ISSUE 13): uptime anchor for the worlds/s
+        # gauge, per-second completed-job buckets (sliding window —
+        # bounded at ~window-many keys regardless of rate, unlike a
+        # timestamp deque whose maxlen would cap the measurable rate),
+        # the latest per-slot worker pvar snapshot, and the optional
+        # Prometheus endpoint (metrics_port; 0 = ephemeral, see
+        # metrics_addr)
+        self._t0 = time.monotonic()
+        self._ok_buckets: Dict[int, int] = {}
+        self._worker_pvars: Dict[int, dict] = {}
+        self._metrics_port = metrics_port
+        self._metrics_httpd = None
+        self.metrics_addr: Optional[str] = None
+        self._host = host
 
     # -- lifecycle ---------------------------------------------------------
 
     def start(self, wait_ready: bool = True,
               timeout: float = 120.0) -> "WorldServer":
+        # the lease-acquire histogram is a process-global mpit pvar:
+        # start this server's document clean so sequential in-process
+        # servers (the test idiom) don't report a predecessor's tail
+        # as their own p99.  (Two CONCURRENT servers in one process —
+        # not a deployment shape — still share it.)
+        _mpit.pvar_hist_reset("lease_acquire_s")
         for slot in range(self.pool_size):
             self._workers[slot] = _Worker(slot)
             self._spawn_worker(slot)
@@ -419,6 +471,8 @@ class WorldServer:
                                   name=f"serve-{name}")
             th.start()
             self._threads.append(th)
+        if self._metrics_port is not None:
+            self._start_metrics(self._metrics_port)
         if wait_ready:
             deadline = time.monotonic() + timeout
             with self._cond:
@@ -465,6 +519,14 @@ class WorldServer:
             self._listener.close()
         except OSError:
             pass
+        httpd = self._metrics_httpd
+        if httpd is not None:
+            self._metrics_httpd = None
+            try:
+                httpd.shutdown()
+                httpd.server_close()
+            except OSError:  # pragma: no cover - teardown race
+                pass
         deadline = time.monotonic() + 5.0
         for p in procs:
             if p.poll() is None:
@@ -482,6 +544,51 @@ class WorldServer:
                 except subprocess.TimeoutExpired:  # pragma: no cover
                     pass
         membership.cleanup_rendezvous(self.rdv)
+
+    # -- metrics endpoint (ISSUE 13) ---------------------------------------
+
+    def _start_metrics(self, port: int) -> None:
+        """Serve ``GET /metrics`` (Prometheus text format, rendered by
+        mpi_tpu/telemetry/metrics.py from the same ``stats()`` document
+        ``client.stats()`` returns) on a side HTTP port.  Port 0 binds
+        ephemeral — ``metrics_addr`` reports the outcome.  The handler
+        only READS (stats() takes the server lock briefly); a scrape
+        can never wedge the monitor/heal machinery."""
+        from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+        from .telemetry import metrics as _metrics
+
+        server = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def do_GET(self) -> None:  # noqa: N802 - http.server API
+                if self.path.split("?", 1)[0] not in ("/", "/metrics"):
+                    self.send_error(404)
+                    return
+                try:
+                    body = _metrics.prometheus_text(
+                        server.stats()).encode()
+                except Exception as e:  # noqa: BLE001 - shipped as 500
+                    self.send_error(500, type(e).__name__)
+                    return
+                self.send_response(200)
+                self.send_header("Content-Type",
+                                 "text/plain; version=0.0.4")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *args) -> None:  # noqa: D102
+                pass  # scrapes are not server-log events
+
+        httpd = ThreadingHTTPServer((self._host, int(port)), Handler)
+        httpd.daemon_threads = True
+        self._metrics_httpd = httpd
+        self.metrics_addr = "%s:%d" % httpd.server_address[:2]
+        th = threading.Thread(target=httpd.serve_forever,
+                              daemon=True, name="serve-metrics")
+        th.start()
+        self._threads.append(th)
 
     # -- worker processes --------------------------------------------------
 
@@ -620,6 +727,9 @@ class WorldServer:
 
     def _job_done(self, slot: int, msg: dict) -> None:
         with self._cond:
+            pvars = msg.get("pvars")
+            if pvars:
+                self._worker_pvars[slot] = pvars
             job = self._jobs.get(msg["job_id"])
             if job is None:
                 return
@@ -641,6 +751,11 @@ class WorldServer:
             return
         w.state = "dead"
         w.conn = None
+        rec = _telemetry.REC
+        if rec is not None:
+            rec.emit("lease", "worker_dead",
+                     attrs={"slot": w.slot, "why": why,
+                            "epoch": self.epoch + 1})
         if w.proc is not None and w.proc.poll() is None:
             # declared dead but the process lives (heartbeat-stale
             # wedge): kill it — two live incarnations of one slot must
@@ -679,14 +794,28 @@ class WorldServer:
                 return
             try:
                 self._monitor_tick()
-            except Exception:  # noqa: BLE001 - the pool's lifeline
+            except Exception as e:  # noqa: BLE001 - the pool's lifeline
                 if self._closing:
                     return  # shutdown raced a heal (rdv dir removed)
+                # a monitor crash must never silently end healing: a
+                # STRUCTURED line (what failed, pool state) + telemetry
+                # event instead of ISSUE 7's bare print_exc, then keep
+                # ticking (ISSUE 13 satellite)
                 import traceback
 
-                traceback.print_exc()
-                # a monitor crash must never silently end healing: log
-                # and keep ticking
+                with self._lock:
+                    epoch, healing = self.epoch, sorted(self._healing)
+                sys.stderr.write(
+                    f"mpi_tpu.serve: monitor tick failed "
+                    f"({type(e).__name__}: {str(e)[:200]}; epoch "
+                    f"{epoch}, healing slots {healing}) — healing "
+                    f"continues:\n{traceback.format_exc()}")
+                rec = _telemetry.REC
+                if rec is not None:
+                    rec.emit("serve", "monitor_error",
+                             attrs={"error": type(e).__name__,
+                                    "epoch": epoch,
+                                    "healing": healing})
 
     def _monitor_tick(self) -> None:
         now_wall = time.time()
@@ -844,7 +973,8 @@ class WorldServer:
             raise ValueError(
                 f"nranks must be in [1, {self.pool_size}] for this pool")
         timeout = float(msg.get("timeout") or self.world_lease_timeout_s)
-        deadline = time.monotonic() + timeout
+        t_req = time.monotonic()
+        deadline = t_req + timeout
         with self._cond:
             while True:
                 if self._closing:
@@ -871,6 +1001,15 @@ class WorldServer:
             epoch = self.epoch
             self._leases[lease_id] = {"slots": slots, "epoch": epoch}
             self.stats_counters["leases_granted"] += 1
+        # lease-acquire latency distribution (ISSUE 13): always on —
+        # the grant is a control round-trip, one histogram add is noise
+        # (this is what the metrics endpoint's p50/p99 summarize)
+        _mpit.hist_record("lease_acquire_s", time.monotonic() - t_req)
+        rec = _telemetry.REC
+        if rec is not None:
+            rec.emit("lease", "grant",
+                     attrs={"lease_id": lease_id, "slots": slots,
+                            "epoch": epoch})
         owned.append(lease_id)
         return {"ok": True, "lease_id": lease_id, "slots": slots,
                 "epoch": epoch}
@@ -939,6 +1078,10 @@ class WorldServer:
                         proc.kill()
                     except OSError:
                         pass
+            sys.stderr.write(
+                f"mpi_tpu.serve: job {job_id} on lease {lease_id} "
+                f"blew the {timeout}s lease timeout; quarantined "
+                f"worker slots {stuck}\n")
             return {"error": {
                 "kind": "LeaseTimeout",
                 "msg": f"job on lease {lease_id} did not complete "
@@ -952,8 +1095,21 @@ class WorldServer:
             errs = sorted(
                 job["errors"],
                 key=lambda e: 0 if e.get("kind") in _ERROR_KINDS else 1)
+            # ISSUE 13 satellite: a lease failure is attributable in
+            # the server log — job/lease id, error class, failed slots
+            sys.stderr.write(
+                f"mpi_tpu.serve: job {job_id} on lease {lease_id} "
+                f"failed: {errs[0].get('kind')}: "
+                f"{str(errs[0].get('msg', ''))[:200]} "
+                f"(failed slots {errs[0].get('failed')})\n")
             return {"error": errs[0]}
-        self.stats_counters["jobs_ok"] += 1
+        with self._cond:
+            self.stats_counters["jobs_ok"] += 1
+            sec = int(time.monotonic())
+            self._ok_buckets[sec] = self._ok_buckets.get(sec, 0) + 1
+            for k in [k for k in self._ok_buckets
+                      if sec - k > _RATE_WINDOW_S]:
+                del self._ok_buckets[k]
         return {"ok": True, "result": job["result"]}
 
     def _release(self, lease_id: int) -> None:
@@ -969,17 +1125,39 @@ class WorldServer:
             self._cond.notify_all()
 
     def stats(self) -> dict:
+        now = time.monotonic()
         with self._lock:
             states = {s: w.state for s, w in self._workers.items()}
-            return {
+            # worlds/s over the sliding window (completed jobs), the
+            # gauge ROADMAP direction 1 asks for; uptime-bounded so a
+            # young server reads its true rate, not a diluted one
+            window = min(_RATE_WINDOW_S, max(1e-9, now - self._t0))
+            recent = sum(c for sec, c in self._ok_buckets.items()
+                         if now - sec <= _RATE_WINDOW_S)
+            agg: Dict[str, int] = {}
+            for snap in self._worker_pvars.values():
+                for k, v in snap.items():
+                    agg[k] = agg.get(k, 0) + int(v)
+            out = {
                 "addr": self.addr, "backend": self.backend,
                 "pool_size": self.pool_size, "epoch": self.epoch,
                 "workers": states,
                 "idle": sum(1 for v in states.values() if v == "idle"),
                 "healing": sorted(self._healing),
                 "leases_active": len(self._leases),
+                "uptime_s": round(now - self._t0, 3),
+                "worlds_per_s": round(recent / window, 3),
+                "worker_pvars": agg,
+                "metrics_addr": self.metrics_addr,
                 **self.stats_counters,
             }
+        # lease-acquire quantiles from the histogram pvar (log-bucket
+        # estimates — mpit.hist_quantile documents the error bound)
+        for q, label in ((0.5, "p50"), (0.99, "p99")):
+            est = _mpit.hist_quantile("lease_acquire_s", q)
+            out[f"lease_acquire_{label}_ms"] = (
+                None if est is None else round(est * 1e3, 3))
+        return out
 
 
 # -- the client ---------------------------------------------------------------
@@ -1155,16 +1333,27 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     ap.add_argument("--rejoin-timeout", type=float,
                     default=_REJOIN_TIMEOUT_S,
                     help="rejoin_timeout_s of one healing handshake")
+    ap.add_argument("--metrics-port", type=int, default=None,
+                    metavar="PORT",
+                    help="serve GET /metrics (Prometheus text format: "
+                         "worlds/s, lease p50/p99, pool epoch, per-"
+                         "worker health, aggregated worker pvars) on "
+                         "this HTTP port; 0 binds an ephemeral port "
+                         "(printed at startup)")
     args = ap.parse_args(argv)
     server = WorldServer(
         pool_size=args.pool_size, backend=args.backend, host=args.host,
         port=args.port, detect_timeout_s=args.detect_timeout,
         heartbeat_s=args.heartbeat,
         world_lease_timeout_s=args.lease_timeout,
-        rejoin_timeout_s=args.rejoin_timeout)
+        rejoin_timeout_s=args.rejoin_timeout,
+        metrics_port=args.metrics_port)
     server.start()
     print(f"mpi_tpu serve: listening on {server.addr} "
           f"(pool {args.pool_size} x {args.backend})", flush=True)
+    if server.metrics_addr:
+        print(f"mpi_tpu serve: metrics on "
+              f"http://{server.metrics_addr}/metrics", flush=True)
     if args.addr_file:
         tmp = args.addr_file + ".tmp"
         with open(tmp, "w") as f:

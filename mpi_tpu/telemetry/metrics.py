@@ -1,0 +1,94 @@
+"""Prometheus text rendering for the serve metrics endpoint.
+
+Pure functions from a ``WorldServer.stats()`` document (plus the mpit
+histogram pvars) to Prometheus exposition format, so the HTTP endpoint
+in serve.py is a ten-line thread and the rendering is unit-testable
+without a server.  The shape follows the Prometheus conventions:
+counters get ``_total``, histograms emit ``_bucket{le=...}`` +
+``_sum`` + ``_count``, labels for the per-worker rows.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+from .. import mpit as _mpit
+
+# stats() keys rendered as monotone counters (name -> _total metric)
+_COUNTER_KEYS = ("leases_granted", "leases_denied", "jobs_ok",
+                 "jobs_failed", "heals_completed", "workers_lost")
+
+# stats() keys rendered as gauges
+_GAUGE_KEYS = ("epoch", "pool_size", "idle", "leases_active",
+               "worlds_per_s", "uptime_s")
+
+_PREFIX = "mpi_tpu_serve"
+
+
+def _fmt(v) -> str:
+    return repr(float(v)) if isinstance(v, float) else str(int(v))
+
+
+def render_histogram(name: str, metric: str,
+                     lines: List[str]) -> None:
+    """One mpit histogram pvar as a Prometheus histogram series."""
+    snap = _mpit.pvar_hist_read(name)
+    lines.append(f"# TYPE {metric} histogram")
+    for le, cum in _mpit.hist_cumulative(name):
+        lines.append(f'{metric}_bucket{{le="{le:.9g}"}} {cum}')
+    lines.append(f'{metric}_bucket{{le="+Inf"}} {snap["count"]}')
+    lines.append(f"{metric}_sum {snap['sum_s']:.9g}")
+    lines.append(f"{metric}_count {snap['count']}")
+
+
+def prometheus_text(stats: Dict,
+                    hists: Optional[Dict[str, str]] = None) -> str:
+    """Render a serve stats document (see ``WorldServer.stats()``) as
+    Prometheus exposition text.  ``hists`` maps mpit histogram pvar
+    names to metric names; the default exports the lease-acquire
+    distribution (the p50/p99 the acceptance names)."""
+    lines: List[str] = []
+    for key in _GAUGE_KEYS:
+        if key in stats:
+            metric = f"{_PREFIX}_{key}"
+            lines.append(f"# TYPE {metric} gauge")
+            lines.append(f"{metric} {_fmt(stats[key])}")
+    for key in _COUNTER_KEYS:
+        if key in stats:
+            metric = f"{_PREFIX}_{key}_total"
+            lines.append(f"# TYPE {metric} counter")
+            lines.append(f"{metric} {_fmt(stats[key])}")
+    workers = stats.get("workers") or {}
+    if workers:
+        metric = f"{_PREFIX}_worker_state"
+        lines.append(f"# TYPE {metric} gauge")
+        for slot, state in sorted(workers.items()):
+            lines.append(
+                f'{metric}{{slot="{slot}",state="{state}"}} 1')
+    healing = stats.get("healing")
+    if healing is not None:
+        metric = f"{_PREFIX}_healing_slots"
+        lines.append(f"# TYPE {metric} gauge")
+        lines.append(f"{metric} {len(healing)}")
+    # aggregated worker pvars (piggybacked on job_done replies): the
+    # pool's data-plane story — link reconnects, arena hits, detected
+    # failures — summed over the latest snapshot of each slot
+    agg = stats.get("worker_pvars") or {}
+    if agg:
+        metric = "mpi_tpu_worker_pvar"
+        lines.append(f"# TYPE {metric} gauge")
+        for name in sorted(agg):
+            lines.append(f'{metric}{{name="{name}"}} {_fmt(agg[name])}')
+    for name, metric in (hists if hists is not None
+                         else {"lease_acquire_s":
+                               "mpi_tpu_lease_acquire_seconds"}).items():
+        render_histogram(name, metric, lines)
+    # the quantile gauges the acceptance scrapes directly (estimated
+    # from the log buckets — see mpit.hist_quantile's error bound)
+    for q, label in ((0.5, "p50"), (0.99, "p99")):
+        est = _mpit.hist_quantile("lease_acquire_s", q)
+        if est is not None:
+            metric = f"{_PREFIX}_lease_acquire_{label}_seconds"
+            lines.append(f"# TYPE {metric} gauge")
+            lines.append(f"{metric} {est:.9g}")
+    return "\n".join(lines) + "\n"

@@ -68,6 +68,7 @@ def run_local(
     verify: bool = False,
     progress: Optional[str] = None,
     tuning_table: Optional[str] = None,
+    trace: bool = False,
 ) -> List[Any]:
     """Run ``fn(comm, *args, **kwargs)`` on ``nranks`` in-process ranks;
     return the per-rank results as a list indexed by rank.
@@ -104,11 +105,23 @@ def run_local(
     — restored to the previous table when the world completes.  ``None``
     leaves the current process configuration (MPI_TPU_TUNING_TABLE /
     the ``tuning_table_path`` cvar) alone.
+
+    ``trace=True`` enables the flight recorder (mpi_tpu/telemetry) for
+    the run: one process-wide ring buffer (rank threads are told apart
+    by tid), left ACTIVE afterwards so the caller can inspect/export —
+    ``mpi_tpu.telemetry.recorder().dump()`` /
+    ``telemetry.export_chrome(path)``; call ``telemetry.disable()``
+    when done.  ``False`` changes nothing (an already-enabled recorder
+    keeps recording).
     """
     from .. import progress as _progress
     from .. import tuning as _tuning
     from ..communicator import P2PCommunicator
 
+    if trace:
+        from .. import telemetry as _telemetry
+
+        _telemetry.enable()
     progress_mode = _progress.resolve_mode(progress)
     prev_table = None
     if tuning_table is not None:

@@ -52,6 +52,7 @@ from typing import Any, Dict, Optional
 from .. import bufpool as _bufpool
 from .. import mpit as _mpit
 from .. import resilience as _resilience
+from .. import telemetry as _telemetry
 from ..errors import EpochSkewError
 from ..resilience import LinkState, backoff_delays
 from . import codec
@@ -393,12 +394,16 @@ class SocketTransport(Transport):
         mismatch) so the sender discovers a dead channel instead of
         streaming into kernel buffers nobody drains."""
         try:
-            self._link.rx_gate(
+            delivered = self._link.rx_gate(
                 src, seq, lambda: self.mailbox.deliver(src, ctx, tag, obj),
                 gen)
         except TransportError:
             conn.close()
             raise
+        rec = _telemetry.REC
+        if rec is not None and delivered:
+            rec.emit("frame", "recv",
+                     attrs={"src": src, "seq": seq, "tag": tag})
 
     # -- cumulative-ack flusher (mpi_tpu/resilience.py) --------------------
 
@@ -716,6 +721,10 @@ class SocketTransport(Transport):
                             self._last_send[dest] = time.monotonic()
                             if self._link.mark_connected(dest):
                                 _mpit.count(link_reconnects=1)
+                                rec = _telemetry.REC
+                                if rec is not None:
+                                    rec.emit("link", "reconnect",
+                                             attrs={"peer": dest})
                             return conn
                         conn = None  # replay tripped: count as a miss
                 if conn is not None:
@@ -736,6 +745,11 @@ class SocketTransport(Transport):
         word/seq are authoritative, the ack field is not).  False on a
         mid-replay socket error (caller retries the whole dial)."""
         pending = self._link.resume(dest, resume_seq)
+        rec = _telemetry.REC
+        if rec is not None and pending:
+            rec.emit("link", "replay",
+                     attrs={"peer": dest, "frames": len(pending),
+                            "resume_seq": resume_seq})
         for seq, word, body in pending:
             views = body.pin()
             if views is None:
@@ -790,6 +804,8 @@ class SocketTransport(Transport):
                     f"failed while re-establishing its link "
                     f"(original fault: {err})")
 
+        rec = _telemetry.REC
+        t_heal = time.perf_counter_ns()
         try:
             self._establish_locked(
                 dest, time.monotonic() + retry_s, backoff_delays(),
@@ -797,12 +813,31 @@ class SocketTransport(Transport):
         except EpochSkewError:
             raise  # membership diagnosis outranks link healing
         except _LinkAbort as e:
+            if rec is not None:
+                rec.emit("link", "heal",
+                         dur_ns=time.perf_counter_ns() - t_heal,
+                         attrs={"peer": dest, "ok": False,
+                                "error": "aborted"})
             raise TransportError(str(e)) from err
         except (OSError, TransportError):
+            if rec is not None:
+                rec.emit("link", "heal",
+                         dur_ns=time.perf_counter_ns() - t_heal,
+                         attrs={"peer": dest, "ok": False,
+                                "error": "retry_timeout"})
             raise TransportError(
                 f"rank {self.world_rank}: link to rank {dest} not "
                 f"re-established within link_retry_timeout_s="
                 f"{retry_s} (original fault: {err})") from err
+        heal_s = (time.perf_counter_ns() - t_heal) / 1e9
+        # link-heal latency distribution (ISSUE 13): always recorded —
+        # a heal is already a multi-ms reconnect, the histogram add is
+        # noise on it (unlike the per-collective hot path, which gates
+        # its histogram on the flight recorder)
+        _mpit.hist_record("link_heal_s", heal_s)
+        if rec is not None:
+            rec.emit("link", "heal", dur_ns=int(heal_s * 1e9),
+                     attrs={"peer": dest, "ok": True})
         _mpit.count(link_faults_masked=1)
 
     def send(self, dest: int, ctx, tag: int, payload: Any) -> None:
@@ -897,6 +932,12 @@ class SocketTransport(Transport):
                     return  # ref released: window torn down (closing)
             else:
                 pinned = views
+            rec = _telemetry.REC
+            # stamped at send START: the matching pass in tracecat.py
+            # needs send <= recv in real time, and an emit placed after
+            # the syscall loses that ordering whenever the receiver
+            # delivers before this thread is rescheduled
+            t_send = time.perf_counter_ns() if rec is not None else 0
             try:
                 if hook is None:
                     # the hot path: header + meta + every segment in
@@ -913,6 +954,11 @@ class SocketTransport(Transport):
                     hook(dest, "mid")  # chaos: reset mid-frame
                     _sendmsg_views(conn, pinned)
                 self._last_send[dest] = time.monotonic()
+                if rec is not None:
+                    rec.emit("frame", "send",
+                             dur_ns=time.perf_counter_ns() - t_send,
+                             attrs={"dest": dest, "seq": seq,
+                                    "nbytes": nbytes})
             except OSError as e:
                 # classification + healing; the retained window replays
                 # this frame on a successful reconnect (with healing
@@ -953,6 +999,11 @@ class SocketTransport(Transport):
                 conn.close()
             except OSError:
                 pass
+            rec = _telemetry.REC
+            if rec is not None:
+                # the chaos timeline's cause marker: reset HERE, then
+                # the heal/reconnect/replay events that answer it
+                rec.emit("link", "reset_injected", attrs={"peer": dest})
 
     # -- membership (mpi_tpu/membership.py) --------------------------------
 

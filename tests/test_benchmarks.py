@@ -212,7 +212,7 @@ def test_serve_chaos_quick_smoke():
     assert result["unnamed_failures"] == []
 
 
-def test_links_chaos_quick_smoke():
+def test_links_chaos_quick_smoke(tmp_path):
     """The link-fault chaos leg (ISSUE 10; the ``bench.py --chaos
     --links --quick`` CI spelling): connection resets — between frames
     AND mid-frame — hammered into a 3-rank socket world running a
@@ -221,10 +221,17 @@ def test_links_chaos_quick_smoke():
     healed by a counted reconnect (link_reconnects >= resets), and a
     genuine mid-run death under the SAME harness still surfaces
     MPI_ERR_PROC_FAILED within the detection bound — healing never
-    masks real death."""
+    masks real death.
+
+    ISSUE 13 rides the same leg under the flight recorder
+    (``--trace-dir``): the merged 3-rank Chrome trace must SHOW the
+    injected fault story — reset → reconnect → replay — with aligned
+    cross-rank timestamps (this is also the tier-1 wiring for the
+    trace-export quick leg + the tools/tracecat.py merge)."""
     from benchmarks import chaos
 
-    result = chaos.run_links_chaos(quick=True)
+    result = chaos.run_links_chaos(quick=True,
+                                   trace_dir=str(tmp_path))
     assert result["ok"], {k: result[k] for k in
                           ("resets_injected", "link_reconnects",
                            "bit_parity_vs_uninjected",
@@ -234,6 +241,16 @@ def test_links_chaos_quick_smoke():
     assert result["link_reconnects"] >= result["resets_injected"]
     assert result["bit_parity_vs_uninjected"]
     assert result["kill_still_diagnosed"]
+    trace = result["trace"]
+    assert trace["ranks"] == 3
+    for evt in ("link.reset_injected", "link.reconnect", "link.replay",
+                "link.heal"):
+        assert trace["link_events"].get(evt, 0) >= 1, trace
+    # the fault story is causally ordered on the merged timeline: the
+    # replayed frames' send/recv matching yields sub-ms offsets with
+    # no frame arriving before it was sent
+    assert trace["coll_events"] > 0 and trace["frame_events"] > 0
+    assert trace["negative_latency_frames"] == 0, trace
 
 
 def test_hotpath_quick_smoke():

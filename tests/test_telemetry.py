@@ -1,0 +1,434 @@
+"""Flight-recorder telemetry (ISSUE 13): the off-mode zero-cost
+contract, ring wraparound, Chrome export, cross-rank clock alignment
+(tools/tracecat.py), histogram pvars, the Prometheus renderer, and
+``client.stats()``/the metrics scrape staying live while the pool
+heals under a kill.
+
+The off-mode contract mirrors ft/verify/progress: with no recorder
+enabled every instrumented seam is one ``telemetry.REC is None``
+attribute test — mechanically asserted here by the ``trace_events``
+pvar staying 0 and the wire-accounting pvars matching a traced run's
+(``bench.py --verify-overhead --trace`` prices the same contract on
+the CLI).
+"""
+
+import json
+import os
+import sys
+import threading
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from mpi_tpu import mpit, serve, telemetry
+from mpi_tpu.telemetry import Recorder
+from mpi_tpu.telemetry import metrics as tmetrics
+from mpi_tpu.transport.local import run_local
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+sys.path.insert(0, os.path.join(REPO, "tools"))
+try:
+    import tracecat
+finally:
+    sys.path.pop(0)
+
+# serve pools on this 2-core box: mirror tests/test_serve.py's margins
+DETECT_S = 1.5
+LOAD_MARGIN_S = 25.0 if (os.cpu_count() or 1) < 4 else 8.0
+
+
+@pytest.fixture(autouse=True)
+def _no_leaked_recorder():
+    """A test that enables tracing must not leak the recorder into the
+    rest of the tier-1 run (the off-mode contract of every OTHER test
+    depends on REC staying None)."""
+    telemetry.disable()
+    yield
+    telemetry.disable()
+
+
+def _coll_mix(comm):
+    comm.allreduce(np.arange(8.0))
+    comm.barrier()
+    comm.allgather(np.arange(4.0))
+    comm.alltoall([np.arange(2.0)] * comm.size)
+
+
+# -- off-mode contract --------------------------------------------------------
+
+
+def test_off_mode_zero_events_zero_hot_path_change():
+    """Tracing off: zero events recorded (pvar-asserted) and the wire
+    accounting — payload copies, pickled array bytes — IDENTICAL to a
+    traced run of the same program: the recorder observes the hot path,
+    never participates in it."""
+    ses = mpit.session_create()
+    ses.reset_all()
+    run_local(_coll_mix, 2)
+    assert telemetry.REC is None
+    assert ses.read("trace_events") == 0
+    off_copies = ses.read("payload_copies")
+    off_pickled = ses.read("bytes_pickled_sent")
+
+    ses.reset_all()
+    run_local(_coll_mix, 2, trace=True)
+    telemetry.disable()
+    assert ses.read("trace_events") > 0
+    assert ses.read("payload_copies") == off_copies
+    assert ses.read("bytes_pickled_sent") == off_pickled
+
+
+def test_trace_events_pvar_zero_across_module_surface():
+    """No recorder -> the emitting seams (collective wrapper, arena,
+    serve, nbc, links) never fire: one pvar proves it for whatever ran
+    before this test in the session."""
+    assert telemetry.REC is None
+    before = mpit.pvar_read("trace_events")
+    run_local(_coll_mix, 2)
+    assert mpit.pvar_read("trace_events") == before
+
+
+# -- the recorder -------------------------------------------------------------
+
+
+def test_ring_wraparound_keeps_newest():
+    rec = Recorder(capacity=4)
+    for i in range(10):
+        rec.emit("test", f"e{i}")
+    assert rec.events_total == 10
+    assert rec.dropped == 6
+    assert [e["name"] for e in rec.dump()] == ["e6", "e7", "e8", "e9"]
+    # partial ring: oldest-first without wrap
+    rec2 = Recorder(capacity=8)
+    rec2.emit("test", "a")
+    rec2.emit("test", "b")
+    assert [e["name"] for e in rec2.dump()] == ["a", "b"]
+    assert rec2.dropped == 0
+
+
+def test_enable_disable_lifecycle():
+    rec = telemetry.enable(rank=7)
+    assert telemetry.enable() is rec  # idempotent, first call wins
+    rec.emit("test", "x")
+    got = telemetry.disable()
+    assert got is rec and telemetry.REC is None
+    # the just-disabled recorder stays inspectable/exportable
+    assert telemetry.recorder() is rec
+    assert rec.find("test", "x")
+
+
+def test_traced_collectives_record_resolved_algorithm():
+    """Every collective span carries the CONCRETE algorithm — the
+    ``auto`` spelling is rewritten at the dispatch pick (and ``sm`` on
+    an arena hit), never recorded as-is."""
+    run_local(_coll_mix, 2, trace=True)
+    rec = telemetry.disable()
+    colls = rec.find("coll")
+    assert {e["name"] for e in colls} == {
+        "allreduce", "barrier", "allgather", "alltoall"}
+    for e in colls:
+        assert e["attrs"].get("algorithm") not in (None, "auto"), e
+        assert e["dur_ns"] >= 0
+
+
+def test_blocked_wait_span_past_noise_floor():
+    """A recv blocked well past WAIT_MIN_NS becomes a ``wait`` span
+    naming the source; an unblocked healthy exchange adds none."""
+    def body(comm):
+        if comm.rank == 0:
+            comm.recv(source=1, tag=5)
+        else:
+            time.sleep(0.08)
+            comm.send(b"x", 0, tag=5)
+
+    run_local(body, 2, trace=True)
+    rec = telemetry.disable()
+    waits = rec.find("wait", "recv")
+    assert waits, "blocked recv recorded no wait span"
+    assert max(e["dur_ns"] for e in waits) >= 50_000_000
+    assert any(e["attrs"].get("src") == 1 for e in waits)
+
+
+def test_chrome_export_shape(tmp_path):
+    run_local(_coll_mix, 2, trace=True)
+    rec = telemetry.disable()
+    path = telemetry.export_chrome(str(tmp_path / "t.json"), rec)
+    doc = json.load(open(path))
+    assert doc["mpi_tpu"]["events_total"] == rec.events_total
+    spans = [e for e in doc["traceEvents"] if e.get("ph") == "X"]
+    assert spans and all("dur" in e and "ts" in e for e in spans)
+    assert any(e.get("ph") == "M" for e in doc["traceEvents"])
+    # export_to_dir: the per-rank filename contract tracecat globs
+    rec.trace_dir = str(tmp_path / "d")
+    out = rec.export_to_dir()
+    assert os.path.basename(out).startswith("trace.r")
+    assert tracecat.load_traces([str(tmp_path / "d")])
+
+
+def test_export_chrome_without_recorder_raises(monkeypatch):
+    monkeypatch.setattr(telemetry, "_LAST", None)  # nothing ever traced
+    with pytest.raises(RuntimeError, match="enable tracing"):
+        telemetry.export_chrome("/tmp/never.json")
+
+
+# -- cross-rank clock alignment (tools/tracecat.py) ---------------------------
+
+
+def _frame_evt(name, ts, **args):
+    return {"pid": 0, "tid": 1, "name": name, "cat": "frame",
+            "ph": "i", "ts": ts, "args": args}
+
+
+def _mk_doc(rank, events):
+    return {"traceEvents": events, "displayTimeUnit": "ms",
+            "_path": f"trace.r{rank}.json",
+            "mpi_tpu": {"rank": rank, "pid": 1000 + rank,
+                        "wall_anchor_ns": 0, "mono_anchor_ns": 0,
+                        "events_total": len(events), "dropped": 0,
+                        "capacity": 64}}
+
+
+def test_alignment_recovers_known_offsets():
+    """Two ranks whose exported clocks disagree by a known constant:
+    matched frames recover the offset and no aligned frame arrives
+    before it was sent."""
+    true_off1 = 500.0  # rank 1's clock reads 500us BEHIND rank 0's
+    lat = 10.0
+    d0, d1 = [], []
+    for seq, t in ((1, 1000.0), (2, 2000.0)):
+        d0.append(_frame_evt("send", t, dest=1, seq=seq))
+        d1.append(_frame_evt("recv", t + lat - true_off1, src=0, seq=seq))
+    for seq, t in ((1, 1500.0), (2, 2500.0)):
+        d1.append(_frame_evt("send", t - true_off1, dest=0, seq=seq))
+        d0.append(_frame_evt("recv", t + lat, src=1, seq=seq))
+    docs = [_mk_doc(0, d0), _mk_doc(1, d1)]
+    offsets = tracecat.estimate_offsets(docs)
+    assert offsets[0] == 0.0
+    assert offsets[1] == pytest.approx(true_off1, abs=lat)
+    assert tracecat.negative_latency_frames(docs, offsets) == 0
+
+
+def test_alignment_monotone_and_triangle_repair():
+    """Three ranks with ASYMMETRIC latencies: the pairwise midpoints
+    are triangle-inconsistent, the projection pass still lands inside
+    every bracket (zero negative-latency frames), and each rank's own
+    event ORDER survives the merge (constant per-rank shift)."""
+    true_off = {0: 0.0, 1: 300.0, 2: -200.0}
+    docs_ev = {0: [], 1: [], 2: []}
+    lat_ab, lat_ba = 5.0, 80.0  # asymmetric: midpoints disagree
+    seq = 0
+    for a in range(3):
+        for b in range(3):
+            if a == b:
+                continue
+            for k in range(3):
+                seq += 1
+                t = 1000.0 * seq
+                lat = lat_ab if a < b else lat_ba
+                docs_ev[a].append(_frame_evt(
+                    "send", t - true_off[a], dest=b, seq=seq))
+                docs_ev[b].append(_frame_evt(
+                    "recv", t + lat - true_off[b], src=a, seq=seq))
+    docs = [_mk_doc(r, evs) for r, evs in docs_ev.items()]
+    merged = tracecat.merge(docs)
+    meta = merged["mpi_tpu"]
+    assert meta["negative_latency_frames"] == 0
+    assert len(meta["ranks"]) == 3
+    # per-rank monotonicity: a constant shift preserves each rank's
+    # own event order
+    ts_by_rank = {}
+    for doc in docs:
+        r = doc["mpi_tpu"]["rank"]
+        ts_by_rank[r] = [e["ts"] for e in doc["traceEvents"]]
+    off = {int(k): v for k, v in meta["offsets_us"].items()}
+    for r, series in ts_by_rank.items():
+        shifted = [t + off[r] for t in series]
+        assert shifted == sorted(shifted)
+
+
+def test_tracecat_cli_report_and_merge(tmp_path):
+    d0 = [_frame_evt("send", 100.0, dest=1, seq=1)]
+    d1 = [_frame_evt("recv", 105.0, src=0, seq=1)]
+    for r, evs in ((0, d0), (1, d1)):
+        doc = _mk_doc(r, evs)
+        doc.pop("_path")
+        with open(tmp_path / f"trace.r{r}.1.json", "w") as f:
+            json.dump(doc, f)
+    assert tracecat.main([str(tmp_path), "--report"]) == 0
+    out = tmp_path / "merged.json"
+    assert tracecat.main([str(tmp_path), "-o", str(out)]) == 0
+    doc = json.load(open(out))
+    assert len(doc["mpi_tpu"]["ranks"]) == 2
+    # re-running does not double events (merged.json not globbed)
+    assert tracecat.main([str(tmp_path), "-o", str(out)]) == 0
+    assert len(json.load(open(out))["traceEvents"]) == len(
+        doc["traceEvents"])
+
+
+# -- histogram pvars ----------------------------------------------------------
+
+
+def test_histogram_record_read_quantile():
+    name = "t_test_hist_s"
+    mpit.pvar_hist_reset(name)
+    for _ in range(100):
+        mpit.hist_record(name, 1e-3)
+    mpit.hist_record(name, 1.0)
+    snap = mpit.pvar_hist_read(name)
+    assert snap["count"] == 101
+    assert snap["sum_s"] == pytest.approx(1.1, rel=0.05)
+    assert snap["min_s"] == pytest.approx(1e-3, rel=0.01)
+    assert snap["max_s"] == pytest.approx(1.0, rel=0.01)
+    # log-bucket estimate: within the documented ~41% relative error
+    p50 = mpit.hist_quantile(name, 0.5)
+    assert 0.5e-3 <= p50 <= 2e-3, p50
+    p100 = mpit.hist_quantile(name, 1.0)
+    assert 0.5 <= p100 <= 1.0, p100
+    cum = mpit.hist_cumulative(name)
+    counts = [c for _, c in cum]
+    assert counts == sorted(counts) and counts[-1] == 101
+    bounds = [b for b, _ in cum]
+    assert bounds == sorted(bounds)
+    mpit.pvar_hist_reset(name)
+    assert mpit.hist_quantile(name, 0.5) is None
+
+
+def test_histogram_unknown_name_raises():
+    with pytest.raises(KeyError, match="unknown histogram"):
+        mpit.pvar_hist_read("no_such_hist")
+    with pytest.raises(ValueError, match="quantile"):
+        mpit.hist_quantile("coll_latency_s", 1.5)
+
+
+def test_histogram_preseeded_names_stable():
+    for name in ("coll_latency_s", "lease_acquire_s", "link_heal_s"):
+        assert name in mpit.pvar_hist_list()
+
+
+def test_coll_latency_histogram_fed_by_traced_run():
+    mpit.pvar_hist_reset("coll_latency_s")
+    run_local(_coll_mix, 2, trace=True)
+    telemetry.disable()
+    assert mpit.pvar_hist_read("coll_latency_s")["count"] == 8  # 4 x 2
+
+
+# -- profiling.CommStats (satellite: no longer dead API) ----------------------
+
+
+def test_comm_stats_filled_by_traced_run():
+    from mpi_tpu import profiling
+
+    run_local(_coll_mix, 3, trace=True)
+    telemetry.disable()
+    stats = profiling.comm_stats()
+    assert stats is not None
+    assert stats.ops["allreduce"] == 3 and stats.ops["barrier"] == 3
+    assert stats.bytes["allreduce"] == 3 * 8 * 8
+    json.loads(stats.to_json())
+
+
+# -- Prometheus renderer ------------------------------------------------------
+
+
+def test_prometheus_text_render():
+    mpit.pvar_hist_reset("lease_acquire_s")
+    mpit.hist_record("lease_acquire_s", 2e-3)
+    mpit.hist_record("lease_acquire_s", 4e-3)
+    stats = {"epoch": 3, "pool_size": 4, "idle": 2, "leases_active": 1,
+             "worlds_per_s": 12.5, "uptime_s": 60.0,
+             "leases_granted": 9, "jobs_ok": 7, "jobs_failed": 2,
+             "heals_completed": 1, "workers_lost": 1,
+             "workers": {0: "idle", 1: "leased"},
+             "healing": [2], "worker_pvars": {"link_reconnects": 5}}
+    text = tmetrics.prometheus_text(stats)
+    assert "mpi_tpu_serve_epoch 3" in text
+    assert "mpi_tpu_serve_worlds_per_s 12.5" in text
+    assert "mpi_tpu_serve_jobs_ok_total 7" in text
+    assert 'mpi_tpu_serve_worker_state{slot="1",state="leased"} 1' in text
+    assert "mpi_tpu_serve_healing_slots 1" in text
+    assert 'mpi_tpu_worker_pvar{name="link_reconnects"} 5' in text
+    assert 'mpi_tpu_lease_acquire_seconds_bucket{le="+Inf"} 2' in text
+    assert "mpi_tpu_lease_acquire_seconds_count 2" in text
+    assert "mpi_tpu_serve_lease_acquire_p99_seconds" in text
+    # every line is exposition-format shaped
+    for line in text.strip().splitlines():
+        assert line.startswith("#") or " " in line
+
+
+def test_verify_overhead_trace_leg_quick_smoke():
+    """The CLI overhead contract (``bench.py --verify-overhead --trace
+    --quick``): trace-off is pvar-zero, trace-on keeps 0 pickled array
+    bytes and an unchanged payload-copy count — asserted inside the
+    bench itself."""
+    from benchmarks import verify_overhead
+
+    assert verify_overhead.main(["--quick", "--trace"]) == 0
+
+
+# -- serve: stats + scrape stay live under a kill -----------------------------
+
+
+def test_stats_and_scrape_survive_kill_mid_lease():
+    """THE endpoint acceptance: while a leased worker dies and the pool
+    heals, a SECOND client's ``stats()`` keeps answering promptly
+    (the monitor thread never wedges behind a scrape) and the HTTP
+    metrics endpoint keeps serving worlds/s + lease p99 + pool epoch."""
+    srv = serve.WorldServer(pool_size=3, backend="socket",
+                            detect_timeout_s=DETECT_S, heartbeat_s=0.2,
+                            rejoin_timeout_s=20.0, metrics_port=0)
+    with srv:
+        assert srv.metrics_addr
+        worker = serve.connect(srv)
+        watcher = serve.connect(srv)
+        try:
+            stop = threading.Event()
+            stats_lat, stats_errs = [], []
+
+            def hammer():
+                while not stop.is_set():
+                    t0 = time.monotonic()
+                    try:
+                        st = watcher.stats()
+                        assert "epoch" in st
+                    except Exception as e:  # noqa: BLE001
+                        stats_errs.append(repr(e))
+                    stats_lat.append(time.monotonic() - t0)
+                    time.sleep(0.05)
+
+            th = threading.Thread(target=hammer, daemon=True)
+            th.start()
+            lease = worker.acquire(2, timeout=10.0)
+            from mpi_tpu.errors import ProcFailedError
+            with pytest.raises(ProcFailedError):
+                lease.run(serve.job_kill_rank, 1, 2048,
+                          timeout=3 * DETECT_S + LOAD_MARGIN_S)
+            lease.release()
+            # scrape WHILE healing (and after): always answers
+            deadline = time.monotonic() + 30.0 + LOAD_MARGIN_S
+            healed = False
+            while time.monotonic() < deadline:
+                body = urllib.request.urlopen(
+                    f"http://{srv.metrics_addr}/metrics",
+                    timeout=5).read().decode()
+                assert "mpi_tpu_serve_epoch" in body
+                assert "mpi_tpu_serve_worlds_per_s" in body
+                assert "mpi_tpu_serve_lease_acquire_p99_seconds" in body
+                st = watcher.stats()
+                if st["idle"] == 3 and not st["healing"]:
+                    healed = True
+                    break
+                time.sleep(0.25)
+            stop.set()
+            th.join(10.0)
+            assert healed, "pool did not heal under the watcher"
+            assert not stats_errs, stats_errs
+            assert stats_lat and max(stats_lat) < 10.0, max(stats_lat)
+            final = watcher.stats()
+            assert final["epoch"] >= 1
+            assert final["lease_acquire_p99_ms"] is not None
+        finally:
+            worker.close()
+            watcher.close()
