@@ -568,6 +568,13 @@ if __name__ == "__main__":
         # resets, and that a genuine SIGKILL is still diagnosed within
         # the detection bound; --no-healing is the honest "pre" run
         # (link_retry_timeout_s=0, the same resets terminal).
+        # --federation (ISSUE 15) swaps in the federated-serve leg:
+        # SIGKILL servers of an N-server federation under an open-loop
+        # client fleet — worlds/s never zero, every failure named,
+        # orphans adopted, no leader-authority overlap, plus the
+        # beyond-capacity admission-control leg; --pre is the honest
+        # single-server baseline dying to zero (the committed
+        # federation_{pre,post}.json artifacts).
         from benchmarks import chaos
 
         args = ["--quick"] if "--quick" in sys.argv[1:] else []
@@ -575,6 +582,10 @@ if __name__ == "__main__":
             args.append("--serve")
         if "--links" in sys.argv[1:]:
             args.append("--links")
+        if "--federation" in sys.argv[1:]:
+            args.append("--federation")
+        if "--pre" in sys.argv[1:]:
+            args.append("--pre")
         if "--no-healing" in sys.argv[1:]:
             args.append("--no-healing")
         if "--trace-dir" in sys.argv[1:]:

@@ -637,6 +637,419 @@ def _merge_links_trace(trace_dir: str) -> Dict:
     }
 
 
+# -- federated serve fabric chaos (ISSUE 15): server kill-storms --------------
+
+
+def _spawn_fed_server(idx: int, ns: str, logdir: str, pool: int,
+                      federated: bool = True,
+                      max_pending: int = 32) -> Dict:
+    """One ``launcher serve`` subprocess (real process: the storm
+    SIGKILLs it).  Returns {proc, addr_file, log, id}."""
+    import subprocess
+
+    addr_file = os.path.join(logdir, f"server{idx}.addr")
+    log = open(os.path.join(logdir, f"server{idx}.log"), "wb")
+    argv = [sys.executable, "-m", "mpi_tpu.launcher", "serve",
+            "--pool-size", str(pool), "--addr-file", addr_file,
+            "--detect-timeout", "1.5", "--heartbeat", "0.2",
+            "--lease-timeout", "6", "--rejoin-timeout", "15",
+            "--max-pending", str(max_pending),
+            "--server-id", f"srv{idx}"]
+    if federated:
+        argv += ["--federation", ns, "--fed-lease-timeout", "2.0",
+                 "--orphan-timeout", "30"]
+    proc = subprocess.Popen(argv, cwd=REPO,
+                            env=dict(os.environ, JAX_PLATFORMS="cpu"),
+                            stdout=log, stderr=log)
+    return {"proc": proc, "addr_file": addr_file, "log": log,
+            "id": f"srv{idx}"}
+
+
+_FED_NAMED = None  # lazily-built tuple of acceptable named error classes
+
+
+def _fed_named_errors():
+    global _FED_NAMED
+    if _FED_NAMED is None:
+        from mpi_tpu.errors import EpochSkewError, ServerBusyError
+        from mpi_tpu.serve import ServerLostError
+
+        # deliberately NO blanket OSError: ServerClient wraps raw
+        # socket errors into ServerLostError, and a raw
+        # ConnectionResetError leaking through is exactly the
+        # anonymous-crash class this gate exists to catch
+        _FED_NAMED = (ProcFailedError, RevokedError, EpochSkewError,
+                      RecvTimeout, ServerLostError, TransportError,
+                      TimeoutError, ServerBusyError)
+    return _FED_NAMED
+
+
+def _fed_client_loop(make_client, deadline: float, t0: float,
+                     outcomes: List[Dict], lock, rng,
+                     think_s: float) -> None:
+    """One open-loop client: its OWN connect() handle, cycling
+    acquire → allreduce → release until the deadline; every cycle's
+    outcome recorded (ok / diagnosed:<named> / error:<unnamed>).
+    Open-loop approximation: a fixed per-client think time independent
+    of completions — offered load does not back off when the fabric
+    degrades, which is exactly what exposes an unbounded queue."""
+    from mpi_tpu import serve as _serve
+
+    client = None
+    while time.monotonic() < deadline:
+        t_cycle = time.monotonic()
+        try:
+            if client is None:
+                client = make_client()
+            got = client.run(_serve.job_allreduce, 128, nranks=1,
+                             timeout=6.0)
+            outcome = "ok" if got == 1.0 else f"wrong_result:{got}"
+        except _fed_named_errors() as e:
+            outcome = f"diagnosed:{type(e).__name__}"
+            try:
+                if client is not None:
+                    client.close()
+            except Exception:  # noqa: BLE001 - teardown of a dead handle
+                pass
+            client = None
+        except Exception as e:  # noqa: BLE001 - the failing verdict
+            outcome = f"error:{type(e).__name__}: {str(e)[:120]}"
+        with lock:
+            outcomes.append(
+                {"at_s": round(t_cycle - t0, 2), "outcome": outcome})
+        time.sleep(rng.uniform(0.2, 1.0) * think_s)
+    if client is not None:
+        try:
+            client.close()
+        except Exception:  # noqa: BLE001
+            pass
+
+
+def run_federation_chaos(quick: bool = False, pre: bool = False) -> Dict:
+    """The federated-serve kill-storm leg (ISSUE 15 acceptance):
+    N >= 2 ``launcher serve --federation NS`` subprocess servers, an
+    open-loop fleet of concurrent ``connect()`` clients churning
+    1-rank leases, and SIGKILL fired into the server set mid-run.
+    Contract (post):
+
+    * aggregate worlds/s NEVER reaches zero — every observation window
+      completes >= 1 world (clients fail over to survivors while the
+      leader reassigns the dead server's pool);
+    * every client-visible failure is a NAMED error — ServerLostError /
+      TransportError / TimeoutError / ServerBusyError / the FT family —
+      never an anonymous crash or hang;
+    * the dead server's orphaned workers RE-REGISTER with a survivor
+      (the survivor's stats shows the adopted pool populated, and the
+      namespace roll-up converges back to every worker idle);
+    * the leader-interval log shows NO authority overlap (the
+      split-brain assertion), and a final cross-server lease completes
+      correctly.
+
+    ``pre=True`` is the honest baseline: ONE non-federated server under
+    the same load, killed mid-run — throughput goes to zero and stays
+    there (windows after the kill complete nothing), which is exactly
+    the SPOF this PR removes.  Committed as
+    benchmarks/results/federation_{pre,post}.json."""
+    import shutil
+    import signal as _signal
+    import tempfile
+    import threading
+
+    from mpi_tpu import federation as _federation
+    from mpi_tpu import serve as _serve
+
+    nservers = 1 if pre else (2 if quick else 3)
+    pool = 2
+    nclients = 6 if quick else 24
+    duration_s = 10.0 if quick else 24.0
+    window_s = 2.5 if quick else 4.0
+    think_s = 0.25
+    # kill times (fractions of the run); always leave >= 1 survivor in
+    # the post leg — the pre leg's whole point is killing the only one
+    kill_at = [0.3] if (quick or pre) else [0.25, 0.55]
+    rng = __import__("random").Random(4321)
+    t_start = time.time()
+    ns = tempfile.mkdtemp(prefix="mpi_tpu_fed_ns_")
+    logdir = tempfile.mkdtemp(prefix="mpi_tpu_fed_log_")
+    servers = [_spawn_fed_server(i, ns, logdir, pool,
+                                 federated=not pre)
+               for i in range(nservers)]
+    outcomes: List[Dict] = []
+    out_lock = threading.Lock()
+    result: Dict = {
+        "quick": quick, "leg": "pre" if pre else "post",
+        "servers": nservers, "pool_per_server": pool,
+        "clients": nclients, "duration_s": duration_s,
+        "open_loop_think_s": think_s,
+        "oversubscribed":
+            (nservers * (pool + 1) + 2) > (os.cpu_count() or 1),
+    }
+    try:
+        # wait for every server to publish its address (and, post leg,
+        # its federation endpoint record)
+        deadline_up = time.monotonic() + 120.0
+        addrs = []
+        for s in servers:
+            while not os.path.exists(s["addr_file"]):
+                if s["proc"].poll() is not None:
+                    raise RuntimeError(
+                        f"server {s['id']} died at startup")
+                if time.monotonic() > deadline_up:
+                    raise RuntimeError("servers never published addrs")
+                time.sleep(0.1)
+            with open(s["addr_file"]) as f:
+                addrs.append(f.read().strip())
+        if not pre:
+            while len([r for r in
+                       _federation.read_server_records(ns).values()
+                       if _federation.record_live(r)]) < nservers:
+                if time.monotonic() > deadline_up:
+                    raise RuntimeError("servers never joined namespace")
+                time.sleep(0.1)
+
+        def make_client():
+            if pre:
+                return _federation.FederatedClient(
+                    addrs=list(addrs), failover_timeout_s=4.0)
+            return _federation.FederatedClient(
+                namespace=ns, failover_timeout_s=4.0)
+
+        t0 = time.monotonic()
+        deadline = t0 + duration_s
+        threads = [threading.Thread(
+            target=_fed_client_loop,
+            args=(make_client, deadline, t0, outcomes, out_lock,
+                  __import__("random").Random(1000 + i), think_s),
+            daemon=True) for i in range(nclients)]
+        for th in threads:
+            th.start()
+        kills = []
+        for frac in kill_at:
+            wait = t0 + frac * duration_s - time.monotonic()
+            if wait > 0:
+                time.sleep(wait)
+            live = [s for s in servers if s["proc"].poll() is None]
+            if len(live) > (0 if pre else 1):
+                victim = rng.choice(live if pre else live[:-1])
+                try:
+                    os.kill(victim["proc"].pid, _signal.SIGKILL)
+                    kills.append({"id": victim["id"],
+                                  "at_s": round(time.monotonic() - t0,
+                                                2)})
+                except OSError:
+                    pass
+        for th in threads:
+            th.join(timeout=max(5.0, deadline - time.monotonic() + 30.0))
+        result["kills"] = kills
+
+        completed = [o for o in outcomes if o["outcome"] == "ok"]
+        bad = [o for o in outcomes
+               if o["outcome"].startswith(("wrong_result", "error"))]
+        nwin = max(1, int(duration_s // window_s))
+        windows = [0] * nwin
+        for o in completed:
+            windows[min(nwin - 1, int(o["at_s"] // window_s))] += 1
+        result.update({
+            "cycles": len(outcomes),
+            "completed_worlds": len(completed),
+            "worlds_per_s": round(len(completed) / duration_s, 2),
+            "windows_completed": windows,
+            "diagnosed": sorted({o["outcome"] for o in outcomes
+                                 if o["outcome"].startswith("diagnosed")}),
+            "unnamed_failures": bad[:50],
+        })
+
+        if pre:
+            # the baseline's contract is the CONTRAST: the kill drains
+            # throughput to zero and it never comes back
+            kill_t = kills[0]["at_s"] if kills else duration_s
+            dead_windows = [w for i, w in enumerate(windows)
+                            if i * window_s > kill_t + window_s]
+            result.update({
+                "windows_after_kill_zero":
+                    bool(dead_windows) and all(w == 0
+                                               for w in dead_windows),
+                "ok": (not bad and bool(kills) and bool(dead_windows)
+                       and all(w == 0 for w in dead_windows)),
+            })
+            return result
+
+        # post: the fabric must CONVERGE — orphans re-registered with a
+        # survivor, every worker idle again, and a cross-server lease
+        # correct.  Poll the namespace roll-up.
+        expect_workers = nservers * pool
+        heal_deadline = time.monotonic() + 45.0
+        healed = False
+        rollup = {}
+        while time.monotonic() < heal_deadline:
+            rollup = _federation.federation_stats(ns)
+            if rollup.get("workers") == expect_workers \
+                    and rollup.get("idle") == expect_workers:
+                healed = True
+                break
+            time.sleep(0.5)
+        orphans = 0
+        adopted_pools = 0
+        final_ok = False
+        try:
+            with make_client() as client:
+                st = client.stats()
+                for sid, rec in (st.get("federation", {})
+                                 .get("servers", {})).items():
+                    if rec.get("live") and rec.get("pools", 0) > 1:
+                        adopted_pools += rec["pools"] - 1
+                orphans = st.get("orphans_reregistered", 0)
+                final_ok = client.run(_serve.job_allreduce, 128,
+                                      nranks=2, timeout=15.0) == 3.0
+        except Exception as e:  # noqa: BLE001 - recorded below
+            result["final_error"] = f"{type(e).__name__}: {str(e)[:200]}"
+        overlap_ok, overlap_err = True, None
+        try:
+            _federation.assert_no_leader_overlap(ns)
+        except AssertionError as e:
+            overlap_ok, overlap_err = False, str(e)
+        result.update({
+            "healed_to_full_strength": healed,
+            "rollup": {k: rollup.get(k) for k in
+                       ("servers_live", "workers", "idle", "pools",
+                        "leader")},
+            "adopted_pools_visible": adopted_pools,
+            "orphans_reregistered_on_polled_server": orphans,
+            "final_cross_server_allreduce_ok": final_ok,
+            "no_leader_overlap": overlap_ok,
+            "leader_overlap_error": overlap_err,
+            "ok": (not bad and bool(kills) and healed and final_ok
+                   and overlap_ok and adopted_pools >= 1
+                   and all(w > 0 for w in windows)),
+        })
+        return result
+    finally:
+        for s in servers:
+            if s["proc"].poll() is None:
+                s["proc"].kill()
+        for s in servers:
+            try:
+                s["proc"].wait(10.0)
+            except Exception:  # noqa: BLE001
+                pass
+            s["log"].close()
+        result["wall_s"] = round(time.time() - t_start, 1)
+        shutil.rmtree(ns, ignore_errors=True)
+        shutil.rmtree(logdir, ignore_errors=True)
+
+
+def run_federation_saturation(quick: bool = False) -> Dict:
+    """The admission-control leg (ISSUE 15 acceptance): offered load
+    beyond capacity against ONE server with a SMALL bounded admission
+    queue.  Contract: queue depth never exceeds the bound, the excess
+    is rejected with NAMED ServerBusyError (no unbounded latency), and
+    an in-bound prioritized client keeps completing leases at its fair
+    share throughout the flood."""
+    import threading
+
+    from mpi_tpu import serve as _serve
+    from mpi_tpu.errors import ServerBusyError
+
+    pool, max_pending = 2, 3
+    duration_s = 5.0 if quick else 10.0
+    nflood = 8
+    t0_wall = time.time()
+    counts = {"flood_ok": 0, "flood_busy": 0, "flood_timeout": 0,
+              "good_ok": 0, "good_busy": 0}
+    max_waiting_seen = [0]
+    lock = threading.Lock()
+    with _serve.WorldServer(pool_size=pool, backend="socket",
+                            detect_timeout_s=1.5, heartbeat_s=0.2,
+                            world_lease_timeout_s=8.0,
+                            max_pending=max_pending) as srv:
+        stop = [False]
+
+        def flood():
+            client = _serve.connect(srv)
+            while not stop[0]:
+                try:
+                    lease = client.acquire(1, timeout=1.5)
+                    try:
+                        lease.run(_serve.job_sleep, 0.15, timeout=6.0)
+                        with lock:
+                            counts["flood_ok"] += 1
+                    finally:
+                        lease.release()
+                except ServerBusyError:
+                    with lock:
+                        counts["flood_busy"] += 1
+                    time.sleep(0.05)
+                except TimeoutError:
+                    with lock:
+                        counts["flood_timeout"] += 1
+                except Exception:  # noqa: BLE001 - teardown race
+                    if stop[0]:
+                        break
+                    raise
+            client.close()
+
+        def good():
+            client = _serve.connect(srv, priority=1)
+            while not stop[0]:
+                try:
+                    lease = client.acquire(1, timeout=6.0)
+                    try:
+                        lease.run(_serve.job_sleep, 0.02, timeout=6.0)
+                        with lock:
+                            counts["good_ok"] += 1
+                    finally:
+                        lease.release()
+                except ServerBusyError:
+                    with lock:
+                        counts["good_busy"] += 1
+                    time.sleep(0.05)
+                except Exception:  # noqa: BLE001 - teardown race
+                    if stop[0]:
+                        break
+                    raise
+                time.sleep(0.05)
+            client.close()
+
+        def sampler():
+            while not stop[0]:
+                st = srv.stats()
+                with lock:
+                    max_waiting_seen[0] = max(max_waiting_seen[0],
+                                              st["waiting"])
+                time.sleep(0.05)
+
+        threads = [threading.Thread(target=flood, daemon=True)
+                   for _ in range(nflood)]
+        threads += [threading.Thread(target=good, daemon=True),
+                    threading.Thread(target=sampler, daemon=True)]
+        for th in threads:
+            th.start()
+        time.sleep(duration_s)
+        stop[0] = True
+        for th in threads:
+            th.join(timeout=20.0)
+        st = srv.stats()
+    # the fair-share floor: the prioritized client must keep landing
+    # leases while 8 flooders hammer a 2-slot pool (each good cycle is
+    # ~0.1s of work; 1/s is far below its entitled share but far above
+    # the zero a starved client would show)
+    good_floor = max(2, int(duration_s * 1.0))
+    result = {
+        "quick": quick, "pool": pool, "max_pending": max_pending,
+        "flood_clients": nflood, "duration_s": duration_s,
+        **counts,
+        "busy_rejected_total": st["busy_rejected"],
+        "max_waiting_seen": max_waiting_seen[0],
+        "good_client_floor": good_floor,
+        "oversubscribed": (pool + 2) > (os.cpu_count() or 1),
+        "ok": (st["busy_rejected"] > 0
+               and max_waiting_seen[0] <= max_pending
+               and counts["good_ok"] >= good_floor),
+        "wall_s": round(time.time() - t0_wall, 1),
+    }
+    return result
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--quick", action="store_true",
@@ -661,10 +1074,28 @@ def main(argv=None) -> int:
                          "the flight recorder and merge the per-rank "
                          "Chrome traces into DIR/chaos_links_trace."
                          "json (tools/tracecat.py)")
+    ap.add_argument("--federation", action="store_true",
+                    help="federated-serve leg (ISSUE 15): SIGKILL "
+                         "servers of an N-server federation under an "
+                         "open-loop client fleet; asserts worlds/s "
+                         "never zero, every failure named, orphaned "
+                         "workers adopted by a survivor, and no "
+                         "leader-authority overlap — plus the "
+                         "beyond-capacity saturation/admission leg")
+    ap.add_argument("--pre", action="store_true",
+                    help="(with --federation) the honest baseline: ONE "
+                         "non-federated server under the same load, "
+                         "killed mid-run — throughput dies to zero")
     ap.add_argument("--backend", choices=("socket", "shm"),
                     default="socket")
     args = ap.parse_args(argv)
-    if args.links:
+    if args.federation:
+        result = run_federation_chaos(quick=args.quick, pre=args.pre)
+        if not args.pre:
+            result["saturation"] = run_federation_saturation(
+                quick=args.quick)
+            result["ok"] = result["ok"] and result["saturation"]["ok"]
+    elif args.links:
         result = run_links_chaos(quick=args.quick,
                                  healing=not args.no_healing,
                                  trace_dir=args.trace_dir)

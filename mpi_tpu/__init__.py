@@ -47,17 +47,23 @@ from .window import GetFuture, P2PWindow
 from .membership import rejoin
 
 
-def connect(addr, timeout: float = 30.0):
+def connect(addr, timeout: float = 30.0, priority: int = 0):
     """Connect to a resident world server (mpi_tpu/serve.py): returns a
     :class:`~mpi_tpu.serve.ServerClient` whose ``acquire(nranks)``
     leases a warm world in one round-trip.  ``addr`` is "host:port", a
     (host, port) tuple, an in-process WorldServer, or the path to a
-    ``serve --addr-file`` file.  Lazy import: the serve module is also
-    the worker entry point (``python -m mpi_tpu.serve``), so the
-    package must not pre-import it."""
+    ``serve --addr-file`` file (a missing/partially-written file is
+    retried within the connect budget).  A path to a DIRECTORY (a
+    ``serve --federation`` namespace) or a list of "host:port" strings
+    returns a :class:`~mpi_tpu.federation.FederatedClient` instead,
+    which resolves live servers and fails over on server death.
+    ``priority`` feeds the server's fair-share lease scheduler.  Lazy
+    import: the serve module is also the worker entry point
+    (``python -m mpi_tpu.serve``), so the package must not pre-import
+    it."""
     from . import serve as _serve
 
-    return _serve.connect(addr, timeout=timeout)
+    return _serve.connect(addr, timeout=timeout, priority=priority)
 
 __all__ = [
     "__version__", "ops", "ReduceOp",

@@ -35,6 +35,7 @@ __all__ = [
     "ProcFailedError", "RevokedError",
     "EpochSkewError", "RejoinRefusedError",
     "DeadlockError", "CollectiveMismatchError",
+    "ServerBusyError",
     "error_class", "error_string",
 ]
 
@@ -150,6 +151,17 @@ class RejoinRefusedError(RuntimeError):
     FRESH incarnation (or wait for acknowledgement)."""
 
 
+class ServerBusyError(RuntimeError):
+    """Admission-control rejection from a resident world server
+    (mpi_tpu/serve.py): the server's acquire queue is at its bounded
+    depth (``max_pending``), so instead of joining an unboundedly long
+    wait the request is rejected IMMEDIATELY with this named error —
+    the client should back off, retry, or fail over to another
+    federation member.  Sustained overload therefore degrades into
+    explicit, named rejections with bounded queueing latency for the
+    admitted requests, never into silent multi-minute acquire tails."""
+
+
 class DeadlockError(RuntimeError):
     """The runtime verifier (mpi_tpu/verify) proved a wait-for
     cycle/knot: every rank in ``ranks`` is blocked, and none of their
@@ -263,6 +275,11 @@ def error_class(exc: Any) -> int:
     if isinstance(exc, DeadlockError):
         return MPI_ERR_PENDING  # operations pending forever: the closest class
     if isinstance(exc, CollectiveMismatchError):
+        return MPI_ERR_OTHER
+    if isinstance(exc, ServerBusyError):
+        # overload is a transient resource condition, not an argument
+        # error: the caller's request was well-formed and may succeed
+        # on retry/failover — the generic class is the honest one
         return MPI_ERR_OTHER
     from .transport.base import RecvTimeout  # local import: no cycle at load
 

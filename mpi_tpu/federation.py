@@ -1,0 +1,898 @@
+"""Federated serve fabric: leader election, pool takeover, failover.
+
+ISSUE 15 tentpole — the last single point of failure in the stack.
+``launcher serve`` (mpi_tpu/serve.py) survives any WORKER death, but the
+server process itself was one process fronting one warm pool: kill it
+and every client, lease, and worker orphans.  This module federates N
+servers over a shared **namespace directory** (the Ray-GCS /
+ZooKeeper-lease shape, rebuilt on the FileBoard lock idiom this repo
+already trusts — O_EXCL claim + mtime-renewed lease + stale takeover):
+
+* **Endpoint records** — every server renews ``server.<id>.json``
+  (pid, control addr, metrics addr, a light stats summary) each tick;
+  a record whose pid is dead or whose renewal is stale past the lease
+  bound IS a dead server.
+* **Leader election** (:class:`LeaderLease`) — one ``leader.lease``
+  file, acquired with an atomic ``O_EXCL`` create and renewed by
+  ``os.utime`` ONLY (the content — holder id, pid, term — is immutable
+  per acquisition, so ownership is never ambiguous); a lease whose
+  mtime is stale past ``lease_timeout_s`` is taken over (read term →
+  unlink → O_EXCL create with term+1; two racing takeovers both unlink
+  — idempotent — and the create arbitrates).  The safety half: a
+  holder's AUTHORITY expires ``validity_s = lease_timeout_s/2`` after
+  its last successful renew, strictly before any takeover can fire, so
+  a leader frozen past the bound (SIGSTOP, the PR-10 rank-freeze story
+  at the server tier) has provably lapsed before its usurper begins —
+  and on thaw its next renew sees foreign content and DEMOTES.  Every
+  acquire/renew appends a ``[from, until]`` authority interval to an
+  append-only per-server log; :func:`assert_no_leader_overlap` is the
+  split-brain assertion the tests run.
+* **Pool takeover** — the leader watches the endpoint records; a dead
+  server's pools (``pool.<id>.json`` ownership records) are assigned
+  to the least-loaded survivor via a ``takeover.<dead>.json``
+  assignment.  The survivor adopts the pool (serve.py grows multi-pool
+  bookkeeping), rewrites the ownership record, and the dead server's
+  ORPHANED WORKERS — whose transports, arenas, and FT detectors are
+  all still warm — re-register with it over the control channel
+  (:func:`wait_pool_owner` is the worker-side resolve).  Worker-level
+  healing on an adopted pool rides the existing announce/claim/admit
+  rejoin protocol against the adopted rendezvous dir unchanged.
+  Double-serving is structurally excluded: a worker serves exactly one
+  master at a time (its control connection is the token), and a thawed
+  ex-owner that finds a newer ownership record relinquishes — closing
+  those connections is precisely what releases the workers to the
+  usurper.
+* **Client failover** (:class:`FederatedClient`) — ``mpi_tpu.connect``
+  grows a server-list / namespace-dir mode: acquire and stats re-resolve
+  live endpoints and retry with backoff on a dead-server
+  ``ServerLostError`` (re-acquire is idempotent — a lease whose server
+  died, died with it); an in-flight ``lease.run`` surfaces the named
+  error instead of transparently re-running a possibly-side-effecting
+  job.
+* **Roll-up** (:func:`federation_stats`) — the per-server summaries in
+  the endpoint records aggregate into one namespace-level document, so
+  the PR-13 Prometheus endpoint stays truthful when pools move between
+  servers.
+
+Chaos: ``python bench.py --chaos --federation [--quick]`` SIGKILLs
+servers under an open-loop fleet of concurrent clients and asserts
+aggregate worlds/s never reaches zero with every failure named
+(committed ``benchmarks/results/federation_{pre,post}.json``; pre =
+the single-server run dying to zero).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+import uuid
+from typing import Any, Dict, List, Optional
+
+from . import resilience as _resilience
+from . import telemetry as _telemetry
+from .membership import _pid_alive, _read_json, _write_json
+from .transport.base import TransportError
+
+# One leadership/liveness knob: a leader lease (and a server endpoint
+# record) untouched for this long belongs to a dead or frozen process
+# and is taken over.  Authority self-expires at HALF this bound
+# (_VALIDITY_FRACTION), so an ex-holder's authority provably lapses
+# before any takeover can begin — the no-overlap invariant the
+# split-brain test asserts.  Per-server override: WorldServer
+# fed_lease_timeout_s / ``launcher serve --fed-lease-timeout``.
+_LEASE_TIMEOUT_S = 3.0
+_VALIDITY_FRACTION = 0.5
+
+# Endpoint records are judged dead a bit later than the leader lease
+# (renewals ride the same tick; the margin absorbs one missed tick
+# under load before a takeover storm starts).
+_SERVER_STALE_FACTOR = 1.5
+
+_TICK_S = 0.25          # federation member duty cadence
+_LEASE_FILE = "leader.lease"
+_OWNER_POLL_S = 0.1     # orphaned-worker resolve cadence
+
+# Client-side liveness filter for endpoint records: liberal (a dial
+# failure skips a dead candidate anyway); the pid check does the fast
+# discrimination on this single-host fabric.
+_CLIENT_RECORD_STALE_S = 10.0
+
+
+# -- namespace file helpers ---------------------------------------------------
+
+
+def _server_path(ns: str, sid: str) -> str:
+    return os.path.join(ns, f"server.{sid}.json")
+
+
+def _pool_path(ns: str, pool_id: str) -> str:
+    return os.path.join(ns, f"pool.{pool_id}.json")
+
+
+def _takeover_path(ns: str, sid: str) -> str:
+    return os.path.join(ns, f"takeover.{sid}.json")
+
+
+def _log_path(ns: str, sid: str) -> str:
+    return os.path.join(ns, f"leader.log.{sid}")
+
+
+def read_server_records(ns: str) -> Dict[str, dict]:
+    """All ``server.<id>.json`` endpoint records in the namespace."""
+    out: Dict[str, dict] = {}
+    try:
+        names = os.listdir(ns)
+    except OSError:
+        return out
+    for name in names:
+        if name.startswith("server.") and name.endswith(".json"):
+            rec = _read_json(os.path.join(ns, name))
+            if rec and rec.get("id"):
+                out[rec["id"]] = rec
+    return out
+
+
+def read_server_record(ns: str, sid: str) -> Optional[dict]:
+    return _read_json(_server_path(ns, sid))
+
+
+def read_leader(ns: str) -> Optional[dict]:
+    """The current ``leader.lease`` content (holder id/pid/term), or
+    None with no leader elected — a RELEASED lease (clean shutdown
+    left the file as a term tombstone) reads as no leader.  File
+    ownership only — whether the holder's AUTHORITY is still valid is
+    its own clock's business (LeaderLease.is_leader)."""
+    rec = _read_json(os.path.join(ns, _LEASE_FILE))
+    return None if rec is None or rec.get("released") else rec
+
+
+def record_live(rec: dict, now: Optional[float] = None,
+                stale_s: float = _CLIENT_RECORD_STALE_S) -> bool:
+    """Is this endpoint record's server alive?  Dead pid → dead NOW
+    (kill -9 detection is one stat); otherwise renewal staleness (the
+    frozen-server case: SIGSTOP keeps the pid but stops the renewals)."""
+    pid = rec.get("pid")
+    if pid is not None and not _pid_alive(int(pid)):
+        return False
+    now = time.time() if now is None else now
+    return now - float(rec.get("renewed_at", 0)) <= stale_s
+
+
+def write_pool_owner(ns: str, pool_id: str, owner: str, ctrl: str,
+                     rdv: str, backend: str, size: int, epoch: int,
+                     term: int, since: Optional[float] = None) -> None:
+    """Publish/replace the ownership record of one pool.  ``since`` is
+    the wall time ownership began — an ex-owner relinquishes on seeing
+    a record with a different owner and a ``since`` at or past its own
+    (the thawed-usurped-server demotion path)."""
+    _write_json(_pool_path(ns, pool_id), {
+        "pool": pool_id, "owner": owner, "ctrl": ctrl, "rdv": rdv,
+        "backend": backend, "size": int(size), "epoch": int(epoch),
+        "term": int(term),
+        "since": time.time() if since is None else float(since)})
+
+
+def read_pool_owner(ns: str, pool_id: str) -> Optional[dict]:
+    return _read_json(_pool_path(ns, pool_id))
+
+
+def read_pool_owners(ns: str) -> Dict[str, dict]:
+    out: Dict[str, dict] = {}
+    try:
+        names = os.listdir(ns)
+    except OSError:
+        return out
+    for name in names:
+        if name.startswith("pool.") and name.endswith(".json"):
+            rec = _read_json(os.path.join(ns, name))
+            if rec and rec.get("pool"):
+                out[rec["pool"]] = rec
+    return out
+
+
+def read_takeovers(ns: str) -> List[dict]:
+    out: List[dict] = []
+    try:
+        names = os.listdir(ns)
+    except OSError:
+        return out
+    for name in names:
+        if name.startswith("takeover.") and name.endswith(".json"):
+            rec = _read_json(os.path.join(ns, name))
+            if rec:
+                out.append(rec)
+    return out
+
+
+def wait_pool_owner(ns: str, pool_id: str, not_ctrl: Optional[str],
+                    timeout: float,
+                    stale_s: float = _CLIENT_RECORD_STALE_S
+                    ) -> Optional[str]:
+    """Orphaned-worker resolve: block until the pool's ownership record
+    names a control address other than ``not_ctrl`` (the address whose
+    ESTABLISHED registration just died; None excludes nothing — a
+    merely-unreachable owner may resolve again) and its owner's
+    endpoint record, when present, reads live — or the orphan budget
+    runs out (→ None: the worker exits rather than leak).  Each
+    death round passes its own just-dead address, so a chain of server
+    deaths keeps resolving forward."""
+    deadline = time.monotonic() + timeout
+    while True:
+        rec = read_pool_owner(ns, pool_id)
+        if rec is not None and rec.get("ctrl") and rec["ctrl"] != not_ctrl:
+            srv = read_server_record(ns, str(rec.get("owner")))
+            if srv is None or record_live(srv, stale_s=stale_s):
+                return rec["ctrl"]
+        if time.monotonic() > deadline:
+            return None
+        time.sleep(_OWNER_POLL_S)
+
+
+# -- the leader lease ---------------------------------------------------------
+
+
+class LeaderLease:
+    """File-lease leader election on the namespace dir (the FileBoard
+    ``pending.summary.lock`` idiom, grown the two properties an
+    AUTHORITY needs that a compaction lock does not):
+
+    * **bounded authority** — holding the file is necessary but not
+      sufficient; :meth:`is_leader` is true only within ``validity_s``
+      of the last *successful* renew, and ``validity_s`` is strictly
+      below the takeover bound, so a frozen holder's authority lapses
+      before a usurper's can begin;
+    * **immutable content per term** — the lease file is written only
+      by ``O_EXCL`` create; renewal is ``os.utime`` + an ownership
+      re-read on BOTH sides of it.  A thawed ex-holder's pending utime
+      can at worst extend a usurper's staleness clock (delaying the
+      next takeover — the conservative direction), never re-take the
+      file.  The residual race — a takeover's re-stat → unlink gap
+      straddled by a thawed holder's utime — is the same accepted
+      one-syscall window FileBoard._unlock documents.
+
+    Every acquire and renew appends the authority interval
+    ``[from, until]`` to ``leader.log.<id>`` (append-only, one writer
+    per file — no contention); :func:`assert_no_leader_overlap` checks
+    the whole namespace's history for the split-brain condition."""
+
+    def __init__(self, ns: str, owner_id: str,
+                 lease_timeout_s: float = _LEASE_TIMEOUT_S) -> None:
+        self.ns = ns
+        self.owner_id = owner_id
+        self.lease_timeout_s = float(lease_timeout_s)
+        self.validity_s = _VALIDITY_FRACTION * self.lease_timeout_s
+        self.term = 0
+        self.takeovers = 0        # stale leases reclaimed by US
+        self.demotions = 0        # times we discovered usurpation
+        self._held = False
+        self._valid_until_mono = 0.0
+
+    def _path(self) -> str:
+        return os.path.join(self.ns, _LEASE_FILE)
+
+    def _content(self) -> dict:
+        return {"id": self.owner_id, "pid": os.getpid(),
+                "term": self.term, "acquired_at": time.time()}
+
+    def is_leader(self) -> bool:
+        """Authority check — NOT just file ownership: false the moment
+        ``validity_s`` elapses since the last successful renew, which
+        is how a frozen leader knows, on thaw, that it must re-verify
+        before acting (and finds itself usurped)."""
+        return self._held and time.monotonic() < self._valid_until_mono
+
+    def _mine(self, cur: Optional[dict]) -> bool:
+        return (cur is not None and not cur.get("released")
+                and cur.get("id") == self.owner_id
+                and cur.get("pid") == os.getpid()
+                and int(cur.get("term", -1)) == self.term)
+
+    def _log_interval(self, now_wall: float) -> None:
+        try:
+            with open(_log_path(self.ns, self.owner_id), "a") as f:
+                f.write(json.dumps({
+                    "id": self.owner_id, "term": self.term,
+                    "from": now_wall,
+                    "until": now_wall + self.validity_s}) + "\n")
+        except OSError:
+            pass  # namespace tearing down
+
+    def tick(self) -> bool:
+        """Acquire-or-renew; returns whether we hold valid authority
+        after the tick.  Called on the federation member cadence."""
+        return self._renew() if self._held else self._try_acquire()
+
+    def _try_acquire(self) -> bool:
+        path = self._path()
+        next_term = self.term + 1
+        for attempt in (0, 1):
+            try:
+                fd = os.open(path, os.O_CREAT | os.O_EXCL | os.O_WRONLY,
+                             0o600)
+            except FileExistsError:
+                if attempt:
+                    return False  # lost the post-takeover create race
+                cur = _read_json(path)
+                if cur is not None:
+                    next_term = max(next_term, int(cur.get("term", 0)) + 1)
+                released = cur is not None and cur.get("released")
+                try:
+                    if not released:
+                        # a released lease is a term TOMBSTONE (clean
+                        # shutdown): immediately claimable, no stale
+                        # wait — and the term history survives it
+                        st = os.stat(path)
+                        if time.time() - st.st_mtime \
+                                < self.lease_timeout_s:
+                            return False  # live holder
+                        # re-stat right before the unlink: a holder
+                        # whose renew landed in our stat→unlink gap
+                        # keeps its lease (shrinks the accepted race
+                        # to one syscall)
+                        if os.stat(path).st_mtime != st.st_mtime:
+                            return False
+                    os.unlink(path)
+                except OSError:
+                    return False  # vanished/renewed: holder is live
+                if not released:
+                    self.takeovers += 1
+                continue
+            except OSError:
+                return False  # namespace tearing down
+            now_mono, now_wall = time.monotonic(), time.time()
+            self.term = next_term
+            try:
+                with os.fdopen(fd, "w") as f:
+                    json.dump(self._content(), f)
+            except OSError:
+                return False
+            self._held = True
+            # authority anchored BEFORE the write: conservative
+            self._valid_until_mono = now_mono + self.validity_s
+            self._log_interval(now_wall)
+            rec = _telemetry.REC
+            if rec is not None:
+                rec.emit("serve", "leader_elected",
+                         attrs={"id": self.owner_id, "term": self.term,
+                                "takeover": self.takeovers > 0})
+            return True
+        return False  # pragma: no cover - loop always returns
+
+    def _renew(self) -> bool:
+        path = self._path()
+        now_mono, now_wall = time.monotonic(), time.time()
+        if not self._mine(_read_json(path)):
+            return self._demote("usurped")
+        try:
+            os.utime(path)
+        except OSError:
+            return self._demote("lease file gone")
+        # re-read AFTER the utime: if we just touched a usurper's file
+        # we extended THEIR staleness clock (conservative — delays the
+        # next takeover, never creates a second holder) and demote
+        if not self._mine(_read_json(path)):
+            return self._demote("usurped")
+        self._valid_until_mono = now_mono + self.validity_s
+        self._log_interval(now_wall)
+        return True
+
+    def _demote(self, why: str) -> bool:
+        self._held = False
+        self._valid_until_mono = 0.0
+        self.demotions += 1
+        rec = _telemetry.REC
+        if rec is not None:
+            rec.emit("serve", "leader_demoted",
+                     attrs={"id": self.owner_id, "term": self.term,
+                            "why": why})
+        return False
+
+    def release(self) -> None:
+        """Clean handoff at shutdown: mark the lease RELEASED (a term
+        tombstone the next acquirer claims immediately and bumps past —
+        unlinking would lose the term history) and log the reign's end,
+        capping our authority interval at NOW rather than letting the
+        last renew's ``until`` imply authority we gave up."""
+        held, self._held = self._held, False
+        self._valid_until_mono = 0.0
+        if not held:
+            return
+        path = self._path()
+        now_wall = time.time()
+        try:
+            if self._mine(_read_json(path)):
+                _write_json(path, {**self._content(), "released": True})
+                with open(_log_path(self.ns, self.owner_id), "a") as f:
+                    f.write(json.dumps({
+                        "id": self.owner_id, "term": self.term,
+                        "release": True, "until": now_wall}) + "\n")
+        except OSError:
+            pass
+
+
+def assert_no_leader_overlap(ns: str) -> List[dict]:
+    """THE split-brain assertion: parse every server's authority-
+    interval log and verify no two DIFFERENT servers' intervals
+    overlap.  Returns the parsed intervals (sorted) for diagnostics;
+    raises AssertionError naming the clash.  The intervals are what
+    each server believed its authority to be (from its own renews),
+    logged conservatively — an overlap here means two servers could
+    both have acted as leader at one instant."""
+    raw: List[dict] = []
+    try:
+        names = os.listdir(ns)
+    except OSError:
+        names = []
+    for name in names:
+        if not name.startswith("leader.log."):
+            continue
+        try:
+            with open(os.path.join(ns, name)) as f:
+                for line in f:
+                    line = line.strip()
+                    if line:
+                        raw.append(json.loads(line))
+        except (OSError, ValueError):
+            continue
+    # a release record caps its (id, term) reign at the release instant
+    # — authority voluntarily given up must not read as held through
+    # the last renew's validity window
+    releases: Dict[tuple, float] = {}
+    for e in raw:
+        if e.get("release"):
+            key = (e["id"], e.get("term"))
+            releases[key] = min(releases.get(key, float("inf")),
+                                float(e["until"]))
+    intervals = []
+    for e in raw:
+        if e.get("release"):
+            continue
+        cap = releases.get((e["id"], e.get("term")))
+        e = dict(e)
+        if cap is not None:
+            e["until"] = min(float(e["until"]), cap)
+        if e["until"] > e["from"]:
+            intervals.append(e)
+    intervals.sort(key=lambda e: e["from"])
+    # merge per-id runs first (renews of one reign overlap by design)
+    merged: List[dict] = []
+    for e in intervals:
+        if merged and merged[-1]["id"] == e["id"] \
+                and e["from"] <= merged[-1]["until"]:
+            merged[-1]["until"] = max(merged[-1]["until"], e["until"])
+        else:
+            merged.append(dict(e))
+    for a, b in zip(merged, merged[1:]):
+        if a["id"] != b["id"] and b["from"] < a["until"]:
+            raise AssertionError(
+                f"leader authority overlap: {a['id']} (term {a['term']}) "
+                f"held until {a['until']:.3f} but {b['id']} (term "
+                f"{b['term']}) began at {b['from']:.3f} "
+                f"({a['until'] - b['from']:.3f}s overlap)")
+    return merged
+
+
+# -- the per-server federation member ----------------------------------------
+
+
+class FederationMember:
+    """The federation duties of ONE server, run on a daemon thread at
+    ``_TICK_S``: renew the endpoint record, tick the leader lease,
+    publish/verify pool ownership (relinquishing pools a usurper took
+    while we were frozen), consume takeover assignments addressed to
+    us, and — while holding valid leader authority — assign dead
+    servers' pools to survivors and garbage-collect their records.
+    A tick that raises logs a structured line and keeps ticking (the
+    serve monitor-loop rule: the fabric's lifeline must not die of one
+    exception)."""
+
+    def __init__(self, server, ns: str, server_id: Optional[str] = None,
+                 lease_timeout_s: float = _LEASE_TIMEOUT_S,
+                 tick_s: float = _TICK_S) -> None:
+        os.makedirs(ns, exist_ok=True)
+        self.server = server
+        self.ns = ns
+        self.server_id = server_id or ("srv-" + uuid.uuid4().hex[:8])
+        self.lease = LeaderLease(ns, self.server_id, lease_timeout_s)
+        self.tick_s = float(tick_s)
+        self.server_stale_s = _SERVER_STALE_FACTOR * float(lease_timeout_s)
+        self.started_at = time.time()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def is_leader(self) -> bool:
+        return self.lease.is_leader()
+
+    def start(self) -> "FederationMember":
+        self._tick_safe()  # register synchronously: visible on return
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name=f"fed-{self.server_id}")
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+        # clean departure: release the lease, retract our records (the
+        # pools die with an orderly stop() — serve shuts the workers
+        # down — so their ownership records retract too)
+        self.lease.release()
+        for pool_id, rec in read_pool_owners(self.ns).items():
+            if rec.get("owner") == self.server_id:
+                try:
+                    os.unlink(_pool_path(self.ns, pool_id))
+                except OSError:
+                    pass
+        try:
+            os.unlink(_server_path(self.ns, self.server_id))
+        except OSError:
+            pass
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.tick_s):
+            self._tick_safe()
+
+    def _tick_safe(self) -> None:
+        try:
+            self._tick()
+        except Exception as e:  # noqa: BLE001 - the fabric's lifeline
+            if self._stop.is_set():
+                return
+            import sys
+            import traceback
+
+            sys.stderr.write(
+                f"mpi_tpu.federation: member tick failed "
+                f"({type(e).__name__}: {str(e)[:200]}) — ticking on:\n"
+                f"{traceback.format_exc()}")
+
+    # -- duties ------------------------------------------------------------
+
+    def _tick(self) -> None:
+        now = time.time()
+        self._write_server_record(now)
+        leading = self.lease.tick()
+        # ONE pool-record snapshot per tick, shared by every duty
+        # (each used to rescan the namespace itself — 3-4 directory
+        # walks per 250ms tick per server, multiplied across the
+        # fabric); staleness within a tick is harmless, every consumer
+        # re-checks live server state before acting
+        owners = read_pool_owners(self.ns)
+        self._verify_pool_ownership(owners)
+        self._reclaim_ghost_pools(owners)
+        self._consume_assignments()
+        if leading and self.lease.is_leader():
+            self._leader_duties(now, owners)
+
+    def _write_server_record(self, now: float) -> None:
+        _write_json(_server_path(self.ns, self.server_id), {
+            "id": self.server_id, "pid": os.getpid(),
+            "ctrl": self.server.addr,
+            "metrics": getattr(self.server, "metrics_addr", None),
+            "started_at": self.started_at, "renewed_at": now,
+            "is_leader": self.lease.is_leader(),
+            "term": self.lease.term,
+            "summary": self.server.fed_summary()})
+
+    def _verify_pool_ownership(self, owners: Dict[str, dict]) -> None:
+        """Publish ownership for pools we hold; RELINQUISH any pool the
+        namespace says a usurper took over while we were frozen (the
+        split-brain-avoidance half of pool handover: our closing of the
+        worker control connections is what releases the workers)."""
+        for pool_id, meta in self.server.owned_pool_records().items():
+            rec = owners.get(pool_id)
+            if rec is None:
+                write_pool_owner(
+                    self.ns, pool_id, owner=self.server_id,
+                    ctrl=self.server.addr, rdv=meta["rdv"],
+                    backend=meta["backend"], size=meta["size"],
+                    epoch=meta["epoch"], term=self.lease.term,
+                    since=meta["since"])
+            elif (rec.get("owner") != self.server_id
+                  and float(rec.get("since", 0)) >= float(meta["since"])):
+                self.server.relinquish_pool(pool_id, rec.get("owner"))
+
+    def _reclaim_ghost_pools(self, owners: Dict[str, dict]) -> None:
+        """A pool record naming US that we do not actually serve is a
+        ghost of our PREVIOUS incarnation (a restart under a stable
+        ``--server-id``): the record reads live to the leader (our new
+        pid renews ``server.<id>.json``), so no takeover will ever
+        fire for it — reclaim it ourselves.  The old incarnation's
+        warm orphans are excluding its DEAD control address in their
+        re-resolve; rewriting the record with our new address is what
+        brings them home."""
+        owned = self.server.owned_pool_records()
+        for pool_id, rec in owners.items():
+            if rec.get("owner") != self.server_id or pool_id in owned:
+                continue
+            if self.server.adopt_pool(pool_id, rec,
+                                      term=self.lease.term):
+                write_pool_owner(
+                    self.ns, pool_id, owner=self.server_id,
+                    ctrl=self.server.addr, rdv=rec["rdv"],
+                    backend=rec.get("backend", "socket"),
+                    size=int(rec["size"]),
+                    epoch=int(rec.get("epoch", 0)),
+                    term=self.lease.term)
+
+    def _consume_assignments(self) -> None:
+        for t in read_takeovers(self.ns):
+            if t.get("to") != self.server_id:
+                continue
+            for pool_id, prec in (t.get("pools") or {}).items():
+                cur = read_pool_owner(self.ns, pool_id)
+                if cur is not None and cur.get("owner") not in (
+                        t.get("dead"), self.server_id):
+                    continue  # moved again since: stale assignment
+                if cur is not None and cur.get("owner") == self.server_id:
+                    continue  # already adopted
+                if self.server.adopt_pool(pool_id, prec,
+                                          term=int(t.get("term", 0))):
+                    write_pool_owner(
+                        self.ns, pool_id, owner=self.server_id,
+                        ctrl=self.server.addr, rdv=prec["rdv"],
+                        backend=prec.get("backend", "socket"),
+                        size=int(prec["size"]),
+                        epoch=int(prec.get("epoch", 0)),
+                        term=int(t.get("term", 0)))
+
+    def _leader_duties(self, now: float,
+                       owners: Dict[str, dict]) -> None:
+        records = read_server_records(self.ns)
+        live = {sid for sid, r in records.items()
+                if sid == self.server_id
+                or record_live(r, now, self.server_stale_s)}
+        for sid, r in records.items():
+            if sid in live:
+                continue
+            dead_pools = {pid: rec for pid, rec in owners.items()
+                          if rec.get("owner") == sid}
+            if dead_pools:
+                existing = _read_json(_takeover_path(self.ns, sid))
+                if existing is None or existing.get("to") not in live:
+                    target = self._choose_survivor(live, owners)
+                    if target is not None and self.lease.is_leader():
+                        # assignments carry the term they were decided
+                        # under — written ONLY with valid authority
+                        _write_json(_takeover_path(self.ns, sid), {
+                            "dead": sid, "to": target,
+                            "term": self.lease.term, "at": now,
+                            "pools": dead_pools})
+                        rec_t = _telemetry.REC
+                        if rec_t is not None:
+                            rec_t.emit("serve", "takeover_assigned",
+                                       attrs={"dead": sid, "to": target,
+                                              "pools":
+                                              sorted(dead_pools)})
+            else:
+                # fully relieved (or never owned a pool): GC the corpse
+                for path in (_server_path(self.ns, sid),
+                             _takeover_path(self.ns, sid)):
+                    try:
+                        os.unlink(path)
+                    except OSError:
+                        pass
+
+    def _choose_survivor(self, live: set,
+                         owners: Dict[str, dict]) -> Optional[str]:
+        """Least-loaded live server (fewest owned pools, id tiebreak) —
+        the leader may assign to itself."""
+        if not live:
+            return None
+        load = {sid: 0 for sid in live}
+        for rec in owners.values():
+            if rec.get("owner") in load:
+                load[rec["owner"]] += 1
+        return min(sorted(load), key=lambda sid: load[sid])
+
+
+# -- namespace roll-up --------------------------------------------------------
+
+
+def federation_stats(ns: str) -> dict:
+    """Aggregate the namespace: one document summing the live servers'
+    summaries (worlds/s, workers, idle, pools, waiting) plus the
+    current leader — what keeps the PR-13 Prometheus endpoint truthful
+    when pools move between servers.  Pure file reads: scrape-safe,
+    callable with zero servers reachable."""
+    now = time.time()
+    records = read_server_records(ns)
+    lease = read_leader(ns)
+    servers = {}
+    totals = {"worlds_per_s": 0.0, "workers": 0, "idle": 0, "pools": 0,
+              "leases_active": 0, "waiting": 0}
+    live = 0
+    for sid, r in sorted(records.items()):
+        alive = record_live(r, now)
+        summary = r.get("summary") or {}
+        servers[sid] = {"live": alive, "ctrl": r.get("ctrl"),
+                        "is_leader": bool(r.get("is_leader")),
+                        **summary}
+        if alive:
+            live += 1
+            for k in totals:
+                totals[k] = totals[k] + summary.get(k, 0)
+    totals["worlds_per_s"] = round(totals["worlds_per_s"], 3)
+    return {"namespace": ns, "servers_total": len(records),
+            "servers_live": live,
+            "leader": lease.get("id") if lease else None,
+            "leader_term": int(lease.get("term", 0)) if lease else 0,
+            "servers": servers, **totals}
+
+
+# -- the failover client ------------------------------------------------------
+
+
+class FederatedClient:
+    """Client handle to a FEDERATION of world servers: resolve live
+    endpoints from a namespace dir (and/or a static address list), and
+    fail acquire/stats over to a survivor on a dead-server
+    ``ServerLostError`` with backoff, bounded by the
+    ``connect_retry_timeout_s`` budget.  Lease semantics are the
+    single-server ones: re-acquire after a failover is idempotent (the
+    lost lease died with its server), and an in-flight ``lease.run``
+    surfaces the named error — jobs are not transparently re-run."""
+
+    def __init__(self, namespace: Optional[str] = None,
+                 addrs: Optional[List[Any]] = None,
+                 timeout: float = 30.0, priority: int = 0,
+                 failover_timeout_s: Optional[float] = None) -> None:
+        if not namespace and not addrs:
+            raise ValueError("FederatedClient needs a namespace dir "
+                             "and/or a server address list")
+        self._ns = namespace
+        self._static = ["%s:%s" % tuple(a) if isinstance(a, (tuple, list))
+                        else str(a) for a in (addrs or [])]
+        self._timeout = float(timeout)
+        self._priority = int(priority)
+        self._id = uuid.uuid4().hex  # one fair-share identity across servers
+        self._failover_s = failover_timeout_s
+        self._client = None
+        self._addr: Optional[str] = None
+        self._rr = 0
+        self.failovers = 0
+
+    # -- endpoint resolution ----------------------------------------------
+
+    def _budget(self) -> float:
+        if self._failover_s is not None:
+            return float(self._failover_s)
+        from . import mpit as _mpit
+
+        return float(_mpit.cvar_read("connect_retry_timeout_s"))
+
+    def _candidates(self) -> List[str]:
+        out = list(self._static)
+        if self._ns:
+            now = time.time()
+            for sid, rec in sorted(read_server_records(self._ns).items()):
+                if rec.get("ctrl") and record_live(rec, now) \
+                        and rec["ctrl"] not in out:
+                    out.append(rec["ctrl"])
+        return out
+
+    def _ensure(self):
+        if self._client is not None:
+            return self._client
+        from . import serve as _serve
+
+        deadline = time.monotonic() + max(self._budget(), 0.0)
+        delays = _resilience.backoff_delays()
+        last_err: Optional[BaseException] = None
+        while True:
+            cands = self._candidates()
+            for i in range(len(cands)):
+                addr = cands[(self._rr + i) % len(cands)]
+                host, _, port = addr.rpartition(":")
+                try:
+                    # a short per-candidate dial budget: OUR loop is
+                    # the patience; a dead candidate must not eat the
+                    # whole failover budget before the next is tried.
+                    # The cap applies to the SINGLE connect attempt
+                    # too (timeout=), not just the retry loop — a
+                    # SYN-blackholed candidate would otherwise block
+                    # the full client timeout before the live survivor
+                    # is ever dialed
+                    c = _serve.ServerClient(
+                        host, int(port),
+                        timeout=min(self._timeout, 2.0),
+                        priority=self._priority, client_id=self._id,
+                        dial_retry_s=0.5)
+                except OSError as e:
+                    last_err = e
+                    continue
+                self._client, self._addr = c, addr
+                self._rr = (self._rr + i + 1) % max(1, len(cands))
+                return c
+            if time.monotonic() > deadline:
+                raise _serve.ServerLostError(
+                    f"no live federation server reachable "
+                    f"(candidates {cands or 'none'}; last: "
+                    f"{type(last_err).__name__ if last_err else 'none'}: "
+                    f"{last_err})")
+            time.sleep(min(next(delays), 0.5))
+
+    def _drop(self) -> None:
+        c, self._client, self._addr = self._client, None, None
+        if c is not None:
+            try:
+                c.close()
+            except OSError:
+                pass
+
+    def _with_failover(self, op):
+        from .serve import ServerLostError
+
+        deadline = time.monotonic() + max(self._budget(), 0.0)
+        delays = _resilience.backoff_delays()
+        while True:
+            client = self._ensure()
+            try:
+                return op(client)
+            except (ServerLostError, OSError) as e:
+                if isinstance(e, TimeoutError) \
+                        and not isinstance(e, ServerLostError):
+                    # a LEASE timeout (TimeoutError is an OSError
+                    # subclass!) is the live server's named verdict,
+                    # not a dead server — never a failover signal
+                    raise
+                self._drop()
+                self.failovers += 1
+                if time.monotonic() > deadline:
+                    raise
+                time.sleep(min(next(delays), 0.25))
+
+    # -- the ServerClient surface ------------------------------------------
+
+    @property
+    def addr(self) -> Optional[str]:
+        """Control address currently connected (None when dropped)."""
+        return self._addr
+
+    def acquire(self, nranks: int, timeout: Optional[float] = None,
+                priority: Optional[int] = None):
+        """Lease ``nranks`` warm workers from any live server —
+        failover-transparent (re-acquire is idempotent).  Named
+        non-failover errors propagate: ``ServerBusyError`` (admission
+        rejection), ``TimeoutError`` (pool busy past the bound)."""
+        return self._with_failover(
+            lambda c: c.acquire(nranks, timeout=timeout,
+                                priority=priority))
+
+    def run(self, fn, *args: Any, nranks: int = 2,
+            timeout: Optional[float] = None) -> Any:
+        """acquire (with failover) + run + release.  A server death
+        MID-JOB raises the named ``ServerLostError`` — the job may have
+        side effects, so re-running it is the caller's decision."""
+        lease = self.acquire(nranks, timeout=timeout)
+        try:
+            return lease.run(fn, *args, timeout=timeout)
+        finally:
+            try:
+                lease.release()
+            except (TransportError, OSError):
+                pass  # server gone: the lease died with it
+
+    def stats(self) -> dict:
+        """One live server's stats document (failover-transparent);
+        federated servers embed the namespace roll-up under
+        ``"federation"``."""
+        return self._with_failover(lambda c: c.stats())
+
+    def federation_stats(self) -> dict:
+        """The namespace roll-up directly (no server round-trip)."""
+        if not self._ns:
+            return self.stats().get("federation") or {}
+        return federation_stats(self._ns)
+
+    def close(self) -> None:
+        self._drop()
+
+    def __enter__(self) -> "FederatedClient":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()

@@ -132,6 +132,22 @@ def read_incarnation(rdv_dir: str, rank: int) -> Optional[str]:
     return rec.get("incarnation") if rec else None
 
 
+def heartbeat_age(rdv_dir: str, rank: int,
+                  now: Optional[float] = None) -> Optional[float]:
+    """Age (seconds) of slot ``rank``'s FT heartbeat file under the
+    rendezvous dir, or None when it was never published.  The liveness
+    read every membership AUTHORITY shares — the resident world server
+    for its own pool, and (ISSUE 15) a federation survivor judging the
+    workers of a pool it adopted, whose processes were never its
+    children (no Popen handle to poll): the heartbeat file is the one
+    liveness signal that survives a change of ownership."""
+    try:
+        st = os.stat(os.path.join(rdv_dir, f"hb.{rank}"))
+    except OSError:
+        return None
+    return (time.time() if now is None else now) - st.st_mtime
+
+
 # -- announce / claim / admit / ready protocol files --------------------------
 
 

@@ -144,21 +144,38 @@ def backoff_delays(base: float = _BACKOFF_BASE_S,
         ceiling = min(cap, ceiling * factor)
 
 
+# Transient dial failures retry_connect heals by waiting (ISSUE 15
+# satellite, extending the refused-only ISSUE 10 rule):
+# * ConnectionRefusedError — the server is still binding (or, in a
+#   federation failover, a just-elected leader has not accept()ed yet);
+# * TimeoutError (socket.timeout) — the connect itself timed out, the
+#   SYN-swallowed flavor of the same race (a dying server's listener
+#   can absorb the handshake without completing it).
+# Anything else (unroutable host, protocol error, reset mid-dial with
+# no listener coming back) propagates immediately — not healed by
+# patience.
+TRANSIENT_DIAL_ERRORS = (ConnectionRefusedError, TimeoutError)
+
+
 def retry_connect(dial: Callable[[], "object"],
                   timeout_s: Optional[float] = None,
-                  rng: Optional[random.Random] = None):
-    """Run ``dial()`` (a socket factory) retrying ConnectionRefusedError
+                  rng: Optional[random.Random] = None,
+                  retry_on: Tuple[type, ...] = TRANSIENT_DIAL_ERRORS):
+    """Run ``dial()`` (a socket factory) retrying the transient dial
+    failures in ``retry_on`` (default :data:`TRANSIENT_DIAL_ERRORS`)
     with backoff + jitter for up to ``timeout_s`` (default: the
-    connect_retry_timeout_s cvar).  The refused-connection case is the
-    server-still-binding race; any OTHER failure propagates immediately
-    (an unroutable host or a protocol error is not healed by patience)."""
+    connect_retry_timeout_s cvar).  A refused or timed-out connect is
+    the server-still-binding race — during federation failover a
+    just-elected server publishing its endpoint record loses that race
+    routinely; any OTHER failure propagates immediately (an unroutable
+    host or a protocol error is not healed by patience)."""
     budget = _CONNECT_RETRY_TIMEOUT_S if timeout_s is None else timeout_s
     deadline = time.monotonic() + budget
     delays = backoff_delays(rng=rng)
     while True:
         try:
             return dial()
-        except ConnectionRefusedError:
+        except retry_on:
             remaining = deadline - time.monotonic()
             if remaining <= 0:
                 raise

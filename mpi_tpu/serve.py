@@ -47,13 +47,15 @@ import subprocess
 import sys
 import threading
 import time
-from typing import Any, Dict, List, Optional, Sequence
+import uuid
+from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 from . import membership
 from . import mpit as _mpit
 from . import telemetry as _telemetry
 from .errors import (DeadlockError, EpochSkewError, ProcFailedError,
-                     RejoinRefusedError, RevokedError, error_class)
+                     RejoinRefusedError, RevokedError, ServerBusyError,
+                     error_class)
 from .transport.base import RecvTimeout, TransportError
 from .transport.socket import _recv_exact
 
@@ -79,6 +81,33 @@ _WORKER_PVARS = ("msgs_sent", "collectives_started", "link_reconnects",
 
 # Sliding window of the worlds/s gauge (per-second completion buckets).
 _RATE_WINDOW_S = 60.0
+
+# ISSUE 15: idle-worker pvar piggyback cadence (PR-13 residual: the
+# latest-per-slot snapshot rode job_done ONLY, so a worker that never
+# completed a job reported nothing) and the orphaned-worker budget — a
+# worker whose server died polls the federation namespace this long for
+# the survivor that adopted its pool before giving up and exiting.
+_PVAR_PUSH_S = 1.0
+_ORPHAN_TIMEOUT_S = 60.0
+
+# Bounded admission queue (ISSUE 15): acquires past this many waiting
+# requests are rejected IMMEDIATELY with ServerBusyError instead of
+# converting overload into unbounded acquire latency.
+_MAX_PENDING = 64
+
+# Federation leader-lease bound (mpi_tpu/federation.py): authority
+# self-expires at half this, takeover fires past it.
+_FED_LEASE_TIMEOUT_S = 3.0
+
+
+class ServerLostError(TransportError):
+    """The control connection to the world server died mid-request —
+    the server process was killed or went away.  Distinct from a
+    server-SHIPPED TransportError (a worker-side failure relayed by a
+    live server): only THIS class means "fail over"; a federated
+    client retries acquire/stats on a survivor, while an in-flight
+    ``lease.run`` surfaces it named (the lease died with its server —
+    re-acquire and decide about re-running the job yourself)."""
 
 
 # -- framing ------------------------------------------------------------------
@@ -116,7 +145,23 @@ _ERROR_KINDS = {
     "RejoinRefusedError": RejoinRefusedError,
     "RecvTimeout": RecvTimeout,
     "TransportError": TransportError,
+    "ServerBusyError": ServerBusyError,
+    "ServerLostError": ServerLostError,
 }
+
+
+def _admission_order(waiters: List[dict], grants: Dict[str, int]
+                     ) -> List[dict]:
+    """Scheduling order of the waiting acquires (ISSUE 15 lease
+    scheduler): strict priority first, then FAIR SHARE — fewest leases
+    already granted to the waiter's client identity — then FIFO.  Pure
+    so the policy is unit-testable; the grant loop walks this order and
+    admits the first waiter the idle capacity can satisfy (work-
+    conserving: an unsatisfiable large request does not idle the pool,
+    it keeps its place and the lease timeout bounds its wait)."""
+    return sorted(waiters, key=lambda w: (-w["priority"],
+                                          grants.get(w["client"], 0),
+                                          w["seq"]))
 
 
 def _pack_error(exc: BaseException) -> dict:
@@ -224,9 +269,15 @@ def _worker_main() -> int:
     serve jobs from the control connection.  A control reader thread
     applies membership transitions IMMEDIATELY (even mid-job — dropping
     a corpse's endpoints must not wait for the current lease), while
-    the main thread runs one job at a time."""
+    the main thread runs one job at a time.
+
+    ISSUE 15: under a federation namespace (MPI_TPU_SERVE_FED) the
+    worker SURVIVES its server — on control-channel EOF it polls the
+    namespace for the survivor that adopted its pool and re-registers
+    there, keeping its warm transport, arenas, and FT detector; without
+    a namespace, server death still ends the worker (nothing to fail
+    over to)."""
     import faulthandler
-    import queue
     import signal as _signal
 
     from . import ft as _ft
@@ -273,21 +324,114 @@ def _worker_main() -> int:
     world_ft = t._ft_world
     slot = t.world_rank
 
-    host, port = os.environ["MPI_TPU_SERVE_CTRL"].rsplit(":", 1)
-    ctrl = socket.create_connection((host, int(port)), timeout=30.0)
+    pool_id = (os.environ.get("MPI_TPU_SERVE_POOL")
+               or os.path.basename(rdv.rstrip("/")))
+    fed_ns = os.environ.get("MPI_TPU_SERVE_FED") or None
+    orphan_timeout = float(os.environ.get(
+        "MPI_TPU_SERVE_ORPHAN_TIMEOUT_S", str(_ORPHAN_TIMEOUT_S)))
+    ctrl_addr = os.environ["MPI_TPU_SERVE_CTRL"]
+    dead_addr: Optional[str] = None
+    orphan_deadline: Optional[float] = None
+    rc = 0
+    while True:
+        outcome = _worker_serve_one(ctrl_addr, t, world_ft, slot, pool_id)
+        if outcome == "shutdown":
+            break
+        if fed_ns is None:
+            # no federation: nothing to fail over to — exit LOUDLY
+            # (a dial failure while the server lives would otherwise
+            # crash-loop heal/respawn with zero diagnostic output)
+            sys.stderr.write(
+                f"mpi_tpu.serve: worker slot {slot} (pool {pool_id}) "
+                f"control channel {outcome} (server {ctrl_addr}); no "
+                f"federation namespace to fail over to — exiting\n")
+            rc = 1
+            break
+        # the server died under us: the pool outlives its server
+        # (ISSUE 15) — resolve the survivor that adopted this pool from
+        # the federation namespace and RE-REGISTER there.  Everything
+        # warm stays warm.  Only an ESTABLISHED registration dying
+        # ("lost") excludes its address from the re-resolve and renews
+        # the orphan budget; a failed DIAL ("unreachable") must not —
+        # the current owner may be live-but-briefly-swamped, and
+        # excluding it would strand this warm worker until the budget
+        # ran out while the owner cold-healed the slot instead.
+        from . import federation as _federation
+
+        now = time.monotonic()
+        if outcome == "lost":
+            dead_addr = ctrl_addr
+            orphan_deadline = now + orphan_timeout
+        elif orphan_deadline is None:
+            orphan_deadline = now + orphan_timeout
+        remaining = orphan_deadline - now
+        new_ctrl = _federation.wait_pool_owner(
+            fed_ns, pool_id, not_ctrl=dead_addr,
+            timeout=max(0.0, remaining)) if remaining > 0 else None
+        if new_ctrl is None:
+            sys.stderr.write(
+                f"mpi_tpu.serve: worker slot {slot} (pool {pool_id}) "
+                f"orphaned: no reachable pool owner within "
+                f"{orphan_timeout}s — exiting\n")
+            break
+        ctrl_addr = new_ctrl
+    # orderly pool shutdown: retire the pooled lease arenas (ISSUE 12
+    # satellite, PR-11 residual (d)) — a worker set that never re-leased
+    # after its last job has nobody else to unlink its /dev/shm segment
+    from . import coll_sm as _coll_sm
+
+    _coll_sm.retire_pooled(t)
+    return rc
+
+
+def _worker_serve_one(ctrl_addr: str, t, world_ft, slot: int,
+                      pool_id: str) -> str:
+    """One control-connection lifetime of a pool worker: dial, hello,
+    serve jobs until an orderly ``shutdown`` op (→ "shutdown"), an
+    ESTABLISHED registration dying (→ "lost": the server went away —
+    exclude its address from the re-resolve), or a failed dial/hello
+    (→ "unreachable": never registered — the target may be live but
+    swamped, so the re-resolve may legitimately return it again)."""
+    import queue
+
+    from . import ft as _ft
+    from .communicator import P2PCommunicator
+    from .resilience import retry_connect
+
+    host, port = ctrl_addr.rsplit(":", 1)
+    try:
+        ctrl = retry_connect(
+            lambda: socket.create_connection((host, int(port)),
+                                             timeout=10.0),
+            timeout_s=10.0)
+    except OSError:
+        return "unreachable"
     ctrl.settimeout(None)
     ctrl.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
     send_lock = threading.Lock()
-    _send_msg(ctrl, send_lock, {
-        "op": "hello", "slot": slot, "pid": os.getpid(),
-        "incarnation": membership.incarnation(), "epoch": t.epoch})
+    try:
+        _send_msg(ctrl, send_lock, {
+            "op": "hello", "slot": slot, "pool": pool_id,
+            "pid": os.getpid(),
+            "incarnation": membership.incarnation(), "epoch": t.epoch})
+    except OSError:
+        ctrl.close()
+        return "unreachable"
 
     jobs: "queue.Queue[Optional[dict]]" = queue.Queue()
+    shutdown = threading.Event()  # orderly stop vs connection death
+    gone = threading.Event()      # this connection is finished
 
     def reader() -> None:
         while True:
-            msg = _recv_msg(ctrl)
+            try:
+                msg = _recv_msg(ctrl)
+            except OSError:
+                msg = None
             if msg is None or msg.get("op") == "shutdown":
+                if msg is not None:
+                    shutdown.set()
+                gone.set()
                 jobs.put(None)
                 return
             op = msg.get("op")
@@ -309,15 +453,71 @@ def _worker_main() -> int:
                 # even mid-job: the corpse's endpoints must go NOW, or
                 # the current lease's sends keep streaming into them
                 membership.survivor_transition(t, msg["epoch"], dead)
-                _send_msg(ctrl, send_lock,
-                          {"op": "transition_ack", "slot": slot,
-                           "epoch": msg["epoch"]})
+                try:
+                    _send_msg(ctrl, send_lock,
+                              {"op": "transition_ack", "slot": slot,
+                               "epoch": msg["epoch"]})
+                except OSError:
+                    pass  # EOF path delivers the verdict next round
             elif op == "rejoined":
                 world_ft.reset_rank(msg["slot"])
                 t.min_peer_epoch[int(msg["slot"])] = int(msg["epoch"])
 
     threading.Thread(target=reader, daemon=True,
                      name=f"serve-ctrl-{slot}").start()
+
+    fed_ns = os.environ.get("MPI_TPU_SERVE_FED") or None
+
+    def pvar_push() -> None:
+        # ISSUE 15 satellite (PR-13 metrics residual): the pvar
+        # snapshot used to piggyback on job_done ONLY, so an idle or
+        # wedged worker reported nothing — push it on the control
+        # channel at a fixed cadence too, so stats() sees every worker
+        while not gone.wait(_PVAR_PUSH_S):
+            try:
+                _send_msg(ctrl, send_lock, {
+                    "op": "pvars", "slot": slot,
+                    "pvars": {n: _mpit.pvar_read(n)
+                              for n in _WORKER_PVARS}})
+            except OSError:
+                return
+            if fed_ns is None:
+                continue
+            # the frozen-master escape (a SIGSTOP'd server keeps our
+            # TCP connection ESTABLISHED forever — EOF alone can never
+            # free us): if the namespace names a LIVE owner other than
+            # the server we are serving, our master was deposed while
+            # frozen — defect by closing the connection ourselves,
+            # which drops us into the normal re-resolve path
+            from . import federation as _federation
+
+            rec = _federation.read_pool_owner(fed_ns, pool_id)
+            if rec is not None and rec.get("ctrl") \
+                    and rec["ctrl"] != ctrl_addr:
+                srv = _federation.read_server_record(
+                    fed_ns, str(rec.get("owner")))
+                if srv is None or _federation.record_live(srv):
+                    sys.stderr.write(
+                        f"mpi_tpu.serve: worker slot {slot} (pool "
+                        f"{pool_id}): ownership moved to "
+                        f"{rec.get('owner')} while our master "
+                        f"{ctrl_addr} held the connection — "
+                        f"defecting\n")
+                    gone.set()
+                    try:
+                        # shutdown BEFORE close: close() alone never
+                        # wakes the reader thread blocked in recv()
+                        ctrl.shutdown(socket.SHUT_RDWR)
+                    except OSError:
+                        pass
+                    try:
+                        ctrl.close()
+                    except OSError:
+                        pass
+                    return
+
+    threading.Thread(target=pvar_push, daemon=True,
+                     name=f"serve-pvars-{slot}").start()
 
     while True:
         msg = jobs.get()
@@ -361,26 +561,37 @@ def _worker_main() -> int:
         try:
             _send_msg(ctrl, send_lock, reply)
         except OSError:
-            return 1  # server gone: nothing left to serve
-    # orderly pool shutdown: retire the pooled lease arenas (ISSUE 12
-    # satellite, PR-11 residual (d)) — a worker set that never re-leased
-    # after its last job has nobody else to unlink its /dev/shm segment
-    from . import coll_sm as _coll_sm
-
-    _coll_sm.retire_pooled(t)
-    return 0
+            # server gone mid-reply: the lease died with it; drop the
+            # reply and let the caller re-resolve the pool's owner
+            gone.set()
+            try:
+                ctrl.close()
+            except OSError:
+                pass
+            return "lost"
+    try:
+        ctrl.close()
+    except OSError:
+        pass
+    return "shutdown" if shutdown.is_set() else "lost"
 
 
 # -- the server ---------------------------------------------------------------
 
 
 class _Worker:
-    __slots__ = ("slot", "proc", "conn", "send_lock", "state",
-                 "incarnation", "epoch", "lease_id", "spawned_at")
+    __slots__ = ("slot", "pool", "proc", "pid", "conn", "send_lock",
+                 "state", "incarnation", "epoch", "lease_id",
+                 "spawned_at")
 
-    def __init__(self, slot: int) -> None:
+    def __init__(self, slot: int, pool: str) -> None:
         self.slot = slot
+        self.pool = pool
         self.proc: Optional[subprocess.Popen] = None
+        # adopted workers (federation takeover) were never our children:
+        # no Popen handle — the hello's pid + the heartbeat file carry
+        # their liveness instead
+        self.pid: Optional[int] = None
         self.conn: Optional[socket.socket] = None
         self.send_lock = threading.Lock()
         self.state = "starting"  # starting|idle|leased|dead
@@ -388,6 +599,29 @@ class _Worker:
         self.epoch = 0
         self.lease_id: Optional[int] = None
         self.spawned_at = time.monotonic()
+
+
+class _Pool:
+    """One warm worker pool: a transport world over one rendezvous dir.
+    A server's HOME pool is forked by start(); ADOPTED pools (ISSUE 15
+    federation takeover) arrive as metadata — their live orphaned
+    workers re-register over the control channel, and worker-level
+    healing runs the same announce/claim/admit protocol against the
+    adopted rendezvous dir."""
+
+    __slots__ = ("pool_id", "rdv", "backend", "size", "epoch", "home",
+                 "adopted_at", "owned_since")
+
+    def __init__(self, pool_id: str, rdv: str, backend: str, size: int,
+                 home: bool, epoch: int = 0) -> None:
+        self.pool_id = pool_id
+        self.rdv = rdv
+        self.backend = backend
+        self.size = int(size)
+        self.epoch = int(epoch)
+        self.home = home
+        self.adopted_at = None if home else time.monotonic()
+        self.owned_since = time.time()
 
 
 class WorldServer:
@@ -404,9 +638,16 @@ class WorldServer:
                  world_lease_timeout_s: float = _WORLD_LEASE_TIMEOUT_S,
                  rejoin_timeout_s: float = _REJOIN_TIMEOUT_S,
                  env_extra: Optional[dict] = None,
-                 metrics_port: Optional[int] = None) -> None:
+                 metrics_port: Optional[int] = None,
+                 federation: Optional[str] = None,
+                 server_id: Optional[str] = None,
+                 fed_lease_timeout_s: float = _FED_LEASE_TIMEOUT_S,
+                 max_pending: int = _MAX_PENDING,
+                 orphan_timeout_s: float = _ORPHAN_TIMEOUT_S) -> None:
         if pool_size < 1:
             raise ValueError("pool_size must be >= 1")
+        if max_pending < 1:
+            raise ValueError("max_pending must be >= 1")
         if backend == "shm":
             from .native import ensure_built
 
@@ -417,6 +658,8 @@ class WorldServer:
         self.heartbeat_s = float(heartbeat_s)
         self.world_lease_timeout_s = float(world_lease_timeout_s)
         self.rejoin_timeout_s = float(rejoin_timeout_s)
+        self.max_pending = int(max_pending)
+        self.orphan_timeout_s = float(orphan_timeout_s)
         self._env_extra = dict(env_extra or {})
         self.rdv = membership.new_rendezvous_dir(prefix="mpi_tpu_serve_")
         self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
@@ -427,16 +670,37 @@ class WorldServer:
         self._lock = threading.Lock()
         self._cond = threading.Condition(self._lock)
         self._closing = False
-        self.epoch = 0
-        self._workers: Dict[int, _Worker] = {}
+        # pools (ISSUE 15): the home pool is this server's forked
+        # worker world; adopted pools arrive via federation takeover.
+        # Workers/healing/pvars are keyed (pool_id, slot) throughout.
+        self._home = os.path.basename(self.rdv.rstrip("/"))
+        self._pools: Dict[str, _Pool] = {
+            self._home: _Pool(self._home, self.rdv, backend, pool_size,
+                              home=True)}
+        self._relinquished_home_epoch = 0
+        self._workers: Dict[Tuple[str, int], _Worker] = {}
         self._leases: Dict[int, dict] = {}
         self._jobs: Dict[int, dict] = {}
-        self._healing: Dict[int, dict] = {}  # slot -> {epoch, proc, since}
+        self._healing: Dict[Tuple[str, int], dict] = {}
         self._seq = 0
+        # admission control (ISSUE 15): bounded waiter queue + the
+        # fair-share grant ledger (leases granted per client identity)
+        self._waiters: List[dict] = []
+        self._client_grants: Dict[str, int] = {}
         self.stats_counters = {"leases_granted": 0, "leases_denied": 0,
                                "jobs_ok": 0, "jobs_failed": 0,
-                               "heals_completed": 0, "workers_lost": 0}
+                               "heals_completed": 0, "workers_lost": 0,
+                               "busy_rejected": 0,
+                               "orphans_reregistered": 0,
+                               "pools_adopted": 0,
+                               "pools_relinquished": 0}
         self._threads: List[threading.Thread] = []
+        # federation membership (ISSUE 15): namespace dir + identity;
+        # the member thread starts in start()
+        self._fed_ns = federation
+        self.server_id = server_id or ("srv-" + uuid.uuid4().hex[:8])
+        self._fed_lease_timeout_s = float(fed_lease_timeout_s)
+        self._fed = None
         # observability (ISSUE 13): uptime anchor for the worlds/s
         # gauge, per-second completed-job buckets (sliding window —
         # bounded at ~window-many keys regardless of rate, unlike a
@@ -446,13 +710,22 @@ class WorldServer:
         # metrics_addr)
         self._t0 = time.monotonic()
         self._ok_buckets: Dict[int, int] = {}
-        self._worker_pvars: Dict[int, dict] = {}
+        self._worker_pvars: Dict[Tuple[str, int], dict] = {}
         self._metrics_port = metrics_port
         self._metrics_httpd = None
         self.metrics_addr: Optional[str] = None
         self._host = host
 
     # -- lifecycle ---------------------------------------------------------
+
+    @property
+    def epoch(self) -> int:
+        """The HOME pool's membership epoch (the single-pool stats
+        contract every pre-federation caller relies on); per-pool
+        epochs live in ``stats()["pools"]``."""
+        pool = self._pools.get(self._home)
+        return pool.epoch if pool is not None \
+            else self._relinquished_home_epoch
 
     def start(self, wait_ready: bool = True,
               timeout: float = 120.0) -> "WorldServer":
@@ -463,8 +736,9 @@ class WorldServer:
         # not a deployment shape — still share it.)
         _mpit.pvar_hist_reset("lease_acquire_s")
         for slot in range(self.pool_size):
-            self._workers[slot] = _Worker(slot)
-            self._spawn_worker(slot)
+            key = (self._home, slot)
+            self._workers[key] = _Worker(slot, self._home)
+            self._spawn_worker(key)
         for name, target in (("accept", self._accept_loop),
                              ("monitor", self._monitor_loop)):
             th = threading.Thread(target=target, daemon=True,
@@ -473,6 +747,14 @@ class WorldServer:
             self._threads.append(th)
         if self._metrics_port is not None:
             self._start_metrics(self._metrics_port)
+        if self._fed_ns is not None:
+            # join the federation namespace: endpoint record, leader
+            # lease, pool-ownership publication, takeover duties
+            from . import federation as _federation
+
+            self._fed = _federation.FederationMember(
+                self, self._fed_ns, server_id=self.server_id,
+                lease_timeout_s=self._fed_lease_timeout_s).start()
         if wait_ready:
             deadline = time.monotonic() + timeout
             with self._cond:
@@ -509,7 +791,18 @@ class WorldServer:
                      if w.proc is not None]
             procs += [h["proc"] for h in self._healing.values()
                       if h.get("proc") is not None]
+            # adopted workers are not our children: ask them to stop
+            # via the shutdown op (sent below); their pids are the only
+            # handle left for the last-resort sweep
+            adopted_pids = [w.pid for w in self._workers.values()
+                            if w.proc is None and w.pid]
+            pools = list(self._pools.values())
             self._cond.notify_all()
+        if self._fed is not None:
+            # leave the namespace FIRST: records retract before the
+            # pools die, so no leader assigns a takeover of a pool
+            # whose workers are about to receive shutdown
+            self._fed.stop()
         for conn, lk in conns:
             try:
                 _send_msg(conn, lk, {"op": "shutdown"})
@@ -543,7 +836,21 @@ class WorldServer:
                     p.wait(2.0)
                 except subprocess.TimeoutExpired:  # pragma: no cover
                     pass
-        membership.cleanup_rendezvous(self.rdv)
+        # adopted workers received the shutdown op above; sweep any
+        # that did not exit (two masters of one pool must never coexist
+        # with the rendezvous dirs about to vanish)
+        deadline = time.monotonic() + 3.0
+        for pid in adopted_pids:
+            while membership._pid_alive(pid) \
+                    and time.monotonic() < deadline:
+                time.sleep(0.05)
+            if membership._pid_alive(pid):
+                try:
+                    os.kill(pid, 9)
+                except OSError:
+                    pass
+        for pool in pools:
+            membership.cleanup_rendezvous(pool.rdv)
 
     # -- metrics endpoint (ISSUE 13) ---------------------------------------
 
@@ -592,23 +899,30 @@ class WorldServer:
 
     # -- worker processes --------------------------------------------------
 
-    def _worker_env(self, slot: int,
+    def _worker_env(self, key: Tuple[str, int],
                     rejoin_epoch: Optional[int] = None) -> dict:
         from .launcher import cpu_pinned_env
 
+        pool_id, slot = key
+        pool = self._pools[pool_id]
         env = dict(os.environ)
         want = self._env_extra.get("MPI_TPU_RANK_JAX_PLATFORMS")
         cpu_pinned_env(env, want)
         env.update({
             "MPI_TPU_RANK": str(slot),
-            "MPI_TPU_SIZE": str(self.pool_size),
-            "MPI_TPU_RDV": self.rdv,
-            "MPI_TPU_BACKEND": self.backend,
+            "MPI_TPU_SIZE": str(pool.size),
+            "MPI_TPU_RDV": pool.rdv,
+            "MPI_TPU_BACKEND": pool.backend,
             "MPI_TPU_FT": "1",
             "MPI_TPU_SERVE_CTRL": self.addr,
+            "MPI_TPU_SERVE_POOL": pool_id,
             "MPI_TPU_SERVE_DETECT_S": str(self.detect_timeout_s),
             "MPI_TPU_SERVE_HEARTBEAT_S": str(self.heartbeat_s),
+            "MPI_TPU_SERVE_ORPHAN_TIMEOUT_S": str(self.orphan_timeout_s),
         })
+        env.pop("MPI_TPU_SERVE_FED", None)
+        if self._fed_ns is not None:
+            env["MPI_TPU_SERVE_FED"] = self._fed_ns
         env.pop("MPI_TPU_SERVE_REJOIN", None)
         if rejoin_epoch is not None:
             env["MPI_TPU_SERVE_REJOIN"] = f"{rejoin_epoch}:{slot}"
@@ -617,15 +931,15 @@ class WorldServer:
         env.update(self._env_extra)
         return env
 
-    def _spawn_worker(self, slot: int,
+    def _spawn_worker(self, key: Tuple[str, int],
                       rejoin_epoch: Optional[int] = None
                       ) -> subprocess.Popen:
         proc = subprocess.Popen(
             [sys.executable, "-m", "mpi_tpu.serve", "--worker"],
-            env=self._worker_env(slot, rejoin_epoch))
+            env=self._worker_env(key, rejoin_epoch))
         if rejoin_epoch is None:
-            self._workers[slot].proc = proc
-            self._workers[slot].spawned_at = time.monotonic()
+            self._workers[key].proc = proc
+            self._workers[key].spawned_at = time.monotonic()
         return proc
 
     # -- accept / connection handling --------------------------------------
@@ -654,35 +968,61 @@ class WorldServer:
 
     def _worker_loop(self, conn: socket.socket, hello: dict) -> None:
         slot = int(hello["slot"])
+        pool_id = str(hello.get("pool") or self._home)
+        key = (pool_id, slot)
         with self._cond:
-            w = self._workers.get(slot)
-            if w is None:
+            w = self._workers.get(key)
+            pool = self._pools.get(pool_id)
+            if w is None or pool is None:
+                # unknown slot, or a pool relinquished/adopted-away in
+                # the hello race: EOF sends the worker back to the
+                # namespace, where the current owner's record is
                 conn.close()
                 return
-            heal = self._healing.pop(slot, None)
+            if w.conn is not None and w.state in ("idle", "leased") \
+                    and hello.get("incarnation") != w.incarnation:
+                # the slot is LIVE under a different incarnation (e.g.
+                # a long-frozen ex-orphan thawing after its slot was
+                # healed): refuse — two incarnations of one slot must
+                # never coexist, and the EOF sends the zombie through
+                # the re-resolve path to reap itself
+                conn.close()
+                return
+            heal = self._healing.pop(key, None)
             if heal is not None:
                 w.proc = heal["proc"]
                 self.stats_counters["heals_completed"] += 1
+            elif w.proc is None and not pool.home:
+                # an orphan of the adopted pool re-registering (ISSUE
+                # 15): everything about it is warm — it becomes
+                # leasable the moment this hello lands
+                self.stats_counters["orphans_reregistered"] += 1
             w.conn = conn
+            w.pid = hello.get("pid")
             w.incarnation = hello.get("incarnation")
             w.epoch = int(hello.get("epoch", 0))
             w.lease_id = None
+            # an adopted pool learns its epoch from its workers (the
+            # dead server's transitions already reached them)
+            pool.epoch = max(pool.epoch, w.epoch)
             # (conn, lock) pairs snapshotted under the lock — see
             # _begin_heal for the concurrent-death rationale
             peers = [(p.conn, p.send_lock)
                      for p in self._workers.values()
-                     if p is not w and p.conn is not None
+                     if p is not w and p.pool == pool_id
+                     and p.conn is not None
                      and p.state not in ("dead",)]
-            behind = w.epoch < self.epoch
-            catchup = {"op": "transition", "epoch": self.epoch,
+            behind = w.epoch < pool.epoch
+            catchup = {"op": "transition", "epoch": pool.epoch,
                        # never list the hello-ing worker's OWN slot
                        # (its state is still 'dead' right here): a
                        # worker observing itself failed would poison
                        # every FT decision of its future leases
                        "dead": [p.slot for p in self._workers.values()
-                                if p is not w
+                                if p is not w and p.pool == pool_id
                                 and (p.state == "dead"
-                                     or p.slot in self._healing)]}
+                                     or (pool_id, p.slot)
+                                     in self._healing)]}
         if behind:
             # another death's transition was broadcast while this
             # worker was still rejoining (excluded as 'dead'): resync
@@ -714,22 +1054,36 @@ class WorldServer:
             msg = _recv_msg(conn)
             if msg is None:
                 with self._cond:
-                    if not self._closing and self._workers[slot] is w \
+                    if not self._closing \
+                            and self._workers.get(key) is w \
                             and w.conn is conn and w.state != "dead":
                         self._mark_dead_locked(w, "control channel EOF")
                     self._cond.notify_all()
                 return
-            if msg.get("op") == "job_done":
-                self._job_done(slot, msg)
+            op = msg.get("op")
+            if op == "job_done":
+                self._job_done(key, msg)
+            elif op == "pvars":
+                # ISSUE 15 satellite: the idle/wedged-worker pvar push
+                # — latest-per-slot, same aggregation as the job_done
+                # piggyback, so stats() sees workers that never
+                # completed a job.  Existence-guarded: an in-flight
+                # push must not resurrect a key relinquish_pool just
+                # popped (the usurper counts those slots now —
+                # double-counting would falsify the roll-up for good)
+                with self._cond:
+                    if key in self._workers:
+                        self._worker_pvars[key] = msg.get("pvars") or {}
             # transition_acks are informational: the monitor's spawn of
             # the replacement does not wait on them (a wedged worker
             # must not stall the pool's healing)
 
-    def _job_done(self, slot: int, msg: dict) -> None:
+    def _job_done(self, key: Tuple[str, int], msg: dict) -> None:
+        slot = key[1]
         with self._cond:
             pvars = msg.get("pvars")
-            if pvars:
-                self._worker_pvars[slot] = pvars
+            if pvars and key in self._workers:
+                self._worker_pvars[key] = pvars
             job = self._jobs.get(msg["job_id"])
             if job is None:
                 return
@@ -745,17 +1099,19 @@ class WorldServer:
 
     def _mark_dead_locked(self, w: _Worker, why: str) -> None:
         """State transition for a lost worker (caller holds the lock):
-        epoch bump + fail its in-flight job; the monitor loop picks the
-        slot up for healing on its next tick."""
+        pool-epoch bump + fail its in-flight job; the monitor loop
+        picks the slot up for healing on its next tick."""
         if w.state == "dead":
             return
+        pool = self._pools.get(w.pool)
         w.state = "dead"
         w.conn = None
         rec = _telemetry.REC
         if rec is not None:
             rec.emit("lease", "worker_dead",
-                     attrs={"slot": w.slot, "why": why,
-                            "epoch": self.epoch + 1})
+                     attrs={"slot": w.slot, "pool": w.pool, "why": why,
+                            "epoch": (pool.epoch + 1 if pool is not None
+                                      else -1)})
         if w.proc is not None and w.proc.poll() is None:
             # declared dead but the process lives (heartbeat-stale
             # wedge): kill it — two live incarnations of one slot must
@@ -765,10 +1121,17 @@ class WorldServer:
                 w.proc.kill()
             except OSError:
                 pass
+        elif w.proc is None and w.pid:
+            # adopted worker (no Popen handle): same rule, by pid
+            try:
+                os.kill(w.pid, 9)
+            except OSError:
+                pass
         self.stats_counters["workers_lost"] += 1
-        self.epoch += 1
+        if pool is not None:
+            pool.epoch += 1
         for job in self._jobs.values():
-            if w.slot in job["pending"]:
+            if job.get("pool") == w.pool and w.slot in job["pending"]:
                 job["pending"].discard(w.slot)
                 job["errors"].append({
                     "kind": "ProcFailedError",
@@ -780,12 +1143,17 @@ class WorldServer:
 
     # -- monitoring / healing ----------------------------------------------
 
-    def _hb_stale(self, slot: int, now: float) -> bool:
-        try:
-            st = os.stat(os.path.join(self.rdv, f"hb.{slot}"))
-        except OSError:
+    def _hb_stale(self, pool: _Pool, slot: int, now: float) -> bool:
+        age = membership.heartbeat_age(pool.rdv, slot, now)
+        if age is None:
             return False  # not yet published: proc liveness covers it
-        return now - st.st_mtime > 3.0 * self.detect_timeout_s
+        return age > 3.0 * self.detect_timeout_s
+
+    def _adopt_grace_s(self) -> float:
+        """How long an adopted pool's slot may stay 'starting' (its
+        orphan resolving the takeover from the namespace) before its
+        heartbeat decides whether it is a corpse to heal."""
+        return max(5.0, 3.0 * self.detect_timeout_s)
 
     def _monitor_loop(self) -> None:
         while not self._closing:
@@ -820,13 +1188,32 @@ class WorldServer:
     def _monitor_tick(self) -> None:
         now_wall = time.time()
         with self._cond:
-            for w in self._workers.values():
-                if w.state == "dead" or w.slot in self._healing:
+            for key, w in self._workers.items():
+                if w.state == "dead" or key in self._healing:
                     continue
+                pool = self._pools.get(w.pool)
+                if pool is None:
+                    continue  # relinquish race: workers go next tick
                 lost = (w.proc is not None
                         and w.proc.poll() is not None)
+                if not lost and w.proc is None and w.pid \
+                        and w.state != "starting":
+                    # adopted worker: no Popen handle — pid liveness
+                    lost = not membership._pid_alive(w.pid)
                 if not lost and w.state != "starting":
-                    lost = self._hb_stale(w.slot, now_wall)
+                    lost = self._hb_stale(pool, w.slot, now_wall)
+                if not lost and w.state == "starting" \
+                        and pool.adopted_at is not None:
+                    # an adopted slot whose orphan never re-registered:
+                    # past the adoption grace, the heartbeat file (the
+                    # one liveness signal that survives a change of
+                    # ownership) decides corpse-or-slow
+                    if time.monotonic() - pool.adopted_at \
+                            > self._adopt_grace_s():
+                        age = membership.heartbeat_age(pool.rdv, w.slot,
+                                                       now_wall)
+                        lost = (age is None
+                                or age > 3.0 * self.detect_timeout_s)
                 if lost:
                     self._mark_dead_locked(
                         w, "process exited"
@@ -837,54 +1224,71 @@ class WorldServer:
             # flight — deaths are marked both here and by the
             # worker-connection EOF path, and both must converge on
             # a replacement
-            dead_now = [w for w in self._workers.values()
-                        if w.state == "dead"
-                        and w.slot not in self._healing]
-            epoch = self.epoch
+            dead_now = [w for key, w in self._workers.items()
+                        if w.state == "dead" and key not in self._healing]
             if dead_now:
                 self._cond.notify_all()
         if dead_now:
-            self._begin_heal(dead_now, epoch)
+            self._begin_heal(dead_now)
         self._drive_healing()
 
-    def _begin_heal(self, dead: List[_Worker], epoch: int) -> None:
-        """One healing round: tell survivors, announce the vacancies,
-        spawn replacements that rejoin under the new epoch."""
-        dead_slots = [w.slot for w in dead]
-        with self._lock:
-            # snapshot (conn, lock) PAIRS under the lock: a concurrent
-            # death nulls worker.conn, and re-reading it outside the
-            # lock would hand None to sendall (AttributeError kills the
-            # monitor thread — the pool would stop healing entirely)
-            live = [(p.conn, p.send_lock) for p in self._workers.values()
-                    if p.state not in ("dead", "starting")
-                    and p.conn is not None]
-        for conn, lk in live:
-            try:
-                _send_msg(conn, lk, {"op": "transition", "epoch": epoch,
-                                     "dead": dead_slots})
-            except OSError:
-                pass  # its own death will be noticed next tick
-        slots_meta = {
-            s: {"ousted": membership.read_incarnation(self.rdv, s),
-                # the server IS the membership authority: it observed
-                # the death and decided to replace, which is the ack —
-                # the refusal gate still protects against an UNINVITED
-                # ousted incarnation claiming before the server's
-                # replacement (it presents the ousted id; the spawned
-                # replacement presents a fresh one)
-                "acked": False}
-            for s in dead_slots}
-        membership.announce_rejoin(self.rdv, epoch, slots_meta,
-                                   self.pool_size, self.backend)
-        with self._lock:
-            if self._closing:
-                return  # a stop() racing this heal owns every process
-            for w in dead:
-                proc = self._spawn_worker(w.slot, rejoin_epoch=epoch)
-                self._healing[w.slot] = {
-                    "epoch": epoch, "proc": proc,
-                    "since": time.monotonic(), "meta": slots_meta}
+    def _begin_heal(self, dead: List[_Worker]) -> None:
+        """One healing round per affected pool: tell that pool's
+        survivors, announce the vacancies on ITS rendezvous dir, spawn
+        replacements that rejoin under the pool's bumped epoch —
+        identical for the home pool and an adopted one (the membership
+        protocol is all files under the pool's own rdv)."""
+        by_pool: Dict[str, List[_Worker]] = {}
+        for w in dead:
+            by_pool.setdefault(w.pool, []).append(w)
+        for pool_id, ws in by_pool.items():
+            dead_slots = [w.slot for w in ws]
+            with self._lock:
+                pool = self._pools.get(pool_id)
+                if pool is None:
+                    continue  # relinquished mid-round: new owner heals
+                epoch = pool.epoch
+                # snapshot (conn, lock) PAIRS under the lock: a
+                # concurrent death nulls worker.conn, and re-reading it
+                # outside the lock would hand None to sendall
+                # (AttributeError kills the monitor thread — the pool
+                # would stop healing entirely)
+                live = [(p.conn, p.send_lock)
+                        for p in self._workers.values()
+                        if p.pool == pool_id
+                        and p.state not in ("dead", "starting")
+                        and p.conn is not None]
+            for conn, lk in live:
+                try:
+                    _send_msg(conn, lk,
+                              {"op": "transition", "epoch": epoch,
+                               "dead": dead_slots})
+                except OSError:
+                    pass  # its own death will be noticed next tick
+            slots_meta = {
+                s: {"ousted": membership.read_incarnation(pool.rdv, s),
+                    # the server IS the membership authority: it
+                    # observed the death and decided to replace, which
+                    # is the ack — the refusal gate still protects
+                    # against an UNINVITED ousted incarnation claiming
+                    # before the server's replacement (it presents the
+                    # ousted id; the spawned replacement presents a
+                    # fresh one)
+                    "acked": False}
+                for s in dead_slots}
+            membership.announce_rejoin(pool.rdv, epoch, slots_meta,
+                                       pool.size, pool.backend)
+            with self._lock:
+                if self._closing:
+                    return  # a stop() racing this heal owns every process
+                if pool_id not in self._pools:
+                    continue  # relinquished while announcing
+                for w in ws:
+                    key = (pool_id, w.slot)
+                    proc = self._spawn_worker(key, rejoin_epoch=epoch)
+                    self._healing[key] = {
+                        "epoch": epoch, "proc": proc,
+                        "since": time.monotonic(), "meta": slots_meta}
 
     def _drive_healing(self) -> None:
         """Per-tick healing duties: validate claims/admit replacements
@@ -893,18 +1297,30 @@ class WorldServer:
         pool recovers, no epoch fork (the announce stays valid)."""
         with self._lock:
             healing = dict(self._healing)
-        for slot, h in healing.items():
-            membership.process_claims(self.rdv, h["epoch"],
+        for key, h in healing.items():
+            pool_id, slot = key
+            pool = self._pools.get(pool_id)
+            if pool is None:
+                # the pool was relinquished mid-heal: the usurper owns
+                # its healing now — reap our half-spawned replacement
+                with self._lock:
+                    self._healing.pop(key, None)
+                try:
+                    h["proc"].kill()
+                except OSError:
+                    pass
+                continue
+            membership.process_claims(pool.rdv, h["epoch"],
                                       {slot: h["meta"][slot]})
             proc = h["proc"]
             if proc.poll() is not None:
                 with self._lock:
-                    if self._closing or slot not in self._healing:
+                    if self._closing or key not in self._healing:
                         continue
                     h["proc"] = self._spawn_worker(
-                        slot, rejoin_epoch=h["epoch"])
+                        key, rejoin_epoch=h["epoch"])
                     h["since"] = time.monotonic()
-                    self._healing[slot] = h
+                    self._healing[key] = h
             elif time.monotonic() - h["since"] > self.rejoin_timeout_s:
                 # the replacement is ALIVE but wedged in its handshake
                 # past the rejoin bound: kill it — next tick's poll()
@@ -916,12 +1332,141 @@ class WorldServer:
                 # possibly-leased worker would livelock healing
                 with self._lock:
                     still = (not self._closing
-                             and self._healing.get(slot) is h)
+                             and self._healing.get(key) is h)
                 if still:
                     try:
                         proc.kill()
                     except OSError:
                         pass
+
+    # -- federation hooks (ISSUE 15; called by FederationMember) -----------
+
+    def owned_pool_records(self) -> Dict[str, dict]:
+        """Metadata of every pool this server currently serves — what
+        the federation member publishes as ownership records."""
+        with self._lock:
+            return {pid: {"rdv": p.rdv, "backend": p.backend,
+                          "size": p.size, "epoch": p.epoch,
+                          "since": p.owned_since}
+                    for pid, p in self._pools.items()}
+
+    def fed_summary(self) -> dict:
+        """The light per-server summary embedded in the endpoint
+        record (federation_stats sums these across the namespace)."""
+        now = time.monotonic()
+        with self._lock:
+            states = [w.state for w in self._workers.values()]
+            return {"pools": len(self._pools),
+                    "workers": len(states),
+                    "idle": states.count("idle"),
+                    "leases_active": len(self._leases),
+                    "waiting": len(self._waiters),
+                    "worlds_per_s": self._worlds_per_s_locked(now),
+                    "backend": self.backend}
+
+    def adopt_pool(self, pool_id: str, rec: dict, term: int = 0) -> bool:
+        """Take over a dead server's pool (leader-assigned takeover):
+        register its metadata and one 'starting' worker entry per slot
+        — the live orphans re-register via their control-channel
+        re-resolve, and a slot whose orphan never shows is healed
+        through the normal announce/claim/admit path against the
+        adopted rendezvous dir after the adoption grace."""
+        with self._cond:
+            if self._closing or pool_id in self._pools:
+                return False
+            pool = _Pool(pool_id, rec["rdv"],
+                         rec.get("backend", "socket"), int(rec["size"]),
+                         home=False, epoch=int(rec.get("epoch", 0)))
+            self._pools[pool_id] = pool
+            for s in range(pool.size):
+                self._workers[(pool_id, s)] = _Worker(s, pool_id)
+            self.stats_counters["pools_adopted"] += 1
+            self._cond.notify_all()
+        rec_t = _telemetry.REC
+        if rec_t is not None:
+            rec_t.emit("serve", "pool_adopted",
+                       attrs={"pool": pool_id, "size": pool.size,
+                              "epoch": pool.epoch, "term": term})
+        sys.stderr.write(
+            f"mpi_tpu.serve: server {self.server_id} adopted pool "
+            f"{pool_id} ({pool.size} slots, epoch {pool.epoch}, "
+            f"term {term})\n")
+        return True
+
+    def relinquish_pool(self, pool_id: str,
+                        new_owner: Optional[str] = None) -> None:
+        """The thawed-usurped path: the namespace says another server
+        now owns this pool — stop serving it IMMEDIATELY.  Closing the
+        worker control connections is the handover itself (a worker
+        serves exactly one master at a time; EOF sends it to the
+        namespace, where the usurper's record is), and every in-flight
+        lease on the pool fails with a NAMED error, never a hang."""
+        with self._cond:
+            pool = self._pools.pop(pool_id, None)
+            if pool is None:
+                return
+            if pool.home:
+                self._relinquished_home_epoch = pool.epoch
+            conns = []
+            for key in [k for k in self._workers if k[0] == pool_id]:
+                w = self._workers.pop(key)
+                if w.conn is not None:
+                    conns.append(w.conn)
+                self._worker_pvars.pop(key, None)
+            heal_procs = [self._healing.pop(k)["proc"]
+                          for k in list(self._healing)
+                          if k[0] == pool_id]
+            for job in self._jobs.values():
+                if job.get("pool") == pool_id and job["pending"]:
+                    job["pending"].clear()
+                    job["errors"].append({
+                        "kind": "TransportError",
+                        "msg": f"pool {pool_id} taken over by server "
+                               f"{new_owner} (ownership moved "
+                               f"mid-lease; re-acquire)",
+                        "failed": [], "collective": None})
+                    job["event"].set()
+            for lease_id in [lid for lid, lease in self._leases.items()
+                             if lease.get("pool") == pool_id]:
+                self._leases.pop(lease_id)
+            # queued acquires that can NEVER be satisfied by the
+            # remaining pools must fail over NOW with the named
+            # signal, not stall to a LeaseTimeout the federated
+            # client treats as a live-server verdict
+            cap = max((p.size for p in self._pools.values()),
+                      default=0)
+            for waiter in self._waiters:
+                if waiter["nranks"] > cap:
+                    waiter["lost"] = True
+            self.stats_counters["pools_relinquished"] += 1
+            self._cond.notify_all()
+        for c in conns:
+            try:
+                # shutdown first: the worker side's reader thread is
+                # blocked in recv(), which a bare close() never wakes
+                c.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                c.close()
+            except OSError:
+                pass
+        for p in heal_procs:
+            try:
+                p.kill()  # the usurper heals its own pool
+            except OSError:
+                pass
+        rec_t = _telemetry.REC
+        if rec_t is not None:
+            rec_t.emit("serve", "pool_relinquished",
+                       attrs={"pool": pool_id, "to": new_owner})
+        sys.stderr.write(
+            f"mpi_tpu.serve: server {self.server_id} relinquished pool "
+            f"{pool_id} to {new_owner} (taken over while this server "
+            f"was unresponsive)\n")
+
+    def is_leader(self) -> bool:
+        return self._fed is not None and self._fed.is_leader()
 
     # -- client side -------------------------------------------------------
 
@@ -967,40 +1512,156 @@ class WorldServer:
         return {"error": {"kind": "ValueError",
                           "msg": f"unknown op {op!r}"}}
 
+    def _pick_idle_locked(self, nranks: int
+                          ) -> Optional[Tuple[str, List[int]]]:
+        """A pool with ``nranks`` idle slots (a lease never spans
+        pools — they are different transport worlds).  BEST-FIT: the
+        pool with the FEWEST idle slots that still satisfies (home as
+        the tiebreak), so small leases are packed into small remnants
+        and a large later request keeps an unfragmented pool to land
+        on — most-idle-first would carve up exactly the pool a
+        full-size lease needs."""
+        best = None
+        for pool_id, pool in self._pools.items():
+            idle = sorted(s for (pid, s), w in self._workers.items()
+                          if pid == pool_id and w.state == "idle")
+            if len(idle) >= nranks:
+                score = (len(idle), 0 if pool.home else 1, pool_id)
+                if best is None or score < best[0]:
+                    best = (score, pool_id, idle[:nranks])
+        return None if best is None else (best[1], best[2])
+
+    def _try_grant_locked(self, waiter: dict
+                          ) -> Optional[Tuple[str, List[int]]]:
+        """Grant ``waiter`` iff it is the first waiter in admission
+        order (priority → fair share → FIFO) that the current idle
+        capacity can satisfy."""
+        for w in _admission_order(self._waiters, self._client_grants):
+            pick = self._pick_idle_locked(w["nranks"])
+            if pick is None:
+                continue
+            return pick if w is waiter else None
+        return None
+
     def _acquire(self, msg: dict, owned: List[int]) -> dict:
         nranks = int(msg["nranks"])
-        if nranks < 1 or nranks > self.pool_size:
-            raise ValueError(
-                f"nranks must be in [1, {self.pool_size}] for this pool")
         timeout = float(msg.get("timeout") or self.world_lease_timeout_s)
+        client_id = str(msg.get("client") or "anon")
+        priority = int(msg.get("priority") or 0)
         t_req = time.monotonic()
         deadline = t_req + timeout
         with self._cond:
-            while True:
-                if self._closing:
-                    raise RuntimeError("server shutting down")
-                idle = sorted(s for s, w in self._workers.items()
-                              if w.state == "idle")
-                if len(idle) >= nranks:
-                    break
-                remaining = deadline - time.monotonic()
-                if remaining <= 0:
-                    self.stats_counters["leases_denied"] += 1
-                    return {"error": {
-                        "kind": "LeaseTimeout",
-                        "msg": f"no {nranks} idle workers within "
-                               f"{timeout}s (pool {self.pool_size}, "
-                               f"idle {len(idle)})"}}
-                self._cond.wait(min(0.25, remaining))
-            slots = idle[:nranks]
+            # under the lock: the federation thread mutates _pools
+            # (adopt/relinquish) — iterating it bare would crash with
+            # dict-changed-size exactly during a takeover, when failed-
+            # over acquires flood the survivor
+            cap = max((p.size for p in self._pools.values()), default=0)
+            if cap == 0:
+                # every pool relinquished (thawed fully-usurped
+                # server): this endpoint cannot serve ANY lease — ship
+                # the failover signal, not an argument error, so a
+                # federated client moves to a survivor
+                raise ServerLostError(
+                    "server owns no pools (relinquished after a "
+                    "takeover): fail over to a live owner")
+            if nranks < 1 or nranks > cap:
+                raise ValueError(
+                    f"nranks must be in [1, {cap}] for this pool")
+            was_full = len(self._waiters) >= self.max_pending
+            self._seq += 1
+            waiter = {"client": client_id, "priority": priority,
+                      "nranks": nranks, "seq": self._seq}
+            self._waiters.append(waiter)
+            # work-conserving door: an arrival the CURRENT idle
+            # capacity can satisfy (net of better-ranked waiters) is
+            # granted immediately and never occupies a queue slot —
+            # a full queue of unsatisfiable large requests must not
+            # bounce small ones that idle workers could serve now
+            grant = self._try_grant_locked(waiter)
+            if grant is None and was_full:
+                # bounded admission queue with a PRIORITY-AWARE door
+                # (ISSUE 15): overload becomes an immediate named
+                # rejection, not unbounded latency — but an arrival
+                # that outranks the WORST waiter (priority, then fair
+                # share, then FIFO: the same admission order) bumps it
+                # instead, so a flood of low-priority acquires can
+                # never lock a prioritized client out of a full queue.
+                # Either way depth stays <= max_pending and every
+                # rejection is a named ServerBusyError.
+                self._waiters.remove(waiter)
+                order = _admission_order(self._waiters,
+                                         self._client_grants)
+                worst = order[-1] if order else None
+                cand_key = (-priority,
+                            self._client_grants.get(client_id, 0),
+                            waiter["seq"])
+                worst_key = None if worst is None else (
+                    -worst["priority"],
+                    self._client_grants.get(worst["client"], 0),
+                    worst["seq"])
+                self.stats_counters["leases_denied"] += 1
+                self.stats_counters["busy_rejected"] += 1
+                if worst is None or worst_key <= cand_key:
+                    raise ServerBusyError(
+                        f"admission queue full ({self.max_pending} "
+                        f"waiting acquires, capacity "
+                        f"{sum(p.size for p in self._pools.values())} "
+                        f"workers): back off or fail over")
+                worst["bumped"] = True
+                self._waiters.remove(worst)
+                self._waiters.append(waiter)
+                self._cond.notify_all()
+            try:
+                while grant is None:
+                    if self._closing:
+                        raise RuntimeError("server shutting down")
+                    if waiter.get("lost"):
+                        raise ServerLostError(
+                            "the pool(s) that could have served this "
+                            "acquire were relinquished to another "
+                            "server: fail over to the new owner")
+                    if waiter.get("bumped"):
+                        raise ServerBusyError(
+                            f"bumped from the full admission queue by "
+                            f"a higher-ranked acquire "
+                            f"({self.max_pending} waiting): back off "
+                            f"or fail over")
+                    grant = self._try_grant_locked(waiter)
+                    if grant is not None:
+                        break
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        idle = sum(1 for w in self._workers.values()
+                                   if w.state == "idle")
+                        self.stats_counters["leases_denied"] += 1
+                        return {"error": {
+                            "kind": "LeaseTimeout",
+                            "msg": f"no {nranks} idle workers within "
+                                   f"{timeout}s (pool {self.pool_size}, "
+                                   f"idle {idle}, waiting "
+                                   f"{len(self._waiters)})"}}
+                    self._cond.wait(min(0.25, remaining))
+            finally:
+                if waiter in self._waiters:
+                    self._waiters.remove(waiter)
+            pool_id, slots = grant
             self._seq += 1
             lease_id = self._seq
             for s in slots:
-                self._workers[s].state = "leased"
-                self._workers[s].lease_id = lease_id
-            epoch = self.epoch
-            self._leases[lease_id] = {"slots": slots, "epoch": epoch}
+                self._workers[(pool_id, s)].state = "leased"
+                self._workers[(pool_id, s)].lease_id = lease_id
+            epoch = self._pools[pool_id].epoch
+            self._leases[lease_id] = {"slots": slots, "epoch": epoch,
+                                      "pool": pool_id}
             self.stats_counters["leases_granted"] += 1
+            # the fair-share ledger: whoever got this grant moves back
+            # in the order among equals.  Bounded: an unbounded client-
+            # uuid dict is a slow leak under connect()-churn, so reset
+            # the baseline rather than grow without limit.
+            self._client_grants[client_id] = \
+                self._client_grants.get(client_id, 0) + 1
+            if len(self._client_grants) > 4096:
+                self._client_grants.clear()
         # lease-acquire latency distribution (ISSUE 13): always on —
         # the grant is a control round-trip, one histogram add is noise
         # (this is what the metrics endpoint's p50/p99 summarize)
@@ -1009,10 +1670,10 @@ class WorldServer:
         if rec is not None:
             rec.emit("lease", "grant",
                      attrs={"lease_id": lease_id, "slots": slots,
-                            "epoch": epoch})
+                            "pool": pool_id, "epoch": epoch})
         owned.append(lease_id)
         return {"ok": True, "lease_id": lease_id, "slots": slots,
-                "epoch": epoch}
+                "epoch": epoch, "pool": pool_id}
 
     def _run_job(self, msg: dict) -> dict:
         lease_id = int(msg["lease_id"])
@@ -1021,14 +1682,16 @@ class WorldServer:
             lease = self._leases.get(lease_id)
             if lease is None:
                 raise ValueError(f"unknown lease {lease_id}")
+            pool_id = lease.get("pool", self._home)
             slots = list(lease["slots"])
             dead = [s for s in slots
-                    if self._workers[s].state != "leased"
-                    or self._workers[s].lease_id != lease_id]
+                    if self._workers[(pool_id, s)].state != "leased"
+                    or self._workers[(pool_id, s)].lease_id != lease_id]
             self._seq += 1
             job_id = self._seq
             job = {"pending": set(slots) - set(dead), "errors": [],
-                   "result": None, "event": threading.Event()}
+                   "result": None, "event": threading.Event(),
+                   "pool": pool_id}
             if dead:
                 job["errors"].append({
                     "kind": "ProcFailedError",
@@ -1037,7 +1700,8 @@ class WorldServer:
                            f"the job started",
                     "failed": dead, "collective": None})
             self._jobs[job_id] = job
-            targets = [(self._workers[s].conn, self._workers[s].send_lock)
+            targets = [(self._workers[(pool_id, s)].conn,
+                        self._workers[(pool_id, s)].send_lock)
                        for s in job["pending"]]
         if not job["pending"]:
             job["event"].set()
@@ -1055,10 +1719,15 @@ class WorldServer:
         with self._cond:
             self._jobs.pop(job_id, None)
             stuck = sorted(job["pending"])
-            # pin the exact PROC OBJECTS while holding the lock: a
-            # concurrent heal could install a healthy replacement under
-            # the same slot, and signalling by slot would dump/kill it
-            stuck_procs = [(s, self._workers[s].proc) for s in stuck]
+            # pin the exact PROC OBJECTS (and, for adopted workers that
+            # were never our children, the hello pid) while holding the
+            # lock: a concurrent heal could install a healthy
+            # replacement under the same slot, and signalling by slot
+            # would dump/kill it
+            stuck_procs = [(s, self._workers[(pool_id, s)].proc,
+                            self._workers[(pool_id, s)].pid)
+                           for s in stuck
+                           if (pool_id, s) in self._workers]
         if not ok:
             # dump the unresponsive workers' stacks to their stderr
             # (faulthandler SIGUSR2 handler) for the diagnosis, then
@@ -1070,12 +1739,18 @@ class WorldServer:
             # fresh replacement under the next epoch
             import signal as _signal
 
-            for s, proc in stuck_procs:
+            for s, proc, pid in stuck_procs:
+                target = None
                 if proc is not None and proc.poll() is None:
+                    target = proc.pid
+                elif proc is None and pid \
+                        and membership._pid_alive(pid):
+                    target = pid
+                if target is not None:
                     try:
-                        os.kill(proc.pid, _signal.SIGUSR2)
+                        os.kill(target, _signal.SIGUSR2)
                         time.sleep(0.1)  # let the dump reach stderr
-                        proc.kill()
+                        os.kill(target, _signal.SIGKILL)
                     except OSError:
                         pass
             sys.stderr.write(
@@ -1117,23 +1792,39 @@ class WorldServer:
             lease = self._leases.pop(lease_id, None)
             if lease is None:
                 return
+            pool_id = lease.get("pool", self._home)
             for s in lease["slots"]:
-                w = self._workers[s]
-                if w.state == "leased" and w.lease_id == lease_id:
+                w = self._workers.get((pool_id, s))
+                if w is not None and w.state == "leased" \
+                        and w.lease_id == lease_id:
                     w.state = "idle"
                     w.lease_id = None
             self._cond.notify_all()
 
+    def _worlds_per_s_locked(self, now: float) -> float:
+        # worlds/s over the sliding window (completed jobs), the
+        # gauge ROADMAP direction 1 asks for; uptime-bounded so a
+        # young server reads its true rate, not a diluted one
+        window = min(_RATE_WINDOW_S, max(1e-9, now - self._t0))
+        recent = sum(c for sec, c in self._ok_buckets.items()
+                     if now - sec <= _RATE_WINDOW_S)
+        return round(recent / window, 3)
+
     def stats(self) -> dict:
         now = time.monotonic()
         with self._lock:
-            states = {s: w.state for s, w in self._workers.items()}
-            # worlds/s over the sliding window (completed jobs), the
-            # gauge ROADMAP direction 1 asks for; uptime-bounded so a
-            # young server reads its true rate, not a diluted one
-            window = min(_RATE_WINDOW_S, max(1e-9, now - self._t0))
-            recent = sum(c for sec, c in self._ok_buckets.items()
-                         if now - sec <= _RATE_WINDOW_S)
+            # single-pool back-compat: "workers"/"epoch" describe the
+            # HOME pool; "idle" counts every pool (a lease can land on
+            # any); "pools" carries the per-pool detail
+            states = {s: w.state for (pid, s), w in self._workers.items()
+                      if pid == self._home}
+            pools = {
+                pid: {"home": p.home, "epoch": p.epoch, "size": p.size,
+                      "workers": {s: w.state
+                                  for (wp, s), w
+                                  in self._workers.items()
+                                  if wp == pid}}
+                for pid, p in self._pools.items()}
             agg: Dict[str, int] = {}
             for snap in self._worker_pvars.values():
                 for k, v in snap.items():
@@ -1142,15 +1833,33 @@ class WorldServer:
                 "addr": self.addr, "backend": self.backend,
                 "pool_size": self.pool_size, "epoch": self.epoch,
                 "workers": states,
-                "idle": sum(1 for v in states.values() if v == "idle"),
-                "healing": sorted(self._healing),
+                "idle": sum(1 for w in self._workers.values()
+                            if w.state == "idle"),
+                "healing": [f"{pid}:{s}"
+                            for pid, s in sorted(self._healing)],
                 "leases_active": len(self._leases),
                 "uptime_s": round(now - self._t0, 3),
-                "worlds_per_s": round(recent / window, 3),
+                "worlds_per_s": self._worlds_per_s_locked(now),
                 "worker_pvars": agg,
                 "metrics_addr": self.metrics_addr,
+                "pools": pools,
+                "waiting": len(self._waiters),
+                "max_pending": self.max_pending,
+                "server_id": self.server_id,
                 **self.stats_counters,
             }
+        # None (not False) outside a federation: a standalone server
+        # must not scrape as a non-leader federation member
+        out["is_leader"] = (self.is_leader() if self._fed is not None
+                            else None)
+        if self._fed_ns is not None:
+            # namespace roll-up (file reads; deliberately OUTSIDE the
+            # server lock): keeps the Prometheus endpoint truthful
+            # when pools move between servers
+            from . import federation as _federation
+
+            out["federation"] = _federation.federation_stats(
+                self._fed_ns)
         # lease-acquire quantiles from the histogram pvar (log-bucket
         # estimates — mpit.hist_quantile documents the error bound)
         for q, label in ((0.5, "p50"), (0.99, "p99")):
@@ -1167,11 +1876,13 @@ class WorldLease:
     """A leased world: run jobs on it, release it when done."""
 
     def __init__(self, client: "ServerClient", lease_id: int,
-                 slots: List[int], epoch: int) -> None:
+                 slots: List[int], epoch: int,
+                 pool: Optional[str] = None) -> None:
         self._client = client
         self.lease_id = lease_id
         self.slots = list(slots)
         self.epoch = int(epoch)
+        self.pool = pool  # which pool served it (federation takeovers)
         self._released = False
 
     @property
@@ -1211,43 +1922,65 @@ class WorldLease:
 class ServerClient:
     """Client handle to a resident world server (see :func:`connect`).
 
-    The initial connect retries ``ConnectionRefusedError`` with
-    exponential backoff + jitter for up to the ``connect_retry_timeout_s``
-    mpit cvar (mpi_tpu/resilience.py): a freshly-spawned server
-    (``launcher serve --addr-file`` races its own bind) looks exactly
-    like a refused connection, and first-failure raise forced every
-    caller to hand-roll the same sleep loop.  Any other failure — or a
-    refusal that outlives the budget — raises as before."""
+    The initial connect retries the TRANSIENT dial failures
+    (ConnectionRefusedError AND a connect timeout — ISSUE 15 satellite;
+    mpi_tpu/resilience.py TRANSIENT_DIAL_ERRORS) with exponential
+    backoff + jitter for up to the ``connect_retry_timeout_s`` mpit
+    cvar: a freshly-spawned server (``launcher serve --addr-file``
+    races its own bind) and a just-elected federation survivor look
+    exactly like a refused/absorbed connection.  Any other failure — or
+    one that outlives the budget — raises as before.
 
-    def __init__(self, host: str, port: int, timeout: float = 30.0) -> None:
+    A connection that dies MID-REQUEST raises :class:`ServerLostError`
+    (a named TransportError subclass): the server process itself is
+    gone, which is what a federated client fails over on."""
+
+    def __init__(self, host: str, port: int, timeout: float = 30.0,
+                 priority: int = 0, client_id: Optional[str] = None,
+                 dial_retry_s: Optional[float] = None) -> None:
         from .resilience import retry_connect
 
         self._sock = retry_connect(
             lambda: socket.create_connection((host, port),
-                                             timeout=timeout))
+                                             timeout=timeout),
+            timeout_s=dial_retry_s)
         self._sock.settimeout(None)
         self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         self._lock = threading.Lock()  # one request/response in flight
+        # fair-share identity + default priority (ISSUE 15): the server
+        # schedules waiting acquires by (priority, grants-per-client,
+        # FIFO) — one uuid per client handle is the ledger key
+        self._id = client_id or uuid.uuid4().hex
+        self.priority = int(priority)
 
     def _request(self, msg: dict) -> dict:
         with self._lock:
-            _send_msg(self._sock, None, msg)
-            reply = _recv_msg(self._sock)
+            try:
+                _send_msg(self._sock, None, msg)
+                reply = _recv_msg(self._sock)
+            except OSError as e:
+                raise ServerLostError(
+                    f"world server connection lost mid-request: "
+                    f"{type(e).__name__}: {e}") from e
         if reply is None:
-            raise TransportError("world server closed the connection")
+            raise ServerLostError("world server closed the connection")
         if "error" in reply:
             _raise_error(reply["error"])
         return reply
 
-    def acquire(self, nranks: int,
-                timeout: Optional[float] = None) -> WorldLease:
+    def acquire(self, nranks: int, timeout: Optional[float] = None,
+                priority: Optional[int] = None) -> WorldLease:
         """Lease ``nranks`` warm workers as a world: ONE round-trip (the
         server reserves idle slots; no fork, no handshake).  Raises
-        TimeoutError when the pool cannot supply them in time."""
-        reply = self._request({"op": "acquire", "nranks": int(nranks),
-                               "timeout": timeout})
+        TimeoutError when the pool cannot supply them in time, and
+        ServerBusyError when the admission queue is at its bound."""
+        reply = self._request({
+            "op": "acquire", "nranks": int(nranks), "timeout": timeout,
+            "client": self._id,
+            "priority": self.priority if priority is None
+            else int(priority)})
         return WorldLease(self, reply["lease_id"], reply["slots"],
-                          reply["epoch"])
+                          reply["epoch"], pool=reply.get("pool"))
 
     def run(self, fn, *args: Any, nranks: int = 2,
             timeout: Optional[float] = None) -> Any:
@@ -1284,23 +2017,98 @@ class ServerClient:
         self.close()
 
 
-def connect(addr: Any, timeout: float = 30.0) -> ServerClient:
-    """Connect to a resident world server.  ``addr`` is ``"host:port"``,
-    a ``(host, port)`` tuple, a :class:`WorldServer` (in-process), or a
-    path to a file containing ``host:port`` (the launcher's
-    ``serve --addr-file``)."""
+def _parse_hostport(text: str) -> Optional[Tuple[str, int]]:
+    host, _, port = text.rpartition(":")
+    if not host:
+        return None
+    try:
+        return host, int(port)
+    except ValueError:
+        return None
+
+
+def _resolve_addr_file(path: str) -> Tuple[str, int]:
+    """Resolve a ``serve --addr-file`` path to (host, port), retrying a
+    MISSING or PARTIALLY-WRITTEN file with backoff for up to the
+    ``connect_retry_timeout_s`` budget (ISSUE 15 satellite): a
+    just-started — or just-elected — server publishing its record
+    loses the race against an eager client routinely, and that is the
+    same transient the refused-dial retry already heals.  A file that
+    never appears (or never parses) within the budget raises a named
+    TransportError; budget 0 keeps first-failure raise."""
+    from .resilience import backoff_delays
+
+    budget = float(_mpit.cvar_read("connect_retry_timeout_s"))
+    deadline = time.monotonic() + budget
+    delays = backoff_delays()
+    while True:
+        content = ""
+        try:
+            with open(path) as f:
+                content = f.read().strip()
+        except OSError:
+            pass
+        got = _parse_hostport(content) if content else None
+        if got is not None:
+            return got
+        if time.monotonic() > deadline:
+            raise TransportError(
+                f"server address file {path!r} was not published as a "
+                f"parseable host:port within {budget}s "
+                f"(content {content[:40]!r})")
+        time.sleep(min(next(delays), 0.25))
+
+
+def connect(addr: Any, timeout: float = 30.0, priority: int = 0):
+    """Connect to a resident world server — or a FEDERATION of them.
+
+    ``addr`` is one of:
+
+    * ``"host:port"``, a ``(host, port)`` tuple, or an in-process
+      :class:`WorldServer` → a plain :class:`ServerClient`;
+    * a path to a file containing ``host:port`` (the launcher's
+      ``serve --addr-file``) → a :class:`ServerClient`; a missing or
+      partially-written file is retried within the
+      ``connect_retry_timeout_s`` budget;
+    * a path to a DIRECTORY (a ``serve --federation`` namespace) or a
+      list of ``"host:port"`` strings → a
+      :class:`~mpi_tpu.federation.FederatedClient` that resolves live
+      servers and fails acquire/stats over on server death."""
     if isinstance(addr, WorldServer):
         addr = addr.addr
     if isinstance(addr, (tuple, list)):
+        # a server LIST only when every element is a "host:port"
+        # string; anything else — including the legacy (host, port)
+        # tuple whose port arrived as a string ("8080" has no colon) —
+        # keeps the single-server meaning
+        if addr and all(isinstance(a, str) and ":" in a for a in addr):
+            from . import federation as _federation
+
+            return _federation.FederatedClient(
+                addrs=list(addr), timeout=timeout, priority=priority)
         host, port = addr[0], int(addr[1])
+        return ServerClient(host, port, timeout=timeout,
+                            priority=priority)
+    text = str(addr)
+    if os.path.isdir(text):
+        from . import federation as _federation
+
+        return _federation.FederatedClient(
+            namespace=text, timeout=timeout, priority=priority)
+    direct = None if os.path.exists(text) else _parse_hostport(text)
+    if direct is not None:
+        host, port = direct
+    elif os.path.exists(text) or os.sep in text:
+        # an existing file, or a PATH-shaped string that must be a
+        # yet-to-be-published addr file: poll it within the budget
+        host, port = _resolve_addr_file(text)
     else:
-        text = str(addr)
-        if os.path.exists(text):
-            with open(text) as f:
-                text = f.read().strip()
-        host, port = text.rsplit(":", 1)
-        port = int(port)
-    return ServerClient(host, port, timeout=timeout)
+        # neither host:port nor path-shaped: a typo deserves an
+        # immediate diagnostic, not a silent poll of the full budget
+        raise ValueError(
+            f"connect: {text!r} is neither a host:port address nor a "
+            f"path to an addr file / federation namespace")
+    return ServerClient(host, port, timeout=timeout, priority=priority)
 
 
 # -- CLI ----------------------------------------------------------------------
@@ -1340,6 +2148,31 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                          "worker health, aggregated worker pvars) on "
                          "this HTTP port; 0 binds an ephemeral port "
                          "(printed at startup)")
+    ap.add_argument("--federation", default=None, metavar="DIR",
+                    help="join the federation namespace DIR "
+                         "(mpi_tpu/federation.py): N servers share it "
+                         "via endpoint records + a file-lease leader; "
+                         "a dead server's pool is adopted by a "
+                         "survivor and its workers re-register there; "
+                         "clients connect(DIR) and fail over")
+    ap.add_argument("--server-id", default=None,
+                    help="federation identity (default: random "
+                         "srv-<hex8>)")
+    ap.add_argument("--fed-lease-timeout", type=float,
+                    default=_FED_LEASE_TIMEOUT_S, metavar="S",
+                    help="leader-lease takeover bound; authority "
+                         "self-expires at half this (the split-brain "
+                         "safety margin)")
+    ap.add_argument("--max-pending", type=int, default=_MAX_PENDING,
+                    help="bounded admission queue depth: acquires "
+                         "beyond this many waiters are rejected with "
+                         "ServerBusyError instead of queueing "
+                         "unboundedly")
+    ap.add_argument("--orphan-timeout", type=float,
+                    default=_ORPHAN_TIMEOUT_S, metavar="S",
+                    help="how long an orphaned worker polls the "
+                         "federation namespace for its pool's new "
+                         "owner before exiting")
     args = ap.parse_args(argv)
     server = WorldServer(
         pool_size=args.pool_size, backend=args.backend, host=args.host,
@@ -1347,10 +2180,17 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         heartbeat_s=args.heartbeat,
         world_lease_timeout_s=args.lease_timeout,
         rejoin_timeout_s=args.rejoin_timeout,
-        metrics_port=args.metrics_port)
+        metrics_port=args.metrics_port,
+        federation=args.federation, server_id=args.server_id,
+        fed_lease_timeout_s=args.fed_lease_timeout,
+        max_pending=args.max_pending,
+        orphan_timeout_s=args.orphan_timeout)
     server.start()
     print(f"mpi_tpu serve: listening on {server.addr} "
           f"(pool {args.pool_size} x {args.backend})", flush=True)
+    if args.federation:
+        print(f"mpi_tpu serve: federation member {server.server_id} "
+              f"in {args.federation}", flush=True)
     if server.metrics_addr:
         print(f"mpi_tpu serve: metrics on "
               f"http://{server.metrics_addr}/metrics", flush=True)

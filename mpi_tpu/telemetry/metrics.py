@@ -16,11 +16,18 @@ from .. import mpit as _mpit
 
 # stats() keys rendered as monotone counters (name -> _total metric)
 _COUNTER_KEYS = ("leases_granted", "leases_denied", "jobs_ok",
-                 "jobs_failed", "heals_completed", "workers_lost")
+                 "jobs_failed", "heals_completed", "workers_lost",
+                 "busy_rejected", "orphans_reregistered",
+                 "pools_adopted", "pools_relinquished")
 
 # stats() keys rendered as gauges
 _GAUGE_KEYS = ("epoch", "pool_size", "idle", "leases_active",
-               "worlds_per_s", "uptime_s")
+               "worlds_per_s", "uptime_s", "waiting", "max_pending")
+
+# federation roll-up keys (stats()["federation"]) rendered as gauges
+_FED_GAUGE_KEYS = ("servers_total", "servers_live", "worlds_per_s",
+                   "workers", "idle", "pools", "leases_active",
+                   "waiting", "leader_term")
 
 _PREFIX = "mpi_tpu_serve"
 
@@ -70,6 +77,43 @@ def prometheus_text(stats: Dict,
         metric = f"{_PREFIX}_healing_slots"
         lines.append(f"# TYPE {metric} gauge")
         lines.append(f"{metric} {len(healing)}")
+    # multi-pool detail (ISSUE 15): per-pool epoch + worker states,
+    # labeled by pool id, so a scrape distinguishes the home pool from
+    # adopted ones after a takeover
+    pools = stats.get("pools") or {}
+    if len(pools) > 1 or any(not p.get("home") for p in pools.values()):
+        metric = f"{_PREFIX}_pool_epoch"
+        lines.append(f"# TYPE {metric} gauge")
+        for pid, p in sorted(pools.items()):
+            home = "true" if p.get("home") else "false"
+            lines.append(f'{metric}{{pool="{pid}",home="{home}"}} '
+                         f'{_fmt(p.get("epoch", 0))}')
+        metric = f"{_PREFIX}_pool_worker_state"
+        lines.append(f"# TYPE {metric} gauge")
+        for pid, p in sorted(pools.items()):
+            for slot, state in sorted((p.get("workers") or {}).items()):
+                lines.append(f'{metric}{{pool="{pid}",slot="{slot}",'
+                             f'state="{state}"}} 1')
+    if stats.get("is_leader") is not None:
+        metric = f"{_PREFIX}_is_leader"
+        lines.append(f"# TYPE {metric} gauge")
+        lines.append(f"{metric} {1 if stats['is_leader'] else 0}")
+    # federation namespace roll-up (ISSUE 15): the aggregate the
+    # acceptance scrapes — the endpoint stays truthful when pools move
+    fed = stats.get("federation")
+    if fed:
+        for key in _FED_GAUGE_KEYS:
+            if key in fed:
+                metric = f"mpi_tpu_fed_{key}"
+                lines.append(f"# TYPE {metric} gauge")
+                lines.append(f"{metric} {_fmt(fed[key])}")
+        metric = "mpi_tpu_fed_server_live"
+        lines.append(f"# TYPE {metric} gauge")
+        for sid, rec in sorted((fed.get("servers") or {}).items()):
+            leader = "true" if rec.get("is_leader") else "false"
+            lines.append(
+                f'{metric}{{server="{sid}",leader="{leader}"}} '
+                f'{1 if rec.get("live") else 0}')
     # aggregated worker pvars (piggybacked on job_done replies): the
     # pool's data-plane story — link reconnects, arena hits, detected
     # failures — summed over the latest snapshot of each slot

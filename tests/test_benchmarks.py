@@ -212,6 +212,34 @@ def test_serve_chaos_quick_smoke():
     assert result["unnamed_failures"] == []
 
 
+def test_federation_chaos_quick_smoke():
+    """The federated-serve kill-storm leg (ISSUE 15; the ``bench.py
+    --chaos --federation --quick`` CI spelling): SIGKILL one of two
+    ``launcher serve --federation`` servers under an open-loop fleet of
+    concurrent connect() clients.  The acceptance contract: aggregate
+    worlds/s never reaches zero in any window, every client-visible
+    failure is a NAMED error, the dead server's orphaned workers
+    re-register with the survivor (adopted pool visible, roll-up
+    converges to full strength), the leader-authority log shows no
+    split-brain overlap, and a final cross-server lease is correct."""
+    from benchmarks import chaos
+
+    result = chaos.run_federation_chaos(quick=True)
+    assert result["ok"], {k: result.get(k) for k in
+                          ("kills", "windows_completed",
+                           "unnamed_failures", "healed_to_full_strength",
+                           "adopted_pools_visible", "no_leader_overlap",
+                           "final_cross_server_allreduce_ok",
+                           "final_error", "leader_overlap_error")}
+    assert result["kills"], "no server was killed"
+    assert all(w > 0 for w in result["windows_completed"])
+    assert result["unnamed_failures"] == []
+    assert result["adopted_pools_visible"] >= 1
+    assert result["orphans_reregistered_on_polled_server"] >= 1 or \
+        result["healed_to_full_strength"]
+    assert result["no_leader_overlap"]
+
+
 def test_links_chaos_quick_smoke(tmp_path):
     """The link-fault chaos leg (ISSUE 10; the ``bench.py --chaos
     --links --quick`` CI spelling): connection resets — between frames

@@ -1,0 +1,473 @@
+"""Federated serve fabric (ISSUE 15): leader election, pool takeover,
+admission policy, and the server-tier freeze matrix.
+
+The unit half exercises the file-lease state machine and the admission
+order in-process (deterministic, no subprocesses).  The e2e half runs
+REAL ``launcher serve --federation`` subprocesses and mirrors the PR-10
+rank-freeze matrix one tier up: a briefly-frozen leader keeps its lease
+and NOBODY fails over; frozen past the bound → takeover + pool
+adoption, and the thawed ex-leader detects usurpation and DEMOTES
+(relinquishing its pool) instead of split-brain double-serving — the
+leader-authority interval log is the split-brain assertion."""
+
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import pytest
+
+from mpi_tpu import federation, serve
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+DETECT_S = 1.5
+FED_LEASE_S = 2.0
+# server + workers + pytest exceed this box's cores: the margins mirror
+# tests/test_fault_tolerance.py's load-scaled bound
+LOAD_MARGIN_S = 25.0 if (os.cpu_count() or 1) < 4 else 8.0
+
+
+# -- leader lease (unit) ------------------------------------------------------
+
+
+def test_leader_lease_lifecycle(tmp_path):
+    """Acquire, contested tick, validity lapse, stale takeover with a
+    term bump, thawed-holder demotion, clean release → re-acquire —
+    and the interval log stays overlap-free throughout."""
+    ns = str(tmp_path)
+    a = federation.LeaderLease(ns, "A", lease_timeout_s=0.8)
+    b = federation.LeaderLease(ns, "B", lease_timeout_s=0.8)
+    assert a.tick() and a.is_leader()
+    assert not b.tick() and not b.is_leader()
+    assert a.tick()  # renew extends authority
+    assert federation.read_leader(ns)["id"] == "A"
+    # A freezes (stops ticking): authority lapses at validity_s, the
+    # file goes stale at lease_timeout_s — strictly later
+    time.sleep(0.5)
+    assert not a.is_leader(), "authority must self-expire"
+    assert not b.tick(), "takeover before the stale bound is forbidden"
+    time.sleep(0.5)
+    assert b.tick() and b.is_leader(), "stale lease must be taken over"
+    assert b.term == a.term + 1
+    assert b.takeovers == 1
+    # the thawed ex-holder discovers foreign content and demotes
+    assert not a.tick() and not a.is_leader()
+    assert a.demotions == 1
+    merged = federation.assert_no_leader_overlap(ns)
+    assert [m["id"] for m in merged] == ["A", "B"]
+    b.release()
+    assert federation.read_leader(ns) is None
+    assert a.tick() and a.is_leader()  # clean re-acquire after release
+    # the released lease is a term tombstone: monotonicity survives it
+    assert a.term == b.term + 1
+    federation.assert_no_leader_overlap(ns)
+
+
+def test_leader_takeover_race_single_winner(tmp_path):
+    """Two contenders racing one stale lease: both unlink (idempotent),
+    the O_EXCL create arbitrates — exactly one wins."""
+    import threading
+
+    ns = str(tmp_path)
+    dead = federation.LeaderLease(ns, "dead", lease_timeout_s=0.3)
+    assert dead.tick()
+    time.sleep(0.5)  # stale now
+    contenders = [federation.LeaderLease(ns, f"c{i}", lease_timeout_s=0.3)
+                  for i in range(4)]
+    barrier = threading.Barrier(len(contenders))
+    results = {}
+
+    def race(lease):
+        barrier.wait()
+        results[lease.owner_id] = lease.tick()
+
+    threads = [threading.Thread(target=race, args=(c,))
+               for c in contenders]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(10.0)
+    assert sum(results.values()) == 1, results
+    winner = [cid for cid, won in results.items() if won][0]
+    assert federation.read_leader(ns)["id"] == winner
+    federation.assert_no_leader_overlap(ns)
+
+
+# -- admission policy (unit) --------------------------------------------------
+
+
+def test_admission_order_policy():
+    """The lease scheduler's pure ordering: strict priority first, then
+    fair share (fewest grants per client), then FIFO."""
+    w = [
+        {"client": "a", "priority": 0, "nranks": 1, "seq": 1},
+        {"client": "b", "priority": 0, "nranks": 1, "seq": 2},
+        {"client": "vip", "priority": 2, "nranks": 1, "seq": 3},
+        {"client": "a", "priority": 0, "nranks": 1, "seq": 4},
+    ]
+    # no grants yet: priority wins, then FIFO
+    order = serve._admission_order(w, {})
+    assert [x["seq"] for x in order] == [3, 1, 2, 4]
+    # client a already got 5 grants: b (0 grants) outranks BOTH of a's
+    # waiters at equal priority — that is the fair share
+    order = serve._admission_order(w, {"a": 5})
+    assert [x["seq"] for x in order] == [3, 2, 1, 4]
+
+
+def test_priority_bumps_full_admission_queue():
+    """The priority-aware door: with the bounded queue full of
+    priority-0 waiters, a priority-1 acquire BUMPS the worst waiter
+    (which raises the named ServerBusyError) instead of being locked
+    out; the prioritized acquire then gets the next free slot."""
+    import threading
+
+    from mpi_tpu.errors import ServerBusyError
+
+    with serve.WorldServer(pool_size=1, backend="socket",
+                           detect_timeout_s=DETECT_S, heartbeat_s=0.2,
+                           max_pending=1) as srv:
+        hog = serve.connect(srv)
+        low = serve.connect(srv)
+        vip = serve.connect(srv, priority=1)
+        try:
+            hold = hog.acquire(1, timeout=10.0)  # pool now empty
+            outcome = {}
+
+            def low_wait():
+                try:
+                    lease = low.acquire(1, timeout=20.0)
+                    outcome["low"] = "granted"
+                    lease.release()
+                except ServerBusyError:
+                    outcome["low"] = "busy"
+
+            th = threading.Thread(target=low_wait, daemon=True)
+            th.start()
+            deadline = time.monotonic() + 10.0
+            while srv.stats()["waiting"] < 1:  # low is queued (full)
+                assert time.monotonic() < deadline
+                time.sleep(0.05)
+
+            def vip_wait():
+                lease = vip.acquire(1, timeout=20.0)
+                outcome["vip"] = "granted"
+                lease.release()
+
+            tv = threading.Thread(target=vip_wait, daemon=True)
+            tv.start()
+            th.join(15.0)
+            assert outcome.get("low") == "busy", outcome
+            hold.release()  # frees the one slot → the vip waiter
+            tv.join(15.0)
+            assert outcome.get("vip") == "granted", outcome
+            st = srv.stats()
+            assert st["busy_rejected"] >= 1
+        finally:
+            hog.close()
+            low.close()
+            vip.close()
+
+
+def test_relinquish_fails_queued_acquires_with_failover_signal():
+    """A QUEUED acquire whose only possible pool is relinquished must
+    fail immediately with the named ServerLostError (the failover
+    signal), not stall to a LeaseTimeout the federated client treats
+    as a live-server verdict."""
+    import threading
+
+    from mpi_tpu.serve import ServerLostError
+
+    with serve.WorldServer(pool_size=1, backend="socket",
+                           detect_timeout_s=DETECT_S,
+                           heartbeat_s=0.2) as srv:
+        hog = serve.connect(srv)
+        waiter = serve.connect(srv)
+        try:
+            hold = hog.acquire(1, timeout=10.0)  # pool now empty
+            outcome = {}
+
+            def wait_acquire():
+                t0 = time.monotonic()
+                try:
+                    waiter.acquire(1, timeout=30.0)
+                    outcome["r"] = "granted"
+                except ServerLostError:
+                    outcome["r"] = "lost"
+                except Exception as e:  # noqa: BLE001
+                    outcome["r"] = type(e).__name__
+                outcome["took"] = time.monotonic() - t0
+
+            th = threading.Thread(target=wait_acquire, daemon=True)
+            th.start()
+            deadline = time.monotonic() + 10.0
+            while srv.stats()["waiting"] < 1:
+                assert time.monotonic() < deadline
+                time.sleep(0.05)
+            srv.relinquish_pool(srv._home, "usurper")
+            th.join(15.0)
+            assert outcome.get("r") == "lost", outcome
+            assert outcome["took"] < 10.0, outcome  # no timeout stall
+            hold  # the hog's lease died with the pool (named path
+            # covered by the in-flight-job relinquish error synthesis)
+        finally:
+            hog.close()
+            waiter.close()
+
+
+def test_saturation_bounded_queue_and_fair_share():
+    """The acceptance saturation row, small: beyond-capacity offered
+    load yields bounded queue depth and named ServerBusyError
+    rejections while the in-bound prioritized client keeps completing
+    leases (its fair-share throughput never starves to zero)."""
+    from benchmarks import chaos
+
+    result = chaos.run_federation_saturation(quick=True)
+    assert result["ok"], result
+    assert result["busy_rejected_total"] > 0
+    assert result["max_waiting_seen"] <= result["max_pending"]
+    assert result["good_ok"] >= result["good_client_floor"]
+    assert result["flood_timeout"] + result["flood_ok"] \
+        + result["flood_busy"] > 0
+
+
+# -- the server-tier freeze matrix (e2e, subprocess servers) ------------------
+
+
+def _spawn_server(idx, ns, tmp, pool=2):
+    addr_file = os.path.join(tmp, f"s{idx}.addr")
+    log = open(os.path.join(tmp, f"s{idx}.log"), "wb")
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "mpi_tpu.launcher", "serve",
+         "--pool-size", str(pool), "--addr-file", addr_file,
+         "--detect-timeout", str(DETECT_S), "--heartbeat", "0.2",
+         "--federation", ns, "--fed-lease-timeout", str(FED_LEASE_S),
+         "--server-id", f"s{idx}", "--orphan-timeout", "60"],
+        cwd=REPO, env=dict(os.environ, JAX_PLATFORMS="cpu"),
+        stdout=log, stderr=log)
+    return {"proc": proc, "addr_file": addr_file, "log": log,
+            "id": f"s{idx}"}
+
+
+def _wait(cond, timeout, what):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        v = cond()
+        if v:
+            return v
+        time.sleep(0.1)
+    raise AssertionError(f"timed out waiting for {what}")
+
+
+def _fabric_up(ns, servers):
+    for s in servers:
+        _wait(lambda: os.path.exists(s["addr_file"])
+              and s["proc"].poll() is None,
+              90.0 + LOAD_MARGIN_S, f"{s['id']} addr file")
+        with open(s["addr_file"]) as f:
+            s["addr"] = f.read().strip()
+    _wait(lambda: len([r for r in
+                       federation.read_server_records(ns).values()
+                       if federation.record_live(r)]) == len(servers),
+          30.0 + LOAD_MARGIN_S, "all endpoint records live")
+    _wait(lambda: federation.read_leader(ns) is not None,
+          15.0 + LOAD_MARGIN_S, "a leader")
+
+
+def _teardown(servers):
+    for s in servers:
+        if s["proc"].poll() is None:
+            s["proc"].kill()
+    for s in servers:
+        try:
+            s["proc"].wait(10.0)
+        except Exception:  # noqa: BLE001
+            pass
+        s["log"].close()
+
+
+def test_leader_freeze_brief_keeps_lease(tmp_path):
+    """SIGSTOP the leader for well under the lease bound, SIGCONT:
+    NOBODY fails over — same leader, no takeover assignment, pool
+    ownership unchanged, the fabric keeps serving, and the authority
+    log shows no overlap (mirrors the PR-10 brief-rank-freeze row)."""
+    ns = str(tmp_path / "ns")
+    servers = [_spawn_server(i, ns, str(tmp_path)) for i in range(2)]
+    try:
+        _fabric_up(ns, servers)
+        leader_id = federation.read_leader(ns)["id"]
+        leader = next(s for s in servers if s["id"] == leader_id)
+        owners_before = {p: r["owner"] for p, r
+                         in federation.read_pool_owners(ns).items()}
+        os.kill(leader["proc"].pid, signal.SIGSTOP)
+        time.sleep(0.4 * FED_LEASE_S)
+        os.kill(leader["proc"].pid, signal.SIGCONT)
+        time.sleep(2.0 * FED_LEASE_S)  # several renew ticks
+        assert federation.read_leader(ns)["id"] == leader_id, \
+            "a brief freeze must not cost the lease"
+        assert not [n for n in os.listdir(ns)
+                    if n.startswith("takeover.")], "nobody failed over"
+        owners_after = {p: r["owner"] for p, r
+                        in federation.read_pool_owners(ns).items()}
+        assert owners_after == owners_before
+        federation.assert_no_leader_overlap(ns)
+        with federation.FederatedClient(namespace=ns) as client:
+            assert client.run(serve.job_allreduce, 64, nranks=2,
+                              timeout=30.0) == 3.0
+    finally:
+        _teardown(servers)
+
+
+def test_leader_freeze_past_bound_takeover_then_demote(tmp_path):
+    """SIGSTOP the leader past the lease bound: the follower takes the
+    lease (term bump) AND — the frozen server's endpoint record going
+    stale is indistinguishable from death — adopts its pool.  On
+    SIGCONT the thawed ex-leader must DEMOTE and RELINQUISH (its next
+    renew sees foreign content; the namespace names a usurper with a
+    newer ownership stamp), its orphaned workers re-register with the
+    survivor, and at no point do two servers hold overlapping leader
+    authority — two live leaders never both admit."""
+    ns = str(tmp_path / "ns")
+    servers = [_spawn_server(i, ns, str(tmp_path)) for i in range(2)]
+    try:
+        _fabric_up(ns, servers)
+        leader_id = federation.read_leader(ns)["id"]
+        leader = next(s for s in servers if s["id"] == leader_id)
+        follower = next(s for s in servers if s["id"] != leader_id)
+        os.kill(leader["proc"].pid, signal.SIGSTOP)
+        # takeover: lease moves to the follower with a term bump...
+        new = _wait(lambda: (federation.read_leader(ns) or {}).get(
+            "id") == follower["id"] and federation.read_leader(ns),
+            6.0 * FED_LEASE_S + LOAD_MARGIN_S, "lease takeover")
+        assert new["term"] >= 2
+        # ...and the frozen server's pool is adopted by the survivor
+        _wait(lambda: all(
+            r["owner"] == follower["id"] for r
+            in federation.read_pool_owners(ns).values()),
+            20.0 + LOAD_MARGIN_S, "pool adoption")
+        # the fabric still serves DURING the freeze (survivor's pool)
+        with federation.FederatedClient(namespace=ns) as client:
+            assert client.run(serve.job_allreduce, 64, nranks=2,
+                              timeout=30.0) == 3.0
+        # the FROZEN-MASTER ESCAPE: a SIGSTOP'd server keeps its
+        # workers' TCP connections ESTABLISHED, so EOF alone could
+        # never free them — the orphans must notice the deposed
+        # ownership record themselves and DEFECT to the survivor
+        # while the ex-master is still frozen
+        fhost, fport = follower["addr"].rsplit(":", 1)
+        fclient = serve.ServerClient(fhost, int(fport))
+        try:
+            _wait(lambda: fclient.stats()["idle"] == 4,
+                  30.0 + LOAD_MARGIN_S,
+                  "orphans defected from the still-frozen master")
+        finally:
+            pass
+        os.kill(leader["proc"].pid, signal.SIGCONT)
+        # thawed ex-leader demotes + relinquishes what it already lost
+        try:
+            st = fclient.stats()
+            assert st["pools_adopted"] >= 1
+            assert st["orphans_reregistered"] >= 2
+            assert st["is_leader"] is True
+        finally:
+            fclient.close()
+        lhost, lport = leader["addr"].rsplit(":", 1)
+        lclient = serve.ServerClient(lhost, int(lport))
+        try:
+            _wait(lambda: lclient.stats()["pools_relinquished"] >= 1,
+                  15.0 + LOAD_MARGIN_S, "ex-leader relinquish")
+            st = lclient.stats()
+            assert st["is_leader"] is False, "thawed ex-leader demotes"
+            assert not st["pools"], "relinquished pools are dropped"
+        finally:
+            lclient.close()
+        assert federation.read_leader(ns)["id"] == follower["id"]
+        # THE split-brain assertion: no two servers' self-believed
+        # authority intervals ever overlapped, freeze included
+        federation.assert_no_leader_overlap(ns)
+        # and the survivor serves BOTH pools: two concurrent 2-rank
+        # leases land on different pools (a lease never spans pools —
+        # they are separate transport worlds) and both run correctly
+        fclient2 = serve.ServerClient(fhost, int(fport))
+        try:
+            la = fclient2.acquire(2, timeout=15.0)
+            lb = fclient2.acquire(2, timeout=15.0)
+            assert la.pool != lb.pool, (la.pool, lb.pool)
+            assert la.run(serve.job_allreduce, 64, timeout=30.0) == 3.0
+            assert lb.run(serve.job_allreduce, 64, timeout=30.0) == 3.0
+            la.release()
+            lb.release()
+        finally:
+            fclient2.close()
+    finally:
+        _teardown(servers)
+
+
+def test_restarted_server_reclaims_ghost_pool(tmp_path):
+    """Restart-under-a-stable-id regression: with NO survivor to adopt
+    (N=1 fabric), a SIGKILLed server's pool record keeps naming its id;
+    the restarted incarnation renews the endpoint record (so no leader
+    could ever judge the owner dead) — it must RECLAIM the ghost pool
+    itself, bringing the previous incarnation's warm orphans home
+    alongside its fresh home pool."""
+    ns = str(tmp_path / "ns")
+    servers = [_spawn_server(0, ns, str(tmp_path))]
+    try:
+        _fabric_up(ns, servers)
+        old_pool = set(federation.read_pool_owners(ns))
+        assert len(old_pool) == 1
+        os.kill(servers[0]["proc"].pid, signal.SIGKILL)
+        servers[0]["proc"].wait(10.0)
+        # restart under the SAME --server-id (fresh addr/log dir)
+        os.makedirs(str(tmp_path / "restart"), exist_ok=True)
+        servers.append(_spawn_server(0, ns, str(tmp_path / "restart")))
+        _fabric_up(ns, servers[1:])
+        host, port = servers[1]["addr"].rsplit(":", 1)
+        client = serve.ServerClient(host, int(port))
+        try:
+            # the ghost pool is reclaimed and its warm orphans
+            # re-register: 2 (fresh home) + 2 (reclaimed) idle workers
+            _wait(lambda: client.stats()["idle"] == 4,
+                  40.0 + LOAD_MARGIN_S, "ghost pool reclaimed")
+            st = client.stats()
+            assert st["pools_adopted"] >= 1
+            assert set(st["pools"]) >= old_pool
+            assert st["orphans_reregistered"] >= 2
+        finally:
+            client.close()
+    finally:
+        _teardown(servers)
+
+
+def test_retry_connect_retries_timeout_and_refused(monkeypatch):
+    """ISSUE 15 satellite: the failover dial retries a connect TIMEOUT
+    (socket.timeout is TimeoutError) and a refusal with backoff inside
+    the budget; a zero budget keeps first-failure raise; non-transient
+    errors propagate immediately."""
+    import socket as _socket
+
+    from mpi_tpu.resilience import retry_connect
+
+    calls = {"n": 0}
+
+    def flaky_dial():
+        calls["n"] += 1
+        if calls["n"] == 1:
+            raise _socket.timeout("connect timed out")
+        if calls["n"] == 2:
+            raise ConnectionRefusedError("refused")
+        return "sock"
+
+    assert retry_connect(flaky_dial, timeout_s=10.0) == "sock"
+    assert calls["n"] == 3
+
+    with pytest.raises(TimeoutError):
+        retry_connect(lambda: (_ for _ in ()).throw(
+            _socket.timeout("slow")), timeout_s=0.0)
+
+    def fatal_dial():
+        raise OSError("no route to host")
+
+    with pytest.raises(OSError, match="no route"):
+        retry_connect(fatal_dial, timeout_s=10.0)

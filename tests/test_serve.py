@@ -237,6 +237,76 @@ def test_launcher_serve_subcommand(tmp_path):
             proc.wait(10.0)
 
 
+def test_idle_worker_pvars_reported_without_jobs():
+    """ISSUE 15 satellite (PR-13 metrics residual): a worker that never
+    completes a job must still show up in stats() — the pvar snapshot
+    piggybacks on the control-channel heartbeat push, not only on
+    job_done.  Lease NOTHING; the aggregated worker pvars appear."""
+    with _pool() as srv:
+        client = serve.connect(srv)
+        try:
+            deadline = time.monotonic() + 15.0
+            agg = {}
+            while time.monotonic() < deadline:
+                agg = client.stats()["worker_pvars"]
+                if agg:
+                    break
+                time.sleep(0.2)
+            assert agg, "idle workers reported no pvars"
+            # the snapshot carries the documented slots (values may be
+            # zero on an idle pool — presence is the contract)
+            for key in ("msgs_sent", "collectives_started",
+                        "proc_failures_detected"):
+                assert key in agg, (key, agg)
+            assert client.stats()["jobs_ok"] == 0  # really no jobs
+        finally:
+            client.close()
+
+
+def test_connect_addr_file_retry_delayed_and_partial(tmp_path):
+    """ISSUE 15 satellite: connect() retries a MISSING addr file and a
+    PARTIALLY-WRITTEN one (unparseable content) within the
+    connect_retry budget — the just-started/just-elected server
+    publishing its record loses the race routinely.  A file that never
+    materializes raises a NAMED TransportError, not a parse crash."""
+    import threading
+
+    from mpi_tpu.transport.base import TransportError
+
+    with _pool(pool_size=1) as srv:
+        path = str(tmp_path / "late.addr")
+
+        def publish():
+            time.sleep(0.4)
+            with open(path, "w") as f:
+                f.write("garbage-not-an-addr")  # partially written
+            time.sleep(0.4)
+            tmp = path + ".tmp"
+            with open(tmp, "w") as f:
+                f.write(srv.addr)
+            os.replace(tmp, path)
+
+        th = threading.Thread(target=publish, daemon=True)
+        th.start()
+        client = serve.connect(path)
+        try:
+            assert client.run(serve.job_allreduce, 64, nranks=1,
+                              timeout=30.0) == 1.0
+        finally:
+            client.close()
+        th.join(5.0)
+    # never-published: a named error inside the (shrunk) budget
+    from mpi_tpu import mpit
+
+    old = mpit.cvar_read("connect_retry_timeout_s")
+    mpit.cvar_write("connect_retry_timeout_s", 0.5)
+    try:
+        with pytest.raises(TransportError, match="not published"):
+            serve.connect(str(tmp_path / "never.addr"))
+    finally:
+        mpit.cvar_write("connect_retry_timeout_s", old)
+
+
 # -- pooled coll/sm arena across leases (ISSUE 11 tentpole #3) ----------------
 
 
