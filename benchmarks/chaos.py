@@ -372,12 +372,20 @@ def run_mix():
         assert np.array_equal(out, np.sum(
             [vec(96, it, r, 9) for r in range(R + 1)], axis=0)), "scan"
         note(out)
+        # one POOL-CLASS payload (1MB doubles, above the recv-pool
+        # floor): the recycled receive buffers and the rendezvous
+        # steering path both run under the reset storm, and the digest
+        # proves them bit-exact (ISSUE 17)
+        out = comm.allreduce(vec(1 << 17, it, R, 13), algorithm="ring")
+        assert np.array_equal(out, np.sum([vec(1 << 17, it, r, 13) for r
+                                           in range(P)], axis=0)), "pool"
+        note(out)
         got = comm.sendrecv(vec(48, it, R, 11), dest=(R + 1) % P,
                             source=(R - 1) % P, sendtag=5, recvtag=5)
         assert np.array_equal(got, vec(48, it, (R - 1) % P, 11)), "sendrecv"
         note(got)
         comm.barrier()
-        colls += 9
+        colls += 10
 
 
 try:
@@ -416,6 +424,11 @@ print(json.dumps({{
     "link_faults_masked": mpit.pvar_read("link_faults_masked"),
     "link_bytes_retained": mpit.pvar_read("link_bytes_retained"),
     "link_cow_snapshots": mpit.pvar_read("link_cow_snapshots"),
+    "link_torn_frames": mpit.pvar_read("link_torn_frames"),
+    "recv_pool_rendezvous": mpit.pvar_read("recv_pool_rendezvous"),
+    "recv_bytes_steered": mpit.pvar_read("recv_bytes_steered"),
+    "recv_pool_hits": mpit.pvar_read("recv_pool_hits"),
+    "recv_pool_misses": mpit.pvar_read("recv_pool_misses"),
     "proc_failures_detected": mpit.pvar_read("proc_failures_detected"),
 }}), flush=True)
 sys.exit(0 if outcome.startswith(("ok", "diagnosed")) else 3)
@@ -561,6 +574,15 @@ def run_links_chaos(quick: bool = False, healing: bool = True,
     # (benchmarks/hotpath.py's ring leg + tests/test_resilience.py).
     retained = sum(r.get("link_bytes_retained", 0) for r in injected)
     cow_snaps = sum(r.get("link_cow_snapshots", 0) for r in injected)
+    # ISSUE 17 receive-side observability: the injected leg runs the
+    # recycled recv-pool and rendezvous steering UNDER the reset storm
+    # (the mix's 1MB leg is pool-class), so the digest parity above is
+    # also the pooled/steered receive path's bit-exactness proof
+    torn = sum(r.get("link_torn_frames", 0) for r in injected)
+    rendezvous = sum(r.get("recv_pool_rendezvous", 0) for r in injected)
+    steered = sum(r.get("recv_bytes_steered", 0) for r in injected)
+    pool_hits = sum(r.get("recv_pool_hits", 0) for r in injected)
+    pool_misses = sum(r.get("recv_pool_misses", 0) for r in injected)
     parity = all(
         b.get("digest") and b.get("digest") == i.get("digest")
         for b, i in zip(baseline, injected))
@@ -574,13 +596,18 @@ def run_links_chaos(quick: bool = False, healing: bool = True,
     min_resets = 6 if quick else 20
     result = {
         "quick": quick, "healing": healing, "nranks": 3,
-        "collectives_per_rank": iters * 9,
+        "collectives_per_rank": iters * 10,
         "resets_injected": resets,
         "link_reconnects": reconnects,
         "link_frames_replayed": replayed,
         "link_faults_masked": masked,
         "link_bytes_retained": retained,
         "link_cow_snapshots": cow_snaps,
+        "link_torn_frames": torn,
+        "recv_pool_rendezvous": rendezvous,
+        "recv_bytes_steered": steered,
+        "recv_pool_hits": pool_hits,
+        "recv_pool_misses": pool_misses,
         "retention_by_reference": (retained > 0 if healing
                                    else retained == 0),
         "bit_parity_vs_uninjected": parity,

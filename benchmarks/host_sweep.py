@@ -179,6 +179,36 @@ def persist_sweep(quick: bool = False, nbc_mode: str = "auto") -> List[Dict]:
     return rows
 
 
+# Receive-side zero-copy band (ISSUE 17): socket-only large-message
+# latency + bi-bandwidth + ring-allreduce rows.  The 'pre' leg pins
+# MPI_TPU_RECV_STEERING=0 (claiming off, channel accounting still on —
+# byte-identical frame paths, so the contrast isolates the removed
+# pool-stage copy), 'post' runs the default steering-on path.  The
+# rendezvous win lives on the internal-tag collective leg; the p2p
+# legs bound the recv pool's own (size-class recycling) effect.
+RECVPOOL_P2P_SIZES = "1MB,4MB,16MB"
+RECVPOOL_ALLREDUCE_SIZES = "4MB,16MB"
+
+
+def recvpool_sweep(quick: bool = False, steering: int = 1) -> List[Dict]:
+    env = {"MPI_TPU_RECV_STEERING": str(steering)}
+    p2p = "1MB" if quick else RECVPOOL_P2P_SIZES
+    ar = "1MB" if quick else RECVPOOL_ALLREDUCE_SIZES
+    iters, warmup = (1, 0) if quick else (30, 5)
+    rows: List[Dict] = []
+    for leg, bench, szs, algos, it in (
+            ("osu_latency", "latency", p2p, None, iters),
+            ("osu_bibw", "bibw", p2p, None, max(1, iters // 2)),
+            ("osu_allreduce", "allreduce", ar, "ring",
+             max(1, iters // 2))):
+        for r in _osu_rows("socket", bench, szs, algos, it, warmup,
+                           env_extra=env):
+            r["leg"] = leg
+            r["recv_steering"] = steering
+            rows.append(r)
+    return rows
+
+
 def latency_diagnosis_legs() -> List[Dict]:
     """1KB ping-pong p50 on socket, shm(default spin), shm(spin off) and
     shm(long spin): separates the futex-wakeup cost (the spin knob removes
@@ -357,6 +387,17 @@ def run_persist_sweep(label: str, quick: bool = False) -> Dict:
         lambda quick: persist_sweep(quick=quick, nbc_mode=mode))
 
 
+def run_recvpool_sweep(label: str, quick: bool = False) -> Dict:
+    """Just the receive-side zero-copy band — the recv-pool/rendezvous
+    PR's pre/post artifact (committed as benchmarks/results/recvpool_
+    {pre,post}.json): 'pre' pins MPI_TPU_RECV_STEERING=0 (pool-stage
+    copy on every receive), 'post' runs the default steering path."""
+    steering = 0 if label == "pre" else 1
+    return _band_result(
+        label, quick, "recvpool_rows",
+        lambda quick: recvpool_sweep(quick=quick, steering=steering))
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--label", default="post")
@@ -375,8 +416,15 @@ def main(argv=None) -> int:
                          "start() re-fire; --label pre pins nbc=thread, "
                          "post nbc=auto) — the engine-owned-nbc pre/post "
                          "artifact")
+    ap.add_argument("--recvpool", action="store_true",
+                    help="receive-side zero-copy band only (socket "
+                         "latency/bibw/ring-allreduce at 1-16MB; --label "
+                         "pre pins MPI_TPU_RECV_STEERING=0) — the "
+                         "recv-pool rendezvous pre/post artifact")
     args = ap.parse_args(argv)
-    result = (run_persist_sweep(args.label, quick=args.quick)
+    result = (run_recvpool_sweep(args.label, quick=args.quick)
+              if args.recvpool
+              else run_persist_sweep(args.label, quick=args.quick)
               if args.persist
               else run_overlap_sweep(args.label, quick=args.quick)
               if args.overlap

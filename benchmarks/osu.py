@@ -206,6 +206,42 @@ def cpu_bench_program(comm, bench: str, sizes: List[int], algos: List[str],
                              "p50_us": t * 1e6})
         return rows
 
+    if bench == "bibw":
+        # classic osu_bibw: BOTH ranks stream a window of nonblocking
+        # sends at each other simultaneously, then drain their posted
+        # receives; bidirectional bandwidth = 2·window·nbytes / time.
+        # The shape receive-side steering (ISSUE 17) targets: with
+        # traffic flowing both ways each rank's reader thread competes
+        # with its sender for the GIL, so the removed pool-stage copy
+        # (and its page faults) is paid twice per exchange here.
+        for nbytes in sizes:
+            window = max(2, min(64, (32 << 20) // max(1, nbytes)))
+            payload = np.zeros(max(1, nbytes // 4), np.float32)
+            comm.barrier()
+            samples = []
+            for i in range(warmup + iters):
+                t0 = time.perf_counter()
+                if comm.rank in (0, 1):
+                    peer = 1 - comm.rank
+                    rreqs = [comm.irecv(source=peer, tag=w)
+                             for w in range(window)]
+                    sreqs = [comm.isend(payload, dest=peer, tag=w)
+                             for w in range(window)]
+                    for r in sreqs:
+                        r.wait()
+                    for r in rreqs:
+                        r.wait()
+                if i >= warmup:
+                    samples.append(time.perf_counter() - t0)
+            comm.barrier()
+            if comm.rank == 0:
+                t = statistics.median(samples)
+                rows.append({"bench": "bibw", "nranks": comm.size,
+                             "bytes": nbytes, "window": window,
+                             "bw_gbps": 2 * window * nbytes / t / 1e9,
+                             "p50_us": t * 1e6})
+        return rows
+
     if bench == "overlap":
         return _overlap_bench(comm, sizes, iters, warmup)
 
@@ -545,9 +581,9 @@ def tpu_bench(bench: str, sizes: List[int], algos: List[str], iters: int,
 # CLI
 # ---------------------------------------------------------------------------
 
-ALL_BENCHES = ["latency", "bw", "barrier", "bcast", "reduce", "allreduce",
-               "allgather", "alltoall", "reduce_scatter", "overlap",
-               "persist"]
+ALL_BENCHES = ["latency", "bw", "bibw", "barrier", "bcast", "reduce",
+               "allreduce", "allgather", "alltoall", "reduce_scatter",
+               "overlap", "persist"]
 DEFAULT_ALGOS = {
     "allreduce": ["ring", "recursive_halving", "fused"],  # + pallas_ring (tpu, opt-in)
     "bcast": ["tree", "fused"],
@@ -557,6 +593,7 @@ DEFAULT_ALGOS = {
     "reduce_scatter": ["ring", "fused"],
     "latency": ["-"],
     "bw": ["-"],
+    "bibw": ["-"],
     "barrier": ["-"],
     "overlap": ["-"],
     "persist": ["-"],
@@ -567,7 +604,7 @@ def run_bench(bench: str, backend: str, nranks: int, sizes: List[int],
               algos: List[str], iters: int, warmup: int,
               algos_explicit: bool = False) -> List[Dict]:
     if backend == "tpu":
-        if bench in ("bw", "barrier", "overlap", "persist"):
+        if bench in ("bw", "bibw", "barrier", "overlap", "persist"):
             # SPMD has no standalone p2p stream, its barrier is a
             # device-fused psum, and its nonblocking ops are XLA's to
             # schedule; all are process-backend benches

@@ -53,6 +53,7 @@ does the wire work and mpi_tpu/resilience.py owns the window.
 
 from __future__ import annotations
 
+import bisect
 import threading
 from typing import List, Optional, Sequence, Tuple
 
@@ -66,9 +67,23 @@ from . import mpit as _mpit
 # read without the lock by touch()/active() so the common case (no
 # socket retention anywhere in the process: shm/local worlds, healing
 # off, everything acked) costs one int compare per fold.
+#
+# The index itself is a SORTED-INTERVAL structure (ISSUE 17, PR-11
+# residual c): ``_starts`` holds every registered [start, end) range's
+# start in sorted order with ``_ivals`` the parallel (start, end, ref)
+# records, and ``_maxlen`` bounds the longest registered interval so a
+# point query only scans entries whose start lies in
+# [qstart - _maxlen, qend) — O(log n + hits) instead of the old flat
+# O(live) sweep per fold.  ``_maxlen`` is grow-only while the index is
+# non-empty (an exact running max would need a heap for nothing) and
+# resets to 0 whenever the index drains, which it does every time the
+# retained window is fully acked.
 _cv = threading.Condition()
 _live: dict = {}   # id(ref) -> ref, refs that still hold mutable ranges
 _NLIVE = 0
+_starts: List[int] = []                          # sorted interval starts
+_ivals: List[Tuple[int, int, "BufRef"]] = []     # parallel (s, e, ref)
+_maxlen = 0
 
 
 def _addr_range(arr) -> Optional[Tuple[int, int]]:
@@ -194,15 +209,31 @@ class BufRef:
 
 
 def _register(ref: BufRef) -> None:
-    global _NLIVE
+    global _NLIVE, _maxlen
     with _cv:
         _live[id(ref)] = ref
+        for (s, e) in ref.ranges:
+            i = bisect.bisect_right(_starts, s)
+            _starts.insert(i, s)
+            _ivals.insert(i, (s, e, ref))
+            if e - s > _maxlen:
+                _maxlen = e - s
         _NLIVE = len(_live)
 
 
 def _unregister_locked(ref: BufRef) -> None:
-    global _NLIVE
-    _live.pop(id(ref), None)
+    global _NLIVE, _maxlen
+    if _live.pop(id(ref), None) is not None:
+        for (s, e) in ref.ranges:
+            i = bisect.bisect_left(_starts, s)
+            while i < len(_starts) and _starts[i] == s:
+                if _ivals[i][2] is ref and _ivals[i][1] == e:
+                    del _starts[i]
+                    del _ivals[i]
+                    break
+                i += 1
+        if not _ivals:
+            _maxlen = 0
     _NLIVE = len(_live)
 
 
@@ -216,18 +247,31 @@ def touch_ranges(ranges: Sequence[Tuple[int, int]],
                  exclude: Optional[BufRef] = None) -> int:
     """Copy-on-write core: snapshot every live retained ref overlapping
     any of ``ranges`` (address intervals), BEFORE the caller's write or
-    conflicting send proceeds.  Returns snapshots taken."""
+    conflicting send proceeds.  Returns snapshots taken.
+
+    Two-phase under the lock: COLLECT the overlapping refs from the
+    sorted-interval index first (a snapshot mutates the index, and
+    ``_snapshot_locked`` may drop the lock waiting for pins), THEN
+    snapshot each — ``_snapshot_locked`` re-checks its own state so a
+    concurrent ack prune or duplicate hit is benign."""
     if not _NLIVE or not ranges:
         return 0
     took = 0
     with _cv:
-        for ref in list(_live.values()):
-            if ref is exclude or ref.snapshotted:
-                continue
-            hit = any(s < e2 and s2 < e
-                      for (s, e) in ref.ranges
-                      for (s2, e2) in ranges)
-            if hit:
+        hits: List[BufRef] = []
+        seen: set = set()
+        for (qs, qe) in ranges:
+            i = bisect.bisect_left(_starts, qs - _maxlen)
+            n = len(_starts)
+            while i < n and _starts[i] < qe:
+                s, e, ref = _ivals[i]
+                if (e > qs and ref is not exclude
+                        and not ref.snapshotted and id(ref) not in seen):
+                    seen.add(id(ref))
+                    hits.append(ref)
+                i += 1
+        for ref in hits:
+            if not ref.snapshotted:
                 ref._snapshot_locked()
                 took += 1
     return took

@@ -37,6 +37,7 @@ from . import coll_sm as _coll_sm
 from . import compress as _compress
 from . import mpit as _mpit
 from . import ops as _ops
+from . import recvpool as _recvpool
 from . import schedules
 from . import telemetry as _telemetry
 from . import tuning as _tuning
@@ -319,6 +320,13 @@ def _unpost(reqs: Sequence["_RecvRequest"]) -> None:
     completing one of these requests right now."""
     if not reqs:
         return
+    reg = reqs[0]._comm._recv_reg
+    if reg is not None:
+        # cancel the steering entries too: a frame that never came must
+        # not leave a claimable entry for a LATER collective's frame to
+        # steer into (mpi_tpu/recvpool.py pairs by per-channel order)
+        for req in reqs:
+            reg.cancel(req._steer_token)
     eng = reqs[0]._comm._progress
     if eng is not None:
         with eng.cv:
@@ -574,6 +582,9 @@ class _RecvRequest(Request):
     slot (segmented-engine send-window credit, _SegSender.advance)."""
 
     _on_complete = None  # set by _seg_exchange under the progress engine
+    # recv-steering registry token of an internal posted irecv
+    # (mpi_tpu/recvpool.py note_post) — cancelled by _unpost
+    _steer_token = None
 
     def __init__(self, comm: "P2PCommunicator", source: int, tag: int,
                  queue: List["_RecvRequest"]):
@@ -609,7 +620,8 @@ class _RecvRequest(Request):
             # validated user tags, and internal (negative-tag) requests —
             # the segmented collective engine's pipelined irecvs — must
             # not trip the user-tag check at completion time
-            head._complete(self._comm._recv_internal(head._source, head._tag))
+            head._complete(self._comm._recv_internal(
+                head._source, head._tag, _posted=True))
         self._vnote(True)
         return self._value
 
@@ -1215,6 +1227,11 @@ class P2PCommunicator(Communicator):
         # feature is a single attribute test per operation
         # (progress=none, the off-mode zero-cost contract).
         self._progress = getattr(transport, "_progress_engine", None)
+        # Recv-steering registry (mpi_tpu/recvpool.py), present only on
+        # transports whose reader can steer frame bodies into posted
+        # buffers (socket); None = all steering bookkeeping is a single
+        # attribute test per internal receive.
+        self._recv_reg = transport.recv_registry
 
     # -- identity ----------------------------------------------------------
 
@@ -1298,8 +1315,18 @@ class P2PCommunicator(Communicator):
         return out
 
     def _recv_internal(self, source: int, tag: int,
-                       status: Optional[Status] = None) -> Any:
+                       status: Optional[Status] = None,
+                       _posted: bool = False) -> Any:
         src_world = ANY_SOURCE if source == ANY_SOURCE else self._world(source)
+        if (tag < 0 and not _posted and src_world != ANY_SOURCE
+                and self._recv_reg is not None):
+            # a BLOCKING internal recv consumes a frame on the same
+            # steering channel the posted irecvs pair on — count it so
+            # the frame/consumer indices stay aligned (it has no
+            # destination buffer, so it never claims).  _posted=True
+            # marks the queue-head servicing call of an ALREADY-counted
+            # posted request (_RecvRequest.wait).
+            self._recv_reg.note_consume(src_world, self._ctx, tag)
         if self._ft is not None or self._verify is not None:
             obj, src, t = self._sliced_wait(src_world, tag)
         else:
@@ -1625,6 +1652,14 @@ class P2PCommunicator(Communicator):
         with self._lock:
             queue = self._irecv_queues.setdefault((source, tag), [])
         req = _RecvRequest(self, source, tag, queue)
+        if (tag < 0 and source != ANY_SOURCE
+                and self._recv_reg is not None):
+            # count the posted consumer on its steering channel; the
+            # collective may attach a destination view to the returned
+            # token, letting the socket reader steer the paired frame's
+            # body straight into it (mpi_tpu/recvpool.py)
+            req._steer_token = self._recv_reg.note_post(
+                self._world(source), self._ctx, tag)
         if self._progress is not None and \
                 not self.__dict__.get("_progress_registered"):
             # background completion: the engine scans this comm's posted
@@ -2054,6 +2089,23 @@ class P2PCommunicator(Communicator):
             self._t, "coll_segment_hint", Transport.coll_segment_hint)
         return max(1, nbytes // max(1, itemsize))
 
+    @staticmethod
+    def _count_recv_store(dests) -> None:
+        """Price a fold-site store whose destination WAS registered for
+        rendezvous steering (mpi_tpu/recvpool.py) but whose payload
+        arrived through the pool path anyway.  It ticks
+        ``payload_copies`` only while steering is administratively off
+        (recv_steering cvar): whether an individual frame steers is a
+        reader-vs-poster thread race, and the zero-copy invariants the
+        suite pins (tests/test_segmented_collectives2.py) must stay
+        deterministic under the default mode.  With steering ON, the
+        hit/miss split is reported by ``recv_pool_rendezvous`` /
+        ``recv_bytes_steered`` and the recvpool fallback trace events
+        instead — that asymmetry is what the pre/post OSU artifacts
+        (benchmarks/results/recvpool_*.json) quantify."""
+        if dests is not None and not _recvpool._STEERING:
+            _mpit.count(copies=1)
+
     def _seg_exchange(self, work: np.ndarray, sbounds: Tuple[int, int],
                       rbounds: Tuple[int, int], dest: int, src: int,
                       op: Optional[_ops.ReduceOp] = None,
@@ -2081,6 +2133,19 @@ class P2PCommunicator(Communicator):
         sspans = schedules.segment_spans(sbounds[0], sbounds[1], seg)
         rspans = schedules.segment_spans(rbounds[0], rbounds[1], seg)
         decode = None if wire is None else wire.decode
+        # Rendezvous steering (mpi_tpu/recvpool.py): pure-copy spans
+        # (op None, fold dtype on the wire) can land DIRECTLY in the
+        # working buffer — register each posted receive's destination
+        # view so the transport's reader steers the body bytes there
+        # instead of staging them in a pool buffer.  Fold spans
+        # (op != None) are never registered: an early arrival would
+        # clobber the accumulator before combine_into reads it.  The
+        # fold site recognises a steered segment by IDENTITY (the
+        # delivered payload IS the registered view) and skips the
+        # store — and its CoW touch, which the reader already did.
+        dests = None
+        if op is None and wire is None and self._recv_reg is not None:
+            dests = [work[lo:hi] for lo, hi in rspans]
         eng = self._progress
         if eng is not None and len(sspans) > _SEG_WINDOW:
             # progress-engine mode: the sends beyond the initial credit
@@ -2097,13 +2162,20 @@ class P2PCommunicator(Communicator):
             sender = _SegSender(self, work, sspans, dest, wire)
             with eng.cv:
                 reqs = []
-                for _ in rspans:
+                for i in range(len(rspans)):
                     req = self._irecv_internal(src, _TAG_COLL)
+                    if dests is not None:
+                        self._recv_reg.attach(req._steer_token, dests[i])
                     req._on_complete = sender.advance
                     reqs.append(req)
         else:
             sender = None
-            reqs = [self._irecv_internal(src, _TAG_COLL) for _ in rspans]
+            reqs = []
+            for i in range(len(rspans)):
+                req = self._irecv_internal(src, _TAG_COLL)
+                if dests is not None:
+                    self._recv_reg.attach(req._steer_token, dests[i])
+                reqs.append(req)
         try:
             if sender is not None:
                 sender.post(_SEG_WINDOW)
@@ -2115,13 +2187,18 @@ class P2PCommunicator(Communicator):
                         if e.segment is None:  # name the stalled segment
                             e.segment = seg_i
                         raise
-                    view = work[lo:hi]
+                    view = work[lo:hi] if dests is None else dests[seg_i]
                     if op is None:
-                        # ownership CoW (bufpool.py): the working
-                        # buffer's spans were just SENT — retained
-                        # frames must snapshot before this overwrite
-                        _bufpool.touch(view)
-                        view[...] = got if decode is None else decode(got)
+                        if got is not view:
+                            # ownership CoW (bufpool.py): the working
+                            # buffer's spans were just SENT — retained
+                            # frames must snapshot before this overwrite
+                            _bufpool.touch(view)
+                            view[...] = (got if decode is None
+                                         else decode(got))
+                            self._count_recv_store(dests)
+                        # else: steered in place by the reader, which
+                        # did the touch before scribbling — no store
                     else:
                         op.combine_into(view, got, decode)
                 sender.drain()
@@ -2144,10 +2221,12 @@ class P2PCommunicator(Communicator):
                     if e.segment is None:  # name the stalled segment
                         e.segment = seg_i
                     raise
-                view = work[lo:hi]
+                view = work[lo:hi] if dests is None else dests[seg_i]
                 if op is None:
-                    _bufpool.touch(view)  # see the engine path above
-                    view[...] = got if decode is None else decode(got)
+                    if got is not view:  # see the engine path above
+                        _bufpool.touch(view)
+                        view[...] = got if decode is None else decode(got)
+                        self._count_recv_store(dests)
                 else:
                     op.combine_into(view, got, decode)
                 if si < len(sspans):

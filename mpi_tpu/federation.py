@@ -768,7 +768,18 @@ class FederatedClient:
         out = list(self._static)
         if self._ns:
             now = time.time()
-            for sid, rec in sorted(read_server_records(self._ns).items()):
+            # freshest renewal first: a SIGSTOP-frozen server's record
+            # passes record_live until it ages past the stale bound,
+            # but its renewals have already stopped — ordering by
+            # recency steers a fresh client at the actively-renewing
+            # survivor instead of the silent not-yet-stale ex-leader
+            # (id order was the tiebreak that dialed the frozen one
+            # first every time).  Ties (all healthy) stay deterministic
+            # via the id in the sort key.
+            recs = sorted(read_server_records(self._ns).items(),
+                          key=lambda kv: (-float(
+                              kv[1].get("renewed_at", 0)), kv[0]))
+            for sid, rec in recs:
                 if rec.get("ctrl") and record_live(rec, now) \
                         and rec["ctrl"] not in out:
                     out.append(rec["ctrl"])

@@ -51,9 +51,10 @@ class _Counters:
                  "tuned_hits", "tuned_fallbacks",
                  "link_reconnects", "link_replayed", "link_masked",
                  "link_retained", "link_cow_snaps", "link_cow_bytes",
-                 "link_syscalls",
+                 "link_syscalls", "link_torn",
                  "nbc_threads", "nbc_sms", "persist_starts",
-                 "trace_events")
+                 "trace_events",
+                 "rp_hits", "rp_misses", "rp_rdv", "rp_steered")
 
     def __init__(self) -> None:
         self.sends = 0
@@ -95,10 +96,15 @@ class _Counters:
         self.link_cow_snaps = 0
         self.link_cow_bytes = 0
         self.link_syscalls = 0
+        self.link_torn = 0
         self.nbc_threads = 0
         self.nbc_sms = 0
         self.persist_starts = 0
         self.trace_events = 0
+        self.rp_hits = 0
+        self.rp_misses = 0
+        self.rp_rdv = 0
+        self.rp_steered = 0
 
 
 counters = _Counters()  # incremented by communicator.py / codec.py (count())
@@ -129,10 +135,15 @@ def count(sends: int = 0, send_bytes: int = 0, recvs: int = 0,
           link_cow_snapshots: int = 0,
           link_cow_bytes: int = 0,
           link_send_syscalls: int = 0,
+          link_torn_frames: int = 0,
           nbc_threads_spawned: int = 0,
           nbc_state_machines: int = 0,
           persistent_starts: int = 0,
-          trace_events: int = 0) -> None:
+          trace_events: int = 0,
+          recv_pool_hits: int = 0,
+          recv_pool_misses: int = 0,
+          recv_pool_rendezvous: int = 0,
+          recv_bytes_steered: int = 0) -> None:
     """Thread-safe increment (rank-threads of the local backend share
     this process's counters; unsynchronized += would lose updates)."""
     with _lock:
@@ -175,10 +186,15 @@ def count(sends: int = 0, send_bytes: int = 0, recvs: int = 0,
         counters.link_cow_snaps += link_cow_snapshots
         counters.link_cow_bytes += link_cow_bytes
         counters.link_syscalls += link_send_syscalls
+        counters.link_torn += link_torn_frames
         counters.nbc_threads += nbc_threads_spawned
         counters.nbc_sms += nbc_state_machines
         counters.persist_starts += persistent_starts
         counters.trace_events += trace_events
+        counters.rp_hits += recv_pool_hits
+        counters.rp_misses += recv_pool_misses
+        counters.rp_rdv += recv_pool_rendezvous
+        counters.rp_steered += recv_bytes_steered
 
 _PVARS: Dict[str, Callable[[], int]] = {
     "msgs_sent": lambda: counters.sends,
@@ -295,6 +311,12 @@ _PVARS: Dict[str, Callable[[], int]] = {
     "link_cow_snapshots": lambda: counters.link_cow_snaps,
     "link_cow_bytes": lambda: counters.link_cow_bytes,
     "link_send_syscalls": lambda: counters.link_syscalls,
+    # torn frames (ISSUE 17 small fix): reader-side disconnects that
+    # landed MID-FRAME (partial header/meta/body bytes then EOF or
+    # error) — a reset the replay protocol must heal, distinguished
+    # from a clean between-frames close (graceful shutdown /
+    # membership departure), which is not counted.
+    "link_torn_frames": lambda: counters.link_torn,
     # engine-owned nonblocking collectives (mpi_tpu/nbc.py, ISSUE 12):
     # per-call _ThreadRequest threads actually SPAWNED (the cost the
     # state machines remove — exactly 0 when every i-collective rode
@@ -310,6 +332,18 @@ _PVARS: Dict[str, Callable[[], int]] = {
     # `telemetry.REC is None` attribute test; bench.py --verify-overhead
     # --trace asserts it alongside the unchanged wire accounting).
     "trace_events": lambda: counters.trace_events,
+    # receive-side zero-copy (mpi_tpu/recvpool.py, ISSUE 17): pool
+    # requests served by a recycled size-class buffer vs fresh
+    # allocations (the page-fault pass the pool removes), frames the
+    # reader STEERED directly into a posted irecv's destination buffer
+    # (the rendezvous path — the intermediate receive copy removed
+    # entirely), and the body bytes that moved that way.  The socket
+    # 16MB allreduce asserts payload_copies drops by exactly the
+    # steered stores (tests/test_recvpool.py).
+    "recv_pool_hits": lambda: counters.rp_hits,
+    "recv_pool_misses": lambda: counters.rp_misses,
+    "recv_pool_rendezvous": lambda: counters.rp_rdv,
+    "recv_bytes_steered": lambda: counters.rp_steered,
 }
 
 
@@ -531,6 +565,7 @@ def _ensure_builtin_cvars() -> None:
     from . import membership as _membership
     from . import nbc as _nbc
     from . import progress as _prog
+    from . import recvpool as _recvpool
     from . import resilience as _resilience
     from . import tuning as _tuning
     from .transport import shm as _shm
@@ -807,6 +842,9 @@ def _ensure_builtin_cvars() -> None:
         def _set_retain_copy(v):
             _resilience._RETAIN_COPY = int(bool(int(v)))
 
+        def _set_recv_steering(v):
+            _recvpool._STEERING = int(bool(int(v)))
+
         def _set_keepalive(v):
             if float(v) < 0:
                 raise ValueError(
@@ -856,6 +894,18 @@ def _ensure_builtin_cvars() -> None:
             "restores the eager per-frame snapshot (strict MPI "
             "buffered-send reusability, one memcpy per frame).  "
             "MPI_TPU_LINK_RETAIN_COPY seeds the default")
+        _CVARS["recv_steering"] = (
+            lambda: _recvpool._STEERING, _set_recv_steering,
+            "receive-side rendezvous steering of the socket transport "
+            "(mpi_tpu/recvpool.py): 1 (default) lets the reader thread "
+            "recv() a matching frame's body DIRECTLY into the posted "
+            "irecv's destination buffer — zero intermediate copy, "
+            "priced by recv_pool_rendezvous / recv_bytes_steered; 0 "
+            "forces every frame through the pool-fallback path (the "
+            "honest pre/post bench toggle).  Channel accounting stays "
+            "on either way, so toggling mid-run cannot desync the "
+            "frame/consumer pairing.  MPI_TPU_RECV_STEERING seeds the "
+            "default")
         _CVARS["link_keepalive_s"] = (
             lambda: _resilience._KEEPALIVE_S, _set_keepalive,
             "idle-link keepalive cadence of the resilient socket "

@@ -239,7 +239,11 @@ class _SMColl(Request):
         self._op = op
         self._finish = finish
         self._srem = [len(st[1]) for st in steps]
-        self._actions: List[Tuple[Any, int, Tuple]] = []
+        # (req, step_i, spec, steer_dest) — steer_dest is the work-span
+        # view registered with the recv registry (None when not a
+        # steerable store span); _apply needs the SAME object for the
+        # delivered-by-identity check
+        self._actions: List[Tuple[Any, int, Tuple, Any]] = []
         self._ai = 0
         self._rdt = 0   # recv-done-through: first step with recvs pending
         self._nss = 0   # next step whose sends are not yet emitted
@@ -265,12 +269,24 @@ class _SMColl(Request):
         fire the initial send window."""
         eng = self._parent._progress
         child = self._comm
+        reg = child._recv_reg
         with eng.cv:
             for step_i, (sends, recvs) in enumerate(self._steps):
                 for spec in recvs:
                     req = child._irecv_internal(spec[0], _TAG_COLL)
+                    # Rendezvous steering (mpi_tpu/recvpool.py): span
+                    # STORES may land directly in the working buffer.
+                    # Fold spans never register — an early arrival
+                    # would clobber the accumulator (the _seg_exchange
+                    # rule).  _apply recognises a steered segment by
+                    # identity and skips the store + CoW touch.
+                    dest = None
+                    if (reg is not None and self._mode == "span"
+                            and not spec[3]):
+                        dest = self._work[spec[1]:spec[2]]
+                        reg.attach(req._steer_token, dest)
                     req._on_complete = self._kick
-                    self._actions.append((req, step_i, spec))
+                    self._actions.append((req, step_i, spec, dest))
         rec = _telemetry.REC
         if rec is not None:
             rec.emit("sm", "arm",
@@ -312,7 +328,7 @@ class _SMColl(Request):
                 self._advance_locked()
             except BaseException as e:  # noqa: BLE001 - surfaced at wait
                 self._error = e
-                _unpost([r for r, _, _ in self._actions[self._ai:]
+                _unpost([r for r, _, _, _ in self._actions[self._ai:]
                          if r is not None and not r._done])
                 rec = _telemetry.REC
                 if rec is not None:
@@ -328,10 +344,10 @@ class _SMColl(Request):
         while progressed:
             progressed = False
             while self._ai < len(self._actions):
-                req, step_i, spec = self._actions[self._ai]
+                req, step_i, spec, dest = self._actions[self._ai]
                 if not req._done:
                     break
-                self._apply(spec, req._value)
+                self._apply(spec, req._value, dest)
                 self._srem[step_i] -= 1
                 self._ai += 1
                 progressed = True
@@ -361,17 +377,20 @@ class _SMColl(Request):
                          attrs={"kind": self.kind, "steps": n})
             self._notify()
 
-    def _apply(self, spec: Tuple, got: Any) -> None:
+    def _apply(self, spec: Tuple, got: Any, dest=None) -> None:
         if self._mode == "span":
             _, lo, hi, fold = spec
-            view = self._work[lo:hi]
+            view = self._work[lo:hi] if dest is None else dest
             if fold:
                 self._op.combine_into(view, got)
-            else:
+            elif got is not view:
                 # ownership CoW (bufpool.py): the span may have just been
                 # SENT — retained frames must snapshot before overwrite
                 _bufpool.touch(view)
                 view[...] = got
+                self._comm._count_recv_store(dest)
+            # else: steered straight into the span by the transport
+            # reader (which did the CoW touch) — nothing left to do
         else:
             _, slot = spec
             if slot >= 0:
@@ -404,7 +423,7 @@ class _SMColl(Request):
             if self._done or self._error is not None:
                 return
             self._error = err
-            _unpost([r for r, _, _ in self._actions[self._ai:]
+            _unpost([r for r, _, _, _ in self._actions[self._ai:]
                      if not r._done])
         rec = _telemetry.REC
         if rec is not None:
@@ -418,7 +437,7 @@ class _SMColl(Request):
         the exact per-call OR-set (verifier residual (d))."""
         child = self._comm
         out = set()
-        for req, _, _ in self._actions[self._ai:]:
+        for req, _, _, _ in self._actions[self._ai:]:
             if not req._done:
                 out.add(child._world(req._source))
         return tuple(sorted(out))
@@ -434,7 +453,7 @@ class _SMColl(Request):
         eng = self._parent._progress
         cbs: List = []
         with eng.cv:
-            for req, _, _ in self._actions[self._ai:]:
+            for req, _, _, _ in self._actions[self._ai:]:
                 if not req._done:
                     cbs.extend(eng.try_complete(req))
         for cb in cbs:
@@ -782,6 +801,12 @@ class PersistentColl(Request):
     context, and fires; rounds on one context can never cross-match
     because start() requires the previous round complete and every rank
     starts its persistent collectives in the same order [S].
+
+    Engine-compiled allreduce rounds re-fire on two PREALLOCATED
+    working buffers alternated per start (no per-round allocation);
+    round k's result is a view of one of them and stays valid until
+    round k+2 starts — hold a result across two later starts and you
+    must copy it, the usual double-buffer contract.
     """
 
     def __init__(self, parent: P2PCommunicator, kind: str, args: tuple,
@@ -794,6 +819,10 @@ class PersistentColl(Request):
         self._req: Optional[Request] = None
         self._last: Any = None
         self._started = False
+        # double-buffered re-fire (PR-12 residual (e)): two preallocated
+        # working buffers alternated across starts — see _round_build
+        self._dbl: Optional[Tuple[np.ndarray, np.ndarray]] = None
+        self._round = 0
         # resolve + compile once, from the bound buffer's geometry; a
         # None build means every round runs the blocking method on a
         # thread (same hoisted context)
@@ -877,6 +906,25 @@ class PersistentColl(Request):
         if (self._build0 is None or self._parent._progress is None
                 or _MODE != "auto"):
             return None
+        if self._kind == "allreduce" and "done" not in self._build0:
+            # Fully preallocated re-fire (PR-12 residual (e)): the
+            # compiled steps, op, and finisher are round-invariant —
+            # only the working buffer's CONTENT changes per start.
+            # Instead of re-running _build (a fresh flatten() alloc
+            # every round), alternate two preallocated buffers: round
+            # k's result (a view of buffer k % 2) stays valid until
+            # round k+2 starts, the one-round grace double buffering
+            # exists to give.  The CoW touch protects retained replay
+            # frames still referencing the previous occupant (the
+            # sent spans of round k-2) before the overwrite.
+            if self._dbl is None:
+                w = self._build0["work"]
+                self._dbl = (np.empty_like(w), np.empty_like(w))
+            buf = self._dbl[self._round & 1]
+            self._round += 1
+            _bufpool.touch(buf)
+            np.copyto(buf, np.asarray(self._args[0]).reshape(-1))
+            return {**self._build0, "work": buf}
         # span work buffers are per-round flatten() copies and the
         # value finishers return fresh lists, so round results never
         # alias the bound buffer or a later round's state — safe to

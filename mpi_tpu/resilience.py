@@ -265,6 +265,21 @@ class LinkState:
         with self._lock:
             return self._gen.get(rank, 0)
 
+    def rx_fresh(self, src: int, seq: int, gen: int) -> bool:
+        """True iff a data frame ``(seq, gen)`` from ``src`` is the next
+        in-sequence frame of the CURRENT stream generation — exactly the
+        frames ``rx_gate`` will deliver, in delivery order.  The recv-
+        steering registry (mpi_tpu/recvpool.py) gates its arrival
+        counting on this so duplicates, stale generations, and gap
+        frames never advance a channel's pairing index; its per-channel
+        watermark closes the remaining race of two connections
+        presenting the same fresh frame concurrently."""
+        with self._lock:
+            if gen != self._gen.get(src, 0):
+                return False
+            st = self._rx.get(src)
+            return seq == (st.delivered if st is not None else 0) + 1
+
     def retained_bytes(self, dest: int) -> int:
         with self._lock:
             return self._tx_of(dest).retained_bytes

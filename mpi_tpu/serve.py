@@ -90,6 +90,13 @@ _RATE_WINDOW_S = 60.0
 _PVAR_PUSH_S = 1.0
 _ORPHAN_TIMEOUT_S = 60.0
 
+# Slack added to a timeout-bearing request's client-side reply bound
+# (ServerClient._request): covers server scheduling + reply transit on
+# a loaded box.  Generous on purpose — the bound only exists to turn an
+# infinite wedge (frozen server, connection ESTABLISHED but silent)
+# into a finite ServerLostError.
+_RPC_GRACE_S = 15.0
+
 # Bounded admission queue (ISSUE 15): acquires past this many waiting
 # requests are rejected IMMEDIATELY with ServerBusyError instead of
 # converting overload into unbounded acquire latency.
@@ -1954,14 +1961,32 @@ class ServerClient:
         self.priority = int(priority)
 
     def _request(self, msg: dict) -> dict:
+        # Bound the reply wait when the caller bounded the op: the
+        # server enforces msg["timeout"] itself (acquire/run clamp to
+        # world_lease_timeout_s), so a live server's reply — grant,
+        # TimeoutError verdict, or any named error — must land within
+        # it plus slack.  Without this a SIGSTOP-frozen server (socket
+        # ESTABLISHED in the kernel, no reply, no EOF — the PR-15
+        # frozen-master class) wedges the client in recv forever; with
+        # it the stall surfaces as ServerLostError, which is exactly
+        # what a federated client fails over on.  timeout-less ops
+        # (stats, release) keep the blocking-read semantics.
+        t = msg.get("timeout")
         with self._lock:
             try:
+                self._sock.settimeout(float(t) + _RPC_GRACE_S
+                                      if t else None)
                 _send_msg(self._sock, None, msg)
                 reply = _recv_msg(self._sock)
             except OSError as e:
                 raise ServerLostError(
                     f"world server connection lost mid-request: "
                     f"{type(e).__name__}: {e}") from e
+            finally:
+                try:
+                    self._sock.settimeout(None)
+                except OSError:
+                    pass  # socket already dead: the raise above rules
         if reply is None:
             raise ServerLostError("world server closed the connection")
         if "error" in reply:

@@ -235,6 +235,18 @@ class Transport(ABC):
     # point is to exercise the wire paths.
     supports_coll_sm = False
 
+    # Receive-side rendezvous steering (mpi_tpu/recvpool.py, ISSUE 17):
+    # True only for transports whose reader can land a frame's body
+    # directly in a posted irecv's buffer (the socket transport).  Such
+    # transports also expose ``recv_registry`` (a PostedRecvRegistry);
+    # the communicator registers posted internal receives with it and
+    # prices the recv-side store copies steering removes
+    # (payload_copies).  Deliberately NOT inherited by wrappers like
+    # FaultyTransport: message-level chaos rewrites delivery order, so
+    # the wrapper must never advertise the inner reader's pairing.
+    recv_steering = False
+    recv_registry = None
+
     def __init__(self, world_rank: int, world_size: int) -> None:
         self.world_rank = world_rank
         self.world_size = world_size

@@ -55,14 +55,12 @@ from __future__ import annotations
 
 import pickle
 import struct
-import sys
-import threading
-import weakref
 from typing import Any, List, Optional, Tuple, Union
 
 import numpy as np
 
 from .. import mpit as _mpit
+from .. import recvpool as _recvpool
 
 # u64 length word: top bit = raw-array frame, low 63 bits = body length
 RAW_FLAG = 1 << 63
@@ -212,79 +210,12 @@ def pack_raw_wire_meta(ctx, tag: int, segs: List[np.ndarray],
     return META.pack(len(meta)) + meta
 
 
-class _BufferPool:
-    """Recycles large receive buffers between messages.
-
-    Why: at bandwidth sizes the receiver's dominant cost on this class of
-    box is not the copy but the PAGE FAULTS of touching a freshly-mmapped
-    destination — measured on the 16MB stream: 48.8k minor faults, 84ms
-    system time of a 120ms wall (one fault per 4KB page, every message,
-    because glibc munmaps large frees).  Handing each recv an
-    already-faulted buffer removes that entire pass.
-
-    Safety: the user owns the returned array indefinitely, so a buffer is
-    recycled only when proven unreachable — a ``weakref.finalize`` on the
-    handed-out view fires after the view is collected, and the callback
-    re-checks the backing buffer's refcount so any still-alive user alias
-    (numpy collapses ``.base`` chains to the backing buffer) vetoes the
-    recycle."""
-
-    def __init__(self, min_bytes: int = 1 << 20,
-                 max_total: int = 256 << 20, max_per_size: int = 3):
-        self._min, self._max_total = min_bytes, max_total
-        self._max_per_size = max_per_size
-        self._free: dict = {}      # nbytes -> [uint8 arrays]
-        self._total = 0
-        # RLock: _maybe_recycle runs inside weakref.finalize callbacks; a
-        # cyclic-GC collection triggered while the lock is held can run
-        # ANOTHER pooled array's finalizer on the same thread — a plain
-        # Lock would self-deadlock there
-        self._lock = threading.RLock()
-        # Self-calibrate the no-alias refcount through the EXACT production
-        # path (a hand-derived constant broke the alias veto: the finalize
-        # registry's ref structure is an implementation detail).  CPython
-        # fires the finalize synchronously when the probe's refcount hits
-        # zero, so _maybe_recycle records the baseline inline.
-        self._baseline: Optional[int] = None
-        probe = self.empty((self._min,), np.dtype(np.uint8))
-        del probe
-        if self._baseline is None:  # pragma: no cover - non-refcount VM
-            self._baseline = -1     # disables recycling (pool = plain empty)
-
-    def empty(self, shape, dtype: np.dtype) -> np.ndarray:
-        n = int(np.prod(shape)) if shape else 1
-        nbytes = n * dtype.itemsize
-        if nbytes < self._min:
-            return np.empty(shape, dtype)
-        with self._lock:
-            stack = self._free.get(nbytes)
-            buf = stack.pop() if stack else None
-            if buf is not None:
-                self._total -= nbytes
-        if buf is None:
-            buf = np.empty(nbytes, np.uint8)
-        arr = buf.view(dtype).reshape(shape)
-        weakref.finalize(arr, self._maybe_recycle, buf)
-        return arr
-
-    def _maybe_recycle(self, buf: np.ndarray) -> None:
-        refs = sys.getrefcount(buf)
-        if self._baseline is None:
-            self._baseline = refs  # calibration probe, not recycled
-            return
-        # anything beyond the calibrated no-alias baseline is a live user
-        # alias (numpy collapses subview .base chains onto the backing
-        # buffer): drop the buffer instead of recycling aliased memory
-        if self._baseline < 0 or refs > self._baseline:
-            return
-        nbytes = buf.nbytes
-        with self._lock:
-            stack = self._free.setdefault(nbytes, [])
-            if (len(stack) < self._max_per_size
-                    and self._total + nbytes <= self._max_total):
-                stack.append(buf)
-                self._total += nbytes
-
+# The receive pool lives in mpi_tpu/recvpool.py since ISSUE 17, where
+# it grew pow2 SIZE CLASSES (the old pool keyed exact byte counts).
+# The old name stays importable here — tests and callers construct
+# ``_BufferPool(min_bytes=...)`` — and the process-wide instance every
+# byte-stream transport allocates from is still ``codec.RECV_POOL``.
+_BufferPool = _recvpool.RecvPool
 
 RECV_POOL = _BufferPool()
 
@@ -292,27 +223,52 @@ RECV_POOL = _BufferPool()
 RawPayload = Union[np.ndarray, List[np.ndarray], "Encoded"]
 
 
-def unpack_raw_meta(meta: bytes) -> Tuple[Any, int, RawPayload]:
-    """Decode a raw frame's meta pickle; returns (ctx, tag, empty array to
-    read the raw bytes into — pooled at bandwidth sizes, see _BufferPool).
-    A multi-segment meta (3-tuple, see pack_raw_segs_meta) yields a LIST
-    of destination arrays, each pooled independently, to be filled in
-    order from the frame body; a wire-tagged meta (4-tuple with a list,
-    see pack_raw_wire_meta) yields an :class:`Encoded` wrapping its
-    destination segments, so the wire encoding survives to the fold
-    site."""
+def parse_raw_meta(meta: bytes) -> Tuple[Any, int, tuple]:
+    """Decode a raw frame's meta pickle WITHOUT allocating destinations:
+    (ctx, tag, plan), where plan is ``("arr", dtype_str, shape)`` for
+    the single-array frame, ``("segs", descs)`` for multi-segment, and
+    ``("wire", descs, wire)`` for wire-tagged.  The socket reader
+    consults the steering registry with the plan BEFORE any allocation
+    — the rendezvous path needs no intermediate buffer at all."""
     tup = pickle.loads(meta)
     if len(tup) == 4 and isinstance(tup[2], str):
-        ctx, tag, dtype_str, shape = tup
-        return ctx, tag, RECV_POOL.empty(shape, np.dtype(dtype_str))
+        return tup[0], tup[1], ("arr", tup[2], tuple(tup[3]))
     if len(tup) == 4:
-        ctx, tag, descs, wire = tup
-        return ctx, tag, Encoded(wire, [
-            RECV_POOL.empty(shape, np.dtype(dtype_str))
-            for dtype_str, shape in descs])
-    ctx, tag, descs = tup
-    return ctx, tag, [RECV_POOL.empty(shape, np.dtype(dtype_str))
-                      for dtype_str, shape in descs]
+        return tup[0], tup[1], ("wire", tup[2], tup[3])
+    return tup[0], tup[1], ("segs", tup[2])
+
+
+def plan_nbytes(plan: tuple) -> int:
+    """Total body bytes a parsed plan describes (frame-length check)."""
+    def one(ds, shape):
+        n = int(np.prod(shape, dtype=np.int64)) if shape else 1
+        return n * np.dtype(ds).itemsize
+    if plan[0] == "arr":
+        return one(plan[1], plan[2])
+    return sum(one(ds, shape) for ds, shape in plan[1])
+
+
+def alloc_raw(plan: tuple) -> RawPayload:
+    """Pool-allocate a parsed plan's destination payload — the fallback
+    when a frame was not steered into a posted receive buffer.  A
+    multi-segment plan yields a LIST of destination arrays, each pooled
+    independently, filled in order from the frame body; a wire-tagged
+    plan yields an :class:`Encoded` wrapping its destination segments,
+    so the wire encoding survives to the fold site."""
+    if plan[0] == "arr":
+        return RECV_POOL.empty(plan[2], np.dtype(plan[1]))
+    segs = [RECV_POOL.empty(shape, np.dtype(ds)) for ds, shape in plan[1]]
+    return Encoded(plan[2], segs) if plan[0] == "wire" else segs
+
+
+def unpack_raw_meta(meta: bytes) -> Tuple[Any, int, RawPayload]:
+    """Decode a raw frame's meta pickle; returns (ctx, tag, empty
+    destination payload to read the raw bytes into — pooled at
+    bandwidth sizes, see :class:`mpi_tpu.recvpool.RecvPool`).  The
+    shm transport's whole-frame path; the socket reader uses the
+    parse/alloc halves separately to give steering first refusal."""
+    ctx, tag, plan = parse_raw_meta(meta)
+    return ctx, tag, alloc_raw(plan)
 
 
 def raw_destinations(payload: RawPayload) -> List[np.ndarray]:

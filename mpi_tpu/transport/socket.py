@@ -47,10 +47,11 @@ import socket
 import struct
 import threading
 import time
-from typing import Any, Dict, Optional
+from typing import Any, Dict, Optional, Tuple
 
 from .. import bufpool as _bufpool
 from .. import mpit as _mpit
+from .. import recvpool as _recvpool
 from .. import resilience as _resilience
 from .. import telemetry as _telemetry
 from ..errors import EpochSkewError
@@ -141,17 +142,31 @@ class _LinkAbort(TransportError):
     from an ordinary dial failure inside ``_establish_locked``."""
 
 
-def _recv_exact(sock: socket.socket, n: int) -> Optional[bytes]:
+def _recv_exact2(sock: socket.socket,
+                 n: int) -> Tuple[Optional[bytes], bool]:
+    """``(data, torn)``: data is None on EOF/error; ``torn`` is True
+    iff the stream died MID-READ (partial bytes already consumed) — a
+    torn frame the resilient link must heal, as opposed to a clean
+    between-reads close (graceful shutdown, membership departure).
+    ISSUE 17 small fix: the old single-value spelling could not tell
+    the two apart, so a mid-header disconnect was silently classified
+    as a clean EOF."""
     buf = bytearray()
     while len(buf) < n:
         try:
             chunk = sock.recv(n - len(buf))
         except OSError:
-            return None
+            return None, len(buf) > 0
         if not chunk:
-            return None
+            return None, len(buf) > 0
         buf += chunk
-    return bytes(buf)
+    return bytes(buf), False
+
+
+def _recv_exact(sock: socket.socket, n: int) -> Optional[bytes]:
+    """Whole-read-or-None spelling (handshake callers, where a partial
+    hello and a clean refusal are handled identically)."""
+    return _recv_exact2(sock, n)[0]
 
 
 def _recv_into_exact(sock: socket.socket, view: memoryview) -> bool:
@@ -183,6 +198,13 @@ class SocketTransport(Transport):
     # data plane.
     tuning_transport = "socket"
 
+    # Receive-side rendezvous steering is live on this transport
+    # (mpi_tpu/recvpool.py): the communicator registers posted internal
+    # irecvs with ``recv_registry`` and prices the recv-side store
+    # copies it can therefore remove.  Deliberately NOT inherited by
+    # wrappers (transport/faulty.py) — see base.Transport.recv_steering.
+    recv_steering = True
+
     def __init__(
         self,
         rank: int,
@@ -210,6 +232,10 @@ class SocketTransport(Transport):
         # Resilient link layer (mpi_tpu/resilience.py): per-dest
         # sequenced streams + retained replay windows + cumulative acks.
         self._link = LinkState(size)
+        # Posted-irecv registry (mpi_tpu/recvpool.py): pairs fresh
+        # inbound frames with posted internal receives so the reader
+        # can steer body bytes straight into the posted buffer.
+        self.recv_registry = _recvpool.PostedRecvRegistry()
         # last successful data/probe write per destination — what the
         # idle-link keepalive (ISSUE 11, link_keepalive_s cvar) scans
         # to find connections worth probing
@@ -319,15 +345,28 @@ class SocketTransport(Transport):
                 except ValueError:
                     pass
 
+    def _note_torn(self, src: int) -> None:
+        """A connection died MID-FRAME (partial header/meta/body):
+        count it — resilience heals it by replay, but a silent drop
+        here would hide the class of fault from diagnosis entirely."""
+        _mpit.count(link_torn_frames=1)
+        rec = _telemetry.REC
+        if rec is not None:
+            rec.emit("link", "torn_frame", attrs={"src": src})
+
     def _reader_loop(self, conn: socket.socket, src: int,
                      gen: int) -> None:
+        reg = self.recv_registry
         while True:
-            head = _recv_exact(conn, _HEADER.size)
+            head, torn = _recv_exact2(conn, _HEADER.size)
             if head is None:
                 # link fault (reset / sender gone): keep the rx stream
                 # state — the sender reconnects and replays unacked
-                # frames; a mid-frame partial below is discarded the
-                # same way (delivery marks only advance on FULL frames)
+                # frames.  A PARTIAL header is a torn frame (the stream
+                # died mid-frame, resilience territory), distinguished
+                # from a clean between-frames close (graceful shutdown)
+                if torn:
+                    self._note_torn(src)
                 conn.close()
                 return
             word, seq, ack = _HEADER.unpack(head)
@@ -339,20 +378,22 @@ class SocketTransport(Transport):
             plen = word & _LEN_MASK
             if word & codec.RAW_FLAG:
                 # raw frame: tiny meta pickle, then the bytes stream
-                # straight into the freshly-allocated result array(s) —
-                # one destination per segment for multi-segment frames
-                mhead = _recv_exact(conn, codec.META.size)
+                # straight into the destination array(s) — the posted
+                # irecv's own buffer on the rendezvous path, pooled
+                # allocations otherwise
+                mhead, _ = _recv_exact2(conn, codec.META.size)
                 if mhead is None:
+                    self._note_torn(src)  # past the header: always torn
                     conn.close()
                     return
                 (mlen,) = codec.META.unpack(mhead)
-                meta = _recv_exact(conn, mlen)
+                meta, _ = _recv_exact2(conn, mlen)
                 if meta is None:
+                    self._note_torn(src)
                     conn.close()
                     return
-                ctx, tag, out = codec.unpack_raw_meta(meta)
-                dests = codec.raw_destinations(out)
-                total = sum(a.nbytes for a in dests)
+                ctx, tag, plan = codec.parse_raw_meta(meta)
+                total = codec.plan_nbytes(plan)
                 if codec.META.size + mlen + total != plen:
                     # a frame whose meta disagrees with the length word
                     # would desync the byte stream (the remainder of the
@@ -364,22 +405,67 @@ class SocketTransport(Transport):
                         f"raw frame length mismatch from rank {src}: "
                         f"header says {plen}, meta implies "
                         f"{codec.META.size + mlen + total}")
-                ok = True
-                for arr in dests:
-                    if arr.nbytes and not _recv_into_exact(
-                            conn, memoryview(arr).cast("B")):
-                        ok = False
-                        break
-                if not ok:
-                    conn.close()
-                    return
+                # Rendezvous steering (ISSUE 17): count a FRESH
+                # internal-tag frame on its (src, ctx, tag) channel —
+                # rx_fresh admits exactly the frames rx_gate will
+                # deliver, in delivery order, so the pairing with
+                # posted receives survives replay and reconnects.  A
+                # matching posted destination takes the body DIRECTLY
+                # (zero intermediate copy; delivery becomes pointer-
+                # passing of the very view the fold site owns).
+                out = None
+                fresh = tag < 0 and self._link.rx_fresh(src, seq, gen)
+                if fresh:
+                    out = reg.note_frame(src, ctx, tag, seq, gen, plan)
+                rec = _telemetry.REC
+                if out is not None:
+                    # CoW-protect any retained frame still referencing
+                    # the destination region BEFORE scribbling on it —
+                    # a replay must stay bit-exact (mpi_tpu/bufpool.py)
+                    _bufpool.touch(out)
+                    if total and not _recv_into_exact(
+                            conn, memoryview(out).cast("B")):
+                        # torn mid-steer: the entry is consumed, the
+                        # watermark keeps the replay re-presentation
+                        # uncounted — it takes the pool path and the
+                        # fold-site store overwrites the partial bytes
+                        self._note_torn(src)
+                        conn.close()
+                        return
+                    _mpit.count(recv_pool_rendezvous=1,
+                                recv_bytes_steered=total)
+                    if rec is not None:
+                        rec.emit("recvpool", "steer",
+                                 attrs={"src": src, "seq": seq,
+                                        "tag": tag, "nbytes": total})
+                else:
+                    out = codec.alloc_raw(plan)
+                    ok = True
+                    for arr in codec.raw_destinations(out):
+                        if arr.nbytes and not _recv_into_exact(
+                                conn, memoryview(arr).cast("B")):
+                            ok = False
+                            break
+                    if not ok:
+                        self._note_torn(src)
+                        conn.close()
+                        return
+                    if fresh and plan[0] == "arr" and rec is not None:
+                        rec.emit("recvpool", "fallback",
+                                 attrs={"src": src, "seq": seq,
+                                        "tag": tag, "nbytes": total})
                 self._deliver_seq(conn, src, seq, ctx, tag, out, gen)
                 continue
-            payload = _recv_exact(conn, plen)
+            payload, _ = _recv_exact2(conn, plen)
             if payload is None:
+                self._note_torn(src)  # past the header: always torn
                 conn.close()
                 return
             ctx, tag, obj = pickle.loads(payload)
+            if tag < 0 and self._link.rx_fresh(src, seq, gen):
+                # pickle frames on internal channels still count (never
+                # steerable) so the frame/consumer pairing stays aligned
+                reg.note_frame(src, ctx, tag, seq, gen, None)
             self._deliver_seq(conn, src, seq, ctx, tag, obj, gen)
 
     def _deliver_seq(self, conn: socket.socket, src: int, seq: int,
@@ -844,7 +930,13 @@ class SocketTransport(Transport):
         if not (0 <= dest < self.world_size):
             raise ValueError(f"dest {dest} out of range for world size {self.world_size}")
         if dest == self.world_rank:
-            # value-semantics copy (cheap .copy() for arrays)
+            # value-semantics copy (cheap .copy() for arrays).  Count
+            # the delivery on its steering channel first: loopback
+            # traffic on an internal tag consumes posted slots like any
+            # other arrival (its own (self, ctx, tag) channel — never
+            # interleaved with a peer's sequenced stream)
+            if tag < 0:
+                self.recv_registry.note_local(dest, ctx, tag)
             self.mailbox.deliver(dest, ctx, tag, codec.value_copy(payload))
             return
         frame = codec.pack_raw_frame(ctx, tag, payload)
@@ -1031,6 +1123,12 @@ class SocketTransport(Transport):
                     except OSError:
                         pass
                 self._link.purge_peer(dest)
+                # resync the steering registry to the bumped generation:
+                # the purged stream's in-flight frames died with it, and
+                # the fenced watermark keeps old-incarnation stragglers
+                # from ever counting (mpi_tpu/recvpool.py)
+                self.recv_registry.purge_src(
+                    dest, self._link.peer_gen(dest))
             # kill the slot's INBOUND readers too: their captured
             # stream generation just went stale, so every frame they
             # read would be fence-dropped — for the corpse that is the

@@ -1,0 +1,504 @@
+"""Receive-side zero-copy (ISSUE 17): the size-classed recv pool, the
+posted-irecv registry, rendezvous steering on the live socket stack,
+the sorted-interval CoW index (PR-11 residual c), and the persistent
+double-buffered re-fire (PR-12 residual e).
+
+The acceptance leg lives here too: a 16MB socket allreduce run with
+steering off then on must show ``payload_copies`` dropping by exactly
+the recv-side stores while ``recv_bytes_steered`` proves the bytes
+landed directly in the posted buffers.
+"""
+
+import os
+import sys
+import threading
+
+import numpy as np
+import pytest
+
+from mpi_tpu import bufpool, mpit, ops, recvpool, telemetry
+from mpi_tpu.recvpool import PostedRecvRegistry, RecvPool
+from mpi_tpu.resilience import LinkState
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from test_resilience import run_socket_world  # noqa: E402
+
+
+# -- RecvPool: size classes + recycling ---------------------------------------
+
+
+def test_class_bytes_pow2_rounding():
+    assert RecvPool.class_bytes(1) == 1
+    assert RecvPool.class_bytes(1024) == 1024
+    assert RecvPool.class_bytes(1025) == 2048
+    assert RecvPool.class_bytes((3 << 20) + (1 << 19)) == 4 << 20  # 3.5MB->4MB
+
+
+def test_below_floor_allocations_bypass_the_pool():
+    pool = RecvPool(min_bytes=1 << 12)
+    h0, m0 = mpit.counters.rp_hits, mpit.counters.rp_misses
+    a = pool.empty((8,), np.dtype(np.float64))
+    assert a.shape == (8,) and a.base is None  # plain np.empty, no class buf
+    assert (mpit.counters.rp_hits, mpit.counters.rp_misses) == (h0, m0)
+
+
+def test_recycle_reuses_the_class_buffer():
+    pool = RecvPool(min_bytes=1 << 12)
+    a = pool.empty((1 << 12,), np.dtype(np.uint8))
+    addr0 = a.base.__array_interface__["data"][0]
+    h0 = mpit.counters.rp_hits
+    del a  # refcount -> 0: finalize fires synchronously, recycles
+    b = pool.empty((1 << 11, 2), np.dtype(np.uint8))  # same class, any shape
+    assert b.base.__array_interface__["data"][0] == addr0
+    assert mpit.counters.rp_hits == h0 + 1
+
+
+def test_subclass_sizes_share_a_class_buffer():
+    pool = RecvPool(min_bytes=1 << 12)
+    a = pool.empty(((1 << 12) + 100,), np.dtype(np.uint8))  # rounds to 8192
+    addr0 = a.base.__array_interface__["data"][0]
+    assert a.base.nbytes == 1 << 13
+    del a
+    b = pool.empty((1 << 10,), np.dtype(np.float64))  # 8192 bytes exactly
+    assert b.base.__array_interface__["data"][0] == addr0
+
+
+def test_live_alias_vetoes_recycling():
+    """A user slice keeps the backing buffer's refcount above the
+    calibrated baseline: the finalize must NOT hand the memory out
+    again while the alias can still read it."""
+    pool = RecvPool(min_bytes=1 << 12)
+    a = pool.empty((1 << 12,), np.dtype(np.uint8))
+    a[:] = 7
+    alias = a[16:32]  # numpy collapses .base onto the backing buffer
+    addr0 = a.base.__array_interface__["data"][0]
+    del a
+    b = pool.empty((1 << 12,), np.dtype(np.uint8))
+    b[:] = 9
+    assert b.base.__array_interface__["data"][0] != addr0
+    np.testing.assert_array_equal(alias, np.full(16, 7, np.uint8))
+
+
+def test_free_list_bounded_per_class():
+    pool = RecvPool(min_bytes=1 << 12, max_per_size=3)
+    for _ in range(5):
+        a = pool.empty((1 << 12,), np.dtype(np.uint8))
+        del a
+    assert len(pool._free[1 << 12]) <= 3
+
+
+# -- PostedRecvRegistry: pairing protocol -------------------------------------
+
+
+SRC, CTX, TAG = 1, ("c", 0), -2
+
+
+def _plan(shape, dtype="<f8"):
+    return ("arr", dtype, tuple(shape))
+
+
+def test_registry_pairs_posts_with_frames_in_order():
+    reg = PostedRecvRegistry()
+    d1, d2 = np.empty(4), np.empty(4)
+    t1 = reg.note_post(SRC, CTX, TAG)
+    t2 = reg.note_post(SRC, CTX, TAG)
+    reg.attach(t1, d1)
+    reg.attach(t2, d2)
+    assert reg.note_frame(SRC, CTX, TAG, 1, 0, _plan((4,))) is d1
+    assert reg.note_frame(SRC, CTX, TAG, 2, 0, _plan((4,))) is d2
+
+
+def test_registry_geometry_mismatch_falls_back():
+    reg = PostedRecvRegistry()
+    t = reg.note_post(SRC, CTX, TAG)
+    reg.attach(t, np.empty(4))
+    # wrong shape -> pool path; entry is consumed either way
+    assert reg.note_frame(SRC, CTX, TAG, 1, 0, _plan((5,))) is None
+    t2 = reg.note_post(SRC, CTX, TAG)
+    reg.attach(t2, np.empty(4, np.float32))
+    # wrong dtype
+    assert reg.note_frame(SRC, CTX, TAG, 2, 0, _plan((4,))) is None
+    # non-"arr" plans (multi-segment, wire-encoded, pickled) never steer
+    t3 = reg.note_post(SRC, CTX, TAG)
+    reg.attach(t3, np.empty(4))
+    assert reg.note_frame(SRC, CTX, TAG, 3, 0, ("segs", [])) is None
+
+
+def test_registry_unattached_and_blocking_consumers_align_indices():
+    reg = PostedRecvRegistry()
+    t1 = reg.note_post(SRC, CTX, TAG)      # idx 1, attached
+    reg.note_consume(SRC, CTX, TAG)        # idx 2, blocking recv
+    t3 = reg.note_post(SRC, CTX, TAG)      # idx 3, attached
+    d1, d3 = np.empty(4), np.empty(4)
+    reg.attach(t1, d1)
+    reg.attach(t3, d3)
+    assert reg.note_frame(SRC, CTX, TAG, 1, 0, _plan((4,))) is d1
+    assert reg.note_frame(SRC, CTX, TAG, 2, 0, _plan((4,))) is None
+    assert reg.note_frame(SRC, CTX, TAG, 3, 0, _plan((4,))) is d3
+
+
+def test_registry_frame_ahead_of_post_drops_the_stale_entry():
+    """A frame that arrives before any consumer was counted claims
+    nothing; the post counted AFTER it is stale for that frame and must
+    not claim a LATER frame (conservative miss, never a false claim)."""
+    reg = PostedRecvRegistry()
+    assert reg.note_frame(SRC, CTX, TAG, 1, 0, _plan((4,))) is None
+    t = reg.note_post(SRC, CTX, TAG)  # idx 1 but frame 1 already passed
+    reg.attach(t, np.empty(4))
+    assert reg.note_frame(SRC, CTX, TAG, 2, 0, _plan((4,))) is None
+    assert reg.stats()["entries"] == 0  # stale entry was dropped
+
+
+def test_registry_cancel_removes_the_entry():
+    reg = PostedRecvRegistry()
+    t = reg.note_post(SRC, CTX, TAG)
+    reg.attach(t, np.empty(4))
+    reg.cancel(t)
+    assert reg.stats()["entries"] == 0
+    assert reg.note_frame(SRC, CTX, TAG, 1, 0, _plan((4,))) is None
+    reg.cancel(None)  # no-op by contract
+
+
+def test_registry_watermark_dedups_replay_representation():
+    reg = PostedRecvRegistry()
+    t = reg.note_post(SRC, CTX, TAG)
+    reg.attach(t, np.empty(4))
+    assert reg.note_frame(SRC, CTX, TAG, 1, 0, _plan((4,))) is not None
+    # the same (gen, seq) presented again (old-conn drain vs replay
+    # race, or counted-then-torn steer): never recounted
+    before = reg.stats()["arrived"]
+    assert reg.note_frame(SRC, CTX, TAG, 1, 0, _plan((4,))) is None
+    assert reg.stats()["arrived"] == before
+
+
+def test_registry_purge_resyncs_and_fences():
+    reg = PostedRecvRegistry()
+    t1 = reg.note_post(SRC, CTX, TAG)
+    t2 = reg.note_post(SRC, CTX, TAG)
+    reg.attach(t1, np.empty(4))
+    reg.attach(t2, np.empty(4))
+    assert reg.note_frame(SRC, CTX, TAG, 1, 0, _plan((4,))) is not None
+    reg.purge_src(SRC, 1)  # membership removal; gen bumped to 1
+    s = reg.stats()
+    assert s["entries"] == 0 and s["arrived"] == s["posted"]
+    # an old-generation straggler sits below the fence: never counts
+    assert reg.note_frame(SRC, CTX, TAG, 2, 0, _plan((4,))) is None
+    assert reg.stats()["arrived"] == s["arrived"]
+    # the replacement stream counts from (gen 1, seq 1)
+    t3 = reg.note_post(SRC, CTX, TAG)
+    d3 = np.empty(4)
+    reg.attach(t3, d3)
+    assert reg.note_frame(SRC, CTX, TAG, 1, 1, _plan((4,))) is d3
+
+
+def test_registry_self_send_consumes_posted_slots():
+    reg = PostedRecvRegistry()
+    t = reg.note_post(SRC, CTX, TAG)
+    reg.attach(t, np.empty(4))
+    reg.note_local(SRC, CTX, TAG)  # loopback delivery, never steered
+    assert reg.stats()["entries"] == 0
+
+
+def test_registry_attach_rejects_non_steerable_views():
+    reg = PostedRecvRegistry()
+    t = reg.note_post(SRC, CTX, TAG)
+    ro = np.empty(4)
+    ro.flags.writeable = False
+    reg.attach(t, ro)
+    assert reg.note_frame(SRC, CTX, TAG, 1, 0, _plan((4,))) is None
+    t2 = reg.note_post(SRC, CTX, TAG)
+    reg.attach(t2, np.empty((4, 4))[:, 0])  # non-contiguous
+    assert reg.note_frame(SRC, CTX, TAG, 2, 0, _plan((4,))) is None
+
+
+def test_steering_cvar_disables_claiming_not_accounting():
+    reg = PostedRecvRegistry()
+    old = mpit.cvar_read("recv_steering")
+    try:
+        mpit.cvar_write("recv_steering", 0)
+        assert recvpool._STEERING == 0
+        t = reg.note_post(SRC, CTX, TAG)
+        reg.attach(t, np.empty(4))
+        # accounting continues (frame counted, entry consumed) but the
+        # claim is refused — toggling can never desync the pairing
+        assert reg.note_frame(SRC, CTX, TAG, 1, 0, _plan((4,))) is None
+        assert reg.stats()["arrived"] == 1
+    finally:
+        mpit.cvar_write("recv_steering", old)
+    assert recvpool._STEERING == old
+
+
+def test_rx_fresh_admits_exactly_the_next_in_sequence_frame():
+    ls = LinkState(2)
+    assert ls.rx_fresh(1, 1, 0)          # next in sequence, current gen
+    assert not ls.rx_fresh(1, 2, 0)      # gap frame: not counted
+    assert not ls.rx_fresh(1, 1, 1)      # stale/future generation
+    ls.rx_gate(1, 1, lambda: None)       # deliver seq 1
+    assert not ls.rx_fresh(1, 1, 0)      # replay duplicate
+    assert ls.rx_fresh(1, 2, 0)
+
+
+# -- sorted-interval CoW live-range index (bufpool, PR-11 residual c) ---------
+
+
+def _addr(arr):
+    return arr.__array_interface__["data"][0]
+
+
+def test_interval_index_overlap_snapshots_exactly_the_hit():
+    base = np.zeros(256, np.uint8)
+    a, b = base[0:64], base[128:192]
+    ra, rb = bufpool.BufRef([a]), bufpool.BufRef([b])
+    try:
+        assert bufpool.touch(base[130:140]) == 1
+        assert rb.snapshotted and not ra.snapshotted
+        assert bufpool.touch(base[130:140]) == 0  # already snapshotted
+    finally:
+        ra.release(), rb.release()
+
+
+def test_interval_index_adjacency_is_half_open():
+    """[s, m) and [m, e) are adjacent, not overlapping: a write at m
+    snapshots only the second ref (e > qs is strict)."""
+    base = np.zeros(256, np.uint8)
+    ra, rb = bufpool.BufRef([base[0:64]]), bufpool.BufRef([base[64:128]])
+    try:
+        assert bufpool.touch(base[64:65]) == 1
+        assert rb.snapshotted and not ra.snapshotted
+    finally:
+        ra.release(), rb.release()
+
+
+def test_interval_index_duplicate_ranges_unregister_by_identity():
+    base = np.zeros(256, np.uint8)
+    view = base[0:64]
+    r1, r2 = bufpool.BufRef([view]), bufpool.BufRef([view])
+    try:
+        r1.release()  # must remove r1's record, not r2's
+        assert bufpool.touch(base[10:11]) == 1
+        assert r2.snapshotted
+    finally:
+        r1.release(), r2.release()
+
+
+def test_interval_index_maxlen_window_finds_long_intervals():
+    """The scan-back window: a query point deep inside a LONG interval
+    whose start is far below the query must still hit (that is what
+    ``_maxlen`` bounds), including after shorter refs registered."""
+    big = np.zeros(1 << 16, np.uint8)
+    small = np.zeros(64, np.uint8)
+    rb, rs = bufpool.BufRef([big]), bufpool.BufRef([small])
+    try:
+        assert bufpool.touch(big[(1 << 16) - 10:(1 << 16) - 9]) == 1
+        assert rb.snapshotted and not rs.snapshotted
+    finally:
+        rb.release(), rs.release()
+
+
+def test_interval_index_purge_drains_and_resets_maxlen():
+    big = np.zeros(1 << 16, np.uint8)
+    small = np.zeros(64, np.uint8)
+    rb, rs = bufpool.BufRef([big]), bufpool.BufRef([small])
+    assert bufpool._maxlen >= 1 << 16
+    rb.release()
+    # grow-only while non-empty: the stale bound costs scan width only
+    assert bufpool._maxlen >= 1 << 16
+    assert bufpool.touch(small[3:5]) == 1  # still correct
+    rs.release()
+    assert bufpool._maxlen == 0 and not bufpool._ivals  # drained -> reset
+    assert bufpool.touch(small[3:5]) == 0
+
+
+def test_interval_index_multirange_ref_registers_every_range():
+    base = np.zeros(512, np.uint8)
+    ref = bufpool.BufRef([base[0:64], base[256:320]])
+    try:
+        assert bufpool.touch(base[257:258]) == 1  # second range hits too
+        assert ref.snapshotted
+    finally:
+        ref.release()
+
+
+# -- live socket worlds: rendezvous steering end to end -----------------------
+
+
+def _steer_deltas(prog, nranks, **kw):
+    names = ("recv_pool_rendezvous", "recv_bytes_steered", "recv_pool_hits",
+             "recv_pool_misses", "payload_copies", "link_torn_frames")
+    base = {n: mpit.pvar_read(n) for n in names}
+    res = run_socket_world(prog, nranks, **kw)
+    return res, {n: mpit.pvar_read(n) - base[n] for n in names}
+
+
+def test_socket_16mb_allreduce_steers_and_drops_the_recv_copy():
+    """THE acceptance assert: steering off, the 16MB ring allreduce
+    pays one fold-site store per received store-span (counted into
+    ``payload_copies``); steering on, those stores vanish from the
+    counter and ``recv_bytes_steered`` shows the bytes landing directly
+    in the posted working-buffer spans.  Runs with the flight recorder
+    OFF — every steer/fallback seam takes its ``REC is None`` branch."""
+    assert telemetry.REC is None
+    data = [np.random.RandomState(i).randn(1 << 21) for i in range(2)]  # 16MB
+    want = data[0] + data[1]
+
+    def prog(comm):
+        out = comm.allreduce(data[comm.rank], ops.SUM)
+        np.testing.assert_allclose(out, want)
+        return True
+
+    old = mpit.cvar_read("recv_steering")
+    try:
+        mpit.cvar_write("recv_steering", 0)
+        res, off = _steer_deltas(prog, 2)
+        assert all(res)
+        mpit.cvar_write("recv_steering", 1)
+        res, on = _steer_deltas(prog, 2)
+        assert all(res)
+    finally:
+        mpit.cvar_write("recv_steering", old)
+    # off: no rendezvous, every store priced, every body pool-staged
+    assert off["recv_pool_rendezvous"] == 0
+    assert off["recv_bytes_steered"] == 0
+    assert off["payload_copies"] >= 2  # the recv-side stores
+    assert off["recv_pool_hits"] + off["recv_pool_misses"] >= 4
+    # on: the drop — stores leave the copy counter, bytes steer direct
+    assert on["payload_copies"] == 0
+    assert on["recv_pool_rendezvous"] > 0
+    assert on["recv_bytes_steered"] >= 4 << 20  # at least one 4MB segment
+
+
+def test_steering_survives_engine_and_nbc_paths():
+    """iallreduce via the progress-engine state machines on the socket
+    stack: span stores steer through _SMColl._apply's identity check."""
+    data = [np.random.RandomState(10 + i).randn(1 << 20) for i in range(2)]
+    want = data[0] + data[1]
+
+    def prog(comm):
+        got = comm.iallreduce(data[comm.rank], ops.SUM).wait()
+        np.testing.assert_allclose(got, want)
+        return True
+
+    res, d = _steer_deltas(prog, 2)
+    assert all(res)
+    assert d["payload_copies"] == 0
+
+
+def test_trace_events_mark_steer_vs_fallback():
+    """Flight-recorder visibility (satellite): with tracing ON, steered
+    frames emit ``recvpool/steer`` instants that survive into the
+    chrome export tracecat merges."""
+    data = [np.random.RandomState(20 + i).randn(1 << 21) for i in range(2)]
+
+    def prog(comm):
+        comm.allreduce(data[comm.rank], ops.SUM)
+        return True
+
+    rec = telemetry.enable(capacity=4096)
+    try:
+        assert all(run_socket_world(prog, 2))
+        steers = rec.find("recvpool", "steer")
+        assert steers, "no steer events recorded"
+        assert {"src", "seq", "tag", "nbytes"} <= set(steers[0]["attrs"])
+        cats = {e.get("cat") for e in rec.chrome_trace()["traceEvents"]}
+        assert "recvpool" in cats  # instants render in the merge
+    finally:
+        telemetry.disable()
+
+
+def test_torn_frame_distinguished_from_clean_close():
+    """Satellite fix: a clean world teardown must not tick
+    ``link_torn_frames``; a mid-frame disconnect must."""
+    def prog(comm):
+        comm.allreduce(np.full(64, 1.0))
+        comm.barrier()
+        return True
+
+    _, d = _steer_deltas(prog, 2)
+    assert d["link_torn_frames"] == 0  # clean closes are not torn
+
+    from mpi_tpu.transport.faulty import FaultyTransport
+    big = np.arange(1 << 20, dtype=np.float64)  # 8MB
+
+    def chaos(comm):
+        FaultyTransport(comm._t, link_reset_midframe_every=2)
+        if comm.rank == 0:
+            comm.send(big, dest=1, tag=5)
+        else:
+            got = comm.recv(source=0, tag=5)
+            assert np.array_equal(got, big)
+        comm.barrier()
+        return True
+
+    assert telemetry.REC is None  # the torn seam's REC-off branch
+    res, d = _steer_deltas(chaos, 2)
+    assert all(res)
+    assert d["link_torn_frames"] >= 1
+
+
+# -- persistent double-buffered re-fire (PR-12 residual e) --------------------
+
+
+def test_persistent_allreduce_alternates_two_preallocated_buffers():
+    from mpi_tpu.transport.local import run_local
+
+    def prog(comm):
+        x = np.arange(8, dtype=np.float64)
+        h = comm.allreduce_init(x)
+        outs, bases = [], []
+        for rd in range(4):
+            x[:] = np.arange(8, dtype=np.float64) * (rd + 1)
+            got = h.start().wait()
+            np.testing.assert_array_equal(
+                got, np.arange(8) * (rd + 1) * comm.size)
+            bases.append(id(np.asarray(got).base))
+            outs.append(float(got.sum()))
+        # two buffers, alternated: rounds k and k+2 share a base
+        assert bases[0] == bases[2] and bases[1] == bases[3]
+        assert bases[0] != bases[1]
+        return outs
+
+    res = run_local(prog, 2, progress="thread")
+    assert res[0] == res[1]
+
+
+def test_persistent_round_result_valid_until_round_plus_two():
+    """The documented double-buffer contract: round k's result array is
+    overwritten when round k+2 starts (it IS buffer k % 2)."""
+    from mpi_tpu.transport.local import run_local
+
+    def prog(comm):
+        x = np.zeros(4)
+        h = comm.allreduce_init(x)
+        x[:] = 1.0
+        r1 = h.start().wait()
+        v1 = np.asarray(r1).copy()
+        x[:] = 2.0
+        r2 = h.start().wait()
+        np.testing.assert_array_equal(r1, v1)  # still valid: one round
+        x[:] = 3.0
+        r3 = h.start().wait()
+        # r1's buffer was recycled for round 3
+        np.testing.assert_array_equal(np.asarray(r1), np.asarray(r3))
+        return float(np.asarray(r2)[0])
+
+    assert run_local(prog, 2, progress="thread") == [4.0, 4.0]
+
+
+def test_persistent_refire_allocates_no_new_work_buffers():
+    """After the first two rounds the re-fire path is allocation-free
+    for working buffers: the same two backing arrays carry every
+    subsequent round."""
+    from mpi_tpu.transport.local import run_local
+
+    def prog(comm):
+        x = np.ones(1024)
+        h = comm.allreduce_init(x)
+        seen = set()
+        for rd in range(6):
+            got = h.start().wait()
+            seen.add(id(np.asarray(got).base))
+            assert float(np.asarray(got)[0]) == comm.size
+        assert len(seen) == 2
+        return True
+
+    assert all(run_local(prog, 2, progress="thread"))

@@ -227,6 +227,37 @@ def test_hook_reset_storm_under_mixed_collectives():
     assert ses.read("link_faults_masked") >= total
 
 
+def test_reset_storm_bit_parity_with_steered_receives():
+    """ISSUE 17 regression of the links-chaos acceptance: rendezvous
+    steering stays BIT-exact while a reset storm tears connections —
+    torn mid-steer frames are replayed onto the pool path, the fenced
+    watermark keeps replays uncounted, and every recycled receive
+    buffer delivers the same bytes the copy path would have.  Payloads
+    are pool-class sized (>= 1MB) so both the steered and pool-staged
+    receive paths run under the churn."""
+    ses = mpit.session_create()
+    ses.reset_all()
+    n = 1 << 17  # 1MB doubles: above the recv-pool floor
+
+    def prog(comm):
+        inj = FaultyTransport(comm._t, link_reset_every=7,
+                              link_reset_midframe_every=11)
+        for i in range(10):
+            x = np.full(n, float(comm.rank + i))
+            out = comm.allreduce(x, algorithm="ring")
+            want = float(sum(r + i for r in range(comm.size)))
+            # bit parity, not allclose: integer-valued sums are exact
+            assert np.array_equal(out, np.full(n, want)), i
+        comm.barrier()
+        return inj.link_resets + inj.link_midframe_resets
+
+    res = run_socket_world(prog, 2, timeout=120)
+    assert sum(res) >= 2, res
+    assert ses.read("link_faults_masked") >= 2
+    # the storm ran THROUGH the steering path, not around it
+    assert ses.read("recv_bytes_steered") > 0
+
+
 def test_accept_side_drop_retried_by_connector():
     def prog(comm):
         if comm.rank == 1:

@@ -307,6 +307,51 @@ def test_connect_addr_file_retry_delayed_and_partial(tmp_path):
         mpit.cvar_write("connect_retry_timeout_s", old)
 
 
+def test_silent_server_bounded_by_request_timeout(monkeypatch):
+    """ISSUE 17 ride-along: a server that ACCEPTS but never replies —
+    the SIGSTOP-frozen-leader shape, where the TCP connection stays
+    ESTABLISHED in the kernel so there is no EOF and no error — must
+    not wedge a timeout-bearing request forever.  The client bounds its
+    reply wait by the op timeout the SERVER itself enforces (plus
+    slack) and surfaces the stall as the named ServerLostError (the
+    federated client's failover signal).  Timeout-less ops keep the
+    blocking-read semantics — only the named-bound path changes."""
+    import socket
+    import threading
+
+    monkeypatch.setattr(serve, "_RPC_GRACE_S", 1.0)
+    lst = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    lst.bind(("127.0.0.1", 0))
+    lst.listen(4)
+    conns = []
+
+    def frozen_accept():
+        try:
+            while True:
+                c, _ = lst.accept()
+                conns.append(c)  # hold it open, never reply
+        except OSError:
+            pass
+
+    th = threading.Thread(target=frozen_accept, daemon=True)
+    th.start()
+    try:
+        client = serve.ServerClient("127.0.0.1", lst.getsockname()[1])
+        t0 = time.monotonic()
+        # the stalled read surfaces as a ServerLostError either way it
+        # is classified (recv timeout wrapped, or the frame reader
+        # reporting no reply) — both are the failover signal
+        with pytest.raises(serve.ServerLostError):
+            client.acquire(1, timeout=0.5)
+        assert time.monotonic() - t0 < 10.0, \
+            "the stall must resolve within timeout + grace, not hang"
+    finally:
+        lst.close()
+        for c in conns:
+            c.close()
+        th.join(2.0)
+
+
 # -- pooled coll/sm arena across leases (ISSUE 11 tentpole #3) ----------------
 
 
