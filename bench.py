@@ -584,6 +584,13 @@ if __name__ == "__main__":
             args.append("--links")
         if "--federation" in sys.argv[1:]:
             args.append("--federation")
+        if "--partition" in sys.argv[1:]:
+            # ISSUE 18: the replicated-store partition leg — a raft
+            # fabric namespace (no shared dir), a store-level partition
+            # isolating the raft leader, named NoQuorumError refusal on
+            # the minority, stale-intent truncation on heal (the
+            # committed federation_partition_{pre,post}.json artifacts)
+            args.append("--partition")
         if "--pre" in sys.argv[1:]:
             args.append("--pre")
         if "--no-healing" in sys.argv[1:]:

@@ -669,7 +669,8 @@ def _merge_links_trace(trace_dir: str) -> Dict:
 
 def _spawn_fed_server(idx: int, ns: str, logdir: str, pool: int,
                       federated: bool = True,
-                      max_pending: int = 32) -> Dict:
+                      max_pending: int = 32,
+                      env_extra: Dict = None) -> Dict:
     """One ``launcher serve`` subprocess (real process: the storm
     SIGKILLs it).  Returns {proc, addr_file, log, id}."""
     import subprocess
@@ -686,7 +687,8 @@ def _spawn_fed_server(idx: int, ns: str, logdir: str, pool: int,
         argv += ["--federation", ns, "--fed-lease-timeout", "2.0",
                  "--orphan-timeout", "30"]
     proc = subprocess.Popen(argv, cwd=REPO,
-                            env=dict(os.environ, JAX_PLATFORMS="cpu"),
+                            env=dict(os.environ, JAX_PLATFORMS="cpu",
+                                     **(env_extra or {})),
                             stdout=log, stderr=log)
     return {"proc": proc, "addr_file": addr_file, "log": log,
             "id": f"srv{idx}"}
@@ -698,7 +700,8 @@ _FED_NAMED = None  # lazily-built tuple of acceptable named error classes
 def _fed_named_errors():
     global _FED_NAMED
     if _FED_NAMED is None:
-        from mpi_tpu.errors import EpochSkewError, ServerBusyError
+        from mpi_tpu.errors import (EpochSkewError, NoQuorumError,
+                                    ServerBusyError)
         from mpi_tpu.serve import ServerLostError
 
         # deliberately NO blanket OSError: ServerClient wraps raw
@@ -707,7 +710,7 @@ def _fed_named_errors():
         # anonymous-crash class this gate exists to catch
         _FED_NAMED = (ProcFailedError, RevokedError, EpochSkewError,
                       RecvTimeout, ServerLostError, TransportError,
-                      TimeoutError, ServerBusyError)
+                      TimeoutError, ServerBusyError, NoQuorumError)
     return _FED_NAMED
 
 
@@ -965,6 +968,307 @@ def run_federation_chaos(quick: bool = False, pre: bool = False) -> Dict:
         shutil.rmtree(logdir, ignore_errors=True)
 
 
+def _free_ports(n: int) -> List[int]:
+    """Reserve n distinct loopback ports (bind-then-close: a short race
+    window, acceptable for a chaos harness)."""
+    import socket as _socket
+
+    socks, ports = [], []
+    for _ in range(n):
+        s = _socket.socket()
+        s.bind(("127.0.0.1", 0))
+        socks.append(s)
+        ports.append(s.getsockname()[1])
+    for s in socks:
+        s.close()
+    return ports
+
+
+def _raft_node_stats(store, raft_addrs: List[str]) -> List[Dict]:
+    """Chaos-RPC stats from every reachable raft node (dead/partitioned
+    nodes recorded as {"unreachable": ...})."""
+    out = []
+    for a in raft_addrs:
+        try:
+            out.append(store.chaos(a, {"op": "stats"})["stats"])
+        except Exception as e:  # noqa: BLE001 - dead node is data here
+            out.append({"unreachable": f"{type(e).__name__}"})
+    return out
+
+
+def run_federation_partition(quick: bool = False,
+                             pre: bool = False) -> Dict:
+    """The replicated-store partition leg (ISSUE 18 acceptance): a
+    3-server federation whose namespace is a RaftStore fabric — every
+    server embeds one raft node (``--federation raft:IDX@a0,a1,a2``),
+    NO shared directory — under an open-loop client fleet, with a
+    store-level network partition injected mid-run (chaos RPC, gated
+    on MPI_TPU_STORE_CHAOS=1) that isolates the raft LEADER's node.
+    Contract (post):
+
+    * the minority-side server REFUSES new leases with the named
+      :class:`NoQuorumError` (admission fence) — probed directly
+      against its serve endpoint, over the wire;
+    * the majority side keeps serving: aggregate worlds/s never
+      reaches zero in any observation window;
+    * on heal, the deposed leader's uncommitted lease intents are
+      DISCARDED (``truncated_entries`` > 0 across the fabric), not
+      replayed — and the leader-interval log shows no authority
+      overlap;
+    * a subsequent SIGKILL of the serve leader (2-of-3 raft quorum
+      preserved) still heals to full strength with a correct final
+      cross-server lease — partition tolerance and crash tolerance
+      compose.
+
+    ``pre=True`` is the honest baseline: the SAME fabric with the
+    admission fence disabled (MPI_TPU_SERVE_STORE_FENCE=0) — the
+    minority server happily grants leases it has no replicated
+    authority to grant (recorded as ``stale_grant_succeeded``), which
+    is exactly the split-brain hazard the fence closes.  Committed as
+    benchmarks/results/federation_partition_{pre,post}.json."""
+    import shutil
+    import signal as _signal
+    import tempfile
+    import threading
+
+    from mpi_tpu import federation as _federation
+    from mpi_tpu import federation_store as _fstore
+    from mpi_tpu import serve as _serve
+    from mpi_tpu.errors import NoQuorumError
+
+    nservers = 3  # raft wants an odd fabric; 2-of-3 is the quorum story
+    pool = 2
+    nclients = 4 if quick else 12
+    duration_s = 16.0 if quick else 28.0
+    window_s = 4.0
+    think_s = 0.25
+    part_frac, heal_frac, kill_frac = 0.15, 0.5, 0.7
+    rng = __import__("random").Random(8421)
+    t_start = time.time()
+    logdir = tempfile.mkdtemp(prefix="mpi_tpu_fedpart_log_")
+    ports = _free_ports(nservers)
+    raft_addrs = [f"127.0.0.1:{p}" for p in ports]
+    addrs_str = ",".join(raft_addrs)
+    cspec = f"raft:{addrs_str}"  # client spec: no embedded node
+    env_extra = {"MPI_TPU_STORE_CHAOS": "1"}
+    if pre:
+        env_extra["MPI_TPU_SERVE_STORE_FENCE"] = "0"
+    servers = [_spawn_fed_server(i, f"raft:{i}@{addrs_str}", logdir,
+                                 pool, env_extra=env_extra)
+               for i in range(nservers)]
+    outcomes: List[Dict] = []
+    out_lock = threading.Lock()
+    result: Dict = {
+        "quick": quick, "leg": "pre" if pre else "post",
+        "servers": nservers, "pool_per_server": pool,
+        "clients": nclients, "duration_s": duration_s,
+        "store": cspec, "fence": not pre,
+        "oversubscribed":
+            (nservers * (pool + 1) + 2) > (os.cpu_count() or 1),
+    }
+    try:
+        deadline_up = time.monotonic() + 120.0
+        serve_addrs = []
+        for s in servers:
+            while not os.path.exists(s["addr_file"]):
+                if s["proc"].poll() is not None:
+                    raise RuntimeError(
+                        f"server {s['id']} died at startup")
+                if time.monotonic() > deadline_up:
+                    raise RuntimeError("servers never published addrs")
+                time.sleep(0.1)
+            with open(s["addr_file"]) as f:
+                serve_addrs.append(f.read().strip())
+        while len([r for r in
+                   _federation.read_server_records(cspec).values()
+                   if _federation.record_live(r)]) < nservers:
+            if time.monotonic() > deadline_up:
+                raise RuntimeError("servers never joined namespace")
+            time.sleep(0.1)
+        store = _fstore.resolve_store(cspec)
+
+        def make_client():
+            return _federation.FederatedClient(
+                namespace=cspec, failover_timeout_s=4.0)
+
+        t0 = time.monotonic()
+        deadline = t0 + duration_s
+        threads = [threading.Thread(
+            target=_fed_client_loop,
+            args=(make_client, deadline, t0, outcomes, out_lock,
+                  __import__("random").Random(2000 + i), think_s),
+            daemon=True) for i in range(nclients)]
+        for th in threads:
+            th.start()
+
+        # -- phase 1: partition the raft leader's node away ------------
+        wait = t0 + part_frac * duration_s - time.monotonic()
+        if wait > 0:
+            time.sleep(wait)
+        stats0 = _raft_node_stats(store, raft_addrs)
+        leaders = [i for i, st in enumerate(stats0)
+                   if st.get("role") == "leader"]
+        lid = leaders[-1] if leaders else 0  # highest term wins ties
+        pmap = {i: (1 if i == lid else 0) for i in range(nservers)}
+        for a in raft_addrs:
+            store.chaos(a, {"op": "partition", "map": pmap})
+        result["partition"] = {"isolated_node": lid, "map": pmap,
+                               "at_s": round(time.monotonic() - t0, 2)}
+
+        # probe the minority server's serve endpoint DIRECTLY: the
+        # fence must refuse with the named error over the wire (post);
+        # with the fence off it grants a lease its lapsed authority
+        # cannot back (pre)
+        probe_deadline = t0 + heal_frac * duration_s - 1.0
+        refused_named = False
+        stale_grant = False
+        probe_err = None
+        time.sleep(2.0)  # let the isolated node notice its acks stale
+        while time.monotonic() < probe_deadline \
+                and not (refused_named or stale_grant):
+            pc = None
+            try:
+                pc = _serve.connect(serve_addrs[lid], timeout=4.0)
+                got = pc.run(_serve.job_allreduce, 128, nranks=1,
+                             timeout=4.0)
+                stale_grant = (got == 1.0)
+            except NoQuorumError as e:
+                refused_named = True
+                probe_err = str(e)[:200]
+            except Exception as e:  # noqa: BLE001 - recorded below
+                probe_err = f"{type(e).__name__}: {str(e)[:120]}"
+            finally:
+                if pc is not None:
+                    try:
+                        pc.close()
+                    except Exception:  # noqa: BLE001
+                        pass
+            time.sleep(0.3)
+        result["minority_probe"] = {
+            "refused_with_noquorum": refused_named,
+            "stale_grant_succeeded": stale_grant,
+            "last_error": probe_err,
+        }
+        result["stats_partitioned"] = _raft_node_stats(store, raft_addrs)
+
+        # -- heal: the deposed leader rejoins; its unreplicated lease
+        # intents must be truncated away, not replayed ----------------
+        wait = t0 + heal_frac * duration_s - time.monotonic()
+        if wait > 0:
+            time.sleep(wait)
+        for a in raft_addrs:
+            store.chaos(a, {"op": "partition", "map": None})
+        result["healed_at_s"] = round(time.monotonic() - t0, 2)
+        time.sleep(2.0)  # reconvergence: AppendEntries truncates
+
+        kills = []
+        if not pre:
+            # -- phase 2: SIGKILL the serve leader (keeps 2-of-3 raft
+            # quorum — crash tolerance on top of partition tolerance)
+            wait = t0 + kill_frac * duration_s - time.monotonic()
+            if wait > 0:
+                time.sleep(wait)
+            rollup = _federation.federation_stats(cspec)
+            victim_sid = rollup.get("leader") or "srv0"
+            victim = next((s for s in servers
+                           if s["id"] == victim_sid), servers[0])
+            if victim["proc"].poll() is None:
+                os.kill(victim["proc"].pid, _signal.SIGKILL)
+                kills.append({"id": victim["id"],
+                              "at_s": round(time.monotonic() - t0, 2)})
+        result["kills"] = kills
+
+        for th in threads:
+            th.join(timeout=max(5.0, deadline - time.monotonic() + 30.0))
+
+        completed = [o for o in outcomes if o["outcome"] == "ok"]
+        bad = [o for o in outcomes
+               if o["outcome"].startswith(("wrong_result", "error"))]
+        nwin = max(1, int(duration_s // window_s))
+        windows = [0] * nwin
+        for o in completed:
+            windows[min(nwin - 1, int(o["at_s"] // window_s))] += 1
+        stats_final = _raft_node_stats(store, raft_addrs)
+        truncated = sum(st.get("truncated_entries", 0)
+                        for st in stats_final)
+        dropped = sum(st.get("partition_dropped", 0)
+                      for st in stats_final
+                      + result["stats_partitioned"])
+        result.update({
+            "cycles": len(outcomes),
+            "completed_worlds": len(completed),
+            "worlds_per_s": round(len(completed) / duration_s, 2),
+            "windows_completed": windows,
+            "diagnosed": sorted({o["outcome"] for o in outcomes
+                                 if o["outcome"].startswith("diagnosed")}),
+            "unnamed_failures": bad[:50],
+            "stats_final": stats_final,
+            "truncated_entries": truncated,
+            "partition_frames_dropped": dropped,
+        })
+
+        if pre:
+            # honest baseline: with the fence off the minority server
+            # granted a lease its lapsed authority cannot back — and
+            # nothing anywhere said "no quorum"
+            result["ok"] = (stale_grant and not refused_named
+                            and not bad
+                            and all(w > 0 for w in windows))
+            return result
+
+        # post: refusal named, majority never stalled, stale intents
+        # discarded on heal, kill-after-heal still converges
+        expect_workers = nservers * pool
+        heal_deadline = time.monotonic() + 45.0
+        healed = False
+        rollup = {}
+        while time.monotonic() < heal_deadline:
+            rollup = _federation.federation_stats(cspec)
+            if rollup.get("workers") == expect_workers \
+                    and rollup.get("idle") == expect_workers:
+                healed = True
+                break
+            time.sleep(0.5)
+        final_ok = False
+        try:
+            with make_client() as client:
+                final_ok = client.run(_serve.job_allreduce, 128,
+                                      nranks=2, timeout=15.0) == 3.0
+        except Exception as e:  # noqa: BLE001 - recorded below
+            result["final_error"] = f"{type(e).__name__}: {str(e)[:200]}"
+        overlap_ok, overlap_err = True, None
+        try:
+            _federation.assert_no_leader_overlap(cspec)
+        except AssertionError as e:
+            overlap_ok, overlap_err = False, str(e)
+        result.update({
+            "healed_to_full_strength": healed,
+            "rollup": {k: rollup.get(k) for k in
+                       ("servers_live", "workers", "idle", "pools",
+                        "leader")},
+            "final_cross_server_allreduce_ok": final_ok,
+            "no_leader_overlap": overlap_ok,
+            "leader_overlap_error": overlap_err,
+            "ok": (refused_named and not bad and bool(kills)
+                   and truncated > 0 and healed and final_ok
+                   and overlap_ok
+                   and all(w > 0 for w in windows)),
+        })
+        return result
+    finally:
+        for s in servers:
+            if s["proc"].poll() is None:
+                s["proc"].kill()
+        for s in servers:
+            try:
+                s["proc"].wait(10.0)
+            except Exception:  # noqa: BLE001
+                pass
+            s["log"].close()
+        result["wall_s"] = round(time.time() - t_start, 1)
+        shutil.rmtree(logdir, ignore_errors=True)
+
+
 def run_federation_saturation(quick: bool = False) -> Dict:
     """The admission-control leg (ISSUE 15 acceptance): offered load
     beyond capacity against ONE server with a SMALL bounded admission
@@ -1109,14 +1413,30 @@ def main(argv=None) -> int:
                          "workers adopted by a survivor, and no "
                          "leader-authority overlap — plus the "
                          "beyond-capacity saturation/admission leg")
+    ap.add_argument("--partition", action="store_true",
+                    help="(with --federation) the replicated-store "
+                         "partition leg (ISSUE 18): a 3-server raft "
+                         "fabric (no shared dir) with a store-level "
+                         "partition isolating the raft leader — the "
+                         "minority server refuses leases with the "
+                         "named NoQuorumError, the majority keeps "
+                         "serving, heal discards the deposed leader's "
+                         "uncommitted intents, and a SIGKILL after "
+                         "heal still converges")
     ap.add_argument("--pre", action="store_true",
                     help="(with --federation) the honest baseline: ONE "
                          "non-federated server under the same load, "
-                         "killed mid-run — throughput dies to zero")
+                         "killed mid-run — throughput dies to zero "
+                         "(with --partition: the same fabric with the "
+                         "admission fence off — the minority grants "
+                         "stale leases)")
     ap.add_argument("--backend", choices=("socket", "shm"),
                     default="socket")
     args = ap.parse_args(argv)
-    if args.federation:
+    if args.federation and args.partition:
+        result = run_federation_partition(quick=args.quick,
+                                          pre=args.pre)
+    elif args.federation:
         result = run_federation_chaos(quick=args.quick, pre=args.pre)
         if not args.pre:
             result["saturation"] = run_federation_saturation(

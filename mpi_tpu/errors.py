@@ -35,7 +35,7 @@ __all__ = [
     "ProcFailedError", "RevokedError",
     "EpochSkewError", "RejoinRefusedError",
     "DeadlockError", "CollectiveMismatchError",
-    "ServerBusyError",
+    "ServerBusyError", "NoQuorumError", "BufferPinnedError",
     "error_class", "error_string",
 ]
 
@@ -162,6 +162,29 @@ class ServerBusyError(RuntimeError):
     admitted requests, never into silent multi-minute acquire tails."""
 
 
+class NoQuorumError(RuntimeError):
+    """The replicated namespace store (mpi_tpu/federation_store.py)
+    cannot commit: this node sits on the MINORITY side of a partition
+    (or the Raft group has lost its majority), so no write — lease
+    renew, ownership record, takeover assignment — can be
+    quorum-acknowledged.  A federation server raises this on acquire
+    instead of serving on stale namespace state (minority refuses,
+    majority serves); a :class:`~mpi_tpu.federation.FederatedClient`
+    treats it as a failover signal and moves to a majority-side
+    server.  Reads are not gated (local applied state is served
+    stale-but-honest); only mutations and authority claims are."""
+
+
+class BufferPinnedError(RuntimeError):
+    """Persistent-collective double-buffer fence (mpi_tpu/nbc.py, with
+    the runtime verifier on): ``start()`` of round k would overwrite
+    the working buffer that still backs round k-2's result, and the
+    caller STILL HOLDS a reference to that result (or a view of it) —
+    the silent-corruption half of the double-buffer contract.  Copy
+    the result (``np.array(r)``) before holding it across two later
+    ``start()``s."""
+
+
 class DeadlockError(RuntimeError):
     """The runtime verifier (mpi_tpu/verify) proved a wait-for
     cycle/knot: every rank in ``ranks`` is blocked, and none of their
@@ -281,6 +304,12 @@ def error_class(exc: Any) -> int:
         # error: the caller's request was well-formed and may succeed
         # on retry/failover — the generic class is the honest one
         return MPI_ERR_OTHER
+    if isinstance(exc, NoQuorumError):
+        # same shape as overload: transient fabric condition, the
+        # request may succeed on a majority-side server
+        return MPI_ERR_OTHER
+    if isinstance(exc, BufferPinnedError):
+        return MPI_ERR_BUFFER
     from .transport.base import RecvTimeout  # local import: no cycle at load
 
     if isinstance(exc, RecvTimeout):

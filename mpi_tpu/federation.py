@@ -4,33 +4,49 @@ ISSUE 15 tentpole — the last single point of failure in the stack.
 ``launcher serve`` (mpi_tpu/serve.py) survives any WORKER death, but the
 server process itself was one process fronting one warm pool: kill it
 and every client, lease, and worker orphans.  This module federates N
-servers over a shared **namespace directory** (the Ray-GCS /
-ZooKeeper-lease shape, rebuilt on the FileBoard lock idiom this repo
-already trusts — O_EXCL claim + mtime-renewed lease + stale takeover):
+servers over a shared **namespace** (the Ray-GCS / ZooKeeper-lease
+shape).  ISSUE 18 put the namespace behind the pluggable
+:class:`~mpi_tpu.federation_store.NamespaceStore` seam: every record
+below lives in a small versioned KV with ATOMIC compare-and-swap —
+backed by a directory (:class:`~mpi_tpu.federation_store.FileStore`,
+the PR-15 single-host/NFS mode, its takeover race now structurally
+closed) or by a replicated Raft-shaped quorum store
+(:class:`~mpi_tpu.federation_store.RaftStore`, N servers on N hosts
+with no shared filesystem).  A federation "namespace" argument is a
+SPEC: a directory path, or ``raft:<idx>@host:port,...`` (server
+member) / ``raft:host:port,...`` (client).
 
-* **Endpoint records** — every server renews ``server.<id>.json``
-  (pid, control addr, metrics addr, a light stats summary) each tick;
-  a record whose pid is dead or whose renewal is stale past the lease
-  bound IS a dead server.
+* **Endpoint records** — every server renews ``server.<id>`` (pid,
+  host, control addr, metrics addr, a light stats summary) each tick;
+  a record whose pid is dead (same-host only — pids don't travel) or
+  whose renewal is stale past the lease bound IS a dead server.
 * **Leader election** (:class:`LeaderLease`) — one ``leader.lease``
-  file, acquired with an atomic ``O_EXCL`` create and renewed by
-  ``os.utime`` ONLY (the content — holder id, pid, term — is immutable
-  per acquisition, so ownership is never ambiguous); a lease whose
-  mtime is stale past ``lease_timeout_s`` is taken over (read term →
-  unlink → O_EXCL create with term+1; two racing takeovers both unlink
-  — idempotent — and the create arbitrates).  The safety half: a
-  holder's AUTHORITY expires ``validity_s = lease_timeout_s/2`` after
-  its last successful renew, strictly before any takeover can fire, so
-  a leader frozen past the bound (SIGSTOP, the PR-10 rank-freeze story
-  at the server tier) has provably lapsed before its usurper begins —
-  and on thaw its next renew sees foreign content and DEMOTES.  Every
+  record, acquired and RENEWED by compare-and-swap (the content —
+  holder id, pid, term — is immutable per acquisition; a renewal
+  re-commits it, refreshing the record's write stamp).  A lease whose
+  stamp is stale past ``lease_timeout_s`` is taken over by CAS'ing
+  against its exact version — two racing takeovers (or a takeover
+  racing a frozen holder's thawed renewal) target the same version
+  and exactly ONE wins; the PR-15 re-stat→unlink window no longer
+  exists.  The safety half: a holder's AUTHORITY expires
+  ``validity_s = lease_timeout_s/2`` after its last successful renew,
+  strictly before any takeover can fire, so a leader frozen past the
+  bound (SIGSTOP, the PR-10 rank-freeze story at the server tier) has
+  provably lapsed before its usurper begins — and on thaw its next
+  renew loses the CAS and DEMOTES.  On the replicated store there is
+  a second lapse mode: a minority-side holder's renew raises
+  :class:`~mpi_tpu.errors.NoQuorumError` — it does NOT demote (it may
+  still be the rightful holder after heal) but it also cannot extend,
+  so its authority lapses within ``validity_s`` — the Chubby-bounded
+  degradation "minority refuses authority, majority serves".  Every
   acquire/renew appends a ``[from, until]`` authority interval to an
-  append-only per-server log; :func:`assert_no_leader_overlap` is the
-  split-brain assertion the tests run.
+  append-only per-server log (an extension that cannot be LOGGED is
+  not granted); :func:`assert_no_leader_overlap` is the split-brain
+  assertion the tests run.
 * **Pool takeover** — the leader watches the endpoint records; a dead
-  server's pools (``pool.<id>.json`` ownership records) are assigned
-  to the least-loaded survivor via a ``takeover.<dead>.json``
-  assignment.  The survivor adopts the pool (serve.py grows multi-pool
+  server's pools (``pool.<id>`` ownership records) are assigned to
+  the least-loaded survivor via a ``takeover.<dead>`` assignment.
+  The survivor adopts the pool (serve.py grows multi-pool
   bookkeeping), rewrites the ownership record, and the dead server's
   ORPHANED WORKERS — whose transports, arenas, and FT detectors are
   all still warm — re-register with it over the control channel
@@ -43,12 +59,12 @@ already trusts — O_EXCL claim + mtime-renewed lease + stale takeover):
   those connections is precisely what releases the workers to the
   usurper.
 * **Client failover** (:class:`FederatedClient`) — ``mpi_tpu.connect``
-  grows a server-list / namespace-dir mode: acquire and stats re-resolve
+  grows a server-list / namespace mode: acquire and stats re-resolve
   live endpoints and retry with backoff on a dead-server
-  ``ServerLostError`` (re-acquire is idempotent — a lease whose server
-  died, died with it); an in-flight ``lease.run`` surfaces the named
-  error instead of transparently re-running a possibly-side-effecting
-  job.
+  ``ServerLostError`` — or a minority-side server's ``NoQuorumError``
+  (re-acquire is idempotent — a lease whose server died, died with
+  it); an in-flight ``lease.run`` surfaces the named error instead of
+  transparently re-running a possibly-side-effecting job.
 * **Roll-up** (:func:`federation_stats`) — the per-server summaries in
   the endpoint records aggregate into one namespace-level document, so
   the PR-13 Prometheus endpoint stays truthful when pools move between
@@ -56,23 +72,28 @@ already trusts — O_EXCL claim + mtime-renewed lease + stale takeover):
 
 Chaos: ``python bench.py --chaos --federation [--quick]`` SIGKILLs
 servers under an open-loop fleet of concurrent clients and asserts
-aggregate worlds/s never reaches zero with every failure named
-(committed ``benchmarks/results/federation_{pre,post}.json``; pre =
-the single-server run dying to zero).
+aggregate worlds/s never reaches zero with every failure named;
+``--partition`` adds the replicated-store leg — an injected store
+partition must make the minority refuse (named ``NoQuorumError``)
+while the majority serves, and heal must rejoin it with its stale
+intents discarded (committed
+``benchmarks/results/federation_partition_{pre,post}.json``).
 """
 
 from __future__ import annotations
 
-import json
 import os
+import socket as _socket
 import threading
 import time
 import uuid
 from typing import Any, Dict, List, Optional
 
+from . import federation_store as _fstore
 from . import resilience as _resilience
 from . import telemetry as _telemetry
-from .membership import _pid_alive, _read_json, _write_json
+from .errors import NoQuorumError
+from .membership import _pid_alive
 from .transport.base import TransportError
 
 # One leadership/liveness knob: a leader lease (and a server endpoint
@@ -91,122 +112,149 @@ _VALIDITY_FRACTION = 0.5
 _SERVER_STALE_FACTOR = 1.5
 
 _TICK_S = 0.25          # federation member duty cadence
-_LEASE_FILE = "leader.lease"
+_LEASE_KEY = "leader.lease"
 _OWNER_POLL_S = 0.1     # orphaned-worker resolve cadence
 
 # Client-side liveness filter for endpoint records: liberal (a dial
 # failure skips a dead candidate anyway); the pid check does the fast
-# discrimination on this single-host fabric.
+# discrimination for same-host records.
 _CLIENT_RECORD_STALE_S = 10.0
 
+_HOSTNAME = _socket.gethostname()
 
-# -- namespace file helpers ---------------------------------------------------
-
-
-def _server_path(ns: str, sid: str) -> str:
-    return os.path.join(ns, f"server.{sid}.json")
-
-
-def _pool_path(ns: str, pool_id: str) -> str:
-    return os.path.join(ns, f"pool.{pool_id}.json")
+# store-read failures helpers swallow (a raft client store with every
+# node briefly unreachable raises OSError; a directory listing of a
+# torn-down namespace likewise) — reads degrade to "nothing visible",
+# mutations surface their errors to the caller
+_READ_ERRORS = (OSError, NoQuorumError)
 
 
-def _takeover_path(ns: str, sid: str) -> str:
-    return os.path.join(ns, f"takeover.{sid}.json")
+def _store(ns: Any) -> "_fstore.NamespaceStore":
+    """Namespace spec (dir path / raft: spec / store instance) → store
+    handle.  Cached per spec inside federation_store.resolve_store."""
+    return _fstore.resolve_store(ns)
 
 
-def _log_path(ns: str, sid: str) -> str:
-    return os.path.join(ns, f"leader.log.{sid}")
+def _ns_name(ns: Any) -> str:
+    return ns.describe() if isinstance(ns, _fstore.NamespaceStore) \
+        else str(ns)
 
 
-def read_server_records(ns: str) -> Dict[str, dict]:
-    """All ``server.<id>.json`` endpoint records in the namespace."""
+# -- namespace record helpers -------------------------------------------------
+
+
+def _server_key(sid: str) -> str:
+    return f"server.{sid}"
+
+
+def _pool_key(pool_id: str) -> str:
+    return f"pool.{pool_id}"
+
+
+def _takeover_key(sid: str) -> str:
+    return f"takeover.{sid}"
+
+
+def _log_key(sid: str) -> str:
+    return f"leader.log.{sid}"
+
+
+def read_server_records(ns: Any) -> Dict[str, dict]:
+    """All ``server.<id>`` endpoint records in the namespace."""
     out: Dict[str, dict] = {}
     try:
-        names = os.listdir(ns)
-    except OSError:
+        recs = _store(ns).scan("server.")
+    except _READ_ERRORS:
         return out
-    for name in names:
-        if name.startswith("server.") and name.endswith(".json"):
-            rec = _read_json(os.path.join(ns, name))
-            if rec and rec.get("id"):
-                out[rec["id"]] = rec
+    for rec in recs.values():
+        val = rec.value
+        if val and val.get("id"):
+            out[val["id"]] = val
     return out
 
 
-def read_server_record(ns: str, sid: str) -> Optional[dict]:
-    return _read_json(_server_path(ns, sid))
+def read_server_record(ns: Any, sid: str) -> Optional[dict]:
+    try:
+        rec = _store(ns).get(_server_key(sid))
+    except _READ_ERRORS:
+        return None
+    return None if rec is None else rec.value
 
 
-def read_leader(ns: str) -> Optional[dict]:
+def read_leader(ns: Any) -> Optional[dict]:
     """The current ``leader.lease`` content (holder id/pid/term), or
     None with no leader elected — a RELEASED lease (clean shutdown
-    left the file as a term tombstone) reads as no leader.  File
+    left the record as a term tombstone) reads as no leader.  Record
     ownership only — whether the holder's AUTHORITY is still valid is
     its own clock's business (LeaderLease.is_leader)."""
-    rec = _read_json(os.path.join(ns, _LEASE_FILE))
-    return None if rec is None or rec.get("released") else rec
+    try:
+        rec = _store(ns).get(_LEASE_KEY)
+    except _READ_ERRORS:
+        return None
+    if rec is None or rec.value is None or rec.value.get("released"):
+        return None
+    return rec.value
 
 
 def record_live(rec: dict, now: Optional[float] = None,
                 stale_s: float = _CLIENT_RECORD_STALE_S) -> bool:
     """Is this endpoint record's server alive?  Dead pid → dead NOW
-    (kill -9 detection is one stat); otherwise renewal staleness (the
-    frozen-server case: SIGSTOP keeps the pid but stops the renewals)."""
-    pid = rec.get("pid")
-    if pid is not None and not _pid_alive(int(pid)):
-        return False
+    (kill -9 detection is one stat) — but only for a record written on
+    THIS host; a pid from another host is meaningless here, so remote
+    records are judged by renewal staleness alone (the frozen-server
+    case: SIGSTOP keeps the pid but stops the renewals)."""
+    host = rec.get("host")
+    if host is None or host == _HOSTNAME:
+        pid = rec.get("pid")
+        if pid is not None and not _pid_alive(int(pid)):
+            return False
     now = time.time() if now is None else now
     return now - float(rec.get("renewed_at", 0)) <= stale_s
 
 
-def write_pool_owner(ns: str, pool_id: str, owner: str, ctrl: str,
+def write_pool_owner(ns: Any, pool_id: str, owner: str, ctrl: str,
                      rdv: str, backend: str, size: int, epoch: int,
                      term: int, since: Optional[float] = None) -> None:
     """Publish/replace the ownership record of one pool.  ``since`` is
     the wall time ownership began — an ex-owner relinquishes on seeing
     a record with a different owner and a ``since`` at or past its own
     (the thawed-usurped-server demotion path)."""
-    _write_json(_pool_path(ns, pool_id), {
+    _store(ns).put(_pool_key(pool_id), {
         "pool": pool_id, "owner": owner, "ctrl": ctrl, "rdv": rdv,
         "backend": backend, "size": int(size), "epoch": int(epoch),
         "term": int(term),
         "since": time.time() if since is None else float(since)})
 
 
-def read_pool_owner(ns: str, pool_id: str) -> Optional[dict]:
-    return _read_json(_pool_path(ns, pool_id))
+def read_pool_owner(ns: Any, pool_id: str) -> Optional[dict]:
+    try:
+        rec = _store(ns).get(_pool_key(pool_id))
+    except _READ_ERRORS:
+        return None
+    return None if rec is None else rec.value
 
 
-def read_pool_owners(ns: str) -> Dict[str, dict]:
+def read_pool_owners(ns: Any) -> Dict[str, dict]:
     out: Dict[str, dict] = {}
     try:
-        names = os.listdir(ns)
-    except OSError:
+        recs = _store(ns).scan("pool.")
+    except _READ_ERRORS:
         return out
-    for name in names:
-        if name.startswith("pool.") and name.endswith(".json"):
-            rec = _read_json(os.path.join(ns, name))
-            if rec and rec.get("pool"):
-                out[rec["pool"]] = rec
+    for rec in recs.values():
+        if rec.value and rec.value.get("pool"):
+            out[rec.value["pool"]] = rec.value
     return out
 
 
-def read_takeovers(ns: str) -> List[dict]:
-    out: List[dict] = []
+def read_takeovers(ns: Any) -> List[dict]:
     try:
-        names = os.listdir(ns)
-    except OSError:
-        return out
-    for name in names:
-        if name.startswith("takeover.") and name.endswith(".json"):
-            rec = _read_json(os.path.join(ns, name))
-            if rec:
-                out.append(rec)
-    return out
+        recs = _store(ns).scan("takeover.")
+    except _READ_ERRORS:
+        return []
+    return [rec.value for rec in recs.values() if rec.value]
 
 
-def wait_pool_owner(ns: str, pool_id: str, not_ctrl: Optional[str],
+def wait_pool_owner(ns: Any, pool_id: str, not_ctrl: Optional[str],
                     timeout: float,
                     stale_s: float = _CLIENT_RECORD_STALE_S
                     ) -> Optional[str]:
@@ -218,13 +266,20 @@ def wait_pool_owner(ns: str, pool_id: str, not_ctrl: Optional[str],
     runs out (→ None: the worker exits rather than leak).  Each
     death round passes its own just-dead address, so a chain of server
     deaths keeps resolving forward."""
+    st = _store(ns)
     deadline = time.monotonic() + timeout
     while True:
-        rec = read_pool_owner(ns, pool_id)
-        if rec is not None and rec.get("ctrl") and rec["ctrl"] != not_ctrl:
-            srv = read_server_record(ns, str(rec.get("owner")))
-            if srv is None or record_live(srv, stale_s=stale_s):
-                return rec["ctrl"]
+        try:
+            prec = st.get(_pool_key(pool_id))
+            rec = None if prec is None else prec.value
+            if rec is not None and rec.get("ctrl") \
+                    and rec["ctrl"] != not_ctrl:
+                srec = st.get(_server_key(str(rec.get("owner"))))
+                srv = None if srec is None else srec.value
+                if srv is None or record_live(srv, stale_s=stale_s):
+                    return rec["ctrl"]
+        except _READ_ERRORS:
+            pass  # store briefly unreachable: the budget is the bound
         if time.monotonic() > deadline:
             return None
         time.sleep(_OWNER_POLL_S)
@@ -234,70 +289,71 @@ def wait_pool_owner(ns: str, pool_id: str, not_ctrl: Optional[str],
 
 
 class LeaderLease:
-    """File-lease leader election on the namespace dir (the FileBoard
+    """Store-lease leader election (the FileBoard
     ``pending.summary.lock`` idiom, grown the two properties an
-    AUTHORITY needs that a compaction lock does not):
+    AUTHORITY needs that a compaction lock does not — and, since
+    ISSUE 18, rebuilt on the store CAS so both properties are
+    arbitration, not timing):
 
-    * **bounded authority** — holding the file is necessary but not
+    * **bounded authority** — holding the record is necessary but not
       sufficient; :meth:`is_leader` is true only within ``validity_s``
       of the last *successful* renew, and ``validity_s`` is strictly
       below the takeover bound, so a frozen holder's authority lapses
-      before a usurper's can begin;
-    * **immutable content per term** — the lease file is written only
-      by ``O_EXCL`` create; renewal is ``os.utime`` + an ownership
-      re-read on BOTH sides of it.  A thawed ex-holder's pending utime
-      can at worst extend a usurper's staleness clock (delaying the
-      next takeover — the conservative direction), never re-take the
-      file.  The residual race — a takeover's re-stat → unlink gap
-      straddled by a thawed holder's utime — is the same accepted
-      one-syscall window FileBoard._unlock documents.
+      before a usurper's can begin.  On the replicated store a
+      minority-side renew raises ``NoQuorumError``: the holder does
+      not demote (post-heal it may still rightfully hold) but cannot
+      extend either — authority lapses, the minority refuses.
+    * **immutable content per term** — the lease content (id, pid,
+      host, term) is fixed at acquisition; a renew re-commits the SAME
+      content by CAS against the exact version last observed, which
+      refreshes the record's write stamp (the staleness clock).  A
+      takeover CAS'es against a stale record's version with term+1.
+      Any interleaving of a thawed holder's renew and a takeover is a
+      single-winner CAS race — the PR-15 accepted window (takeover
+      re-stat → unlink straddled by a renew) is structurally gone.
 
     Every acquire and renew appends the authority interval
-    ``[from, until]`` to ``leader.log.<id>`` (append-only, one writer
-    per file — no contention); :func:`assert_no_leader_overlap` checks
-    the whole namespace's history for the split-brain condition."""
+    ``[from, until]`` to the ``leader.log.<id>`` append-only log (one
+    writer per log — no contention) BEFORE the validity extension
+    takes effect: an interval that cannot be logged is not granted.
+    :func:`assert_no_leader_overlap` checks the whole namespace's
+    history for the split-brain condition."""
 
-    def __init__(self, ns: str, owner_id: str,
+    def __init__(self, ns: Any, owner_id: str,
                  lease_timeout_s: float = _LEASE_TIMEOUT_S) -> None:
         self.ns = ns
+        self.store = _store(ns)
         self.owner_id = owner_id
         self.lease_timeout_s = float(lease_timeout_s)
         self.validity_s = _VALIDITY_FRACTION * self.lease_timeout_s
         self.term = 0
         self.takeovers = 0        # stale leases reclaimed by US
         self.demotions = 0        # times we discovered usurpation
+        self.quorum_stalls = 0    # renews refused by NoQuorumError
         self._held = False
         self._valid_until_mono = 0.0
-
-    def _path(self) -> str:
-        return os.path.join(self.ns, _LEASE_FILE)
-
-    def _content(self) -> dict:
-        return {"id": self.owner_id, "pid": os.getpid(),
-                "term": self.term, "acquired_at": time.time()}
+        self._content: dict = {}
 
     def is_leader(self) -> bool:
-        """Authority check — NOT just file ownership: false the moment
-        ``validity_s`` elapses since the last successful renew, which
-        is how a frozen leader knows, on thaw, that it must re-verify
-        before acting (and finds itself usurped)."""
+        """Authority check — NOT just record ownership: false the
+        moment ``validity_s`` elapses since the last successful renew,
+        which is how a frozen (or minority-partitioned) leader knows
+        it must re-verify before acting."""
         return self._held and time.monotonic() < self._valid_until_mono
 
-    def _mine(self, cur: Optional[dict]) -> bool:
-        return (cur is not None and not cur.get("released")
-                and cur.get("id") == self.owner_id
-                and cur.get("pid") == os.getpid()
-                and int(cur.get("term", -1)) == self.term)
+    def _mine(self, val: Optional[dict]) -> bool:
+        return (val is not None and not val.get("released")
+                and val.get("id") == self.owner_id
+                and val.get("pid") == os.getpid()
+                and val.get("host", _HOSTNAME) == _HOSTNAME
+                and int(val.get("term", -1)) == self.term)
 
     def _log_interval(self, now_wall: float) -> None:
-        try:
-            with open(_log_path(self.ns, self.owner_id), "a") as f:
-                f.write(json.dumps({
-                    "id": self.owner_id, "term": self.term,
-                    "from": now_wall,
-                    "until": now_wall + self.validity_s}) + "\n")
-        except OSError:
-            pass  # namespace tearing down
+        # raises on failure (quorum loss / namespace teardown): the
+        # caller treats an unlogged extension as no extension
+        self.store.append(_log_key(self.owner_id), {
+            "id": self.owner_id, "term": self.term,
+            "from": now_wall, "until": now_wall + self.validity_s})
 
     def tick(self) -> bool:
         """Acquire-or-renew; returns whether we hold valid authority
@@ -305,77 +361,95 @@ class LeaderLease:
         return self._renew() if self._held else self._try_acquire()
 
     def _try_acquire(self) -> bool:
-        path = self._path()
+        st = self.store
+        try:
+            cur = st.get(_LEASE_KEY)
+        except _READ_ERRORS:
+            return False
         next_term = self.term + 1
-        for attempt in (0, 1):
-            try:
-                fd = os.open(path, os.O_CREAT | os.O_EXCL | os.O_WRONLY,
-                             0o600)
-            except FileExistsError:
-                if attempt:
-                    return False  # lost the post-takeover create race
-                cur = _read_json(path)
-                if cur is not None:
-                    next_term = max(next_term, int(cur.get("term", 0)) + 1)
-                released = cur is not None and cur.get("released")
-                try:
-                    if not released:
-                        # a released lease is a term TOMBSTONE (clean
-                        # shutdown): immediately claimable, no stale
-                        # wait — and the term history survives it
-                        st = os.stat(path)
-                        if time.time() - st.st_mtime \
-                                < self.lease_timeout_s:
-                            return False  # live holder
-                        # re-stat right before the unlink: a holder
-                        # whose renew landed in our stat→unlink gap
-                        # keeps its lease (shrinks the accepted race
-                        # to one syscall)
-                        if os.stat(path).st_mtime != st.st_mtime:
-                            return False
-                    os.unlink(path)
-                except OSError:
-                    return False  # vanished/renewed: holder is live
-                if not released:
-                    self.takeovers += 1
-                continue
-            except OSError:
-                return False  # namespace tearing down
-            now_mono, now_wall = time.monotonic(), time.time()
-            self.term = next_term
-            try:
-                with os.fdopen(fd, "w") as f:
-                    json.dump(self._content(), f)
-            except OSError:
-                return False
-            self._held = True
-            # authority anchored BEFORE the write: conservative
-            self._valid_until_mono = now_mono + self.validity_s
+        expect = None
+        takeover = False
+        if cur is not None and cur.value is not None:
+            val = cur.value
+            next_term = max(next_term, int(val.get("term", 0)) + 1)
+            expect = cur.ver
+            if not val.get("released"):
+                # a released lease is a term TOMBSTONE (clean
+                # shutdown): immediately claimable — and the term
+                # history survives it.  A live one must be stale.
+                if time.time() - cur.stamp < self.lease_timeout_s:
+                    return False  # live holder
+                takeover = True
+        now_mono, now_wall = time.monotonic(), time.time()
+        content = {"id": self.owner_id, "pid": os.getpid(),
+                   "host": _HOSTNAME, "term": next_term,
+                   "acquired_at": now_wall}
+        try:
+            # THE arbitration: against the exact version we judged
+            # stale (or absence).  A renew that landed since — or a
+            # rival takeover — moved the version, and we lose cleanly.
+            rec = st.cas(_LEASE_KEY, expect, content)
+        except NoQuorumError:
+            self.quorum_stalls += 1
+            return False  # minority side: authority refused, by design
+        except OSError:
+            return False  # store unreachable / namespace teardown
+        if rec is None:
+            return False  # lost the CAS race
+        self.term = next_term
+        self._content = content
+        self._lease_ver = rec.ver
+        if takeover:
+            self.takeovers += 1
+        self._held = True
+        try:
             self._log_interval(now_wall)
-            rec = _telemetry.REC
-            if rec is not None:
-                rec.emit("serve", "leader_elected",
-                         attrs={"id": self.owner_id, "term": self.term,
-                                "takeover": self.takeovers > 0})
-            return True
-        return False  # pragma: no cover - loop always returns
+        except _READ_ERRORS:
+            # we hold the record but could not log the interval: grant
+            # ZERO validity (we never act on unlogged authority); the
+            # next tick renews and retries the log
+            self._valid_until_mono = 0.0
+            return False
+        # authority anchored BEFORE the write: conservative
+        self._valid_until_mono = now_mono + self.validity_s
+        rec_t = _telemetry.REC
+        if rec_t is not None:
+            rec_t.emit("serve", "leader_elected",
+                       attrs={"id": self.owner_id, "term": self.term,
+                              "takeover": self.takeovers > 0})
+        return True
 
     def _renew(self) -> bool:
-        path = self._path()
+        st = self.store
         now_mono, now_wall = time.monotonic(), time.time()
-        if not self._mine(_read_json(path)):
+        try:
+            cur = st.get(_LEASE_KEY)
+        except _READ_ERRORS:
+            return False  # cannot verify: no extension, let it lapse
+        if cur is None or not self._mine(cur.value):
             return self._demote("usurped")
         try:
-            os.utime(path)
+            rec = st.cas(_LEASE_KEY, cur.ver, self._content)
+        except NoQuorumError:
+            # minority side of a partition: we may STILL be the
+            # rightful holder (the majority has judged nothing yet) —
+            # do not demote, but do not extend: authority lapses
+            # within validity_s and this side refuses leadership
+            self.quorum_stalls += 1
+            return False
         except OSError:
-            return self._demote("lease file gone")
-        # re-read AFTER the utime: if we just touched a usurper's file
-        # we extended THEIR staleness clock (conservative — delays the
-        # next takeover, never creates a second holder) and demote
-        if not self._mine(_read_json(path)):
+            return False
+        if rec is None:
+            # single-winner CAS: a takeover landed between our read
+            # and our write — the structural replacement for the
+            # PR-15 re-stat window
             return self._demote("usurped")
+        self._lease_ver = rec.ver
+        try:
+            self._log_interval(now_wall)
+        except _READ_ERRORS:
+            return False  # unlogged extension = no extension
         self._valid_until_mono = now_mono + self.validity_s
-        self._log_interval(now_wall)
         return True
 
     def _demote(self, why: str) -> bool:
@@ -392,27 +466,27 @@ class LeaderLease:
     def release(self) -> None:
         """Clean handoff at shutdown: mark the lease RELEASED (a term
         tombstone the next acquirer claims immediately and bumps past —
-        unlinking would lose the term history) and log the reign's end,
+        deleting would lose the term history) and log the reign's end,
         capping our authority interval at NOW rather than letting the
         last renew's ``until`` imply authority we gave up."""
         held, self._held = self._held, False
         self._valid_until_mono = 0.0
         if not held:
             return
-        path = self._path()
         now_wall = time.time()
         try:
-            if self._mine(_read_json(path)):
-                _write_json(path, {**self._content(), "released": True})
-                with open(_log_path(self.ns, self.owner_id), "a") as f:
-                    f.write(json.dumps({
-                        "id": self.owner_id, "term": self.term,
-                        "release": True, "until": now_wall}) + "\n")
-        except OSError:
+            cur = self.store.get(_LEASE_KEY)
+            if cur is not None and self._mine(cur.value):
+                self.store.cas(_LEASE_KEY, cur.ver,
+                               {**self._content, "released": True})
+            self.store.append(_log_key(self.owner_id), {
+                "id": self.owner_id, "term": self.term,
+                "release": True, "until": now_wall})
+        except _READ_ERRORS:
             pass
 
 
-def assert_no_leader_overlap(ns: str) -> List[dict]:
+def assert_no_leader_overlap(ns: Any) -> List[dict]:
     """THE split-brain assertion: parse every server's authority-
     interval log and verify no two DIFFERENT servers' intervals
     overlap.  Returns the parsed intervals (sorted) for diagnostics;
@@ -422,20 +496,11 @@ def assert_no_leader_overlap(ns: str) -> List[dict]:
     both have acted as leader at one instant."""
     raw: List[dict] = []
     try:
-        names = os.listdir(ns)
-    except OSError:
-        names = []
-    for name in names:
-        if not name.startswith("leader.log."):
-            continue
-        try:
-            with open(os.path.join(ns, name)) as f:
-                for line in f:
-                    line = line.strip()
-                    if line:
-                        raw.append(json.loads(line))
-        except (OSError, ValueError):
-            continue
+        logs = _store(ns).log_scan("leader.log.")
+    except _READ_ERRORS:
+        logs = {}
+    for entries in logs.values():
+        raw.extend(entries)
     # a release record caps its (id, term) reign at the release instant
     # — authority voluntarily given up must not read as held through
     # the last renew's validity window
@@ -486,24 +551,37 @@ class FederationMember:
     servers' pools to survivors and garbage-collect their records.
     A tick that raises logs a structured line and keeps ticking (the
     serve monitor-loop rule: the fabric's lifeline must not die of one
-    exception)."""
+    exception).  On a ``raft:<idx>@...`` namespace spec the member
+    STARTS its embedded store node; a tick on the minority side of a
+    partition (store unhealthy) skips every mutation — the lease
+    lapses, the admission fence in serve.py refuses clients, and the
+    majority side carries the fabric."""
 
-    def __init__(self, server, ns: str, server_id: Optional[str] = None,
+    def __init__(self, server, ns: Any,
+                 server_id: Optional[str] = None,
                  lease_timeout_s: float = _LEASE_TIMEOUT_S,
                  tick_s: float = _TICK_S) -> None:
-        os.makedirs(ns, exist_ok=True)
         self.server = server
         self.ns = ns
+        self.store, self._owns_store = _fstore.resolve_member_store(ns)
         self.server_id = server_id or ("srv-" + uuid.uuid4().hex[:8])
-        self.lease = LeaderLease(ns, self.server_id, lease_timeout_s)
+        self.lease = LeaderLease(self.store, self.server_id,
+                                 lease_timeout_s)
         self.tick_s = float(tick_s)
         self.server_stale_s = _SERVER_STALE_FACTOR * float(lease_timeout_s)
         self.started_at = time.time()
+        self.unhealthy_ticks = 0
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
 
     def is_leader(self) -> bool:
         return self.lease.is_leader()
+
+    def healthy(self) -> bool:
+        """Can this member's store commit (quorum reachability)?  The
+        serve.py admission fence consults this: a minority-side server
+        refuses new leases with the named ``NoQuorumError``."""
+        return self.store.healthy()
 
     def start(self) -> "FederationMember":
         self._tick_safe()  # register synchronously: visible on return
@@ -519,17 +597,16 @@ class FederationMember:
         # clean departure: release the lease, retract our records (the
         # pools die with an orderly stop() — serve shuts the workers
         # down — so their ownership records retract too)
-        self.lease.release()
-        for pool_id, rec in read_pool_owners(self.ns).items():
-            if rec.get("owner") == self.server_id:
-                try:
-                    os.unlink(_pool_path(self.ns, pool_id))
-                except OSError:
-                    pass
         try:
-            os.unlink(_server_path(self.ns, self.server_id))
-        except OSError:
-            pass
+            self.lease.release()
+            for pool_id, rec in read_pool_owners(self.store).items():
+                if rec.get("owner") == self.server_id:
+                    self.store.delete(_pool_key(pool_id))
+            self.store.delete(_server_key(self.server_id))
+        except _READ_ERRORS:
+            pass  # partitioned/torn-down at exit: records go stale
+        if self._owns_store:
+            self.store.close()
 
     def _loop(self) -> None:
         while not self._stop.wait(self.tick_s):
@@ -553,14 +630,27 @@ class FederationMember:
 
     def _tick(self) -> None:
         now = time.time()
+        if not self.store.healthy():
+            # minority side: every mutation below would burn a propose
+            # timeout and fail with NoQuorumError anyway.  Skip the
+            # tick wholesale — the lease lapses by not renewing
+            # (is_leader() goes false within validity_s), the stale
+            # endpoint record steers clients at the majority, and the
+            # admission fence names the refusal.
+            self.unhealthy_ticks += 1
+            rec = _telemetry.REC
+            if rec is not None:
+                rec.emit("serve", "fed_tick_no_quorum",
+                         attrs={"id": self.server_id})
+            return
         self._write_server_record(now)
         leading = self.lease.tick()
         # ONE pool-record snapshot per tick, shared by every duty
-        # (each used to rescan the namespace itself — 3-4 directory
-        # walks per 250ms tick per server, multiplied across the
-        # fabric); staleness within a tick is harmless, every consumer
+        # (each used to rescan the namespace itself — 3-4 scans per
+        # 250ms tick per server, multiplied across the fabric);
+        # staleness within a tick is harmless, every consumer
         # re-checks live server state before acting
-        owners = read_pool_owners(self.ns)
+        owners = read_pool_owners(self.store)
         self._verify_pool_ownership(owners)
         self._reclaim_ghost_pools(owners)
         self._consume_assignments()
@@ -568,8 +658,9 @@ class FederationMember:
             self._leader_duties(now, owners)
 
     def _write_server_record(self, now: float) -> None:
-        _write_json(_server_path(self.ns, self.server_id), {
+        self.store.put(_server_key(self.server_id), {
             "id": self.server_id, "pid": os.getpid(),
+            "host": _HOSTNAME,
             "ctrl": self.server.addr,
             "metrics": getattr(self.server, "metrics_addr", None),
             "started_at": self.started_at, "renewed_at": now,
@@ -586,7 +677,7 @@ class FederationMember:
             rec = owners.get(pool_id)
             if rec is None:
                 write_pool_owner(
-                    self.ns, pool_id, owner=self.server_id,
+                    self.store, pool_id, owner=self.server_id,
                     ctrl=self.server.addr, rdv=meta["rdv"],
                     backend=meta["backend"], size=meta["size"],
                     epoch=meta["epoch"], term=self.lease.term,
@@ -599,11 +690,11 @@ class FederationMember:
         """A pool record naming US that we do not actually serve is a
         ghost of our PREVIOUS incarnation (a restart under a stable
         ``--server-id``): the record reads live to the leader (our new
-        pid renews ``server.<id>.json``), so no takeover will ever
-        fire for it — reclaim it ourselves.  The old incarnation's
-        warm orphans are excluding its DEAD control address in their
-        re-resolve; rewriting the record with our new address is what
-        brings them home."""
+        pid renews ``server.<id>``), so no takeover will ever fire for
+        it — reclaim it ourselves.  The old incarnation's warm orphans
+        are excluding its DEAD control address in their re-resolve;
+        rewriting the record with our new address is what brings them
+        home."""
         owned = self.server.owned_pool_records()
         for pool_id, rec in owners.items():
             if rec.get("owner") != self.server_id or pool_id in owned:
@@ -611,7 +702,7 @@ class FederationMember:
             if self.server.adopt_pool(pool_id, rec,
                                       term=self.lease.term):
                 write_pool_owner(
-                    self.ns, pool_id, owner=self.server_id,
+                    self.store, pool_id, owner=self.server_id,
                     ctrl=self.server.addr, rdv=rec["rdv"],
                     backend=rec.get("backend", "socket"),
                     size=int(rec["size"]),
@@ -619,11 +710,11 @@ class FederationMember:
                     term=self.lease.term)
 
     def _consume_assignments(self) -> None:
-        for t in read_takeovers(self.ns):
+        for t in read_takeovers(self.store):
             if t.get("to") != self.server_id:
                 continue
             for pool_id, prec in (t.get("pools") or {}).items():
-                cur = read_pool_owner(self.ns, pool_id)
+                cur = read_pool_owner(self.store, pool_id)
                 if cur is not None and cur.get("owner") not in (
                         t.get("dead"), self.server_id):
                     continue  # moved again since: stale assignment
@@ -632,7 +723,7 @@ class FederationMember:
                 if self.server.adopt_pool(pool_id, prec,
                                           term=int(t.get("term", 0))):
                     write_pool_owner(
-                        self.ns, pool_id, owner=self.server_id,
+                        self.store, pool_id, owner=self.server_id,
                         ctrl=self.server.addr, rdv=prec["rdv"],
                         backend=prec.get("backend", "socket"),
                         size=int(prec["size"]),
@@ -641,7 +732,7 @@ class FederationMember:
 
     def _leader_duties(self, now: float,
                        owners: Dict[str, dict]) -> None:
-        records = read_server_records(self.ns)
+        records = read_server_records(self.store)
         live = {sid for sid, r in records.items()
                 if sid == self.server_id
                 or record_live(r, now, self.server_stale_s)}
@@ -651,13 +742,16 @@ class FederationMember:
             dead_pools = {pid: rec for pid, rec in owners.items()
                           if rec.get("owner") == sid}
             if dead_pools:
-                existing = _read_json(_takeover_path(self.ns, sid))
+                existing = None
+                trec = self.store.get(_takeover_key(sid))
+                if trec is not None:
+                    existing = trec.value
                 if existing is None or existing.get("to") not in live:
                     target = self._choose_survivor(live, owners)
                     if target is not None and self.lease.is_leader():
                         # assignments carry the term they were decided
                         # under — written ONLY with valid authority
-                        _write_json(_takeover_path(self.ns, sid), {
+                        self.store.put(_takeover_key(sid), {
                             "dead": sid, "to": target,
                             "term": self.lease.term, "at": now,
                             "pools": dead_pools})
@@ -669,12 +763,8 @@ class FederationMember:
                                               sorted(dead_pools)})
             else:
                 # fully relieved (or never owned a pool): GC the corpse
-                for path in (_server_path(self.ns, sid),
-                             _takeover_path(self.ns, sid)):
-                    try:
-                        os.unlink(path)
-                    except OSError:
-                        pass
+                self.store.delete(_server_key(sid))
+                self.store.delete(_takeover_key(sid))
 
     def _choose_survivor(self, live: set,
                          owners: Dict[str, dict]) -> Optional[str]:
@@ -692,12 +782,13 @@ class FederationMember:
 # -- namespace roll-up --------------------------------------------------------
 
 
-def federation_stats(ns: str) -> dict:
+def federation_stats(ns: Any) -> dict:
     """Aggregate the namespace: one document summing the live servers'
     summaries (worlds/s, workers, idle, pools, waiting) plus the
     current leader — what keeps the PR-13 Prometheus endpoint truthful
-    when pools move between servers.  Pure file reads: scrape-safe,
-    callable with zero servers reachable."""
+    when pools move between servers.  Pure store reads: scrape-safe,
+    callable with zero servers reachable (and on the MINORITY side of
+    a store partition, where it reports the last applied state)."""
     now = time.time()
     records = read_server_records(ns)
     lease = read_leader(ns)
@@ -716,7 +807,7 @@ def federation_stats(ns: str) -> dict:
             for k in totals:
                 totals[k] = totals[k] + summary.get(k, 0)
     totals["worlds_per_s"] = round(totals["worlds_per_s"], 3)
-    return {"namespace": ns, "servers_total": len(records),
+    return {"namespace": _ns_name(ns), "servers_total": len(records),
             "servers_live": live,
             "leader": lease.get("id") if lease else None,
             "leader_term": int(lease.get("term", 0)) if lease else 0,
@@ -728,9 +819,10 @@ def federation_stats(ns: str) -> dict:
 
 class FederatedClient:
     """Client handle to a FEDERATION of world servers: resolve live
-    endpoints from a namespace dir (and/or a static address list), and
-    fail acquire/stats over to a survivor on a dead-server
-    ``ServerLostError`` with backoff, bounded by the
+    endpoints from a namespace (dir or ``raft:`` spec, and/or a static
+    address list), and fail acquire/stats over to a survivor on a
+    dead-server ``ServerLostError`` — or a partitioned minority
+    server's ``NoQuorumError`` — with backoff, bounded by the
     ``connect_retry_timeout_s`` budget.  Lease semantics are the
     single-server ones: re-acquire after a failover is idempotent (the
     lost lease died with its server), and an in-flight ``lease.run``
@@ -741,7 +833,7 @@ class FederatedClient:
                  timeout: float = 30.0, priority: int = 0,
                  failover_timeout_s: Optional[float] = None) -> None:
         if not namespace and not addrs:
-            raise ValueError("FederatedClient needs a namespace dir "
+            raise ValueError("FederatedClient needs a namespace "
                              "and/or a server address list")
         self._ns = namespace
         self._static = ["%s:%s" % tuple(a) if isinstance(a, (tuple, list))
@@ -775,7 +867,9 @@ class FederatedClient:
             # survivor instead of the silent not-yet-stale ex-leader
             # (id order was the tiebreak that dialed the frozen one
             # first every time).  Ties (all healthy) stay deterministic
-            # via the id in the sort key.
+            # via the id in the sort key.  The same ordering is the
+            # partition play: minority-side servers stop renewing
+            # their records, so clients drain toward the majority.
             recs = sorted(read_server_records(self._ns).items(),
                           key=lambda kv: (-float(
                               kv[1].get("renewed_at", 0)), kv[0]))
@@ -843,13 +937,16 @@ class FederatedClient:
             client = self._ensure()
             try:
                 return op(client)
-            except (ServerLostError, OSError) as e:
+            except (ServerLostError, NoQuorumError, OSError) as e:
                 if isinstance(e, TimeoutError) \
                         and not isinstance(e, ServerLostError):
                     # a LEASE timeout (TimeoutError is an OSError
                     # subclass!) is the live server's named verdict,
                     # not a dead server — never a failover signal
                     raise
+                # NoQuorumError IS a failover signal: the server is
+                # alive but on the minority side of a store partition —
+                # refusing by design; a majority-side server can serve
                 self._drop()
                 self.failovers += 1
                 if time.monotonic() > deadline:

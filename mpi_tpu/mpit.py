@@ -21,6 +21,7 @@ reset, so reads are session-relative like the standard requires.
 
 from __future__ import annotations
 
+import sys
 import threading
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
@@ -54,7 +55,9 @@ class _Counters:
                  "link_syscalls", "link_torn",
                  "nbc_threads", "nbc_sms", "persist_starts",
                  "trace_events",
-                 "rp_hits", "rp_misses", "rp_rdv", "rp_steered")
+                 "rp_hits", "rp_misses", "rp_rdv", "rp_steered",
+                 "rp_fold",
+                 "store_elections", "store_truncated", "store_dropped")
 
     def __init__(self) -> None:
         self.sends = 0
@@ -105,6 +108,10 @@ class _Counters:
         self.rp_misses = 0
         self.rp_rdv = 0
         self.rp_steered = 0
+        self.rp_fold = 0
+        self.store_elections = 0
+        self.store_truncated = 0
+        self.store_dropped = 0
 
 
 counters = _Counters()  # incremented by communicator.py / codec.py (count())
@@ -143,7 +150,11 @@ def count(sends: int = 0, send_bytes: int = 0, recvs: int = 0,
           recv_pool_hits: int = 0,
           recv_pool_misses: int = 0,
           recv_pool_rendezvous: int = 0,
-          recv_bytes_steered: int = 0) -> None:
+          recv_bytes_steered: int = 0,
+          recv_pool_fold_fallbacks: int = 0,
+          store_elections: int = 0,
+          store_entries_truncated: int = 0,
+          store_partition_dropped: int = 0) -> None:
     """Thread-safe increment (rank-threads of the local backend share
     this process's counters; unsynchronized += would lose updates)."""
     with _lock:
@@ -195,6 +206,10 @@ def count(sends: int = 0, send_bytes: int = 0, recvs: int = 0,
         counters.rp_misses += recv_pool_misses
         counters.rp_rdv += recv_pool_rendezvous
         counters.rp_steered += recv_bytes_steered
+        counters.rp_fold += recv_pool_fold_fallbacks
+        counters.store_elections += store_elections
+        counters.store_truncated += store_entries_truncated
+        counters.store_dropped += store_partition_dropped
 
 _PVARS: Dict[str, Callable[[], int]] = {
     "msgs_sent": lambda: counters.sends,
@@ -344,7 +359,34 @@ _PVARS: Dict[str, Callable[[], int]] = {
     "recv_pool_misses": lambda: counters.rp_misses,
     "recv_pool_rendezvous": lambda: counters.rp_rdv,
     "recv_bytes_steered": lambda: counters.rp_steered,
+    # rendezvous steering races LOST (ISSUE 18 satellite, the ISSUE 17
+    # residual (c)): frames whose exact-match channel had no posted
+    # entry yet (reader beat the poster) or whose posted destination
+    # was steering-ineligible, so the body folded through the pool
+    # instead of a direct store.  A visibility counter only — the
+    # deterministic payload_copies assertions are NOT derived from it.
+    "recv_pool_fold_fallbacks": lambda: counters.rp_fold,
+    # replicated namespace store (mpi_tpu/federation_store.py, ISSUE
+    # 18): store-leader elections STARTED by a node in this process,
+    # uncommitted log entries truncated away by a new leader's
+    # conflict check (the minority's stale intents being discarded at
+    # heal), and node-to-node frames dropped by an installed partition
+    # map (proof the injection actually fired).  All exactly 0 in
+    # file-store / non-federated runs.
+    "store_elections": lambda: counters.store_elections,
+    "store_entries_truncated": lambda: counters.store_truncated,
+    "store_partition_dropped": lambda: counters.store_dropped,
+    # gauges, not counters: current max term / commit index over this
+    # process's live store nodes (0 with none).  Lazy sys.modules
+    # lookup — reading a pvar must not import the federation tier.
+    "store_term": lambda: _store_gauge("term"),
+    "store_commit_index": lambda: _store_gauge("commit_index"),
 }
+
+
+def _store_gauge(field: str) -> int:
+    mod = sys.modules.get("mpi_tpu.federation_store")
+    return 0 if mod is None else int(mod.store_gauge(field))
 
 
 def pvar_list() -> List[str]:

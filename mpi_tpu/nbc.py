@@ -53,10 +53,12 @@ back to one thread per round on the same hoisted context.
 
 from __future__ import annotations
 
+import gc
 import os
 import queue
 import threading
 import time
+import weakref
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import numpy as np
@@ -72,7 +74,7 @@ from .communicator import (P2PCommunicator, Request, _CompletedRequest,
                            _FT_POLL_S, _SEG_WINDOW, _TAG_COLL, _as_array,
                            _maybe_stack, _unpost, _unwrap,
                            seed_allreduce_algorithm)
-from .errors import ProcFailedError
+from .errors import BufferPinnedError, ProcFailedError
 from .transport.base import ANY_SOURCE, RecvTimeout, payload_nbytes
 
 __all__ = ["try_state_machine", "persistent_init", "PersistentColl"]
@@ -806,7 +808,12 @@ class PersistentColl(Request):
     working buffers alternated per start (no per-round allocation);
     round k's result is a view of one of them and stays valid until
     round k+2 starts — hold a result across two later starts and you
-    must copy it, the usual double-buffer contract.
+    must copy it (``np.array(r)``), the usual double-buffer contract.
+    With the runtime verifier on the contract is FENCED (ISSUE 18
+    satellite, the PR-12/17 residual): a ``start()`` that would
+    overwrite a round result the caller still references raises the
+    named :class:`~mpi_tpu.errors.BufferPinnedError` instead of
+    silently invalidating it.
     """
 
     def __init__(self, parent: P2PCommunicator, kind: str, args: tuple,
@@ -823,6 +830,9 @@ class PersistentColl(Request):
         # working buffers alternated across starts — see _round_build
         self._dbl: Optional[Tuple[np.ndarray, np.ndarray]] = None
         self._round = 0
+        # verify-mode fence state: weakref per working buffer to the
+        # round result handed out from it (see _fence_check)
+        self._pinned: List[Optional["weakref.ref"]] = [None, None]
         # resolve + compile once, from the bound buffer's geometry; a
         # None build means every round runs the blocking method on a
         # thread (same hoisted context)
@@ -920,7 +930,10 @@ class PersistentColl(Request):
             if self._dbl is None:
                 w = self._build0["work"]
                 self._dbl = (np.empty_like(w), np.empty_like(w))
-            buf = self._dbl[self._round & 1]
+            i = self._round & 1
+            if self._parent._verify is not None:
+                self._fence_check(i)
+            buf = self._dbl[i]
             self._round += 1
             _bufpool.touch(buf)
             np.copyto(buf, np.asarray(self._args[0]).reshape(-1))
@@ -934,23 +947,84 @@ class PersistentColl(Request):
         return _build(self._parent, "i" + self._kind, self._args,
                       self._kwargs)
 
+    def _note_result(self, value: Any) -> None:
+        """Verify-mode bookkeeping: remember (weakly) which working
+        buffer this round's result aliases, so _fence_check can tell
+        whether the caller is still holding it when the buffer comes
+        back around."""
+        if (self._parent._verify is None or self._dbl is None
+                or not isinstance(value, np.ndarray)):
+            return
+        try:
+            for i in (0, 1):
+                # a value that IS the buffer (not a view of it) can't be
+                # distinguished from our own strong ref — skip it
+                if value is not self._dbl[i] and np.shares_memory(
+                        value, self._dbl[i]):
+                    self._pinned[i] = weakref.ref(value)
+                    return
+        except TypeError:
+            pass
+
+    def _fence_check(self, i: int) -> None:
+        """The double-buffer contract, fenced (PR-12/17 residual): a
+        round result stays valid for exactly one further start().  If
+        the caller still references the result that round i's buffer
+        backs when start() wants to overwrite it, raise the named error
+        instead of silently invalidating their array.  self._last is
+        exempt: the handle's own reference is not a caller pin."""
+        ref = self._pinned[i]
+        if ref is None:
+            return
+        obj = ref()
+        if obj is not None and obj is not self._last:
+            # a dropped reference may merely await collection — give the
+            # collector one shot before declaring a contract violation
+            obj = None
+            gc.collect()
+            obj = ref()
+        if obj is None or obj is self._last:
+            self._pinned[i] = None
+            return
+        raise BufferPinnedError(
+            f"persistent {self._kind}: start() would overwrite the "
+            f"round-{self._round - 2 if self._round >= 2 else 0} result "
+            f"the caller still references (double-buffer grace is one "
+            f"round); copy it first (np.array(result))")
+
     def wait(self) -> Any:
         if self._req is None:
             if not self._started:
                 raise RuntimeError(
                     "wait() before start() on a persistent collective")
             return self._last
-        value = self._req.wait()
+        req = self._req
+        value = req.wait()
         self._last, self._req = value, None
+        self._drop_result_retention(req)
+        self._note_result(value)
         return value
 
     def test(self) -> Tuple[bool, Any]:
         if self._req is None:
             return (True, self._last) if self._started else (False, None)
-        done, value = self._req.test()
+        req = self._req
+        done, value = req.test()
         if done:
             self._last, self._req = value, None
+            self._drop_result_retention(req)
+            self._note_result(value)
         return done, value
+
+    @staticmethod
+    def _drop_result_retention(req: Request) -> None:
+        """A finished _SMColl can outlive the round (a fold-pool
+        worker's frame keeps the last item it processed alive until the
+        next one arrives), and its _result slot would then count as a
+        pin in _fence_check.  This handle is the request's only
+        consumer, so forget the result once it's been handed over."""
+        if isinstance(req, _SMColl):
+            req._result = None
 
 
 # positional-argument names of each persistent kind, mirroring the

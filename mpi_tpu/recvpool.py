@@ -81,6 +81,7 @@ from typing import Any, Dict, Optional, Tuple
 import numpy as np
 
 from . import mpit as _mpit
+from . import telemetry as _telemetry
 
 
 def _env_flag(name: str, default: int) -> int:
@@ -178,13 +179,17 @@ class RecvPool:
 
 
 class _Entry:
-    __slots__ = ("idx", "dest", "ds", "shape")
+    __slots__ = ("idx", "dest", "ds", "shape", "declined")
 
     def __init__(self, idx: int) -> None:
         self.idx = idx
         self.dest: Optional[np.ndarray] = None
         self.ds: Optional[str] = None
         self.shape: Tuple[int, ...] = ()
+        # the poster looked at its destination and it was NOT steering
+        # eligible (non-contiguous / read-only): a later dest-less
+        # match is a decision, not a lost race — don't count it
+        self.declined = False
 
 
 class _Channel:
@@ -236,9 +241,11 @@ class PostedRecvRegistry:
         """Give a posted irecv's entry a destination view the reader may
         steer into.  Only store-destination views qualify (contiguous,
         writable, filled by a plain assignment at the fold site)."""
-        if not (dest.flags.writeable and dest.flags.c_contiguous):
-            return
         _key, e = token
+        if not (dest.flags.writeable and dest.flags.c_contiguous):
+            with self._lock:
+                e.declined = True
+            return
         with self._lock:
             e.dest = dest
             e.ds = dest.dtype.str
@@ -267,25 +274,59 @@ class PostedRecvRegistry:
         into when the paired consumer has one of matching geometry,
         else None (pool path).  ``plan`` is the codec's parsed meta
         (``("arr", dtype_str, shape)`` for the steerable single-array
-        frames, anything else for the rest)."""
-        with self._lock:
-            ch = self._chan(src, ctx, tag)
-            if (gen, seq) <= ch.wm:
-                return None   # replay re-presentation: already counted
-            ch.wm = (gen, seq)
-            ch.arrived += 1
-            j = ch.arrived
-            q = ch.entries
-            while q and q[0].idx < j:
-                q.popleft()   # stale: their frames already passed
-            if not q or q[0].idx != j:
-                return None
-            e = q.popleft()
-            if (e.dest is None or not _STEERING or plan is None
-                    or plan[0] != "arr" or e.ds != plan[1]
-                    or e.shape != tuple(plan[2])):
-                return None
-            return e.dest
+        frames, anything else for the rest).
+
+        A steerable frame that found NO destination because it lost
+        the reader-vs-poster race (the frame outran the post, or the
+        post outran its ``attach``) folds through the pool and is
+        counted in the ``recv_pool_fold_fallbacks`` pvar (+ a trace
+        instant) — ISSUE 18 satellite, the ISSUE 17 residual (c).
+        Visibility only: nothing about the fold path itself changes,
+        and the deterministic ``payload_copies`` accounting is
+        untouched."""
+        fold_race = False
+        try:
+            with self._lock:
+                ch = self._chan(src, ctx, tag)
+                if (gen, seq) <= ch.wm:
+                    return None   # replay re-presentation: already counted
+                ch.wm = (gen, seq)
+                ch.arrived += 1
+                j = ch.arrived
+                q = ch.entries
+                while q and q[0].idx < j:
+                    q.popleft()   # stale: their frames already passed
+                steerable = (_STEERING and plan is not None
+                             and plan[0] == "arr")
+                if not q or q[0].idx != j:
+                    # no entry for this arrival: a genuine lost race
+                    # only when NO consumer was counted yet (posted <
+                    # j — the reader beat the poster); an entry-less
+                    # match with posted >= j is a blocking recv, which
+                    # never steers by design
+                    fold_race = steerable and ch.posted < j
+                    return None
+                e = q.popleft()
+                if (e.dest is None or not _STEERING or plan is None
+                        or plan[0] != "arr" or e.ds != plan[1]
+                        or e.shape != tuple(plan[2])):
+                    # dest-less entry: the irecv was POSTED but its
+                    # attach() hadn't landed when the frame arrived —
+                    # the other flavor of the same race (unless the
+                    # poster explicitly declined an ineligible dest,
+                    # which is a decision, not a race)
+                    fold_race = (steerable and e.dest is None
+                                 and not e.declined)
+                    return None
+                return e.dest
+        finally:
+            if fold_race:
+                # outside the lock: pvar + trace instant
+                _mpit.count(recv_pool_fold_fallbacks=1)
+                rec = _telemetry.REC
+                if rec is not None:
+                    rec.emit("recvpool", "fold_fallback",
+                             attrs={"src": src, "tag": tag})
 
     def note_local(self, src, ctx, tag) -> None:
         """Count a self-send delivery (value-copy path, never steered) so

@@ -53,9 +53,9 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 from . import membership
 from . import mpit as _mpit
 from . import telemetry as _telemetry
-from .errors import (DeadlockError, EpochSkewError, ProcFailedError,
-                     RejoinRefusedError, RevokedError, ServerBusyError,
-                     error_class)
+from .errors import (DeadlockError, EpochSkewError, NoQuorumError,
+                     ProcFailedError, RejoinRefusedError, RevokedError,
+                     ServerBusyError, error_class)
 from .transport.base import RecvTimeout, TransportError
 from .transport.socket import _recv_exact
 
@@ -154,6 +154,7 @@ _ERROR_KINDS = {
     "TransportError": TransportError,
     "ServerBusyError": ServerBusyError,
     "ServerLostError": ServerLostError,
+    "NoQuorumError": NoQuorumError,
 }
 
 
@@ -698,6 +699,7 @@ class WorldServer:
                                "jobs_ok": 0, "jobs_failed": 0,
                                "heals_completed": 0, "workers_lost": 0,
                                "busy_rejected": 0,
+                               "no_quorum_rejected": 0,
                                "orphans_reregistered": 0,
                                "pools_adopted": 0,
                                "pools_relinquished": 0}
@@ -708,6 +710,12 @@ class WorldServer:
         self.server_id = server_id or ("srv-" + uuid.uuid4().hex[:8])
         self._fed_lease_timeout_s = float(fed_lease_timeout_s)
         self._fed = None
+        # ISSUE 18: refuse NEW leases while the namespace store has no
+        # quorum (minority side of a partition).  Default on; the
+        # chaos "pre" leg turns it off to demonstrate the failure mode
+        # it closes (a minority server serving on stale authority).
+        self._store_fence = os.environ.get(
+            "MPI_TPU_SERVE_STORE_FENCE", "1") != "0"
         # observability (ISSUE 13): uptime anchor for the worlds/s
         # gauge, per-second completed-job buckets (sliding window —
         # bounded at ~window-many keys regardless of rate, unlike a
@@ -929,7 +937,12 @@ class WorldServer:
         })
         env.pop("MPI_TPU_SERVE_FED", None)
         if self._fed_ns is not None:
-            env["MPI_TPU_SERVE_FED"] = self._fed_ns
+            # CLIENT spec: a raft:<idx>@... member spec must not leak
+            # into workers — they resolve pool owners over the store's
+            # RPC port, never by embedding a node
+            from . import federation_store as _fstore
+
+            env["MPI_TPU_SERVE_FED"] = _fstore.client_spec(self._fed_ns)
         env.pop("MPI_TPU_SERVE_REJOIN", None)
         if rejoin_epoch is not None:
             env["MPI_TPU_SERVE_REJOIN"] = f"{rejoin_epoch}:{slot}"
@@ -1558,6 +1571,24 @@ class WorldServer:
         t_req = time.monotonic()
         deadline = t_req + timeout
         with self._cond:
+            if self._fed is not None and self._store_fence \
+                    and not self._fed.healthy():
+                # ISSUE 18 admission fence: this server sits on the
+                # MINORITY side of a namespace-store partition (or the
+                # store group has no leader).  Granting a lease here
+                # could double-serve a pool the majority is about to
+                # reassign — refuse with the NAMED verdict instead;
+                # FederatedClient treats it as a failover signal and
+                # lands on a majority-side server.  In-flight leases
+                # run to completion (reads and running jobs are not
+                # gated); only NEW authority is refused.
+                self.stats_counters["leases_denied"] += 1
+                self.stats_counters["no_quorum_rejected"] += 1
+                raise NoQuorumError(
+                    f"server {self.server_id} has no namespace-store "
+                    f"quorum (minority side of a partition): refusing "
+                    f"new leases — fail over to a majority-side "
+                    f"server")
             # under the lock: the federation thread mutates _pools
             # (adopt/relinquish) — iterating it bare would crash with
             # dict-changed-size exactly during a takeover, when failed-
@@ -1860,13 +1891,18 @@ class WorldServer:
         out["is_leader"] = (self.is_leader() if self._fed is not None
                             else None)
         if self._fed_ns is not None:
-            # namespace roll-up (file reads; deliberately OUTSIDE the
+            # namespace roll-up (store reads; deliberately OUTSIDE the
             # server lock): keeps the Prometheus endpoint truthful
-            # when pools move between servers
+            # when pools move between servers.  Through the MEMBER's
+            # own store handle (not the spec) — a raft member serves
+            # its local applied state instead of dialing itself
             from . import federation as _federation
 
             out["federation"] = _federation.federation_stats(
-                self._fed_ns)
+                self._fed.store if self._fed is not None
+                else self._fed_ns)
+            if self._fed is not None:
+                out["store_healthy"] = self._fed.healthy()
         # lease-acquire quantiles from the histogram pvar (log-bucket
         # estimates — mpit.hist_quantile documents the error bound)
         for q, label in ((0.5, "p50"), (0.99, "p99")):
@@ -2115,7 +2151,7 @@ def connect(addr: Any, timeout: float = 30.0, priority: int = 0):
         return ServerClient(host, port, timeout=timeout,
                             priority=priority)
     text = str(addr)
-    if os.path.isdir(text):
+    if os.path.isdir(text) or text.startswith("raft:"):
         from . import federation as _federation
 
         return _federation.FederatedClient(
@@ -2173,13 +2209,19 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                          "worker health, aggregated worker pvars) on "
                          "this HTTP port; 0 binds an ephemeral port "
                          "(printed at startup)")
-    ap.add_argument("--federation", default=None, metavar="DIR",
-                    help="join the federation namespace DIR "
-                         "(mpi_tpu/federation.py): N servers share it "
-                         "via endpoint records + a file-lease leader; "
-                         "a dead server's pool is adopted by a "
-                         "survivor and its workers re-register there; "
-                         "clients connect(DIR) and fail over")
+    ap.add_argument("--federation", default=None, metavar="SPEC",
+                    help="join a federation namespace "
+                         "(mpi_tpu/federation.py): a shared DIR "
+                         "(FileStore — single host/NFS), or "
+                         "raft:<idx>@h0:p0,h1:p1,... to embed store "
+                         "node <idx> of a replicated quorum group "
+                         "(mpi_tpu/federation_store.py — N hosts, no "
+                         "shared FS; a partitioned minority refuses "
+                         "leases with NoQuorumError).  N servers share "
+                         "endpoint records + a CAS leader lease; a "
+                         "dead server's pool is adopted by a survivor "
+                         "and its workers re-register there; clients "
+                         "connect(DIR | raft:h0:p0,...) and fail over")
     ap.add_argument("--server-id", default=None,
                     help="federation identity (default: random "
                          "srv-<hex8>)")

@@ -240,6 +240,35 @@ def test_federation_chaos_quick_smoke():
     assert result["no_leader_overlap"]
 
 
+def test_federation_partition_quick_smoke():
+    """The consensus-tier partition leg (ISSUE 18; the ``bench.py
+    --chaos --federation --partition --quick`` CI spelling): a 3-server
+    federated fabric whose leases live in a replicated 3-node Raft
+    store gets its raft leader isolated into a minority partition, then
+    its serve leader SIGKILLed after heal.  The contract: the minority
+    server refuses new leases with a NAMED NoQuorumError (never a stale
+    grant), the majority side keeps electing and serving (no window
+    hits zero), heal converges the log (truncated entries observed),
+    and the leader-authority log shows no split-brain overlap."""
+    from benchmarks import chaos
+
+    result = chaos.run_federation_partition(quick=True)
+    assert result["ok"], {k: result.get(k) for k in
+                          ("kills", "windows_completed",
+                           "unnamed_failures", "minority_probe",
+                           "truncated_entries", "healed_to_full_strength",
+                           "no_leader_overlap",
+                           "final_cross_server_allreduce_ok",
+                           "final_error", "leader_overlap_error")}
+    assert result["minority_probe"]["refused_with_noquorum"]
+    assert not result["minority_probe"]["stale_grant_succeeded"]
+    assert result["truncated_entries"] > 0
+    assert result["kills"], "no serve leader was killed post-heal"
+    assert all(w > 0 for w in result["windows_completed"])
+    assert result["unnamed_failures"] == []
+    assert result["no_leader_overlap"]
+
+
 def test_links_chaos_quick_smoke(tmp_path):
     """The link-fault chaos leg (ISSUE 10; the ``bench.py --chaos
     --links --quick`` CI spelling): connection resets — between frames

@@ -1,0 +1,378 @@
+"""Replicated namespace store (ISSUE 18): the NamespaceStore
+conformance suite, run against BOTH backends — the dir-backed
+:class:`FileStore` (versioned-file link-CAS) and the raft-replicated
+:class:`RaftStore` (an in-process 3-node fabric) — plus backend-
+specific legs: the FileStore frozen-holder CAS regression (the PR-15
+takeover window, now structurally closed), raft log-replay
+idempotence (the applied-nonce table), and the named-NoQuorumError
+minority verdict under an injected store partition.
+
+The conformance half is the contract the federation tier programs
+against: whatever passes here can carry leases, server records, and
+authority logs without the caller knowing which backend it got."""
+
+import os
+import socket
+import sys
+import threading
+import time
+import uuid
+
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from mpi_tpu import federation, federation_store as fstore  # noqa: E402
+from mpi_tpu.errors import NoQuorumError  # noqa: E402
+
+# propose RTTs are sub-ms in-process; elections dominate setup
+ELECT_S = 0.3
+
+
+def _free_ports(n):
+    socks, ports = [], []
+    for _ in range(n):
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        socks.append(s)
+        ports.append(s.getsockname()[1])
+    for s in socks:
+        s.close()
+    return ports
+
+
+def _mk_fabric(n=3, elect_s=ELECT_S):
+    addrs = [f"127.0.0.1:{p}" for p in _free_ports(n)]
+    nodes = [fstore.RaftNode(i, addrs, elect_timeout_s=elect_s)
+             for i in range(n)]
+    deadline = time.monotonic() + 30.0
+    while not any(nd.role == "leader" for nd in nodes):
+        if time.monotonic() > deadline:
+            for nd in nodes:
+                nd.close()
+            raise RuntimeError("raft fabric never elected a leader")
+        time.sleep(0.05)
+    return addrs, nodes
+
+
+@pytest.fixture(scope="module")
+def raft_fabric():
+    addrs, nodes = _mk_fabric()
+    yield addrs, nodes
+    for nd in nodes:
+        nd.close()
+
+
+@pytest.fixture(params=["file", "raft"])
+def store(request, tmp_path):
+    """One conformance subject per backend.  The raft subject is a
+    member-mode handle on node 0 of a shared module fabric (propose
+    forwards to whoever leads); tests isolate by unique keys."""
+    if request.param == "file":
+        yield fstore.FileStore(str(tmp_path))
+    else:
+        _, nodes = request.getfixturevalue("raft_fabric")
+        yield fstore.RaftStore(nodes[0], owns_node=False)
+
+
+def _key():
+    return f"t.{uuid.uuid4().hex[:12]}"
+
+
+# -- conformance: the contract both backends honor ---------------------------
+
+
+def test_cas_create_update_and_stale_rejection(store):
+    k = _key()
+    assert store.get(k) is None
+    r1 = store.cas(k, None, {"n": 1})
+    assert r1 is not None and r1.value == {"n": 1}
+    # create-if-absent against an existing key loses
+    assert store.cas(k, None, {"n": 99}) is None
+    r2 = store.cas(k, r1.ver, {"n": 2})
+    assert r2 is not None and r2.ver > r1.ver
+    # a stale version token is rejected, not last-writer-wins
+    assert store.cas(k, r1.ver, {"n": 3}) is None
+    got = store.get(k)
+    assert got.value == {"n": 2} and got.ver == r2.ver
+    # stamps are wall-clock-ish and move forward: the staleness clock
+    # LeaderLease reads
+    assert abs(r2.stamp - time.time()) < 30.0
+    assert r2.stamp >= r1.stamp
+
+
+def test_cas_single_winner_under_contention(store):
+    """The lease primitive: N threads racing read-modify-CAS on one
+    counter — every successful cas is exactly one increment (atomic
+    arbitration, no lost updates), regardless of backend."""
+    k = _key()
+    store.cas(k, None, {"n": 0})
+    nthreads, wins = 6, [0] * 6
+    deadline = time.monotonic() + 30.0
+
+    def contender(i):
+        while wins[i] < 5 and time.monotonic() < deadline:
+            cur = store.get(k)
+            if cur is None:
+                continue
+            rec = store.cas(k, cur.ver, {"n": cur.value["n"] + 1})
+            if rec is not None:
+                wins[i] += 1
+
+    threads = [threading.Thread(target=contender, args=(i,))
+               for i in range(nthreads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert all(w == 5 for w in wins), wins
+    assert store.get(k).value["n"] == sum(wins)
+
+
+def test_put_delete_and_scan(store):
+    pre = f"s.{uuid.uuid4().hex[:8]}."
+    ra = store.put(pre + "a", {"x": 1})
+    store.put(pre + "b", {"x": 2})
+    store.put("other." + pre, {"x": 3})  # outside the prefix
+    got = store.scan(pre)
+    assert set(got) == {pre + "a", pre + "b"}
+    # upsert bumps the version
+    ra2 = store.put(pre + "a", {"x": 10})
+    assert ra2.ver > ra.ver
+    assert store.scan(pre)[pre + "a"].value == {"x": 10}
+    assert store.delete(pre + "b")
+    assert store.get(pre + "b") is None
+    assert set(store.scan(pre)) == {pre + "a"}
+    # deletion is not a hole: the key is re-creatable
+    assert store.cas(pre + "b", None, {"x": 4}) is not None
+
+
+def test_watch_delivers_updates_and_deletes(store):
+    pre = f"w.{uuid.uuid4().hex[:8]}."
+    store.put(pre + "pre", {"x": 0})  # pre-existing: no event
+    w = store.watch(pre)
+    try:
+        store.put(pre + "k", {"x": 1})
+        ev = w.next(timeout=10.0)
+        assert ev is not None and ev[0] == pre + "k"
+        assert ev[1].value == {"x": 1}
+        store.delete(pre + "k")
+        ev = w.next(timeout=10.0)
+        assert ev == (pre + "k", None)
+    finally:
+        w.close()
+
+
+def test_append_log_order_and_reread_stability(store):
+    """Authority-interval logs: append-only, in order, and re-reading
+    never re-applies (the replay shape assert_no_leader_overlap's
+    history audit depends on)."""
+    lk = f"audit.log.conf-{uuid.uuid4().hex[:8]}"
+    for i in range(5):
+        store.append(lk, {"i": i})
+    logs = store.log_scan("audit.log.conf-")
+    assert [r["i"] for r in logs[lk]] == [0, 1, 2, 3, 4]
+    assert store.log_scan("audit.log.conf-") == logs  # idempotent read
+    store.append(lk, {"i": 5})
+    assert [r["i"] for r in store.log_scan(
+        "audit.log.conf-")[lk]] == [0, 1, 2, 3, 4, 5]
+
+
+def test_leader_lease_expiry_and_takeover(store):
+    """The federation LeaderLease running ON the conformance subject:
+    B cannot take a live lease, CAN take a stale one (term bump), the
+    deposed holder demotes, and the interval history stays
+    overlap-free — identical semantics on both backends."""
+    a = federation.LeaderLease(store, "A", lease_timeout_s=0.8)
+    b = federation.LeaderLease(store, "B", lease_timeout_s=0.8)
+    assert a.tick() and a.is_leader()
+    assert not b.tick()  # live holder: refused
+    time.sleep(0.9)      # past the lease bound: A never renewed
+    assert not a.is_leader()  # bounded authority lapsed on its own
+    assert b.tick() and b.is_leader()
+    assert b.term > a.term
+    assert b.takeovers == 1
+    assert not a.tick()  # thawed holder discovers usurpation
+    assert a.demotions == 1
+    federation.assert_no_leader_overlap(store)
+    b.release()
+
+
+# -- FileStore: the frozen-holder CAS window (PR-15 regression) ---------------
+
+
+def test_filestore_frozen_holder_mid_cas_loses(tmp_path):
+    """The PR-15 accepted race, now structurally closed: a holder
+    frozen (SIGSTOP-shaped: the _test_mid_cas seam blocks it) BETWEEN
+    its current-version read and its publish thaws after a usurper's
+    takeover committed — its publish must LOSE the version-slot
+    arbitration, leaving exactly one winner."""
+    frozen, release = threading.Event(), threading.Event()
+    holder_store = fstore.FileStore(str(tmp_path))
+    usurper_store = fstore.FileStore(str(tmp_path))
+    seed = holder_store.cas("leader.lease", None, {"id": "H", "term": 1})
+    assert seed is not None
+
+    def seam(key):
+        frozen.set()
+        assert release.wait(10.0)
+
+    holder_store._test_mid_cas = seam  # instance seam: holder only
+    out = {}
+
+    def holder_renew():
+        out["holder"] = holder_store.cas(
+            "leader.lease", seed.ver, {"id": "H", "term": 1, "r": 1})
+
+    th = threading.Thread(target=holder_renew)
+    th.start()
+    assert frozen.wait(10.0)  # holder read ver, now frozen in the window
+    won = usurper_store.cas("leader.lease", seed.ver,
+                            {"id": "U", "term": 2})
+    assert won is not None  # takeover committed while holder frozen
+    release.set()
+    th.join(10.0)
+    assert out["holder"] is None  # thawed holder LOSES, no silent overwrite
+    final = usurper_store.get("leader.lease")
+    assert final.value["id"] == "U" and final.ver == won.ver
+
+
+def test_filestore_version_gc_truncates_but_never_recycles(tmp_path):
+    """The version-chain GC keeps the arbitration sound across many
+    generations: 40 sequential CASes leave a readable current record,
+    bounded CONTENT (older slots truncated to placeholders), and every
+    slot NAME still present — a recycled name would hand a straggler
+    frozen past GC a silent win, the lost-update variant of the PR-15
+    window."""
+    st = fstore.FileStore(str(tmp_path))
+    rec = st.cas("k", None, {"n": 0})
+    for i in range(1, 40):
+        rec = st.cas("k", rec.ver, {"n": i})
+        assert rec is not None
+    assert st.get("k").value == {"n": 39}
+    names = [n for n in os.listdir(str(tmp_path))
+             if not n.startswith(".tmp.")]
+    assert len(names) == 40  # every slot name survives (no recycling)
+    nonempty = [n for n in names if os.path.getsize(
+        os.path.join(str(tmp_path), n)) > 0]
+    assert len(nonempty) <= 3  # content bounded: current + fallback
+    # a straggler holding a long-stale version token cannot re-win a
+    # truncated slot
+    assert st.cas("k", 5, {"n": -1}) is None
+    assert st.get("k").value == {"n": 39}
+
+
+# -- RaftStore: replication-specific legs -------------------------------------
+
+
+def test_raft_log_replay_is_idempotent():
+    """Exactly-once under retry: re-applying a command with an
+    already-seen nonce (the retransmit/replay shape) returns the
+    cached result and does NOT re-execute — an append is not
+    duplicated, a cas does not double-fire."""
+    addrs = [f"127.0.0.1:{_free_ports(1)[0]}"]
+    node = fstore.RaftNode(0, addrs, elect_timeout_s=0.2)
+    try:
+        cmd = {"op": "append", "key": "leader.log.x",
+               "rec": {"i": 0}, "nonce": "N1", "stamp": 1.0}
+        with node._lock:
+            assert node._apply_cmd(cmd, 1) == ("ok",)
+            assert node._apply_cmd(cmd, 2) == ("ok",)  # replayed
+            assert node.logs["leader.log.x"] == [{"i": 0}]  # applied ONCE
+            c2 = {"op": "cas", "key": "k", "ev": None, "val": {"n": 1},
+                  "nonce": "N2", "stamp": 1.0}
+            r = node._apply_cmd(c2, 3)
+            assert r[0] == "ok"
+            assert node._apply_cmd(c2, 4) == r  # cached, not re-arbitrated
+            assert node.kv["k"][0] == {"n": 1}
+    finally:
+        node.close()
+
+
+def test_raft_minority_partition_named_refusal_and_heal():
+    """The partition matrix on a private fabric: isolate the leader →
+    its mutations raise the NAMED NoQuorumError (healthy() False), the
+    majority re-elects and keeps committing; heal → the deposed
+    leader's uncommitted entries are truncated away and every node
+    converges on the majority's history."""
+    addrs, nodes = _mk_fabric()
+    stores = [fstore.RaftStore(nd, owns_node=False) for nd in nodes]
+    try:
+        lead = next(i for i, nd in enumerate(nodes)
+                    if nd.role == "leader")
+        stores[lead].put("seed", {"v": 0})
+        pmap = {i: (1 if i == lead else 0) for i in range(3)}
+        for nd in nodes:
+            nd.install_partition(pmap)
+        time.sleep(2.5 * ELECT_S)  # isolated leader's acks go stale
+        assert not stores[lead].healthy()
+        with pytest.raises(NoQuorumError):
+            stores[lead].cas("minority", None, {"v": 1})
+        # majority side: re-elects among itself and commits
+        maj = (lead + 1) % 3
+        deadline = time.monotonic() + 20.0
+        committed = None
+        while committed is None and time.monotonic() < deadline:
+            try:
+                committed = stores[maj].cas("majority", None, {"v": 2})
+            except NoQuorumError:
+                time.sleep(0.1)
+        assert committed is not None
+        assert stores[maj].healthy()
+        for nd in nodes:
+            nd.install_partition(None)  # heal
+        deadline = time.monotonic() + 20.0
+        while time.monotonic() < deadline:
+            got = stores[lead].get("majority")
+            if got is not None and got.value == {"v": 2} \
+                    and stores[lead].get("minority") is None:
+                break
+            time.sleep(0.1)
+        # the ex-leader converged on the MAJORITY history: its
+        # uncommitted minority intent is gone, not replayed
+        assert stores[lead].get("majority").value == {"v": 2}
+        assert stores[lead].get("minority") is None
+        assert sum(nd.truncated_entries for nd in nodes) >= 1
+    finally:
+        for nd in nodes:
+            nd.close()
+
+
+def test_raft_client_store_rpc_roundtrip(raft_fabric):
+    """The worker/client path: a socket RaftClientStore against the
+    fabric mirrors the member handle's view — same CAS arbitration,
+    same scan, over the wire."""
+    addrs, nodes = raft_fabric
+    client = fstore.RaftClientStore(list(addrs))
+    try:
+        k = _key()
+        r1 = client.cas(k, None, {"via": "rpc"})
+        assert r1 is not None
+        assert client.cas(k, None, {"via": "again"}) is None
+        assert client.get(k).value == {"via": "rpc"}
+        member = fstore.RaftStore(nodes[0], owns_node=False)
+        deadline = time.monotonic() + 10.0
+        while member.get(k) is None and time.monotonic() < deadline:
+            time.sleep(0.05)
+        assert member.get(k).value == {"via": "rpc"}
+    finally:
+        client.close()
+
+
+def test_member_and_client_spec_resolution(tmp_path):
+    """Spec grammar: dir → FileStore; raft:<idx>@addrs → member spec
+    (parsed, not started here); raft:addrs → cached client store; and
+    client_spec() strips the member index for worker hand-off."""
+    st = fstore.resolve_store(str(tmp_path))
+    assert isinstance(st, fstore.FileStore)
+    assert fstore.resolve_store(str(tmp_path)) is st  # cached
+    idx, addrs = fstore.parse_member_spec("raft:2@h1:1,h2:2,h3:3")
+    assert idx == 2 and addrs == ["h1:1", "h2:2", "h3:3"]
+    assert fstore.client_spec("raft:2@h1:1,h2:2") == "raft:h1:1,h2:2"
+    assert fstore.client_spec(str(tmp_path)) == str(tmp_path)
+    c1 = fstore.resolve_store("raft:h1:1,h2:2")
+    c2 = fstore.resolve_store("raft:0@h1:1,h2:2")  # member → client
+    assert c1 is c2  # same addr-set: one cached client handle
+    with pytest.raises(ValueError):
+        fstore.parse_member_spec("raft:h1:1,h2:2")  # no index: not a member

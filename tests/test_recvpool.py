@@ -502,3 +502,142 @@ def test_persistent_refire_allocates_no_new_work_buffers():
         return True
 
     assert all(run_local(prog, 2, progress="thread"))
+
+
+# -- fold-fallback visibility (ISSUE 18 satellite) ----------------------------
+
+
+def _fold_delta():
+    return mpit.pvar_read("recv_pool_fold_fallbacks")
+
+
+def test_fold_fallback_counts_reader_beating_poster():
+    """A steerable frame arriving before ANY consumer was counted is a
+    genuine lost race: it folds through the pool and ticks the pvar."""
+    reg = PostedRecvRegistry()
+    plan = ("arr", "<f8", (4,))
+    c0 = _fold_delta()
+    assert reg.note_frame("s", "c", -7, 1, 1, plan=plan) is None
+    assert _fold_delta() == c0 + 1
+
+
+def test_fold_fallback_ignores_blocking_recvs():
+    """A blocking recv (note_consume) never steers by design — its
+    frame folding through the pool is not a race."""
+    reg = PostedRecvRegistry()
+    plan = ("arr", "<f8", (4,))
+    reg.note_consume("s", "c", -7)
+    c0 = _fold_delta()
+    assert reg.note_frame("s", "c", -7, 1, 1, plan=plan) is None
+    assert _fold_delta() == c0
+
+
+def test_fold_fallback_counts_post_without_attach():
+    """The other flavor: the irecv was posted but its attach() hadn't
+    landed when the frame arrived (dest-less entry)."""
+    reg = PostedRecvRegistry()
+    plan = ("arr", "<f8", (4,))
+    reg.note_post("s", "c", -7)  # posted, never attached
+    c0 = _fold_delta()
+    assert reg.note_frame("s", "c", -7, 1, 1, plan=plan) is None
+    assert _fold_delta() == c0 + 1
+
+
+def test_fold_fallback_ignores_declined_attach():
+    """An explicitly declined dest (read-only / non-contiguous) is a
+    decision, not a race — the pvar stays put."""
+    reg = PostedRecvRegistry()
+    plan = ("arr", "<f8", (4,))
+    token = reg.note_post("s", "c", -7)
+    ro = np.zeros(4)
+    ro.flags.writeable = False
+    reg.attach(token, ro)
+    c0 = _fold_delta()
+    assert reg.note_frame("s", "c", -7, 1, 1, plan=plan) is None
+    assert _fold_delta() == c0
+
+
+def test_fold_fallback_silent_on_matched_steer():
+    """A matched geometry steers and counts nothing."""
+    reg = PostedRecvRegistry()
+    dest = np.zeros(4)
+    token = reg.note_post("s", "c", -7)
+    reg.attach(token, dest)
+    c0 = _fold_delta()
+    got = reg.note_frame("s", "c", -7, 1, 1, plan=("arr", "<f8", (4,)))
+    assert got is dest
+    assert _fold_delta() == c0
+
+
+def test_fold_fallback_emits_trace_instant():
+    reg = PostedRecvRegistry()
+    rec = telemetry.enable(capacity=256)
+    try:
+        reg.note_frame("sX", "cX", -9, 1, 1, plan=("arr", "<f8", (2,)))
+        evs = rec.find("recvpool", "fold_fallback")
+        assert evs and evs[0]["attrs"] == {"src": "sX", "tag": -9}
+    finally:
+        telemetry.disable()
+
+
+# -- persistent double-buffer fence (ISSUE 18 satellite) ----------------------
+
+
+def test_persistent_fence_trips_on_round_plus_two_overwrite():
+    """Verify mode: start() raises the named ``BufferPinnedError`` when
+    the caller still references the round-k result at round k+2, where
+    silent corruption would otherwise begin."""
+    from mpi_tpu.errors import BufferPinnedError
+    from mpi_tpu.transport.local import run_local
+
+    def prog(comm):
+        x = np.ones(16)
+        h = comm.allreduce_init(x)
+        r0 = h.start().wait()            # round 0 result, kept alive
+        h.start().wait()                 # round 1
+        try:
+            h.start().wait()             # round 2 would overwrite r0
+        except BufferPinnedError as e:
+            return ("fenced", "copy it first" in str(e), float(r0[0]))
+        return ("missed", False, float(r0[0]))
+
+    res = run_local(prog, 2, verify=True, progress="thread", timeout=60.0)
+    assert res == [("fenced", True, 2.0)] * 2
+
+
+def test_persistent_fence_silent_when_contract_followed():
+    """Dropping the stale reference (or only ever holding the latest
+    result) never trips the fence — including the reassignment idiom
+    where the previous round's array dies on rebinding."""
+    from mpi_tpu.transport.local import run_local
+
+    def prog(comm):
+        x = np.ones(16)
+        h = comm.allreduce_init(x)
+        r0 = h.start().wait()
+        r1 = h.start().wait()
+        del r0, r1                       # contract honored: release early
+        got = None
+        for _ in range(6):               # rebinding loop: old result dies
+            got = h.start().wait()
+        return float(np.asarray(got)[0])
+
+    assert run_local(prog, 2, verify=True, progress="thread",
+                     timeout=60.0) == [2.0, 2.0]
+
+
+def test_persistent_fence_off_without_verify():
+    """The fence is verify-gated: the documented overwrite behavior is
+    unchanged in normal runs (round k's array IS buffer k % 2)."""
+    from mpi_tpu.transport.local import run_local
+
+    def prog(comm):
+        x = np.ones(8)
+        h = comm.allreduce_init(x)
+        r0 = h.start().wait()
+        h.start().wait()
+        r2 = h.start().wait()            # overwrites r0 silently: by design
+        np.testing.assert_array_equal(np.asarray(r0), np.asarray(r2))
+        return True
+
+    assert all(run_local(prog, 2, progress="thread"))

@@ -18,7 +18,7 @@ cd "$(dirname "$0")/.."
 echo "check.sh: python -m compileall (syntax gate)"
 python -m compileall -q mpi_tpu tools examples benchmarks tests bench.py
 
-echo "check.sh: mpilint over examples/ + mpi_tpu/ (incl. compress.py, membership.py, serve.py, resilience.py, bufpool.py, recvpool.py, telemetry/, federation.py)"
+echo "check.sh: mpilint over examples/ + mpi_tpu/ (incl. compress.py, membership.py, serve.py, resilience.py, bufpool.py, recvpool.py, telemetry/, federation.py, federation_store.py)"
 python tools/mpilint.py examples mpi_tpu
 
 echo "check.sh: tune.py --check over committed tuning tables"
