@@ -676,6 +676,26 @@ if __name__ == "__main__":
         if "--quick" in sys.argv[1:]:
             sys.exit(tune.main(["--quick"]))
         sys.exit(tune.main([]))
+    if "--recvpool" in sys.argv[1:] and "--shm" in sys.argv[1:]:
+        # zero-copy-everywhere leg (ISSUE 19): the pvar-asserted steer
+        # bench (shm ring steering + user irecv(buf=) rendezvous +
+        # scatter-gather receives) on both host transports; the full
+        # run writes the committed recvpool_shm_{pre,post}.json pair
+        # ('pre' pins MPI_TPU_RECV_STEERING=0).  --quick is the tier-1
+        # smoke spelling (64KB, 1 sample, stdout only).
+        from benchmarks import host_sweep
+
+        if "--quick" in sys.argv[1:]:
+            sys.exit(host_sweep.main(["--recvpool", "--shm",
+                                      "--label", "post", "--quick"]))
+        rc = host_sweep.main(
+            ["--recvpool", "--shm", "--label", "pre",
+             "--out", os.path.join(REPO, "benchmarks", "results",
+                                   "recvpool_shm_pre.json")])
+        sys.exit(rc or host_sweep.main(
+            ["--recvpool", "--shm", "--label", "post",
+             "--out", os.path.join(REPO, "benchmarks", "results",
+                                   "recvpool_shm_post.json")]))
     if "--persist" in sys.argv[1:]:
         # persistent-collective leg (ISSUE 12): osu_allreduce_persistent-
         # shaped fresh-call vs start() re-fire p50s at small payloads on

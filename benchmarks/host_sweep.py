@@ -209,6 +209,28 @@ def recvpool_sweep(quick: bool = False, steering: int = 1) -> List[Dict]:
     return rows
 
 
+# Zero-copy-everywhere band (ISSUE 19): the pvar-asserted ``steer``
+# bench (benchmarks/osu.py) on BOTH host transports — the shm ring
+# drain now consults the same posted-recv registry the socket reader
+# does, and user-buffer rendezvous / scatter-gather receives are part
+# of the contract.  Rows carry the world-summed pvar deltas, so the
+# committed artifact proves bytes-steered/copies-at-floor directly.
+RECVPOOL_SHM_SIZES = "1MB,16MB"
+
+
+def recvpool_shm_sweep(quick: bool = False, steering: int = 1) -> List[Dict]:
+    env = {"MPI_TPU_RECV_STEERING": str(steering)}
+    sizes = "64KB" if quick else RECVPOOL_SHM_SIZES
+    iters, warmup = (1, 0) if quick else (15, 3)
+    rows: List[Dict] = []
+    for backend in TRANSPORTS:
+        for r in _osu_rows(backend, "steer", sizes, None, iters, warmup,
+                           env_extra=env):
+            r["recv_steering"] = steering
+            rows.append(r)
+    return rows
+
+
 def latency_diagnosis_legs() -> List[Dict]:
     """1KB ping-pong p50 on socket, shm(default spin), shm(spin off) and
     shm(long spin): separates the futex-wakeup cost (the spin knob removes
@@ -398,6 +420,18 @@ def run_recvpool_sweep(label: str, quick: bool = False) -> Dict:
         lambda quick: recvpool_sweep(quick=quick, steering=steering))
 
 
+def run_recvpool_shm_sweep(label: str, quick: bool = False) -> Dict:
+    """The zero-copy-everywhere band — ISSUE 19's pre/post artifact
+    (committed as benchmarks/results/recvpool_shm_{pre,post}.json):
+    'pre' pins MPI_TPU_RECV_STEERING=0, 'post' runs the default
+    steering path; rows carry world-summed pvar deltas per leg
+    (allreduce_ring / user_irecv / scatter_gather, both transports)."""
+    steering = 0 if label == "pre" else 1
+    return _band_result(
+        label, quick, "recvpool_shm_rows",
+        lambda quick: recvpool_shm_sweep(quick=quick, steering=steering))
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--label", default="post")
@@ -421,8 +455,15 @@ def main(argv=None) -> int:
                          "latency/bibw/ring-allreduce at 1-16MB; --label "
                          "pre pins MPI_TPU_RECV_STEERING=0) — the "
                          "recv-pool rendezvous pre/post artifact")
+    ap.add_argument("--shm", action="store_true",
+                    help="with --recvpool: the zero-copy-everywhere band "
+                         "(pvar-asserted steer legs on BOTH transports, "
+                         "incl. shm ring steering, user irecv(buf=) and "
+                         "scatter-gather) — ISSUE 19's pre/post artifact")
     args = ap.parse_args(argv)
-    result = (run_recvpool_sweep(args.label, quick=args.quick)
+    result = (run_recvpool_shm_sweep(args.label, quick=args.quick)
+              if args.recvpool and args.shm
+              else run_recvpool_sweep(args.label, quick=args.quick)
               if args.recvpool
               else run_persist_sweep(args.label, quick=args.quick)
               if args.persist

@@ -248,6 +248,9 @@ def cpu_bench_program(comm, bench: str, sizes: List[int], algos: List[str],
     if bench == "persist":
         return _persist_bench(comm, sizes, iters, warmup)
 
+    if bench == "steer":
+        return _steer_bench(comm, sizes, iters, warmup)
+
     for nbytes in sizes:
         if bench == "allgather":
             # nbytes is the TOTAL gathered payload (busbw convention; matches
@@ -466,6 +469,86 @@ def _persist_bench(comm, sizes: List[int], iters: int,
     return rows
 
 
+_STEER_PVARS = ("payload_copies", "recv_bytes_steered",
+                "recv_pool_rendezvous", "recv_user_inplace",
+                "recv_user_fallbacks", "recv_pool_hits",
+                "recv_pool_misses", "recv_pool_fold_fallbacks",
+                "link_recv_syscalls")
+
+
+def _steer_bench(comm, sizes: List[int], iters: int,
+                 warmup: int) -> List[Dict]:
+    """Receive-plane steering legs (ISSUE 19): each leg brackets its
+    loop with pvar reads and ships the world-SUMMED deltas home on the
+    row, so the committed artifact PROVES the zero-copy claims (bytes
+    steered, stores at the floor, zero pool traffic on the user path)
+    instead of inferring them from timing.  Three legs per size:
+
+    * ``allreduce_ring`` — the 16MB acceptance shape: internal-tag
+      segmented collective, both transports.
+    * ``user_irecv`` — ``irecv(buf=)`` rendezvous, post-before-send
+      (a tag-99 handshake pins the in-order case).
+    * ``scatter_gather`` — a two-segment frame into a view list (the
+      vectored-read path on socket).
+    """
+    def leg_rows(run_iter):
+        comm.barrier()
+        base = {n: mpi_tpu.mpit.pvar_read(n) for n in _STEER_PVARS}
+        samples = []
+        for i in range(warmup + iters):
+            t0 = time.perf_counter()
+            run_iter()
+            if i >= warmup:
+                samples.append(time.perf_counter() - t0)
+        comm.barrier()
+        local = np.array([mpi_tpu.mpit.pvar_read(n) - base[n]
+                          for n in _STEER_PVARS], np.int64)
+        tot = np.asarray(comm.allreduce(local, algorithm="ring"))
+        return (statistics.median(samples) * 1e6,
+                {n: int(v) for n, v in zip(_STEER_PVARS, tot)})
+
+    rows: List[Dict] = []
+    for nbytes in sizes:
+        n = max(2, nbytes // 8)
+        data = np.arange(n, dtype=np.float64) + comm.rank
+        payload = np.ones(n, np.float64)
+        buf = np.zeros(n, np.float64)
+        segs = [np.ones(n // 2, np.float64),
+                np.ones(n - n // 2, np.float64)]
+        bufs = [np.zeros_like(s) for s in segs]
+
+        def ar_iter():
+            comm.allreduce(data, algorithm="ring")
+
+        def user_iter():
+            if comm.rank == 0:
+                comm.recv(source=1, tag=99)
+                comm.send(payload, dest=1, tag=7)
+            elif comm.rank == 1:
+                req = comm.irecv(source=0, tag=7, buf=buf)
+                comm.send(b"p", dest=0, tag=99)
+                req.wait()
+
+        def sg_iter():
+            if comm.rank == 0:
+                comm.recv(source=1, tag=99)
+                comm.send(segs, dest=1, tag=8)
+            elif comm.rank == 1:
+                req = comm.irecv(source=0, tag=8, buf=bufs)
+                comm.send(b"p", dest=0, tag=99)
+                req.wait()
+
+        for leg, run_iter in (("allreduce_ring", ar_iter),
+                              ("user_irecv", user_iter),
+                              ("scatter_gather", sg_iter)):
+            p50, pvars = leg_rows(run_iter)
+            if comm.rank == 0:
+                rows.append({"bench": "steer", "leg": leg,
+                             "nranks": comm.size, "bytes": nbytes,
+                             "p50_us": p50, "pvars": pvars})
+    return rows
+
+
 # ---------------------------------------------------------------------------
 # TPU backend: one jitted shard_map program per (bench, size, algorithm)
 # ---------------------------------------------------------------------------
@@ -583,7 +666,7 @@ def tpu_bench(bench: str, sizes: List[int], algos: List[str], iters: int,
 
 ALL_BENCHES = ["latency", "bw", "bibw", "barrier", "bcast", "reduce",
                "allreduce", "allgather", "alltoall", "reduce_scatter",
-               "overlap", "persist"]
+               "overlap", "persist", "steer"]
 DEFAULT_ALGOS = {
     "allreduce": ["ring", "recursive_halving", "fused"],  # + pallas_ring (tpu, opt-in)
     "bcast": ["tree", "fused"],
@@ -597,6 +680,7 @@ DEFAULT_ALGOS = {
     "barrier": ["-"],
     "overlap": ["-"],
     "persist": ["-"],
+    "steer": ["-"],
 }
 
 
@@ -604,7 +688,8 @@ def run_bench(bench: str, backend: str, nranks: int, sizes: List[int],
               algos: List[str], iters: int, warmup: int,
               algos_explicit: bool = False) -> List[Dict]:
     if backend == "tpu":
-        if bench in ("bw", "bibw", "barrier", "overlap", "persist"):
+        if bench in ("bw", "bibw", "barrier", "overlap", "persist",
+                     "steer"):
             # SPMD has no standalone p2p stream, its barrier is a
             # device-fused psum, and its nonblocking ops are XLA's to
             # schedule; all are process-backend benches

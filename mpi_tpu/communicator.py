@@ -585,6 +585,12 @@ class _RecvRequest(Request):
     # recv-steering registry token of an internal posted irecv
     # (mpi_tpu/recvpool.py note_post) — cancelled by _unpost
     _steer_token = None
+    # user-buffer rendezvous (ISSUE 19): the irecv(buf=...) destination
+    # (ndarray or list of ndarrays, filled at completion) and whether it
+    # was registered as a claimable steering entry — armed completions
+    # whose payload is NOT the view take the named fallback below
+    _user_buf = None
+    _user_armed = False
 
     def __init__(self, comm: "P2PCommunicator", source: int, tag: int,
                  queue: List["_RecvRequest"]):
@@ -595,6 +601,41 @@ class _RecvRequest(Request):
         queue.append(self)
 
     def _complete(self, payload: Any) -> None:
+        reg = self._comm._recv_reg
+        if reg is not None and reg.live_count and self._tag >= -1:
+            # USER-facing completion (every engine and queue-head path
+            # funnels through here): a steered user view may be live in
+            # the aliasing guard.  The owner's identity pop closes its
+            # lifecycle zero-copy; any other consumer of a live view
+            # gets a private copy (mpi_tpu/recvpool.py sanitize).
+            payload = reg.sanitize(payload, self._user_buf)
+        ub = self._user_buf
+        if ub is not None:
+            if payload is ub:
+                # the frame's bytes were landed DIRECTLY in the
+                # caller's buffer by the transport reader — the
+                # zero-copy user rendezvous path
+                _mpit.count(recv_user_inplace=1)
+            else:
+                if self._user_armed:
+                    # the match raced the reader (or the frame was not
+                    # steerable): rescue any still-unpopped claim
+                    # first, then retire the entry so a LATER frame can
+                    # never claim it and scribble the now-user-owned
+                    # buffer
+                    reg.pre_overwrite(ub)
+                    reg.cancel(self._steer_token)
+                    _mpit.count(recv_user_fallbacks=1)
+                try:
+                    if isinstance(ub, list):
+                        for b, g in zip(ub, payload):
+                            _bufpool.touch(b)
+                            b[...] = g
+                    else:
+                        _bufpool.touch(ub)
+                        ub[...] = payload
+                except (TypeError, ValueError):
+                    pass  # geometry mismatch: payload still returned
         self._value, self._done = payload, True
         if self in self._queue:
             self._queue.remove(self)
@@ -696,6 +737,14 @@ class PersistentRequest(Request):
             self._inner = self._comm.isend(payload, self._peer, self._tag)
         else:
             self._inner = self._comm.irecv(self._peer, self._tag)
+            if self._buf is not None:
+                # bind the bound buffer to THIS operation: the refill
+                # happens at the inner completion (steered frames land
+                # in it directly on steering transports — the
+                # persistent-handle flavor of the ISSUE 19 user-buffer
+                # rendezvous; everything else is copied in there)
+                self._comm._arm_user_recv(
+                    self._inner, self._peer, self._tag, self._buf)
             v = self._comm._verify
             if v is not None and isinstance(self._buf, np.ndarray):
                 # live receive buffer: overlapping another pending
@@ -729,12 +778,20 @@ class PersistentRequest(Request):
         return done, value
 
     def _complete(self, value: Any) -> None:
+        inner = self._inner
         self._inner = None
         self._last = value
         if self._buf_key is not None:
             self._comm._verify.world.buffer_release(self._buf_key)
             self._buf_key = None
-        if self._kind == "recv" and isinstance(self._buf, np.ndarray):
+        if (self._kind == "recv" and isinstance(self._buf, np.ndarray)
+                and value is not self._buf
+                and (inner is None
+                     or getattr(inner, "_user_buf", None) is None)):
+            # legacy refill for inner requests that could not carry the
+            # buffer (non-_RecvRequest paths); _arm_user_recv-bound
+            # buffers were already refilled — or steered in place — at
+            # the inner completion (_RecvRequest._complete)
             _bufpool.touch(self._buf)  # ownership CoW before the refill
             self._buf[...] = value
 
@@ -1318,19 +1375,36 @@ class P2PCommunicator(Communicator):
                        status: Optional[Status] = None,
                        _posted: bool = False) -> Any:
         src_world = ANY_SOURCE if source == ANY_SOURCE else self._world(source)
-        if (tag < 0 and not _posted and src_world != ANY_SOURCE
-                and self._recv_reg is not None):
-            # a BLOCKING internal recv consumes a frame on the same
-            # steering channel the posted irecvs pair on — count it so
-            # the frame/consumer indices stay aligned (it has no
+        reg = self._recv_reg
+        counted = False
+        if (not _posted and src_world != ANY_SOURCE and reg is not None
+                and (tag < 0 or (reg.user_count and reg.user_active(
+                    src_world, self._ctx, tag)))):
+            # a BLOCKING recv on a counted channel (internal, or a user
+            # channel activated by irecv(buf=)) consumes a frame on the
+            # same steering channel the posted irecvs pair on — count
+            # it so the frame/consumer indices stay aligned (it has no
             # destination buffer, so it never claims).  _posted=True
             # marks the queue-head servicing call of an ALREADY-counted
-            # posted request (_RecvRequest.wait).
-            self._recv_reg.note_consume(src_world, self._ctx, tag)
+            # posted request (_RecvRequest.wait) — its sanitize/refill
+            # runs in _RecvRequest._complete instead.
+            reg.note_consume(src_world, self._ctx, tag)
+            counted = True
         if self._ft is not None or self._verify is not None:
             obj, src, t = self._sliced_wait(src_world, tag)
         else:
             obj, src, t = self._plain_recv(src_world, tag)
+        if reg is not None and not _posted and t >= 0:
+            if reg.live_count:
+                # this pop may have taken a steered USER view some
+                # armed irecv owns — the aliasing guard hands any
+                # non-owner a private copy (mpi_tpu/recvpool.py)
+                obj = reg.sanitize(obj)
+            if not counted and reg.user_count:
+                # an UNCOUNTED pop (wildcard envelope) that landed on
+                # an active user channel shifts every later consumer
+                # one message earlier — tell the pairing
+                reg.note_steal(src, self._ctx, t)
         _mpit.count(recvs=1)
         if status is not None:
             status._fill(self._from_world(src), t, obj)
@@ -1636,15 +1710,66 @@ class P2PCommunicator(Communicator):
                 inner._vinfo, buf, inner._vinfo.describe(), writes=True)
         return _ReplaceRequest(inner, buf)
 
-    def irecv(self, source: int = ANY_SOURCE, tag: int = ANY_TAG) -> Request:
+    def irecv(self, source: int = ANY_SOURCE, tag: int = ANY_TAG,
+              buf: Any = None) -> Request:
         """Nonblocking receive (MPI_Irecv): returns a Request; ``test()``
         polls without blocking, ``wait()`` blocks.  Requests on the same
-        (source, tag) complete in posted order."""
+        (source, tag) complete in posted order.
+
+        ``buf``: optional preallocated destination (ndarray, or a list
+        of ndarrays for multi-segment payloads) filled in place at
+        completion.  On a steering transport with a SPECIFIC envelope
+        (source and tag) the matched frame's body bytes are landed
+        directly in it — the user-buffer rendezvous (ISSUE 19), priced
+        by the ``recv_user_inplace`` / ``recv_user_fallbacks`` pvars."""
         _check_user_tag(tag)
         req = self._irecv_internal(source, tag)
+        if buf is not None:
+            self._arm_user_recv(req, source, tag, buf)
         if self._verify is not None:
             self._track_request(req, "irecv", source, tag)
+            if req._user_buf is not None and req._vinfo is not None:
+                # live WRITE buffer until completion: overlapping any
+                # other pending op's buffer is the message-race lint —
+                # the aliasing surface user steering opens (ISSUE 19)
+                bufs = buf if isinstance(buf, list) else [buf]
+                for b in bufs:
+                    self._verify.world.track_buffer(
+                        req._vinfo, b, req._vinfo.describe(), writes=True)
         return req
+
+    def _arm_user_recv(self, req: "_RecvRequest", source: int, tag: int,
+                       buf: Any) -> None:
+        """Bind a user destination buffer to a posted receive: the
+        payload is copied in at completion, and — when the envelope is
+        specific and the buffer steering-eligible — registered with the
+        recv-steering registry so the transport reader can land the
+        matched frame's bytes in it directly (mpi_tpu/recvpool.py
+        note_post_user/attach; shared by irecv(buf=) and started
+        recv_init handles)."""
+        bufs = buf if isinstance(buf, list) else [buf]
+        if not all(isinstance(b, np.ndarray) for b in bufs):
+            return
+        req._user_buf = buf
+        reg = self._recv_reg
+        if (reg is None or tag < 0 or source == ANY_SOURCE
+                or not (0 <= source < self.size)
+                or not all(b.flags.writeable and b.flags.c_contiguous
+                           for b in bufs)):
+            return
+        src_world = self._world(source)
+        tok = req._steer_token
+        if tok is None:
+            # frames delivered before this channel's FIRST posted user
+            # buffer were never counted: seed the pairing lag with the
+            # current mailbox backlog so the first counted frame pairs
+            # with the right consumer (recvpool.note_post_user)
+            backlog = self._t.mailbox.count_matching(
+                src_world, self._ctx, tag)
+            tok = reg.note_post_user(src_world, self._ctx, tag, backlog)
+            req._steer_token = tok
+        reg.attach(tok, buf)
+        req._user_armed = True
 
     def _irecv_internal(self, source: int, tag: int) -> "_RecvRequest":
         """irecv without the user-tag gate — the collective engine posts
@@ -1660,6 +1785,16 @@ class P2PCommunicator(Communicator):
             # body straight into it (mpi_tpu/recvpool.py)
             req._steer_token = self._recv_reg.note_post(
                 self._world(source), self._ctx, tag)
+        elif (tag >= 0 and source != ANY_SOURCE
+              and self._recv_reg is not None
+              and self._recv_reg.user_count and 0 <= source < self.size
+              and self._recv_reg.user_active(
+                  self._world(source), self._ctx, tag)):
+            # a BUFFERLESS user irecv on an ACTIVE user channel is
+            # still a counted consumer (pairing alignment); claimable
+            # only if irecv(buf=) attaches a destination right after
+            req._steer_token = self._recv_reg.note_post_user(
+                self._world(source), self._ctx, tag, claimable=False)
         if self._progress is not None and \
                 not self.__dict__.get("_progress_registered"):
             # background completion: the engine scans this comm's posted
@@ -1713,10 +1848,26 @@ class P2PCommunicator(Communicator):
             obj, src, t = self._sliced_wait(src_world, tag)
         else:
             obj, src, t = self._plain_recv(src_world, tag)
+        obj = self._note_probe_steal(obj, src, t)
         msg = Message(obj, self._from_world(src), t, comm=self)
         if status is not None:
             status._fill(msg.source, msg.tag, obj)
         return msg
+
+    def _note_probe_steal(self, obj: Any, src_world: int, t: int) -> Any:
+        """A matched probe REMOVED a message from matching: run it
+        through the user-steering aliasing guard (the popped payload
+        may be a steered view some armed irecv owns — hand out a
+        private copy) and shift the channel's pairing lag down
+        (mpi_tpu/recvpool.py note_steal)."""
+        reg = self._recv_reg
+        if reg is None or t < 0:
+            return obj
+        if reg.live_count:
+            obj = reg.sanitize(obj)
+        if reg.user_count:
+            reg.note_steal(src_world, self._ctx, t)
+        return obj
 
     def improbe(self, source: int = ANY_SOURCE, tag: int = ANY_TAG,
                 status: Optional[Status] = None) -> Optional["Message"]:
@@ -1731,6 +1882,7 @@ class P2PCommunicator(Communicator):
         if self._verify is not None:
             self._verify.world.note_progress()
         obj, src, t = hit
+        obj = self._note_probe_steal(obj, src, t)
         msg = Message(obj, self._from_world(src), t, comm=self)
         if status is not None:
             status._fill(msg.source, msg.tag, obj)

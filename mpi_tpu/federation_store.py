@@ -315,7 +315,8 @@ class FileStore(NamespaceStore):
     def cas(self, key: str, expect_ver: Optional[int],
             value: Optional[dict], _dead: bool = False
             ) -> Optional[Rec]:
-        w, cur = self._current(key)
+        vers = self._versions(self._names()).get(key, [])
+        w, cur = self._current(key, vers)
         live = w is not None and not w.get("dead")
         if expect_ver is None:
             if live:
@@ -324,7 +325,14 @@ class FileStore(NamespaceStore):
             return None
         if self._test_mid_cas is not None:
             self._test_mid_cas(key)
-        new_ver = cur + 1
+        # epoch check (ISSUE 19 satellite): the successor slot must top
+        # EVERY existing slot NUMBER, not just the highest parseable
+        # one — inside the tombstone-GC window a recreate can observe a
+        # chain of truncated placeholders (cur == 0) whose names are
+        # still on disk; cur + 1 would collide with (EEXIST) or recycle
+        # one of them, handing a straggler frozen on the dead chain a
+        # silent win over the recreated key
+        new_ver = max(vers[0] if vers else 0, cur) + 1
         stamp = time.time()
         wrapper = {"v": value, "stamp": stamp}
         if _dead:
@@ -396,7 +404,13 @@ class FileStore(NamespaceStore):
                 # opportunistic tombstone GC: a long-dead key's version
                 # chain is garbage once every reader has moved on
                 if now - float(w.get("stamp", now)) > _TOMBSTONE_GC_S:
-                    for vv in vers:
+                    # unlink ASCENDING so the tombstone (the highest
+                    # slot) goes LAST: a GC interrupted mid-chain
+                    # leaves the key still visibly dead — removing the
+                    # tombstone first would resurrect the stale
+                    # predecessor value for every reader racing the
+                    # delete/recreate window (ISSUE 19 satellite)
+                    for vv in reversed(vers):
                         try:
                             os.unlink(os.path.join(
                                 self.root, f"{key}.v{vv}.json"))

@@ -52,11 +52,11 @@ class _Counters:
                  "tuned_hits", "tuned_fallbacks",
                  "link_reconnects", "link_replayed", "link_masked",
                  "link_retained", "link_cow_snaps", "link_cow_bytes",
-                 "link_syscalls", "link_torn",
+                 "link_syscalls", "link_rsyscalls", "link_torn",
                  "nbc_threads", "nbc_sms", "persist_starts",
                  "trace_events",
                  "rp_hits", "rp_misses", "rp_rdv", "rp_steered",
-                 "rp_fold",
+                 "rp_fold", "rp_user_in", "rp_user_fb",
                  "store_elections", "store_truncated", "store_dropped")
 
     def __init__(self) -> None:
@@ -99,6 +99,7 @@ class _Counters:
         self.link_cow_snaps = 0
         self.link_cow_bytes = 0
         self.link_syscalls = 0
+        self.link_rsyscalls = 0
         self.link_torn = 0
         self.nbc_threads = 0
         self.nbc_sms = 0
@@ -109,6 +110,8 @@ class _Counters:
         self.rp_rdv = 0
         self.rp_steered = 0
         self.rp_fold = 0
+        self.rp_user_in = 0
+        self.rp_user_fb = 0
         self.store_elections = 0
         self.store_truncated = 0
         self.store_dropped = 0
@@ -142,6 +145,7 @@ def count(sends: int = 0, send_bytes: int = 0, recvs: int = 0,
           link_cow_snapshots: int = 0,
           link_cow_bytes: int = 0,
           link_send_syscalls: int = 0,
+          link_recv_syscalls: int = 0,
           link_torn_frames: int = 0,
           nbc_threads_spawned: int = 0,
           nbc_state_machines: int = 0,
@@ -152,6 +156,8 @@ def count(sends: int = 0, send_bytes: int = 0, recvs: int = 0,
           recv_pool_rendezvous: int = 0,
           recv_bytes_steered: int = 0,
           recv_pool_fold_fallbacks: int = 0,
+          recv_user_inplace: int = 0,
+          recv_user_fallbacks: int = 0,
           store_elections: int = 0,
           store_entries_truncated: int = 0,
           store_partition_dropped: int = 0) -> None:
@@ -197,6 +203,7 @@ def count(sends: int = 0, send_bytes: int = 0, recvs: int = 0,
         counters.link_cow_snaps += link_cow_snapshots
         counters.link_cow_bytes += link_cow_bytes
         counters.link_syscalls += link_send_syscalls
+        counters.link_rsyscalls += link_recv_syscalls
         counters.link_torn += link_torn_frames
         counters.nbc_threads += nbc_threads_spawned
         counters.nbc_sms += nbc_state_machines
@@ -207,6 +214,8 @@ def count(sends: int = 0, send_bytes: int = 0, recvs: int = 0,
         counters.rp_rdv += recv_pool_rendezvous
         counters.rp_steered += recv_bytes_steered
         counters.rp_fold += recv_pool_fold_fallbacks
+        counters.rp_user_in += recv_user_inplace
+        counters.rp_user_fb += recv_user_fallbacks
         counters.store_elections += store_elections
         counters.store_truncated += store_entries_truncated
         counters.store_dropped += store_partition_dropped
@@ -326,6 +335,12 @@ _PVARS: Dict[str, Callable[[], int]] = {
     "link_cow_snapshots": lambda: counters.link_cow_snaps,
     "link_cow_bytes": lambda: counters.link_cow_bytes,
     "link_send_syscalls": lambda: counters.link_syscalls,
+    # receive twin of link_send_syscalls (ISSUE 19): data-plane socket
+    # READ calls on the raw-body path — one vectored recvmsg_into per
+    # multi-segment frame (scatter-gather receive) vs one recv_into per
+    # segment before it.  Headers/meta keep their own exact reads and
+    # are not counted.
+    "link_recv_syscalls": lambda: counters.link_rsyscalls,
     # torn frames (ISSUE 17 small fix): reader-side disconnects that
     # landed MID-FRAME (partial header/meta/body bytes then EOF or
     # error) — a reset the replay protocol must heal, distinguished
@@ -366,6 +381,15 @@ _PVARS: Dict[str, Callable[[], int]] = {
     # instead of a direct store.  A visibility counter only — the
     # deterministic payload_copies assertions are NOT derived from it.
     "recv_pool_fold_fallbacks": lambda: counters.rp_fold,
+    # user-buffer rendezvous (ISSUE 19): irecv(buf=)/recv_init(buf=)
+    # completions whose payload WAS the registered buffer (bytes landed
+    # in place, the final store skipped) vs armed completions that had
+    # to copy (the match raced the reader, a heal replay re-presented
+    # the frame, or a wildcard/probe stole the steered frame — the
+    # named fallback the tentpole demands).  Unarmed buf= completions
+    # count neither.
+    "recv_user_inplace": lambda: counters.rp_user_in,
+    "recv_user_fallbacks": lambda: counters.rp_user_fb,
     # replicated namespace store (mpi_tpu/federation_store.py, ISSUE
     # 18): store-leader elections STARTED by a node in this process,
     # uncommitted log entries truncated away by a new leader's

@@ -694,9 +694,17 @@ def _build(parent: P2PCommunicator, kind: str, args: tuple,
             "steps": _plan(("reduce_scatter", p, r, work.size),
                            lambda: tuple(
                 schedules.block_ring_reduce_scatter_steps(p, r, bn))),
+            # one-shot: COPY the owned block out so the p-times-larger
+            # work buffer isn't pinned by a small result
             "finish": lambda sm: _unwrap(
                 sm._work[r * bn:(r + 1) * bn].reshape(shape).copy(),
                 was_scalar),
+            # persistent double-buffer re-fire (ISSUE 19 satellite): the
+            # handle owns the preallocated work buffers, so a round's
+            # result can stay a VIEW of one — _note_result pins it and
+            # the BufferPinnedError fence covers the k+2 overwrite
+            "span_view": lambda sm: _unwrap(
+                sm._work[r * bn:(r + 1) * bn].reshape(shape), was_scalar),
         }
 
     if kind == "ibarrier":
@@ -804,8 +812,9 @@ class PersistentColl(Request):
     because start() requires the previous round complete and every rank
     starts its persistent collectives in the same order [S].
 
-    Engine-compiled allreduce rounds re-fire on two PREALLOCATED
-    working buffers alternated per start (no per-round allocation);
+    Engine-compiled allreduce and reduce_scatter rounds re-fire on two
+    PREALLOCATED working buffers alternated per start (no per-round
+    allocation);
     round k's result is a view of one of them and stays valid until
     round k+2 starts — hold a result across two later starts and you
     must copy it (``np.array(r)``), the usual double-buffer contract.
@@ -916,17 +925,19 @@ class PersistentColl(Request):
         if (self._build0 is None or self._parent._progress is None
                 or _MODE != "auto"):
             return None
-        if self._kind == "allreduce" and "done" not in self._build0:
-            # Fully preallocated re-fire (PR-12 residual (e)): the
-            # compiled steps, op, and finisher are round-invariant —
-            # only the working buffer's CONTENT changes per start.
-            # Instead of re-running _build (a fresh flatten() alloc
-            # every round), alternate two preallocated buffers: round
-            # k's result (a view of buffer k % 2) stays valid until
-            # round k+2 starts, the one-round grace double buffering
-            # exists to give.  The CoW touch protects retained replay
-            # frames still referencing the previous occupant (the
-            # sent spans of round k-2) before the overwrite.
+        if (self._kind in ("allreduce", "reduce_scatter")
+                and "done" not in self._build0):
+            # Fully preallocated re-fire (PR-12 residual (e); extended
+            # to reduce_scatter by ISSUE 19): the compiled steps, op,
+            # and finisher are round-invariant — only the working
+            # buffer's CONTENT changes per start.  Instead of
+            # re-running _build (a fresh flatten() alloc every round),
+            # alternate two preallocated buffers: round k's result (a
+            # view of buffer k % 2) stays valid until round k+2 starts,
+            # the one-round grace double buffering exists to give.  The
+            # CoW touch protects retained replay frames still
+            # referencing the previous occupant (the sent spans of
+            # round k-2) before the overwrite.
             if self._dbl is None:
                 w = self._build0["work"]
                 self._dbl = (np.empty_like(w), np.empty_like(w))
@@ -937,7 +948,15 @@ class PersistentColl(Request):
             self._round += 1
             _bufpool.touch(buf)
             np.copyto(buf, np.asarray(self._args[0]).reshape(-1))
-            return {**self._build0, "work": buf}
+            build = {**self._build0, "work": buf}
+            view = self._build0.get("span_view")
+            if view is not None:
+                # reduce_scatter's one-shot finisher copies its block
+                # out; on the double-buffered path the handle owns the
+                # buffers, so hand out the view and let the fence guard
+                # the overwrite instead
+                build["finish"] = view
+            return build
         # span work buffers are per-round flatten() copies and the
         # value finishers return fresh lists, so round results never
         # alias the bound buffer or a later round's state — safe to

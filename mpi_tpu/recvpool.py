@@ -67,6 +67,28 @@ Correctness invariants (the reasons this is safe under replay/chaos):
 ``recv_steering`` (cvar / MPI_TPU_RECV_STEERING) disables CLAIMING
 only: channel accounting stays on so toggling mid-run cannot desync
 the pairing, and the pre/post benches keep identical frame paths.
+
+ISSUE 19 extends the registry from "socket, internal tags only" to the
+whole receive plane:
+
+* both byte-stream transports consult it — the shm ring drain steers
+  an in-order frame straight from the ring into the posted view
+  (transport/shm.py synthesizes the per-src (gen, seq) the ring frames
+  don't carry);
+* USER channels (tag >= 0) activate on the first ``irecv(buf=...)`` /
+  started ``recv_init`` handle (:meth:`note_post_user`) — and because
+  user matching admits wildcards, matched probes, and undisciplined
+  blocking receives, every claimed user view carries an ALIASING GUARD
+  (:class:`_LiveSteer`): the owner's pop is identity (zero-copy), any
+  other consumer's pop is a private copy, and an owner that completes
+  without its view rescues the steered bytes first.  Mispairing is
+  therefore a performance event (``recv_user_fallbacks``), never a
+  correctness event;
+* multi-segment destinations (:meth:`attach` with a view list) match
+  ``"segs"`` plans per segment, so the socket reader lands a
+  multi-segment frame with one vectored ``recvmsg_into`` across the
+  posted views (scatter-gather receive, the mirror of the PR 11
+  single-``sendmsg`` send).
 """
 
 from __future__ import annotations
@@ -97,6 +119,14 @@ def _env_flag(name: str, default: int) -> int:
 # Rendezvous claiming on/off (the ``recv_steering`` cvar seeds/reads
 # this).  Accounting is NOT gated on it — see module docstring.
 _STEERING = _env_flag("MPI_TPU_RECV_STEERING", 1)
+
+
+def _copy_steered(obj):
+    """Private snapshot of a steered user destination (single view or
+    the multi-segment view list)."""
+    if isinstance(obj, list):
+        return [a.copy() for a in obj]
+    return obj.copy()
 
 
 class RecvPool:
@@ -179,13 +209,20 @@ class RecvPool:
 
 
 class _Entry:
-    __slots__ = ("idx", "dest", "ds", "shape", "declined")
+    __slots__ = ("idx", "dest", "ds", "shape", "segs", "user", "declined")
 
     def __init__(self, idx: int) -> None:
         self.idx = idx
-        self.dest: Optional[np.ndarray] = None
+        self.dest = None                    # ndarray, or list of ndarrays
         self.ds: Optional[str] = None
         self.shape: Tuple[int, ...] = ()
+        # multi-segment destination (list attach): per-segment
+        # (dtype_str, shape) descriptors in fill order — matched against
+        # a "segs" plan's descs for scatter-gather steering (ISSUE 19)
+        self.segs: Optional[Tuple] = None
+        # a USER-buffer entry (irecv(buf=)/recv_init): its claimed views
+        # enter the _live aliasing-guard set (see PostedRecvRegistry)
+        self.user = False
         # the poster looked at its destination and it was NOT steering
         # eligible (non-contiguous / read-only): a later dest-less
         # match is a decision, not a lost race — don't count it
@@ -193,13 +230,39 @@ class _Entry:
 
 
 class _Channel:
-    __slots__ = ("posted", "arrived", "wm", "entries")
+    __slots__ = ("posted", "arrived", "wm", "entries", "lag", "user")
 
     def __init__(self) -> None:
         self.posted = 0    # consumers counted (posted irecvs + blocking recvs)
         self.arrived = 0   # fresh data frames counted (+ self-send deliveries)
         self.wm: Tuple[int, int] = (0, 0)   # (gen, seq) counting watermark
         self.entries: deque = deque()       # outstanding posted-irecv entries
+        # USER channels only (tag >= 0, activated by the first
+        # irecv(buf=)): frames that were already DELIVERED before
+        # activation were never counted, so the Nth counted arrival is
+        # really the (N + lag)th thing the mailbox hands out — pairing
+        # indexes consumers at arrived + lag.  A matched-probe steal
+        # (mprobe removes a message from matching) shifts it back down.
+        # Internal channels keep lag == 0 and behave exactly as before.
+        self.lag = 0
+        self.user = False
+
+
+class _LiveSteer:
+    """Aliasing guard for ONE claimed user destination: tracks the view
+    (or list) from reader claim to consumer pop, so a mispaired pop —
+    wildcard receive, matched probe, an out-of-order blocking recv, a
+    heal that re-routed the frame — costs a COPY, never correctness
+    (see PostedRecvRegistry.sanitize / pre_overwrite)."""
+
+    __slots__ = ("obj", "writing", "sanitized", "owner_done", "rescue")
+
+    def __init__(self, obj) -> None:
+        self.obj = obj
+        self.writing = True      # reader body-read in progress
+        self.sanitized = False   # a foreign consumer already took a copy
+        self.owner_done = False  # owner completed WITHOUT the view
+        self.rescue = None       # owner-made snapshot for a later popper
 
 
 class PostedRecvRegistry:
@@ -210,7 +273,16 @@ class PostedRecvRegistry:
 
     def __init__(self) -> None:
         self._lock = threading.Lock()
+        self._cv = threading.Condition(self._lock)
         self._ch: Dict[Tuple[Any, Any, int], _Channel] = {}
+        # user-buffer rendezvous (ISSUE 19): activated user channels and
+        # the live claimed-view guard set.  The two bare ints are GIL-
+        # safe fast-path gates — readers and completion sites skip the
+        # lock entirely while the feature is unused.
+        self._user_keys: set = set()
+        self.user_count = 0
+        self._live: Dict[int, _LiveSteer] = {}
+        self.live_count = 0
 
     def _chan(self, src, ctx, tag) -> _Channel:
         key = (src, ctx, tag)
@@ -237,11 +309,75 @@ class PostedRecvRegistry:
         with self._lock:
             self._chan(src, ctx, tag).posted += 1
 
-    def attach(self, token, dest: np.ndarray) -> None:
-        """Give a posted irecv's entry a destination view the reader may
-        steer into.  Only store-destination views qualify (contiguous,
-        writable, filled by a plain assignment at the fold site)."""
+    def note_post_user(self, src, ctx, tag, backlog: int = 0,
+                       claimable: bool = True):
+        """Count a posted USER irecv (``irecv(buf=...)`` / a started
+        ``recv_init`` handle) on its channel, ACTIVATING the channel on
+        first use: from here on the reader counts this channel's fresh
+        frames exactly like an internal channel's.  ``backlog`` is the
+        number of already-delivered (never counted) messages queued for
+        this envelope at activation time — it seeds the pairing lag so
+        the first counted frame pairs with the right consumer even when
+        the sender raced ahead of the first posted buffer.
+        ``claimable=False`` counts a BUFFERLESS user irecv posted on an
+        already-active channel (alignment only — its pool fold is a
+        decision, not a lost race, so it never ticks the fallback
+        pvar); a later :meth:`attach` re-arms it."""
+        with self._lock:
+            ch = self._chan(src, ctx, tag)
+            if not ch.user:
+                ch.user = True
+                ch.lag = backlog
+                self._user_keys.add((src, ctx, tag))
+                self.user_count = len(self._user_keys)
+            ch.posted += 1
+            e = _Entry(ch.posted)
+            e.user = True
+            e.declined = not claimable
+            ch.entries.append(e)
+            return ((src, ctx, tag), e)
+
+    def user_active(self, src, ctx, tag) -> bool:
+        """Whether a user channel was activated (reader counting gate +
+        the blocking-recv note_consume gate).  Callers pre-gate on the
+        bare ``user_count`` int so the common no-user-steering run never
+        pays a lock here."""
+        if not self.user_count:
+            return False
+        return (src, ctx, tag) in self._user_keys
+
+    def note_steal(self, src, ctx, tag) -> None:
+        """A matched probe (mprobe/improbe) REMOVED a message from this
+        envelope's matching queue: later consumers each shift one
+        message earlier, so the pairing lag drops by one.  Best-effort —
+        any residual mispairing is caught by the sanitize/rescue guard,
+        costing a copy, never correctness."""
+        if not self.user_count:
+            return
+        with self._lock:
+            ch = self._ch.get((src, ctx, tag))
+            if ch is not None and ch.user:
+                ch.lag -= 1
+
+    def attach(self, token, dest) -> None:
+        """Give a posted irecv's entry a destination the reader may
+        steer into: a single view (matched against single-array frames)
+        or a LIST of views (matched per-segment against multi-segment
+        frames — the scatter-gather receive, ISSUE 19).  Only
+        store-destination views qualify (contiguous, writable, filled
+        by a plain assignment at the fold site)."""
         _key, e = token
+        if isinstance(dest, list):
+            if not all(isinstance(a, np.ndarray) and a.flags.writeable
+                       and a.flags.c_contiguous for a in dest):
+                with self._lock:
+                    e.declined = True
+                return
+            with self._lock:
+                e.dest = dest
+                e.segs = tuple((a.dtype.str, tuple(a.shape)) for a in dest)
+                e.declined = False
+            return
         if not (dest.flags.writeable and dest.flags.c_contiguous):
             with self._lock:
                 e.declined = True
@@ -250,6 +386,7 @@ class PostedRecvRegistry:
             e.dest = dest
             e.ds = dest.dtype.str
             e.shape = tuple(dest.shape)
+            e.declined = False
 
     def cancel(self, token) -> None:
         """Remove a posted irecv's entry (``_unpost`` / failure paths),
@@ -292,12 +429,14 @@ class PostedRecvRegistry:
                     return None   # replay re-presentation: already counted
                 ch.wm = (gen, seq)
                 ch.arrived += 1
-                j = ch.arrived
+                # user channels: the Nth counted arrival is consumer
+                # N + lag (pre-activation backlog / probe steals)
+                j = ch.arrived + ch.lag
                 q = ch.entries
                 while q and q[0].idx < j:
                     q.popleft()   # stale: their frames already passed
                 steerable = (_STEERING and plan is not None
-                             and plan[0] == "arr")
+                             and plan[0] in ("arr", "segs"))
                 if not q or q[0].idx != j:
                     # no entry for this arrival: a genuine lost race
                     # only when NO consumer was counted yet (posted <
@@ -307,9 +446,8 @@ class PostedRecvRegistry:
                     fold_race = steerable and ch.posted < j
                     return None
                 e = q.popleft()
-                if (e.dest is None or not _STEERING or plan is None
-                        or plan[0] != "arr" or e.ds != plan[1]
-                        or e.shape != tuple(plan[2])):
+                if e.dest is None or not steerable \
+                        or not self._plan_fits(e, plan):
                     # dest-less entry: the irecv was POSTED but its
                     # attach() hadn't landed when the frame arrived —
                     # the other flavor of the same race (unless the
@@ -318,6 +456,16 @@ class PostedRecvRegistry:
                     fold_race = (steerable and e.dest is None
                                  and not e.declined)
                     return None
+                if e.user:
+                    # aliasing guard: the claimed USER view is tracked
+                    # from here until its consumer pops it.  A prior
+                    # lifecycle of the same buffer still open (a broken
+                    # round awaiting its foreign popper) declines the
+                    # claim rather than corrupt the guard.
+                    if id(e.dest) in self._live:
+                        return None
+                    self._live[id(e.dest)] = _LiveSteer(e.dest)
+                    self.live_count = len(self._live)
                 return e.dest
         finally:
             if fold_race:
@@ -328,16 +476,112 @@ class PostedRecvRegistry:
                     rec.emit("recvpool", "fold_fallback",
                              attrs={"src": src, "tag": tag})
 
+    @staticmethod
+    def _plan_fits(e: _Entry, plan) -> bool:
+        """Geometry-exact match of a steerable plan against an entry's
+        attached destination (single view vs "arr", view list vs
+        "segs" — per segment)."""
+        if plan[0] == "arr":
+            return (e.segs is None and e.ds == plan[1]
+                    and e.shape == tuple(plan[2]))
+        if e.segs is None or len(e.segs) != len(plan[1]):
+            return False
+        return all(ds == eds and tuple(shape) == eshape
+                   for (ds, shape), (eds, eshape) in zip(plan[1], e.segs))
+
     def note_local(self, src, ctx, tag) -> None:
         """Count a self-send delivery (value-copy path, never steered) so
         loopback traffic on a registered channel keeps indices aligned."""
         with self._lock:
             ch = self._chan(src, ctx, tag)
             ch.arrived += 1
-            j = ch.arrived
+            j = ch.arrived + ch.lag
             q = ch.entries
             while q and q[0].idx <= j:
                 q.popleft()
+
+    # -- user-buffer aliasing guard (ISSUE 19) ------------------------------
+    #
+    # A USER claim writes frame bytes into a buffer the application
+    # owns, and the mailbox is a scan-queue: a wildcard receive, a
+    # matched probe, or an out-of-order blocking recv can legally pop
+    # the steered view instead of the buffer's own request.  The guard
+    # turns every such mispairing into a copy: the reader brackets the
+    # body read with steer_done/steer_abort, every user-facing
+    # completion runs its payload through sanitize (identity for the
+    # owner, a private copy for anyone else), and an armed owner that
+    # completes WITHOUT its view first rescues the steered bytes
+    # (pre_overwrite) so a later popper still reads the right data.
+    # All transitions serialize on the registry condition variable;
+    # whoever arrives second sees the first's state.
+
+    def steer_done(self, obj) -> None:
+        """Reader: the claimed user destination's body read finished —
+        the view is about to be delivered."""
+        with self._cv:
+            ls = self._live.get(id(obj))
+            if ls is not None and ls.obj is obj:
+                ls.writing = False
+                self._cv.notify_all()
+
+    def steer_abort(self, obj) -> None:
+        """Reader: the body read DIED mid-steer (torn frame / dead
+        peer).  The view never reaches the mailbox; drop its guard so
+        the (partially scribbled) buffer can be re-armed — the owner's
+        completion overwrites the partial bytes on the fallback path."""
+        with self._cv:
+            ls = self._live.get(id(obj))
+            if ls is not None and ls.obj is obj:
+                del self._live[id(obj)]
+                self.live_count = len(self._live)
+            self._cv.notify_all()
+
+    def sanitize(self, value, own=None):
+        """Run a popped user-facing payload through the guard: the
+        owning request (``own is value``) takes its view and closes the
+        lifecycle; any OTHER consumer of a live steered view gets a
+        private copy (or the owner's rescue snapshot), because the
+        owner will overwrite that memory.  Payloads outside the guard
+        pass through untouched — callers pre-gate on ``live_count``."""
+        with self._cv:
+            ls = self._live.get(id(value))
+            if ls is None or ls.obj is not value:
+                return value
+            while ls.writing:
+                self._cv.wait()
+            if own is value:
+                del self._live[id(value)]
+                self.live_count = len(self._live)
+                return value
+            out = ls.rescue if ls.rescue is not None \
+                else _copy_steered(value)
+            ls.sanitized = True
+            if ls.owner_done:
+                del self._live[id(value)]
+                self.live_count = len(self._live)
+            return out
+
+    def pre_overwrite(self, buf) -> None:
+        """An ARMED owner is about to overwrite its registered buffer on
+        the fallback path (its completion payload was not the view).
+        If a claim landed bytes there that some other consumer has yet
+        to pop, snapshot them first (the rescue) — and wait out a
+        reader mid-steer so the snapshot is whole."""
+        if not self.live_count:
+            return
+        with self._cv:
+            ls = self._live.get(id(buf))
+            if ls is None or ls.obj is not buf:
+                return
+            while ls.writing:
+                self._cv.wait()
+            if ls.sanitized:
+                # the foreign popper already took its copy
+                del self._live[id(buf)]
+            else:
+                ls.rescue = _copy_steered(buf)
+                ls.owner_done = True   # entry waits for its popper
+            self.live_count = len(self._live)
 
     def purge_src(self, src, gen: int) -> None:
         """Membership removal of ``src``: its in-flight frames died with
@@ -359,4 +603,6 @@ class PostedRecvRegistry:
                 "entries": sum(len(c.entries) for c in self._ch.values()),
                 "posted": sum(c.posted for c in self._ch.values()),
                 "arrived": sum(c.arrived for c in self._ch.values()),
+                "user_channels": len(self._user_keys),
+                "live_steers": len(self._live),
             }

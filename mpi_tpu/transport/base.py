@@ -186,6 +186,16 @@ class Mailbox:
                                       "probe")
         return s, t, payload_nbytes(p)
 
+    def count_matching(self, source: int, ctx, tag: int) -> int:
+        """Number of queued messages matching (source, ctx, tag) right
+        now — the recv-steering registry's activation BACKLOG: frames
+        delivered before a user channel was activated were never
+        counted, so the first posted user buffer seeds its pairing lag
+        with this count (mpi_tpu/recvpool.py note_post_user)."""
+        with self._lock:
+            return sum(1 for item in self._items
+                       if self._matches(item, source, ctx, tag))
+
     def pending_summary(self) -> List[Tuple[int, int, int]]:
         with self._lock:
             return [(s, c, t) for s, c, t, _ in self._items[:16]]

@@ -54,7 +54,10 @@ from typing import Any, Dict, Optional, Tuple
 
 import numpy as np
 
+from .. import bufpool as _bufpool
 from .. import mpit as _mpit
+from .. import recvpool as _recvpool
+from .. import telemetry as _telemetry
 from ..errors import EpochSkewError
 from ..native import load_shmring
 from . import codec
@@ -137,6 +140,14 @@ class ShmTransport(Transport):
     # carry no name, so chaos legs bypass the table.
     tuning_transport = "shm"
 
+    # Receive-side rendezvous steering (ISSUE 19): the ring drain can
+    # land a large raw frame's body DIRECTLY in a posted receive's
+    # buffer — one ring->destination memcpy, no intermediate array.
+    # Ring frames carry no (gen, seq); the reader synthesizes both
+    # per source (see _read_frame / membership_invalidate) so the
+    # registry's watermark and purge fences carry over unchanged.
+    recv_steering = True
+
     def __init__(self, rank: int, size: int, rdv_dir: str,
                  ring_bytes: int = _RING_BYTES,
                  connect_timeout: float = _OPEN_TIMEOUT,
@@ -154,6 +165,18 @@ class ShmTransport(Transport):
         # a frame half-written — the byte stream is desynced); skipped
         # by _drain_once until an epoch transition recreates the ring
         self._dead_srcs: set = set()
+        # Rendezvous steering (ISSUE 19): the registry the ring drain
+        # consults, plus the per-source synthesized stream position the
+        # registry's watermark is keyed on.  The ring is a reliable
+        # in-order byte stream, so every frame read is by construction
+        # the next fresh frame of the current generation — seq is just
+        # a counter, and gen bumps when membership_invalidate recreates
+        # a slot's ring (fencing old-incarnation pairings exactly like
+        # the socket link's stream generation).  Both dicts are touched
+        # only under the progress lock.
+        self.recv_registry = _recvpool.PostedRecvRegistry()
+        self._rx_seq: Dict[int, int] = {}
+        self._rx_gen: Dict[int, int] = {}
         # consumer side: create my incoming rings + doorbell, then publish
         self._in_rings: Dict[int, int] = {}
         for src in range(size):
@@ -267,6 +290,24 @@ class ShmTransport(Transport):
                     f"rank {self.world_rank}: truncated frame from {src} "
                     f"(no data for {_WRITE_TIMEOUT}s — is the sender alive?)")
 
+    def _note_counted(self, src: int, ctx, tag: int, plan):
+        """Count one in-order ring frame on its steering channel; returns
+        (posted destination to steer into or None, counted?).  The ring
+        delivers reliably in order, so every frame IS the next fresh
+        frame of the current generation — the freshness gate the socket
+        reader gets from ``rx_fresh`` is the ring's structure here.
+        Internal tags always count; user tags only once an
+        ``irecv(buf=...)`` activated the channel (reg.user_active).
+        Caller holds the progress lock (the seq dict is engine state)."""
+        reg = self.recv_registry
+        if tag >= 0 and not (reg.user_count
+                             and reg.user_active(src, ctx, tag)):
+            return None, False
+        seq = self._rx_seq.get(src, 0) + 1
+        self._rx_seq[src] = seq
+        return reg.note_frame(src, ctx, tag, seq,
+                              self._rx_gen.get(src, 0), plan), True
+
     def _read_frame(self, src: int, ring: int) -> Tuple[Any, int, Any]:
         """Read one complete frame (header already known present).
 
@@ -274,7 +315,9 @@ class ShmTransport(Transport):
         calls — header word, then the whole body into one buffer parsed
         host-side — because on the latency path ctypes call overhead
         (~1-3µs each) dwarfs an extra ≤8KB memcpy.  Only large raw frames
-        take the streamed zero-copy read into the final array."""
+        take the streamed zero-copy read into the final array — a POSTED
+        destination when steering pairs one (ring -> the very view the
+        fold site or user owns), else a pooled fallback allocation."""
         hdr = ctypes.create_string_buffer(_LEN.size)
         self._read_exact(ring, ctypes.addressof(hdr), _LEN.size, src)
         (word,) = _LEN.unpack(hdr.raw)
@@ -284,22 +327,69 @@ class ShmTransport(Transport):
                 if body <= _SMALL:
                     buf = ctypes.create_string_buffer(body)
                     self._read_exact(ring, ctypes.addressof(buf), body, src)
-                    return codec.parse_raw_body(buf.raw)
+                    ctx, tag, out = codec.parse_raw_body(buf.raw)
+                    # small frames never steer (the whole-body read
+                    # already happened) but still count, so the
+                    # frame/consumer pairing stays aligned
+                    self._note_counted(src, ctx, tag, None)
+                    return ctx, tag, out
                 mbuf = ctypes.create_string_buffer(codec.META.size)
                 self._read_exact(ring, ctypes.addressof(mbuf),
                                  codec.META.size, src)
                 (mlen,) = codec.META.unpack(mbuf.raw)
                 meta = ctypes.create_string_buffer(mlen)
                 self._read_exact(ring, ctypes.addressof(meta), mlen, src)
-                ctx, tag, out = codec.unpack_raw_meta(meta.raw)
-                dests = codec.raw_destinations(out)
-                total = sum(a.nbytes for a in dests)
+                ctx, tag, plan = codec.parse_raw_meta(meta.raw)
+                total = codec.plan_nbytes(plan)
                 if codec.META.size + mlen + total != body:
                     raise ValueError(
                         f"raw frame length mismatch: header says {body}, "
                         f"meta implies {codec.META.size + mlen + total}")
-                # the single receive-side copy: ring -> final array(s)
-                for a in dests:
+                # steering first refusal: a posted receive of matching
+                # geometry takes the ring bytes DIRECTLY (ISSUE 19 —
+                # the shm edition of the socket reader's rendezvous)
+                out, counted = self._note_counted(src, ctx, tag, plan)
+                rec = _telemetry.REC
+                if out is not None:
+                    dests = codec.raw_destinations(out)
+                    # CoW-protect any retained frame still referencing
+                    # the destination region BEFORE scribbling on it —
+                    # a replay must stay bit-exact (mpi_tpu/bufpool.py)
+                    for a in dests:
+                        _bufpool.touch(a)
+                    try:
+                        # the single receive-side copy: ring -> the
+                        # posted view(s), one streamed read per segment
+                        for a in dests:
+                            if a.nbytes:
+                                self._read_exact(ring, a.ctypes.data,
+                                                 a.nbytes, src)
+                    except TransportError:
+                        # torn mid-steer (peer died / teardown): the
+                        # view never reaches the mailbox — drop the
+                        # user aliasing guard so the buffer can re-arm;
+                        # the owner's fallback refill overwrites any
+                        # partial bytes
+                        if tag >= 0:
+                            self.recv_registry.steer_abort(out)
+                        raise
+                    if tag >= 0:
+                        self.recv_registry.steer_done(out)
+                    _mpit.count(recv_pool_rendezvous=1,
+                                recv_bytes_steered=total)
+                    if rec is not None:
+                        rec.emit("recvpool", "steer",
+                                 attrs={"src": src, "tag": tag,
+                                        "nbytes": total,
+                                        "transport": "shm"})
+                    return ctx, tag, out
+                out = codec.alloc_raw(plan)
+                if counted and plan[0] in ("arr", "segs") \
+                        and rec is not None:
+                    rec.emit("recvpool", "fallback",
+                             attrs={"src": src, "tag": tag,
+                                    "nbytes": total, "transport": "shm"})
+                for a in codec.raw_destinations(out):
                     if a.nbytes:
                         self._read_exact(ring, a.ctypes.data, a.nbytes, src)
                 return ctx, tag, out
@@ -307,6 +397,9 @@ class ShmTransport(Transport):
             if body:
                 self._read_exact(ring, ctypes.addressof(payload), body, src)
             ctx, tag, obj = pickle.loads(payload.raw if body else b"")
+            # pickle frames on counted channels still count (never
+            # steerable) so the frame/consumer pairing stays aligned
+            self._note_counted(src, ctx, tag, None)
             return ctx, tag, obj
         except TransportError:
             raise
@@ -629,6 +722,14 @@ class ShmTransport(Transport):
             raise TransportError(
                 f"rank {self.world_rank}: send on a closed transport")
         if dest == self.world_rank:
+            # count the delivery on its steering channel first: loopback
+            # traffic on a counted envelope consumes posted slots like
+            # any other arrival (its own (self, ctx, tag) channel —
+            # never interleaved with a peer ring's frame order)
+            reg = self.recv_registry
+            if tag < 0 or (reg.user_count
+                           and reg.user_active(dest, ctx, tag)):
+                reg.note_local(dest, ctx, tag)
             self.mailbox.deliver(dest, ctx, tag, codec.value_copy(payload))
             # ring our own bell: a thread parked in _match_loop's
             # doorbell-wait branch (lost the progress-lock race) waits on
@@ -799,6 +900,16 @@ class ShmTransport(Transport):
             if self._closing:
                 return
             for src in dead:
+                # fence the steering registry to the slot's NEXT stream
+                # generation before (re)creating anything: the purged
+                # ring's in-flight frames died with it, and the bumped
+                # gen keeps the replacement's fresh stream from ever
+                # pairing against old-incarnation counts (the shm
+                # edition of the socket link's purge_peer + purge_src)
+                gen = self._rx_gen.get(int(src), 0) + 1
+                self._rx_gen[int(src)] = gen
+                self._rx_seq[int(src)] = 0
+                self.recv_registry.purge_src(int(src), gen)
                 old = self._in_rings.pop(int(src), None)
                 if old is None:
                     continue

@@ -185,6 +185,48 @@ def _recv_into_exact(sock: socket.socket, view: memoryview) -> bool:
     return True
 
 
+_HAS_RECVMSG_INTO = hasattr(socket.socket, "recvmsg_into")
+
+
+def _recvmsg_into_views(sock: socket.socket, views) -> bool:
+    """Fill every view in ``views`` completely with vectored
+    ``recvmsg_into`` — the receive-side mirror of :func:`_sendmsg_views`
+    (ISSUE 19 scatter-gather): one syscall per IOV_MAX batch in the
+    common case, resuming mid-view on partial reads.  Counted in
+    ``link_recv_syscalls``.  False on EOF/error (torn frame)."""
+    views = [memoryview(v).cast("B") for v in views if v.nbytes]
+    if not _HAS_RECVMSG_INTO:  # pragma: no cover - non-recvmsg platform
+        for v in views:
+            if not _recv_into_exact(sock, v):
+                return False
+        return True
+    idx, off = 0, 0
+    n = len(views)
+    while idx < n:
+        if off:
+            batch = [views[idx][off:]]
+            batch.extend(views[idx + 1:idx + _IOV_MAX])
+        else:
+            batch = views[idx:idx + _IOV_MAX]
+        try:
+            got = sock.recvmsg_into(batch)[0]
+        except OSError:
+            return False
+        _mpit.count(link_recv_syscalls=1)
+        if got == 0:
+            return False
+        while got > 0:
+            rem = views[idx].nbytes - off
+            if got < rem:
+                off += got
+                got = 0
+            else:
+                got -= rem
+                idx += 1
+                off = 0
+    return True
+
+
 class SocketTransport(Transport):
     # Loopback/intra-host TCP gets its exchange overlap from the kernel
     # socket buffers; what the engine's segmentation costs it is per-frame
@@ -414,7 +456,13 @@ class SocketTransport(Transport):
                 # (zero intermediate copy; delivery becomes pointer-
                 # passing of the very view the fold site owns).
                 out = None
-                fresh = tag < 0 and self._link.rx_fresh(src, seq, gen)
+                # user channels (ISSUE 19): a frame whose envelope was
+                # activated by an irecv(buf=...) counts exactly like an
+                # internal frame; everything else with tag >= 0 stays
+                # off the registry entirely
+                fresh = (tag < 0 or (reg.user_count
+                                     and reg.user_active(src, ctx, tag))) \
+                    and self._link.rx_fresh(src, seq, gen)
                 if fresh:
                     out = reg.note_frame(src, ctx, tag, seq, gen, plan)
                 rec = _telemetry.REC
@@ -422,16 +470,27 @@ class SocketTransport(Transport):
                     # CoW-protect any retained frame still referencing
                     # the destination region BEFORE scribbling on it —
                     # a replay must stay bit-exact (mpi_tpu/bufpool.py)
-                    _bufpool.touch(out)
-                    if total and not _recv_into_exact(
-                            conn, memoryview(out).cast("B")):
+                    dests = codec.raw_destinations(out)
+                    for arr in dests:
+                        _bufpool.touch(arr)
+                    if len(dests) > 1:
+                        ok = _recvmsg_into_views(conn, dests)
+                    else:
+                        ok = not total or _recv_into_exact(
+                            conn, memoryview(out).cast("B"))
+                    if not ok:
                         # torn mid-steer: the entry is consumed, the
                         # watermark keeps the replay re-presentation
                         # uncounted — it takes the pool path and the
-                        # fold-site store overwrites the partial bytes
+                        # fold-site store (or the user request's
+                        # fallback refill) overwrites the partial bytes
+                        if tag >= 0:
+                            reg.steer_abort(out)
                         self._note_torn(src)
                         conn.close()
                         return
+                    if tag >= 0:
+                        reg.steer_done(out)
                     _mpit.count(recv_pool_rendezvous=1,
                                 recv_bytes_steered=total)
                     if rec is not None:
@@ -440,17 +499,24 @@ class SocketTransport(Transport):
                                         "tag": tag, "nbytes": total})
                 else:
                     out = codec.alloc_raw(plan)
-                    ok = True
-                    for arr in codec.raw_destinations(out):
-                        if arr.nbytes and not _recv_into_exact(
-                                conn, memoryview(arr).cast("B")):
-                            ok = False
-                            break
+                    dests = codec.raw_destinations(out)
+                    if len(dests) > 1:
+                        # scatter-gather across the pooled segments too:
+                        # one vectored read per frame, not per segment
+                        ok = _recvmsg_into_views(conn, dests)
+                    else:
+                        ok = True
+                        for arr in dests:
+                            if arr.nbytes and not _recv_into_exact(
+                                    conn, memoryview(arr).cast("B")):
+                                ok = False
+                                break
                     if not ok:
                         self._note_torn(src)
                         conn.close()
                         return
-                    if fresh and plan[0] == "arr" and rec is not None:
+                    if fresh and plan[0] in ("arr", "segs") \
+                            and rec is not None:
                         rec.emit("recvpool", "fallback",
                                  attrs={"src": src, "seq": seq,
                                         "tag": tag, "nbytes": total})
@@ -462,8 +528,10 @@ class SocketTransport(Transport):
                 conn.close()
                 return
             ctx, tag, obj = pickle.loads(payload)
-            if tag < 0 and self._link.rx_fresh(src, seq, gen):
-                # pickle frames on internal channels still count (never
+            if (tag < 0 or (reg.user_count
+                            and reg.user_active(src, ctx, tag))) \
+                    and self._link.rx_fresh(src, seq, gen):
+                # pickle frames on counted channels still count (never
                 # steerable) so the frame/consumer pairing stays aligned
                 reg.note_frame(src, ctx, tag, seq, gen, None)
             self._deliver_seq(conn, src, seq, ctx, tag, obj, gen)
@@ -935,8 +1003,10 @@ class SocketTransport(Transport):
             # traffic on an internal tag consumes posted slots like any
             # other arrival (its own (self, ctx, tag) channel — never
             # interleaved with a peer's sequenced stream)
-            if tag < 0:
-                self.recv_registry.note_local(dest, ctx, tag)
+            reg = self.recv_registry
+            if tag < 0 or (reg.user_count
+                           and reg.user_active(dest, ctx, tag)):
+                reg.note_local(dest, ctx, tag)
             self.mailbox.deliver(dest, ctx, tag, codec.value_copy(payload))
             return
         frame = codec.pack_raw_frame(ctx, tag, payload)

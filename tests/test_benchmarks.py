@@ -336,6 +336,41 @@ def test_hotpath_quick_smoke():
     assert lease["coll_sm_hits_first"] > 0 and lease["arena_reused"]
 
 
+def test_recvpool_shm_quick_smoke():
+    """The zero-copy-everywhere band end to end in --quick mode (the
+    ``bench.py --recvpool --shm --quick`` CI spelling): the pvar-carrying
+    ``steer`` bench on BOTH host transports with steering on.  The
+    structural acceptance rides the row pvars: the user-buffer
+    rendezvous legs land IN PLACE (post-before-send handshake makes the
+    match deterministic — zero pool fallbacks), the scatter-gather leg
+    on socket reads multi-segment frames with vectored syscalls, and
+    no leg anywhere pays a pool-stage payload copy."""
+    from benchmarks import host_sweep
+
+    result = host_sweep.run_recvpool_shm_sweep("post", quick=True)
+    assert result["quick"] and result["nranks"] == 2
+    rows = [r for r in result["recvpool_shm_rows"] if "p50_us" in r]
+    assert {(r["backend"], r["leg"]) for r in rows} == {
+        (b, leg) for b in ("socket", "shm")
+        for leg in ("allreduce_ring", "user_irecv", "scatter_gather")}
+    for r in rows:
+        assert r["bench"] == "steer" and r["recv_steering"] == 1
+        assert r["p50_us"] > 0 and np.isfinite(r["p50_us"])
+        pv = r["pvars"]
+        assert pv["payload_copies"] == 0, r
+        if r["leg"] == "user_irecv":
+            assert pv["recv_user_inplace"] >= 1, r
+            assert pv["recv_user_fallbacks"] == 0, r
+            assert pv["recv_bytes_steered"] >= r["bytes"], r
+        if r["leg"] == "scatter_gather":
+            assert pv["recv_user_inplace"] >= 1, r
+            assert pv["recv_bytes_steered"] >= r["bytes"], r
+            if r["backend"] == "socket":
+                assert pv["link_recv_syscalls"] >= 1, r
+        if r["leg"] == "allreduce_ring":
+            assert pv["recv_bytes_steered"] > 0, r
+
+
 def test_serve_bench_quick_smoke():
     """The world-churn harness end to end in --quick mode (the
     ``bench.py --serve-bench --quick`` CI spelling): cold launch() vs

@@ -263,6 +263,67 @@ def test_filestore_version_gc_truncates_but_never_recycles(tmp_path):
     assert st.get("k").value == {"n": 39}
 
 
+def test_filestore_tombstone_gc_interrupted_never_resurrects(
+        tmp_path, monkeypatch):
+    """The delete/recreate window (ISSUE 19 satellite): tombstone GC
+    unlinks the chain ASCENDING, so a GC that dies mid-walk removes
+    stale predecessors first and the tombstone LAST — an interrupted
+    collection leaves the key visibly dead instead of resurrecting the
+    pre-delete value, and the recreate wins a slot above every prior
+    name."""
+    import json
+
+    st = fstore.FileStore(str(tmp_path))
+    rec = st.cas("k", None, {"n": 0})                     # v1
+    rec = st.cas("k", rec.ver, {"n": 1})                  # v2
+    assert st.delete("k", rec.ver)                        # v3 tombstone
+    # backdate the tombstone past the GC horizon
+    p3 = os.path.join(str(tmp_path), "k.v3.json")
+    with open(p3) as f:
+        w = json.load(f)
+    w["stamp"] = time.time() - 3600.0
+    with open(p3, "w") as f:
+        json.dump(w, f)
+    # simulated mid-GC crash: exactly one unlink lands
+    real_unlink, calls = os.unlink, []
+
+    def partial_unlink(path):
+        if not calls:
+            calls.append(path)
+            real_unlink(path)
+
+    monkeypatch.setattr(os, "unlink", partial_unlink)
+    st.scan("")                                           # triggers GC
+    monkeypatch.undo()
+    # ascending: the ONE unlink that landed was the oldest slot, never
+    # the tombstone — the key is still dead, not resurrected to {"n":1}
+    assert calls and calls[0].endswith("k.v1.json")
+    assert st.get("k") is None
+    # recreate inside the window: wins, above every prior slot
+    rec = st.cas("k", None, {"n": 9})
+    assert rec is not None and rec.ver == 4
+    assert st.get("k").value == {"n": 9}
+
+
+def test_filestore_recreate_in_gc_window_tops_every_stale_slot(tmp_path):
+    """Post-partial-GC residue: only truncated placeholders remain
+    (nothing parseable).  The recreate must neither EEXIST-fail against
+    a leftover name nor recycle one — the epoch check starts the new
+    chain ABOVE the highest stale slot number."""
+    st = fstore.FileStore(str(tmp_path))
+    rec = st.cas("k", None, {"n": 0})                     # v1
+    rec = st.cas("k", rec.ver, {"n": 1})                  # v2
+    rec = st.cas("k", rec.ver, {"n": 2})                  # v3; v1 truncated
+    assert st.delete("k", rec.ver)                        # v4; v2 truncated
+    # GC collected the tombstone and the fallback, then died: the
+    # empty placeholders v1/v2 are still on disk
+    for v in (4, 3):
+        os.unlink(os.path.join(str(tmp_path), f"k.v{v}.json"))
+    rec = st.cas("k", None, {"n": 9})
+    assert rec is not None and rec.ver == 3               # tops slot 2
+    assert st.get("k").value == {"n": 9}
+
+
 # -- RaftStore: replication-specific legs -------------------------------------
 
 

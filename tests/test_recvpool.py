@@ -626,6 +626,49 @@ def test_persistent_fence_silent_when_contract_followed():
                      timeout=60.0) == [2.0, 2.0]
 
 
+def test_persistent_reduce_scatter_refires_on_preallocated_buffers():
+    """ISSUE 19 satellite: the double-buffered re-fire extends to
+    reduce_scatter_init on the engine's span path — round k's result is
+    a VIEW of preallocated buffer k % 2 (no per-round allocation), so
+    rounds two apart share backing memory."""
+    from mpi_tpu.transport.local import run_local
+
+    def prog(comm):
+        blocks = [np.full(8, float(comm.rank + 1)) for _ in range(2)]
+        h = comm.reduce_scatter_init(blocks)
+        r0 = np.asarray(h.start().wait())
+        np.testing.assert_array_equal(r0, np.full(8, 3.0))
+        h.start().wait()
+        r2 = np.asarray(h.start().wait())
+        return bool(np.shares_memory(r0, r2)), float(r2[0])
+
+    assert run_local(prog, 2, progress="thread",
+                     timeout=60.0) == [(True, 3.0)] * 2
+
+
+def test_persistent_reduce_scatter_fence_trips_like_allreduce():
+    """The BufferPinnedError fence covers the extended path: holding
+    round k's reduce_scatter block across two later starts raises the
+    named error instead of silently overwriting it."""
+    from mpi_tpu.errors import BufferPinnedError
+    from mpi_tpu.transport.local import run_local
+
+    def prog(comm):
+        blocks = [np.ones(8) for _ in range(2)]
+        h = comm.reduce_scatter_init(blocks)
+        r0 = h.start().wait()                # round 0 block, kept alive
+        h.start().wait()
+        try:
+            h.start().wait()
+        except BufferPinnedError as e:
+            return ("fenced", "copy it first" in str(e),
+                    float(np.asarray(r0)[0]))
+        return ("missed", False, 0.0)
+
+    res = run_local(prog, 2, verify=True, progress="thread", timeout=60.0)
+    assert res == [("fenced", True, 2.0)] * 2
+
+
 def test_persistent_fence_off_without_verify():
     """The fence is verify-gated: the documented overwrite behavior is
     unchanged in normal runs (round k's array IS buffer k % 2)."""

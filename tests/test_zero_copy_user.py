@@ -1,0 +1,346 @@
+"""Zero-copy everywhere (ISSUE 19): shm ring steering, user-buffer
+rendezvous (``irecv(buf=...)``), and scatter-gather receives.
+
+World-level legs run the real transports through the thread harnesses
+(``run_socket_world`` / ``run_shm_world``) and assert the pvar deltas
+the acceptance criteria name: ``recv_bytes_steered`` > 0 on shm with
+``payload_copies`` at the arena-only floor, ``recv_user_inplace``
+ticking with ZERO pool stores on the steered user path, and the named
+``recv_user_fallbacks`` pool fallback whenever the match races the
+reader (including across an shm membership purge — no cross-generation
+byte may land in a user buffer through a stale claim).
+
+Registry unit tests pin the user-channel pairing algebra: activation
+backlog seeds the lag, probe steals decrement it, ``claimable=False``
+posts decline without polluting the fold-race pvar, and the aliasing
+guard (sanitize / pre_overwrite / steer_abort) turns every mispairing
+into a copy, never corruption.
+"""
+
+import os
+import sys
+import threading
+
+import numpy as np
+
+from mpi_tpu import mpit, ops
+from mpi_tpu.recvpool import PostedRecvRegistry
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from test_resilience import run_socket_world  # noqa: E402
+from test_shm_backend import run_shm_world    # noqa: E402
+
+SRC, CTX = 1, ("c", 0)
+
+_NAMES = ("recv_user_inplace", "recv_user_fallbacks", "recv_bytes_steered",
+          "recv_pool_rendezvous", "recv_pool_hits", "recv_pool_misses",
+          "payload_copies", "link_recv_syscalls")
+
+
+def _deltas(runner, prog, nranks, **kw):
+    base = {n: mpit.pvar_read(n) for n in _NAMES}
+    res = runner(prog, nranks, **kw)
+    return res, {n: mpit.pvar_read(n) - base[n] for n in _NAMES}
+
+
+def _plan(shape, ds="<f8"):
+    return ("arr", ds, tuple(shape))
+
+
+# -- shm acceptance: the 16MB ring allreduce ----------------------------------
+
+
+def test_shm_16mb_allreduce_steers_to_the_arena_only_floor():
+    """The shm edition of the socket acceptance leg: steering off, the
+    ring drain pool-stages every body and each fold-site store is
+    priced into ``payload_copies``; steering on, the drain consults the
+    same posted-recv registry and copies each in-order frame ONCE from
+    the ring directly into its destination span."""
+    data = [np.random.RandomState(i).randn(1 << 21) for i in range(2)]  # 16MB
+    want = data[0] + data[1]
+
+    def prog(comm):
+        out = comm.allreduce(data[comm.rank], ops.SUM)
+        np.testing.assert_allclose(out, want)
+        return True
+
+    old = mpit.cvar_read("recv_steering")
+    try:
+        mpit.cvar_write("recv_steering", 0)
+        res, off = _deltas(run_shm_world, prog, 2)
+        assert all(res)
+        mpit.cvar_write("recv_steering", 1)
+        res, on = _deltas(run_shm_world, prog, 2)
+        assert all(res)
+    finally:
+        mpit.cvar_write("recv_steering", old)
+    # off: every received span is a priced store (the shm ring's 256KB
+    # segments sit BELOW the pool's 1MB class floor, so unlike the 4MB
+    # socket segments they allocate plain — no hit/miss tick)
+    assert off["recv_bytes_steered"] == 0
+    assert off["payload_copies"] >= 2
+    # on: stores leave the copy counter, bytes land straight in spans
+    assert on["payload_copies"] == 0
+    assert on["recv_pool_rendezvous"] > 0
+    assert on["recv_bytes_steered"] >= 4 << 20
+
+
+# -- user-buffer rendezvous: irecv(buf=...) -----------------------------------
+
+
+def _user_inplace_prog(payload, tag):
+    """Receiver posts BEFORE the sender fires (tag-99 handshake), so the
+    posted entry provably precedes the frame and the steer must win."""
+
+    def prog(comm):
+        if comm.rank == 0:
+            comm.recv(1, 99)
+            comm.send(payload, dest=1, tag=tag)
+            return True
+        buf = np.zeros_like(payload)
+        req = comm.irecv(0, tag, buf=buf)
+        comm.send(b"posted", dest=0, tag=99)
+        got = req.wait()
+        assert got is buf, "user rendezvous did not deliver in place"
+        np.testing.assert_array_equal(buf, payload)
+        return True
+
+    return prog
+
+
+def test_user_irecv_lands_in_place_on_socket():
+    payload = np.random.RandomState(7).randn(1 << 17)
+    res, d = _deltas(run_socket_world, _user_inplace_prog(payload, 21), 2)
+    assert all(res)
+    assert d["recv_user_inplace"] == 1 and d["recv_user_fallbacks"] == 0
+    assert d["recv_bytes_steered"] >= payload.nbytes
+    # zero pool stores on the steered path (handshake frames are pickled)
+    assert d["recv_pool_hits"] + d["recv_pool_misses"] == 0
+
+
+def test_user_irecv_lands_in_place_on_shm():
+    payload = np.random.RandomState(8).randn(1 << 17)
+    res, d = _deltas(run_shm_world, _user_inplace_prog(payload, 22), 2)
+    assert all(res)
+    assert d["recv_user_inplace"] == 1 and d["recv_user_fallbacks"] == 0
+    assert d["recv_bytes_steered"] >= payload.nbytes
+    assert d["recv_pool_hits"] + d["recv_pool_misses"] == 0
+
+
+def test_recv_init_user_buffer_refires_in_place():
+    """Persistent-recv handles re-arm the SAME buffer every start():
+    each round's frame steers into it with no per-round allocation."""
+    rounds = 3
+    payloads = [np.random.RandomState(30 + i).randn(1 << 14)
+                for i in range(rounds)]
+
+    def prog(comm):
+        if comm.rank == 0:
+            comm.recv(1, 99)
+            for p in payloads:
+                comm.send(p, dest=1, tag=23)
+            return True
+        buf = np.zeros(1 << 14)
+        h = comm.recv_init(0, 23, buf=buf)
+        comm.send(b"armed", dest=0, tag=99)
+        for p in payloads:
+            got = h.start().wait()
+            np.testing.assert_array_equal(np.asarray(got), p)
+            np.testing.assert_array_equal(buf, p)
+        return True
+
+    res, d = _deltas(run_socket_world, prog, 2)
+    assert all(res)
+    assert d["recv_user_inplace"] >= 1
+
+
+# -- scatter-gather: multi-segment frames into a view list --------------------
+
+
+def _sg_prog(segs, tag):
+    def prog(comm):
+        if comm.rank == 0:
+            comm.recv(1, 99)
+            comm.send(list(segs), dest=1, tag=tag)
+            return True
+        bufs = [np.zeros_like(s) for s in segs]
+        req = comm.irecv(0, tag, buf=bufs)
+        comm.send(b"posted", dest=0, tag=99)
+        got = req.wait()
+        assert got is bufs, "multi-segment frame did not steer per-segment"
+        for b, s in zip(bufs, segs):
+            np.testing.assert_array_equal(b, s)
+        return True
+
+    return prog
+
+
+def test_scatter_gather_irecv_on_socket_uses_vectored_reads():
+    segs = (np.random.RandomState(1).randn(1 << 15),
+            np.random.RandomState(2).randn(1 << 14),
+            np.random.RandomState(3).randn(1 << 13))
+    res, d = _deltas(run_socket_world, _sg_prog(segs, 31), 2)
+    assert all(res)
+    assert d["recv_user_inplace"] == 1 and d["recv_user_fallbacks"] == 0
+    assert d["recv_bytes_steered"] == sum(s.nbytes for s in segs)
+    # the segments arrived through recvmsg_into, not one read per view
+    assert d["link_recv_syscalls"] >= 1
+
+
+def test_scatter_gather_irecv_on_shm():
+    segs = (np.random.RandomState(4).randn(1 << 15),
+            np.random.RandomState(5).randn(1 << 14))
+    res, d = _deltas(run_shm_world, _sg_prog(segs, 32), 2)
+    assert all(res)
+    assert d["recv_user_inplace"] == 1 and d["recv_user_fallbacks"] == 0
+    assert d["recv_bytes_steered"] == sum(s.nbytes for s in segs)
+
+
+# -- fallbacks: the match racing the reader -----------------------------------
+
+
+def test_user_irecv_beaten_by_frame_takes_pool_path():
+    """The frame is already QUEUED when the irecv posts (tag-12 sentinel
+    rides the same FIFO link, so delivery order is deterministic): the
+    activation backlog keeps the pairing aligned, the steer never
+    happens, and the completion falls back to one sanctioned copy into
+    the user's buffer with the named pvar ticking."""
+    payload = np.random.RandomState(9).randn(1 << 14)
+
+    def prog(comm):
+        if comm.rank == 0:
+            comm.send(payload, dest=1, tag=41)
+            comm.send(b"sent", dest=1, tag=42)
+            return True
+        comm.recv(0, 42)              # tag-41 frame is now in the mailbox
+        buf = np.zeros_like(payload)
+        got = comm.irecv(0, 41, buf=buf).wait()
+        assert got is not buf         # pool path, then copied in
+        np.testing.assert_array_equal(buf, payload)
+        np.testing.assert_array_equal(np.asarray(got), payload)
+        return True
+
+    res, d = _deltas(run_socket_world, prog, 2)
+    assert all(res)
+    assert d["recv_user_fallbacks"] == 1 and d["recv_user_inplace"] == 0
+
+
+def test_shm_purge_fences_user_buffer_across_generations():
+    """Membership purge/rejoin with a user buffer armed: the purge
+    clears the posted entry and fences the watermark to the bumped
+    generation, so the post-heal frame can never claim the stale entry
+    — it takes the pool path (fallback pvar) and the buffer ends with
+    exactly the new-generation bytes, placed by the completion copy,
+    not by a cross-generation steer."""
+    payload = np.random.RandomState(11).randn(1 << 14)
+    bar = threading.Barrier(2)
+
+    def prog(comm):
+        if comm.rank == 0:
+            bar.wait()                              # peer armed its buf
+            comm._t.membership_invalidate([1])      # symmetric link flap
+            bar.wait()
+            comm.send(payload, dest=1, tag=51)
+            return True
+        buf = np.zeros_like(payload)
+        req = comm.irecv(0, 51, buf=buf)
+        bar.wait()
+        comm._t.membership_invalidate([0])          # purge + ring recreate
+        bar.wait()
+        got = req.wait()
+        assert got is not buf
+        np.testing.assert_array_equal(buf, payload)
+        np.testing.assert_array_equal(np.asarray(got), payload)
+        return True
+
+    res, d = _deltas(run_shm_world, prog, 2)
+    assert all(res)
+    assert d["recv_user_fallbacks"] == 1 and d["recv_user_inplace"] == 0
+
+
+# -- registry unit tests: user-channel pairing algebra ------------------------
+
+
+def test_backlog_seeds_lag_so_queued_frames_skip_the_first_post():
+    """A pre-activation mailbox backlog of 1 means consumer #1 will pop
+    the queued (uncounted) message: the first COUNTED frame must pair
+    with consumer #2, never scribble consumer #1's buffer."""
+    reg = PostedRecvRegistry()
+    d1, d2 = np.zeros(4), np.zeros(4)
+    t1 = reg.note_post_user(SRC, CTX, 5, backlog=1)
+    reg.attach(t1, d1)
+    t2 = reg.note_post_user(SRC, CTX, 5)
+    reg.attach(t2, d2)
+    got = reg.note_frame(SRC, CTX, 5, 1, 0, _plan((4,)))
+    assert got is d2
+    reg.steer_done(d2)
+    assert reg.sanitize(d2, d2) is d2   # owner pop closes the lifecycle
+
+
+def test_probe_steal_shifts_pairing_back_by_one():
+    """A matched probe popped frame N: its consumer is still waiting, so
+    frame N+1 belongs to it (no entry left -> pool path, a copy), and
+    frame N+2 pairs with the NEXT posted entry."""
+    reg = PostedRecvRegistry()
+    d1, d2 = np.zeros(4), np.zeros(4)
+    t1 = reg.note_post_user(SRC, CTX, 6)
+    reg.attach(t1, d1)
+    assert reg.note_frame(SRC, CTX, 6, 1, 0, _plan((4,))) is d1
+    reg.steer_done(d1)
+    assert reg.sanitize(d1) is not d1   # the probe's pop: a private copy
+    reg.note_steal(SRC, CTX, 6)
+    t2 = reg.note_post_user(SRC, CTX, 6)
+    reg.attach(t2, d2)
+    # frame 2 re-pairs with consumer 1 (entry gone -> pool, copy only)
+    assert reg.note_frame(SRC, CTX, 6, 2, 0, _plan((4,))) is None
+    # frame 3 pairs with consumer 2's entry
+    assert reg.note_frame(SRC, CTX, 6, 3, 0, _plan((4,))) is d2
+
+
+def test_unclaimable_post_declines_without_a_fold_race_tick():
+    """A bufferless user irecv on an active channel is a DECISION, not
+    a race: its frame folds through the pool silently."""
+    reg = PostedRecvRegistry()
+    reg.attach(reg.note_post_user(SRC, CTX, 7), np.zeros(4))  # activate
+    reg.note_frame(SRC, CTX, 7, 1, 0, _plan((4,)))
+    tok = reg.note_post_user(SRC, CTX, 7, claimable=False)
+    c0 = mpit.pvar_read("recv_pool_fold_fallbacks")
+    assert reg.note_frame(SRC, CTX, 7, 2, 0, _plan((4,))) is None
+    assert mpit.pvar_read("recv_pool_fold_fallbacks") == c0
+    # a later attach on the same token re-arms the entry
+    d = np.zeros(4)
+    tok2 = reg.note_post_user(SRC, CTX, 7, claimable=False)
+    reg.attach(tok2, d)
+    assert reg.note_frame(SRC, CTX, 7, 3, 0, _plan((4,))) is d
+    reg.steer_done(d)
+    reg.cancel(tok)
+
+
+def test_pre_overwrite_rescues_steered_bytes_for_the_foreign_popper():
+    """Owner completes through the fallback while its steered view is
+    still queued for someone else: the rescue snapshot preserves the
+    frame's bytes across the owner's overwrite."""
+    reg = PostedRecvRegistry()
+    d = np.zeros(4)
+    tok = reg.note_post_user(SRC, CTX, 8)
+    reg.attach(tok, d)
+    assert reg.note_frame(SRC, CTX, 8, 1, 0, _plan((4,))) is d
+    d[:] = [1.0, 2.0, 3.0, 4.0]        # the frame's bytes
+    reg.steer_done(d)
+    reg.pre_overwrite(d)               # owner takes the fallback path
+    d[:] = 9.0                         # ...and overwrites its buffer
+    out = reg.sanitize(d)              # the foreign popper arrives late
+    assert out is not d
+    np.testing.assert_array_equal(out, [1.0, 2.0, 3.0, 4.0])
+    assert reg.live_count == 0         # lifecycle closed
+
+
+def test_steer_abort_drops_the_guard_without_a_copy():
+    reg = PostedRecvRegistry()
+    d = np.zeros(4)
+    tok = reg.note_post_user(SRC, CTX, 9)
+    reg.attach(tok, d)
+    assert reg.note_frame(SRC, CTX, 9, 1, 0, _plan((4,))) is d
+    reg.steer_abort(d)                 # torn frame: view never delivered
+    assert reg.live_count == 0
+    assert reg.sanitize(d) is d        # outside the guard: identity
