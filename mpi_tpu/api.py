@@ -326,21 +326,36 @@ def MPI_Waitany(requests):
 
     if not requests:
         raise ValueError("MPI_Waitany needs at least one request")
-    delay = 0.0
-    while True:
-        live = False
-        for i, r in enumerate(requests):
-            if _retired(r):
-                continue
-            live = True
-            done, value = r.test()
-            if done:
-                r._retired = True
-                return i, value
-        if not live:
-            return None, None  # MPI_UNDEFINED: no active requests left
-        _time.sleep(delay)
-        delay = min(0.001, delay + 0.0001)
+    # Scope the progress engine's stalled-poll publication to THIS
+    # call's request list: when the verifier publishes on the drain
+    # loop's behalf, the OR-set names exactly these requests' pending
+    # sources, not the union over every posted request in the world.
+    eng = None
+    for r in requests:
+        c = getattr(r, "_comm", None)
+        if c is not None:
+            eng = getattr(c._t, "_progress_engine", None)
+            break
+    prev_scope = eng.enter_poll_scope(requests) if eng is not None else None
+    try:
+        delay = 0.0
+        while True:
+            live = False
+            for i, r in enumerate(requests):
+                if _retired(r):
+                    continue
+                live = True
+                done, value = r.test()
+                if done:
+                    r._retired = True
+                    return i, value
+            if not live:
+                return None, None  # MPI_UNDEFINED: no active requests
+            _time.sleep(delay)
+            delay = min(0.001, delay + 0.0001)
+    finally:
+        if eng is not None:
+            eng.exit_poll_scope(prev_scope)
 
 
 def MPI_Waitsome(requests):

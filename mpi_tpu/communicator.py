@@ -643,6 +643,15 @@ class _RecvRequest(Request):
     def _poll_once(self):
         src_world = (ANY_SOURCE if self._source == ANY_SOURCE
                      else self._comm._world(self._source))
+        if src_world == ANY_SOURCE and self._tag >= -1 \
+                and self._comm._verify is not None:
+            # wildcard irecv: attribute any race the consume scan finds
+            # to the posting site (the consuming thread may be the
+            # progress engine, whose own frames are meaningless here)
+            vc = getattr(self._comm._t, "verify_clock", None)
+            if vc is not None:
+                vi = self._vinfo
+                vc.set_site(vi.site if vi is not None else "<irecv>")
         return self._comm._t.poll(src_world, self._comm._ctx, self._tag)
 
     def wait(self) -> Any:
@@ -1390,6 +1399,14 @@ class P2PCommunicator(Communicator):
             # runs in _RecvRequest._complete instead.
             reg.note_consume(src_world, self._ctx, tag)
             counted = True
+        if self._verify is not None and src_world == ANY_SOURCE and tag >= -1:
+            # wildcard-race attribution: the consume scan merges clocks
+            # under the mailbox lock and cannot walk user frames there,
+            # so the receive records its own call site first
+            vc = getattr(self._t, "verify_clock", None)
+            if vc is not None:
+                from .verify.state import user_site
+                vc.set_site(user_site())
         if self._ft is not None or self._verify is not None:
             obj, src, t = self._sliced_wait(src_world, tag)
         else:

@@ -46,6 +46,7 @@ class _Counters:
                  "sm_hits", "sm_bytes", "sm_fallbacks",
                  "v_deadlocks", "v_mismatches", "v_leaked", "v_double_waits",
                  "v_buf_overlaps", "v_comms_unfreed",
+                 "v_wildcard_races", "v_clock_bytes",
                  "prog_wakeups", "prog_completions", "prog_idle_parks",
                  "rejoins", "epoch_skews",
                  "comp_saved", "comp_fallbacks",
@@ -83,6 +84,8 @@ class _Counters:
         self.v_double_waits = 0
         self.v_buf_overlaps = 0
         self.v_comms_unfreed = 0
+        self.v_wildcard_races = 0
+        self.v_clock_bytes = 0
         self.prog_wakeups = 0
         self.prog_completions = 0
         self.prog_idle_parks = 0
@@ -131,6 +134,8 @@ def count(sends: int = 0, send_bytes: int = 0, recvs: int = 0,
           verify_requests_leaked: int = 0, verify_double_waits: int = 0,
           verify_buffer_overlaps: int = 0,
           verify_comms_unfreed: int = 0,
+          verify_wildcard_races: int = 0,
+          verify_clock_bytes: int = 0,
           progress_wakeups: int = 0, progress_completions: int = 0,
           progress_idle_parks: int = 0,
           rejoins: int = 0, epoch_skews: int = 0,
@@ -187,6 +192,8 @@ def count(sends: int = 0, send_bytes: int = 0, recvs: int = 0,
         counters.v_double_waits += verify_double_waits
         counters.v_buf_overlaps += verify_buffer_overlaps
         counters.v_comms_unfreed += verify_comms_unfreed
+        counters.v_wildcard_races += verify_wildcard_races
+        counters.v_clock_bytes += verify_clock_bytes
         counters.prog_wakeups += progress_wakeups
         counters.prog_completions += progress_completions
         counters.prog_idle_parks += progress_idle_parks
@@ -276,6 +283,15 @@ _PVARS: Dict[str, Callable[[], int]] = {
     "verify_double_waits": lambda: counters.v_double_waits,
     "verify_buffer_overlaps": lambda: counters.v_buf_overlaps,
     "verify_comms_unfreed": lambda: counters.v_comms_unfreed,
+    # wildcard-race detector (mpi_tpu/verify/vclock.py): ANY_SOURCE
+    # receives whose consumed message was CONCURRENT (no happens-before
+    # edge, per the piggybacked vector clocks) with another eligible
+    # pending sender — the nondeterministic match MPL009 flags
+    # statically, observed at runtime; and the clock bytes piggybacked
+    # on frames to prove it.  Both exactly 0 outside verify mode (the
+    # off-mode zero-cost contract).
+    "verify_wildcard_races": lambda: counters.v_wildcard_races,
+    "verify_clock_bytes": lambda: counters.v_clock_bytes,
     # async progress engine (mpi_tpu/progress.py): engine-thread wakeups
     # (the added cost the ``progress`` cvar prices), nonblocking
     # requests completed in the BACKGROUND (by the engine rather than a

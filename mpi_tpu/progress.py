@@ -157,6 +157,14 @@ class ProgressEngine:
         # episode must never keep a completed request alive)
         self._last_empty_poll = 0.0
         self._poll_req: Optional["weakref.ref"] = None
+        # Explicit drain-loop scope (MPI_Waitany / MPI_Waitsome): the
+        # exact request set the caller's poll loop is spinning over.
+        # When set, stalled-poll publication names THIS set's pending
+        # sources — never the union over every tracked request in the
+        # world (which would accuse ranks the drain loop isn't even
+        # waiting for).  A tuple snapshot, not the caller's list: the
+        # caller may mutate its list while the engine reads.
+        self._poll_scope: Optional[tuple] = None
         self._episode_start: Optional[float] = None
         self._episode_block = 0
         self._published = False
@@ -191,6 +199,20 @@ class ProgressEngine:
         evidence at all and escape publication entirely."""
         self._last_empty_poll = time.monotonic()
         self._poll_req = None if req is None else weakref.ref(req)
+
+    def enter_poll_scope(self, requests):
+        """Scope stalled-poll publication to ONE drain call's request
+        set (MPI_Waitany/Waitsome).  While a scope is installed, a
+        published 'waitany-poll' entry's OR-set is computed from these
+        requests only — their exact pending sources — instead of the
+        union over every tracked request in the world.  Returns the
+        previous scope so nested drains restore it (try/finally)."""
+        prev = self._poll_scope
+        self._poll_scope = tuple(requests)
+        return prev
+
+    def exit_poll_scope(self, prev) -> None:
+        self._poll_scope = prev
 
     def check_error(self) -> None:
         if self.pending_error is not None:
@@ -330,38 +352,81 @@ class ProgressEngine:
             # single polls)
             self._end_episode(vw)
             return
-        # the freshest poll's own request, when it is a schedule state
-        # machine (mpi_tpu/nbc.py): publish THAT call's exact pending
-        # OR-set — its internal receives are untracked, so the union
-        # below can neither see them nor narrow to them
+        # Precedence of pending-set evidence (most exact first):
+        # 1. an installed poll scope (MPI_Waitany's own request list) —
+        #    the drain loop told us exactly what it is spinning on;
+        # 2. the freshest poll's own request when it is a schedule state
+        #    machine (mpi_tpu/nbc.py) — that call's exact pending
+        #    OR-set, whose internal receives are untracked below;
+        # 3. the union over all tracked posted requests (the legacy
+        #    conservative fallback for anonymous polling loops).
         sm = None
-        ref = self._poll_req
-        if ref is not None:
-            cand = ref()
-            if (cand is not None and not cand._done
-                    and cand._error is None):
-                sm = cand
+        scope_info = None
+        scope = self._poll_scope
+        if scope is not None:
+            with self.cv:  # serialize _done reads with completion
+                live = [r for r in scope
+                        if not getattr(r, "_retired", False)
+                        and not getattr(r, "_done", False)
+                        and getattr(r, "_error", None) is None]
+                scope_targets = set()
+                for r in live:
+                    if hasattr(r, "_pending_world_srcs"):
+                        scope_targets.update(r._pending_world_srcs())
+                    elif hasattr(r, "_source"):
+                        c = r._comm
+                        if r._source == ANY_SOURCE:
+                            scope_targets.update(
+                                w for w in c._group
+                                if w != c._t.world_rank)
+                        else:
+                            scope_targets.add(c._world(r._source))
+            if not scope_targets:
+                self._end_episode(vw)
+                return
+            scope_info = (live, scope_targets)
+        if scope_info is None:
+            ref = self._poll_req
+            if ref is not None:
+                cand = ref()
+                if (cand is not None and not cand._done
+                        and cand._error is None):
+                    sm = cand
+                else:
+                    self._poll_req = None
+            if sm is not None:
+                with self.cv:  # serialize the _done reads with completion
+                    sm_targets = sm._pending_world_srcs()
+                if not sm_targets:
+                    self._end_episode(vw)
+                    return
             else:
-                self._poll_req = None
-        if sm is not None:
-            with self.cv:  # serialize the _done reads with completion
-                sm_targets = sm._pending_world_srcs()
-            if not sm_targets:
-                self._end_episode(vw)
-                return
-        else:
-            with self.cv:
-                pending = self._pending_tracked()
-            if not pending:
-                self._end_episode(vw)
-                return
+                with self.cv:
+                    pending = self._pending_tracked()
+                if not pending:
+                    self._end_episode(vw)
+                    return
         if self._episode_start is None:
             self._episode_start = now
             self._episode_block = vw.begin_block()
             return
         if now - self._episode_start < vw.stall_timeout_s:
             return
-        if sm is not None:
+        if scope_info is not None:
+            live, targets = scope_info
+            anchor = next((r for r in live if hasattr(r, "_comm")), None)
+            if anchor is None:
+                return
+            comm = anchor._comm
+            tag = getattr(anchor, "_tag", -1)  # ANY_TAG when unknowable
+            coll = getattr(anchor, "kind", None)
+            site = "<waitany drain>"
+            for r in live:
+                vi = getattr(r, "_vinfo", None)
+                if vi is not None and vi.site:
+                    site = vi.site
+                    break
+        elif sm is not None:
             comm, tag, coll = sm._comm, sm._tag, sm.kind
             site = f"<nbc:{sm.kind} state machine>"
             targets = set(sm_targets)

@@ -45,22 +45,30 @@ def payload_nbytes(obj: Any) -> Optional[int]:
 
 
 class Mailbox:
-    """Thread-safe matching queue of (src, ctx, tag, payload) messages."""
+    """Thread-safe matching queue of (src, ctx, tag, payload, stamp)
+    messages.  ``stamp`` is the sender's vector-clock stamp under verify
+    mode and None otherwise (mpi_tpu/verify/vclock.py)."""
 
     def __init__(self) -> None:
         self._lock = threading.Lock()
         self._cv = threading.Condition(self._lock)
-        self._items: List[Tuple[int, int, int, Any]] = []
+        self._items: List[Tuple[int, int, int, Any, Any]] = []
         self._closed = False
         # lifetime delivery count: the runtime verifier's cheap progress
         # stamp (mpi_tpu/verify/deadlock.py) — a "blocked" rank whose
         # mailbox keeps receiving is matching-starved, not deadlocked,
         # and the confirm pass uses the stamp to tell the two apart
         self.deliveries = 0
+        # receiver-side vector clock, attached by verify.enable(): the
+        # consume scan merges each consumed stamp and runs the wildcard
+        # race check against the pending alternates it can see under
+        # this lock.  None outside verify mode (zero cost).
+        self.clock = None
 
-    def deliver(self, src: int, ctx: int, tag: int, payload: Any) -> None:
+    def deliver(self, src: int, ctx: int, tag: int, payload: Any,
+                stamp: Any = None) -> None:
         with self._cv:
-            self._items.append((src, ctx, tag, payload))
+            self._items.append((src, ctx, tag, payload, stamp))
             self.deliveries += 1
             self._cv.notify_all()
 
@@ -90,7 +98,7 @@ class Mailbox:
 
     @staticmethod
     def _matches(item, source: int, ctx, tag: int) -> bool:
-        s, c, t, _ = item
+        s, c, t = item[0], item[1], item[2]
         if c != ctx:
             return False
         if source != ANY_SOURCE and s != source:
@@ -105,9 +113,22 @@ class Mailbox:
         Caller holds the lock."""
         for i, item in enumerate(self._items):
             if self._matches(item, source, ctx, tag):
-                s, _, t, payload = item
+                s, _, t, payload, stamp = item
                 if consume:
                     self._items.pop(i)
+                    if self.clock is not None and stamp is not None:
+                        # verify mode: merge the consumed stamp; for a
+                        # USER wildcard receive, every other pending
+                        # message this scan could equally have matched
+                        # is a race candidate (internal negative tags
+                        # are exact-matched and never race)
+                        wild = (source == ANY_SOURCE
+                                and (tag >= 0 or tag == ANY_TAG))
+                        alts = ([(it[0], it[4]) for it in self._items
+                                 if self._matches(it, ANY_SOURCE, ctx, tag)
+                                 and it[0] != s and it[4] is not None]
+                                if wild else ())
+                        self.clock.note_consume(s, t, stamp, alts, wild)
                 return payload, s, t
         return None
 
@@ -129,7 +150,8 @@ class Mailbox:
                 else:
                     remaining = deadline - time.monotonic()
                     if remaining <= 0:
-                        pending = [(s, c, t) for s, c, t, _ in self._items[:16]]
+                        pending = [(s, c, t) for s, c, t, _, _ in
+                                   self._items[:16]]
                         raise RecvTimeout(
                             f"{what}(source={source}, ctx={ctx}, tag={tag}) timed "
                             f"out after {timeout}s; pending={pending}"
@@ -198,13 +220,13 @@ class Mailbox:
 
     def pending_summary(self) -> List[Tuple[int, int, int]]:
         with self._lock:
-            return [(s, c, t) for s, c, t, _ in self._items[:16]]
+            return [(s, c, t) for s, c, t, _, _ in self._items[:16]]
 
     def drain(self) -> List[Tuple[int, int, int]]:
         """Return and clear all pending (src, ctx, tag) — used by the finalize
         'unexpected message' check (sanitizer analogue, SURVEY.md §5)."""
         with self._lock:
-            items = [(s, c, t) for s, c, t, _ in self._items]
+            items = [(s, c, t) for s, c, t, _, _ in self._items]
             self._items.clear()
             return items
 
@@ -256,6 +278,14 @@ class Transport(ABC):
     # the wrapper must never advertise the inner reader's pairing.
     recv_steering = False
     recv_registry = None
+
+    # Verify-mode vector clock (mpi_tpu/verify/vclock.py), attached by
+    # verify.enable() together with mailbox.clock.  Send paths test
+    # exactly ``verify_clock is None`` (the off-mode cost contract) and
+    # under verify either wrap the wire ctx (remote framing) or pass
+    # tick_send()'s stamp straight to mailbox.deliver (same-process
+    # deliveries, which never reserialize the ctx).
+    verify_clock = None
 
     def __init__(self, world_rank: int, world_size: int) -> None:
         self.world_rank = world_rank

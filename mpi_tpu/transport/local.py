@@ -46,7 +46,10 @@ class LocalTransport(Transport):
             raise ValueError(f"dest {dest} out of range for world size {self.world_size}")
         if self._world.copy_payloads:
             payload = copy.deepcopy(payload)
-        self._world.mailboxes[dest].deliver(self.world_rank, ctx, tag, payload)
+        vc = self.verify_clock
+        stamp = vc.tick_send() if vc is not None else None
+        self._world.mailboxes[dest].deliver(self.world_rank, ctx, tag,
+                                            payload, stamp)
 
     def close(self) -> None:
         self.mailbox.close()

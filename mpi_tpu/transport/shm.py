@@ -308,8 +308,9 @@ class ShmTransport(Transport):
         return reg.note_frame(src, ctx, tag, seq,
                               self._rx_gen.get(src, 0), plan), True
 
-    def _read_frame(self, src: int, ring: int) -> Tuple[Any, int, Any]:
-        """Read one complete frame (header already known present).
+    def _read_frame(self, src: int, ring: int) -> Tuple[Any, int, Any, Any]:
+        """Read one complete frame (header already known present);
+        returns (ctx, tag, payload, vclock-stamp-or-None).
 
         Small frames (body ≤ _SMALL) are pulled in exactly TWO native
         calls — header word, then the whole body into one buffer parsed
@@ -322,17 +323,21 @@ class ShmTransport(Transport):
         self._read_exact(ring, ctypes.addressof(hdr), _LEN.size, src)
         (word,) = _LEN.unpack(hdr.raw)
         body = word & codec.LEN_MASK
+        vc = self.verify_clock
+        stamp = None
         try:
             if word & codec.RAW_FLAG:
                 if body <= _SMALL:
                     buf = ctypes.create_string_buffer(body)
                     self._read_exact(ring, ctypes.addressof(buf), body, src)
                     ctx, tag, out = codec.parse_raw_body(buf.raw)
+                    if vc is not None:
+                        ctx, stamp = vc.unwrap(ctx)
                     # small frames never steer (the whole-body read
                     # already happened) but still count, so the
                     # frame/consumer pairing stays aligned
                     self._note_counted(src, ctx, tag, None)
-                    return ctx, tag, out
+                    return ctx, tag, out, stamp
                 mbuf = ctypes.create_string_buffer(codec.META.size)
                 self._read_exact(ring, ctypes.addressof(mbuf),
                                  codec.META.size, src)
@@ -340,6 +345,10 @@ class ShmTransport(Transport):
                 meta = ctypes.create_string_buffer(mlen)
                 self._read_exact(ring, ctypes.addressof(meta), mlen, src)
                 ctx, tag, plan = codec.parse_raw_meta(meta.raw)
+                if vc is not None:
+                    # unwrap BEFORE the steering consult: the posted-recv
+                    # registry keys on the real ctx
+                    ctx, stamp = vc.unwrap(ctx)
                 total = codec.plan_nbytes(plan)
                 if codec.META.size + mlen + total != body:
                     raise ValueError(
@@ -382,7 +391,7 @@ class ShmTransport(Transport):
                                  attrs={"src": src, "tag": tag,
                                         "nbytes": total,
                                         "transport": "shm"})
-                    return ctx, tag, out
+                    return ctx, tag, out, stamp
                 out = codec.alloc_raw(plan)
                 if counted and plan[0] in ("arr", "segs") \
                         and rec is not None:
@@ -392,15 +401,17 @@ class ShmTransport(Transport):
                 for a in codec.raw_destinations(out):
                     if a.nbytes:
                         self._read_exact(ring, a.ctypes.data, a.nbytes, src)
-                return ctx, tag, out
+                return ctx, tag, out, stamp
             payload = ctypes.create_string_buffer(body) if body else b""
             if body:
                 self._read_exact(ring, ctypes.addressof(payload), body, src)
             ctx, tag, obj = pickle.loads(payload.raw if body else b"")
+            if vc is not None:
+                ctx, stamp = vc.unwrap(ctx)
             # pickle frames on counted channels still count (never
             # steerable) so the frame/consumer pairing stays aligned
             self._note_counted(src, ctx, tag, None)
-            return ctx, tag, obj
+            return ctx, tag, obj, stamp
         except TransportError:
             raise
         except Exception as e:  # noqa: BLE001 - deliver the diagnosis
@@ -421,8 +432,8 @@ class ShmTransport(Transport):
                 continue
             try:
                 while lib.shmring_avail(ring) >= _LEN.size:
-                    ctx, tag, obj = self._read_frame(src, ring)
-                    self.mailbox.deliver(src, ctx, tag, obj)
+                    ctx, tag, obj, stamp = self._read_frame(src, ring)
+                    self.mailbox.deliver(src, ctx, tag, obj, stamp)
                     progressed = True
             except _PeerDeadMidFrame:
                 self._dead_srcs.add(src)
@@ -730,13 +741,21 @@ class ShmTransport(Transport):
             if tag < 0 or (reg.user_count
                            and reg.user_active(dest, ctx, tag)):
                 reg.note_local(dest, ctx, tag)
-            self.mailbox.deliver(dest, ctx, tag, codec.value_copy(payload))
+            vc = self.verify_clock
+            stamp = vc.tick_send() if vc is not None else None
+            self.mailbox.deliver(dest, ctx, tag, codec.value_copy(payload),
+                                 stamp)
             # ring our own bell: a thread parked in _match_loop's
             # doorbell-wait branch (lost the progress-lock race) waits on
             # the bell, not the mailbox cv — without this it would sleep
             # its full nap slice before noticing the local delivery
             self._lib.shmdb_ring(self._db)
             return
+        vc = self.verify_clock
+        if vc is not None:
+            # stamp rides inside the frame (the ctx slot of the meta /
+            # pickle body); the ring reader unwraps right after parse
+            ctx = vc.wrap(ctx)
         frame = codec.pack_raw_frame(ctx, tag, payload)
         if frame is not None:
             head, bufs = frame

@@ -435,6 +435,12 @@ class SocketTransport(Transport):
                     conn.close()
                     return
                 ctx, tag, plan = codec.parse_raw_meta(meta)
+                vc = self.verify_clock
+                stamp = None
+                if vc is not None:
+                    # unwrap BEFORE the steering consult: the posted-recv
+                    # registry keys on the real ctx
+                    ctx, stamp = vc.unwrap(ctx)
                 total = codec.plan_nbytes(plan)
                 if codec.META.size + mlen + total != plen:
                     # a frame whose meta disagrees with the length word
@@ -520,7 +526,8 @@ class SocketTransport(Transport):
                         rec.emit("recvpool", "fallback",
                                  attrs={"src": src, "seq": seq,
                                         "tag": tag, "nbytes": total})
-                self._deliver_seq(conn, src, seq, ctx, tag, out, gen)
+                self._deliver_seq(conn, src, seq, ctx, tag, out, gen,
+                                  stamp)
                 continue
             payload, _ = _recv_exact2(conn, plen)
             if payload is None:
@@ -528,16 +535,21 @@ class SocketTransport(Transport):
                 conn.close()
                 return
             ctx, tag, obj = pickle.loads(payload)
+            vc = self.verify_clock
+            stamp = None
+            if vc is not None:
+                ctx, stamp = vc.unwrap(ctx)
             if (tag < 0 or (reg.user_count
                             and reg.user_active(src, ctx, tag))) \
                     and self._link.rx_fresh(src, seq, gen):
                 # pickle frames on counted channels still count (never
                 # steerable) so the frame/consumer pairing stays aligned
                 reg.note_frame(src, ctx, tag, seq, gen, None)
-            self._deliver_seq(conn, src, seq, ctx, tag, obj, gen)
+            self._deliver_seq(conn, src, seq, ctx, tag, obj, gen, stamp)
 
     def _deliver_seq(self, conn: socket.socket, src: int, seq: int,
-                     ctx, tag: int, obj: Any, gen: int) -> None:
+                     ctx, tag: int, obj: Any, gen: int,
+                     stamp: Any = None) -> None:
         """Sequenced delivery: contiguous frames reach the mailbox,
         replay duplicates (and frames from a since-purged incarnation's
         connection) are dropped, a gap is a loud protocol error
@@ -549,7 +561,8 @@ class SocketTransport(Transport):
         streaming into kernel buffers nobody drains."""
         try:
             delivered = self._link.rx_gate(
-                src, seq, lambda: self.mailbox.deliver(src, ctx, tag, obj),
+                src, seq,
+                lambda: self.mailbox.deliver(src, ctx, tag, obj, stamp),
                 gen)
         except TransportError:
             conn.close()
@@ -1007,8 +1020,18 @@ class SocketTransport(Transport):
             if tag < 0 or (reg.user_count
                            and reg.user_active(dest, ctx, tag)):
                 reg.note_local(dest, ctx, tag)
-            self.mailbox.deliver(dest, ctx, tag, codec.value_copy(payload))
+            vc = self.verify_clock
+            stamp = vc.tick_send() if vc is not None else None
+            self.mailbox.deliver(dest, ctx, tag, codec.value_copy(payload),
+                                 stamp)
             return
+        vc = self.verify_clock
+        if vc is not None:
+            # stamp rides inside the frame (the ctx slot of the meta /
+            # pickle body); the reader unwraps right after parse, so
+            # replays of retained frames deliver the stamp exactly once
+            # through the rx_gate dedup
+            ctx = vc.wrap(ctx)
         frame = codec.pack_raw_frame(ctx, tag, payload)
         if frame is not None:
             # the ndarrays ride whole (not pre-cast to memoryviews):

@@ -25,10 +25,22 @@ here) into a real subsystem:
   across pending nonblocking ops (the message-race case), and unfreed
   communicators — reported through ``verify_*`` pvars and the
   finalize-time report (:func:`take_report` / :func:`finalize_report`).
-* **Static lint** (:mod:`.lint` + ``tools/mpilint.py``): an AST pass
-  flagging rank-conditional collectives, send-send cycles between
-  literal rank pairs, literal count truncation, and operations on
-  possibly-revoked comms without an error handler.
+* **Static lint v2** (:mod:`.lint` on the :mod:`.dataflow` +
+  :mod:`.commgraph` engine, CLI ``tools/mpilint.py``): rules
+  MPL001–MPL009 — collective schedule divergence, send-send cycles,
+  count truncation, revoked-comm use, unwaited nonblocking requests,
+  buffer reuse under a live request, unmatchable tag pairs,
+  rank-dependent collective loops, and racy ``ANY_SOURCE`` receives —
+  now firing on SYMBOLIC ranks (``r = c.rank``, ``(c.rank + 1) %
+  c.size``, rank-guarded helpers) via guard-chain + constant/rank
+  propagation, not just literals.
+* **Wildcard-race detection** (:mod:`.vclock`): verify mode piggybacks
+  a per-rank vector clock on every frame; an ``ANY_SOURCE`` receive
+  that consumes a message CONCURRENT with another eligible pending
+  sender (no happens-before edge between the sends) is reported as a
+  named nondeterminism race — the ``verify_wildcard_races`` pvar, a
+  finalize report line naming both candidate senders, and a trace
+  event.  MPL009's static "maybe", observed at runtime.
 
 Enable with ``MPI_TPU_VERIFY=1`` under the launcher (or
 ``python -m mpi_tpu.launcher --verify``), ``run_local(...,
@@ -52,11 +64,12 @@ from .collcheck import TAG_VERIFY
 from .lint import Finding, lint_file, lint_paths, lint_source
 from .state import (CommVerify, FileBoard, MemoryBoard, WorldVerify,
                     finalize_report, peek_report, take_report, user_site)
+from .vclock import VClock
 
 __all__ = [
     "enable", "is_enabled", "take_report", "peek_report", "finalize_report",
     "user_site",
-    "MemoryBoard", "FileBoard", "WorldVerify", "CommVerify",
+    "MemoryBoard", "FileBoard", "WorldVerify", "CommVerify", "VClock",
     "DeadlockError", "CollectiveMismatchError", "TAG_VERIFY",
     "Finding", "lint_source", "lint_file", "lint_paths",
     # the folded-in seed: schedule checking + trace-based matching
@@ -94,5 +107,27 @@ def enable(comm, board=None, rdv_dir: Optional[str] = None,
             _state._STALL_TIMEOUT_S if stall_timeout_s is None
             else stall_timeout_s)
         comm._t._verify_world = world
+    _attach_clock(comm._t)
     comm._verify = CommVerify(world)
     return comm
+
+
+def _attach_clock(transport) -> None:
+    """Attach one per-rank :class:`VClock` to the transport stack (the
+    wildcard-race detector's send stamp + consume merge).  Wrapper
+    transports (FaultyTransport, TracingTransport) delegate ``send`` to
+    their inner transport, so the clock must sit on EVERY layer down the
+    ``inner`` chain — they all share one mailbox, which gets the same
+    clock as its consume-side merge point.  Idempotent."""
+    t = transport
+    if getattr(t, "verify_clock", None) is not None:
+        return
+    vc = VClock(t.world_rank, t.world_size)
+    seen = set()
+    while t is not None and id(t) not in seen:
+        seen.add(id(t))
+        t.verify_clock = vc
+        mb = getattr(t, "mailbox", None)
+        if mb is not None:
+            mb.clock = vc
+        t = getattr(t, "inner", None) or getattr(t, "_inner", None)

@@ -1,32 +1,54 @@
-"""Static MPI lint (MPI-Checker style): an AST pass over user programs.
+"""Static MPI lint v2 (MUST / MPI-Checker style), grounded on a dataflow
+engine instead of literal pattern-matching.
 
-Four checks, deliberately literal-only (no dataflow guessing — every
-finding is a pattern a reviewer can confirm by reading the flagged
-lines; suppress a deliberate one with ``# mpilint: ok`` on the flagged
-line or the line above):
+v1 (PR 5) matched the literal ``if c.rank == 0:`` shape and nothing
+else.  v2 runs every program through :mod:`mpi_tpu.verify.dataflow`
+(guard chains + constant/rank propagation + a one-level call graph) and
+:mod:`mpi_tpu.verify.commgraph` (per-model-rank schedules + match
+rules), so ``r = c.rank; if r == 0:``, ``peer = (c.rank + 1) % c.size``
+and rank-guarded helper functions resolve exactly.  Undecidable facts
+never fire a rule — every finding is still something a reviewer can
+confirm by reading the flagged lines.  Suppress a deliberate one with
+``# mpilint: ok`` on the flagged line or the line above.
 
-* **MPL001 — rank-conditional collective**: a collective call on ``c``
-  inside an ``if`` whose condition tests ``c.rank``, with no matching
-  call of the same collective on ``c`` in the other branch.  Collective
-  schedules must be entered by every rank; a rank-conditional entry is
-  the divergent-order hang the runtime matcher catches dynamically.
-* **MPL002 — send-send cycle**: literal rank-pair branches (``if c.rank
-  == A: ... elif c.rank == B: ...``) where BOTH ranks blocking-send to
-  each other before either receives — legal under this library's
-  buffered sends, but a deadlock under MPI's synchronous/rendezvous
-  sends and any bounded-buffer transport; use ``sendrecv``.
-* **MPL003 — literal count truncation**: a typed ``MPI_Send(...,
-  count=N)`` to literal rank B paired with B's ``MPI_Recv(...,
-  count=M)`` from the sender with ``M < N`` — the receive silently
+The rules:
+
+* **MPL001 — collective schedule divergence**: under the resolved rank
+  conditions, some rank reaches a collective on ``c`` that other ranks
+  never post (or posts a different one at the same position) — the
+  divergent-order hang the runtime matcher catches dynamically.
+* **MPL002 — send-send cycle**: two ranks whose first operation toward
+  each other is a blocking send, both later receiving — legal under
+  this library's buffered sends, but a deadlock under MPI's
+  synchronous/rendezvous sends and any bounded-buffer transport; use
+  ``sendrecv``.
+* **MPL003 — count truncation**: a matched send/recv pair whose receive
+  count is smaller than the send count — the receive silently
   truncates.
 * **MPL004 — revoked comm without an error handler**: a p2p/collective
   call on a comm after ``c.revoke()`` appears, with no
   ``set_errhandler`` on it and outside any ``try``: every post-revoke
   call raises RevokedError, so unhandled it just moves the crash.
+* **MPL005 — unwaited nonblocking request**: an ``isend/irecv/i*``
+  request that reaches a function exit without ``wait()``/``test()``
+  along at least one CFG path (branch joins are may-unions, so a
+  request waited on only one side of an ``if`` still fires).
+* **MPL006 — buffer reuse under a live request**: a write into a
+  buffer while a nonblocking operation on it may still be in flight.
+* **MPL007 — unmatchable tag pair**: a send and an exact-tag receive on
+  the same channel whose tags can never match each other.
+* **MPL008 — rank-dependent collective loop**: a collective inside a
+  loop whose trip count depends on the rank — ranks execute different
+  numbers of collectives.
+* **MPL009 — racy ANY_SOURCE receive**: a wildcard receive with two or
+  more eligible same-tag senders; the match order is nondeterministic
+  (the runtime wildcard-race detector observes the same race via
+  vector clocks — see ``mpi_tpu.verify.vclock``).
 
 ``lint_source``/``lint_paths`` return :class:`Finding` lists; the CLI is
-``tools/mpilint.py`` (wired into ``tools/check.sh`` over ``examples/``
-and ``mpi_tpu/``).
+``tools/mpilint.py`` (``--format json``, ``--baseline``), wired into
+``tools/check.sh`` over ``examples/``, ``mpi_tpu/``, ``tests/`` and
+``benchmarks/`` against ``tools/lint_baseline.json``.
 """
 
 from __future__ import annotations
@@ -35,11 +57,9 @@ import ast
 import os
 from typing import Dict, List, NamedTuple, Optional, Sequence, Tuple
 
-COLLECTIVES = frozenset({
-    "bcast", "reduce", "allreduce", "allgather", "allgatherv", "alltoall",
-    "alltoallv", "barrier", "scan", "exscan", "reduce_scatter", "scatter",
-    "scatterv", "gather", "gatherv", "maxloc", "minloc",
-})
+from . import commgraph, dataflow
+
+COLLECTIVES = dataflow.COLLECTIVES
 _P2P_OR_COLL = COLLECTIVES | frozenset({
     "send", "recv", "sendrecv", "isend", "irecv", "probe", "iprobe",
     "shift", "exchange", "split", "dup",
@@ -64,17 +84,12 @@ def _method_call(node: ast.AST) -> Optional[Tuple[str, str, ast.Call]]:
     return None
 
 
-def _rank_cond_name(test: ast.AST) -> Optional[str]:
-    """Receiver name when the expression mentions ``<name>.rank``."""
-    for n in ast.walk(test):
-        if (isinstance(n, ast.Attribute) and n.attr == "rank"
-                and isinstance(n.value, ast.Name)):
-            return n.value.id
-    return None
-
-
 def _rank_eq_literal(test: ast.AST) -> Optional[Tuple[str, int]]:
-    """(name, K) for a test of the exact form ``name.rank == K``."""
+    """(name, K) for a test of the exact form ``name.rank == K``.
+
+    This was the ONLY guard shape v1 resolved; it is kept as the legacy
+    reference predicate so tests can demonstrate v1-blind/v2-caught on
+    the symbolic corpus variants."""
     if not (isinstance(test, ast.Compare) and len(test.ops) == 1
             and isinstance(test.ops[0], ast.Eq)):
         return None
@@ -87,18 +102,6 @@ def _rank_eq_literal(test: ast.AST) -> Optional[Tuple[str, int]]:
         elif isinstance(s, ast.Constant) and isinstance(s.value, int):
             lit = s.value
     return (name, lit) if name is not None and lit is not None else None
-
-
-def _int_arg(call: ast.Call, kw: str, pos: Optional[int]) -> Optional[int]:
-    for k in call.keywords:
-        if k.arg == kw and isinstance(k.value, ast.Constant) \
-                and isinstance(k.value.value, int):
-            return k.value.value
-    if pos is not None and len(call.args) > pos:
-        a = call.args[pos]
-        if isinstance(a, ast.Constant) and isinstance(a.value, int):
-            return a.value
-    return None
 
 
 def _calls_in(nodes: Sequence[ast.AST], *, into_defs: bool = False):
@@ -132,177 +135,60 @@ def lint_source(src: str, filename: str = "<string>") -> List[Finding]:
         return [Finding(filename, e.lineno or 0, "MPL000",
                         f"syntax error: {e.msg}")]
     findings: List[Finding] = []
-    findings += _check_rank_conditional_collectives(tree, filename)
-    for scope in _scopes(tree):
-        branches = _rank_literal_branches(scope)
-        findings += _check_send_send_cycles(branches, filename)
-        findings += _check_count_truncation(branches, filename)
+
+    # engine-grounded rules: MPL001/002/003/007/009 off the match graph,
+    # MPL008 off the loop evidence the op walk collects
+    roots, rank_loops = dataflow.collect_roots(tree)
+    for cg in commgraph.analyze(roots):
+        findings.append(Finding(filename, cg.line, cg.code, cg.msg))
+    for rl in rank_loops:
+        findings.append(Finding(
+            filename, rl.line, "MPL008",
+            f"collective {rl.comm}.{rl.name}() inside a loop (line "
+            f"{rl.loop_line}) whose trip count depends on {rl.comm}.rank: "
+            f"ranks execute different numbers of collectives and the "
+            f"schedule diverges"))
+
+    # per-function local rules
     findings += _check_revoked_unhandled(tree, filename)
+    findings += _check_request_flow(tree, filename)
+
     sup = _suppressed(src)
-    return sorted((f for f in findings if f.line not in sup),
-                  key=lambda f: (f.line, f.code))
-
-
-def _scopes(tree: ast.Module):
-    yield tree
-    for n in ast.walk(tree):
-        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)):
-            yield n
-
-
-# -- MPL001 ------------------------------------------------------------------
-
-def _branch_collectives(nodes: Sequence[ast.AST]) -> Dict[Tuple[str, str],
-                                                          int]:
-    out: Dict[Tuple[str, str], int] = {}
-    for call in _calls_in(nodes):
-        mc = _method_call(call)
-        if mc and mc[1] in COLLECTIVES:
-            out.setdefault((mc[0], mc[1]), call.lineno)
-    return out
-
-
-def _check_rank_conditional_collectives(tree, filename) -> List[Finding]:
-    findings = []
-    for node in ast.walk(tree):
-        if not isinstance(node, ast.If):
-            continue
-        comm = _rank_cond_name(node.test)
-        if comm is None:
-            continue
-        body = _branch_collectives(node.body)
-        other = _branch_collectives(node.orelse)
-        for (recv_name, meth), line in sorted(body.items(),
-                                              key=lambda kv: kv[1]):
-            if recv_name == comm and (recv_name, meth) not in other:
-                findings.append(Finding(
-                    filename, line, "MPL001",
-                    f"collective {recv_name}.{meth}() is conditional on "
-                    f"{comm}.rank with no matching {meth}() in the other "
-                    f"branch — non-calling ranks diverge from the "
-                    f"collective schedule (hang/mismatch)"))
-        for (recv_name, meth), line in sorted(other.items(),
-                                              key=lambda kv: kv[1]):
-            if recv_name == comm and (recv_name, meth) not in body:
-                findings.append(Finding(
-                    filename, line, "MPL001",
-                    f"collective {recv_name}.{meth}() runs only when the "
-                    f"{comm}.rank test is false, with no matching "
-                    f"{meth}() in the taken branch — ranks diverge from "
-                    f"the collective schedule (hang/mismatch)"))
-    return findings
-
-
-# -- rank-literal branch collection (MPL002/003) -----------------------------
-
-class _Op(NamedTuple):
-    kind: str        # 'send' | 'recv'
-    peer: Optional[int]
-    count: Optional[int]
-    line: int
-
-
-def _branch_ops(comm: str, nodes: Sequence[ast.AST]) -> List[_Op]:
-    ops = []
-    for call in _calls_in(nodes):
-        mc = _method_call(call)
-        if mc and mc[0] == comm:
-            _, meth, c = mc
-            if meth == "send":
-                ops.append(_Op("send", _int_arg(c, "dest", 1), None,
-                               c.lineno))
-            elif meth == "recv":
-                ops.append(_Op("recv", _int_arg(c, "source", 0), None,
-                               c.lineno))
-        elif isinstance(call.func, ast.Name):
-            if call.func.id == "MPI_Send":
-                ops.append(_Op("send", _int_arg(call, "dest", 1),
-                               _int_arg(call, "count", None), call.lineno))
-            elif call.func.id == "MPI_Recv":
-                ops.append(_Op("recv", _int_arg(call, "source", 0),
-                               _int_arg(call, "count", None), call.lineno))
-    return sorted(ops, key=lambda o: o.line)
-
-
-def _rank_literal_branches(scope) -> Dict[Tuple[str, int], List[_Op]]:
-    """rank-literal branch bodies of one scope: (comm, K) -> ordered
-    send/recv ops, merged across every ``if comm.rank == K`` in it."""
-    branches: Dict[Tuple[str, int], List[_Op]] = {}
-    stack = list(ast.iter_child_nodes(scope))
-    while stack:
-        n = stack.pop()
-        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
-                          ast.ClassDef)) and n is not scope:
-            continue
-        if isinstance(n, ast.If):
-            hit = _rank_eq_literal(n.test)
-            if hit is not None:
-                comm, k = hit
-                branches.setdefault((comm, k), []).extend(
-                    _branch_ops(comm, n.body))
-        stack.extend(ast.iter_child_nodes(n))
-    for ops in branches.values():
-        ops.sort(key=lambda o: o.line)
-    return branches
-
-
-# -- MPL002 ------------------------------------------------------------------
-
-def _first_line(ops: List[_Op], kind: str, peer: int) -> Optional[int]:
-    for o in ops:
-        if o.kind == kind and o.peer == peer:
-            return o.line
-    return None
-
-
-def _check_send_send_cycles(branches, filename) -> List[Finding]:
-    findings = []
     seen = set()
-    for (comm, a), ops_a in branches.items():
-        for (comm_b, b), ops_b in branches.items():
-            if comm_b != comm or b <= a or (comm, a, b) in seen:
-                continue
-            sa, ra = _first_line(ops_a, "send", b), _first_line(ops_a, "recv", b)
-            sb, rb = _first_line(ops_b, "send", a), _first_line(ops_b, "recv", a)
-            if None in (sa, ra, sb, rb):
-                continue
-            if sa < ra and sb < rb:
-                seen.add((comm, a, b))
-                findings.append(Finding(
-                    filename, sa, "MPL002",
-                    f"send-send cycle: rank {a} sends to {b} (line {sa}) "
-                    f"before receiving from it (line {ra}) while rank {b} "
-                    f"sends to {a} (line {sb}) before receiving (line "
-                    f"{rb}) — deadlocks under synchronous/rendezvous "
-                    f"sends; use {comm}.sendrecv()"))
-    return findings
-
-
-# -- MPL003 ------------------------------------------------------------------
-
-def _check_count_truncation(branches, filename) -> List[Finding]:
-    findings = []
-    for (comm, a), ops_a in branches.items():
-        for (comm_b, b), ops_b in branches.items():
-            if comm_b != comm:
-                continue
-            sends = [o for o in ops_a if o.kind == "send" and o.peer == b
-                     and o.count is not None]
-            recvs = [o for o in ops_b if o.kind == "recv"
-                     and o.peer in (a, None) and o.count is not None]
-            for s, r in zip(sends, recvs):
-                if r.count < s.count:
-                    findings.append(Finding(
-                        filename, r.line, "MPL003",
-                        f"recv count {r.count} < matching send count "
-                        f"{s.count} (rank {a} line {s.line} -> rank {b}): "
-                        f"the receive truncates the message"))
-    return findings
+    out = []
+    for f in sorted((f for f in findings if f.line not in sup),
+                    key=lambda f: (f.line, f.code)):
+        key = (f.line, f.code)
+        if key not in seen:
+            seen.add(key)
+            out.append(f)
+    return out
 
 
 # -- MPL004 ------------------------------------------------------------------
 
+def _alias_map(tree: ast.Module) -> Dict[str, str]:
+    """Simple name-to-name bindings (``c2 = comm``), so a comm revoked
+    under an alias still pairs with calls through the original name."""
+    out: Dict[str, str] = {}
+    for n in ast.walk(tree):
+        if isinstance(n, ast.Assign) and len(n.targets) == 1 \
+                and isinstance(n.targets[0], ast.Name) \
+                and isinstance(n.value, ast.Name):
+            out[n.targets[0].id] = n.value.id
+    return out
+
+
+def _canon(name: str, aliases: Dict[str, str]) -> str:
+    seen = set()
+    while name in aliases and name not in seen:
+        seen.add(name)
+        name = aliases[name]
+    return name
+
+
 def _check_revoked_unhandled(tree, filename) -> List[Finding]:
+    aliases = _alias_map(tree)
     revoked: Dict[str, int] = {}
     handled: set = set()
     in_try: set = set()
@@ -320,6 +206,7 @@ def _check_revoked_unhandled(tree, filename) -> List[Finding]:
         if mc is None:
             continue
         name, meth, _ = mc
+        name = _canon(name, aliases)
         if meth == "revoke":
             revoked.setdefault(name, call.lineno)
         elif meth == "set_errhandler":
@@ -333,6 +220,7 @@ def _check_revoked_unhandled(tree, filename) -> List[Finding]:
         if mc is None:
             continue
         name, meth, _ = mc
+        name = _canon(name, aliases)
         if (name in revoked and name not in handled and name not in flagged
                 and meth in _P2P_OR_COLL and call.lineno > revoked[name]
                 and id(call) not in in_try):
@@ -344,6 +232,34 @@ def _check_revoked_unhandled(tree, filename) -> List[Finding]:
                 f"try: every operation on a revoked comm raises "
                 f"RevokedError — install set_errhandler or shrink() "
                 f"first"))
+    return findings
+
+
+# -- MPL005 / MPL006 ---------------------------------------------------------
+
+def _check_request_flow(tree, filename) -> List[Finding]:
+    findings = []
+    module_stmts = [s for s in tree.body
+                    if not isinstance(s, (ast.FunctionDef,
+                                          ast.AsyncFunctionDef,
+                                          ast.ClassDef))]
+    bodies = [module_stmts] + [fn.body for fn in dataflow.all_functions(tree)]
+    for body in bodies:
+        for issue in dataflow.request_flow(body):
+            if issue.code == "MPL005":
+                findings.append(Finding(
+                    filename, issue.line, "MPL005",
+                    f"nonblocking {issue.op_name}() request is never "
+                    f"completed along at least one path to exit (no "
+                    f"wait/test reaches it): the operation may never "
+                    f"finish and its resources leak"))
+            else:
+                findings.append(Finding(
+                    filename, issue.line, "MPL006",
+                    f"buffer '{issue.buf}' is written while the "
+                    f"{issue.op_name}() request from line {issue.op_line} "
+                    f"may still be live: complete the request before "
+                    f"reusing its buffer"))
     return findings
 
 

@@ -1,0 +1,11 @@
+"""Near-miss twin: every rank reaches the collective, through the same
+symbolic guard shape as the buggy variant."""
+
+
+def main(comm, data):
+    r = comm.rank
+    if r == 0:
+        out = comm.bcast(data, root=0)
+    else:
+        out = comm.bcast(None, root=0)
+    return out

@@ -1,0 +1,6 @@
+"""Seeded bug: rank 0 alone enters a collective (literal guard)."""
+
+
+def main(comm):
+    if comm.rank == 0:
+        comm.barrier()

@@ -1,0 +1,8 @@
+"""Seeded bug: divergent collective behind a rank-variable guard —
+invisible to a literal-only ``comm.rank == 0`` pattern match."""
+
+
+def main(comm, x):
+    r = comm.rank
+    if r == 0:
+        comm.allreduce(x)

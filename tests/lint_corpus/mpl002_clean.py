@@ -1,0 +1,14 @@
+"""Near-miss twin: same computed peer, but one side receives first —
+the classic safe ordering."""
+
+
+def main(comm):
+    peer = 1 - comm.rank
+    if comm.rank == 0:
+        comm.send(b"x", peer, tag=3)
+        return comm.recv(peer, tag=3)
+    if comm.rank == 1:
+        got = comm.recv(peer, tag=3)
+        comm.send(b"y", peer, tag=3)
+        return got
+    return None

@@ -1,0 +1,13 @@
+"""Seeded bug: head-to-head blocking sends between literal ranks."""
+
+
+def main(comm):
+    if comm.rank == 0:
+        comm.send(b"a", 1, tag=0)
+        got = comm.recv(1, tag=0)
+    elif comm.rank == 1:
+        comm.send(b"b", 0, tag=0)
+        got = comm.recv(0, tag=0)
+    else:
+        got = None
+    return got

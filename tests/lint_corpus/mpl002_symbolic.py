@@ -1,0 +1,11 @@
+"""Seeded bug: the same rendezvous deadlock with a COMPUTED peer —
+``peer = 1 - comm.rank`` resolves to the 0<->1 pair only under
+dataflow."""
+
+
+def main(comm):
+    peer = 1 - comm.rank
+    if comm.rank < 2:
+        comm.send(b"x", peer, tag=3)
+        return comm.recv(peer, tag=3)
+    return None

@@ -1,0 +1,10 @@
+"""Near-miss twin: counts agree through the same variable dataflow."""
+
+
+def main(comm, buf, b, dt):
+    n = 8
+    if comm.rank == 0:
+        MPI_Send(buf, dest=1, datatype=dt, count=n)
+    if comm.rank == 1:
+        return MPI_Recv(source=0, datatype=dt, buf=b, count=n)
+    return None

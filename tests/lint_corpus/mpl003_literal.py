@@ -1,0 +1,9 @@
+"""Seeded bug: typed receive count smaller than the matched send's."""
+
+
+def main(comm, buf, b, dt):
+    if comm.rank == 0:
+        MPI_Send(buf, dest=1, datatype=dt, count=8)
+    if comm.rank == 1:
+        return MPI_Recv(source=0, datatype=dt, buf=b, count=4)
+    return None

@@ -1,0 +1,11 @@
+"""Seeded bug: the truncating count pair expressed through variables —
+``n`` and ``n // 2`` only compare under constant propagation."""
+
+
+def main(comm, buf, b, dt):
+    n = 8
+    if comm.rank == 0:
+        MPI_Send(buf, dest=1, datatype=dt, count=n)
+    if comm.rank == 1:
+        return MPI_Recv(source=0, datatype=dt, buf=b, count=n // 2)
+    return None

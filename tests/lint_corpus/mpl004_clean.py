@@ -1,0 +1,11 @@
+"""Near-miss twin: same alias shape, but the post-revoke operation is
+guarded by a try/except recovery path."""
+
+
+def recover(comm, x):
+    c2 = comm
+    c2.revoke()
+    try:
+        comm.allreduce(x)
+    except Exception:
+        pass

@@ -1,0 +1,7 @@
+"""Seeded bug: collective on a comm revoked earlier in the same scope,
+with no error handling in sight."""
+
+
+def recover(comm, x):
+    comm.revoke()
+    comm.allreduce(x)

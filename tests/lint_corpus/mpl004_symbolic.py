@@ -1,0 +1,9 @@
+"""Seeded bug: the revoke travels through an ALIAS — ``c2`` and
+``comm`` are the same communicator, which only name-alias resolution
+sees."""
+
+
+def recover(comm, x):
+    c2 = comm
+    c2.revoke()
+    comm.allreduce(x)

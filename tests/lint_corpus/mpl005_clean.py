@@ -1,0 +1,11 @@
+"""Near-miss twin: both paths complete the request."""
+
+
+def main(comm, flag):
+    req = comm.irecv(0, tag=1)
+    if flag:
+        return req.wait()
+    done, value = req.test()
+    if not done:
+        value = req.wait()
+    return value

@@ -1,0 +1,6 @@
+"""Seeded bug: a nonblocking send whose request is simply dropped."""
+
+
+def main(comm):
+    req = comm.isend(b"payload", 1, tag=0)
+    comm.barrier()

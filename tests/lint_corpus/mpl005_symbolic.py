@@ -1,0 +1,9 @@
+"""Seeded bug: the request IS waited — but only on one CFG path; the
+``else`` path leaks it.  Literal scanning cannot see paths."""
+
+
+def main(comm, flag):
+    req = comm.irecv(0, tag=1)
+    if flag:
+        return req.wait()
+    return None

@@ -1,0 +1,7 @@
+"""Near-miss twin: the write happens after completion."""
+
+
+def main(comm, buf):
+    req = comm.isend(buf, 1, tag=0)
+    req.wait()
+    buf[0] = 9.9

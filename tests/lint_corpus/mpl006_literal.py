@@ -1,0 +1,8 @@
+"""Seeded bug: the send buffer is overwritten while the isend that
+posted it may still be on the wire."""
+
+
+def main(comm, buf):
+    req = comm.isend(buf, 1, tag=0)
+    buf[0] = 9.9
+    req.wait()

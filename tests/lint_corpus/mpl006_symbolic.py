@@ -1,0 +1,9 @@
+"""Seeded bug: the live-buffer write hides inside a loop's augmented
+assignment — same race, one hop of dataflow away."""
+
+
+def main(comm, buf):
+    req = comm.isend(buf, 1, tag=2)
+    for i in range(4):
+        buf[i] += 1.0
+    req.wait()

@@ -1,0 +1,10 @@
+"""Near-miss twin: same variable tags, and they agree."""
+
+
+def main(comm):
+    t = 5
+    if comm.rank == 0:
+        comm.send(b"m", 1, tag=t)
+    elif comm.rank == 1:
+        return comm.recv(0, tag=t)
+    return None

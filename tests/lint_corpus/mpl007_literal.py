@@ -1,0 +1,9 @@
+"""Seeded bug: the matched pair's tags can never meet (5 vs 6)."""
+
+
+def main(comm):
+    if comm.rank == 0:
+        comm.send(b"m", 1, tag=5)
+    elif comm.rank == 1:
+        return comm.recv(0, tag=6)
+    return None

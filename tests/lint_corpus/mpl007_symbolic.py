@@ -1,0 +1,11 @@
+"""Seeded bug: the mismatch is ``t`` vs ``t + 1`` — only constant
+propagation proves the pair unmatchable."""
+
+
+def main(comm):
+    t = 5
+    if comm.rank == 0:
+        comm.send(b"m", 1, tag=t)
+    elif comm.rank == 1:
+        return comm.recv(0, tag=t + 1)
+    return None

@@ -1,0 +1,7 @@
+"""Near-miss twin: same loop shape, rank-independent trip count."""
+
+
+def main(comm):
+    n = 4
+    for _ in range(n):
+        comm.barrier()

@@ -1,0 +1,7 @@
+"""Seeded bug: a collective inside a loop whose trip count IS the
+rank — every rank executes a different number of barriers."""
+
+
+def main(comm):
+    for _ in range(comm.rank):
+        comm.barrier()

@@ -1,0 +1,8 @@
+"""Seeded bug: the rank-dependent trip count flows through a local
+(``n = comm.size - comm.rank``) before reaching the loop."""
+
+
+def main(comm):
+    n = comm.size - comm.rank
+    for _ in range(n):
+        comm.allreduce(1)

@@ -1,0 +1,10 @@
+"""Near-miss twin: a wildcard receive with exactly ONE eligible sender
+is deterministic — no race to report."""
+
+
+def main(comm):
+    if comm.rank == 0:
+        return comm.recv(ANY_SOURCE, tag=2)
+    if comm.rank == 1:
+        comm.send(b"x", 0, tag=2)
+    return None

@@ -1,0 +1,11 @@
+"""Seeded bug: a wildcard receive two different ranks race to match."""
+
+
+def main(comm):
+    if comm.rank == 0:
+        return comm.recv(ANY_SOURCE, tag=7)
+    if comm.rank == 1:
+        comm.send(b"x", 0, tag=7)
+    if comm.rank == 2:
+        comm.send(b"y", 0, tag=7)
+    return None

@@ -1,0 +1,10 @@
+"""Seeded bug: the wildcard hides behind a variable and the senders
+behind an else-branch over symbolic ranks."""
+
+
+def main(comm):
+    if comm.rank == 0:
+        src = ANY_SOURCE
+        return comm.recv(src, tag=2)
+    comm.send(comm.rank, 0, tag=2)
+    return None

@@ -300,6 +300,44 @@ def test_slow_peer_never_false_positives(_fast_stall):
     assert mpit.pvar_read("verify_deadlocks_detected") == base
 
 
+def test_waitany_publishes_exact_request_set(_fast_stall):
+    """A stalled ``MPI_Waitany`` publishes the OR-set of ITS OWN request
+    list.  Rank 0 posts two tracked irecvs (from 1 and from 2) but
+    drains only the first through Waitany — the published entry must
+    name source 1 alone, never the {1, 2} union over every tracked
+    request (which would accuse rank 2 of blocking a loop that is not
+    waiting for it)."""
+    def prog(comm):
+        if comm.rank == 0:
+            req_a = comm.irecv(1, tag=5)
+            req_b = comm.irecv(2, tag=6)
+            i, v = MPI_Waitany([req_a])
+            assert (i, v) == (0, b"from-1")
+            return req_b.wait()
+        if comm.rank == 1:
+            time.sleep(3.0)  # hold rank 0 in the drain past the stall
+            comm.send(b"from-1", 0, tag=5)
+            return "sent"
+        # rank 2: watch the shared board for rank 0's drain-loop entry,
+        # record the targets it names, then release the second irecv
+        board = comm._t._verify_world.board
+        seen = None
+        deadline = time.time() + 2.5
+        while time.time() < deadline:
+            e = board.read_all().get(0)
+            if e is not None and e.get("kind") == "waitany-poll":
+                seen = list(e.get("targets", ()))
+                break
+            time.sleep(0.05)
+        comm.send(b"from-2", 0, tag=6)
+        return seen
+
+    out = run_local(prog, 3, verify=True, progress="thread", timeout=60)
+    assert out[0] == b"from-2"
+    assert out[1] == "sent"
+    assert out[2] == [1], out[2]
+
+
 def test_posted_irecv_without_polling_never_published(_fast_stall):
     """A rank that posts an irecv and then just computes (no polls) is
     NOT a drain loop: the engine must not publish it, even while a peer
